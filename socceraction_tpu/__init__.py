@@ -1,0 +1,17 @@
+"""socceraction-tpu: a TPU-native soccer action-valuation framework.
+
+A brand-new framework with the capabilities of `socceraction` (reference:
+``/root/reference``, fork of ML-KULeuven/socceraction v1.2.3) redesigned
+around a columnar action-tensor runtime executed with JAX/XLA on TPU:
+
+- :mod:`socceraction_tpu.spadl` -- the SPADL action language: vocabulary,
+  schemas and provider converters.
+- :mod:`socceraction_tpu.core` -- the columnar ``ActionBatch`` tensor bundle
+  that packs seasons of SPADL actions into padded ``(game, action)`` device
+  arrays.
+- :mod:`socceraction_tpu.ops` -- the JAX/XLA kernels for the valuation hot
+  paths (xT value iteration, VAEP feature/label/formula transforms).
+- :mod:`socceraction_tpu.xthreat` -- the Expected Threat (xT) model.
+"""
+
+__version__ = '0.1.0'

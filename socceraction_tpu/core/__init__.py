@@ -1,0 +1,5 @@
+"""Columnar action-tensor runtime core."""
+
+from .batch import ActionBatch, pack_actions, pad_length, unpack_values
+
+__all__ = ['ActionBatch', 'pack_actions', 'pad_length', 'unpack_values']

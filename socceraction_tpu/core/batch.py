@@ -1,0 +1,233 @@
+"""The columnar action-tensor bundle at the heart of the TPU runtime.
+
+The reference operates on one pandas DataFrame per game, row by row. The
+TPU-native design instead packs a whole *collection* of games into a padded
+struct-of-arrays bundle of shape ``(G games, A actions)`` living in HBM:
+
+- integer categorical columns (type/result/bodypart/period) as ``int32``,
+- coordinates and timestamps as ``float32`` (or ``float64`` off-TPU),
+- team identity reduced to an ``is_home`` bool -- soccer has exactly two
+  teams per game, so every team-equality predicate used downstream
+  (possession flags in features, label team checks, formula team continuity)
+  is equivalent to equality of ``is_home`` flags,
+- a validity ``mask`` plus per-game length vector for the padding.
+
+Games are left-aligned and padded to a common ``A`` (rounded up to a
+multiple of 128 to keep the TPU lane dimension aligned). Every valuation
+kernel in :mod:`socceraction_tpu.ops` is written per-game on ``(A,)`` arrays
+and ``jax.vmap``-ed over the game axis; the game axis is the data-parallel
+sharding axis (see :mod:`socceraction_tpu.parallel`).
+
+This replaces the reference's per-game DataFrame plumbing (e.g.
+``socceraction/vaep/base.py:97-137`` computing features game by game).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+from flax import struct
+
+__all__ = ['ActionBatch', 'pack_actions', 'unpack_values', 'pad_length']
+
+# TPU vector lanes are 128 wide; keeping the action axis a multiple of 128
+# lets XLA tile elementwise kernels without a ragged remainder.
+_LANE = 128
+
+
+def pad_length(n: int, multiple: int = _LANE) -> int:
+    """Round ``n`` up to a multiple of ``multiple`` (minimum one tile)."""
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+@struct.dataclass
+class ActionBatch:
+    """A padded ``(G, A)`` struct-of-arrays bundle of SPADL actions.
+
+    All per-action fields have shape ``(G, A)``; per-game fields ``(G,)``.
+    """
+
+    # per-action categorical / ordinal
+    type_id: jax.Array  # int32
+    result_id: jax.Array  # int32
+    bodypart_id: jax.Array  # int32
+    period_id: jax.Array  # int32
+    is_home: jax.Array  # bool: team_id == home_team_id
+    # per-action continuous
+    time_seconds: jax.Array  # float
+    start_x: jax.Array  # float
+    start_y: jax.Array  # float
+    end_x: jax.Array  # float
+    end_y: jax.Array  # float
+    # padding bookkeeping
+    mask: jax.Array  # bool (G, A): True on valid rows
+    n_actions: jax.Array  # int32 (G,): valid rows per game
+    # host-side identity (static, not involved in kernels)
+    game_id: jax.Array  # (G,) int64-as-int32-safe identifier index
+    row_index: jax.Array  # (G, A) int32: positional row in the source frame (-1 pad)
+
+    @property
+    def n_games(self) -> int:
+        return self.type_id.shape[0]
+
+    @property
+    def max_actions(self) -> int:
+        return self.type_id.shape[1]
+
+    @property
+    def total_actions(self) -> int:
+        """Total number of valid (unpadded) actions, as a host int."""
+        return int(np.asarray(jax.device_get(self.n_actions)).sum())
+
+    def astype(self, float_dtype: Any) -> 'ActionBatch':
+        """Return a copy with the continuous fields cast to ``float_dtype``."""
+        return self.replace(
+            time_seconds=self.time_seconds.astype(float_dtype),
+            start_x=self.start_x.astype(float_dtype),
+            start_y=self.start_y.astype(float_dtype),
+            end_x=self.end_x.astype(float_dtype),
+            end_y=self.end_y.astype(float_dtype),
+        )
+
+
+_FLOAT_COLS = ('time_seconds', 'start_x', 'start_y', 'end_x', 'end_y')
+_INT_COLS = ('type_id', 'result_id', 'bodypart_id', 'period_id')
+
+
+def pack_actions(
+    actions: pd.DataFrame,
+    home_team_ids: Optional[Dict[Any, Any]] = None,
+    *,
+    home_team_id: Optional[Any] = None,
+    max_actions: Optional[int] = None,
+    float_dtype: Any = np.float32,
+    device: Optional[Any] = None,
+) -> Tuple[ActionBatch, List[Any]]:
+    """Pack a SPADL DataFrame (one or many games) into an :class:`ActionBatch`.
+
+    Parameters
+    ----------
+    actions : pd.DataFrame
+        SPADL actions, ordered within each game. May contain any number of
+        games (distinguished by ``game_id``).
+    home_team_ids : dict, optional
+        Mapping ``game_id -> home_team_id``. Required for multi-game frames
+        unless ``home_team_id`` is given.
+    home_team_id : optional
+        Home team for a single-game frame (reference-style call sites pass
+        one game plus its home team).
+    max_actions : int, optional
+        Pad/clamp the action axis to this length. Defaults to the longest
+        game rounded up to a lane multiple.
+    float_dtype
+        dtype of continuous fields (float32 on TPU, float64 for parity runs).
+    device : optional
+        If given, ``jax.device_put`` the batch onto this device/sharding.
+
+    Returns
+    -------
+    (ActionBatch, list)
+        The packed batch and the list of game_ids in game-axis order.
+    """
+    if 'game_id' not in actions.columns:
+        raise ValueError('actions frame must contain a game_id column')
+
+    # Stable game order: order of first appearance.
+    game_ids = list(dict.fromkeys(actions['game_id'].tolist()))
+    n_games = len(game_ids)
+    if n_games == 0:
+        raise ValueError('cannot pack an empty actions frame')
+
+    if home_team_ids is None:
+        if home_team_id is not None:
+            home_team_ids = {g: home_team_id for g in game_ids}
+        elif 'home_team_id' in actions.columns:
+            home_team_ids = (
+                actions.groupby('game_id', sort=False)['home_team_id'].first().to_dict()
+            )
+        else:
+            raise ValueError('home_team_ids (or home_team_id) is required')
+
+    counts = actions.groupby('game_id', sort=False).size()
+    counts = counts.reindex(game_ids)
+    longest = int(counts.max())
+    A = max_actions if max_actions is not None else pad_length(longest)
+    if longest > A:
+        raise ValueError(f'game of length {longest} exceeds max_actions={A}')
+
+    def alloc(dtype, fill=0):
+        return np.full((n_games, A), fill, dtype=dtype)
+
+    cols = {c: alloc(float_dtype) for c in _FLOAT_COLS}
+    cols.update({c: alloc(np.int32) for c in _INT_COLS})
+    is_home = alloc(bool, False)
+    mask = alloc(bool, False)
+    row_index = alloc(np.int32, -1)
+    n_actions = np.zeros(n_games, dtype=np.int32)
+
+    positions = pd.RangeIndex(len(actions))
+    grouped = dict(tuple(actions.set_index(positions).groupby('game_id', sort=False)))
+    for gi, gid in enumerate(game_ids):
+        g = grouped[gid]
+        n = len(g)
+        n_actions[gi] = n
+        for c in _FLOAT_COLS:
+            cols[c][gi, :n] = g[c].to_numpy(dtype=float_dtype)
+        for c in _INT_COLS:
+            cols[c][gi, :n] = g[c].to_numpy(dtype=np.int64).astype(np.int32)
+        is_home[gi, :n] = (g['team_id'] == home_team_ids[gid]).to_numpy()
+        mask[gi, :n] = True
+        row_index[gi, :n] = g.index.to_numpy(dtype=np.int64).astype(np.int32)
+
+    batch = ActionBatch(
+        type_id=jnp.asarray(cols['type_id']),
+        result_id=jnp.asarray(cols['result_id']),
+        bodypart_id=jnp.asarray(cols['bodypart_id']),
+        period_id=jnp.asarray(cols['period_id']),
+        is_home=jnp.asarray(is_home),
+        time_seconds=jnp.asarray(cols['time_seconds']),
+        start_x=jnp.asarray(cols['start_x']),
+        start_y=jnp.asarray(cols['start_y']),
+        end_x=jnp.asarray(cols['end_x']),
+        end_y=jnp.asarray(cols['end_y']),
+        mask=jnp.asarray(mask),
+        n_actions=jnp.asarray(n_actions),
+        game_id=jnp.arange(n_games, dtype=jnp.int32),
+        row_index=jnp.asarray(row_index),
+    )
+    if device is not None:
+        batch = jax.device_put(batch, device)
+    return batch, game_ids
+
+
+def unpack_values(values: Any, batch: ActionBatch) -> np.ndarray:
+    """Return per-action device output in the source frame's row order.
+
+    Padding rows are dropped and valid rows are scattered back to the
+    positional order of the DataFrame that was packed, so
+    ``df['rating'] = unpack_values(model.rate(batch), batch)`` aligns
+    correctly even when games were interleaved in the source frame.
+
+    Parameters
+    ----------
+    values : array
+        Shape ``(G, A)`` or ``(G, A, F)`` device/host array.
+    batch : ActionBatch
+        The batch the values were computed for.
+
+    Returns
+    -------
+    np.ndarray
+        Shape ``(total_actions,)`` or ``(total_actions, F)``.
+    """
+    arr = np.asarray(jax.device_get(values))
+    mask = np.asarray(jax.device_get(batch.mask))
+    rows = np.asarray(jax.device_get(batch.row_index))[mask]
+    picked = arr[mask]
+    out = np.empty_like(picked)
+    out[rows] = picked
+    return out

@@ -1,0 +1,1 @@
+"""JAX/XLA kernels for the valuation hot paths."""

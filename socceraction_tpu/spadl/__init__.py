@@ -1,0 +1,32 @@
+"""SPADL: the Soccer Player Action Description Language.
+
+Vocabulary, schema, shared converter passes, utilities and the per-provider
+``convert_to_actions`` converters (reference ``socceraction/spadl``).
+"""
+
+from .config import (
+    actiontypes,
+    actiontypes_df,
+    bodyparts,
+    bodyparts_df,
+    field_length,
+    field_width,
+    results,
+    results_df,
+)
+from .schema import SPADLSchema
+from .utils import add_names, play_left_to_right
+
+__all__ = [
+    'actiontypes',
+    'actiontypes_df',
+    'bodyparts',
+    'bodyparts_df',
+    'field_length',
+    'field_width',
+    'results',
+    'results_df',
+    'SPADLSchema',
+    'add_names',
+    'play_left_to_right',
+]

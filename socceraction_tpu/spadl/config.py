@@ -1,0 +1,109 @@
+"""Vocabulary and pitch constants of the SPADL action language.
+
+SPADL ("Soccer Player Action Description Language") describes every
+on-the-ball action as one row with a fixed vocabulary: 23 action types,
+6 results and 4 bodyparts, with coordinates in meters on a 105 x 68 pitch.
+The vocabulary *order defines the id spaces* used everywhere downstream
+(one-hot widths, grid kernels, label masks), so these lists are the single
+source of truth for both the pandas oracle backend and the packed tensor
+backend.
+
+Parity: reference ``socceraction/spadl/config.py:21-91``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import pandas as pd
+
+field_length: float = 105.0  # meters
+field_width: float = 68.0  # meters
+
+bodyparts: List[str] = ['foot', 'head', 'other', 'head/other']
+
+results: List[str] = [
+    'fail',
+    'success',
+    'offside',
+    'owngoal',
+    'yellow_card',
+    'red_card',
+]
+
+actiontypes: List[str] = [
+    'pass',
+    'cross',
+    'throw_in',
+    'freekick_crossed',
+    'freekick_short',
+    'corner_crossed',
+    'corner_short',
+    'take_on',
+    'foul',
+    'tackle',
+    'interception',
+    'shot',
+    'shot_penalty',
+    'shot_freekick',
+    'keeper_save',
+    'keeper_claim',
+    'keeper_punch',
+    'keeper_pick_up',
+    'clearance',
+    'bad_touch',
+    'non_action',
+    'dribble',
+    'goalkick',
+]
+
+# Frequently needed id constants, resolved once at import time. The tensor
+# kernels in socceraction_tpu.ops index with these as static Python ints so
+# XLA sees fixed masks rather than dynamic lookups.
+PASS = actiontypes.index('pass')
+CROSS = actiontypes.index('cross')
+DRIBBLE = actiontypes.index('dribble')
+SHOT = actiontypes.index('shot')
+SHOT_PENALTY = actiontypes.index('shot_penalty')
+SHOT_FREEKICK = actiontypes.index('shot_freekick')
+CLEARANCE = actiontypes.index('clearance')
+NON_ACTION = actiontypes.index('non_action')
+
+FAIL = results.index('fail')
+SUCCESS = results.index('success')
+OFFSIDE = results.index('offside')
+OWNGOAL = results.index('owngoal')
+YELLOW_CARD = results.index('yellow_card')
+RED_CARD = results.index('red_card')
+
+FOOT = bodyparts.index('foot')
+HEAD = bodyparts.index('head')
+OTHER = bodyparts.index('other')
+
+# Action-type ids whose name contains 'shot' -- the goal predicate used by the
+# VAEP labels and goalscore feature (reference vaep/labels.py:28,
+# vaep/features.py:522 use `type_name.str.contains('shot')`).
+SHOT_LIKE = tuple(i for i, t in enumerate(actiontypes) if 'shot' in t)
+
+shot_like_mask: np.ndarray = np.zeros(len(actiontypes), dtype=bool)
+shot_like_mask[list(SHOT_LIKE)] = True
+
+
+def actiontypes_df() -> pd.DataFrame:
+    """Return the 'type_id' and 'type_name' of each SPADL action type."""
+    return pd.DataFrame(
+        {'type_id': np.arange(len(actiontypes)), 'type_name': actiontypes}
+    )
+
+
+def results_df() -> pd.DataFrame:
+    """Return the 'result_id' and 'result_name' of each SPADL result."""
+    return pd.DataFrame({'result_id': np.arange(len(results)), 'result_name': results})
+
+
+def bodyparts_df() -> pd.DataFrame:
+    """Return the 'bodypart_id' and 'bodypart_name' of each SPADL bodypart."""
+    return pd.DataFrame(
+        {'bodypart_id': np.arange(len(bodyparts)), 'bodypart_name': bodyparts}
+    )

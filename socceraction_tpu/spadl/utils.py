@@ -1,0 +1,46 @@
+"""Utility functions for working with SPADL action tables.
+
+Parity: reference ``socceraction/spadl/utils.py:8-57`` (`add_names` and the
+upstream two-argument `play_left_to_right_sa`, which is the canonical
+semantics -- see SURVEY.md section 0).
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from . import config as spadlconfig
+from .base import _fix_direction_of_play
+from .schema import SPADLSchema
+
+
+def add_names(actions: pd.DataFrame) -> pd.DataFrame:
+    """Add 'type_name', 'result_name' and 'bodypart_name' columns.
+
+    Any pre-existing name columns are replaced.
+    """
+    out = (
+        actions.drop(columns=['type_name', 'result_name', 'bodypart_name'], errors='ignore')
+        .merge(spadlconfig.actiontypes_df(), how='left')
+        .merge(spadlconfig.results_df(), how='left')
+        .merge(spadlconfig.bodyparts_df(), how='left')
+    )
+    return SPADLSchema.validate(out)
+
+
+def play_left_to_right(actions: pd.DataFrame, home_team_id) -> pd.DataFrame:
+    """Mirror the away team's actions so every team plays left-to-right.
+
+    Parameters
+    ----------
+    actions : pd.DataFrame
+        The SPADL actions of one game.
+    home_team_id
+        The ID of the game's home team.
+
+    Returns
+    -------
+    pd.DataFrame
+        A copy with away-team coordinates mirrored in both axes.
+    """
+    return _fix_direction_of_play(actions.copy(), home_team_id)

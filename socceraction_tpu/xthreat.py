@@ -1,0 +1,396 @@
+"""The Expected Threat (xT) model.
+
+xT values ball-progressing actions as the difference in long-term scoring
+probability between an action's start and end cell of an ``M x N`` pitch
+grid, where the value surface solves a Markov possession model by value
+iteration (Karun Singh, 2019).
+
+API parity: reference ``socceraction/xthreat.py`` (``ExpectedThreat`` class
+with ``fit``/``rate``/``save_model``; module-level ``scoring_prob``,
+``action_prob``, ``move_transition_matrix``, ``get_move_actions``,
+``get_successful_move_actions``, ``load_model``). Two execution backends:
+
+- ``backend='pandas'``: a vectorized numpy oracle with the reference's exact
+  semantics (bincount scatters stand in for ``value_counts``; the value
+  iteration is the same mat-vec the reference's quadruple Python loop
+  computes, reference ``xthreat.py:306-312``).
+- ``backend='jax'`` (default): packs actions into an
+  :class:`~socceraction_tpu.core.batch.ActionBatch` and runs the kernels in
+  :mod:`socceraction_tpu.ops.xt` -- scatter-add count matrices and a
+  ``lax.while_loop`` value iteration, one MXU mat-vec per sweep.
+
+The count matrices are additive across game shards, so the JAX path scales
+to multi-chip by psum-reducing :class:`~socceraction_tpu.ops.xt.XTCounts`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from .spadl import config as spadlconfig
+
+try:  # pragma: no cover - import guard mirrors optional-dependency handling
+    import jax
+    import jax.numpy as jnp
+
+    from .core.batch import ActionBatch, pack_actions
+    from .ops import xt as _xtops
+
+    _HAS_JAX = True
+except ImportError:  # pragma: no cover
+    _HAS_JAX = False
+
+
+class NotFittedError(ValueError):
+    """Raised when ``rate``/``save_model`` is called before ``fit``."""
+
+
+M: int = 12
+N: int = 16
+
+Actions = Union[pd.DataFrame, 'ActionBatch']
+
+
+# ---------------------------------------------------------------------------
+# Functional numpy oracle (reference xthreat.py:25-218 semantics)
+# ---------------------------------------------------------------------------
+
+
+def _get_cell_indexes(
+    x: np.ndarray, y: np.ndarray, l: int = N, w: int = M
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Bin coordinates: truncate toward zero, clip into the grid."""
+    xi = np.asarray(x, dtype=np.float64) / spadlconfig.field_length * l
+    yj = np.asarray(y, dtype=np.float64) / spadlconfig.field_width * w
+    xi = np.clip(xi.astype(np.int64), 0, l - 1)
+    yj = np.clip(yj.astype(np.int64), 0, w - 1)
+    return xi, yj
+
+
+def _get_flat_indexes(x: np.ndarray, y: np.ndarray, l: int = N, w: int = M) -> np.ndarray:
+    xi, yj = _get_cell_indexes(x, y, l, w)
+    return (w - 1 - yj) * l + xi
+
+
+def _count(x: np.ndarray, y: np.ndarray, l: int = N, w: int = M) -> np.ndarray:
+    """Count actions per grid cell (top-left origin ``(w, l)`` matrix)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    ok = ~np.isnan(x) & ~np.isnan(y)
+    flat = _get_flat_indexes(x[ok], y[ok], l, w)
+    return np.bincount(flat, minlength=w * l).astype(np.float64).reshape(w, l)
+
+
+def _safe_divide(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.divide(a, b, out=np.zeros_like(a, dtype=np.float64), where=b != 0)
+
+
+def scoring_prob(actions: pd.DataFrame, l: int = N, w: int = M) -> np.ndarray:
+    """P(goal | shot from cell) for each grid cell."""
+    shots = actions[actions['type_id'] == spadlconfig.SHOT]
+    goals = shots[shots['result_id'] == spadlconfig.SUCCESS]
+    shotmatrix = _count(shots['start_x'].to_numpy(), shots['start_y'].to_numpy(), l, w)
+    goalmatrix = _count(goals['start_x'].to_numpy(), goals['start_y'].to_numpy(), l, w)
+    return _safe_divide(goalmatrix, shotmatrix)
+
+
+def get_move_actions(actions: pd.DataFrame) -> pd.DataFrame:
+    """All ball-progressing actions: passes, dribbles and crosses."""
+    t = actions['type_id']
+    return actions[
+        (t == spadlconfig.PASS) | (t == spadlconfig.DRIBBLE) | (t == spadlconfig.CROSS)
+    ]
+
+
+def get_successful_move_actions(actions: pd.DataFrame) -> pd.DataFrame:
+    """All successful ball-progressing actions."""
+    moves = get_move_actions(actions)
+    return moves[moves['result_id'] == spadlconfig.SUCCESS]
+
+
+def action_prob(
+    actions: pd.DataFrame, l: int = N, w: int = M
+) -> Tuple[np.ndarray, np.ndarray]:
+    """P(choose shot) and P(choose move) for each grid cell."""
+    moves = get_move_actions(actions)
+    shots = actions[actions['type_id'] == spadlconfig.SHOT]
+    movematrix = _count(moves['start_x'].to_numpy(), moves['start_y'].to_numpy(), l, w)
+    shotmatrix = _count(shots['start_x'].to_numpy(), shots['start_y'].to_numpy(), l, w)
+    total = movematrix + shotmatrix
+    return _safe_divide(shotmatrix, total), _safe_divide(movematrix, total)
+
+
+def move_transition_matrix(actions: pd.DataFrame, l: int = N, w: int = M) -> np.ndarray:
+    """P(successful move from cell i ends in cell j).
+
+    Normalized by the count of *all* moves started in cell i (successful or
+    not), like reference ``xthreat.py:206-216``.
+    """
+    moves = get_move_actions(actions)
+    sx = moves['start_x'].to_numpy(dtype=np.float64)
+    sy = moves['start_y'].to_numpy(dtype=np.float64)
+    ex = moves['end_x'].to_numpy(dtype=np.float64)
+    ey = moves['end_y'].to_numpy(dtype=np.float64)
+    # Moves with NaN coordinates are excluded (consistent with _count's NaN
+    # filter; the reference's float->int cast on NaN here is undefined
+    # behavior that we do not reproduce).
+    start_ok = ~np.isnan(sx) & ~np.isnan(sy)
+    end_ok = start_ok & ~np.isnan(ex) & ~np.isnan(ey)
+    start = _get_flat_indexes(sx[start_ok], sy[start_ok], l, w)
+    pair_start = _get_flat_indexes(sx[end_ok], sy[end_ok], l, w)
+    pair_end = _get_flat_indexes(ex[end_ok], ey[end_ok], l, w)
+    success = (moves['result_id'] == spadlconfig.SUCCESS).to_numpy()[end_ok]
+
+    n_cells = w * l
+    start_counts = np.bincount(start, minlength=n_cells).astype(np.float64)
+    pair = pair_start[success] * n_cells + pair_end[success]
+    counts = np.bincount(pair, minlength=n_cells * n_cells).reshape(n_cells, n_cells)
+    return _safe_divide(counts.astype(np.float64), start_counts[:, None])
+
+
+# ---------------------------------------------------------------------------
+# Model class
+# ---------------------------------------------------------------------------
+
+
+class ExpectedThreat:
+    """The Expected Threat model with selectable execution backend.
+
+    Parameters
+    ----------
+    l : int
+        Grid cells along the pitch length (x). Default 16.
+    w : int
+        Grid cells along the pitch width (y). Default 12.
+    eps : float
+        Convergence threshold of the value iteration. Default 1e-5.
+    backend : {'jax', 'pandas'}
+        Execution backend for ``fit`` and ``rate``. Default 'jax' when JAX
+        is importable.
+    max_iter : int
+        Safety cap on value-iteration sweeps. Default 1000.
+    keep_heatmaps : bool
+        When True, store the value surface after every iteration in
+        ``self.heatmaps`` like the reference. Implies host-stepped iteration
+        on the JAX backend; leave False for large grids.
+    """
+
+    def __init__(
+        self,
+        l: int = N,
+        w: int = M,
+        eps: float = 1e-5,
+        backend: Optional[str] = None,
+        max_iter: int = 1000,
+        keep_heatmaps: bool = False,
+    ) -> None:
+        if backend is None:
+            backend = 'jax' if _HAS_JAX else 'pandas'
+        if backend not in ('jax', 'pandas'):
+            raise ValueError(f'unknown backend {backend!r}')
+        if backend == 'jax' and not _HAS_JAX:
+            raise ImportError('JAX backend requested but jax is not importable')
+        self.l = l
+        self.w = w
+        self.eps = eps
+        self.backend = backend
+        self.max_iter = max_iter
+        self.keep_heatmaps = keep_heatmaps
+        self.n_iter: int = 0
+        self.heatmaps: List[np.ndarray] = []
+        self.xT: np.ndarray = np.zeros((w, l))
+        self.scoring_prob_matrix: Optional[np.ndarray] = None
+        self.shot_prob_matrix: Optional[np.ndarray] = None
+        self.move_prob_matrix: Optional[np.ndarray] = None
+        self.transition_matrix: Optional[np.ndarray] = None
+
+    # -- fitting -----------------------------------------------------------
+
+    def _solve_numpy(self) -> None:
+        gs = self.scoring_prob_matrix * self.shot_prob_matrix
+        T = self.transition_matrix
+        xT = np.zeros((self.w, self.l))
+        if self.keep_heatmaps:
+            self.heatmaps.append(xT.copy())
+        it = 0
+        while it < self.max_iter:
+            payoff = (T @ xT.reshape(-1)).reshape(self.w, self.l)
+            new = gs + self.move_prob_matrix * payoff
+            diff = new - xT
+            xT = new
+            it += 1
+            if self.keep_heatmaps:
+                self.heatmaps.append(xT.copy())
+            if not np.any(diff > self.eps):
+                break
+        self.xT = xT
+        self.n_iter = it
+
+    def _fit_pandas(self, actions: pd.DataFrame) -> None:
+        self.scoring_prob_matrix = scoring_prob(actions, self.l, self.w)
+        self.shot_prob_matrix, self.move_prob_matrix = action_prob(actions, self.l, self.w)
+        self.transition_matrix = move_transition_matrix(actions, self.l, self.w)
+        self._solve_numpy()
+
+    def _fit_jax(self, batch: 'ActionBatch') -> None:
+        counts = _xtops.xt_counts(
+            batch.type_id,
+            batch.result_id,
+            batch.start_x,
+            batch.start_y,
+            batch.end_x,
+            batch.end_y,
+            batch.mask,
+            l=self.l,
+            w=self.w,
+        )
+        probs = _xtops.xt_probabilities(counts, l=self.l, w=self.w)
+        self.scoring_prob_matrix = np.asarray(probs.p_score, dtype=np.float64)
+        self.shot_prob_matrix = np.asarray(probs.p_shot, dtype=np.float64)
+        self.move_prob_matrix = np.asarray(probs.p_move, dtype=np.float64)
+        self.transition_matrix = np.asarray(probs.transition, dtype=np.float64)
+        if self.keep_heatmaps:
+            # Host-stepped sweeps so every intermediate surface can be kept.
+            self._solve_numpy()
+        else:
+            xT, it = _xtops.solve_xt(probs, eps=self.eps, max_iter=self.max_iter)
+            self.xT = np.asarray(xT, dtype=np.float64)
+            self.n_iter = int(it)
+
+    def _as_batch(self, actions: Actions) -> 'ActionBatch':
+        if isinstance(actions, pd.DataFrame):
+            df = actions
+            if 'game_id' not in df.columns:
+                df = df.assign(game_id=0)
+            # xT only reads type/result/coordinates; fill whatever other
+            # packed columns a minimal frame omits (the pandas backend and
+            # the reference accept such frames too).
+            defaults = {
+                'team_id': 0,
+                'period_id': 1,
+                'time_seconds': 0.0,
+                'bodypart_id': 0,
+                'result_id': 0,
+            }
+            missing = {c: v for c, v in defaults.items() if c not in df.columns}
+            if missing:
+                df = df.assign(**missing)
+            # xT is team-agnostic: home side is irrelevant, any constant works.
+            batch, _ = pack_actions(df, home_team_ids={g: None for g in df['game_id'].unique()})
+            return batch
+        return actions
+
+    def fit(self, actions: Actions) -> 'ExpectedThreat':
+        """Fit the model on SPADL actions (DataFrame or packed batch)."""
+        if self.backend == 'jax':
+            self._fit_jax(self._as_batch(actions))
+        else:
+            self._fit_pandas(actions)
+        return self
+
+    # -- inference ---------------------------------------------------------
+
+    def _grid(self, use_interpolation: bool) -> Tuple[np.ndarray, int, int]:
+        if not use_interpolation:
+            return self.xT, self.l, self.w
+        l = int(spadlconfig.field_length * 10)
+        w = int(spadlconfig.field_width * 10)
+        if self.backend == 'jax':
+            fine = np.asarray(_xtops.interpolate_grid(jnp.asarray(self.xT), l, w))
+        else:
+            fine = self._interpolate_numpy(l, w)
+        return fine, l, w
+
+    def _interpolate_numpy(self, l_out: int, w_out: int) -> np.ndarray:
+        """Bilinear upsampling between cell centers with edge extrapolation."""
+        cell_l = spadlconfig.field_length / self.l
+        cell_w = spadlconfig.field_width / self.w
+        xs = np.linspace(0.0, spadlconfig.field_length, l_out)
+        ys = np.linspace(0.0, spadlconfig.field_width, w_out)
+        fx = (xs - 0.5 * cell_l) / cell_l
+        fy = (ys - 0.5 * cell_w) / cell_w
+        ix = np.clip(np.floor(fx).astype(np.int64), 0, self.l - 2)
+        iy = np.clip(np.floor(fy).astype(np.int64), 0, self.w - 2)
+        tx = fx - ix
+        ty = fy - iy
+        r0 = self.w - 1 - iy
+        r1 = self.w - 2 - iy
+        g00 = self.xT[r0][:, ix]
+        g01 = self.xT[r0][:, ix + 1]
+        g10 = self.xT[r1][:, ix]
+        g11 = self.xT[r1][:, ix + 1]
+        top = g00 * (1 - tx[None, :]) + g01 * tx[None, :]
+        bot = g10 * (1 - tx[None, :]) + g11 * tx[None, :]
+        fine = top * (1 - ty[:, None]) + bot * ty[:, None]
+        return fine[::-1]
+
+    def rate(
+        self, actions: Actions, use_interpolation: bool = False
+    ) -> np.ndarray:
+        """Compute per-action xT ratings.
+
+        Only successful pass/dribble/cross actions are rated; all other rows
+        receive NaN (reference ``xthreat.py:453-464``).
+        """
+        if not np.any(self.xT):
+            raise NotFittedError('fit the model before calling rate')
+
+        grid, l, w = self._grid(use_interpolation)
+
+        if self.backend == 'jax' and not isinstance(actions, pd.DataFrame):
+            batch = actions
+            vals = _xtops.rate_actions(
+                jnp.asarray(grid, dtype=jnp.float32),
+                batch.type_id,
+                batch.result_id,
+                batch.start_x,
+                batch.start_y,
+                batch.end_x,
+                batch.end_y,
+                batch.mask,
+                l=l,
+                w=w,
+            )
+            return np.asarray(vals)
+
+        df = actions.reset_index(drop=True)
+        ratings = np.full(len(df), np.nan)
+        moves = get_successful_move_actions(df)
+        sxi, syj = _get_cell_indexes(
+            moves['start_x'].to_numpy(), moves['start_y'].to_numpy(), l, w
+        )
+        exi, eyj = _get_cell_indexes(moves['end_x'].to_numpy(), moves['end_y'].to_numpy(), l, w)
+        xt_start = grid[w - 1 - syj, sxi]
+        xt_end = grid[w - 1 - eyj, exi]
+        ratings[moves.index.to_numpy()] = xt_end - xt_start
+        return ratings
+
+    predict = rate  # deprecated alias kept for API parity (xthreat.py:380)
+
+    # -- persistence -------------------------------------------------------
+
+    def save_model(self, filepath: str, overwrite: bool = True) -> None:
+        """Save the xT value surface as a JSON 2-D matrix."""
+        if not np.any(self.xT):
+            raise NotFittedError('fit the model before saving')
+        if not overwrite and os.path.isfile(filepath):
+            raise ValueError(
+                f'save_model got overwrite=False, but file {filepath!r} already exists'
+            )
+        with open(filepath, 'w') as f:
+            json.dump(np.asarray(self.xT).tolist(), f)
+
+
+def load_model(path: str, backend: Optional[str] = None) -> ExpectedThreat:
+    """Create a model from a pre-computed xT value surface (JSON 2-D matrix)."""
+    with open(path) as f:
+        grid = np.asarray(json.load(f), dtype=np.float64)
+    model = ExpectedThreat(backend=backend)
+    model.xT = grid
+    model.w, model.l = grid.shape
+    return model

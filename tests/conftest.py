@@ -1,0 +1,50 @@
+"""Shared test fixtures.
+
+Tests run on a virtual 8-device CPU mesh: the env vars below must be set
+before jax is first imported, which this conftest guarantees by being the
+pytest entry point. Benchmarks (bench.py) run on real TPU hardware instead.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in xla_flags:
+    os.environ['XLA_FLAGS'] = (
+        xla_flags + ' --xla_force_host_platform_device_count=8'
+    ).strip()
+
+import json
+from pathlib import Path
+
+import pandas as pd
+import pytest
+
+DATA_DIR = Path(__file__).parent / 'datasets'
+
+
+@pytest.fixture(scope='session')
+def spadl_actions() -> pd.DataFrame:
+    """The 200-action golden SPADL snapshot (game 8657, home team 782)."""
+    df = pd.read_json(DATA_DIR / 'spadl' / 'spadl.json')
+    return df
+
+
+@pytest.fixture(scope='session')
+def atomic_spadl_actions() -> pd.DataFrame:
+    """The golden Atomic-SPADL snapshot derived from the same game."""
+    df = pd.read_json(DATA_DIR / 'spadl' / 'atomic_spadl.json')
+    return df
+
+
+@pytest.fixture(scope='session')
+def home_team_id() -> int:
+    """Home team used for the golden snapshot game.
+
+    Note: the reference generated the snapshot with ``create_spadl(8657, 777)``
+    (reference tests/datasets/download.py:303) but team 777 does not occur in
+    game 8657 (teams are 782 and 768), so every action was mirrored during
+    conversion. We use 782 -- the game's actual home side -- so that
+    direction-sensitive tests exercise both branches.
+    """
+    return 782

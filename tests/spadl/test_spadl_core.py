@@ -1,0 +1,185 @@
+"""Tests for the SPADL vocabulary, schema, shared passes and utilities."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.schema import SchemaError
+from socceraction_tpu.spadl import (
+    SPADLSchema,
+    actiontypes,
+    actiontypes_df,
+    add_names,
+    bodyparts,
+    bodyparts_df,
+    play_left_to_right,
+    results,
+    results_df,
+)
+from socceraction_tpu.spadl import config as spadlconfig
+from socceraction_tpu.spadl.base import _add_dribbles, _fix_clearances, _fix_direction_of_play
+
+
+def test_vocabulary_sizes_and_ids():
+    # The vocabulary *order* defines the id spaces (reference spadl/config.py:24-57).
+    assert len(actiontypes) == 23
+    assert len(results) == 6
+    assert len(bodyparts) == 4
+    assert actiontypes.index('pass') == 0
+    assert actiontypes.index('shot') == 11
+    assert actiontypes.index('dribble') == 21
+    assert actiontypes.index('goalkick') == 22
+    assert results.index('success') == 1
+    assert results.index('owngoal') == 3
+
+
+def test_vocab_dataframes():
+    adf = actiontypes_df()
+    assert list(adf.columns) == ['type_id', 'type_name']
+    assert len(adf) == 23
+    rdf = results_df()
+    assert list(rdf.columns) == ['result_id', 'result_name']
+    bdf = bodyparts_df()
+    assert list(bdf.columns) == ['bodypart_id', 'bodypart_name']
+
+
+def test_schema_validates_golden(spadl_actions):
+    out = SPADLSchema.validate(spadl_actions)
+    assert len(out) == len(spadl_actions)
+    assert out['period_id'].between(1, 5).all()
+    assert out['start_x'].between(0, spadlconfig.field_length).all()
+
+
+def test_schema_rejects_bad_range(spadl_actions):
+    bad = spadl_actions.copy()
+    bad.loc[0, 'start_x'] = 500.0
+    with pytest.raises(SchemaError):
+        SPADLSchema.validate(bad)
+
+
+def test_add_names(spadl_actions):
+    named = add_names(spadl_actions)
+    assert {'type_name', 'result_name', 'bodypart_name'} <= set(named.columns)
+    row = named.iloc[0]
+    assert row['type_name'] == actiontypes[row['type_id']]
+    assert row['result_name'] == results[row['result_id']]
+
+
+def test_play_left_to_right(spadl_actions, home_team_id):
+    ltr = play_left_to_right(spadl_actions, home_team_id)
+    away = spadl_actions['team_id'] != home_team_id
+    np.testing.assert_allclose(
+        ltr.loc[away, 'start_x'].to_numpy(),
+        spadlconfig.field_length - spadl_actions.loc[away, 'start_x'].to_numpy(),
+    )
+    np.testing.assert_allclose(
+        ltr.loc[~away, 'start_x'].to_numpy(),
+        spadl_actions.loc[~away, 'start_x'].to_numpy(),
+    )
+    # Original frame untouched.
+    assert not ltr.loc[away, 'start_x'].equals(spadl_actions.loc[away, 'start_x'])
+
+
+def _mini_actions() -> pd.DataFrame:
+    return pd.DataFrame(
+        {
+            'game_id': [1, 1, 1],
+            'period_id': [1, 1, 1],
+            'action_id': [0, 1, 2],
+            'time_seconds': [0.0, 4.0, 30.0],
+            'team_id': [10, 10, 20],
+            'player_id': [1, 2, 3],
+            'start_x': [10.0, 30.0, 60.0],
+            'start_y': [10.0, 30.0, 40.0],
+            'end_x': [25.0, 45.0, 80.0],
+            'end_y': [25.0, 35.0, 50.0],
+            'type_id': [spadlconfig.PASS, spadlconfig.CLEARANCE, spadlconfig.PASS],
+            'result_id': [1, 1, 1],
+            'bodypart_id': [0, 0, 0],
+        }
+    )
+
+
+def test_fix_clearances_takes_next_start():
+    actions = _mini_actions()
+    fixed = _fix_clearances(actions.copy())
+    # clearance end = next action's start (reference spadl/base.py:12-19)
+    assert fixed.loc[1, 'end_x'] == 60.0
+    assert fixed.loc[1, 'end_y'] == 40.0
+
+
+def test_fix_clearances_last_row_uses_own_start():
+    actions = _mini_actions()
+    actions.loc[2, 'type_id'] = spadlconfig.CLEARANCE
+    fixed = _fix_clearances(actions.copy())
+    assert fixed.loc[2, 'end_x'] == actions.loc[2, 'start_x']
+    assert fixed.loc[2, 'end_y'] == actions.loc[2, 'start_y']
+
+
+def test_fix_direction_of_play():
+    actions = _mini_actions()
+    fixed = _fix_direction_of_play(actions.copy(), home_team_id=10)
+    # away team (20) mirrored in both axes
+    assert fixed.loc[2, 'start_x'] == spadlconfig.field_length - 60.0
+    assert fixed.loc[2, 'start_y'] == spadlconfig.field_width - 40.0
+    # home untouched
+    assert fixed.loc[0, 'start_x'] == 10.0
+
+
+def test_add_dribbles_inserts_between_gap():
+    actions = pd.DataFrame(
+        {
+            'game_id': [1, 1],
+            'period_id': [1, 1],
+            'action_id': [0, 1],
+            'time_seconds': [0.0, 5.0],
+            'team_id': [10, 10],
+            'player_id': [1, 2],
+            'start_x': [10.0, 30.0],
+            'start_y': [10.0, 10.0],
+            'end_x': [20.0, 50.0],
+            'end_y': [10.0, 10.0],
+            'type_id': [spadlconfig.PASS, spadlconfig.PASS],
+            'result_id': [1, 1],
+            'bodypart_id': [0, 0],
+        }
+    )
+    out = _add_dribbles(actions)
+    # 10m gap between end of a0 and start of a1 -> dribble inserted
+    assert len(out) == 3
+    d = out.iloc[1]
+    assert d['type_id'] == spadlconfig.DRIBBLE
+    assert d['start_x'] == 20.0 and d['end_x'] == 30.0
+    assert d['time_seconds'] == 2.5
+    assert d['team_id'] == 10
+    assert list(out['action_id']) == [0, 1, 2]
+
+
+def test_add_dribbles_respects_thresholds():
+    base = dict(
+        game_id=[1, 1],
+        period_id=[1, 1],
+        action_id=[0, 1],
+        team_id=[10, 10],
+        player_id=[1, 2],
+        start_y=[10.0, 10.0],
+        end_y=[10.0, 10.0],
+        type_id=[0, 0],
+        result_id=[1, 1],
+        bodypart_id=[0, 0],
+    )
+    # too close (< 3m): no dribble
+    close = pd.DataFrame(
+        dict(base, time_seconds=[0.0, 5.0], start_x=[10.0, 21.0], end_x=[20.0, 30.0])
+    )
+    assert len(_add_dribbles(close)) == 2
+    # too far (> 60m): no dribble
+    far = pd.DataFrame(
+        dict(base, time_seconds=[0.0, 5.0], start_x=[90.0, 70.0], end_x=[5.0, 30.0])
+    )
+    assert len(_add_dribbles(far)) == 2
+    # too slow (>= 10s): no dribble
+    slow = pd.DataFrame(
+        dict(base, time_seconds=[0.0, 15.0], start_x=[10.0, 30.0], end_x=[20.0, 50.0])
+    )
+    assert len(_add_dribbles(slow)) == 2

@@ -1,0 +1,65 @@
+"""Tests for the columnar ActionBatch packing/unpacking."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.batch import pack_actions, pad_length, unpack_values
+
+
+def _frame(game_ids, xs):
+    n = len(game_ids)
+    return pd.DataFrame(
+        {
+            'game_id': game_ids,
+            'period_id': [1] * n,
+            'action_id': range(n),
+            'time_seconds': np.arange(n, dtype=float),
+            'team_id': [10] * n,
+            'player_id': [1] * n,
+            'start_x': xs,
+            'start_y': [10.0] * n,
+            'end_x': xs,
+            'end_y': [10.0] * n,
+            'type_id': [0] * n,
+            'result_id': [1] * n,
+            'bodypart_id': [0] * n,
+        }
+    )
+
+
+def test_pad_length_lane_multiple():
+    assert pad_length(1) == 128
+    assert pad_length(128) == 128
+    assert pad_length(129) == 256
+
+
+def test_pack_shapes_and_mask():
+    df = _frame([1, 1, 2], [1.0, 2.0, 3.0])
+    batch, gids = pack_actions(df, home_team_ids={1: 10, 2: 99})
+    assert gids == [1, 2]
+    assert batch.n_games == 2
+    assert batch.max_actions == 128
+    assert batch.total_actions == 3
+    np.testing.assert_array_equal(np.asarray(batch.n_actions), [2, 1])
+    assert bool(batch.is_home[0, 0]) is True
+    assert bool(batch.is_home[1, 0]) is False
+
+
+def test_unpack_restores_interleaved_row_order():
+    df = _frame([1, 2, 1, 2], [1.0, 2.0, 3.0, 4.0])
+    batch, _ = pack_actions(df, home_team_ids={1: 10, 2: 10})
+    out = unpack_values(batch.start_x, batch)
+    np.testing.assert_allclose(out, [1.0, 2.0, 3.0, 4.0])
+
+
+def test_pack_requires_home_team():
+    df = _frame([1], [1.0])
+    with pytest.raises(ValueError):
+        pack_actions(df)
+
+
+def test_pack_max_actions_overflow():
+    df = _frame([1] * 5, [1.0] * 5)
+    with pytest.raises(ValueError):
+        pack_actions(df, home_team_ids={1: 10}, max_actions=4)

@@ -1,0 +1,170 @@
+"""Tests for the Expected Threat (xT) model: oracle semantics + backend parity."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu import xthreat
+from socceraction_tpu.core.batch import pack_actions, unpack_values
+from socceraction_tpu.spadl import config as spadlconfig
+
+
+def test_cell_indexes_truncate_and_clip():
+    x = np.array([0.0, 104.9, 105.0, 52.5])
+    y = np.array([0.0, 67.9, 68.0, 34.0])
+    xi, yj = xthreat._get_cell_indexes(x, y, l=16, w=12)
+    assert list(xi) == [0, 15, 15, 8]
+    assert list(yj) == [0, 11, 11, 6]
+
+
+def test_flat_indexes_top_left_origin():
+    # top of the pitch (max y) maps to row 0
+    flat = xthreat._get_flat_indexes(np.array([0.0]), np.array([67.9]), l=16, w=12)
+    assert flat[0] == 0
+    flat = xthreat._get_flat_indexes(np.array([0.0]), np.array([0.0]), l=16, w=12)
+    assert flat[0] == (12 - 1) * 16
+
+
+def test_count_ignores_nan():
+    x = np.array([10.0, np.nan, 10.0])
+    y = np.array([10.0, 10.0, np.nan])
+    m = xthreat._count(x, y, l=16, w=12)
+    assert m.sum() == 1
+
+
+def test_safe_divide():
+    out = xthreat._safe_divide(np.array([1.0, 2.0]), np.array([2.0, 0.0]))
+    np.testing.assert_allclose(out, [0.5, 0.0])
+
+
+def _two_move_actions() -> pd.DataFrame:
+    """One successful + one failed move from the same cell (reference
+    tests/test_xthreat.py pattern)."""
+    return pd.DataFrame(
+        {
+            'game_id': [1, 1],
+            'period_id': [1, 1],
+            'action_id': [0, 1],
+            'time_seconds': [0.0, 10.0],
+            'team_id': [10, 10],
+            'player_id': [1, 1],
+            'start_x': [10.0, 10.0],
+            'start_y': [10.0, 10.0],
+            'end_x': [90.0, 90.0],
+            'end_y': [50.0, 50.0],
+            'type_id': [spadlconfig.PASS, spadlconfig.PASS],
+            'result_id': [spadlconfig.SUCCESS, spadlconfig.FAIL],
+            'bodypart_id': [0, 0],
+        }
+    )
+
+
+def test_move_transition_matrix_normalizes_by_all_starts():
+    actions = _two_move_actions()
+    T = xthreat.move_transition_matrix(actions, l=16, w=12)
+    start = xthreat._get_flat_indexes(np.array([10.0]), np.array([10.0]), 16, 12)[0]
+    end = xthreat._get_flat_indexes(np.array([90.0]), np.array([50.0]), 16, 12)[0]
+    # 1 successful of 2 total moves from this cell
+    assert T[start, end] == 0.5
+    assert T.sum() == 0.5
+
+
+def test_fit_rate_pandas_backend(spadl_actions):
+    model = xthreat.ExpectedThreat(backend='pandas')
+    model.fit(spadl_actions)
+    assert model.xT.shape == (12, 16)
+    assert model.n_iter > 0
+    ratings = model.rate(spadl_actions)
+    assert len(ratings) == len(spadl_actions)
+    moves = xthreat.get_successful_move_actions(spadl_actions.reset_index(drop=True))
+    assert np.isfinite(ratings[moves.index.to_numpy()]).all()
+    non_move = np.setdiff1d(np.arange(len(spadl_actions)), moves.index.to_numpy())
+    assert np.isnan(ratings[non_move]).all()
+
+
+def test_fit_rate_jax_matches_pandas(spadl_actions):
+    ref = xthreat.ExpectedThreat(backend='pandas').fit(spadl_actions)
+    jx = xthreat.ExpectedThreat(backend='jax').fit(spadl_actions)
+    np.testing.assert_allclose(jx.scoring_prob_matrix, ref.scoring_prob_matrix, atol=1e-6)
+    np.testing.assert_allclose(jx.shot_prob_matrix, ref.shot_prob_matrix, atol=1e-6)
+    np.testing.assert_allclose(jx.move_prob_matrix, ref.move_prob_matrix, atol=1e-6)
+    np.testing.assert_allclose(jx.transition_matrix, ref.transition_matrix, atol=1e-6)
+    np.testing.assert_allclose(jx.xT, ref.xT, atol=1e-5)
+
+    # rate: jax on packed batch must bit-match pandas on the frame
+    batch, _ = pack_actions(spadl_actions, home_team_id=777)
+    jax_vals = unpack_values(jx.rate(batch), batch)
+    ref_vals = ref.rate(spadl_actions)
+    np.testing.assert_allclose(jax_vals, ref_vals, atol=1e-5, equal_nan=True)
+
+
+def test_fit_jax_on_dataframe(spadl_actions):
+    model = xthreat.ExpectedThreat(backend='jax').fit(spadl_actions)
+    ratings = model.rate(spadl_actions)
+    assert len(ratings) == len(spadl_actions)
+
+
+def test_rate_unfitted_raises(spadl_actions):
+    with pytest.raises(xthreat.NotFittedError):
+        xthreat.ExpectedThreat(backend='pandas').rate(spadl_actions)
+
+
+def test_save_load_roundtrip(tmp_path, spadl_actions):
+    model = xthreat.ExpectedThreat(backend='pandas').fit(spadl_actions)
+    path = str(tmp_path / 'xt.json')
+    model.save_model(path)
+    loaded = xthreat.load_model(path, backend='pandas')
+    np.testing.assert_allclose(loaded.xT, model.xT)
+    assert (loaded.w, loaded.l) == (12, 16)
+    np.testing.assert_allclose(
+        loaded.rate(spadl_actions), model.rate(spadl_actions), equal_nan=True
+    )
+
+
+def test_save_no_overwrite(tmp_path, spadl_actions):
+    model = xthreat.ExpectedThreat(backend='pandas').fit(spadl_actions)
+    path = str(tmp_path / 'xt.json')
+    model.save_model(path)
+    with pytest.raises(ValueError):
+        model.save_model(path, overwrite=False)
+
+
+def test_heatmaps_recorded(spadl_actions):
+    model = xthreat.ExpectedThreat(backend='pandas', keep_heatmaps=True).fit(spadl_actions)
+    # initial zero surface + one per iteration
+    assert len(model.heatmaps) == model.n_iter + 1
+    assert not model.heatmaps[0].any()
+
+
+def test_interpolated_rate(spadl_actions):
+    model = xthreat.ExpectedThreat(backend='pandas').fit(spadl_actions)
+    coarse = model.rate(spadl_actions)
+    fine = model.rate(spadl_actions, use_interpolation=True)
+    mask = np.isfinite(coarse)
+    assert np.isfinite(fine[mask]).all()
+    assert np.isnan(fine[~mask]).all()
+
+
+def test_interpolation_exact_on_linear_surface(spadl_actions):
+    # On a planar value surface bilinear interpolation is exact, so fine and
+    # coarse ratings must agree up to the sub-cell position of each action.
+    model = xthreat.ExpectedThreat(backend='pandas')
+    ys, xs = np.mgrid[0:12, 0:16]
+    model.xT = 0.01 * xs + 0.002 * (11 - ys)  # value grows toward x, y
+    coarse = model.rate(spadl_actions)
+    fine = model.rate(spadl_actions, use_interpolation=True)
+    mask = np.isfinite(coarse)
+    # one coarse cell is 6.56m x 5.67m -> max sub-cell delta ~ one cell value step
+    np.testing.assert_allclose(fine[mask], coarse[mask], atol=0.012)
+    assert np.corrcoef(coarse[mask], fine[mask])[0, 1] > 0.95
+
+
+def test_jax_interpolation_matches_numpy(spadl_actions):
+    import jax.numpy as jnp
+
+    from socceraction_tpu.ops import xt as xtops
+
+    model = xthreat.ExpectedThreat(backend='pandas').fit(spadl_actions)
+    fine_np = model._interpolate_numpy(1050, 680)
+    fine_jax = np.asarray(xtops.interpolate_grid(jnp.asarray(model.xT), 1050, 680))
+    np.testing.assert_allclose(fine_jax, fine_np, atol=1e-5)
