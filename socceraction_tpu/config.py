@@ -1,0 +1,48 @@
+"""Framework-wide configuration knobs.
+
+The reference has no config system -- its knobs are module-level constants
+scattered across files (SURVEY.md section 5). This module centralizes
+exactly those knobs so both backends read one source of truth:
+
+===========================  =========  ==========================================
+knob                         default    reference source
+===========================  =========  ==========================================
+XT_GRID_LENGTH (N)           16         socceraction/xthreat.py:22
+XT_GRID_WIDTH (M)            12         socceraction/xthreat.py:21
+XT_EPS                       1e-5       socceraction/xthreat.py:267
+LABEL_LOOKAHEAD              10         socceraction/vaep/labels.py:9
+SAMEPHASE_SECONDS            10         socceraction/vaep/formula.py:14
+PENALTY_PRIOR                0.792453   socceraction/vaep/formula.py:62
+CORNER_PRIOR                 0.046500   socceraction/vaep/formula.py:66
+NB_PREV_ACTIONS              3          socceraction/vaep/base.py:90
+MIN_DRIBBLE_LENGTH           3.0        socceraction/spadl/base.py:49
+MAX_DRIBBLE_LENGTH           60.0       socceraction/spadl/base.py:50
+MAX_DRIBBLE_DURATION         10.0       socceraction/spadl/base.py:51
+===========================  =========  ==========================================
+
+Plus the TPU-build additions: the default execution backend and packing
+alignment.
+"""
+
+from __future__ import annotations
+
+# xT grid
+XT_GRID_LENGTH: int = 16  # N: cells along pitch length (x)
+XT_GRID_WIDTH: int = 12  # M: cells along pitch width (y)
+XT_EPS: float = 1e-5
+
+# VAEP
+LABEL_LOOKAHEAD: int = 10
+SAMEPHASE_SECONDS: float = 10
+PENALTY_PRIOR: float = 0.792453
+CORNER_PRIOR: float = 0.046500
+NB_PREV_ACTIONS: int = 3
+
+# dribble synthesis (SPADL converters)
+MIN_DRIBBLE_LENGTH: float = 3.0
+MAX_DRIBBLE_LENGTH: float = 60.0
+MAX_DRIBBLE_DURATION: float = 10.0
+
+# TPU runtime
+DEFAULT_BACKEND: str = 'jax'
+ACTION_AXIS_ALIGNMENT: int = 128  # TPU lane width the action axis pads to

@@ -1,0 +1,6 @@
+"""Probability models: gradient-boosted trees (host) and a JAX MLP (device)."""
+
+from .learners import LEARNERS
+from .mlp import MLPClassifier
+
+__all__ = ['LEARNERS', 'MLPClassifier']

@@ -1,0 +1,112 @@
+"""Gradient-boosted-tree learners for the VAEP probability models.
+
+The reference supports xgboost / catboost / lightgbm, each instantiated
+with the same default shape (100 estimators, depth 3, AUC early stopping;
+reference ``socceraction/vaep/base.py:215-282``). All three remain
+supported when importable; this environment additionally gets an
+always-available scikit-learn fallback so the framework works with zero
+optional dependencies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+try:
+    import xgboost
+except ImportError:  # pragma: no cover
+    xgboost = None
+try:
+    import catboost
+except ImportError:  # pragma: no cover
+    catboost = None
+try:
+    import lightgbm
+except ImportError:  # pragma: no cover
+    lightgbm = None
+
+from sklearn.ensemble import HistGradientBoostingClassifier
+
+from .mlp import MLPClassifier
+
+EvalSet = Optional[List[Tuple[Any, Any]]]
+
+
+def fit_xgboost(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+    """xgboost with the reference's defaults (base.py:215-235).
+
+    Written against the xgboost >= 2.0 API, where ``eval_metric`` and
+    ``early_stopping_rounds`` are constructor parameters rather than
+    ``fit()`` kwargs.
+    """
+    if xgboost is None:
+        raise ImportError('xgboost is not installed')
+    if tree_params is None:
+        tree_params = dict(n_estimators=100, max_depth=3, eval_metric='auc')
+        if eval_set is not None:
+            tree_params['early_stopping_rounds'] = 10
+    if fit_params is None:
+        fit_params = dict(verbose=False)
+    if eval_set is not None:
+        fit_params = {**fit_params, 'eval_set': eval_set}
+    model = xgboost.XGBClassifier(**tree_params)
+    return model.fit(X, y, **fit_params)
+
+
+def fit_catboost(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+    """catboost with the reference's defaults (base.py:237-261)."""
+    if catboost is None:
+        raise ImportError('catboost is not installed')
+    if tree_params is None:
+        tree_params = dict(eval_metric='BrierScore', loss_function='Logloss', iterations=100)
+    if fit_params is None:
+        is_cat = [str(X[c].dtype) == 'category' for c in X.columns]
+        fit_params = dict(cat_features=np.nonzero(is_cat)[0].tolist(), verbose=False)
+    if eval_set is not None:
+        fit_params = {**fit_params, 'early_stopping_rounds': 10, 'eval_set': eval_set}
+    model = catboost.CatBoostClassifier(**tree_params)
+    return model.fit(X, y, **fit_params)
+
+
+def fit_lightgbm(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+    """lightgbm with the reference's defaults (base.py:263-282)."""
+    if lightgbm is None:
+        raise ImportError('lightgbm is not installed')
+    if tree_params is None:
+        tree_params = dict(n_estimators=100, max_depth=3)
+    if fit_params is None:
+        fit_params = dict(eval_metric='auc')
+    if eval_set is not None:
+        fit_params = {**fit_params, 'eval_set': eval_set}
+    model = lightgbm.LGBMClassifier(**tree_params)
+    return model.fit(X, y, **fit_params)
+
+
+def fit_sklearn(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+    """Histogram gradient boosting from scikit-learn (always available).
+
+    Mirrors the reference's learner shape: 100 boosting iterations of
+    depth-3 trees with early stopping when a validation fraction is used.
+    """
+    if tree_params is None:
+        tree_params = dict(max_iter=100, max_depth=3, early_stopping=eval_set is not None)
+    model = HistGradientBoostingClassifier(**tree_params)
+    return model.fit(X, y, **(fit_params or {}))
+
+
+def fit_mlp(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+    """The on-device JAX MLP (see :class:`socceraction_tpu.ml.mlp.MLPClassifier`)."""
+    model = MLPClassifier(**(tree_params or {}))
+    es = eval_set[0] if eval_set else None
+    return model.fit(np.asarray(X), np.asarray(y), eval_set=es)
+
+
+LEARNERS: Dict[str, Any] = {
+    'xgboost': fit_xgboost,
+    'catboost': fit_catboost,
+    'lightgbm': fit_lightgbm,
+    'sklearn': fit_sklearn,
+    'mlp': fit_mlp,
+}

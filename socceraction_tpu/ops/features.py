@@ -1,0 +1,274 @@
+"""Fused JAX kernels for the VAEP game-state features.
+
+The pandas oracle (:mod:`socceraction_tpu.vaep.features`) materializes
+``nb_prev_actions`` shifted DataFrame copies per game and concatenates
+per-transformer blocks (reference ``socceraction/vaep/features.py:62-145``).
+Here the whole feature matrix for *all* games is produced by one fused XLA
+computation over a packed ``(G, A)`` batch:
+
+- "game states" are static edge-clamped gathers (``arr[:, max(j - i, 0)]``)
+  -- no materialized copies,
+- one-hots are ``jax.nn.one_hot`` on the int id columns (numerically equal
+  to the reference's name-equality columns),
+- the left-to-right mirror is a ``where`` on the current action's
+  home/away flag,
+- goalscore is a masked cumulative sum along the action axis.
+
+Everything is elementwise / static-gather algebra on ``(G, A)`` tensors, so
+XLA fuses the transformer blocks into a handful of kernels; the game axis
+is vmap-free (kernels are written batched) and shards over the device mesh.
+
+Feature *names and order* are still derived by executing the pandas
+transformers on a dummy frame (reference ``features.py:20-59``), so both
+backends agree column-for-column by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..spadl import config as spadlconfig
+from ..core.batch import ActionBatch
+
+__all__ = ['compute_features', 'KERNELS']
+
+_N_TYPES = len(spadlconfig.actiontypes)
+_N_RESULTS = len(spadlconfig.results)
+_N_BODYPARTS = len(spadlconfig.bodyparts)
+_GOAL_X = spadlconfig.field_length
+_GOAL_Y = spadlconfig.field_width / 2
+
+
+def _shift_gather(arr: jax.Array, i: int) -> jax.Array:
+    """State gather: row j sees row ``max(j - i, 0)`` (edge backfill)."""
+    if i == 0:
+        return arr
+    A = arr.shape[1]
+    idx = jnp.maximum(jnp.arange(A) - i, 0)
+    return arr[:, idx]
+
+
+class _States:
+    """Per-state views of a batch, with the left-to-right mirror applied."""
+
+    def __init__(self, batch: ActionBatch, k: int):
+        self.k = k
+        f = jnp.float32
+        a0_home = batch.is_home  # (G, A): flip decided by the current action
+        self.a0_home = a0_home
+
+        def ltr(x, extent):
+            return jnp.where(a0_home, x, extent - x)
+
+        self.type_id = [_shift_gather(batch.type_id, i) for i in range(k)]
+        self.result_id = [_shift_gather(batch.result_id, i) for i in range(k)]
+        self.bodypart_id = [_shift_gather(batch.bodypart_id, i) for i in range(k)]
+        self.period_id = [_shift_gather(batch.period_id, i).astype(f) for i in range(k)]
+        self.time_seconds = [
+            _shift_gather(batch.time_seconds, i).astype(f) for i in range(k)
+        ]
+        self.is_home = [_shift_gather(batch.is_home, i) for i in range(k)]
+        L, W = spadlconfig.field_length, spadlconfig.field_width
+        self.start_x = [ltr(_shift_gather(batch.start_x, i).astype(f), L) for i in range(k)]
+        self.start_y = [ltr(_shift_gather(batch.start_y, i).astype(f), W) for i in range(k)]
+        self.end_x = [ltr(_shift_gather(batch.end_x, i).astype(f), L) for i in range(k)]
+        self.end_y = [ltr(_shift_gather(batch.end_y, i).astype(f), W) for i in range(k)]
+
+
+def _stack(cols: List[jax.Array], like: jax.Array = None) -> jax.Array:
+    """Stack per-column ``(G, A)`` arrays into a ``(G, A, F)`` block.
+
+    An empty column list yields a zero-width block (state features with
+    ``nb_prev_actions == 1``), matching the pandas backend's empty frames.
+    """
+    if not cols:
+        return jnp.zeros((*like.shape, 0), dtype=jnp.float32)
+    return jnp.stack(cols, axis=-1).astype(jnp.float32)
+
+
+# --- per-transformer blocks (names match the pandas transformers) ----------
+
+
+def _actiontype(s: _States) -> jax.Array:
+    return _stack([s.type_id[i].astype(jnp.float32) for i in range(s.k)])
+
+
+def _actiontype_onehot(s: _States) -> jax.Array:
+    return jnp.concatenate(
+        [jax.nn.one_hot(s.type_id[i], _N_TYPES, dtype=jnp.float32) for i in range(s.k)],
+        axis=-1,
+    )
+
+
+def _result(s: _States) -> jax.Array:
+    return _stack([s.result_id[i].astype(jnp.float32) for i in range(s.k)])
+
+
+def _result_onehot(s: _States) -> jax.Array:
+    return jnp.concatenate(
+        [jax.nn.one_hot(s.result_id[i], _N_RESULTS, dtype=jnp.float32) for i in range(s.k)],
+        axis=-1,
+    )
+
+
+def _actiontype_result_onehot(s: _States) -> jax.Array:
+    blocks = []
+    for i in range(s.k):
+        ty = jax.nn.one_hot(s.type_id[i], _N_TYPES, dtype=jnp.float32)
+        re = jax.nn.one_hot(s.result_id[i], _N_RESULTS, dtype=jnp.float32)
+        # type-major flattening matches the reference's nested column loop
+        blocks.append((ty[..., :, None] * re[..., None, :]).reshape(*ty.shape[:-1], -1))
+    return jnp.concatenate(blocks, axis=-1)
+
+
+def _bodypart(s: _States) -> jax.Array:
+    return _stack([s.bodypart_id[i].astype(jnp.float32) for i in range(s.k)])
+
+
+def _bodypart_onehot(s: _States) -> jax.Array:
+    return jnp.concatenate(
+        [
+            jax.nn.one_hot(s.bodypart_id[i], _N_BODYPARTS, dtype=jnp.float32)
+            for i in range(s.k)
+        ],
+        axis=-1,
+    )
+
+
+def _time(s: _States) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        overall = (s.period_id[i] - 1) * 45 * 60 + s.time_seconds[i]
+        cols += [s.period_id[i], s.time_seconds[i], overall]
+    return _stack(cols)
+
+
+def _startlocation(s: _States) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        cols += [s.start_x[i], s.start_y[i]]
+    return _stack(cols)
+
+
+def _endlocation(s: _States) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        cols += [s.end_x[i], s.end_y[i]]
+    return _stack(cols)
+
+
+def _polar(x: jax.Array, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    dx = jnp.abs(_GOAL_X - x)
+    dy = jnp.abs(_GOAL_Y - y)
+    dist = jnp.sqrt(dx**2 + dy**2)
+    angle = jnp.nan_to_num(jnp.arctan(dy / dx))
+    return dist, angle
+
+
+def _startpolar(s: _States) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        cols += list(_polar(s.start_x[i], s.start_y[i]))
+    return _stack(cols)
+
+
+def _endpolar(s: _States) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        cols += list(_polar(s.end_x[i], s.end_y[i]))
+    return _stack(cols)
+
+
+def _movement(s: _States) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        dx = s.end_x[i] - s.start_x[i]
+        dy = s.end_y[i] - s.start_y[i]
+        cols += [dx, dy, jnp.sqrt(dx**2 + dy**2)]
+    return _stack(cols)
+
+
+def _team(s: _States) -> jax.Array:
+    return _stack([(s.is_home[i] == s.is_home[0]) for i in range(1, s.k)], s.is_home[0])
+
+
+def _time_delta(s: _States) -> jax.Array:
+    return _stack(
+        [s.time_seconds[0] - s.time_seconds[i] for i in range(1, s.k)], s.is_home[0]
+    )
+
+
+def _space_delta(s: _States) -> jax.Array:
+    cols = []
+    for i in range(1, s.k):
+        dx = s.end_x[i] - s.start_x[0]
+        dy = s.end_y[i] - s.start_y[0]
+        cols += [dx, dy, jnp.sqrt(dx**2 + dy**2)]
+    return _stack(cols, s.is_home[0])
+
+
+def _goalscore(s: _States) -> jax.Array:
+    type_id = s.type_id[0]
+    result_id = s.result_id[0]
+    shot_like = (
+        (type_id == spadlconfig.SHOT)
+        | (type_id == spadlconfig.SHOT_PENALTY)
+        | (type_id == spadlconfig.SHOT_FREEKICK)
+    )
+    goals = shot_like & (result_id == spadlconfig.SUCCESS)
+    owngoals = shot_like & (result_id == spadlconfig.OWNGOAL)
+    # team "A" is the team of the game's first action (reference
+    # features.py:521); games are left-aligned so that is column 0.
+    teamisA = s.is_home[0] == s.is_home[0][:, :1]
+    goalsA = (goals & teamisA) | (owngoals & ~teamisA)
+    goalsB = (goals & ~teamisA) | (owngoals & teamisA)
+    f = jnp.float32
+    scoreA = jnp.cumsum(goalsA.astype(f), axis=1) - goalsA.astype(f)
+    scoreB = jnp.cumsum(goalsB.astype(f), axis=1) - goalsB.astype(f)
+    team_score = jnp.where(teamisA, scoreA, scoreB)
+    opp_score = jnp.where(teamisA, scoreB, scoreA)
+    return _stack([team_score, opp_score, team_score - opp_score])
+
+
+KERNELS: Dict[str, object] = {
+    'actiontype': _actiontype,
+    'actiontype_onehot': _actiontype_onehot,
+    'result': _result,
+    'result_onehot': _result_onehot,
+    'actiontype_result_onehot': _actiontype_result_onehot,
+    'bodypart': _bodypart,
+    'bodypart_onehot': _bodypart_onehot,
+    'time': _time,
+    'startlocation': _startlocation,
+    'endlocation': _endlocation,
+    'startpolar': _startpolar,
+    'endpolar': _endpolar,
+    'movement': _movement,
+    'team': _team,
+    'time_delta': _time_delta,
+    'space_delta': _space_delta,
+    'goalscore': _goalscore,
+}
+
+
+@functools.partial(jax.jit, static_argnames=('names', 'k'))
+def compute_features(batch: ActionBatch, *, names: Tuple[str, ...], k: int) -> jax.Array:
+    """Compute the concatenated ``(G, A, F)`` feature tensor.
+
+    Parameters
+    ----------
+    batch : ActionBatch
+        Packed actions. The left-to-right mirror is applied internally from
+        ``batch.is_home`` (so pack with the correct per-game home team).
+    names : tuple of str
+        Transformer names (keys of :data:`KERNELS`) in output order.
+    k : int
+        ``nb_prev_actions``: number of game states.
+    """
+    s = _States(batch, k)
+    blocks = [KERNELS[n](s) for n in names]
+    return jnp.concatenate(blocks, axis=-1)

@@ -1,0 +1,66 @@
+"""JAX kernel for the VAEP value formula.
+
+Parity with the pandas oracle (:mod:`socceraction_tpu.vaep.formula`,
+reference ``socceraction/vaep/formula.py:17-151``): lag-1 selects with
+team-continuity, the 10-second same-phase cutoff, the previous-goal reset
+and the fixed penalty/corner priors, evaluated as fused ``where`` algebra
+on the packed ``(G, A)`` batch. The lag clamps at each game's first row
+(``max(j - 1, 0)``), which is exact because games are left-aligned.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import CORNER_PRIOR, PENALTY_PRIOR, SAMEPHASE_SECONDS
+from ..core.batch import ActionBatch
+from ..spadl import config as spadlconfig
+
+__all__ = ['vaep_values']
+
+_CORNER_TYPES = (
+    spadlconfig.actiontypes.index('corner_crossed'),
+    spadlconfig.actiontypes.index('corner_short'),
+)
+
+
+@jax.jit
+def vaep_values(
+    batch: ActionBatch, p_scores: jax.Array, p_concedes: jax.Array
+) -> jax.Array:
+    """Compute ``(G, A, 3)``: offensive, defensive and total VAEP values."""
+    A = batch.type_id.shape[1]
+    prev = jnp.maximum(jnp.arange(A) - 1, 0)
+
+    type_id = batch.type_id
+    type_prev = type_id[:, prev]
+    result_prev = batch.result_id[:, prev]
+    sameteam = batch.is_home[:, prev] == batch.is_home
+    p_scores_prev = p_scores[:, prev]
+    p_concedes_prev = p_concedes[:, prev]
+
+    t = batch.time_seconds
+    toolong = jnp.abs(t - t[:, prev]) > SAMEPHASE_SECONDS
+
+    prevgoal = (
+        (type_prev == spadlconfig.SHOT)
+        | (type_prev == spadlconfig.SHOT_PENALTY)
+        | (type_prev == spadlconfig.SHOT_FREEKICK)
+    ) & (result_prev == spadlconfig.SUCCESS)
+
+    reset = toolong | prevgoal
+
+    prev_scores = jnp.where(sameteam, p_scores_prev, p_concedes_prev)
+    prev_scores = jnp.where(reset, 0.0, prev_scores)
+    is_penalty = type_id == spadlconfig.SHOT_PENALTY
+    is_corner = (type_id == _CORNER_TYPES[0]) | (type_id == _CORNER_TYPES[1])
+    prev_scores = jnp.where(is_penalty, PENALTY_PRIOR, prev_scores)
+    prev_scores = jnp.where(is_corner, CORNER_PRIOR, prev_scores)
+
+    prev_concedes = jnp.where(sameteam, p_concedes_prev, p_scores_prev)
+    prev_concedes = jnp.where(reset, 0.0, prev_concedes)
+
+    offensive = p_scores - prev_scores
+    defensive = -(p_concedes - prev_concedes)
+    return jnp.stack([offensive, defensive, offensive + defensive], axis=-1)

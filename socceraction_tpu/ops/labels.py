@@ -1,0 +1,65 @@
+"""JAX kernels for the VAEP scoring/conceding labels.
+
+The pandas oracle (:mod:`socceraction_tpu.vaep.labels`, reference
+``socceraction/vaep/labels.py:9-93``) builds ``nr_actions - 1``
+forward-shifted copies and OR-reduces them. Here the same windowed OR is a
+statically unrolled sequence of per-game edge-clamped gathers on the packed
+``(G, A)`` batch: the clamp is at each game's *last valid row*
+(``min(j + i, n_valid - 1)``), reproducing the reference's per-game tail
+backfill even though many games share one tensor.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..spadl import config as spadlconfig
+from ..core.batch import ActionBatch
+
+__all__ = ['scores_concedes', 'goal_from_shot']
+
+
+def _goal_masks(type_id: jax.Array, result_id: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    shot_like = (
+        (type_id == spadlconfig.SHOT)
+        | (type_id == spadlconfig.SHOT_PENALTY)
+        | (type_id == spadlconfig.SHOT_FREEKICK)
+    )
+    goal = shot_like & (result_id == spadlconfig.SUCCESS)
+    owngoal = shot_like & (result_id == spadlconfig.OWNGOAL)
+    return goal, owngoal
+
+
+@functools.partial(jax.jit, static_argnames=('nr_actions',))
+def scores_concedes(batch: ActionBatch, *, nr_actions: int = 10) -> Tuple[jax.Array, jax.Array]:
+    """Compute the ``scores`` and ``concedes`` label tensors, shape ``(G, A)``.
+
+    Returns bool arrays; padded rows carry arbitrary values (mask them).
+    """
+    goal, owngoal = _goal_masks(batch.type_id, batch.result_id)
+    team = batch.is_home
+    A = goal.shape[1]
+    last = (batch.n_actions - 1)[:, None]  # (G, 1) per-game clamp
+
+    scores = goal
+    concedes = owngoal
+    for i in range(1, nr_actions):
+        idx = jnp.minimum(jnp.arange(A) + i, last)  # (G, A)
+        goal_i = jnp.take_along_axis(goal, idx, axis=1)
+        owngoal_i = jnp.take_along_axis(owngoal, idx, axis=1)
+        team_i = jnp.take_along_axis(team, idx, axis=1)
+        same = team_i == team
+        scores = scores | (goal_i & same) | (owngoal_i & ~same)
+        concedes = concedes | (goal_i & ~same) | (owngoal_i & same)
+    return scores, concedes
+
+
+@jax.jit
+def goal_from_shot(batch: ActionBatch) -> jax.Array:
+    """xG label: True when a goal was scored from the current action."""
+    goal, _ = _goal_masks(batch.type_id, batch.result_id)
+    return goal

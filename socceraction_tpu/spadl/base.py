@@ -16,11 +16,12 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
+from ..config import MAX_DRIBBLE_DURATION, MAX_DRIBBLE_LENGTH, MIN_DRIBBLE_LENGTH
 from . import config as spadlconfig
 
-min_dribble_length: float = 3.0
-max_dribble_length: float = 60.0
-max_dribble_duration: float = 10.0
+min_dribble_length: float = MIN_DRIBBLE_LENGTH
+max_dribble_length: float = MAX_DRIBBLE_LENGTH
+max_dribble_duration: float = MAX_DRIBBLE_DURATION
 
 
 def _fix_clearances(actions: pd.DataFrame) -> pd.DataFrame:

@@ -1,0 +1,5 @@
+"""The VAEP action-valuation framework."""
+
+from .base import VAEP, NotFittedError, xfns_default
+
+__all__ = ['VAEP', 'NotFittedError', 'xfns_default']

@@ -1,0 +1,58 @@
+"""Label transformers of the VAEP framework (pandas oracle side).
+
+Parity: reference ``socceraction/vaep/labels.py`` -- ``scores:9``,
+``concedes:53``, ``goal_from_shot:96``. The lookahead clamps at the last
+row of the game (edge rows see the final action repeated), matching the
+reference's ``shift(-i)`` + tail backfill.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from ..spadl import config as spadlconfig
+
+
+def _goal_masks(actions: pd.DataFrame):
+    shot_like = actions['type_name'].str.contains('shot').to_numpy()
+    goal = shot_like & (actions['result_id'] == spadlconfig.SUCCESS).to_numpy()
+    owngoal = shot_like & (actions['result_id'] == spadlconfig.OWNGOAL).to_numpy()
+    return goal, owngoal
+
+
+def _lookahead(
+    goal: np.ndarray, owngoal: np.ndarray, team: np.ndarray, nr_actions: int, concede: bool
+) -> np.ndarray:
+    n = len(goal)
+    res = owngoal.copy() if concede else goal.copy()
+    for i in range(1, nr_actions):
+        idx = np.minimum(np.arange(n) + i, n - 1)
+        same = team[idx] == team
+        if concede:
+            res |= (goal[idx] & ~same) | (owngoal[idx] & same)
+        else:
+            res |= (goal[idx] & same) | (owngoal[idx] & ~same)
+    return res
+
+
+def scores(actions: pd.DataFrame, nr_actions: int = 10) -> pd.DataFrame:
+    """True when the acting team scores within the next ``nr_actions``."""
+    goal, owngoal = _goal_masks(actions)
+    team = actions['team_id'].to_numpy()
+    res = _lookahead(goal, owngoal, team, nr_actions, concede=False)
+    return pd.DataFrame({'scores': res}, index=actions.index)
+
+
+def concedes(actions: pd.DataFrame, nr_actions: int = 10) -> pd.DataFrame:
+    """True when the acting team concedes within the next ``nr_actions``."""
+    goal, owngoal = _goal_masks(actions)
+    team = actions['team_id'].to_numpy()
+    res = _lookahead(goal, owngoal, team, nr_actions, concede=True)
+    return pd.DataFrame({'concedes': res}, index=actions.index)
+
+
+def goal_from_shot(actions: pd.DataFrame) -> pd.DataFrame:
+    """True when a goal was scored from the current action (xG label)."""
+    goal, _ = _goal_masks(actions)
+    return pd.DataFrame({'goal_from_shot': goal}, index=actions.index)

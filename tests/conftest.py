@@ -1,18 +1,36 @@
 """Shared test fixtures.
 
-Tests run on a virtual 8-device CPU mesh: the env vars below must be set
-before jax is first imported, which this conftest guarantees by being the
-pytest entry point. Benchmarks (bench.py) run on real TPU hardware instead.
+Tests run on a virtual 8-device CPU mesh. In this image a sitecustomize
+hook registers the remote-TPU ("axon") PJRT plugin at *interpreter startup*
+and latches JAX_PLATFORMS before any test code runs, so setting env vars
+here is too late -- instead the conftest re-execs pytest once with a clean
+CPU environment (axon registration disabled via empty
+PALLAS_AXON_POOL_IPS). Benchmarks (bench.py) run on the real TPU.
 """
 
 import os
+import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-xla_flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in xla_flags:
-    os.environ['XLA_FLAGS'] = (
-        xla_flags + ' --xla_force_host_platform_device_count=8'
-    ).strip()
+
+def pytest_configure(config):
+    if os.environ.get('SOCCERACTION_TPU_TEST_ENV') == '1':
+        return
+    env = dict(os.environ)
+    env['SOCCERACTION_TPU_TEST_ENV'] = '1'
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PALLAS_AXON_POOL_IPS'] = ''  # skip remote-TPU plugin registration
+    xla_flags = env.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in xla_flags:
+        env['XLA_FLAGS'] = (
+            xla_flags + ' --xla_force_host_platform_device_count=8'
+        ).strip()
+    # pytest has already dup2'd fd 1/2 into its capture files; restore them
+    # so the re-exec'd run writes to the real terminal.
+    capman = config.pluginmanager.getplugin('capturemanager')
+    if capman is not None:
+        capman.stop_global_capturing()
+    args = list(config.invocation_params.args)
+    os.execve(sys.executable, [sys.executable, '-m', 'pytest'] + args, env)
 
 import json
 from pathlib import Path

@@ -1,0 +1,145 @@
+"""Parity tests for VAEP labels and the value formula."""
+
+import numpy as np
+import pandas as pd
+
+from socceraction_tpu.core.batch import pack_actions, unpack_values
+from socceraction_tpu.ops import formula as formulaops
+from socceraction_tpu.ops import labels as labops
+from socceraction_tpu.spadl import add_names
+from socceraction_tpu.spadl import config as spadlconfig
+from socceraction_tpu.vaep import formula as vaepformula
+from socceraction_tpu.vaep import labels as lab
+
+
+def _goal_game() -> pd.DataFrame:
+    """A tiny game with a goal at row 5 and an owngoal at row 12."""
+    n = 16
+    df = pd.DataFrame(
+        {
+            'game_id': [1] * n,
+            'original_event_id': [None] * n,
+            'period_id': [1] * n,
+            'action_id': range(n),
+            'time_seconds': np.arange(n, dtype=float) * 5.0,
+            'team_id': [10, 10, 20, 10, 10, 10, 20, 20, 10, 20, 10, 20, 20, 10, 20, 10],
+            'player_id': [1] * n,
+            'start_x': [50.0] * n,
+            'start_y': [30.0] * n,
+            'end_x': [60.0] * n,
+            'end_y': [30.0] * n,
+            'type_id': [0] * n,
+            'result_id': [1] * n,
+            'bodypart_id': [0] * n,
+        }
+    )
+    df.loc[5, 'type_id'] = spadlconfig.SHOT
+    df.loc[12, 'type_id'] = spadlconfig.SHOT
+    df.loc[12, 'result_id'] = spadlconfig.OWNGOAL
+    return df
+
+
+def test_scores_lookahead_semantics():
+    df = add_names(_goal_game())
+    s = lab.scores(df, nr_actions=10)['scores']
+    # goal by team 10 at row 5: rows 0..5 with team 10 within window are True
+    assert bool(s[5]) is True  # the goal row itself
+    assert bool(s[0]) is True  # team 10, 5 actions before
+    assert bool(s[2]) is False  # team 20 never scores
+    # owngoal by team 20 at row 12 counts for team 10
+    assert bool(s[8]) is True
+    assert bool(s[9]) is False  # team 20's own goal does not score for them
+
+
+def test_concedes_lookahead_semantics():
+    df = add_names(_goal_game())
+    c = lab.concedes(df, nr_actions=10)['concedes']
+    # team 20 concedes the row-5 goal
+    assert bool(c[2]) is True
+    # the own-goaling team (20) concedes its own goal
+    assert bool(c[12]) is True
+    assert bool(c[9]) is True
+
+
+def test_window_clamps_at_game_end():
+    df = add_names(_goal_game())
+    s1 = lab.scores(df, nr_actions=1)['scores']
+    # with window 1 only the goal row itself is labeled
+    assert s1.sum() == 1 and bool(s1[5])
+
+
+def test_labels_jax_matches_pandas(spadl_actions, home_team_id):
+    named = add_names(spadl_actions)
+    ref_s = lab.scores(named)['scores'].to_numpy()
+    ref_c = lab.concedes(named)['concedes'].to_numpy()
+    batch, _ = pack_actions(spadl_actions, home_team_id=home_team_id)
+    s, c = labops.scores_concedes(batch)
+    np.testing.assert_array_equal(unpack_values(s, batch), ref_s)
+    np.testing.assert_array_equal(unpack_values(c, batch), ref_c)
+
+
+def test_labels_jax_matches_pandas_synthetic():
+    df = _goal_game()
+    named = add_names(df)
+    batch, _ = pack_actions(df, home_team_id=10)
+    s, c = labops.scores_concedes(batch)
+    np.testing.assert_array_equal(
+        unpack_values(s, batch), lab.scores(named)['scores'].to_numpy()
+    )
+    np.testing.assert_array_equal(
+        unpack_values(c, batch), lab.concedes(named)['concedes'].to_numpy()
+    )
+
+
+def test_goal_from_shot(spadl_actions):
+    named = add_names(spadl_actions)
+    ref = lab.goal_from_shot(named)['goal_from_shot'].to_numpy()
+    batch, _ = pack_actions(spadl_actions, home_team_id=782)
+    np.testing.assert_array_equal(unpack_values(labops.goal_from_shot(batch), batch), ref)
+
+
+def test_formula_jax_matches_pandas(spadl_actions, home_team_id):
+    named = add_names(spadl_actions)
+    rng = np.random.default_rng(0)
+    p_s = rng.uniform(0, 0.2, len(named)).astype(np.float32)
+    p_c = rng.uniform(0, 0.2, len(named)).astype(np.float32)
+
+    ref = vaepformula.value(named, pd.Series(p_s), pd.Series(p_c))
+
+    batch, _ = pack_actions(spadl_actions, home_team_id=home_team_id)
+    # scatter host probs into the padded (G, A) layout
+    import jax.numpy as jnp
+
+    mask = np.asarray(batch.mask)
+    rows = np.asarray(batch.row_index)[mask]
+    ps = np.zeros(mask.shape, dtype=np.float32)
+    pc = np.zeros(mask.shape, dtype=np.float32)
+    ps[mask] = p_s[rows]
+    pc[mask] = p_c[rows]
+    vals = formulaops.vaep_values(batch, jnp.asarray(ps), jnp.asarray(pc))
+    out = unpack_values(vals, batch)
+    np.testing.assert_allclose(out[:, 0], ref['offensive_value'].to_numpy(), atol=1e-6)
+    np.testing.assert_allclose(out[:, 1], ref['defensive_value'].to_numpy(), atol=1e-6)
+    np.testing.assert_allclose(out[:, 2], ref['vaep_value'].to_numpy(), atol=1e-6)
+
+
+def test_formula_priors_and_resets():
+    df = _goal_game()
+    # make row 6 a penalty and row 7 a corner; row 5 is a goal so row 6 also
+    # has the previous-goal reset -- the penalty prior must win
+    df.loc[5, 'result_id'] = spadlconfig.SUCCESS
+    df.loc[6, 'type_id'] = spadlconfig.SHOT_PENALTY
+    df.loc[7, 'type_id'] = spadlconfig.actiontypes.index('corner_crossed')
+    named = add_names(df)
+    n = len(named)
+    p_s = pd.Series(np.full(n, 0.1))
+    p_c = pd.Series(np.full(n, 0.05))
+    v = vaepformula.value(named, p_s, p_c)
+    # penalty: offensive = 0.1 - 0.792453
+    np.testing.assert_allclose(v['offensive_value'][6], 0.1 - 0.792453)
+    # corner: offensive = 0.1 - 0.0465
+    np.testing.assert_allclose(v['offensive_value'][7], 0.1 - 0.0465)
+    # row 6 defensive: prev action was a goal -> prev_concedes = 0
+    np.testing.assert_allclose(v['defensive_value'][6], -0.05)
+    # time gaps are 5s (< 10s cutoff): row 1 same team keeps prev probability
+    np.testing.assert_allclose(v['offensive_value'][1], 0.0)

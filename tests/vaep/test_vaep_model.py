@@ -1,0 +1,143 @@
+"""End-to-end tests of the VAEP model class (both backends, both model types)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.batch import pack_actions, unpack_values
+from socceraction_tpu.vaep import VAEP, NotFittedError
+from socceraction_tpu.vaep import features as fs
+
+
+@pytest.fixture(scope='module')
+def game(home_team_id):
+    return pd.Series({'game_id': 8657, 'home_team_id': home_team_id})
+
+
+@pytest.fixture(scope='module')
+def fitted(game, spadl_actions):
+    """A VAEP model fitted on the golden game with the sklearn learner."""
+    np.random.seed(0)
+    model = VAEP(backend='pandas')
+    X = model.compute_features(game, spadl_actions)
+    y = model.compute_labels(game, spadl_actions)
+    model.fit(X, y, learner='sklearn')
+    return model, X, y
+
+
+def test_feature_and_label_columns(game, spadl_actions):
+    model = VAEP(backend='pandas')
+    X = model.compute_features(game, spadl_actions)
+    assert list(X.columns) == model.feature_names
+    y = model.compute_labels(game, spadl_actions)
+    assert list(y.columns) == ['scores', 'concedes']
+    assert y.dtypes.map(str).tolist() == ['bool', 'bool']
+
+
+def test_backend_feature_parity(game, spadl_actions):
+    ref = VAEP(backend='pandas').compute_features(game, spadl_actions)
+    out = VAEP(backend='jax').compute_features(game, spadl_actions)
+    assert list(ref.columns) == list(out.columns)
+    np.testing.assert_allclose(
+        out.to_numpy(dtype=np.float64),
+        ref.to_numpy(dtype=np.float64),
+        atol=2e-3,
+        rtol=1e-5,
+    )
+
+
+def test_backend_label_parity(game, spadl_actions):
+    ref = VAEP(backend='pandas').compute_labels(game, spadl_actions)
+    out = VAEP(backend='jax').compute_labels(game, spadl_actions)
+    pd.testing.assert_frame_equal(ref, out)
+
+
+def test_fit_checks_feature_columns(fitted, game, spadl_actions):
+    model, X, y = fitted
+    with pytest.raises(ValueError, match='not available'):
+        VAEP(backend='pandas').fit(X.iloc[:, :10], y, learner='sklearn')
+
+
+def test_rate_unfitted_raises(game, spadl_actions):
+    with pytest.raises(NotFittedError):
+        VAEP(backend='pandas').rate(game, spadl_actions)
+
+
+def test_rate_outputs(fitted, game, spadl_actions):
+    model, X, y = fitted
+    ratings = model.rate(game, spadl_actions)
+    assert list(ratings.columns) == ['offensive_value', 'defensive_value', 'vaep_value']
+    assert len(ratings) == len(spadl_actions)
+    assert np.isfinite(ratings.to_numpy()).all()
+    np.testing.assert_allclose(
+        ratings['vaep_value'],
+        ratings['offensive_value'] + ratings['defensive_value'],
+        atol=1e-9,
+    )
+
+
+def test_rate_backend_parity(fitted, game, spadl_actions):
+    """pandas-path and jax-path rating agree within 1e-5 on equal features.
+
+    Tree models are step functions, so the float32 features of the device
+    path can flip borderline split thresholds vs float64 pandas features;
+    the 1e-5 parity contract is on the pipeline given the same features
+    (the feature tensors themselves are compared elementwise in
+    test_backend_feature_parity).
+    """
+    model, X, y = fitted
+    jx = VAEP(backend='jax')
+    jx._models = model._models  # same fitted probability models
+    X_jax = jx.compute_features(game, spadl_actions)
+
+    ref = model.rate(game, spadl_actions, game_states=X_jax)
+    out = jx.rate(game, spadl_actions)
+    np.testing.assert_allclose(out.to_numpy(), ref.to_numpy(), atol=1e-5, rtol=1e-4)
+
+
+def test_score_metrics(fitted):
+    model, X, y = fitted
+    s = model.score(X, y)
+    for col in ('scores', 'concedes'):
+        assert 0 <= s[col]['brier'] <= 1
+        # the 200-action snippet has goal-free label columns, for which
+        # ROC-AUC is undefined; assert it only when both classes occur
+        if y[col].nunique() > 1:
+            assert 0 <= s[col]['auroc'] <= 1
+
+
+def test_mlp_learner_and_fused_rate_batch(game, spadl_actions, home_team_id):
+    np.random.seed(1)
+    model = VAEP(backend='jax')
+    X = model.compute_features(game, spadl_actions)
+    y = model.compute_labels(game, spadl_actions)
+    model.fit(X, y, learner='mlp', tree_params=dict(max_epochs=3, hidden=(16,)))
+
+    batch, _ = pack_actions(spadl_actions, home_team_id=home_team_id)
+    values = model.rate_batch(batch)
+    out = unpack_values(values, batch)
+    assert out.shape == (len(spadl_actions), 3)
+    assert np.isfinite(out).all()
+
+    # per-game DataFrame API agrees with the batched device path
+    df = model.rate(game, spadl_actions)
+    np.testing.assert_allclose(df.to_numpy(), out, atol=1e-6)
+
+
+def test_custom_xfns_subset(game, spadl_actions):
+    xfns = [fs.startlocation, fs.team, fs.goalscore]
+    ref = VAEP(xfns=xfns, backend='pandas').compute_features(game, spadl_actions)
+    out = VAEP(xfns=xfns, backend='jax').compute_features(game, spadl_actions)
+    assert list(ref.columns) == list(out.columns)
+    np.testing.assert_allclose(
+        out.to_numpy(dtype=np.float64), ref.to_numpy(dtype=np.float64), atol=1e-4
+    )
+
+
+def test_unknown_custom_transformer_jax_raises(game, spadl_actions):
+    def my_feature(gamestates):
+        return pd.DataFrame({'x': gamestates[0]['start_x']})
+
+    model = VAEP(xfns=[my_feature], backend='jax')
+    with pytest.raises(ValueError, match='no JAX kernel'):
+        model.compute_features(game, spadl_actions)
