@@ -1,0 +1,58 @@
+"""Benchmark: VAEP rating throughput (SPADL actions/sec) on one chip.
+
+Measures the fused device rating path — game-state features (154 cols,
+nb_prev_actions=3) → two MLP probability heads → VAEP value formula — on a
+synthetic multi-game batch, end-to-end as one jitted computation.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is measured throughput / the 1M actions/sec v4-8 target
+(BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+
+BASELINE_ACTIONS_PER_SEC = 1_000_000.0
+
+
+def main() -> None:
+    from __graft_entry__ import entry
+    from socceraction_tpu.core.synthetic import synthetic_batch
+
+    forward, (params, _) = entry()
+    fn = jax.jit(forward)
+
+    # ~850k valid actions; feature tensor (G, A, 154) fp32 ≈ 430 MB in HBM.
+    batch = synthetic_batch(n_games=512, n_actions=1664, seed=1)
+    total_actions = batch.total_actions
+
+    # warmup / compile
+    jax.block_until_ready(fn(params, batch))
+
+    n_iters = 10
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = fn(params, batch)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    actions_per_sec = total_actions * n_iters / dt
+    print(
+        json.dumps(
+            {
+                'metric': 'vaep_rate_actions_per_sec',
+                'value': round(actions_per_sec, 1),
+                'unit': 'actions/sec',
+                'vs_baseline': round(actions_per_sec / BASELINE_ACTIONS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == '__main__':
+    main()

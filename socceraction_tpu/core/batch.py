@@ -34,9 +34,11 @@ from flax import struct
 
 __all__ = ['ActionBatch', 'pack_actions', 'unpack_values', 'pad_length']
 
-# TPU vector lanes are 128 wide; keeping the action axis a multiple of 128
-# lets XLA tile elementwise kernels without a ragged remainder.
-_LANE = 128
+from ..config import ACTION_AXIS_ALIGNMENT
+
+# TPU vector lanes are 128 wide; keeping the action axis a multiple of the
+# lane width lets XLA tile elementwise kernels without a ragged remainder.
+_LANE = ACTION_AXIS_ALIGNMENT
 
 
 def pad_length(n: int, multiple: int = _LANE) -> int:

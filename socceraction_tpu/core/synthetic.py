@@ -1,0 +1,93 @@
+"""Synthetic SPADL action streams for benchmarks and compile checks.
+
+Generates statistically plausible (not physically consistent) action
+tensors directly as an :class:`ActionBatch` — no pandas round-trip — so
+benchmarks measure kernel throughput, not host packing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..spadl import config as spadlconfig
+from .batch import ActionBatch
+
+__all__ = ['synthetic_batch']
+
+
+def synthetic_batch(
+    n_games: int = 64,
+    n_actions: int = 1664,
+    *,
+    fill: float = 1.0,
+    seed: int = 0,
+) -> ActionBatch:
+    """Build a random but schema-valid ``(G, A)`` batch.
+
+    Parameters
+    ----------
+    n_games, n_actions
+        Batch shape. The default action count (1664 = 13×128) is the
+        typical SPADL game length (~1.5-2.5k actions per game, SURVEY §5)
+        rounded to a lane multiple.
+    fill : float
+        Fraction of each game's action axis that is valid (rest padding).
+    seed : int
+        numpy seed for reproducibility.
+    """
+    rng = np.random.default_rng(seed)
+    G, A = n_games, n_actions
+    n_valid = max(2, int(A * fill))
+
+    # Action-type distribution loosely matching real SPADL streams:
+    # passes dominate, then dribbles, with a tail over the remaining vocab.
+    n_types = len(spadlconfig.actiontypes)
+    probs = np.full(n_types, 0.02)
+    probs[spadlconfig.PASS] = 0.45
+    probs[spadlconfig.DRIBBLE] = 0.25
+    probs[spadlconfig.SHOT] = 0.03
+    probs /= probs.sum()
+
+    type_id = rng.choice(n_types, size=(G, A), p=probs).astype(np.int32)
+    result_id = rng.choice(
+        len(spadlconfig.results), size=(G, A), p=[0.25, 0.68, 0.02, 0.02, 0.02, 0.01]
+    ).astype(np.int32)
+    bodypart_id = rng.choice(
+        len(spadlconfig.bodyparts), size=(G, A), p=[0.85, 0.08, 0.05, 0.02]
+    ).astype(np.int32)
+    period_id = np.sort(rng.integers(1, 5, size=(G, A)), axis=1).astype(np.int32)
+    time_seconds = np.sort(
+        rng.uniform(0, 3000, size=(G, A)).astype(np.float32), axis=1
+    )
+    L, W = spadlconfig.field_length, spadlconfig.field_width
+    start_x = rng.uniform(0, L, size=(G, A)).astype(np.float32)
+    start_y = rng.uniform(0, W, size=(G, A)).astype(np.float32)
+    end_x = np.clip(start_x + rng.normal(0, 12, size=(G, A)), 0, L).astype(np.float32)
+    end_y = np.clip(start_y + rng.normal(0, 8, size=(G, A)), 0, W).astype(np.float32)
+    is_home = rng.integers(0, 2, size=(G, A)).astype(bool)
+
+    mask = np.zeros((G, A), dtype=bool)
+    mask[:, :n_valid] = True
+    row_index = np.where(
+        mask, np.arange(G * A).reshape(G, A) % (G * n_valid), -1
+    ).astype(np.int32)
+    # row_index must be a permutation of [0, total) over valid rows
+    row_index[mask] = np.arange(G * n_valid, dtype=np.int32)
+
+    return ActionBatch(
+        type_id=jnp.asarray(type_id),
+        result_id=jnp.asarray(result_id),
+        bodypart_id=jnp.asarray(bodypart_id),
+        period_id=jnp.asarray(period_id),
+        is_home=jnp.asarray(is_home),
+        time_seconds=jnp.asarray(time_seconds),
+        start_x=jnp.asarray(start_x),
+        start_y=jnp.asarray(start_y),
+        end_x=jnp.asarray(end_x),
+        end_y=jnp.asarray(end_y),
+        mask=jnp.asarray(mask),
+        n_actions=jnp.full(G, n_valid, dtype=jnp.int32),
+        game_id=jnp.arange(G, dtype=jnp.int32),
+        row_index=jnp.asarray(row_index),
+    )

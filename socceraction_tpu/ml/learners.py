@@ -45,8 +45,10 @@ def fit_xgboost(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=Non
         raise ImportError('xgboost is not installed')
     if tree_params is None:
         tree_params = dict(n_estimators=100, max_depth=3, eval_metric='auc')
-        if eval_set is not None:
-            tree_params['early_stopping_rounds'] = 10
+    else:
+        tree_params = dict(tree_params)
+    if eval_set is not None:
+        tree_params.setdefault('early_stopping_rounds', 10)
     if fit_params is None:
         fit_params = dict(verbose=False)
     if eval_set is not None:
@@ -79,7 +81,11 @@ def fit_lightgbm(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=No
     if fit_params is None:
         fit_params = dict(eval_metric='auc')
     if eval_set is not None:
-        fit_params = {**fit_params, 'eval_set': eval_set}
+        # lightgbm >= 4 dropped early_stopping_rounds from fit(); the
+        # callback keeps the reference's early-stopping-on-eval-set behavior
+        callbacks = list(fit_params.get('callbacks', []))
+        callbacks.append(lightgbm.early_stopping(10, verbose=False))
+        fit_params = {**fit_params, 'eval_set': eval_set, 'callbacks': callbacks}
     model = lightgbm.LGBMClassifier(**tree_params)
     return model.fit(X, y, **fit_params)
 

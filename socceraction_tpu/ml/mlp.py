@@ -120,7 +120,9 @@ class MLPClassifier:
 
         n = len(X)
         bs = min(self.batch_size, n)
-        steps = max(1, n // bs)
+        # ceil so the tail is trained on; the last batch wraps around the
+        # permutation to keep a fixed shape (no per-epoch recompilation)
+        steps = (n + bs - 1) // bs
         best_loss = np.inf
         best_params = params
         bad_epochs = 0
@@ -135,7 +137,7 @@ class MLPClassifier:
         for _ in range(self.max_epochs):
             perm = np_rng.permutation(n)
             for s in range(steps):
-                sel = jnp.asarray(perm[s * bs : (s + 1) * bs])
+                sel = jnp.asarray(perm[np.arange(s * bs, (s + 1) * bs) % n])
                 xb = jnp.take(Xd, sel, axis=0)
                 yb = jnp.take(yd, sel, axis=0)
                 params, opt_state, _ = train_step(params, opt_state, xb, yb)

@@ -17,6 +17,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..config import LABEL_LOOKAHEAD
 from ..spadl import config as spadlconfig
 from ..core.batch import ActionBatch
 
@@ -35,7 +36,7 @@ def _goal_masks(type_id: jax.Array, result_id: jax.Array) -> Tuple[jax.Array, ja
 
 
 @functools.partial(jax.jit, static_argnames=('nr_actions',))
-def scores_concedes(batch: ActionBatch, *, nr_actions: int = 10) -> Tuple[jax.Array, jax.Array]:
+def scores_concedes(batch: ActionBatch, *, nr_actions: int = LABEL_LOOKAHEAD) -> Tuple[jax.Array, jax.Array]:
     """Compute the ``scores`` and ``concedes`` label tensors, shape ``(G, A)``.
 
     Returns bool arrays; padded rows carry arbitrary values (mask them).

@@ -1,0 +1,40 @@
+"""Multi-device scale-out for the action-tensor runtime.
+
+The reference is single-process pandas with no parallelism of any kind
+(SURVEY §2 #26/#27: no reference counterpart exists). Here scale-out is a
+first-class subsystem built on ``jax.sharding``:
+
+- the **game axis** of an :class:`~socceraction_tpu.core.batch.ActionBatch`
+  is the data-parallel axis, sharded over a 1-D or 2-D
+  :class:`jax.sharding.Mesh` (ICI within a slice, DCN across slices),
+- xT training reduces its per-shard count matrices with a single ``psum``
+  (the only cross-game reduction in the whole system, reference
+  ``socceraction/xthreat.py:177-218`` builds it serially),
+- VAEP MLP training runs data-parallel (batch over ``games``) with
+  optionally tensor-parallel hidden layers (weights over ``model``);
+  XLA inserts the gradient all-reduce / activation collectives from the
+  sharding annotations.
+"""
+
+from .mesh import (
+    batch_sharding,
+    make_mesh,
+    pad_games,
+    replicated,
+    shard_batch,
+)
+from .xt import sharded_xt_counts, sharded_xt_fit
+from .vaep import make_train_step, sharded_rate, train_distributed
+
+__all__ = [
+    'make_mesh',
+    'batch_sharding',
+    'pad_games',
+    'replicated',
+    'shard_batch',
+    'sharded_xt_counts',
+    'sharded_xt_fit',
+    'make_train_step',
+    'sharded_rate',
+    'train_distributed',
+]

@@ -1,0 +1,104 @@
+"""Device-mesh construction and batch sharding helpers.
+
+A mesh has up to two named axes:
+
+- ``'games'`` — the data-parallel axis. Games are embarrassingly parallel
+  for every transform in the system (the reference's only loop over games,
+  its L5 pipelines, is sequential), so this axis does the heavy lifting.
+- ``'model'`` — optional tensor-parallel axis for the MLP probability
+  head's hidden dimension.
+
+On a multi-host pod the same code runs unchanged: ``jax.devices()`` spans
+hosts and the mesh lays 'games' along DCN-adjacent devices last, so the
+frequent collectives (gradient psum) ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.batch import ActionBatch
+
+__all__ = ['make_mesh', 'batch_sharding', 'pad_games', 'replicated', 'shard_batch']
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    model_parallel: int = 1,
+    *,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build a ``(games, model)`` mesh over the available devices.
+
+    Parameters
+    ----------
+    n_devices : int, optional
+        Use the first ``n_devices`` devices (default: all).
+    model_parallel : int
+        Size of the tensor-parallel ``'model'`` axis; must divide the
+        device count. Default 1 (pure data parallelism).
+    devices : sequence, optional
+        Explicit device list overriding ``n_devices``.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    devices = list(devices)
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(
+            f'model_parallel={model_parallel} does not divide {n} devices'
+        )
+    arr = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, axis_names=('games', 'model'))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of per-action ``(G, A)`` tensors: split the game axis."""
+    return NamedSharding(mesh, P('games'))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding (model grids, parameters, vocab tables)."""
+    return NamedSharding(mesh, P())
+
+
+def pad_games(batch: ActionBatch, multiple: int) -> ActionBatch:
+    """Pad the game axis up to a multiple of the mesh's data axis size.
+
+    Padding games carry ``mask == False`` and ``n_actions == 0``; every
+    kernel either ignores them via the mask or clamps its per-game gathers
+    (JAX gather semantics clip out-of-range indices), so they are inert.
+    """
+    G = batch.n_games
+    G_pad = ((G + multiple - 1) // multiple) * multiple
+    if G_pad == G:
+        return batch
+    extra = G_pad - G
+
+    def pad(x: jax.Array) -> jax.Array:
+        pad_width = [(0, extra)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, pad_width)
+
+    padded = jax.tree.map(pad, batch)
+    return padded.replace(row_index=padded.row_index.at[G:].set(-1))
+
+
+def shard_batch(batch: ActionBatch, mesh: Mesh) -> ActionBatch:
+    """Place a batch on the mesh, game axis sharded over ``'games'``.
+
+    The game axis is padded (with inert games) to a multiple of the data
+    axis so every device holds an equal shard. Use the returned batch's
+    ``row_index``/``mask`` to drop the padding on unpack —
+    :func:`~socceraction_tpu.core.batch.unpack_values` already does.
+    """
+    data_size = mesh.shape['games']
+    batch = pad_games(batch, data_size)
+    sh = NamedSharding(mesh, P('games'))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

@@ -1,0 +1,83 @@
+"""Sharded xT training: per-shard count matrices + one ``psum``.
+
+The xT count/transition matrices are plain sums over actions (reference
+``socceraction/xthreat.py:40-67,177-218``), so the distributed form is
+textbook: each device scatter-adds its local game shard into device-local
+matrices, one ``psum`` over the ``'games'`` axis reduces them, and the
+(small, replicated) value iteration runs identically on every device.
+This is the only cross-game collective in the whole framework.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.batch import ActionBatch
+from ..ops.xt import (
+    XTCounts,
+    XTProbabilities,
+    solve_xt,
+    xt_counts,
+    xt_probabilities,
+)
+
+__all__ = ['sharded_xt_counts', 'sharded_xt_fit']
+
+
+def _local_counts(batch: ActionBatch, l: int, w: int) -> XTCounts:
+    counts = xt_counts(
+        batch.type_id,
+        batch.result_id,
+        batch.start_x,
+        batch.start_y,
+        batch.end_x,
+        batch.end_y,
+        batch.mask,
+        l=l,
+        w=w,
+    )
+    return jax.tree.map(lambda c: jax.lax.psum(c, 'games'), counts)
+
+
+def sharded_xt_counts(batch: ActionBatch, mesh: Mesh, *, l: int, w: int) -> XTCounts:
+    """All-reduced xT counts for a game-sharded batch.
+
+    The batch must already be sharded/shardable over ``mesh`` (game axis a
+    multiple of the ``'games'`` axis size; see
+    :func:`~socceraction_tpu.parallel.mesh.shard_batch`).
+    """
+    fn = jax.shard_map(
+        functools.partial(_local_counts, l=l, w=w),
+        mesh=mesh,
+        in_specs=P('games'),
+        out_specs=P(),
+    )
+    return fn(batch)
+
+
+def sharded_xt_fit(
+    batch: ActionBatch,
+    mesh: Mesh,
+    *,
+    l: int = 16,
+    w: int = 12,
+    eps: float = 1e-5,
+    max_iter: int = 1000,
+) -> Tuple[jax.Array, XTProbabilities, jax.Array]:
+    """Fit xT on a game-sharded batch: psum'd counts, replicated solve.
+
+    Returns ``(grid, probabilities, n_iterations)`` — identical values to
+    the single-device :func:`~socceraction_tpu.ops.xt.xt_counts` path
+    (count sums are order-insensitive in fp32 up to reassociation).
+    """
+    counts = sharded_xt_counts(batch, mesh, l=l, w=w)
+    probs = xt_probabilities(counts, l=l, w=w)
+    grid, it = solve_xt(probs, eps=eps, max_iter=max_iter)
+    rep = NamedSharding(mesh, P())
+    grid = jax.device_put(grid, rep)
+    return grid, probs, it

@@ -26,6 +26,7 @@ import pandas as pd
 from sklearn.metrics import brier_score_loss, roc_auc_score
 
 from .. import spadl as _spadl_pkg
+from ..config import DEFAULT_BACKEND, NB_PREV_ACTIONS
 from ..core.batch import ActionBatch, pack_actions, unpack_values
 from ..ml.learners import LEARNERS
 from ..ml.mlp import MLPClassifier
@@ -95,8 +96,8 @@ class VAEP:
     def __init__(
         self,
         xfns: Optional[List[fs.FeatureTransfomer]] = None,
-        nb_prev_actions: int = 3,
-        backend: str = 'jax',
+        nb_prev_actions: int = NB_PREV_ACTIONS,
+        backend: str = DEFAULT_BACKEND,
     ) -> None:
         if backend not in ('jax', 'pandas'):
             raise ValueError(f'unknown backend {backend!r}')
@@ -105,6 +106,7 @@ class VAEP:
         self.yfns = [self._lab.scores, self._lab.concedes]
         self.nb_prev_actions = nb_prev_actions
         self.backend = backend
+        self._feature_names_cache: Dict[Tuple[Any, ...], List[str]] = {}
 
     def _default_xfns(self) -> List[fs.FeatureTransfomer]:
         return list(xfns_default)
@@ -113,8 +115,17 @@ class VAEP:
 
     @property
     def feature_names(self) -> List[str]:
-        """Exact output column names (derived like the reference)."""
-        return self._fs.feature_column_names(self.xfns, self.nb_prev_actions)
+        """Exact output column names (derived like the reference).
+
+        Cached per ``(xfns, nb_prev_actions)``: deriving names executes all
+        transformers on a dummy frame, far too slow for every rate() call.
+        """
+        key = (tuple(self.xfns), self.nb_prev_actions)
+        names = self._feature_names_cache.get(key)
+        if names is None:
+            names = self._fs.feature_column_names(self.xfns, self.nb_prev_actions)
+            self._feature_names_cache[key] = names
+        return names
 
     def _kernel_names(self) -> Tuple[str, ...]:
         names = []

@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
+from ..config import LABEL_LOOKAHEAD
 from ..spadl import config as spadlconfig
 
 
@@ -36,7 +37,7 @@ def _lookahead(
     return res
 
 
-def scores(actions: pd.DataFrame, nr_actions: int = 10) -> pd.DataFrame:
+def scores(actions: pd.DataFrame, nr_actions: int = LABEL_LOOKAHEAD) -> pd.DataFrame:
     """True when the acting team scores within the next ``nr_actions``."""
     goal, owngoal = _goal_masks(actions)
     team = actions['team_id'].to_numpy()
@@ -44,7 +45,7 @@ def scores(actions: pd.DataFrame, nr_actions: int = 10) -> pd.DataFrame:
     return pd.DataFrame({'scores': res}, index=actions.index)
 
 
-def concedes(actions: pd.DataFrame, nr_actions: int = 10) -> pd.DataFrame:
+def concedes(actions: pd.DataFrame, nr_actions: int = LABEL_LOOKAHEAD) -> pd.DataFrame:
     """True when the acting team concedes within the next ``nr_actions``."""
     goal, owngoal = _goal_masks(actions)
     team = actions['team_id'].to_numpy()

@@ -1,0 +1,136 @@
+"""Multi-device tests on the virtual 8-device CPU mesh.
+
+Stands in for multi-chip TPU (SURVEY §4): the same pjit/shard_map code
+paths run over ``--xla_force_host_platform_device_count=8``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from socceraction_tpu.core.batch import pack_actions, unpack_values
+from socceraction_tpu.ops.xt import solve_xt, xt_counts, xt_probabilities
+from socceraction_tpu.parallel import (
+    make_mesh,
+    make_train_step,
+    pad_games,
+    shard_batch,
+    sharded_rate,
+    sharded_xt_counts,
+    sharded_xt_fit,
+    train_distributed,
+)
+from socceraction_tpu.vaep.base import VAEP
+
+
+@pytest.fixture(scope='module')
+def batch(spadl_actions, home_team_id):
+    b, _ = pack_actions(spadl_actions, home_team_id=home_team_id)
+    return b
+
+
+def _multi_game(batch, n):
+    """Tile one game into an n-game batch (distinct but equal games)."""
+    return jax.tree.map(
+        lambda x: jnp.concatenate([x] * n, axis=0), batch
+    )
+
+
+def test_mesh_shapes():
+    assert len(jax.devices()) == 8, 'tests expect the 8-device CPU mesh'
+    mesh = make_mesh()
+    assert mesh.shape == {'games': 8, 'model': 1}
+    mesh2 = make_mesh(model_parallel=2)
+    assert mesh2.shape == {'games': 4, 'model': 2}
+
+
+def test_pad_games_is_inert(batch):
+    padded = pad_games(batch, 8)
+    assert padded.n_games == 8
+    assert not bool(padded.mask[1:].any())
+    assert padded.total_actions == batch.total_actions
+
+
+def test_sharded_xt_counts_match_single_device(batch):
+    mesh = make_mesh()
+    many = _multi_game(batch, 8)
+    sharded = shard_batch(many, mesh)
+    counts = sharded_xt_counts(sharded, mesh, l=16, w=12)
+
+    local = xt_counts(
+        batch.type_id, batch.result_id,
+        batch.start_x, batch.start_y, batch.end_x, batch.end_y,
+        batch.mask, l=16, w=12,
+    )
+    np.testing.assert_allclose(np.asarray(counts.shots), 8 * np.asarray(local.shots))
+    np.testing.assert_allclose(np.asarray(counts.trans), 8 * np.asarray(local.trans))
+
+
+def test_sharded_xt_fit_matches_replicated_probabilities(batch):
+    mesh = make_mesh()
+    many = _multi_game(batch, 8)
+    sharded = shard_batch(many, mesh)
+    grid, probs, it = sharded_xt_fit(sharded, mesh, l=16, w=12)
+
+    # counts scaled by 8 -> identical probabilities -> identical grid
+    local = xt_counts(
+        batch.type_id, batch.result_id,
+        batch.start_x, batch.start_y, batch.end_x, batch.end_y,
+        batch.mask, l=16, w=12,
+    )
+    probs1 = xt_probabilities(local, l=16, w=12)
+    grid1, _ = solve_xt(probs1)
+    np.testing.assert_allclose(np.asarray(grid), np.asarray(grid1), atol=1e-6)
+    assert int(it) > 0
+
+
+@pytest.mark.parametrize('model_parallel', [1, 2])
+def test_distributed_train_step_runs(batch, model_parallel):
+    mesh = make_mesh(model_parallel=model_parallel)
+    many = shard_batch(_multi_game(batch, mesh.shape['games']), mesh)
+    names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'team')
+    init_fn, step_fn, place = make_train_step(mesh, names, k=3, hidden=(32, 32))
+    from socceraction_tpu.ops.features import compute_features
+
+    n_features = int(compute_features.eval_shape(many, names=names, k=3).shape[-1])
+    params, opt_state = init_fn(jax.random.PRNGKey(0), n_features)
+    p1, o1, loss1 = step_fn(params, opt_state, many)
+    _, _, loss2 = step_fn(p1, o1, many)
+    assert float(loss2) < float(loss1)
+
+
+def test_train_distributed_and_sharded_rate(batch, spadl_actions, home_team_id):
+    mesh = make_mesh()
+    import pandas as pd
+
+    frames = []
+    for g in range(8):
+        f = spadl_actions.copy()
+        f['game_id'] = 1000 + g
+        frames.append(f)
+    many_df = pd.concat(frames, ignore_index=True)
+    many, _ = pack_actions(many_df, home_team_id=home_team_id)
+    names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'team')
+    models = train_distributed(many, mesh, names, k=3, hidden=(16,), epochs=3)
+
+    model = VAEP(backend='jax', nb_prev_actions=3)
+    model.xfns = [
+        getattr(__import__('socceraction_tpu.vaep.features', fromlist=[n]), n)
+        for n in names
+    ]
+    model._models = models
+    values, sharded = sharded_rate(model, many, mesh)
+    assert values.shape == (8, batch.max_actions, 3)
+
+    flat = unpack_values(values, sharded)
+    assert flat.shape[0] == 8 * len(spadl_actions)
+    assert np.isfinite(flat).all()
+
+    # vs. unsharded rate of one game
+    single = model.rate_batch(batch)
+    np.testing.assert_allclose(
+        flat[: len(spadl_actions)],
+        unpack_values(single, batch),
+        rtol=1e-4, atol=1e-5,
+    )
