@@ -1,0 +1,1 @@
+"""Atomic-SPADL representation and the Atomic-VAEP valuation framework."""

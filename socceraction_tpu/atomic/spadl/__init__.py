@@ -1,0 +1,31 @@
+"""Atomic-SPADL: the atomic action representation.
+
+Public API parity with reference ``socceraction/atomic/spadl/__init__.py``.
+"""
+
+from . import config
+from .base import convert_to_atomic
+from .config import (
+    actiontypes,
+    actiontypes_df,
+    bodyparts,
+    bodyparts_df,
+    field_length,
+    field_width,
+)
+from .schema import AtomicSPADLSchema
+from .utils import add_names, play_left_to_right
+
+__all__ = [
+    'config',
+    'convert_to_atomic',
+    'actiontypes',
+    'actiontypes_df',
+    'bodyparts',
+    'bodyparts_df',
+    'field_length',
+    'field_width',
+    'AtomicSPADLSchema',
+    'add_names',
+    'play_left_to_right',
+]

@@ -1,0 +1,61 @@
+"""Vocabulary of the Atomic-SPADL action language.
+
+Atomic-SPADL splits composite SPADL actions into atomic events: a pass
+becomes pass + receival (or interception/out/offside), a scoring shot
+becomes shot + goal, a carded foul becomes foul + card. Rows carry a
+location and a displacement ``(x, y, dx, dy)`` instead of start/end pairs,
+and no result (outcomes are themselves actions).
+
+Parity: reference ``socceraction/atomic/spadl/config.py:25-36`` — the
+vocabulary is the 23 SPADL types plus 10 atomic extras. Note the reference
+quirk kept here: ``'interception'`` occurs twice (SPADL id 10 and atomic
+id 24); inserted interception events resolve the *first* occurrence, so
+atomic id 24 is never produced by the converter.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import pandas as pd
+
+from ...spadl import config as _spadl
+
+field_length: float = _spadl.field_length
+field_width: float = _spadl.field_width
+
+bodyparts: List[str] = _spadl.bodyparts
+bodyparts_df = _spadl.bodyparts_df
+
+actiontypes: List[str] = _spadl.actiontypes + [
+    'receival',
+    'interception',
+    'out',
+    'offside',
+    'goal',
+    'owngoal',
+    'yellow_card',
+    'red_card',
+    'corner',
+    'freekick',
+]
+
+# id constants; .index() picks the FIRST occurrence like the reference
+RECEIVAL = actiontypes.index('receival')  # 23
+INTERCEPTION = actiontypes.index('interception')  # 10 (the SPADL id)
+OUT = actiontypes.index('out')  # 25
+OFFSIDE = actiontypes.index('offside')  # 26
+GOAL = actiontypes.index('goal')  # 27
+OWNGOAL = actiontypes.index('owngoal')  # 28
+YELLOW_CARD = actiontypes.index('yellow_card')  # 29
+RED_CARD = actiontypes.index('red_card')  # 30
+CORNER = actiontypes.index('corner')  # 31
+FREEKICK = actiontypes.index('freekick')  # 32
+
+
+def actiontypes_df() -> pd.DataFrame:
+    """Return the 'type_id' and 'type_name' of each Atomic-SPADL type."""
+    return pd.DataFrame(
+        {'type_id': np.arange(len(actiontypes)), 'type_name': actiontypes}
+    )

@@ -1,0 +1,5 @@
+"""Atomic-VAEP: the VAEP framework over atomic actions."""
+
+from .base import AtomicVAEP
+
+__all__ = ['AtomicVAEP']

@@ -1,0 +1,71 @@
+"""The Atomic-VAEP model class.
+
+Parity: reference ``socceraction/atomic/vaep/base.py:34-79`` — a subclass
+of :class:`~socceraction_tpu.vaep.base.VAEP` that swaps the class-level
+module handles (the "shared transform core + per-language specialization"
+coupling noted in SURVEY §2) plus, in this build, the packed-tensor kernel
+handles and the atomic batch packer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...config import DEFAULT_BACKEND, NB_PREV_ACTIONS
+from ...core.batch import AtomicActionBatch, pack_atomic_actions
+from ...ops import atomic as _atomicops
+from ...vaep.base import VAEP
+from .. import spadl as spadlcfg
+from . import features as fs
+from . import formula as vaepformula
+from . import labels as lab
+
+__all__ = ['AtomicVAEP', 'xfns_default']
+
+xfns_default: List[fs.FeatureTransfomer] = [
+    fs.actiontype,
+    fs.actiontype_onehot,
+    fs.bodypart,
+    fs.bodypart_onehot,
+    fs.time,
+    fs.team,
+    fs.time_delta,
+    fs.location,
+    fs.polar,
+    fs.movement_polar,
+    fs.direction,
+    fs.goalscore,
+]
+
+
+class AtomicVAEP(VAEP):
+    """VAEP over atomic actions.
+
+    Distinguishes the contribution of the player who initiates an action
+    (e.g. gives the pass) from the player who completes it (e.g. receives
+    the pass). Same API and backends as :class:`VAEP`.
+    """
+
+    _spadlcfg = spadlcfg
+    _fs = fs
+    _lab = lab
+    _vaep = vaepformula
+    _kernels = _atomicops.ATOMIC_KERNELS
+    _compute_features_kernel = staticmethod(_atomicops.compute_features)
+    _labels_kernel = staticmethod(_atomicops.scores_concedes)
+    _formula_kernel = staticmethod(_atomicops.vaep_values)
+
+    def __init__(
+        self,
+        xfns: Optional[List[fs.FeatureTransfomer]] = None,
+        nb_prev_actions: int = NB_PREV_ACTIONS,
+        backend: str = DEFAULT_BACKEND,
+    ) -> None:
+        super().__init__(xfns, nb_prev_actions, backend)
+
+    def _default_xfns(self) -> List[fs.FeatureTransfomer]:
+        return list(xfns_default)
+
+    def _pack(self, game_actions, home_team_id) -> AtomicActionBatch:
+        batch, _ = pack_atomic_actions(game_actions, home_team_id=home_team_id)
+        return batch

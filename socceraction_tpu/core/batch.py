@@ -32,7 +32,14 @@ import numpy as np
 import pandas as pd
 from flax import struct
 
-__all__ = ['ActionBatch', 'pack_actions', 'unpack_values', 'pad_length']
+__all__ = [
+    'ActionBatch',
+    'AtomicActionBatch',
+    'pack_actions',
+    'pack_atomic_actions',
+    'unpack_values',
+    'pad_length',
+]
 
 from ..config import ACTION_AXIS_ALIGNMENT
 
@@ -96,8 +103,116 @@ class ActionBatch:
         )
 
 
+@struct.dataclass
+class AtomicActionBatch:
+    """A padded ``(G, A)`` struct-of-arrays bundle of Atomic-SPADL actions.
+
+    Atomic rows carry a location and displacement ``(x, y, dx, dy)`` and no
+    result (outcomes are themselves action types).
+    """
+
+    type_id: jax.Array  # int32
+    bodypart_id: jax.Array  # int32
+    period_id: jax.Array  # int32
+    is_home: jax.Array  # bool
+    time_seconds: jax.Array  # float
+    x: jax.Array  # float
+    y: jax.Array  # float
+    dx: jax.Array  # float
+    dy: jax.Array  # float
+    mask: jax.Array  # bool (G, A)
+    n_actions: jax.Array  # int32 (G,)
+    game_id: jax.Array  # (G,) int32 index
+    row_index: jax.Array  # (G, A) int32 (-1 pad)
+
+    n_games = ActionBatch.n_games
+    max_actions = ActionBatch.max_actions
+    total_actions = ActionBatch.total_actions
+
+
 _FLOAT_COLS = ('time_seconds', 'start_x', 'start_y', 'end_x', 'end_y')
 _INT_COLS = ('type_id', 'result_id', 'bodypart_id', 'period_id')
+_ATOMIC_FLOAT_COLS = ('time_seconds', 'x', 'y', 'dx', 'dy')
+_ATOMIC_INT_COLS = ('type_id', 'bodypart_id', 'period_id')
+
+
+def _pack_frame(
+    actions,
+    home_team_ids,
+    home_team_id,
+    max_actions,
+    float_dtype,
+    device,
+    float_cols,
+    int_cols,
+    make_batch,
+):
+    """Shared packing core: group by game, left-align, pad, build the batch.
+
+    ``make_batch(cols, is_home, mask, n_actions, n_games, row_index)`` builds
+    the concrete batch dataclass from the filled numpy arrays.
+    """
+    if 'game_id' not in actions.columns:
+        raise ValueError('actions frame must contain a game_id column')
+
+    # Stable game order: order of first appearance.
+    game_ids = list(dict.fromkeys(actions['game_id'].tolist()))
+    n_games = len(game_ids)
+    if n_games == 0:
+        raise ValueError('cannot pack an empty actions frame')
+
+    if home_team_ids is None:
+        if home_team_id is not None:
+            home_team_ids = {g: home_team_id for g in game_ids}
+        elif 'home_team_id' in actions.columns:
+            home_team_ids = (
+                actions.groupby('game_id', sort=False)['home_team_id'].first().to_dict()
+            )
+        else:
+            raise ValueError('home_team_ids (or home_team_id) is required')
+
+    counts = actions.groupby('game_id', sort=False).size().reindex(game_ids)
+    longest = int(counts.max())
+    A = max_actions if max_actions is not None else pad_length(longest)
+    if longest > A:
+        raise ValueError(f'game of length {longest} exceeds max_actions={A}')
+
+    def alloc(dtype, fill=0):
+        return np.full((n_games, A), fill, dtype=dtype)
+
+    cols = {c: alloc(float_dtype) for c in float_cols}
+    cols.update({c: alloc(np.int32) for c in int_cols})
+    is_home = alloc(bool, False)
+    mask = alloc(bool, False)
+    row_index = alloc(np.int32, -1)
+    n_actions = np.zeros(n_games, dtype=np.int32)
+
+    positions = pd.RangeIndex(len(actions))
+    grouped = dict(tuple(actions.set_index(positions).groupby('game_id', sort=False)))
+    for gi, gid in enumerate(game_ids):
+        g = grouped[gid]
+        n = len(g)
+        n_actions[gi] = n
+        for c in float_cols:
+            cols[c][gi, :n] = g[c].to_numpy(dtype=float_dtype)
+        for c in int_cols:
+            cols[c][gi, :n] = g[c].to_numpy(dtype=np.int64).astype(np.int32)
+        is_home[gi, :n] = (g['team_id'] == home_team_ids[gid]).to_numpy()
+        mask[gi, :n] = True
+        row_index[gi, :n] = g.index.to_numpy(dtype=np.int64).astype(np.int32)
+
+    jcols = {c: jnp.asarray(v) for c, v in cols.items()}
+    batch = make_batch(
+        **jcols,
+        is_home=jnp.asarray(is_home),
+        mask=jnp.asarray(mask),
+        n_actions=jnp.asarray(n_actions),
+        game_id=jnp.arange(n_games, dtype=jnp.int32),
+        row_index=jnp.asarray(row_index),
+    )
+    if device is not None:
+        batch = jax.device_put(batch, device)
+    return batch, game_ids
 
 
 def pack_actions(
@@ -135,75 +250,30 @@ def pack_actions(
     (ActionBatch, list)
         The packed batch and the list of game_ids in game-axis order.
     """
-    if 'game_id' not in actions.columns:
-        raise ValueError('actions frame must contain a game_id column')
-
-    # Stable game order: order of first appearance.
-    game_ids = list(dict.fromkeys(actions['game_id'].tolist()))
-    n_games = len(game_ids)
-    if n_games == 0:
-        raise ValueError('cannot pack an empty actions frame')
-
-    if home_team_ids is None:
-        if home_team_id is not None:
-            home_team_ids = {g: home_team_id for g in game_ids}
-        elif 'home_team_id' in actions.columns:
-            home_team_ids = (
-                actions.groupby('game_id', sort=False)['home_team_id'].first().to_dict()
-            )
-        else:
-            raise ValueError('home_team_ids (or home_team_id) is required')
-
-    counts = actions.groupby('game_id', sort=False).size()
-    counts = counts.reindex(game_ids)
-    longest = int(counts.max())
-    A = max_actions if max_actions is not None else pad_length(longest)
-    if longest > A:
-        raise ValueError(f'game of length {longest} exceeds max_actions={A}')
-
-    def alloc(dtype, fill=0):
-        return np.full((n_games, A), fill, dtype=dtype)
-
-    cols = {c: alloc(float_dtype) for c in _FLOAT_COLS}
-    cols.update({c: alloc(np.int32) for c in _INT_COLS})
-    is_home = alloc(bool, False)
-    mask = alloc(bool, False)
-    row_index = alloc(np.int32, -1)
-    n_actions = np.zeros(n_games, dtype=np.int32)
-
-    positions = pd.RangeIndex(len(actions))
-    grouped = dict(tuple(actions.set_index(positions).groupby('game_id', sort=False)))
-    for gi, gid in enumerate(game_ids):
-        g = grouped[gid]
-        n = len(g)
-        n_actions[gi] = n
-        for c in _FLOAT_COLS:
-            cols[c][gi, :n] = g[c].to_numpy(dtype=float_dtype)
-        for c in _INT_COLS:
-            cols[c][gi, :n] = g[c].to_numpy(dtype=np.int64).astype(np.int32)
-        is_home[gi, :n] = (g['team_id'] == home_team_ids[gid]).to_numpy()
-        mask[gi, :n] = True
-        row_index[gi, :n] = g.index.to_numpy(dtype=np.int64).astype(np.int32)
-
-    batch = ActionBatch(
-        type_id=jnp.asarray(cols['type_id']),
-        result_id=jnp.asarray(cols['result_id']),
-        bodypart_id=jnp.asarray(cols['bodypart_id']),
-        period_id=jnp.asarray(cols['period_id']),
-        is_home=jnp.asarray(is_home),
-        time_seconds=jnp.asarray(cols['time_seconds']),
-        start_x=jnp.asarray(cols['start_x']),
-        start_y=jnp.asarray(cols['start_y']),
-        end_x=jnp.asarray(cols['end_x']),
-        end_y=jnp.asarray(cols['end_y']),
-        mask=jnp.asarray(mask),
-        n_actions=jnp.asarray(n_actions),
-        game_id=jnp.arange(n_games, dtype=jnp.int32),
-        row_index=jnp.asarray(row_index),
+    return _pack_frame(
+        actions, home_team_ids, home_team_id, max_actions, float_dtype, device,
+        _FLOAT_COLS, _INT_COLS, ActionBatch,
     )
-    if device is not None:
-        batch = jax.device_put(batch, device)
-    return batch, game_ids
+
+
+def pack_atomic_actions(
+    actions: pd.DataFrame,
+    home_team_ids: Optional[Dict[Any, Any]] = None,
+    *,
+    home_team_id: Optional[Any] = None,
+    max_actions: Optional[int] = None,
+    float_dtype: Any = np.float32,
+    device: Optional[Any] = None,
+) -> Tuple[AtomicActionBatch, List[Any]]:
+    """Pack an Atomic-SPADL DataFrame into an :class:`AtomicActionBatch`.
+
+    Same contract as :func:`pack_actions` but for atomic frames
+    (``x, y, dx, dy``; no result column).
+    """
+    return _pack_frame(
+        actions, home_team_ids, home_team_id, max_actions, float_dtype, device,
+        _ATOMIC_FLOAT_COLS, _ATOMIC_INT_COLS, AtomicActionBatch,
+    )
 
 
 def unpack_values(values: Any, batch: ActionBatch) -> np.ndarray:

@@ -1,0 +1,246 @@
+"""Fused JAX kernels for Atomic-VAEP features, labels and formula.
+
+Mirrors :mod:`socceraction_tpu.ops.features` / ``.labels`` / ``.formula``
+for the atomic representation: one fused XLA computation per entry point
+over a packed ``(G, A)`` :class:`~socceraction_tpu.core.batch.AtomicActionBatch`.
+
+Vocabulary quirk (see :mod:`socceraction_tpu.atomic.spadl.config`): the
+name ``'interception'`` owns two ids, so its one-hot column is the OR of
+both and the one-hot width is 32, matching the pandas oracle's column set.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..atomic.spadl import config as atomicconfig
+from ..config import LABEL_LOOKAHEAD
+from ..core.batch import AtomicActionBatch
+from .features import _shift_gather, _stack
+
+__all__ = ['ATOMIC_KERNELS', 'compute_features', 'scores_concedes', 'vaep_values']
+
+_N_BODYPARTS = len(atomicconfig.bodyparts)
+_GOAL_X = atomicconfig.field_length
+_GOAL_Y = atomicconfig.field_width / 2
+
+# unique (name, ids) groups in first-occurrence order -> 32 one-hot columns
+_ONEHOT_GROUPS: Tuple[Tuple[str, Tuple[int, ...]], ...] = tuple(
+    (
+        name,
+        tuple(i for i, t in enumerate(atomicconfig.actiontypes) if t == name),
+    )
+    for name in dict.fromkeys(atomicconfig.actiontypes)
+)
+
+
+class _AtomicStates:
+    """Per-state views of an atomic batch, left-to-right mirror applied."""
+
+    def __init__(self, batch: AtomicActionBatch, k: int):
+        self.k = k
+        f = jnp.float32
+        a0_home = batch.is_home
+        self.a0_home = a0_home
+
+        self.type_id = [_shift_gather(batch.type_id, i) for i in range(k)]
+        self.bodypart_id = [_shift_gather(batch.bodypart_id, i) for i in range(k)]
+        self.period_id = [_shift_gather(batch.period_id, i).astype(f) for i in range(k)]
+        self.time_seconds = [
+            _shift_gather(batch.time_seconds, i).astype(f) for i in range(k)
+        ]
+        self.is_home = [_shift_gather(batch.is_home, i) for i in range(k)]
+        L, W = atomicconfig.field_length, atomicconfig.field_width
+        self.x = [
+            jnp.where(a0_home, v, L - v)
+            for v in (_shift_gather(batch.x, i).astype(f) for i in range(k))
+        ]
+        self.y = [
+            jnp.where(a0_home, v, W - v)
+            for v in (_shift_gather(batch.y, i).astype(f) for i in range(k))
+        ]
+        self.dx = [
+            jnp.where(a0_home, v, -v)
+            for v in (_shift_gather(batch.dx, i).astype(f) for i in range(k))
+        ]
+        self.dy = [
+            jnp.where(a0_home, v, -v)
+            for v in (_shift_gather(batch.dy, i).astype(f) for i in range(k))
+        ]
+
+
+def _actiontype(s: _AtomicStates) -> jax.Array:
+    return _stack([s.type_id[i].astype(jnp.float32) for i in range(s.k)])
+
+
+def _actiontype_onehot(s: _AtomicStates) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        for _, ids in _ONEHOT_GROUPS:
+            col = s.type_id[i] == ids[0]
+            for t in ids[1:]:
+                col = col | (s.type_id[i] == t)
+            cols.append(col.astype(jnp.float32))
+    return _stack(cols)
+
+
+def _bodypart(s: _AtomicStates) -> jax.Array:
+    return _stack([s.bodypart_id[i].astype(jnp.float32) for i in range(s.k)])
+
+
+def _bodypart_onehot(s: _AtomicStates) -> jax.Array:
+    return jnp.concatenate(
+        [
+            jax.nn.one_hot(s.bodypart_id[i], _N_BODYPARTS, dtype=jnp.float32)
+            for i in range(s.k)
+        ],
+        axis=-1,
+    )
+
+
+def _time(s: _AtomicStates) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        overall = (s.period_id[i] - 1) * 45 * 60 + s.time_seconds[i]
+        cols += [s.period_id[i], s.time_seconds[i], overall]
+    return _stack(cols)
+
+
+def _team(s: _AtomicStates) -> jax.Array:
+    return _stack([(s.is_home[i] == s.is_home[0]) for i in range(1, s.k)], s.is_home[0])
+
+
+def _time_delta(s: _AtomicStates) -> jax.Array:
+    return _stack(
+        [s.time_seconds[0] - s.time_seconds[i] for i in range(1, s.k)], s.is_home[0]
+    )
+
+
+def _location(s: _AtomicStates) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        cols += [s.x[i], s.y[i]]
+    return _stack(cols)
+
+
+def _polar(s: _AtomicStates) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        dx = jnp.abs(_GOAL_X - s.x[i])
+        dy = jnp.abs(_GOAL_Y - s.y[i])
+        cols.append(jnp.sqrt(dx**2 + dy**2))
+        cols.append(jnp.nan_to_num(jnp.arctan(dy / dx)))
+    return _stack(cols)
+
+
+def _movement_polar(s: _AtomicStates) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        d = jnp.sqrt(s.dx[i] ** 2 + s.dy[i] ** 2)
+        angle = jnp.where(s.dy[i] == 0, 0.0, jnp.arctan2(s.dy[i], s.dx[i]))
+        cols += [d, angle]
+    return _stack(cols)
+
+
+def _direction(s: _AtomicStates) -> jax.Array:
+    cols = []
+    for i in range(s.k):
+        total = jnp.sqrt(s.dx[i] ** 2 + s.dy[i] ** 2)
+        safe = jnp.where(total > 0, total, 1.0)
+        cols.append(jnp.where(total > 0, s.dx[i] / safe, s.dx[i]))
+        cols.append(jnp.where(total > 0, s.dy[i] / safe, s.dy[i]))
+    return _stack(cols)
+
+
+def _goalscore(s: _AtomicStates) -> jax.Array:
+    type_id = s.type_id[0]
+    goals = type_id == atomicconfig.GOAL
+    owngoals = type_id == atomicconfig.OWNGOAL
+    teamisA = s.is_home[0] == s.is_home[0][:, :1]
+    goalsA = (goals & teamisA) | (owngoals & ~teamisA)
+    goalsB = (goals & ~teamisA) | (owngoals & teamisA)
+    f = jnp.float32
+    scoreA = jnp.cumsum(goalsA.astype(f), axis=1) - goalsA.astype(f)
+    scoreB = jnp.cumsum(goalsB.astype(f), axis=1) - goalsB.astype(f)
+    team_score = jnp.where(teamisA, scoreA, scoreB)
+    opp_score = jnp.where(teamisA, scoreB, scoreA)
+    return _stack([team_score, opp_score, team_score - opp_score])
+
+
+ATOMIC_KERNELS: Dict[str, object] = {
+    'actiontype': _actiontype,
+    'actiontype_onehot': _actiontype_onehot,
+    'bodypart': _bodypart,
+    'bodypart_onehot': _bodypart_onehot,
+    'time': _time,
+    'team': _team,
+    'time_delta': _time_delta,
+    'location': _location,
+    'polar': _polar,
+    'movement_polar': _movement_polar,
+    'direction': _direction,
+    'goalscore': _goalscore,
+}
+
+
+@functools.partial(jax.jit, static_argnames=('names', 'k'))
+def compute_features(
+    batch: AtomicActionBatch, *, names: Tuple[str, ...], k: int
+) -> jax.Array:
+    """Concatenated ``(G, A, F)`` atomic feature tensor."""
+    s = _AtomicStates(batch, k)
+    blocks = [ATOMIC_KERNELS[n](s) for n in names]
+    return jnp.concatenate(blocks, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=('nr_actions',))
+def scores_concedes(
+    batch: AtomicActionBatch, *, nr_actions: int = LABEL_LOOKAHEAD
+) -> Tuple[jax.Array, jax.Array]:
+    """Atomic scores/concedes labels, shape ``(G, A)`` bool."""
+    goal = batch.type_id == atomicconfig.GOAL
+    owngoal = batch.type_id == atomicconfig.OWNGOAL
+    team = batch.is_home
+    A = goal.shape[1]
+    last = (batch.n_actions - 1)[:, None]
+
+    scores = goal
+    concedes = owngoal
+    for i in range(1, nr_actions):
+        idx = jnp.minimum(jnp.arange(A) + i, last)
+        goal_i = jnp.take_along_axis(goal, idx, axis=1)
+        owngoal_i = jnp.take_along_axis(owngoal, idx, axis=1)
+        team_i = jnp.take_along_axis(team, idx, axis=1)
+        same = team_i == team
+        scores = scores | (goal_i & same) | (owngoal_i & ~same)
+        concedes = concedes | (goal_i & ~same) | (owngoal_i & same)
+    return scores, concedes
+
+
+@jax.jit
+def vaep_values(
+    batch: AtomicActionBatch, p_scores: jax.Array, p_concedes: jax.Array
+) -> jax.Array:
+    """Atomic VAEP values ``(G, A, 3)``: no phase cutoff, no priors."""
+    A = batch.type_id.shape[1]
+    prev = jnp.maximum(jnp.arange(A) - 1, 0)
+
+    type_prev = batch.type_id[:, prev]
+    sameteam = batch.is_home[:, prev] == batch.is_home
+    p_scores_prev = p_scores[:, prev]
+    p_concedes_prev = p_concedes[:, prev]
+
+    prevgoal = (type_prev == atomicconfig.GOAL) | (type_prev == atomicconfig.OWNGOAL)
+
+    prev_scores = jnp.where(sameteam, p_scores_prev, p_concedes_prev)
+    prev_scores = jnp.where(prevgoal, 0.0, prev_scores)
+    prev_concedes = jnp.where(sameteam, p_concedes_prev, p_scores_prev)
+    prev_concedes = jnp.where(prevgoal, 0.0, prev_concedes)
+
+    offensive = p_scores - prev_scores
+    defensive = -(p_concedes - prev_concedes)
+    return jnp.stack([offensive, defensive, offensive + defensive], axis=-1)

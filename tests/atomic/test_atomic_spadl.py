@@ -1,0 +1,67 @@
+"""Atomic-SPADL conversion against the golden snapshot.
+
+The golden ``atomic_spadl.json`` is the reference's
+``convert_to_atomic(actions).head(200)`` of game 8657 (reference
+``tests/datasets/download.py:220-238``); the golden ``spadl.json`` holds
+that game's first 200 SPADL actions, so our conversion must reproduce the
+atomic snapshot row-for-row (modulo the tail rows derived from SPADL
+actions beyond the 200-action cut).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.atomic import spadl as atomicspadl
+
+
+def test_vocabulary():
+    assert len(atomicspadl.actiontypes) == 33
+    assert atomicspadl.config.RECEIVAL == 23
+    # reference quirk: inserted interceptions resolve to the SPADL id
+    assert atomicspadl.config.INTERCEPTION == 10
+    assert atomicspadl.config.FREEKICK == 32
+    df = atomicspadl.actiontypes_df()
+    assert list(df.columns) == ['type_id', 'type_name']
+    assert len(df) == 33
+
+
+def test_convert_matches_golden(spadl_actions, atomic_spadl_actions):
+    atomic = atomicspadl.convert_to_atomic(spadl_actions)
+    assert len(atomic) >= 200
+    got = atomic.head(200).reset_index(drop=True)
+    want = atomic_spadl_actions.reset_index(drop=True)
+
+    assert list(got['type_id']) == list(want['type_id'])
+    assert list(got['bodypart_id']) == list(want['bodypart_id'])
+    assert list(got['team_id']) == list(want['team_id'])
+    assert list(got['player_id']) == list(want['player_id'])
+    assert list(got['period_id']) == list(want['period_id'])
+    for col in ('x', 'y', 'dx', 'dy', 'time_seconds'):
+        np.testing.assert_allclose(
+            got[col].to_numpy(), want[col].to_numpy(), atol=1e-6, err_msg=col
+        )
+
+
+def test_schema_roundtrip(spadl_actions):
+    atomic = atomicspadl.convert_to_atomic(spadl_actions)
+    validated = atomicspadl.AtomicSPADLSchema.validate(atomic)
+    assert len(validated) == len(atomic)
+    named = atomicspadl.add_names(atomic)
+    assert 'type_name' in named.columns
+    assert named['type_name'].notna().all()
+
+
+def test_play_left_to_right(spadl_actions, home_team_id):
+    atomic = atomicspadl.convert_to_atomic(spadl_actions)
+    ltr = atomicspadl.play_left_to_right(atomic, home_team_id)
+    away = atomic['team_id'] != home_team_id
+    np.testing.assert_allclose(
+        ltr.loc[away, 'x'].to_numpy(),
+        atomicspadl.field_length - atomic.loc[away, 'x'].to_numpy(),
+    )
+    np.testing.assert_allclose(
+        ltr.loc[away, 'dy'].to_numpy(), -atomic.loc[away, 'dy'].to_numpy()
+    )
+    home = ~away
+    pd.testing.assert_frame_equal(ltr.loc[home], atomic.loc[home])

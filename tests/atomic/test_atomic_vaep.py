@@ -1,0 +1,90 @@
+"""Atomic-VAEP: pandas-oracle vs fused-kernel parity on the golden game."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.atomic.spadl import add_names
+from socceraction_tpu.atomic.vaep import AtomicVAEP
+from socceraction_tpu.atomic.vaep import features as fs
+from socceraction_tpu.atomic.vaep import formula as vaepformula
+from socceraction_tpu.atomic.vaep import labels as lab
+from socceraction_tpu.atomic.vaep.base import xfns_default
+
+
+@pytest.fixture(scope='module')
+def game(home_team_id):
+    return pd.Series({'home_team_id': home_team_id})
+
+
+def test_feature_column_names_match_transformer_output(atomic_spadl_actions):
+    names = fs.feature_column_names(xfns_default, 3)
+    actions = add_names(atomic_spadl_actions)
+    gs = fs.gamestates(actions, 3)
+    df = pd.concat([fn(gs) for fn in xfns_default], axis=1)
+    assert list(df.columns) == names
+    # 'interception' occurs twice in the vocab but yields ONE column
+    assert names.count('type_interception_a0') == 1
+
+
+def test_labels_on_inline_microframe():
+    # a goal by team 1, then actions by team 2 (reference-style micro test)
+    actions = pd.DataFrame(
+        {
+            'game_id': [1] * 4,
+            'type_id': [0, 27, 0, 0],  # pass, goal, pass, pass
+            'team_id': [1, 1, 2, 2],
+        }
+    )
+    s = lab.scores(actions, nr_actions=2)
+    c = lab.concedes(actions, nr_actions=2)
+    assert s['scores'].tolist() == [True, True, False, False]
+    assert c['concedes'].tolist() == [False, False, False, False]
+
+
+def test_backend_parity_features_labels(game, atomic_spadl_actions):
+    mj = AtomicVAEP(backend='jax')
+    mp = AtomicVAEP(backend='pandas')
+    Xj = mj.compute_features(game, atomic_spadl_actions)
+    Xp = mp.compute_features(game, atomic_spadl_actions)
+    assert list(Xj.columns) == list(Xp.columns)
+    np.testing.assert_allclose(
+        Xj.to_numpy(),
+        Xp.to_numpy().astype(np.float32),
+        atol=1e-5,
+        err_msg='atomic feature parity',
+    )
+    yj = mj.compute_labels(game, atomic_spadl_actions)
+    yp = mp.compute_labels(game, atomic_spadl_actions)
+    assert (yj == yp).all().all()
+
+
+def test_backend_parity_rate(game, atomic_spadl_actions):
+    mp = AtomicVAEP(backend='pandas')
+    X = mp.compute_features(game, atomic_spadl_actions)
+    y = mp.compute_labels(game, atomic_spadl_actions)
+    mp.fit(X, y, learner='mlp', tree_params={'hidden': (16,), 'max_epochs': 3})
+
+    rp = mp.rate(game, atomic_spadl_actions)
+
+    mj = AtomicVAEP(backend='jax')
+    mj._models = mp._models
+    rj = mj.rate(game, atomic_spadl_actions)
+    np.testing.assert_allclose(
+        rj.to_numpy(), rp.to_numpy(), atol=2e-5, err_msg='atomic rate parity'
+    )
+
+
+def test_formula_prevgoal_reset():
+    actions = pd.DataFrame(
+        {
+            'team_id': [1, 1, 2],
+            'type_name': ['shot', 'goal', 'pass'],
+        }
+    )
+    ps = pd.Series([0.5, 0.9, 0.1])
+    pc = pd.Series([0.1, 0.05, 0.2])
+    v = vaepformula.value(actions, ps, pc)
+    # action after a goal: previous probabilities reset to 0
+    assert v['offensive_value'].iloc[2] == pytest.approx(0.1)
+    assert v['defensive_value'].iloc[2] == pytest.approx(-0.2)
