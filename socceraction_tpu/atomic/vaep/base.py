@@ -9,9 +9,8 @@ handles and the atomic batch packer.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
-from ...config import DEFAULT_BACKEND, NB_PREV_ACTIONS
 from ...core.batch import AtomicActionBatch, pack_atomic_actions
 from ...ops import atomic as _atomicops
 from ...vaep.base import VAEP
@@ -54,14 +53,6 @@ class AtomicVAEP(VAEP):
     _compute_features_kernel = staticmethod(_atomicops.compute_features)
     _labels_kernel = staticmethod(_atomicops.scores_concedes)
     _formula_kernel = staticmethod(_atomicops.vaep_values)
-
-    def __init__(
-        self,
-        xfns: Optional[List[fs.FeatureTransfomer]] = None,
-        nb_prev_actions: int = NB_PREV_ACTIONS,
-        backend: str = DEFAULT_BACKEND,
-    ) -> None:
-        super().__init__(xfns, nb_prev_actions, backend)
 
     def _default_xfns(self) -> List[fs.FeatureTransfomer]:
         return list(xfns_default)

@@ -149,8 +149,9 @@ def _pack_frame(
 ):
     """Shared packing core: group by game, left-align, pad, build the batch.
 
-    ``make_batch(cols, is_home, mask, n_actions, n_games, row_index)`` builds
-    the concrete batch dataclass from the filled numpy arrays.
+    ``make_batch`` is the batch dataclass constructor, called with one
+    keyword per packed column (``float_cols`` + ``int_cols``) plus
+    ``is_home``, ``mask``, ``n_actions``, ``game_id`` and ``row_index``.
     """
     if 'game_id' not in actions.columns:
         raise ValueError('actions frame must contain a game_id column')

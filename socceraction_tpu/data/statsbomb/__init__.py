@@ -1,0 +1,20 @@
+"""StatsBomb data loader."""
+
+from .loader import StatsBombLoader, extract_player_games
+from .schema import (
+    StatsBombCompetitionSchema,
+    StatsBombEventSchema,
+    StatsBombGameSchema,
+    StatsBombPlayerSchema,
+    StatsBombTeamSchema,
+)
+
+__all__ = [
+    'StatsBombLoader',
+    'extract_player_games',
+    'StatsBombCompetitionSchema',
+    'StatsBombGameSchema',
+    'StatsBombTeamSchema',
+    'StatsBombPlayerSchema',
+    'StatsBombEventSchema',
+]

@@ -16,8 +16,10 @@ from .config import (
 )
 from .schema import SPADLSchema
 from .utils import add_names, play_left_to_right
+from . import statsbomb  # noqa: F401  (provider converters)
 
 __all__ = [
+    'statsbomb',
     'actiontypes',
     'actiontypes_df',
     'bodyparts',

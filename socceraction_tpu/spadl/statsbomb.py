@@ -1,0 +1,246 @@
+"""StatsBomb event stream → SPADL converter.
+
+Parity: reference ``socceraction/spadl/statsbomb.py:12-322`` with the
+upstream (``_sa``) post-processing semantics (see :mod:`.base`). The
+vectorizable core — period-relative clock, the 120×80 → 105×68 coordinate
+rescale with y-flip, sorting and the direction/clearance fixes — runs
+columnar; the per-event ``extra``-dict parsing necessarily stays host-side
+(ragged JSON), organized as one parser function per StatsBomb event type.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import pandas as pd
+
+from . import config as spadlconfig
+from .base import _add_dribbles, _fix_clearances, _fix_direction_of_play
+from .schema import SPADLSchema
+
+__all__ = ['convert_to_actions']
+
+Location = Tuple[float, float]
+
+
+def convert_to_actions(events: pd.DataFrame, home_team_id) -> pd.DataFrame:
+    """Convert StatsBomb events of one game to SPADL actions.
+
+    Parameters
+    ----------
+    events : pd.DataFrame
+        StatsBomb events of a single game (see
+        :meth:`~socceraction_tpu.data.statsbomb.StatsBombLoader.events`).
+    home_team_id : int
+        ID of the game's home team.
+
+    Returns
+    -------
+    pd.DataFrame
+        The game's actions in SPADL format.
+    """
+    actions = pd.DataFrame()
+
+    events = events.copy()
+    events['extra'] = events['extra'].apply(lambda d: d if isinstance(d, dict) else {})
+    events = events.fillna(0)
+
+    actions['game_id'] = events['game_id']
+    actions['original_event_id'] = events['event_id']
+    actions['period_id'] = events['period_id']
+
+    # Clock relative to the period start (regular period lengths assumed).
+    actions['time_seconds'] = (
+        60 * events['minute']
+        + events['second']
+        - ((events['period_id'] > 1) * 45 * 60)
+        - ((events['period_id'] > 2) * 45 * 60)
+        - ((events['period_id'] > 3) * 15 * 60)
+        - ((events['period_id'] > 4) * 15 * 60)
+    )
+    actions['team_id'] = events['team_id']
+    actions['player_id'] = events['player_id']
+
+    # StatsBomb's pitch is a 120x80 grid of 1-yard cells indexed from (1, 1);
+    # rescale cell centers onto the 105x68 m pitch and flip the y axis.
+    actions['start_x'] = events['location'].apply(lambda x: x[0] if x else 1).clip(1, 120)
+    actions['start_y'] = events['location'].apply(lambda x: x[1] if x else 1).clip(1, 80)
+    actions['start_x'] = (actions['start_x'] - 1) / 119 * spadlconfig.field_length
+    actions['start_y'] = (
+        spadlconfig.field_width - (actions['start_y'] - 1) / 79 * spadlconfig.field_width
+    )
+
+    end_location = events[['location', 'extra']].apply(_get_end_location, axis=1)
+    actions['end_x'] = end_location.apply(lambda x: x[0] if x else 1).clip(1, 120)
+    actions['end_y'] = end_location.apply(lambda x: x[1] if x else 1).clip(1, 80)
+    actions['end_x'] = (actions['end_x'] - 1) / 119 * spadlconfig.field_length
+    actions['end_y'] = (
+        spadlconfig.field_width - (actions['end_y'] - 1) / 79 * spadlconfig.field_width
+    )
+
+    actions[['type_id', 'result_id', 'bodypart_id']] = events[
+        ['type_name', 'extra']
+    ].apply(_parse_event, axis=1, result_type='expand')
+
+    actions = (
+        actions[actions['type_id'] != spadlconfig.NON_ACTION]
+        .sort_values(['game_id', 'period_id', 'time_seconds'])
+        .reset_index(drop=True)
+    )
+    actions = _fix_direction_of_play(actions, home_team_id)
+    actions = _fix_clearances(actions)
+
+    actions['action_id'] = range(len(actions))
+    actions = _add_dribbles(actions)
+
+    return SPADLSchema.validate(actions)
+
+
+def _get_end_location(q: Tuple[Any, Dict[str, Any]]) -> Any:
+    start_location, extra = q
+    for event in ('pass', 'shot', 'carry'):
+        if event in extra and 'end_location' in extra[event]:
+            return extra[event]['end_location']
+    return start_location
+
+
+def _bodypart_name(bp: Any) -> str:
+    if bp is None:
+        return 'foot'
+    if 'Head' in bp:
+        return 'head'
+    if 'Foot' in bp or bp == 'Drop Kick':
+        return 'foot'
+    return 'other'
+
+
+def _parse_pass(extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    p = extra.get('pass', {})
+    ptype = p.get('type', {}).get('name')
+    height = p.get('height', {}).get('name')
+    cross = p.get('cross')
+    if ptype == 'Free Kick':
+        a = 'freekick_crossed' if (height == 'High Pass' or cross) else 'freekick_short'
+    elif ptype == 'Corner':
+        a = 'corner_crossed' if (height == 'High Pass' or cross) else 'corner_short'
+    elif ptype == 'Goal Kick':
+        a = 'goalkick'
+    elif ptype == 'Throw-in':
+        a = 'throw_in'
+    elif cross:
+        a = 'cross'
+    else:
+        a = 'pass'
+
+    outcome = p.get('outcome', {}).get('name')
+    if outcome in ('Incomplete', 'Out'):
+        r = 'fail'
+    elif outcome == 'Pass Offside':
+        r = 'offside'
+    else:
+        r = 'success'
+    return a, r, _bodypart_name(p.get('body_part', {}).get('name'))
+
+
+def _parse_dribble(extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    outcome = extra.get('dribble', {}).get('outcome', {}).get('name')
+    return 'take_on', 'fail' if outcome == 'Incomplete' else 'success', 'foot'
+
+
+def _parse_carry(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    return 'dribble', 'success', 'foot'
+
+
+def _parse_foul(extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    card = extra.get('foul_committed', {}).get('card', {}).get('name', '')
+    if 'Yellow' in card:
+        r = 'yellow_card'
+    elif 'Red' in card:
+        r = 'red_card'
+    else:
+        r = 'success'
+    return 'foul', r, 'foot'
+
+
+def _parse_duel(extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    if extra.get('duel', {}).get('type', {}).get('name') == 'Tackle':
+        outcome = extra.get('duel', {}).get('outcome', {}).get('name')
+        r = 'fail' if outcome in ('Lost In Play', 'Lost Out') else 'success'
+        return 'tackle', r, 'foot'
+    return _parse_non_action(extra)
+
+
+def _parse_interception(extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    outcome = extra.get('interception', {}).get('outcome', {}).get('name')
+    r = 'fail' if outcome in ('Lost In Play', 'Lost Out') else 'success'
+    return 'interception', r, 'foot'
+
+
+def _parse_shot(extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    s = extra.get('shot', {})
+    stype = s.get('type', {}).get('name')
+    if stype == 'Free Kick':
+        a = 'shot_freekick'
+    elif stype == 'Penalty':
+        a = 'shot_penalty'
+    else:
+        a = 'shot'
+    r = 'success' if s.get('outcome', {}).get('name') == 'Goal' else 'fail'
+    return a, r, _bodypart_name(s.get('body_part', {}).get('name'))
+
+
+def _parse_own_goal(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    return 'bad_touch', 'owngoal', 'foot'
+
+
+def _parse_goalkeeper(extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    g = extra.get('goalkeeper', {})
+    gtype = g.get('type', {}).get('name')
+    if gtype == 'Shot Saved':
+        a = 'keeper_save'
+    elif gtype in ('Collected', 'Keeper Sweeper'):
+        a = 'keeper_claim'
+    elif gtype == 'Punch':
+        a = 'keeper_punch'
+    else:
+        a = 'non_action'
+    outcome = g.get('outcome', {}).get('name', 'x')
+    r = 'fail' if outcome in ('In Play Danger', 'No Touch') else 'success'
+    return a, r, _bodypart_name(g.get('body_part', {}).get('name'))
+
+
+def _parse_clearance(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    return 'clearance', 'success', 'foot'
+
+
+def _parse_miscontrol(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    return 'bad_touch', 'fail', 'foot'
+
+
+def _parse_non_action(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
+    return 'non_action', 'success', 'foot'
+
+
+_EVENT_PARSERS = {
+    'Pass': _parse_pass,
+    'Dribble': _parse_dribble,
+    'Carry': _parse_carry,
+    'Foul Committed': _parse_foul,
+    'Duel': _parse_duel,
+    'Interception': _parse_interception,
+    'Shot': _parse_shot,
+    'Own Goal Against': _parse_own_goal,
+    'Goal Keeper': _parse_goalkeeper,
+    'Clearance': _parse_clearance,
+    'Miscontrol': _parse_miscontrol,
+}
+
+
+def _parse_event(q: Tuple[str, Dict[str, Any]]) -> Tuple[int, int, int]:
+    type_name, extra = q
+    a, r, b = _EVENT_PARSERS.get(type_name, _parse_non_action)(extra)
+    return (
+        spadlconfig.actiontypes.index(a),
+        spadlconfig.results.index(r),
+        spadlconfig.bodyparts.index(b),
+    )

@@ -1,0 +1,92 @@
+"""StatsBomb loader tests against the synthetic open-data fixture."""
+
+import os
+
+import pandas as pd
+import pytest
+
+from socceraction_tpu.data.base import ParseError
+from socceraction_tpu.data.statsbomb import StatsBombLoader
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), os.pardir, 'datasets', 'statsbomb', 'raw')
+GAME_ID = 7584
+
+
+@pytest.fixture(scope='module')
+def SBL() -> StatsBombLoader:
+    return StatsBombLoader(getter='local', root=DATA_DIR)
+
+
+def test_init_invalid_getter():
+    with pytest.raises(ValueError):
+        StatsBombLoader(getter='foo')
+    with pytest.raises(ValueError):
+        StatsBombLoader(getter='local')
+
+
+def test_competitions(SBL):
+    df = SBL.competitions()
+    assert len(df) == 1
+    assert df.iloc[0]['competition_id'] == 43
+    assert df.iloc[0]['season_id'] == 3
+    assert df.iloc[0]['competition_name'] == 'FIFA World Cup'
+
+
+def test_games(SBL):
+    df = SBL.games(43, 3)
+    assert len(df) == 1
+    g = df.iloc[0]
+    assert g['game_id'] == GAME_ID
+    assert g['home_team_id'] == 782
+    assert g['away_team_id'] == 778
+    assert g['home_score'] == 3 and g['away_score'] == 2
+    assert g['venue'] == 'Rostov Arena'
+    assert g['game_date'] == pd.Timestamp('2018-07-02 20:00:00')
+
+
+def test_teams(SBL):
+    df = SBL.teams(GAME_ID)
+    assert set(df['team_id']) == {782, 778}
+    assert set(df['team_name']) == {'Belgium', 'Japan'}
+
+
+def test_players_minutes(SBL):
+    df = SBL.players(GAME_ID)
+    assert len(df) == 7  # 6 starters + 1 substitute
+    players = df.set_index('player_id')
+    # periods: 47' + 48' of injury-included halves = 95 total minutes
+    total = 95
+    # an untouched starter plays the whole game
+    assert players.loc[3955, 'minutes_played'] == total
+    assert bool(players.loc[3955, 'is_starter'])
+    # substituted at 60' -> expanded by the 2 min of first-half injury time
+    assert players.loc[3604, 'minutes_played'] == 62
+    # his replacement plays the rest
+    assert players.loc[3607, 'minutes_played'] == total - 62
+    assert not bool(players.loc[3607, 'is_starter'])
+    # red card at 85' -> expanded to 87'
+    assert players.loc[5630, 'minutes_played'] == 87
+
+
+def test_events(SBL):
+    df = SBL.events(GAME_ID)
+    assert len(df) == 27
+    assert (df['game_id'] == GAME_ID).all()
+    assert df['period_id'].isin([1, 2]).all()
+    assert not df['under_pressure'].any()
+    pass_event = df[df['index'] == 4].iloc[0]
+    assert pass_event['type_name'] == 'Pass'
+    assert pass_event['player_id'] == 3289
+    assert pass_event['extra']['pass']['end_location'] == [49.0, 43.0]
+
+
+def test_events_missing_game(SBL):
+    with pytest.raises(FileNotFoundError):
+        SBL.events(99999)
+
+
+def test_malformed_json_raises(tmp_path):
+    (tmp_path / 'competitions.json').write_text('{"not": "a list"}')
+    loader = StatsBombLoader(getter='local', root=str(tmp_path))
+    with pytest.raises(ParseError):
+        loader.competitions()

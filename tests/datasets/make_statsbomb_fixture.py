@@ -1,0 +1,357 @@
+"""Generate a synthetic StatsBomb open-data fixture for loader tests.
+
+The real test data of the reference is downloaded from the StatsBomb
+open-data repo in CI (reference ``tests/datasets/download.py:39-60``);
+this environment has no egress, so a small hand-built game in the same
+directory layout stands in. Event ids, teams and players are invented;
+the *structure* matches the open-data format.
+
+Run: ``python tests/datasets/make_statsbomb_fixture.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+ROOT = os.path.join(os.path.dirname(__file__), 'statsbomb', 'raw')
+
+GAME_ID = 7584
+HOME, AWAY = 782, 778  # Belgium, Japan (ids as in the open data)
+
+competitions = [
+    {
+        'competition_id': 43,
+        'season_id': 3,
+        'country_name': 'International',
+        'competition_name': 'FIFA World Cup',
+        'competition_gender': 'male',
+        'season_name': '2018',
+        'match_updated': '2021-06-12T16:17:31.694',
+        'match_available': '2021-06-12T16:17:31.694',
+    }
+]
+
+matches = [
+    {
+        'match_id': GAME_ID,
+        'match_date': '2018-07-02',
+        'kick_off': '20:00:00.000',
+        'competition': {
+            'competition_id': 43,
+            'country_name': 'International',
+            'competition_name': 'FIFA World Cup',
+        },
+        'season': {'season_id': 3, 'season_name': '2018'},
+        'home_team': {
+            'home_team_id': HOME,
+            'home_team_name': 'Belgium',
+            'home_team_gender': 'male',
+            'home_team_group': 'Group G',
+            'country': {'id': 22, 'name': 'Belgium'},
+        },
+        'away_team': {
+            'away_team_id': AWAY,
+            'away_team_name': 'Japan',
+            'away_team_gender': 'male',
+            'away_team_group': 'Group H',
+            'country': {'id': 112, 'name': 'Japan'},
+        },
+        'home_score': 3,
+        'away_score': 2,
+        'match_status': 'available',
+        'last_updated': '2021-06-12T16:17:31.694',
+        'metadata': {},
+        'match_week': 4,
+        'competition_stage': {'id': 11, 'name': 'Round of 16'},
+        'stadium': {'id': 4222, 'name': 'Rostov Arena', 'country': {'id': 188, 'name': 'Russia'}},
+        'referee': {'id': 727, 'name': 'M. Mazic', 'country': {'id': 203, 'name': 'Serbia'}},
+    }
+]
+
+_home_players = [
+    (3289, 'Dries Mertens', 14),
+    (3955, 'Thibaut Courtois', 1),
+    (5630, 'Jan Vertonghen', 5),
+]
+_away_players = [
+    (3604, 'Genki Haraguchi', 8),
+    (3605, 'Eiji Kawashima', 1),
+    (3606, 'Maya Yoshida', 22),
+]
+# on the teamsheet but not in the Starting XI (comes on as a substitute)
+_away_bench = [(3607, 'Takashi Inui', 14)]
+
+lineups = [
+    {
+        'team_id': HOME,
+        'team_name': 'Belgium',
+        'lineup': [
+            {
+                'player_id': pid,
+                'player_name': name,
+                'player_nickname': None,
+                'jersey_number': num,
+                'country': {'id': 22, 'name': 'Belgium'},
+            }
+            for pid, name, num in _home_players
+        ],
+    },
+    {
+        'team_id': AWAY,
+        'team_name': 'Japan',
+        'lineup': [
+            {
+                'player_id': pid,
+                'player_name': name,
+                'player_nickname': None,
+                'jersey_number': num,
+                'country': {'id': 112, 'name': 'Japan'},
+            }
+            for pid, name, num in _away_players + _away_bench
+        ],
+    },
+]
+
+
+def _ev(i, type_id, type_name, **kw):
+    base = {
+        'id': f'00000000-0000-0000-0000-{i:012d}',
+        'index': i,
+        'period': kw.pop('period', 1),
+        'timestamp': kw.pop('timestamp', '00:00:00.000'),
+        'minute': kw.pop('minute', 0),
+        'second': kw.pop('second', 0),
+        'type': {'id': type_id, 'name': type_name},
+        'possession': kw.pop('possession', 1),
+        'possession_team': {'id': HOME, 'name': 'Belgium'},
+        'play_pattern': {'id': 1, 'name': 'Regular Play'},
+        'team': kw.pop('team', {'id': HOME, 'name': 'Belgium'}),
+        'duration': kw.pop('duration', 0.0),
+    }
+    base.update(kw)
+    return base
+
+
+_team_away = {'id': AWAY, 'name': 'Japan'}
+_p = lambda pid, name: {'id': pid, 'name': name}  # noqa: E731
+
+events = [
+    _ev(
+        1, 35, 'Starting XI',
+        tactics={
+            'formation': 433,
+            'lineup': [
+                {
+                    'player': _p(pid, name),
+                    'position': {'id': 1 + j, 'name': 'Goalkeeper' if j == 1 else 'Forward'},
+                    'jersey_number': num,
+                }
+                for j, (pid, name, num) in enumerate(_home_players)
+            ],
+        },
+    ),
+    _ev(
+        2, 35, 'Starting XI', team=_team_away,
+        tactics={
+            'formation': 442,
+            'lineup': [
+                {
+                    'player': _p(pid, name),
+                    'position': {'id': 1 + j, 'name': 'Goalkeeper' if j == 1 else 'Forward'},
+                    'jersey_number': num,
+                }
+                for j, (pid, name, num) in enumerate(_away_players)
+            ],
+        },
+    ),
+    _ev(3, 18, 'Half Start'),
+    # ordinary completed pass by the home side
+    _ev(
+        4, 30, 'Pass', minute=0, second=5, timestamp='00:00:05.000',
+        player=_p(3289, 'Dries Mertens'),
+        position={'id': 17, 'name': 'Right Wing'},
+        location=[61.0, 40.0],
+        **{'pass': {
+            'recipient': _p(5630, 'Jan Vertonghen'),
+            'length': 13.3, 'angle': 2.9,
+            'height': {'id': 1, 'name': 'Ground Pass'},
+            'end_location': [49.0, 43.0],
+            'body_part': {'id': 40, 'name': 'Right Foot'},
+        }},
+    ),
+    # carry
+    _ev(
+        5, 43, 'Carry', minute=0, second=7, timestamp='00:00:07.000',
+        player=_p(5630, 'Jan Vertonghen'),
+        location=[49.0, 43.0],
+        carry={'end_location': [55.0, 45.0]},
+    ),
+    # cross (flagged)
+    _ev(
+        6, 30, 'Pass', minute=0, second=10, timestamp='00:00:10.000',
+        player=_p(5630, 'Jan Vertonghen'),
+        location=[55.0, 45.0],
+        **{'pass': {
+            'cross': True,
+            'height': {'id': 3, 'name': 'High Pass'},
+            'end_location': [110.0, 40.0],
+            'outcome': {'id': 9, 'name': 'Incomplete'},
+        }},
+    ),
+    # interception by the away side
+    _ev(
+        7, 10, 'Interception', minute=0, second=12, timestamp='00:00:12.000',
+        team=_team_away, player=_p(3606, 'Maya Yoshida'),
+        location=[11.0, 41.0],
+        interception={'outcome': {'id': 4, 'name': 'Won'}},
+    ),
+    # failed take-on
+    _ev(
+        8, 14, 'Dribble', minute=0, second=15, timestamp='00:00:15.000',
+        team=_team_away, player=_p(3604, 'Genki Haraguchi'),
+        location=[30.0, 30.0],
+        dribble={'outcome': {'id': 9, 'name': 'Incomplete'}},
+    ),
+    # tackle
+    _ev(
+        9, 4, 'Duel', minute=0, second=16, timestamp='00:00:16.000',
+        player=_p(3289, 'Dries Mertens'),
+        location=[90.0, 50.0],
+        duel={'type': {'id': 11, 'name': 'Tackle'}, 'outcome': {'id': 16, 'name': 'Success In Play'}},
+    ),
+    # foul with a yellow card
+    _ev(
+        10, 22, 'Foul Committed', minute=2, second=0, timestamp='00:02:00.000',
+        team=_team_away, player=_p(3606, 'Maya Yoshida'),
+        location=[60.0, 40.0],
+        foul_committed={'card': {'id': 7, 'name': 'Yellow Card'}},
+    ),
+    # free kick, crossed
+    _ev(
+        11, 30, 'Pass', minute=2, second=30, timestamp='00:02:30.000',
+        player=_p(3289, 'Dries Mertens'),
+        location=[60.0, 40.0],
+        **{'pass': {
+            'type': {'id': 62, 'name': 'Free Kick'},
+            'height': {'id': 3, 'name': 'High Pass'},
+            'end_location': [105.0, 38.0],
+        }},
+    ),
+    # saved shot + keeper save
+    _ev(
+        12, 16, 'Shot', minute=3, second=0, timestamp='00:03:00.000',
+        player=_p(3289, 'Dries Mertens'),
+        location=[105.0, 38.0],
+        shot={
+            'outcome': {'id': 100, 'name': 'Saved'},
+            'end_location': [119.0, 40.0, 0.3],
+            'body_part': {'id': 37, 'name': 'Head'},
+            'statsbomb_xg': 0.12,
+        },
+    ),
+    _ev(
+        13, 23, 'Goal Keeper', minute=3, second=1, timestamp='00:03:01.000',
+        team=_team_away, player=_p(3605, 'Eiji Kawashima'),
+        location=[1.0, 40.0],
+        goalkeeper={
+            'type': {'id': 33, 'name': 'Shot Saved'},
+            'outcome': {'id': 15, 'name': 'Success'},
+            'body_part': {'id': 35, 'name': 'Both Hands'},
+        },
+    ),
+    # clearance and miscontrol
+    _ev(
+        14, 9, 'Clearance', minute=4, second=0, timestamp='00:04:00.000',
+        team=_team_away, player=_p(3606, 'Maya Yoshida'),
+        location=[10.0, 40.0],
+    ),
+    _ev(
+        15, 38, 'Miscontrol', minute=4, second=10, timestamp='00:04:10.000',
+        player=_p(3289, 'Dries Mertens'),
+        location=[70.0, 30.0],
+    ),
+    # goal kick
+    _ev(
+        16, 30, 'Pass', minute=5, second=0, timestamp='00:05:00.000',
+        team=_team_away, player=_p(3605, 'Eiji Kawashima'),
+        location=[6.0, 40.0],
+        **{'pass': {
+            'type': {'id': 63, 'name': 'Goal Kick'},
+            'height': {'id': 1, 'name': 'Ground Pass'},
+            'end_location': [30.0, 40.0],
+        }},
+    ),
+    # goal
+    _ev(
+        17, 16, 'Shot', minute=44, second=30, timestamp='00:44:30.000',
+        player=_p(3289, 'Dries Mertens'),
+        location=[108.0, 36.0],
+        shot={
+            'outcome': {'id': 97, 'name': 'Goal'},
+            'end_location': [120.0, 38.0, 1.2],
+            'body_part': {'id': 40, 'name': 'Right Foot'},
+            'statsbomb_xg': 0.31,
+        },
+    ),
+    _ev(18, 34, 'Half End', minute=47, second=10, timestamp='00:47:10.000'),
+    _ev(19, 34, 'Half End', minute=47, second=10, timestamp='00:47:10.000', team=_team_away),
+    # second half: own goal pair + substitution + throw-in
+    _ev(20, 18, 'Half Start', period=2, minute=45, second=0),
+    _ev(
+        21, 30, 'Pass', period=2, minute=46, second=0, timestamp='00:01:00.000',
+        team=_team_away, player=_p(3604, 'Genki Haraguchi'),
+        location=[80.0, 20.0],
+        **{'pass': {
+            'type': {'id': 67, 'name': 'Throw-in'},
+            'height': {'id': 2, 'name': 'Low Pass'},
+            'end_location': [85.0, 25.0],
+        }},
+    ),
+    # own goal: "for" row (credited team) is a non-action, "against" converts
+    _ev(
+        22, 25, 'Own Goal For', period=2, minute=50, second=0, timestamp='00:05:00.000',
+        team=_team_away,
+    ),
+    _ev(
+        23, 20, 'Own Goal Against', period=2, minute=50, second=0, timestamp='00:05:00.000',
+        player=_p(5630, 'Jan Vertonghen'),
+        location=[115.0, 40.0],
+    ),
+    _ev(
+        24, 19, 'Substitution', period=2, minute=60, second=0, timestamp='00:15:00.000',
+        team=_team_away, player=_p(3604, 'Genki Haraguchi'),
+        substitution={
+            'outcome': {'id': 102, 'name': 'Injury'},
+            'replacement': {'id': 3607, 'name': 'Takashi Inui'},
+        },
+    ),
+    # red card late in the game
+    _ev(
+        25, 22, 'Foul Committed', period=2, minute=85, second=0, timestamp='00:40:00.000',
+        player=_p(5630, 'Jan Vertonghen'),
+        location=[40.0, 30.0],
+        foul_committed={'card': {'id': 5, 'name': 'Red Card'}},
+    ),
+    _ev(26, 34, 'Half End', period=2, minute=93, second=20, timestamp='00:48:20.000'),
+    _ev(27, 34, 'Half End', period=2, minute=93, second=20, timestamp='00:48:20.000', team=_team_away),
+]
+
+
+def main() -> None:
+    os.makedirs(os.path.join(ROOT, 'matches', '43'), exist_ok=True)
+    os.makedirs(os.path.join(ROOT, 'lineups'), exist_ok=True)
+    os.makedirs(os.path.join(ROOT, 'events'), exist_ok=True)
+    with open(os.path.join(ROOT, 'competitions.json'), 'w') as fh:
+        json.dump(competitions, fh, indent=1)
+    with open(os.path.join(ROOT, 'matches', '43', '3.json'), 'w') as fh:
+        json.dump(matches, fh, indent=1)
+    with open(os.path.join(ROOT, 'lineups', f'{GAME_ID}.json'), 'w') as fh:
+        json.dump(lineups, fh, indent=1)
+    with open(os.path.join(ROOT, 'events', f'{GAME_ID}.json'), 'w') as fh:
+        json.dump(events, fh, indent=1)
+    print(f'wrote fixture to {ROOT}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,133 @@
+"""StatsBomb → SPADL converter tests (reference assertion style)."""
+
+import os
+
+import pytest
+
+from socceraction_tpu.data.statsbomb import StatsBombLoader
+from socceraction_tpu.spadl import config as spadl
+from socceraction_tpu.spadl import statsbomb as sb
+from socceraction_tpu.spadl.schema import SPADLSchema
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), os.pardir, 'datasets', 'statsbomb', 'raw')
+GAME_ID = 7584
+HOME = 782
+
+PASS_EVENT_ID = '00000000-0000-0000-0000-000000000004'
+
+
+@pytest.fixture(scope='module')
+def events():
+    return StatsBombLoader(getter='local', root=DATA_DIR).events(GAME_ID)
+
+
+def test_convert_to_actions(events):
+    actions = sb.convert_to_actions(events, HOME)
+    assert len(actions) > 0
+    SPADLSchema.validate(actions)
+    assert (actions['game_id'] == GAME_ID).all()
+    assert actions['team_id'].isin([782, 778]).all()
+    # non-action events (Starting XI, Half Start/End, Own Goal For, Substitution)
+    # are dropped
+    assert (actions['type_id'] != spadl.NON_ACTION).all()
+
+
+def test_convert_start_location(events):
+    event = events[events['event_id'] == PASS_EVENT_ID]
+    action = sb.convert_to_actions(event, HOME).iloc[0]
+    assert action['start_x'] == (61.0 - 1) / 119 * spadl.field_length
+    assert action['start_y'] == 68 - (40.0 - 1) / 79 * spadl.field_width
+
+
+def test_convert_end_location(events):
+    event = events[events['event_id'] == PASS_EVENT_ID]
+    action = sb.convert_to_actions(event, HOME).iloc[0]
+    assert action['end_x'] == (49.0 - 1) / 119 * spadl.field_length
+    assert action['end_y'] == 68 - (43.0 - 1) / 79 * spadl.field_width
+
+
+@pytest.mark.parametrize(
+    'period,minute,second',
+    [
+        (1, 0, 0),
+        (1, 47, 9),  # first-half injury time
+        (2, 64, 51),  # second half restarts at 45'
+        (2, 93, 10),
+        (3, 100, 12),  # extra time
+        (4, 118, 31),
+        (5, 122, 37),  # shoot-out
+    ],
+)
+def test_convert_time(events, period, minute, second):
+    event = events[events['event_id'] == PASS_EVENT_ID].copy()
+    event['period_id'] = period
+    event['minute'] = minute
+    event['second'] = second
+    action = sb.convert_to_actions(event, HOME).iloc[0]
+    assert action['period_id'] == period
+    assert (
+        action['time_seconds']
+        == 60 * minute
+        + second
+        - (period > 1) * 45 * 60
+        - (period > 2) * 45 * 60
+        - (period > 3) * 15 * 60
+        - (period > 4) * 15 * 60
+    )
+
+
+def test_convert_pass(events):
+    action = sb.convert_to_actions(
+        events[events['event_id'] == PASS_EVENT_ID], HOME
+    ).iloc[0]
+    assert action['team_id'] == 782
+    assert action['player_id'] == 3289
+    assert action['type_id'] == spadl.PASS
+    assert action['result_id'] == spadl.SUCCESS
+    assert action['bodypart_id'] == spadl.FOOT
+
+
+@pytest.mark.parametrize(
+    'index,type_name,result_name,bodypart_name',
+    [
+        (6, 'cross', 'fail', 'foot'),
+        (7, 'interception', 'success', 'foot'),
+        (8, 'take_on', 'fail', 'foot'),
+        (9, 'tackle', 'success', 'foot'),
+        (10, 'foul', 'yellow_card', 'foot'),
+        (11, 'freekick_crossed', 'success', 'foot'),
+        (12, 'shot', 'fail', 'head'),
+        (13, 'keeper_save', 'success', 'other'),
+        (14, 'clearance', 'success', 'foot'),
+        (15, 'bad_touch', 'fail', 'foot'),
+        (16, 'goalkick', 'success', 'foot'),
+        (17, 'shot', 'success', 'foot'),
+        (21, 'throw_in', 'success', 'foot'),
+    ],
+)
+def test_convert_event_types(events, index, type_name, result_name, bodypart_name):
+    event_id = f'00000000-0000-0000-0000-{index:012d}'
+    action = sb.convert_to_actions(events[events['event_id'] == event_id], HOME).iloc[0]
+    assert action['type_id'] == spadl.actiontypes.index(type_name)
+    assert action['result_id'] == spadl.results.index(result_name)
+    assert action['bodypart_id'] == spadl.bodyparts.index(bodypart_name)
+
+
+def test_convert_own_goal(events):
+    own_goal_for = events[events['type_name'] == 'Own Goal For']
+    assert len(sb.convert_to_actions(own_goal_for, HOME)) == 0
+    own_goal_against = events[events['type_name'] == 'Own Goal Against']
+    actions = sb.convert_to_actions(own_goal_against, HOME)
+    assert len(actions) == 1
+    assert actions.iloc[0]['type_id'] == spadl.actiontypes.index('bad_touch')
+    assert actions.iloc[0]['result_id'] == spadl.OWNGOAL
+    assert actions.iloc[0]['bodypart_id'] == spadl.FOOT
+
+
+def test_away_coordinates_mirrored(events):
+    actions = sb.convert_to_actions(events, HOME)
+    # interception at x=11 by the away team mirrors to ~105 - x
+    interception = actions[actions['type_id'] == spadl.actiontypes.index('interception')]
+    assert len(interception) == 1
+    raw_x = (11.0 - 1) / 119 * spadl.field_length
+    assert interception.iloc[0]['start_x'] == pytest.approx(spadl.field_length - raw_x)
