@@ -1,0 +1,24 @@
+"""Wyscout event data provider.
+
+Parity: reference ``socceraction/data/wyscout/__init__.py``.
+"""
+
+from .loader import PublicWyscoutLoader, WyscoutLoader, wyscout_periods
+from .schema import (
+    WyscoutCompetitionSchema,
+    WyscoutEventSchema,
+    WyscoutGameSchema,
+    WyscoutPlayerSchema,
+    WyscoutTeamSchema,
+)
+
+__all__ = [
+    'PublicWyscoutLoader',
+    'WyscoutLoader',
+    'wyscout_periods',
+    'WyscoutCompetitionSchema',
+    'WyscoutGameSchema',
+    'WyscoutPlayerSchema',
+    'WyscoutTeamSchema',
+    'WyscoutEventSchema',
+]

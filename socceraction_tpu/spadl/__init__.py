@@ -17,9 +17,11 @@ from .config import (
 from .schema import SPADLSchema
 from .utils import add_names, play_left_to_right
 from . import statsbomb  # noqa: F401  (provider converters)
+from . import wyscout  # noqa: F401
 
 __all__ = [
     'statsbomb',
+    'wyscout',
     'actiontypes',
     'actiontypes_df',
     'bodyparts',

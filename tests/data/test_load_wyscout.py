@@ -1,0 +1,156 @@
+"""Wyscout loader tests against the synthetic fixtures.
+
+Mirrors reference ``tests/data/test_load_wyscout.py`` (public + API-v2
+loaders, minutes-played edge cases) on the hand-built fixture games.
+"""
+
+import os
+
+import pytest
+
+from socceraction_tpu.data.wyscout import (
+    PublicWyscoutLoader,
+    WyscoutCompetitionSchema,
+    WyscoutEventSchema,
+    WyscoutGameSchema,
+    WyscoutLoader,
+    WyscoutPlayerSchema,
+    WyscoutTeamSchema,
+)
+
+PUBLIC_DIR = os.path.join(
+    os.path.dirname(__file__), os.pardir, 'datasets', 'wyscout_public', 'raw'
+)
+API_DIR = os.path.join(os.path.dirname(__file__), os.pardir, 'datasets', 'wyscout_api')
+GAME_ID = 2058007
+
+
+@pytest.fixture(scope='module')
+def WSL() -> PublicWyscoutLoader:
+    return PublicWyscoutLoader(root=PUBLIC_DIR, download=False)
+
+
+@pytest.fixture(scope='module')
+def API() -> WyscoutLoader:
+    feeds = {
+        'competitions': 'competitions.json',
+        'seasons': 'seasons_{competition_id}.json',
+        'events': 'events_{game_id}.json',
+    }
+    return WyscoutLoader(root=API_DIR, getter='local', feeds=feeds)
+
+
+class TestPublicWyscoutLoader:
+    def test_competitions(self, WSL):
+        df = WSL.competitions()
+        assert len(df) == 1
+        WyscoutCompetitionSchema.validate(df)
+        row = df.iloc[0]
+        assert row['competition_id'] == 28
+        assert row['season_id'] == 10078
+        assert row['country_name'] == 'International'
+        assert row['season_name'] == '2018'
+
+    def test_games(self, WSL):
+        df = WSL.games(28, 10078)
+        assert len(df) == 1
+        WyscoutGameSchema.validate(df)
+        g = df.iloc[0]
+        assert g['game_id'] == GAME_ID
+        assert g['home_team_id'] == 5629
+        assert g['away_team_id'] == 12913
+
+    def test_teams(self, WSL):
+        df = WSL.teams(GAME_ID)
+        assert len(df) == 2
+        WyscoutTeamSchema.validate(df)
+        assert set(df['team_id']) == {5629, 12913}
+        assert 'Fixture United FC' in set(df['team_name'])
+
+    def test_players(self, WSL):
+        df = WSL.players(GAME_ID)
+        WyscoutPlayerSchema.validate(df)
+        # 6 starters + 1 substitute made it onto the pitch
+        assert len(df) == 7
+        players = df.set_index('player_id')
+        # unicode-escaped names are decoded
+        assert players.at[101, 'firstname'] == 'José'
+        # both halves ran to 48 min -> 96 match minutes
+        assert players.at[101, 'minutes_played'] == 96
+        # substituted at 60' (+3' stoppage) and his replacement
+        assert players.at[103, 'minutes_played'] == 63
+        assert players.at[104, 'minutes_played'] == 96 - 63
+        assert not bool(players.at[104, 'is_starter'])
+        # red card at 85' -> expanded to 88'
+        assert players.at[203, 'minutes_played'] == 88
+
+    def test_events(self, WSL):
+        df = WSL.events(GAME_ID)
+        WyscoutEventSchema.validate(df)
+        assert len(df) == 21
+        assert (df['game_id'] == GAME_ID).all()
+        assert df['period_id'].isin([1, 2]).all()
+        # eventSec is converted to milliseconds
+        assert df.iloc[0]['milliseconds'] == 2000.0
+        # eventId/subEventId become the type ids
+        assert df.iloc[0]['type_id'] == 8
+        assert df.iloc[0]['subtype_id'] == 85
+
+
+def test_minutes_exclude_penalty_shootout():
+    from socceraction_tpu.data.wyscout.loader import _minutes_played
+
+    teams_data = [
+        {
+            'teamId': 1,
+            'formation': {
+                'lineup': [{'playerId': 1, 'shirtNumber': 1, 'redCards': '0'}],
+                'bench': [],
+                'substitutions': 'null',
+            },
+        }
+    ]
+    events = [
+        {'matchPeriod': '1H', 'eventSec': 45 * 60.0},
+        {'matchPeriod': '2H', 'eventSec': 45 * 60.0},
+        {'matchPeriod': 'E1', 'eventSec': 15 * 60.0},
+        {'matchPeriod': 'E2', 'eventSec': 15 * 60.0},
+        {'matchPeriod': 'P', 'eventSec': 10 * 60.0},  # shootout: not played time
+    ]
+    mp = _minutes_played(teams_data, events)
+    assert mp.set_index('player_id').at[1, 'minutes_played'] == 120
+
+
+class TestWyscoutAPILoader:
+    def test_competitions(self, API):
+        df = API.competitions()
+        assert len(df) == 1
+        WyscoutCompetitionSchema.validate(df)
+        assert df.iloc[0]['competition_id'] == 77
+        assert df.iloc[0]['season_id'] == 2021
+
+    def test_games(self, API):
+        df = API.games(77, 2021)
+        assert len(df) == 1
+        WyscoutGameSchema.validate(df)
+        assert df.iloc[0]['game_id'] == 555001
+
+    def test_teams(self, API):
+        df = API.teams(555001)
+        assert len(df) == 2
+        WyscoutTeamSchema.validate(df)
+
+    def test_players(self, API):
+        df = API.players(555001)
+        WyscoutPlayerSchema.validate(df)
+        assert len(df) == 5  # 4 starters + 1 sub
+        players = df.set_index('player_id')
+        # halves of 45 and 46 min -> 91 match minutes
+        assert players.at[9001, 'minutes_played'] == 91
+        assert players.at[9002, 'minutes_played'] == 70
+        assert players.at[9003, 'minutes_played'] == 21
+
+    def test_events(self, API):
+        df = API.events(555001)
+        WyscoutEventSchema.validate(df)
+        assert len(df) == 5

@@ -1,0 +1,303 @@
+"""Generate synthetic Wyscout fixtures for loader + converter tests.
+
+The reference tests run against the public figshare dataset and recorded
+API-v2 feeds (reference ``tests/data/test_load_wyscout.py``); this
+environment has no egress, so small hand-built games in the same two
+directory layouts stand in:
+
+- ``wyscout_public/raw`` — the figshare release layout (global
+  ``competitions.json`` / ``teams.json`` / ``players.json`` plus
+  per-competition ``matches_*.json`` / ``events_*.json``).
+- ``wyscout_api`` — API-v2 feed files (``competitions.json``,
+  ``seasons_{competition_id}.json``, per-game ``events_{game_id}.json``).
+
+Run: ``python tests/datasets/make_wyscout_fixture.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+HERE = os.path.dirname(__file__)
+PUBLIC_ROOT = os.path.join(HERE, 'wyscout_public', 'raw')
+API_ROOT = os.path.join(HERE, 'wyscout_api')
+
+GAME_ID = 2058007
+HOME, AWAY = 5629, 12913
+
+
+def _tags(*ids: int) -> list:
+    return [{'id': i} for i in ids]
+
+
+def _pos(*points: tuple) -> list:
+    return [{'x': x, 'y': y} for x, y in points]
+
+
+def _event(
+    eid: int,
+    sec: float,
+    type_id: int,
+    subtype_id: int,
+    team: int,
+    player: int,
+    positions: list,
+    tags: list,
+    period: str = '1H',
+    type_name: str = '',
+    subtype_name: str = '',
+) -> dict:
+    return {
+        'id': eid,
+        'matchId': GAME_ID,
+        'matchPeriod': period,
+        'eventSec': sec,
+        'eventId': type_id,
+        'subEventId': subtype_id,
+        'eventName': type_name,
+        'subEventName': subtype_name,
+        'teamId': team,
+        'playerId': player,
+        'positions': positions,
+        'tags': tags,
+    }
+
+
+# A coherent ~20-event synthetic game exercising every converter pass:
+# a duel pair ending out of field, a tagged goal with zone tags, a keeper
+# save right after the goal (must be dropped), a goalkick, fouls, an
+# offside pass, a touch that becomes a pass, an interception-pass and a
+# clearance.
+EVENTS = [
+    _event(1, 2.0, 8, 85, HOME, 101, _pos((50, 50), (60, 40)), _tags(1801), type_name='Pass'),
+    _event(2, 6.5, 8, 80, HOME, 102, _pos((60, 40), (85, 20)), _tags(1802), type_name='Pass'),
+    # duel pair + ball out of field -> the away duelist (different team from
+    # the out event's team, which is HOME) wins a synthetic pass
+    _event(3, 10.0, 1, 10, HOME, 103, _pos((70, 30), (72, 28)), _tags(701), type_name='Duel'),
+    _event(4, 10.2, 1, 10, AWAY, 201, _pos((30, 70), (28, 72)), _tags(703), type_name='Duel'),
+    _event(5, 13.0, 5, 50, HOME, 101, _pos((25, 75)), [], type_name='Interruption'),
+    # goal for the away team, zone tag mid-left; single position entry
+    _event(6, 300.0, 10, 100, AWAY, 202, _pos((85, 45)), _tags(101, 402, 1204), type_name='Shot'),
+    # keeper picks the ball out of the net 5 s later -> dropped
+    _event(7, 305.0, 9, 90, HOME, 103, _pos((100, 50), (3, 50)), _tags(1801), type_name='Save attempt'),
+    # goalkick; retained by HOME -> success
+    _event(8, 330.0, 3, 34, HOME, 103, _pos((1, 50), (40, 60)), _tags(1801), type_name='Free Kick'),
+    _event(9, 335.0, 8, 85, HOME, 101, _pos((40, 60), (55, 55)), _tags(1801), type_name='Pass'),
+    # second half
+    _event(10, 30.0, 2, 20, AWAY, 203, _pos((45, 45)), _tags(1702), period='2H', type_name='Foul'),
+    _event(11, 40.0, 3, 31, HOME, 102, _pos((55, 55), (60, 50)), _tags(1801), period='2H', type_name='Free Kick'),
+    # touch reaching a teammate at the same spot -> pass (accurate)
+    _event(12, 100.0, 7, 72, AWAY, 201, _pos((60, 50), (62, 52)), [], period='2H', type_name='Others on the ball'),
+    _event(13, 103.0, 8, 85, AWAY, 202, _pos((62, 52), (75, 40)), _tags(1801), period='2H', type_name='Pass'),
+    # offside pass: the pass is followed by an offside whistle
+    _event(14, 200.0, 8, 83, HOME, 101, _pos((50, 50), (85, 30)), _tags(1802), period='2H', type_name='Pass'),
+    _event(15, 203.0, 6, 0, HOME, 102, _pos((85, 30)), [], period='2H', type_name='Offside'),
+    # missed shot with an out-zone tag
+    _event(16, 1000.0, 10, 100, AWAY, 202, _pos((80, 55)), _tags(1802, 1213), period='2H', type_name='Shot'),
+    # clearance
+    _event(17, 1005.0, 7, 71, HOME, 103, _pos((8, 50), (30, 70)), _tags(1501), period='2H', type_name='Others on the ball'),
+    # interception-tagged pass -> split into two actions
+    _event(18, 1100.0, 8, 85, HOME, 101, _pos((35, 65), (50, 60)), _tags(1401, 1801), period='2H', type_name='Pass'),
+    _event(19, 1104.0, 8, 85, HOME, 102, _pos((50, 60), (60, 55)), _tags(1801), period='2H', type_name='Pass'),
+    # clocks defining the period durations: 48 min per half
+    _event(20, 2880.0, 5, 51, HOME, 101, _pos((50, 50)), [], type_name='Interruption'),
+    _event(21, 2880.0, 5, 51, HOME, 101, _pos((50, 50)), [], period='2H', type_name='Interruption'),
+]
+
+
+def _player(pid: int, first: str, last: str, short: str) -> dict:
+    return {
+        'wyId': pid,
+        'firstName': first,
+        'lastName': last,
+        'shortName': short,
+        'birthDate': '1992-03-01',
+        'foot': 'right',
+    }
+
+
+def _lineup_entry(pid: int, shirt: int, red: str = '0') -> dict:
+    return {
+        'playerId': pid,
+        'shirtNumber': shirt,
+        'redCards': red,
+        'yellowCards': '0',
+        'goals': '0',
+        'ownGoals': '0',
+    }
+
+
+TEAMS_DATA = {
+    str(HOME): {
+        'teamId': HOME,
+        'side': 'home',
+        'score': 0,
+        'formation': {
+            'lineup': [
+                _lineup_entry(101, 10),
+                _lineup_entry(102, 7),
+                _lineup_entry(103, 1),
+            ],
+            'bench': [_lineup_entry(104, 14)],
+            # 104 replaces 103 on the hour; with 3' of first-half stoppage
+            # the expanded minute is 63
+            'substitutions': [{'playerIn': 104, 'playerOut': 103, 'minute': 60}],
+        },
+    },
+    str(AWAY): {
+        'teamId': AWAY,
+        'side': 'away',
+        'score': 1,
+        'formation': {
+            'lineup': [
+                _lineup_entry(201, 9),
+                _lineup_entry(202, 11),
+                # sent off in the 85th minute -> expanded to 88
+                _lineup_entry(203, 5, red='85'),
+            ],
+            'bench': [_lineup_entry(204, 18)],
+            'substitutions': 'null',
+        },
+    },
+}
+
+MATCH = {
+    'wyId': GAME_ID,
+    'competitionId': 28,
+    'seasonId': 10078,
+    'dateutc': '2018-06-17 18:00:00',
+    'gameweek': 1,
+    'label': 'Fixture United - Synthetic City, 0 - 1',
+    'teamsData': TEAMS_DATA,
+}
+
+
+def write_public_fixture() -> None:
+    os.makedirs(PUBLIC_ROOT, exist_ok=True)
+
+    def dump(name: str, obj: object) -> None:
+        with open(os.path.join(PUBLIC_ROOT, name), 'w', encoding='utf-8') as fh:
+            json.dump(obj, fh)
+
+    dump('competitions.json', [
+        {'wyId': 28, 'name': 'World Cup', 'area': {'name': ''}, 'format': 'International cup'},
+    ])
+    dump('teams.json', [
+        {'wyId': HOME, 'name': 'Fixture United', 'officialName': 'Fixture United FC',
+         'area': {'name': 'Fixtureland'}},
+        {'wyId': AWAY, 'name': 'Synthetic City', 'officialName': 'Synthetic City FC',
+         'area': {'name': 'Testonia'}},
+    ])
+    dump('players.json', [
+        # the figshare dump stores names with literal escape sequences
+        _player(101, 'Jos\\u00e9', 'Alpha', 'J. Alpha'),
+        _player(102, 'Bob', 'Bravo', 'B. Bravo'),
+        _player(103, 'Carl', 'Charlie', 'C. Charlie'),
+        _player(104, 'Dan', 'Delta', 'D. Delta'),
+        _player(201, 'Erik', 'Echo', 'E. Echo'),
+        _player(202, 'Finn', 'Foxtrot', 'F. Foxtrot'),
+        _player(203, 'Gus', 'Golf', 'G. Golf'),
+        _player(204, 'Hugo', 'Hotel', 'H. Hotel'),
+    ])
+    dump('matches_World_Cup.json', [MATCH])
+    dump('events_World_Cup.json', EVENTS)
+
+
+API_GAME_ID = 555001
+API_HOME, API_AWAY = 801, 802
+
+
+def write_api_fixture() -> None:
+    os.makedirs(API_ROOT, exist_ok=True)
+
+    def dump(name: str, obj: object) -> None:
+        with open(os.path.join(API_ROOT, name), 'w', encoding='utf-8') as fh:
+            json.dump(obj, fh)
+
+    dump('competitions.json', {
+        'competitions': [
+            {'wyId': 77, 'name': 'Test League', 'area': {'name': 'Testonia'},
+             'gender': 'male'},
+        ]
+    })
+    dump('seasons_77.json', {
+        'competition': {'wyId': 77, 'name': 'Test League', 'area': {'name': 'Testonia'},
+                        'gender': 'male'},
+        'seasons': [
+            {'season': {'wyId': 2021, 'name': '2020/2021', 'competitionId': 77}},
+        ],
+    })
+    api_teams_data = {
+        str(API_HOME): {
+            'teamId': API_HOME,
+            'side': 'home',
+            'formation': {
+                'lineup': [_lineup_entry(9001, 1), _lineup_entry(9002, 2)],
+                'bench': [_lineup_entry(9003, 3)],
+                'substitutions': [{'playerIn': 9003, 'playerOut': 9002, 'minute': 70}],
+            },
+        },
+        str(API_AWAY): {
+            'teamId': API_AWAY,
+            'side': 'away',
+            'formation': {
+                'lineup': [_lineup_entry(9004, 4), _lineup_entry(9005, 5)],
+                'bench': [],
+                'substitutions': 'null',
+            },
+        },
+    }
+    api_events = [
+        {
+            'id': 1000 + i,
+            'matchId': API_GAME_ID,
+            'matchPeriod': period,
+            'eventSec': sec,
+            'eventId': 8,
+            'subEventId': 85,
+            'eventName': 'Pass',
+            'subEventName': 'Simple pass',
+            'teamId': API_HOME if i % 2 == 0 else API_AWAY,
+            'playerId': 9001 + (i % 5),
+            'positions': [{'x': 40 + i, 'y': 50}, {'x': 45 + i, 'y': 52}],
+            'tags': [{'id': 1801}],
+        }
+        for i, (period, sec) in enumerate(
+            [('1H', 5.0), ('1H', 9.0), ('1H', 2700.0), ('2H', 8.0), ('2H', 2760.0)]
+        )
+    ]
+    dump(f'events_{API_GAME_ID}.json', {
+        'match': {
+            'wyId': API_GAME_ID,
+            'competitionId': 77,
+            'seasonId': 2021,
+            'dateutc': '2021-02-14 15:00:00',
+            'gameweek': 23,
+            'teamsData': api_teams_data,
+        },
+        'teams': {
+            str(API_HOME): {'team': {'wyId': API_HOME, 'name': 'Home API',
+                                     'officialName': 'Home API FC'}},
+            str(API_AWAY): {'team': {'wyId': API_AWAY, 'name': 'Away API',
+                                     'officialName': 'Away API FC'}},
+        },
+        'players': {
+            str(API_HOME): [
+                {'player': _player(9001, 'Goal', 'Keeper', 'G. Keeper')},
+                {'player': _player(9002, 'Out', 'Field', 'O. Field')},
+                {'player': _player(9003, 'Sub', 'Stitute', 'S. Stitute')},
+            ],
+            str(API_AWAY): [
+                {'player': _player(9004, 'Away', 'One', 'A. One')},
+                {'player': _player(9005, 'Away', 'Two', 'A. Two')},
+            ],
+        },
+        'events': api_events,
+    })
+
+
+if __name__ == '__main__':
+    write_public_fixture()
+    write_api_fixture()
+    print(f'wrote {PUBLIC_ROOT} and {API_ROOT}')

@@ -1,0 +1,287 @@
+"""Wyscout → SPADL converter tests.
+
+Mirrors reference ``tests/spadl/test_wyscout.py``: the inline micro-frames
+(interception-pass split, own-goal touches, simulations) plus an
+end-to-end conversion of the synthetic fixture game.
+"""
+
+import os
+
+import pandas as pd
+import pytest
+
+from socceraction_tpu.data.wyscout import PublicWyscoutLoader
+from socceraction_tpu.spadl import config as spadl
+from socceraction_tpu.spadl import wyscout as wy
+from socceraction_tpu.spadl.schema import SPADLSchema
+
+PUBLIC_DIR = os.path.join(
+    os.path.dirname(__file__), os.pardir, 'datasets', 'wyscout_public', 'raw'
+)
+GAME_ID = 2058007
+
+
+def _event(**kwargs):
+    base = {
+        'event_id': 1,
+        'game_id': 1,
+        'period_id': 1,
+        'milliseconds': 1000.0,
+        'team_id': 1,
+        'player_id': 1,
+        'type_id': 8,
+        'type_name': 'Pass',
+        'subtype_id': 85,
+        'subtype_name': 'Simple pass',
+        'positions': [{'x': 50, 'y': 50}, {'x': 60, 'y': 50}],
+        'tags': [{'id': 1801}],
+    }
+    base.update(kwargs)
+    return base
+
+
+@pytest.fixture(scope='module')
+def fixture_events() -> pd.DataFrame:
+    return PublicWyscoutLoader(root=PUBLIC_DIR, download=False).events(GAME_ID)
+
+
+def test_convert_fixture_game(fixture_events):
+    actions = wy.convert_to_actions(fixture_events, 5629)
+    assert len(actions) > 0
+    SPADLSchema.validate(actions)
+    assert (actions['game_id'] == GAME_ID).all()
+    assert actions['team_id'].isin([5629, 12913]).all()
+
+
+def test_goal_shot_end_coords(fixture_events):
+    actions = wy.convert_to_actions(fixture_events, 5629)
+    shots = actions[actions['type_id'] == spadl.actiontypes.index('shot')]
+    goal = shots[shots['result_id'] == spadl.SUCCESS].iloc[0]
+    # zone tag mid-left -> raw end (100, 45); away team plays right-to-left
+    # after the direction fix, so coordinates are mirrored
+    assert goal['end_x'] == pytest.approx(105 - 100 / 100 * 105)
+    assert goal['end_y'] == pytest.approx(68 - (100 - 45) / 100 * 68)
+
+
+def test_keeper_save_after_goal_removed(fixture_events):
+    actions = wy.convert_to_actions(fixture_events, 5629)
+    assert (actions['type_id'] != spadl.actiontypes.index('keeper_save')).all()
+
+
+def test_goalkick_fixed_start(fixture_events):
+    actions = wy.convert_to_actions(fixture_events, 5629)
+    gk = actions[actions['type_id'] == spadl.actiontypes.index('goalkick')].iloc[0]
+    assert gk['start_x'] == 5.0 and gk['start_y'] == 34.0
+    assert gk['result_id'] == spadl.SUCCESS  # retained by the same team
+
+
+def test_offside_pass(fixture_events):
+    actions = wy.convert_to_actions(fixture_events, 5629)
+    assert (actions['result_id'] == spadl.OFFSIDE).any()
+
+
+def test_insert_interception_passes():
+    # a headed pass that is simultaneously an interception and an own goal
+    event = pd.DataFrame(
+        [
+            _event(
+                type_id=8,
+                subtype_id=82,
+                subtype_name='Head pass',
+                tags=[{'id': 102}, {'id': 1401}, {'id': 1801}],
+                positions=[{'y': 56, 'x': 5}, {'y': 100, 'x': 100}],
+            )
+        ]
+    )
+    actions = wy.convert_to_actions(event, 1)
+    assert len(actions) == 2
+    assert actions.at[0, 'type_id'] == spadl.actiontypes.index('interception')
+    assert actions.at[0, 'result_id'] == spadl.SUCCESS
+    assert actions.at[1, 'type_id'] == spadl.actiontypes.index('bad_touch')
+    assert actions.at[1, 'result_id'] == spadl.OWNGOAL
+
+
+def test_convert_own_goal_touch():
+    # an own goal off a bad touch must survive as bad_touch/owngoal
+    events = pd.DataFrame(
+        [
+            _event(
+                event_id=1,
+                type_id=8,
+                subtype_id=80,
+                type_name='Pass',
+                subtype_name='Cross',
+                team_id=1631,
+                player_id=8013,
+                milliseconds=1496729.0,
+                period_id=2,
+                tags=[{'id': 402}, {'id': 801}, {'id': 1802}],
+                positions=[{'y': 89, 'x': 97}, {'y': 0, 'x': 0}],
+            ),
+            _event(
+                event_id=2,
+                type_id=7,
+                subtype_id=72,
+                type_name='Others on the ball',
+                subtype_name='Touch',
+                team_id=1639,
+                player_id=8094,
+                milliseconds=1497633.0,
+                period_id=2,
+                tags=[{'id': 102}],
+                positions=[{'y': 50, 'x': 1}, {'y': 100, 'x': 100}],
+            ),
+            _event(
+                event_id=3,
+                type_id=9,
+                subtype_id=90,
+                type_name='Save attempt',
+                subtype_name='Reflexes',
+                team_id=1639,
+                player_id=8094,
+                milliseconds=1499980.0,
+                period_id=2,
+                tags=[{'id': 101}, {'id': 1802}],
+                positions=[{'y': 100, 'x': 100}, {'y': 50, 'x': 1}],
+            ),
+        ]
+    )
+    actions = wy.convert_to_actions(events, 1639)
+    # cross, bad touch (owngoal), synthesized dribble, keeper save
+    assert len(actions) == 4
+    assert actions.at[1, 'type_id'] == spadl.actiontypes.index('bad_touch')
+    assert actions.at[1, 'result_id'] == spadl.OWNGOAL
+
+
+def test_simulation_after_take_on_removed():
+    events = pd.DataFrame(
+        [
+            _event(
+                event_id=1,
+                type_id=1,
+                subtype_id=11,
+                type_name='Duel',
+                subtype_name='Ground attacking duel',
+                team_id=3158,
+                player_id=8327,
+                milliseconds=706309.0,
+                period_id=2,
+                tags=[{'id': 503}, {'id': 701}, {'id': 1802}],
+                positions=[{'y': 48, 'x': 82}, {'y': 47, 'x': 83}],
+            ),
+            _event(
+                event_id=2,
+                type_id=2,
+                subtype_id=25,
+                type_name='Foul',
+                subtype_name='Simulation',
+                team_id=3158,
+                player_id=8327,
+                milliseconds=709102.0,
+                period_id=2,
+                tags=[{'id': 1702}],
+                positions=[{'y': 47, 'x': 83}, {'y': 0, 'x': 0}],
+            ),
+        ]
+    )
+    actions = wy.convert_to_actions(events, 3158)
+    assert len(actions) == 1
+    assert actions.at[0, 'type_id'] == spadl.actiontypes.index('take_on')
+    assert actions.at[0, 'result_id'] == spadl.FAIL
+
+
+def test_simulation_becomes_failed_take_on():
+    events = pd.DataFrame(
+        [
+            _event(
+                event_id=1,
+                type_id=8,
+                subtype_id=80,
+                type_name='Pass',
+                subtype_name='Cross',
+                team_id=3173,
+                player_id=20472,
+                milliseconds=1010546.0,
+                tags=[{'id': 402}, {'id': 801}, {'id': 1801}],
+                positions=[{'y': 76, 'x': 92}, {'y': 92, 'x': 98}],
+            ),
+            _event(
+                event_id=2,
+                type_id=1,
+                subtype_id=13,
+                type_name='Duel',
+                subtype_name='Ground loose ball duel',
+                team_id=3173,
+                player_id=116171,
+                milliseconds=1012801.0,
+                tags=[{'id': 701}, {'id': 1802}],
+                positions=[{'y': 92, 'x': 98}, {'y': 43, 'x': 87}],
+            ),
+            _event(
+                event_id=3,
+                type_id=2,
+                subtype_id=25,
+                type_name='Foul',
+                subtype_name='Simulation',
+                team_id=3173,
+                player_id=116171,
+                milliseconds=1014754.0,
+                tags=[{'id': 1702}],
+                positions=[{'y': 43, 'x': 87}, {'y': 100, 'x': 100}],
+            ),
+        ]
+    )
+    actions = wy.convert_to_actions(events, 3157)
+    assert len(actions) == 3
+    assert actions.at[2, 'type_id'] == spadl.actiontypes.index('take_on')
+    assert actions.at[2, 'result_id'] == spadl.FAIL
+
+
+def test_duel_out_of_field_becomes_pass():
+    events = pd.DataFrame(
+        [
+            _event(
+                event_id=1,
+                type_id=1,
+                subtype_id=10,
+                type_name='Duel',
+                subtype_name='Air duel',
+                team_id=1,
+                player_id=11,
+                milliseconds=1000.0,
+                tags=[{'id': 701}],
+                positions=[{'x': 70, 'y': 30}, {'x': 72, 'y': 28}],
+            ),
+            _event(
+                event_id=2,
+                type_id=1,
+                subtype_id=10,
+                type_name='Duel',
+                subtype_name='Air duel',
+                team_id=2,
+                player_id=21,
+                milliseconds=1200.0,
+                tags=[{'id': 703}],
+                positions=[{'x': 30, 'y': 70}, {'x': 28, 'y': 72}],
+            ),
+            _event(
+                event_id=3,
+                type_id=5,
+                subtype_id=50,
+                type_name='Interruption',
+                subtype_name='Ball out of the field',
+                team_id=1,
+                player_id=0,
+                milliseconds=3000.0,
+                tags=[],
+                positions=[{'x': 25, 'y': 75}],
+            ),
+        ]
+    )
+    actions = wy.convert_to_actions(events, 1)
+    # the away duelist (team 2, different from the out event's team... the
+    # HOME team concedes the restart) wins a synthetic headed pass
+    passes = actions[actions['type_id'] == spadl.actiontypes.index('pass')]
+    assert len(passes) == 1
+    assert passes.iloc[0]['result_id'] == spadl.FAIL
+    assert passes.iloc[0]['bodypart_id'] == spadl.bodyparts.index('head')
