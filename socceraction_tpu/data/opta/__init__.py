@@ -1,0 +1,43 @@
+"""Opta event data provider.
+
+Parity: reference ``socceraction/data/opta/__init__.py``.
+"""
+
+from .loader import OptaLoader, eventtypes_df
+from .parsers import (
+    F1JSONParser,
+    F7XMLParser,
+    F9JSONParser,
+    F24JSONParser,
+    F24XMLParser,
+    MA1JSONParser,
+    MA3JSONParser,
+    OptaParser,
+    WhoScoredParser,
+)
+from .schema import (
+    OptaCompetitionSchema,
+    OptaEventSchema,
+    OptaGameSchema,
+    OptaPlayerSchema,
+    OptaTeamSchema,
+)
+
+__all__ = [
+    'OptaLoader',
+    'eventtypes_df',
+    'OptaParser',
+    'F1JSONParser',
+    'F7XMLParser',
+    'F9JSONParser',
+    'F24JSONParser',
+    'F24XMLParser',
+    'MA1JSONParser',
+    'MA3JSONParser',
+    'WhoScoredParser',
+    'OptaCompetitionSchema',
+    'OptaGameSchema',
+    'OptaPlayerSchema',
+    'OptaTeamSchema',
+    'OptaEventSchema',
+]

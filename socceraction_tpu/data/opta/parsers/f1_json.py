@@ -1,0 +1,69 @@
+"""Parser for Opta F1 (fixtures) JSON feeds.
+
+Parity: reference ``socceraction/data/opta/parsers/f1_json.py:9-102``.
+The F1 feed lists a competition-season's fixtures.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, Tuple
+
+from ...base import MissingDataError
+from .base import OptaJSONParser, assertget
+
+
+class F1JSONParser(OptaJSONParser):
+    """Extract competition and fixture data from an Opta F1 JSON feed."""
+
+    def _get_doc(self) -> Dict[str, Any]:
+        for node in self.root:
+            if 'OptaFeed' in node['data'].keys():
+                data = assertget(node, 'data')
+                feed = assertget(data, 'OptaFeed')
+                return assertget(feed, 'OptaDocument')
+        raise MissingDataError
+
+    def extract_competitions(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
+        """Return ``{(competition_id, season_id): info}``."""
+        doc = self._get_doc()
+        attr = assertget(doc, '@attributes')
+        competition_id = int(assertget(attr, 'competition_id'))
+        season_id = int(assertget(attr, 'season_id'))
+        return {
+            (competition_id, season_id): dict(
+                season_id=season_id,
+                season_name=str(assertget(attr, 'season_id')),
+                competition_id=competition_id,
+                competition_name=assertget(attr, 'competition_name'),
+            )
+        }
+
+    def extract_games(self) -> Dict[int, Dict[str, Any]]:
+        """Return ``{game_id: info}`` for every fixture in the feed."""
+        doc = self._get_doc()
+        attr = assertget(doc, '@attributes')
+        competition_id = int(assertget(attr, 'competition_id'))
+        season_id = int(assertget(attr, 'season_id'))
+        games = {}
+        for match in assertget(doc, 'MatchData'):
+            match_attr = assertget(match, '@attributes')
+            info = assertget(match, 'MatchInfo')
+            info_attr = assertget(info, '@attributes')
+            game_id = int(assertget(match_attr, 'uID')[1:])
+            record: Dict[str, Any] = dict(
+                game_id=game_id,
+                competition_id=competition_id,
+                season_id=season_id,
+                game_day=int(assertget(info_attr, 'MatchDay')),
+                game_date=datetime.strptime(
+                    assertget(info, 'Date'), '%Y-%m-%d %H:%M:%S'
+                ),
+            )
+            for team in assertget(match, 'TeamData'):
+                team_attr = assertget(team, '@attributes')
+                prefix = 'home' if assertget(team_attr, 'Side') == 'Home' else 'away'
+                record[f'{prefix}_team_id'] = int(assertget(team_attr, 'TeamRef')[1:])
+                record[f'{prefix}_score'] = int(assertget(team_attr, 'Score'))
+            games[game_id] = record
+        return games

@@ -1,0 +1,83 @@
+"""Parser for Opta F24 (match events) JSON feeds.
+
+Parity: reference ``socceraction/data/opta/parsers/f24_json.py:9-122``.
+The F24 feed holds one game's full event stream with qualifiers.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, Tuple
+
+from ...base import MissingDataError
+from .base import OptaJSONParser, _get_end_x, _get_end_y, assertget
+
+
+class F24JSONParser(OptaJSONParser):
+    """Extract game and event data from an Opta F24 JSON feed."""
+
+    def _get_game(self) -> Dict[str, Any]:
+        for node in self.root:
+            if 'Games' in node['data'].keys():
+                data = assertget(node, 'data')
+                games = assertget(data, 'Games')
+                return assertget(games, 'Game')
+        raise MissingDataError
+
+    def extract_games(self) -> Dict[int, Dict[str, Any]]:
+        """Return ``{game_id: info}``."""
+        game = self._get_game()
+        attr = assertget(game, '@attributes')
+        game_id = int(assertget(attr, 'id'))
+        return {
+            game_id: dict(
+                game_id=game_id,
+                season_id=int(assertget(attr, 'season_id')),
+                competition_id=int(assertget(attr, 'competition_id')),
+                game_day=int(assertget(attr, 'matchday')),
+                game_date=datetime.strptime(
+                    assertget(assertget(attr, 'game_date'), 'locale'),
+                    '%Y-%m-%dT%H:%M:%S.%fZ',
+                ).replace(tzinfo=None),
+                home_team_id=int(assertget(attr, 'home_team_id')),
+                away_team_id=int(assertget(attr, 'away_team_id')),
+            )
+        }
+
+    def extract_events(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
+        """Return ``{(game_id, event_id): info}``."""
+        game = self._get_game()
+        game_attr = assertget(game, '@attributes')
+        game_id = int(assertget(game_attr, 'id'))
+        events = {}
+        for element in assertget(game, 'Event'):
+            attr = element['@attributes']
+            ts_raw = attr['TimeStamp'].get('locale') if attr.get('TimeStamp') else None
+            timestamp = datetime.strptime(ts_raw, '%Y-%m-%dT%H:%M:%S.%fZ')
+            qualifiers = {
+                int(q['@attributes']['qualifier_id']): q['@attributes']['value']
+                for q in element.get('Q', [])
+            }
+            start_x = float(assertget(attr, 'x'))
+            start_y = float(assertget(attr, 'y'))
+            event_id = int(assertget(attr, 'id'))
+            events[(game_id, event_id)] = dict(
+                game_id=game_id,
+                event_id=event_id,
+                period_id=int(assertget(attr, 'period_id')),
+                team_id=int(assertget(attr, 'team_id')),
+                player_id=int(assertget(attr, 'player_id')),
+                type_id=int(assertget(attr, 'type_id')),
+                timestamp=timestamp,
+                minute=int(assertget(attr, 'min')),
+                second=int(assertget(attr, 'sec')),
+                outcome=bool(int(attr.get('outcome', 1))),
+                start_x=start_x,
+                start_y=start_y,
+                end_x=_get_end_x(qualifiers) or start_x,
+                end_y=_get_end_y(qualifiers) or start_y,
+                qualifiers=qualifiers,
+                assist=bool(int(attr.get('assist', 0))),
+                keypass=bool(int(attr.get('keypass', 0))),
+            )
+        return events

@@ -1,0 +1,73 @@
+"""Parser for Opta F24 (match events) XML feeds.
+
+Parity: reference ``socceraction/data/opta/parsers/f24_xml.py:10-105``.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, Tuple
+
+from .base import OptaXMLParser, _get_end_x, _get_end_y, assertget
+
+
+class F24XMLParser(OptaXMLParser):
+    """Extract game and event data from an Opta F24 XML feed."""
+
+    def extract_games(self) -> Dict[int, Dict[str, Any]]:
+        """Return ``{game_id: info}``."""
+        game = self.root.find('Game')
+        attr = game.attrib
+        game_id = int(assertget(attr, 'id'))
+        return {
+            game_id: dict(
+                game_id=game_id,
+                season_id=int(assertget(attr, 'season_id')),
+                competition_id=int(assertget(attr, 'competition_id')),
+                game_day=int(assertget(attr, 'matchday')),
+                game_date=datetime.strptime(
+                    assertget(attr, 'game_date'), '%Y-%m-%dT%H:%M:%S'
+                ),
+                home_team_id=int(assertget(attr, 'home_team_id')),
+                away_team_id=int(assertget(attr, 'away_team_id')),
+                home_score=int(assertget(attr, 'home_score')),
+                away_score=int(assertget(attr, 'away_score')),
+            )
+        }
+
+    def extract_events(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
+        """Return ``{(game_id, event_id): info}``."""
+        game = self.root.find('Game')
+        game_id = int(assertget(game.attrib, 'id'))
+        events = {}
+        for element in game.iterchildren('Event'):
+            attr = dict(element.attrib)
+            event_id = int(assertget(attr, 'id'))
+            qualifiers = {
+                int(q.attrib['qualifier_id']): q.attrib.get('value')
+                for q in element.iterchildren('Q')
+            }
+            start_x = float(assertget(attr, 'x'))
+            start_y = float(assertget(attr, 'y'))
+            events[(game_id, event_id)] = dict(
+                game_id=game_id,
+                event_id=event_id,
+                period_id=int(assertget(attr, 'period_id')),
+                team_id=int(assertget(attr, 'team_id')),
+                player_id=int(attr['player_id']) if 'player_id' in attr else None,
+                type_id=int(assertget(attr, 'type_id')),
+                timestamp=datetime.strptime(
+                    assertget(attr, 'timestamp'), '%Y-%m-%dT%H:%M:%S.%f'
+                ),
+                minute=int(assertget(attr, 'min')),
+                second=int(assertget(attr, 'sec')),
+                outcome=bool(int(attr['outcome'])) if 'outcome' in attr else None,
+                start_x=start_x,
+                start_y=start_y,
+                end_x=_get_end_x(qualifiers) or start_x,
+                end_y=_get_end_y(qualifiers) or start_y,
+                qualifiers=qualifiers,
+                assist=bool(int(attr.get('assist', 0))),
+                keypass=bool(int(attr.get('keypass', 0))),
+            )
+        return events

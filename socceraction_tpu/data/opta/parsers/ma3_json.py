@@ -1,0 +1,262 @@
+"""Parser for Stats Perform MA3 (match events) JSON feeds.
+
+Parity: reference ``socceraction/data/opta/parsers/ma3_json.py:11-364``.
+MA3 feeds carry one game's event stream; lineups are encoded as
+"team set up" events (type 34) whose qualifiers hold parallel id lists.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from ...base import MissingDataError
+from .base import (
+    OptaJSONParser,
+    _get_end_x,
+    _get_end_y,
+    _team_on_side,
+    assertget,
+)
+
+_POSITIONS = {
+    1: 'Goalkeeper',
+    2: 'Defender',
+    3: 'Midfielder',
+    4: 'Forward',
+    5: 'Substitute',
+}
+
+
+class MA3JSONParser(OptaJSONParser):
+    """Extract game, team, player and event data from an MA3 JSON feed."""
+
+    def _match_info(self) -> Dict[str, Any]:
+        if 'matchInfo' in self.root:
+            return self.root['matchInfo']
+        raise MissingDataError
+
+    def _live_data(self) -> Dict[str, Any]:
+        if 'liveData' in self.root:
+            return self.root['liveData']
+        raise MissingDataError
+
+    @staticmethod
+    def _parse_timestamp(raw: str) -> datetime:
+        try:
+            return datetime.strptime(raw, '%Y-%m-%dT%H:%M:%S.%fZ')
+        except ValueError:
+            return datetime.strptime(raw, '%Y-%m-%dT%H:%M:%SZ')
+
+    def extract_competitions(self) -> Dict[Tuple[str, str], Dict[str, Any]]:
+        """Return ``{(competition_id, season_id): info}``."""
+        info = self._match_info()
+        season = assertget(info, 'tournamentCalendar')
+        competition = assertget(info, 'competition')
+        key = (assertget(competition, 'id'), assertget(season, 'id'))
+        return {
+            key: dict(
+                season_id=key[1],
+                season_name=assertget(season, 'name'),
+                competition_id=key[0],
+                competition_name=assertget(competition, 'name'),
+            )
+        }
+
+    def extract_games(self) -> Dict[str, Dict[str, Any]]:
+        """Return ``{game_id: info}``."""
+        info = self._match_info()
+        live = self._live_data()
+        game_id = assertget(info, 'id')
+        contestants = assertget(info, 'contestant')
+        details = assertget(live, 'matchDetails')
+        score_total = assertget(assertget(details, 'scores'), 'total')
+        home_score = away_score = None
+        if isinstance(score_total, dict):
+            home_score = assertget(score_total, 'home')
+            away_score = assertget(score_total, 'away')
+        game_datetime = (
+            f"{assertget(info, 'date')[0:10]}T{assertget(info, 'time')[0:8]}"
+        )
+        return {
+            game_id: dict(
+                game_id=game_id,
+                season_id=assertget(assertget(info, 'tournamentCalendar'), 'id'),
+                competition_id=assertget(assertget(info, 'competition'), 'id'),
+                game_day=int(assertget(info, 'week')),
+                game_date=datetime.strptime(game_datetime, '%Y-%m-%dT%H:%M:%S'),
+                home_team_id=_team_on_side(contestants, 'home'),
+                away_team_id=_team_on_side(contestants, 'away'),
+                home_score=home_score,
+                away_score=away_score,
+                duration=assertget(details, 'matchLengthMin'),
+                venue=assertget(assertget(info, 'venue'), 'shortName'),
+            )
+        }
+
+    def extract_teams(self) -> Dict[str, Dict[str, Any]]:
+        """Return ``{team_id: info}``."""
+        info = self._match_info()
+        teams = {}
+        for contestant in assertget(info, 'contestant'):
+            team_id = assertget(contestant, 'id')
+            teams[team_id] = dict(
+                team_id=team_id,
+                team_name=assertget(contestant, 'name'),
+            )
+        return teams
+
+    def extract_players(self) -> Dict[Tuple[str, str], Dict[str, Any]]:
+        """Return ``{(game_id, player_id): info}`` (players with minutes > 0).
+
+        Lineups come from the type-34 "team set up" events: qualifier 30
+        lists player ids, 44 starting positions, 131 formation slots and 59
+        jersey numbers, all as comma-separated parallel lists.
+        """
+        info = self._match_info()
+        game_id = assertget(info, 'id')
+        live = self._live_data()
+        events = assertget(live, 'event')
+
+        duration = self._extract_duration()
+        names: Dict[str, str] = {}
+        columns: Dict[str, List[Any]] = {
+            'starting_position_id': [],
+            'player_id': [],
+            'team_id': [],
+            'position_in_formation': [],
+            'jersey_number': [],
+        }
+        sent_off: Dict[str, int] = {}
+        for event in events:
+            type_id = assertget(event, 'typeId')
+            if type_id == 34:
+                team_id = assertget(event, 'contestantId')
+                for q in assertget(event, 'qualifier'):
+                    qualifier_id = assertget(q, 'qualifierId')
+                    values = assertget(q, 'value').split(', ')
+                    if qualifier_id == 30:
+                        columns['player_id'] += values
+                        columns['team_id'] += [team_id] * len(values)
+                    elif qualifier_id == 44:
+                        columns['starting_position_id'] += [int(v) for v in values]
+                    elif qualifier_id == 131:
+                        columns['position_in_formation'] += [int(v) for v in values]
+                    elif qualifier_id == 59:
+                        columns['jersey_number'] += [int(v) for v in values]
+            elif type_id == 17 and 'playerId' in event:
+                for q in assertget(event, 'qualifier'):
+                    if assertget(q, 'qualifierId') in (32, 33):
+                        sent_off[event['playerId']] = event['timeMin']
+            player_id = event.get('playerId')
+            if player_id is not None and player_id not in names:
+                names[player_id] = assertget(event, 'playerName')
+
+        roster = pd.DataFrame.from_dict(columns)
+
+        subs = pd.DataFrame(
+            list(self.extract_substitutions().values()),
+            columns=['player_id', 'team_id', 'minute_start', 'minute_end'],
+        )
+        subs = subs.groupby(['player_id', 'team_id']).max().reset_index()
+        subs['minute_start'] = subs['minute_start'].fillna(0)
+        subs['minute_end'] = subs['minute_end'].fillna(duration)
+        if subs.empty:
+            roster['minute_start'] = 0
+            roster['minute_end'] = duration
+        else:
+            roster = roster.merge(subs, on=['team_id', 'player_id'], how='left')
+        roster['minute_end'] = roster.apply(
+            lambda row: sent_off.get(row['player_id'], row['minute_end']), axis=1
+        )
+        roster['is_starter'] = roster['position_in_formation'] > 0
+        starter_rows = roster['is_starter']
+        roster.loc[starter_rows & roster['minute_start'].isnull(), 'minute_start'] = 0
+        roster.loc[starter_rows & roster['minute_end'].isnull(), 'minute_end'] = duration
+        roster['minutes_played'] = (
+            (roster['minute_end'] - roster['minute_start']).fillna(0).astype(int)
+        )
+
+        players = {}
+        for _, row in roster.iterrows():
+            if row.minutes_played > 0:
+                players[(game_id, row.player_id)] = dict(
+                    game_id=game_id,
+                    team_id=row.team_id,
+                    player_id=row.player_id,
+                    player_name=names[row.player_id],
+                    is_starter=row.is_starter,
+                    minutes_played=row.minutes_played,
+                    jersey_number=row.jersey_number,
+                    starting_position=_POSITIONS.get(
+                        row.starting_position_id, 'Unknown'
+                    ),
+                )
+        return players
+
+    def extract_events(self) -> Dict[Tuple[str, int], Dict[str, Any]]:
+        """Return ``{(game_id, event_id): info}``."""
+        info = self._match_info()
+        live = self._live_data()
+        game_id = assertget(info, 'id')
+        events = {}
+        for element in assertget(live, 'event'):
+            timestamp = self._parse_timestamp(assertget(element, 'timeStamp'))
+            qualifiers = {
+                int(q['qualifierId']): q.get('value')
+                for q in element.get('qualifier', [])
+            }
+            start_x = float(assertget(element, 'x'))
+            start_y = float(assertget(element, 'y'))
+            event_id = int(assertget(element, 'id'))
+            events[(game_id, event_id)] = dict(
+                game_id=game_id,
+                event_id=event_id,
+                period_id=int(assertget(element, 'periodId')),
+                team_id=assertget(element, 'contestantId'),
+                player_id=element.get('playerId'),
+                type_id=int(assertget(element, 'typeId')),
+                timestamp=timestamp,
+                minute=int(assertget(element, 'timeMin')),
+                second=int(assertget(element, 'timeSec')),
+                outcome=bool(int(element.get('outcome', 1))),
+                start_x=start_x,
+                start_y=start_y,
+                end_x=_get_end_x(qualifiers) or start_x,
+                end_y=_get_end_y(qualifiers) or start_y,
+                qualifiers=qualifiers,
+                assist=bool(int(element.get('assist', 0))),
+                keypass=bool(int(element.get('keyPass', 0))),
+            )
+        return events
+
+    def extract_substitutions(self) -> Dict[Any, Dict[str, Any]]:
+        """Return per-player substitution windows from type 18/19 events."""
+        live = self._live_data()
+        subs: Dict[Any, Dict[str, Any]] = {}
+        for e in assertget(live, 'event'):
+            type_id = assertget(e, 'typeId')
+            if type_id in (18, 19):
+                sub_id = assertget(e, 'playerId')
+                record = {
+                    'player_id': sub_id,
+                    'team_id': assertget(e, 'contestantId'),
+                }
+                if type_id == 18:
+                    record['minute_end'] = assertget(e, 'timeMin')
+                else:
+                    record['minute_start'] = assertget(e, 'timeMin')
+                subs[sub_id] = record
+        return subs
+
+    def _extract_duration(self) -> int:
+        live = self._live_data()
+        duration = 90
+        for event in assertget(live, 'event'):
+            if assertget(event, 'typeId') == 30:
+                for q in assertget(event, 'qualifier'):
+                    if assertget(q, 'qualifierId') == 209:
+                        duration = max(duration, assertget(event, 'timeMin'))
+        return duration

@@ -18,10 +18,12 @@ from .schema import SPADLSchema
 from .utils import add_names, play_left_to_right
 from . import statsbomb  # noqa: F401  (provider converters)
 from . import wyscout  # noqa: F401
+from . import opta  # noqa: F401
 
 __all__ = [
     'statsbomb',
     'wyscout',
+    'opta',
     'actiontypes',
     'actiontypes_df',
     'bodyparts',

@@ -1,0 +1,226 @@
+"""Opta loader tests across all four parser families.
+
+Mirrors reference ``tests/data/test_load_opta.py`` and the per-parser unit
+tests (``tests/data/opta/parsers/``) on the synthetic feeds: the same game
+(501, Home FC 100 vs Away FC 200, 2-1) is encoded in XML (F7+F24), JSON
+(F1+F9+F24), Stats Perform (MA1+MA3) and WhoScored layouts.
+"""
+
+import os
+from datetime import datetime
+
+import pytest
+
+from socceraction_tpu.data.opta import (
+    OptaCompetitionSchema,
+    OptaEventSchema,
+    OptaGameSchema,
+    OptaLoader,
+    OptaPlayerSchema,
+    OptaTeamSchema,
+)
+
+DATASETS = os.path.join(os.path.dirname(__file__), os.pardir, 'datasets')
+GAME = 501
+
+
+@pytest.fixture(scope='module')
+def xml_loader() -> OptaLoader:
+    return OptaLoader(
+        root=os.path.join(DATASETS, 'opta'),
+        parser='xml',
+        feeds={
+            'f7': 'f7-{competition_id}-{season_id}-{game_id}.xml',
+            'f24': 'f24-{competition_id}-{season_id}-{game_id}.xml',
+        },
+    )
+
+
+@pytest.fixture(scope='module')
+def json_loader() -> OptaLoader:
+    return OptaLoader(
+        root=os.path.join(DATASETS, 'opta'),
+        parser='json',
+        feeds={
+            'f1': 'tournament-{season_id}-{competition_id}.json',
+            'f9': 'f7-{competition_id}-{season_id}-{game_id}.json',
+            'f24': 'f7-{competition_id}-{season_id}-{game_id}.json',
+        },
+    )
+
+
+@pytest.fixture(scope='module')
+def sp_loader() -> OptaLoader:
+    return OptaLoader(root=os.path.join(DATASETS, 'statsperform'), parser='statsperform')
+
+
+@pytest.fixture(scope='module')
+def ws_loader() -> OptaLoader:
+    return OptaLoader(root=os.path.join(DATASETS, 'whoscored'), parser='whoscored')
+
+
+def test_invalid_parser():
+    with pytest.raises(ValueError):
+        OptaLoader(root='.', parser='nope')
+
+
+def test_unknown_feed_warns():
+    with pytest.warns(UserWarning):
+        OptaLoader(root='.', parser='xml', feeds={'f42': 'f42-{game_id}.xml'})
+
+
+class TestXMLLoader:
+    def test_competitions(self, xml_loader):
+        df = xml_loader.competitions()
+        OptaCompetitionSchema.validate(df)
+        assert len(df) == 1
+        assert df.iloc[0]['competition_id'] == 8
+        assert df.iloc[0]['season_id'] == 2017
+        assert df.iloc[0]['competition_name'] == 'Test Premier League'
+
+    def test_games(self, xml_loader):
+        df = xml_loader.games(8, 2017)
+        OptaGameSchema.validate(df)
+        assert len(df) == 1
+        g = df.iloc[0]
+        assert g['game_id'] == GAME
+        assert g['home_team_id'] == 100 and g['away_team_id'] == 200
+        assert g['home_score'] == 2 and g['away_score'] == 1
+        assert g['venue'] == 'Test Arena'
+        assert g['referee'] == 'Ref Eree'
+        assert g['duration'] == 95
+
+    def test_teams(self, xml_loader):
+        df = xml_loader.teams(GAME)
+        OptaTeamSchema.validate(df)
+        assert set(df['team_id']) == {100, 200}
+        assert set(df['team_name']) == {'Home FC', 'Away FC'}
+
+    def test_players_minutes(self, xml_loader):
+        df = xml_loader.players(GAME)
+        OptaPlayerSchema.validate(df)
+        players = df.set_index('player_id')
+        assert len(df) == 6
+        assert players.at[1, 'minutes_played'] == 95    # full game
+        assert players.at[11, 'minutes_played'] == 70   # subbed off
+        assert players.at[13, 'minutes_played'] == 25   # subbed on
+        assert players.at[12, 'minutes_played'] == 85   # sent off
+        assert bool(players.at[1, 'is_starter'])
+        assert not bool(players.at[13, 'is_starter'])
+
+    def test_events(self, xml_loader):
+        df = xml_loader.events(GAME)
+        OptaEventSchema.validate(df)
+        assert len(df) == 13
+        assert (df['game_id'] == GAME).all()
+        # type names are joined from the event-type table
+        goals = df[df['type_name'] == 'goal']
+        assert len(goals) == 2
+        # qualifier 140/141 produce the pass end location
+        p = df[df['event_id'] == 1003].iloc[0]
+        assert p['end_x'] == 62.0 and p['end_y'] == 55.0
+        # qualifier 102 produces the goal-mouth end location
+        g = df[df['event_id'] == 1007].iloc[0]
+        assert g['end_x'] == 100.0 and g['end_y'] == 48.0
+
+
+class TestJSONLoader:
+    def test_competitions(self, json_loader):
+        df = json_loader.competitions()
+        OptaCompetitionSchema.validate(df)
+        assert df.iloc[0]['competition_id'] == 8
+
+    def test_games(self, json_loader):
+        df = json_loader.games(8, 2017)
+        OptaGameSchema.validate(df)
+        g = df.iloc[0]
+        assert g['game_id'] == GAME
+        # the F1 and F9 views of the same game are deep-merged
+        assert g['home_team_id'] == 100
+        assert g['duration'] == 95
+
+    def test_teams(self, json_loader):
+        df = json_loader.teams(GAME)
+        OptaTeamSchema.validate(df)
+        assert set(df['team_id']) == {100, 200}
+
+    def test_players(self, json_loader):
+        df = json_loader.players(GAME)
+        OptaPlayerSchema.validate(df)
+        players = df.set_index('player_id')
+        assert players.at[11, 'minutes_played'] == 70
+        assert players.at[13, 'minutes_played'] == 25
+        assert players.at[12, 'minutes_played'] == 85
+
+    def test_events(self, json_loader):
+        df = json_loader.events(GAME)
+        OptaEventSchema.validate(df)
+        assert len(df) == 13
+
+
+class TestStatsPerformLoader:
+    def test_competitions(self, sp_loader):
+        df = sp_loader.competitions()
+        OptaCompetitionSchema.validate(df)
+        assert df.iloc[0]['competition_id'] == '8'
+        assert df.iloc[0]['season_name'] == '2017/2018'
+
+    def test_games(self, sp_loader):
+        df = sp_loader.games(8, 2017)
+        OptaGameSchema.validate(df)
+        g = df.iloc[0]
+        assert g['game_id'] == '501'
+        assert g['home_team_id'] == '100'
+        assert g['home_score'] == 2 and g['away_score'] == 1
+        assert g['game_date'] == datetime(2017, 8, 11, 19, 45)
+
+    def test_teams(self, sp_loader):
+        df = sp_loader.teams(GAME)
+        OptaTeamSchema.validate(df)
+        assert set(df['team_id']) == {'100', '200'}
+
+    def test_players(self, sp_loader):
+        df = sp_loader.players(GAME)
+        OptaPlayerSchema.validate(df)
+        players = df.set_index('player_id')
+        # MA1 lineups + substitutions/cards
+        assert players.at['pl1', 'minutes_played'] == 95
+        assert players.at['pl11', 'minutes_played'] == 70
+        assert players.at['pl13', 'minutes_played'] == 25
+        assert players.at['pl12', 'minutes_played'] == 85
+
+    def test_events(self, sp_loader):
+        df = sp_loader.events(GAME)
+        OptaEventSchema.validate(df)
+        assert len(df) > 0
+        assert (df['game_id'] == '501').all()
+
+
+class TestWhoScoredLoader:
+    def test_games(self, ws_loader):
+        df = ws_loader.games(8, 2017)
+        OptaGameSchema.validate(df)
+        g = df.iloc[0]
+        assert g['game_id'] == GAME
+        assert g['home_manager'] == 'Coach Home'
+        assert g['attendance'] == 12345
+
+    def test_teams(self, ws_loader):
+        df = ws_loader.teams(GAME)
+        OptaTeamSchema.validate(df)
+        assert set(df['team_id']) == {100, 200}
+
+    def test_players(self, ws_loader):
+        df = ws_loader.players(GAME)
+        OptaPlayerSchema.validate(df)
+        players = df.set_index('player_id')
+        assert players.at[1, 'minutes_played'] == 95
+        assert players.at[11, 'minutes_played'] == 70
+        assert players.at[13, 'minutes_played'] == 25
+        assert players.at[12, 'minutes_played'] == 85
+
+    def test_events(self, ws_loader):
+        df = ws_loader.events(GAME)
+        OptaEventSchema.validate(df)
+        # the pre-match team-setup event is absent from WhoScored scrapes
+        assert len(df) == 12

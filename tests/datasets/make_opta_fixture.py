@@ -1,0 +1,606 @@
+"""Generate synthetic Opta fixtures for loader/parser/converter tests.
+
+The reference tests run against recorded Opta feed files (reference
+``tests/datasets/opta/``); this environment ships none, so one hand-built
+game (competition 8 / season 2017 / game 501, Home FC t100 vs Away FC
+t200, 2-1) is emitted in every supported feed layout:
+
+- ``opta/f7-8-2017-501.xml``  + ``opta/f24-8-2017-501.xml``  (xml parser)
+- ``opta/tournament-2017-8.json`` (F1) and ``opta/f7-8-2017-501.json``
+  (F9 node + F24 node, the combined match JSON layout)
+- ``statsperform/ma1-8-2017.json`` + ``statsperform/ma3-8-2017-501.json``
+- ``whoscored/8-2017-501.json``
+
+Run: ``python tests/datasets/make_opta_fixture.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from datetime import datetime, timedelta
+
+
+def _clock(mn: int, sc: int) -> str:
+    """Wall-clock timestamp for a game-clock minute/second."""
+    t = datetime(2017, 8, 11, 19, 45) + timedelta(minutes=mn, seconds=sc)
+    return t.strftime('%Y-%m-%dT%H:%M:%S')
+
+HERE = os.path.dirname(__file__)
+OPTA_ROOT = os.path.join(HERE, 'opta')
+SP_ROOT = os.path.join(HERE, 'statsperform')
+WS_ROOT = os.path.join(HERE, 'whoscored')
+
+GAME, COMP, SEASON = 501, 8, 2017
+HOME, AWAY = 100, 200
+
+# (event_id, type_id, period, minute, second, team, player, outcome, x, y, quals)
+# Covers the converter paths: pass, crossed corner, take-on, foul, shot →
+# goal, keeper save, clearance, bad touch, interception and an own goal.
+EVENTS = [
+    (1001, 34, 16, 0, 0, HOME, None, 1, 0.0, 0.0, {}),        # team set up
+    (1002, 32, 1, 0, 0, HOME, 1, 1, 0.0, 0.0, {}),            # start
+    (1003, 1, 1, 0, 14, HOME, 2, 1, 50.0, 50.0, {140: '62.0', 141: '55.0'}),
+    (1004, 1, 1, 2, 5, HOME, 2, 0, 95.0, 1.0, {2: None, 6: None, 140: '90.0', 141: '48.0'}),
+    (1005, 3, 1, 10, 30, AWAY, 11, 1, 40.0, 60.0, {}),        # take on
+    (1006, 4, 1, 15, 2, AWAY, 12, 0, 55.0, 30.0, {}),         # foul
+    (1007, 16, 1, 30, 45, HOME, 3, 1, 88.0, 52.0, {102: '48.0'}),  # goal
+    (1008, 10, 2, 50, 10, AWAY, 11, 1, 5.0, 45.0, {}),        # save
+    (1009, 12, 2, 60, 0, HOME, 2, 1, 10.0, 20.0, {}),         # clearance
+    (1010, 61, 2, 70, 30, AWAY, 12, 0, 48.0, 52.0, {}),       # ball touch
+    (1011, 8, 2, 80, 5, HOME, 3, 1, 30.0, 40.0, {}),          # interception
+    (1012, 16, 2, 88, 0, AWAY, 12, 1, 3.0, 50.0, {28: None, 102: '50.0'}),  # own goal
+    (1013, 30, 2, 95, 0, HOME, None, 1, 0.0, 0.0, {209: '1'}),  # end
+]
+
+HOME_PLAYERS = [(1, 'Gus', 'Glover', 'Goalkeeper', 1), (2, 'Dee', 'Fender', 'Defender', 4),
+                (3, 'Stan', 'Striker', 'Striker', 9)]
+AWAY_PLAYERS = [(11, 'Al', 'Winger', 'Midfielder', 7), (12, 'Bo', 'Backer', 'Defender', 5),
+                (13, 'Sub', 'Stute', 'Substitute', 14)]
+
+
+def _write(path: str, content: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as fh:
+        fh.write(content)
+
+
+def _dump(path: str, obj: object) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as fh:
+        json.dump(obj, fh)
+
+
+# --------------------------------------------------------------------------
+# XML feeds
+# --------------------------------------------------------------------------
+
+def _f24_xml() -> str:
+    rows = []
+    for eid, tid, per, mn, sc, team, player, out, x, y, quals in EVENTS:
+        player_attr = f' player_id="{player}"' if player is not None else ''
+        qs = ''.join(
+            f'<Q id="{9000 + qid}" qualifier_id="{qid}"'
+            + (f' value="{val}"' if val is not None else '')
+            + ' />'
+            for qid, val in quals.items()
+        )
+        rows.append(
+            f'<Event id="{eid}" event_id="{eid - 1000}" type_id="{tid}" '
+            f'period_id="{per}" min="{mn}" sec="{sc}" team_id="{team}"'
+            f'{player_attr} outcome="{out}" x="{x}" y="{y}" '
+            f'timestamp="{_clock(mn, sc)}.000" '
+            f'last_modified="2017-08-11T22:00:00">{qs}</Event>'
+        )
+    events = '\n    '.join(rows)
+    return f'''<?xml version="1.0" encoding="UTF-8"?>
+<Games timestamp="2017-08-12T10:00:00">
+  <Game id="{GAME}" away_score="1" away_team_id="{AWAY}" away_team_name="Away FC"
+        competition_id="{COMP}" competition_name="Test Premier League"
+        game_date="2017-08-11T19:45:00" home_score="2" home_team_id="{HOME}"
+        home_team_name="Home FC" matchday="1" season_id="{SEASON}"
+        season_name="Season 2017/2018">
+    {events}
+  </Game>
+</Games>
+'''
+
+
+def _f7_team_xml(team_id: int, side: str, score: int, players: list) -> str:
+    match_players = ''.join(
+        f'<MatchPlayer Formation_Place="{0 if pos == "Substitute" else i + 1}" '
+        f'PlayerRef="p{pid}" Position="{pos}" ShirtNumber="{shirt}" '
+        f'Status="{"Sub" if pos == "Substitute" else "Start"}">'
+        f'<Stat Type="mins_played">90</Stat></MatchPlayer>'
+        for i, (pid, _, _, pos, shirt) in enumerate(players)
+    )
+    substitution = ''
+    booking = ''
+    if side == 'Away':
+        # 13 on for 11 at 70'; 12 sent off at 85'
+        substitution = (
+            '<Substitution Period="SecondHalf" Reason="Tactical" '
+            'SubOff="p11" SubOn="p13" Time="70" uID="s1" />'
+        )
+        booking = (
+            '<Booking Card="Red" CardType="Red" Min="85" Period="SecondHalf" '
+            'PlayerRef="p12" Reason="Foul" Time="85" uID="b1" />'
+        )
+    return (
+        f'<TeamData Formation="433" Score="{score}" Side="{side}" TeamRef="t{team_id}">'
+        f'{booking}{substitution}'
+        f'<PlayerLineUp>{match_players}</PlayerLineUp>'
+        f'<Stat Type="goals_conceded">{1 if side == "Home" else 2}</Stat>'
+        f'</TeamData>'
+    )
+
+
+def _f7_xml() -> str:
+    teams = ''
+    for team_id, name, players in (
+        (HOME, 'Home FC', HOME_PLAYERS),
+        (AWAY, 'Away FC', AWAY_PLAYERS),
+    ):
+        entries = ''.join(
+            f'<Player loan="0" uID="p{pid}"><PersonName>'
+            f'<First>{first}</First><Last>{last}</Last></PersonName></Player>'
+            for pid, first, last, _, _ in players
+        )
+        teams += (
+            f'<Team uID="t{team_id}"><Name>{name}</Name>{entries}'
+            f'<TeamOfficial Type="Manager" uID="o{team_id}"><PersonName>'
+            f'<First>Coach</First><Last>Of{name.split()[0]}</Last>'
+            f'</PersonName></TeamOfficial></Team>'
+        )
+    return f'''<?xml version="1.0" encoding="UTF-8"?>
+<SoccerFeed TimeStamp="20170812T100000+0000">
+  <SoccerDocument Type="Result" detail_id="1" uID="f{GAME}">
+    <Competition uID="c{COMP}">
+      <Country>Testland</Country>
+      <Name>Test Premier League</Name>
+      <Stat Type="season_id">{SEASON}</Stat>
+      <Stat Type="season_name">Season 2017/2018</Stat>
+      <Stat Type="matchday">1</Stat>
+    </Competition>
+    <MatchData>
+      <MatchInfo MatchType="Regular" Period="FullTime">
+        <Attendance>12345</Attendance>
+        <Date>20170811T194500+0100</Date>
+        <Result Type="NormalResult" Winner="t{HOME}" />
+      </MatchInfo>
+      <MatchOfficial uID="o1">
+        <OfficialName>
+          <First>Ref</First>
+          <Last>Eree</Last>
+        </OfficialName>
+      </MatchOfficial>
+      <Stat Type="match_time">95</Stat>
+      {_f7_team_xml(HOME, 'Home', 2, HOME_PLAYERS)}
+      {_f7_team_xml(AWAY, 'Away', 1, AWAY_PLAYERS)}
+    </MatchData>
+    {teams}
+    <Venue uID="v1">
+      <Name>Test Arena</Name>
+    </Venue>
+  </SoccerDocument>
+</SoccerFeed>
+'''
+
+
+# --------------------------------------------------------------------------
+# JSON feeds (F1 / F9 / F24)
+# --------------------------------------------------------------------------
+
+def _stat(type_name: str, value) -> dict:
+    return {'@attributes': {'Type': type_name}, '@value': value}
+
+
+def _f1_json() -> list:
+    doc = {
+        '@attributes': {
+            'competition_id': str(COMP),
+            'season_id': str(SEASON),
+            'competition_name': 'Test Premier League',
+        },
+        'MatchData': [
+            {
+                '@attributes': {'uID': f'g{GAME}'},
+                'MatchInfo': {
+                    '@attributes': {'MatchDay': str(1)},
+                    'Date': '2017-08-11 19:45:00',
+                },
+                'TeamData': [
+                    {'@attributes': {'Side': 'Home', 'TeamRef': f't{HOME}', 'Score': '2'}},
+                    {'@attributes': {'Side': 'Away', 'TeamRef': f't{AWAY}', 'Score': '1'}},
+                ],
+            }
+        ],
+    }
+    return [{'url': 'f1', 'data': {'OptaFeed': {'OptaDocument': doc}}}]
+
+
+def _f9_teamdata(team_id: int, side: str, score: int, players: list) -> dict:
+    lineup = []
+    for i, (pid, _, _, pos, shirt) in enumerate(players):
+        lineup.append(
+            {
+                '@attributes': {
+                    'PlayerRef': f'p{pid}',
+                    'ShirtNumber': shirt,
+                    'Position': pos,
+                    'position_id': 1 if pos == 'Goalkeeper' else 2,
+                    'Status': 'Sub' if pos == 'Substitute' else 'Start',
+                },
+                'Stat': [_stat('mins_played', 90)],
+            }
+        )
+    subs = []
+    bookings = []
+    if side == 'Away':
+        subs = [{'@attributes': {'Time': 70, 'SubOff': 'p11', 'SubOn': 'p13',
+                                 'Period': 'SecondHalf', 'Reason': 'Tactical'}}]
+        bookings = [{'@attributes': {'CardType': 'Red', 'PlayerRef': 'p12', 'Time': 85,
+                                     'Period': 'SecondHalf', 'Min': 85}}]
+    return {
+        '@attributes': {'TeamRef': f't{team_id}', 'Side': side, 'Score': score,
+                        'ShootOutScore': None},
+        'Stat': [_stat('goals_conceded', 1 if side == 'Home' else 2)],
+        'Substitution': subs,
+        'Booking': bookings,
+        'PlayerLineUp': {'MatchPlayer': lineup},
+        'TeamOfficial': {'@attributes': {'Type': 'Manager'},
+                         'PersonName': {'First': 'Coach', 'Last': side}},
+    }
+
+
+def _f9_team(team_id: int, name: str, players: list) -> dict:
+    return {
+        '@attributes': {'uID': f't{team_id}'},
+        'id': team_id,
+        'nameObj': {'name': name, 'short': name},
+        'Name': name,
+        'Player': [
+            {
+                '@attributes': {'uID': f'p{pid}'},
+                'PersonName': {
+                    'First': first,
+                    'Last': last,
+                    'nameObj': {'first': first, 'last': last, 'known': ''},
+                },
+            }
+            for pid, first, last, _, _ in players
+        ],
+    }
+
+
+def _f24_json_events() -> list:
+    out = []
+    for eid, tid, per, mn, sc, team, player, outc, x, y, quals in EVENTS:
+        attr = {
+            'id': eid,
+            'event_id': eid - 1000,
+            'type_id': str(tid),
+            'period_id': str(per),
+            'min': mn,
+            'sec': sc,
+            'team_id': str(team),
+            'outcome': str(outc),
+            'x': x,
+            'y': y,
+            'assist': '0',
+            'keypass': '0',
+            'TimeStamp': {'locale': f'{_clock(mn, sc)}.000Z'},
+        }
+        if player is not None:
+            attr['player_id'] = str(player)
+        else:
+            attr['player_id'] = '0'
+        qs = [
+            {'@attributes': {'id': 9000 + qid, 'qualifier_id': str(qid),
+                             'value': val if val is not None else '1'}}
+            for qid, val in quals.items()
+        ]
+        out.append({'@attributes': attr, 'Q': qs})
+    return out
+
+
+def _match_json() -> list:
+    f9_doc = {
+        '@attributes': {'uID': f'g{GAME}', 'Type': 'Result'},
+        'Competition': {
+            '@attributes': {'uID': f'c{COMP}'},
+            'Name': 'Test Premier League',
+            'Stat': [_stat('season_id', SEASON), _stat('matchday', 1)],
+        },
+        'MatchData': {
+            'MatchInfo': {'Date': '20170811T194500+0100', 'Attendance': '12345'},
+            'MatchOfficial': {'OfficialName': {'First': 'Ref', 'Last': 'Eree'}},
+            'Stat': _stat('match_time', 95),
+            'TeamData': [
+                _f9_teamdata(HOME, 'Home', 2, HOME_PLAYERS),
+                _f9_teamdata(AWAY, 'Away', 1, AWAY_PLAYERS),
+            ],
+        },
+        'Team': [
+            _f9_team(HOME, 'Home FC', HOME_PLAYERS),
+            _f9_team(AWAY, 'Away FC', AWAY_PLAYERS),
+        ],
+        'Venue': {'Name': 'Test Arena'},
+    }
+    f24_game = {
+        '@attributes': {
+            'id': GAME,
+            'competition_id': str(COMP),
+            'season_id': SEASON,
+            'home_team_id': HOME,
+            'away_team_id': AWAY,
+            'matchday': 1,
+            'game_date': {'locale': '2017-08-11T18:45:00.000Z'},
+        },
+        'Event': _f24_json_events(),
+    }
+    return [
+        {'url': 'f9', 'data': {'OptaFeed': {'OptaDocument': [f9_doc]}}},
+        {'url': 'f24', 'data': {'Games': {'Game': f24_game}}},
+    ]
+
+
+# --------------------------------------------------------------------------
+# Stats Perform feeds (MA1 / MA3)
+# --------------------------------------------------------------------------
+
+SP_GAME = str(GAME)
+SP_HOME, SP_AWAY = str(HOME), str(AWAY)
+
+
+def _sp_match_info() -> dict:
+    return {
+        'id': SP_GAME,
+        'date': '2017-08-11Z',
+        'time': '19:45:00Z',
+        'week': '1',
+        'tournamentCalendar': {'id': str(SEASON), 'name': '2017/2018'},
+        'competition': {'id': str(COMP), 'name': 'Test Premier League'},
+        'contestant': [
+            {'id': SP_HOME, 'name': 'Home FC', 'position': 'home'},
+            {'id': SP_AWAY, 'name': 'Away FC', 'position': 'away'},
+        ],
+        'venue': {'shortName': 'Test Arena'},
+    }
+
+
+def _sp_events() -> list:
+    out = []
+    for eid, tid, per, mn, sc, team, player, outc, x, y, quals in EVENTS:
+        e = {
+            'id': eid,
+            'eventId': eid - 1000,
+            'typeId': tid,
+            'periodId': per,
+            'timeMin': mn,
+            'timeSec': sc,
+            'contestantId': str(team),
+            'outcome': outc,
+            'x': x,
+            'y': y,
+            'timeStamp': f'{_clock(mn, sc)}.000Z',
+            'qualifier': [
+                {'qualifierId': qid, 'value': val if val is not None else '1'}
+                for qid, val in quals.items()
+            ],
+        }
+        if player is not None:
+            e['playerId'] = f'pl{player}'
+            all_players = dict(
+                [(p[0], p) for p in HOME_PLAYERS] + [(p[0], p) for p in AWAY_PLAYERS]
+            )
+            _, first, last, _, _ = all_players[player]
+            e['playerName'] = f'{first} {last}'
+        out.append(e)
+    return out
+
+
+def _sp_setup_events() -> list:
+    events = []
+    for team, players in ((SP_HOME, HOME_PLAYERS), (SP_AWAY, AWAY_PLAYERS)):
+        ids = ', '.join(f'pl{p[0]}' for p in players)
+        positions = ', '.join(
+            '1' if p[3] == 'Goalkeeper' else ('5' if p[3] == 'Substitute' else '2')
+            for p in players
+        )
+        formation = ', '.join(
+            '0' if p[3] == 'Substitute' else str(i + 1) for i, p in enumerate(players)
+        )
+        shirts = ', '.join(str(p[4]) for p in players)
+        events.append(
+            {
+                'id': 900 + int(team),
+                'typeId': 34,
+                'periodId': 16,
+                'timeMin': 0,
+                'timeSec': 0,
+                'contestantId': team,
+                'outcome': 1,
+                'x': 0.0,
+                'y': 0.0,
+                'timeStamp': '2017-08-11T19:00:00.000Z',
+                'qualifier': [
+                    {'qualifierId': 30, 'value': ids},
+                    {'qualifierId': 44, 'value': positions},
+                    {'qualifierId': 131, 'value': formation},
+                    {'qualifierId': 59, 'value': shirts},
+                ],
+            }
+        )
+    # substitution on/off pair at 70' and the full-time whistle at 95'
+    events.append({'id': 980, 'typeId': 18, 'periodId': 2, 'timeMin': 70, 'timeSec': 0,
+                   'contestantId': SP_AWAY, 'playerId': 'pl11', 'playerName': 'Al Winger',
+                   'outcome': 1, 'x': 0.0, 'y': 0.0,
+                   'timeStamp': '2017-08-11T21:10:00.000Z', 'qualifier': []})
+    events.append({'id': 981, 'typeId': 19, 'periodId': 2, 'timeMin': 70, 'timeSec': 0,
+                   'contestantId': SP_AWAY, 'playerId': 'pl13', 'playerName': 'Sub Stute',
+                   'outcome': 1, 'x': 0.0, 'y': 0.0,
+                   'timeStamp': '2017-08-11T21:10:00.000Z', 'qualifier': []})
+    events.append({'id': 979, 'typeId': 17, 'periodId': 2, 'timeMin': 85, 'timeSec': 0,
+                   'contestantId': SP_AWAY, 'playerId': 'pl12', 'playerName': 'Bo Backer',
+                   'outcome': 1, 'x': 0.0, 'y': 0.0,
+                   'timeStamp': '2017-08-11T21:25:00.000Z',
+                   'qualifier': [{'qualifierId': 33, 'value': '1'}]})
+    events.append({'id': 982, 'typeId': 30, 'periodId': 2, 'timeMin': 95, 'timeSec': 0,
+                   'contestantId': SP_HOME, 'outcome': 1, 'x': 0.0, 'y': 0.0,
+                   'timeStamp': '2017-08-11T21:40:00.000Z',
+                   'qualifier': [{'qualifierId': 209, 'value': '1'}]})
+    return events
+
+
+def _ma1_json() -> dict:
+    return {
+        'matchInfo': _sp_match_info(),
+        'liveData': {
+            'matchDetails': {
+                'matchLengthMin': 95,
+                'scores': {'total': {'home': 2, 'away': 1}},
+            },
+            'matchDetailsExtra': {
+                'attendance': '12345',
+                'matchOfficial': [
+                    {'type': 'Main', 'firstName': 'Ref', 'lastName': 'Eree'}
+                ],
+            },
+            'lineUp': [
+                {
+                    'contestantId': SP_HOME,
+                    'player': [
+                        {'playerId': f'pl{pid}', 'firstName': first, 'lastName': last,
+                         'position': pos, 'shirtNumber': shirt}
+                        for pid, first, last, pos, shirt in HOME_PLAYERS
+                    ],
+                },
+                {
+                    'contestantId': SP_AWAY,
+                    'player': [
+                        {'playerId': f'pl{pid}', 'firstName': first, 'lastName': last,
+                         'position': pos, 'shirtNumber': shirt}
+                        for pid, first, last, pos, shirt in AWAY_PLAYERS
+                    ],
+                },
+            ],
+            'substitute': [
+                {'playerOnId': 'pl13', 'playerOffId': 'pl11',
+                 'contestantId': SP_AWAY, 'periodId': 2, 'timeMin': 70}
+            ],
+            'card': [
+                {'playerId': 'pl12', 'timeMin': 85, 'type': 'RC'}
+            ],
+        },
+    }
+
+
+def _ma3_json() -> dict:
+    return {
+        'matchInfo': _sp_match_info(),
+        'liveData': {
+            'matchDetails': {
+                'matchLengthMin': 95,
+                'scores': {'total': {'home': 2, 'away': 1}},
+            },
+            'event': _sp_setup_events() + _sp_events(),
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# WhoScored feed
+# --------------------------------------------------------------------------
+
+def _ws_team(team_id: int, name: str, field: str, score: int, players: list) -> dict:
+    roster = []
+    for pid, first, last, pos, shirt in players:
+        p = {
+            'playerId': pid,
+            'name': f'{first} {last}',
+            'shirtNo': shirt,
+            'position': 'GK' if pos == 'Goalkeeper' else 'DC',
+            'isFirstEleven': pos != 'Substitute',
+            'stats': {'touches': {'0': 10, '1': 12}},
+        }
+        if pid == 13:
+            p['subbedInExpandedMinute'] = 70
+        if pid == 11:
+            p['subbedOutExpandedMinute'] = 70
+        roster.append(p)
+    incidents = []
+    if field == 'away':
+        incidents = [{'playerId': 12, 'expandedMinute': 85,
+                      'cardType': {'displayName': 'Red', 'value': 33}}]
+    return {
+        'teamId': team_id,
+        'name': name,
+        'field': field,
+        'managerName': f'Coach {name.split()[0]}',
+        'scores': {'running': score, 'fulltime': score},
+        'players': roster,
+        'incidentEvents': incidents,
+        'formations': [
+            {
+                'formationName': '433',
+                'formationPositions': [{'vertical': 0.0, 'horizontal': 5.0}] * len(players),
+                'playerIds': [p[0] for p in players],
+                'startMinuteExpanded': 0,
+                'endMinuteExpanded': 95,
+            }
+        ],
+    }
+
+
+def _ws_json() -> dict:
+    ws_events = []
+    for eid, tid, per, mn, sc, team, player, outc, x, y, quals in EVENTS:
+        if per == 16:
+            continue  # pre-match setup events are not in the scrape
+        e = {
+            'id': eid,
+            'eventId': eid - 1000,
+            'type': {'value': tid, 'displayName': 'Event'},
+            'period': {'value': per, 'displayName': f'Period{per}'},
+            'minute': mn if per == 1 else mn - 45,
+            'expandedMinute': mn,
+            'second': sc,
+            'teamId': team,
+            'outcomeType': {'value': outc},
+            'x': x,
+            'y': y,
+            'isTouch': True,
+            'qualifiers': [
+                {'type': {'value': qid}, 'value': val if val is not None else True}
+                for qid, val in quals.items()
+            ],
+        }
+        if player is not None:
+            e['playerId'] = player
+        if tid == 19:
+            e['relatedPlayerId'] = 11
+        ws_events.append(e)
+    return {
+        'startTime': '2017-08-11T19:45:00',
+        'expandedMaxMinute': 95,
+        'periodMinuteLimits': {'1': 45, '2': 95},
+        'periodEndMinutes': {'1': 45, '2': 95},
+        'venueName': 'Test Arena',
+        'referee': {'name': 'Ref Eree'},
+        'attendance': 12345,
+        'home': _ws_team(HOME, 'Home FC', 'home', 2, HOME_PLAYERS),
+        'away': _ws_team(AWAY, 'Away FC', 'away', 1, AWAY_PLAYERS),
+        'events': ws_events,
+    }
+
+
+if __name__ == '__main__':
+    _write(os.path.join(OPTA_ROOT, f'f24-{COMP}-{SEASON}-{GAME}.xml'), _f24_xml())
+    _write(os.path.join(OPTA_ROOT, f'f7-{COMP}-{SEASON}-{GAME}.xml'), _f7_xml())
+    _dump(os.path.join(OPTA_ROOT, f'tournament-{SEASON}-{COMP}.json'), _f1_json())
+    _dump(os.path.join(OPTA_ROOT, f'f7-{COMP}-{SEASON}-{GAME}.json'), _match_json())
+    _dump(os.path.join(SP_ROOT, f'ma1-{COMP}-{SEASON}.json'), _ma1_json())
+    _dump(os.path.join(SP_ROOT, f'ma3-{COMP}-{SEASON}-{GAME}.json'), _ma3_json())
+    _dump(os.path.join(WS_ROOT, f'{COMP}-{SEASON}-{GAME}.json'), _ws_json())
+    print(f'wrote {OPTA_ROOT}, {SP_ROOT}, {WS_ROOT}')

@@ -1,0 +1,73 @@
+"""Opta → SPADL converter tests.
+
+Mirrors reference ``tests/spadl/test_opta.py`` on the synthetic game: the
+qualifier-driven type mapping, the own-goal flip and schema validity.
+"""
+
+import os
+
+import pytest
+
+from socceraction_tpu.data.opta import OptaLoader
+from socceraction_tpu.spadl import config as spadl
+from socceraction_tpu.spadl import opta
+from socceraction_tpu.spadl.schema import SPADLSchema
+
+DATASETS = os.path.join(os.path.dirname(__file__), os.pardir, 'datasets')
+GAME = 501
+
+
+@pytest.fixture(scope='module')
+def actions():
+    loader = OptaLoader(
+        root=os.path.join(DATASETS, 'opta'),
+        parser='xml',
+        feeds={
+            'f7': 'f7-{competition_id}-{season_id}-{game_id}.xml',
+            'f24': 'f24-{competition_id}-{season_id}-{game_id}.xml',
+        },
+    )
+    return opta.convert_to_actions(loader.events(GAME), 100)
+
+
+def test_schema_valid(actions):
+    SPADLSchema.validate(actions)
+    assert (actions['game_id'] == GAME).all()
+    assert actions['team_id'].isin([100, 200]).all()
+
+
+def test_non_actions_dropped(actions):
+    # team set up / start / end events never become actions
+    assert (actions['type_id'] != spadl.NON_ACTION).all()
+
+
+def test_qualifier_type_mapping(actions):
+    ids = actions.set_index('original_event_id')
+    # qualifiers 2 (cross) + 6 (corner) -> corner_crossed
+    assert ids.at[1004, 'type_id'] == spadl.actiontypes.index('corner_crossed')
+    assert ids.at[1005, 'type_id'] == spadl.actiontypes.index('take_on')
+    assert ids.at[1006, 'type_id'] == spadl.actiontypes.index('foul')
+    assert ids.at[1007, 'type_id'] == spadl.SHOT
+    assert ids.at[1008, 'type_id'] == spadl.actiontypes.index('keeper_save')
+    assert ids.at[1009, 'type_id'] == spadl.CLEARANCE
+    assert ids.at[1010, 'type_id'] == spadl.actiontypes.index('bad_touch')
+    assert ids.at[1011, 'type_id'] == spadl.actiontypes.index('interception')
+
+
+def test_goal_result(actions):
+    ids = actions.set_index('original_event_id')
+    assert ids.at[1007, 'result_id'] == spadl.SUCCESS
+
+
+def test_owngoal_flip(actions):
+    ids = actions.set_index('original_event_id')
+    og = ids.loc[1012]
+    # own goals become bad touches with the owngoal result
+    assert og['type_id'] == spadl.actiontypes.index('bad_touch')
+    assert og['result_id'] == spadl.OWNGOAL
+
+
+def test_period_clock(actions):
+    ids = actions.set_index('original_event_id')
+    # event 1008: minute 50 of the second half -> 5*60+10 period seconds
+    assert ids.at[1008, 'time_seconds'] == 5 * 60 + 10
