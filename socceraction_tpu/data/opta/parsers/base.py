@@ -12,8 +12,6 @@ import json
 from abc import ABC
 from typing import Any, Dict, List, Optional, Tuple
 
-from lxml import objectify
-
 __all__ = [
     'OptaParser',
     'OptaJSONParser',
@@ -71,6 +69,11 @@ class OptaXMLParser(OptaParser):
     """Parser backed by an XML feed file."""
 
     def __init__(self, path: str, **kwargs: Any) -> None:
+        # lxml is an optional dependency (the 'io' extra): only the XML
+        # feeds (F7/F24) need it, so JSON-only installs must still import
+        # this package.
+        from lxml import objectify
+
         with open(path, 'rb') as fh:
             self.root = objectify.fromstring(fh.read())
 

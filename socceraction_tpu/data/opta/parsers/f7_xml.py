@@ -6,9 +6,10 @@ Parity: reference ``socceraction/data/opta/parsers/f7_xml.py:10-245``.
 from __future__ import annotations
 
 from datetime import datetime
-from typing import Any, Dict, Tuple
+from typing import TYPE_CHECKING, Any, Dict, Tuple
 
-from lxml import objectify
+if TYPE_CHECKING:
+    from lxml import objectify
 
 from .base import OptaXMLParser, assertget
 

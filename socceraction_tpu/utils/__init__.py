@@ -1,0 +1,20 @@
+"""Auxiliary subsystems: tracing/profiling hooks and structured logging.
+
+The reference has no tracing or profiling facilities (its only signal is an
+iteration-count print in the xT solver, reference xthreat.py:320); a TPU
+framework needs them, so this package provides:
+
+- :mod:`socceraction_tpu.utils.profiling` -- ``jax.profiler``-backed trace
+  contexts, named-scope annotation for XLA ops, and a lightweight wall-clock
+  timer registry for host-side stages.
+"""
+
+from socceraction_tpu.utils.profiling import (
+    Timer,
+    annotate,
+    profile_trace,
+    timed,
+    timer_report,
+)
+
+__all__ = ['Timer', 'annotate', 'profile_trace', 'timed', 'timer_report']

@@ -1,0 +1,123 @@
+"""Tracing and profiling hooks.
+
+Three layers of observability, all optional and zero-cost when unused:
+
+1. :func:`profile_trace` -- context manager around ``jax.profiler`` that
+   captures a device trace (TensorBoard-viewable) for a code region.
+2. :func:`annotate` -- names a region inside a traced/jitted computation via
+   ``jax.named_scope`` so it is identifiable in XLA/HLO dumps and profiles.
+3. :class:`Timer` / :func:`timed` -- host-side wall-clock timers for the
+   stages that stay off-device (JSON parsing, event surgery, Arrow packing),
+   aggregated in a process-wide registry readable via :func:`timer_report`.
+
+The reference library has no equivalent (SURVEY §5: "Tracing / profiling:
+none"); this subsystem is new, designed for the TPU runtime where host-side
+ingest and device-side kernels need to be attributed separately.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+_registry_lock = threading.Lock()
+_timers: Dict[str, 'Timer'] = {}
+
+
+class Timer:
+    """Accumulating wall-clock timer (count, total, max) for one stage."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def add(self, elapsed_s: float) -> None:
+        self.count += 1
+        self.total_s += elapsed_s
+        self.max_s = max(self.max_s, elapsed_s)
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            'count': self.count,
+            'total_s': self.total_s,
+            'mean_s': self.total_s / self.count if self.count else 0.0,
+            'max_s': self.max_s,
+        }
+
+
+def _get_timer(name: str) -> Timer:
+    with _registry_lock:
+        timer = _timers.get(name)
+        if timer is None:
+            timer = _timers[name] = Timer(name)
+        return timer
+
+
+@contextlib.contextmanager
+def timed(name: str, *, block_until_ready: bool = False) -> Iterator[Timer]:
+    """Time a host-side stage and record it under ``name``.
+
+    With ``block_until_ready=True`` the context exit synchronizes all live
+    JAX arrays first, so asynchronously dispatched device work is charged to
+    the stage that launched it.
+    """
+    timer = _get_timer(name)
+    t0 = time.perf_counter()
+    try:
+        yield timer
+    finally:
+        if block_until_ready:
+            # jax.effects_barrier() only waits on *effectful* computations;
+            # pure async dispatches leave no runtime token, so block on the
+            # live arrays themselves to charge device time to this stage.
+            jax.block_until_ready(jax.live_arrays())
+        timer.add(time.perf_counter() - t0)
+
+
+def timer_report(reset: bool = False) -> Dict[str, Dict[str, float]]:
+    """Snapshot of all timers as ``{name: {count, total_s, mean_s, max_s}}``."""
+    with _registry_lock:
+        report = {name: t.as_dict() for name, t in sorted(_timers.items())}
+        if reset:
+            _timers.clear()
+    return report
+
+
+def annotate(name: str):
+    """Named scope visible in XLA profiles; usable inside jitted code.
+
+    Example::
+
+        with annotate('xt/solve'):
+            grid = solve_xt(probs, eps=eps)
+    """
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def profile_trace(
+    log_dir: str,
+    *,
+    create_perfetto_link: bool = False,
+    enabled: bool = True,
+) -> Iterator[None]:
+    """Capture a ``jax.profiler`` device trace for the enclosed region.
+
+    Writes a TensorBoard-loadable trace to ``log_dir``. ``enabled=False``
+    turns the context into a no-op so call sites can keep the hook in place
+    unconditionally.
+    """
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

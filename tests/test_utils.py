@@ -1,0 +1,42 @@
+"""Tests for the profiling/tracing subsystem."""
+
+import jax.numpy as jnp
+
+from socceraction_tpu.utils import annotate, timed, timer_report
+
+
+def test_timed_accumulates():
+    timer_report(reset=True)
+    for _ in range(3):
+        with timed('stage/a'):
+            pass
+    report = timer_report()
+    assert report['stage/a']['count'] == 3
+    assert report['stage/a']['total_s'] >= 0.0
+    assert report['stage/a']['max_s'] <= report['stage/a']['total_s']
+
+
+def test_timed_block_until_ready():
+    timer_report(reset=True)
+    with timed('stage/device', block_until_ready=True):
+        x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+    assert x.shape == (128, 128)
+    assert timer_report()['stage/device']['count'] == 1
+
+
+def test_annotate_inside_jit():
+    import jax
+
+    @jax.jit
+    def f(x):
+        with annotate('test/scope'):
+            return x * 2.0
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+
+
+def test_timer_report_reset():
+    with timed('stage/b'):
+        pass
+    assert 'stage/b' in timer_report(reset=True)
+    assert 'stage/b' not in timer_report()
