@@ -1,0 +1,24 @@
+"""L5 pipeline layer: season stores and batch feeding.
+
+The reference has no pipeline *library* code -- its canonical pipeline lives
+in notebooks and ``tests/datasets/download.py:63-125``, which materialize a
+per-game HDF5 store with keys ``games``, ``teams``, ``players``,
+``actiontypes``, ``results``, ``bodyparts`` and ``actions/game_<id>``.
+
+This package makes that convention first-class:
+
+- :class:`SeasonStore` -- a keyed DataFrame store with the reference's key
+  layout and two engines: Parquet (default; Arrow is the host<->device
+  interchange format of the TPU runtime) and HDF5 via h5py.
+- :func:`build_spadl_store` -- loader + converter -> store, the library
+  equivalent of the reference download pipeline.
+- :func:`load_batch` / :func:`iter_batches` -- read stored games into
+  packed :class:`~socceraction_tpu.core.ActionBatch` bundles, including a
+  streaming iterator for feeding seasons through HBM in fixed-size chunks.
+"""
+
+from socceraction_tpu.pipeline.build import build_spadl_store
+from socceraction_tpu.pipeline.feed import iter_batches, load_batch
+from socceraction_tpu.pipeline.store import SeasonStore
+
+__all__ = ['SeasonStore', 'build_spadl_store', 'iter_batches', 'load_batch']

@@ -1,0 +1,138 @@
+"""Build a :class:`SeasonStore` from a provider loader.
+
+Library equivalent of the reference download pipeline
+(``tests/datasets/download.py:63-125``): iterate the requested
+competition/season pairs, convert each game's events to (Atomic-)SPADL and
+write the per-game frames plus the metadata and vocabulary tables.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Optional, Tuple
+
+import pandas as pd
+
+from socceraction_tpu.pipeline.store import SeasonStore
+from socceraction_tpu.utils import timed
+
+logger = logging.getLogger(__name__)
+
+__all__ = ['build_spadl_store']
+
+
+def build_spadl_store(
+    loader: Any,
+    store: SeasonStore,
+    competitions: Optional[Iterable[Tuple[Any, Any]]] = None,
+    *,
+    convert: Optional[Callable[[pd.DataFrame, Any], pd.DataFrame]] = None,
+    atomic: bool = False,
+    on_error: str = 'raise',
+) -> SeasonStore:
+    """Convert every game of the given competitions into ``store``.
+
+    Parameters
+    ----------
+    loader : EventDataLoader
+        Any provider loader (StatsBomb, Wyscout, Opta, ...).
+    store : SeasonStore
+        Open, writable store to populate.
+    competitions : iterable of (competition_id, season_id), optional
+        Defaults to every competition the loader advertises.
+    convert : callable, optional
+        ``convert(events, home_team_id) -> actions``. Defaults to the
+        provider converter matching the loader class name.
+    atomic : bool
+        Additionally convert each game to Atomic-SPADL and store the
+        atomic vocabulary (``atomic/spadl/config.py`` id space).
+    on_error : {'raise', 'skip'}
+        'skip' logs and continues past games whose feed files are missing
+        or malformed.
+
+    Returns
+    -------
+    SeasonStore
+        ``store``, for chaining.
+    """
+    from socceraction_tpu.spadl import config as spadlcfg
+
+    if convert is None:
+        convert = _default_converter(loader)
+
+    store.put('actiontypes', spadlcfg.actiontypes_df())
+    store.put('results', spadlcfg.results_df())
+    store.put('bodyparts', spadlcfg.bodyparts_df())
+    if atomic:
+        from socceraction_tpu.atomic.spadl import config as atomiccfg
+        from socceraction_tpu.atomic.spadl import convert_to_atomic
+
+        store.put('atomic_actiontypes', atomiccfg.actiontypes_df())
+
+    comp_table = loader.competitions()
+    store.put('competitions', comp_table)
+    if competitions is None:
+        competitions = list(
+            comp_table[['competition_id', 'season_id']].itertuples(index=False)
+        )
+
+    all_games, all_teams, all_players = [], [], []
+    for competition_id, season_id in competitions:
+        games = loader.games(competition_id, season_id)
+        for row in games.itertuples(index=False):
+            game_id = row.game_id
+            try:
+                with timed('pipeline/load_events'):
+                    events = loader.events(game_id)
+                    teams = loader.teams(game_id)
+                    players = loader.players(game_id)
+                with timed('pipeline/convert'):
+                    actions = convert(events, row.home_team_id)
+            except Exception:
+                if on_error == 'skip':
+                    logger.warning('skipping game %s', game_id, exc_info=True)
+                    continue
+                raise
+            store.put_actions(game_id, actions)
+            if atomic:
+                store.put(f'atomic_actions/game_{game_id}', convert_to_atomic(actions))
+            # metadata recorded only for games whose actions made it into the
+            # store, so games()/teams()/players() never reference a missing
+            # actions/game_<id> key
+            all_games.append(games[games['game_id'] == game_id])
+            all_teams.append(teams)
+            all_players.append(players)
+            logger.info('stored game %s (%d actions)', game_id, len(actions))
+
+    empty = pd.DataFrame(columns=['game_id', 'home_team_id', 'away_team_id'])
+    store.put(
+        'games',
+        pd.concat(all_games, ignore_index=True) if all_games else empty,
+    )
+    if all_teams:
+        teams = pd.concat(all_teams, ignore_index=True)
+        store.put('teams', teams.drop_duplicates(subset='team_id').reset_index(drop=True))
+    if all_players:
+        players = pd.concat(all_players, ignore_index=True)
+        store.put('players', players.reset_index(drop=True))
+    return store
+
+
+def _default_converter(loader: Any) -> Callable[[pd.DataFrame, Any], pd.DataFrame]:
+    name = type(loader).__name__.lower()
+    if 'statsbomb' in name:
+        from socceraction_tpu.spadl import statsbomb
+
+        return statsbomb.convert_to_actions
+    if 'wyscout' in name:
+        from socceraction_tpu.spadl import wyscout
+
+        return wyscout.convert_to_actions
+    if 'opta' in name:
+        from socceraction_tpu.spadl import opta
+
+        return opta.convert_to_actions
+    raise ValueError(
+        f'cannot infer a SPADL converter for loader {type(loader).__name__}; '
+        'pass convert= explicitly'
+    )

@@ -1,0 +1,88 @@
+"""Feed stored seasons to the device as packed :class:`ActionBatch` chunks.
+
+The streaming path (:func:`iter_batches`) reads the next chunk's parquet/
+hdf5 frames and packs them on the host while the device works on the
+current chunk (JAX dispatch is asynchronous, so handing the next batch to a
+jitted consumer overlaps host IO with device compute -- double buffering
+without explicit threads).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import pandas as pd
+
+from socceraction_tpu.core import ActionBatch, pack_actions
+from socceraction_tpu.pipeline.store import SeasonStore
+from socceraction_tpu.utils import timed
+
+__all__ = ['load_batch', 'iter_batches']
+
+
+def _home_team_ids(store: SeasonStore) -> dict:
+    games = store.games()
+    return dict(zip(games['game_id'], games['home_team_id']))
+
+
+def load_batch(
+    store: SeasonStore,
+    game_ids: Optional[Sequence[Any]] = None,
+    *,
+    max_actions: Optional[int] = None,
+    float_dtype: Any = 'float32',
+    device: Optional[Any] = None,
+) -> Tuple[ActionBatch, List[Any]]:
+    """Pack the given stored games (default: all) into one ActionBatch."""
+    if game_ids is None:
+        game_ids = store.game_ids()
+    home = _home_team_ids(store)
+    with timed('pipeline/read_actions'):
+        frames = [store.get_actions(gid) for gid in game_ids]
+        actions = pd.concat(frames, ignore_index=True)
+    with timed('pipeline/pack'):
+        return pack_actions(
+            actions,
+            {gid: home[gid] for gid in game_ids},
+            max_actions=max_actions,
+            float_dtype=float_dtype,
+            device=device,
+        )
+
+
+def iter_batches(
+    store: SeasonStore,
+    games_per_batch: int,
+    *,
+    game_ids: Optional[Sequence[Any]] = None,
+    max_actions: Optional[int] = None,
+    float_dtype: Any = 'float32',
+    device: Optional[Any] = None,
+    drop_remainder: bool = False,
+) -> Iterator[Tuple[ActionBatch, List[Any]]]:
+    """Stream the store in fixed-size game chunks.
+
+    With ``max_actions`` set (recommended), every chunk has identical
+    ``(games_per_batch, max_actions)`` device shapes so a jitted consumer
+    compiles exactly once; ``drop_remainder`` skips the final short chunk
+    to keep the game axis static too.
+    """
+    if game_ids is None:
+        game_ids = store.game_ids()
+    home = _home_team_ids(store)
+    for lo in range(0, len(game_ids), games_per_batch):
+        chunk = list(game_ids[lo : lo + games_per_batch])
+        if drop_remainder and len(chunk) < games_per_batch:
+            return
+        with timed('pipeline/read_actions'):
+            actions = pd.concat(
+                [store.get_actions(gid) for gid in chunk], ignore_index=True
+            )
+        with timed('pipeline/pack'):
+            yield pack_actions(
+                actions,
+                {gid: home[gid] for gid in chunk},
+                max_actions=max_actions,
+                float_dtype=float_dtype,
+                device=device,
+            )

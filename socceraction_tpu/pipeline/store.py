@@ -1,0 +1,240 @@
+"""Keyed season store with the reference HDF5 key convention.
+
+Key layout (mirrors reference ``tests/datasets/download.py:95-124``):
+
+- ``competitions``, ``games``, ``teams``, ``players`` -- metadata tables
+- ``actiontypes``, ``results``, ``bodyparts`` -- SPADL vocabulary tables
+- ``actions/game_<id>`` -- one SPADL (or Atomic-SPADL) frame per game
+
+Engines:
+
+- ``parquet`` (default): a directory of ``<key>.parquet`` files with an
+  ``actions/`` subdirectory. Arrow-native, columnar, mmap-friendly -- the
+  natural on-disk twin of the device ``ActionBatch``.
+- ``hdf5``: a single ``.h5`` file via h5py (pandas' HDFStore needs
+  pytables, which this engine deliberately avoids). One group per key, one
+  dataset per column; numeric/bool columns are stored natively,
+  datetime64 as int64 nanoseconds, and object columns as JSON-encoded
+  strings (exact for the str/int/float/None values SPADL frames contain).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, List, Optional
+
+import numpy as np
+import pandas as pd
+
+__all__ = ['SeasonStore']
+
+_GAME_KEY_RE = re.compile(r'^actions/game_(.+)$')
+
+
+def _infer_engine(path: str, engine: Optional[str]) -> str:
+    if engine is not None:
+        return engine
+    if path.endswith(('.h5', '.hdf5')):
+        return 'hdf5'
+    return 'parquet'
+
+
+class SeasonStore:
+    """A keyed DataFrame store holding one or more converted seasons.
+
+    Parameters
+    ----------
+    path : str
+        Directory (parquet engine) or ``.h5`` file (hdf5 engine).
+    engine : {'parquet', 'hdf5'}, optional
+        Defaults to 'hdf5' when ``path`` ends in ``.h5``/``.hdf5``, else
+        'parquet'.
+    mode : {'a', 'r', 'w'}
+        'w' truncates an existing store, 'a' appends/overwrites keys,
+        'r' is read-only.
+    """
+
+    def __init__(self, path: str, engine: Optional[str] = None, mode: str = 'a') -> None:
+        if mode not in ('a', 'r', 'w'):
+            raise ValueError(f"mode must be 'a', 'r' or 'w', got {mode!r}")
+        self.path = path
+        self.engine = _infer_engine(path, engine)
+        if self.engine not in ('parquet', 'hdf5'):
+            raise ValueError(f'unknown engine {self.engine!r}')
+        self.mode = mode
+        self._h5 = None
+        if self.engine == 'hdf5':
+            import h5py
+
+            h5_mode = {'a': 'a', 'r': 'r', 'w': 'w'}[mode]
+            self._h5 = h5py.File(path, h5_mode)
+        else:
+            if mode == 'w' and os.path.isdir(path):
+                import shutil
+
+                shutil.rmtree(path)
+            if mode != 'r':
+                os.makedirs(os.path.join(path, 'actions'), exist_ok=True)
+            elif not os.path.isdir(path):
+                raise FileNotFoundError(path)
+
+    # -- context manager ---------------------------------------------------
+    def __enter__(self) -> 'SeasonStore':
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def close(self) -> None:
+        if self._h5 is not None:
+            self._h5.close()
+            self._h5 = None
+
+    # -- generic key access ------------------------------------------------
+    def _check_writable(self) -> None:
+        if self.mode == 'r':
+            raise OSError('store opened read-only')
+
+    def _parquet_path(self, key: str) -> str:
+        return os.path.join(self.path, *key.split('/')) + '.parquet'
+
+    def put(self, key: str, frame: pd.DataFrame) -> None:
+        """Write ``frame`` under ``key`` (overwriting any existing frame)."""
+        self._check_writable()
+        if self.engine == 'parquet':
+            path = self._parquet_path(key)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            frame.to_parquet(path, index=False)
+        else:
+            assert self._h5 is not None
+            if key in self._h5:
+                del self._h5[key]
+            group = self._h5.create_group(key)
+            group.attrs['columns'] = json.dumps(list(map(str, frame.columns)))
+            for col in frame.columns:
+                _write_column(group, str(col), frame[col])
+
+    def get(self, key: str) -> pd.DataFrame:
+        """Read the frame stored under ``key``."""
+        if self.engine == 'parquet':
+            path = self._parquet_path(key)
+            if not os.path.exists(path):
+                raise KeyError(key)
+            return pd.read_parquet(path)
+        assert self._h5 is not None
+        if key not in self._h5:
+            raise KeyError(key)
+        group = self._h5[key]
+        cols = json.loads(group.attrs['columns'])
+        return pd.DataFrame({col: _read_column(group, col) for col in cols})
+
+    def keys(self) -> List[str]:
+        """All keys in the store ('actions/game_<id>' entries included)."""
+        if self.engine == 'parquet':
+            found = []
+            for root, _dirs, files in os.walk(self.path):
+                for name in files:
+                    if name.endswith('.parquet'):
+                        rel = os.path.relpath(os.path.join(root, name), self.path)
+                        found.append(rel[: -len('.parquet')].replace(os.sep, '/'))
+            return sorted(found)
+        assert self._h5 is not None
+        found = []
+
+        def _visit(name: str, obj: Any) -> None:
+            if 'columns' in getattr(obj, 'attrs', {}):
+                found.append(name)
+
+        self._h5.visititems(_visit)
+        return sorted(found)
+
+    def __contains__(self, key: str) -> bool:
+        try:
+            if self.engine == 'parquet':
+                return os.path.exists(self._parquet_path(key))
+            assert self._h5 is not None
+            return key in self._h5
+        except Exception:
+            return False
+
+    # -- the reference key convention --------------------------------------
+    def put_actions(self, game_id: Any, actions: pd.DataFrame) -> None:
+        """Store one game's action frame under ``actions/game_<id>``."""
+        self.put(f'actions/game_{game_id}', actions)
+
+    def get_actions(self, game_id: Any) -> pd.DataFrame:
+        """Read one game's action frame."""
+        return self.get(f'actions/game_{game_id}')
+
+    def game_ids(self) -> List[Any]:
+        """All stored game ids, parsed back to int where possible."""
+        ids: List[Any] = []
+        for key in self.keys():
+            m = _GAME_KEY_RE.match(key)
+            if m:
+                raw = m.group(1)
+                ids.append(int(raw) if raw.lstrip('-').isdigit() else raw)
+        return ids
+
+    def games(self) -> pd.DataFrame:
+        return self.get('games')
+
+    def teams(self) -> pd.DataFrame:
+        return self.get('teams')
+
+    def players(self) -> pd.DataFrame:
+        return self.get('players')
+
+
+# -- hdf5 column codecs ----------------------------------------------------
+
+def _write_column(group: Any, name: str, series: pd.Series) -> None:
+    import h5py
+
+    pandas_dtype = str(series.dtype)
+    values = series.to_numpy()
+    if np.issubdtype(values.dtype, np.datetime64):
+        data = values.astype('datetime64[ns]').astype(np.int64)
+        ds = group.create_dataset(name, data=data)
+        ds.attrs['codec'] = 'datetime'
+    elif values.dtype == object or values.dtype.kind in ('U', 'S'):
+        encoded = [json.dumps(None if _isna(v) else v) for v in values]
+        ds = group.create_dataset(
+            name, data=encoded, dtype=h5py.string_dtype(encoding='utf-8')
+        )
+        ds.attrs['codec'] = 'json'
+    else:
+        ds = group.create_dataset(name, data=values)
+        ds.attrs['codec'] = 'native'
+    ds.attrs['pandas_dtype'] = pandas_dtype
+
+
+def _read_column(group: Any, name: str) -> Any:
+    ds = group[name]
+    codec = ds.attrs.get('codec', 'native')
+    pandas_dtype = ds.attrs.get('pandas_dtype', None)
+    if codec == 'datetime':
+        out = pd.Series(ds[...].astype(np.int64).view('datetime64[ns]'))
+    elif codec == 'json':
+        raw = [v.decode('utf-8') if isinstance(v, bytes) else v for v in ds[...]]
+        decoded = [json.loads(v) for v in raw]
+        out = pd.Series(
+            [np.nan if v is None else v for v in decoded], dtype=object
+        )
+    else:
+        return ds[...]
+    if pandas_dtype and pandas_dtype != str(out.dtype):
+        try:
+            out = out.astype(pandas_dtype)
+        except (TypeError, ValueError):
+            pass  # unknown extension dtype in this pandas version
+    return out
+
+
+def _isna(v: Any) -> bool:
+    try:
+        return bool(pd.isna(v))
+    except (TypeError, ValueError):
+        return False

@@ -36,11 +36,13 @@ class Timer:
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self._lock = threading.Lock()
 
     def add(self, elapsed_s: float) -> None:
-        self.count += 1
-        self.total_s += elapsed_s
-        self.max_s = max(self.max_s, elapsed_s)
+        with self._lock:
+            self.count += 1
+            self.total_s += elapsed_s
+            self.max_s = max(self.max_s, elapsed_s)
 
     def as_dict(self) -> Dict[str, float]:
         return {

@@ -1,0 +1,128 @@
+"""Tests for the L5 pipeline layer: SeasonStore, build, and batch feeding."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.data.statsbomb import StatsBombLoader
+from socceraction_tpu.pipeline import (
+    SeasonStore,
+    build_spadl_store,
+    iter_batches,
+    load_batch,
+)
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), 'datasets', 'statsbomb', 'raw')
+GAME_ID = 7584
+
+ENGINES = ['parquet', 'hdf5']
+
+
+def _store_path(tmp_path, engine):
+    return str(tmp_path / ('store.h5' if engine == 'hdf5' else 'store'))
+
+
+@pytest.mark.parametrize('engine', ENGINES)
+def test_roundtrip_golden_actions(tmp_path, engine, spadl_actions):
+    path = _store_path(tmp_path, engine)
+    with SeasonStore(path, engine=engine, mode='w') as store:
+        store.put_actions(8657, spadl_actions)
+        assert 'actions/game_8657' in store
+        assert store.game_ids() == [8657]
+        back = store.get_actions(8657)
+    pd.testing.assert_frame_equal(
+        back.reset_index(drop=True), spadl_actions.reset_index(drop=True)
+    )
+
+
+@pytest.mark.parametrize('engine', ENGINES)
+def test_engine_inference_and_modes(tmp_path, engine):
+    path = _store_path(tmp_path, engine)
+    df = pd.DataFrame({'a': [1, 2], 'b': ['x', 'y']})
+    with SeasonStore(path, mode='w') as store:
+        assert store.engine == engine  # inferred from the path suffix
+        store.put('games', df)
+    with SeasonStore(path, mode='r') as store:
+        pd.testing.assert_frame_equal(store.get('games'), df)
+        with pytest.raises(OSError):
+            store.put('games', df)
+        with pytest.raises(KeyError):
+            store.get('nope')
+
+
+@pytest.mark.parametrize('engine', ENGINES)
+def test_hdf5_object_and_datetime_columns(tmp_path, engine):
+    path = _store_path(tmp_path, engine)
+    df = pd.DataFrame(
+        {
+            'strs': pd.Series(['ev-1', np.nan, 'ev-3'], dtype='str'),
+            'when': pd.to_datetime(
+                ['2018-06-14 15:00', '2018-06-14 18:00', '2018-06-15 12:00']
+            ).astype('datetime64[ns]'),
+            'f': np.array([1.5, 2.5, np.nan]),
+            'i': np.array([1, 2, 3], dtype=np.int64),
+        }
+    )
+    with SeasonStore(path, engine=engine, mode='w') as store:
+        store.put('games', df)
+        back = store.get('games')
+    pd.testing.assert_frame_equal(back, df)
+
+
+@pytest.mark.parametrize('engine', ENGINES)
+def test_build_and_feed(tmp_path, engine):
+    loader = StatsBombLoader(getter='local', root=DATA_DIR)
+    path = _store_path(tmp_path, engine)
+    with SeasonStore(path, engine=engine, mode='w') as store:
+        build_spadl_store(loader, store, atomic=True)
+        for key in ('games', 'teams', 'players', 'actiontypes', 'results',
+                    'bodyparts', 'competitions', 'atomic_actiontypes'):
+            assert key in store, key
+        assert store.game_ids() == [GAME_ID]
+        actions = store.get_actions(GAME_ID)
+        assert len(actions) > 0
+        atomic = store.get(f'atomic_actions/game_{GAME_ID}')
+        assert len(atomic) > len(actions)
+
+        batch, gids = load_batch(store)
+        assert gids == [GAME_ID]
+        assert batch.n_games == 1
+        assert batch.total_actions == len(actions)
+
+        chunks = list(iter_batches(store, games_per_batch=1, max_actions=2048))
+        assert len(chunks) == 1
+        assert chunks[0][0].max_actions == 2048
+
+
+def test_iter_batches_static_shapes(tmp_path, spadl_actions):
+    # three copies of the golden game under different ids -> two chunks of 2
+    # (one short, dropped with drop_remainder)
+    with SeasonStore(str(tmp_path / 'store'), mode='w') as store:
+        games = []
+        for gid in (1, 2, 3):
+            df = spadl_actions.copy()
+            df['game_id'] = gid
+            store.put_actions(gid, df)
+            games.append({'game_id': gid, 'home_team_id': 782})
+        store.put('games', pd.DataFrame(games))
+
+        chunks = list(iter_batches(store, 2, max_actions=256))
+        assert [b.n_games for b, _ in chunks] == [2, 1]
+        chunks = list(iter_batches(store, 2, max_actions=256, drop_remainder=True))
+        assert [b.n_games for b, _ in chunks] == [2]
+        assert all(b.max_actions == 256 for b, _ in chunks)
+
+
+def test_build_on_error_skip(tmp_path):
+    loader = StatsBombLoader(getter='local', root=DATA_DIR)
+
+    def broken_convert(events, home_team_id):
+        raise RuntimeError('boom')
+
+    with SeasonStore(str(tmp_path / 'store'), mode='w') as store:
+        build_spadl_store(loader, store, convert=broken_convert, on_error='skip')
+        assert store.game_ids() == []
+        with pytest.raises(RuntimeError):
+            build_spadl_store(loader, store, convert=broken_convert)
