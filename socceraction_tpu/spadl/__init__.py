@@ -18,11 +18,13 @@ from .schema import SPADLSchema
 from .utils import add_names, play_left_to_right
 from . import statsbomb  # noqa: F401  (provider converters)
 from . import wyscout  # noqa: F401
+from . import wyscout_v3  # noqa: F401
 from . import opta  # noqa: F401
 
 __all__ = [
     'statsbomb',
     'wyscout',
+    'wyscout_v3',
     'opta',
     'actiontypes',
     'actiontypes_df',

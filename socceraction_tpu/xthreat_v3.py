@@ -1,0 +1,163 @@
+"""Expected Threat (xT) over raw Wyscout-v3 event frames.
+
+Parity: reference ``socceraction/xthreat_v3.py`` — a fork of the xT model
+that runs directly on flat-column Wyscout v3 frames (``type_primary``
+strings, ``shot_is_goal``, 0/1 ``result``) with a move-action set widened
+from {pass, dribble, cross} to {pass, carry, cross, acceleration, dribble,
+take_on} (reference ``xthreat_v3.py:111-118``).
+
+The reference file's column access is internally inconsistent WIP code
+(``scoring_prob`` reads dotted ``type.primary``/``shot.isGoal`` names,
+``:89-90``, while everything else reads underscore names;
+``move_transition_matrix`` builds ``result_id`` but filters ``X.result``,
+``:191,201``); this module implements the *intended* semantics — underscore
+columns throughout, success = ``result == 1``.
+
+Design: the algorithm is identical to :mod:`socceraction_tpu.xthreat`, so
+instead of forking the engine this module *encodes* a v3 frame into the
+SPADL id space (every move-set primary → a move type id, shots with
+``shot_is_goal`` → successful shots) and delegates to the shared
+dual-backend (pandas oracle / JAX kernel) implementation. One encode
+function is the whole variant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from . import xthreat as _xt
+from .spadl import config as spadlconfig
+
+__all__ = [
+    'MOVE_PRIMARIES',
+    'ExpectedThreatV3',
+    'encode_v3_actions',
+    'get_move_actions',
+    'get_successful_move_actions',
+    'scoring_prob',
+    'action_prob',
+    'move_transition_matrix',
+    'load_model',
+]
+
+M: int = _xt.M
+N: int = _xt.N
+
+#: The widened ball-progressing action set (reference xthreat_v3.py:111-118).
+MOVE_PRIMARIES: Tuple[str, ...] = (
+    'pass', 'carry', 'cross', 'acceleration', 'dribble', 'take_on',
+)
+
+
+def encode_v3_actions(events: pd.DataFrame) -> pd.DataFrame:
+    """Encode a Wyscout-v3 frame into the SPADL id space for the xT engine.
+
+    Mapping:
+
+    - ``type_primary`` in :data:`MOVE_PRIMARIES` → the SPADL ``pass`` id
+      (any single move id works: the engine only tests membership in its
+      move set),
+    - ``type_primary == 'shot'`` → the SPADL ``shot`` id,
+    - everything else → ``non_action`` (ignored by the model).
+    - ``result_id`` is 1 for successful moves (``result == 1``) and for
+      goals (``shot_is_goal == 1``; falls back to ``result`` when the
+      column is absent).
+
+    Requires ``start_x/start_y/end_x/end_y`` in meters (i.e. frames that
+    passed the v3 converter's coordinate rescale, or any SPADL-coordinate
+    frame carrying v3 type columns).
+    """
+    primary = events['type_primary'].astype(str)
+    is_move = primary.isin(MOVE_PRIMARIES)
+    is_shot = primary == 'shot'
+    type_id = np.where(
+        is_move,
+        spadlconfig.PASS,
+        np.where(is_shot, spadlconfig.SHOT, spadlconfig.NON_ACTION),
+    )
+    result = pd.to_numeric(
+        events.get('result', pd.Series(np.nan, index=events.index)),
+        errors='coerce',
+    )
+    if 'shot_is_goal' in events.columns:
+        goal = pd.to_numeric(events['shot_is_goal'], errors='coerce') == 1
+    else:
+        goal = result == 1
+    success = np.where(is_shot, goal, result == 1)
+    encoded = pd.DataFrame(
+        {
+            'type_id': type_id.astype(np.int64),
+            'result_id': np.where(success, spadlconfig.SUCCESS, spadlconfig.FAIL).astype(
+                np.int64
+            ),
+            'start_x': events['start_x'].astype(float),
+            'start_y': events['start_y'].astype(float),
+            'end_x': events['end_x'].astype(float),
+            'end_y': events['end_y'].astype(float),
+        },
+        index=events.index,
+    )
+    for passthrough in ('game_id', 'team_id', 'period_id', 'time_seconds'):
+        if passthrough in events.columns:
+            encoded[passthrough] = events[passthrough]
+    return encoded
+
+
+def get_move_actions(events: pd.DataFrame) -> pd.DataFrame:
+    """All ball-progressing v3 events (widened move set)."""
+    return events[events['type_primary'].astype(str).isin(MOVE_PRIMARIES)]
+
+
+def get_successful_move_actions(events: pd.DataFrame) -> pd.DataFrame:
+    """All successful ball-progressing v3 events (``result == 1``)."""
+    moves = get_move_actions(events)
+    return moves[pd.to_numeric(moves['result'], errors='coerce') == 1]
+
+
+def scoring_prob(events: pd.DataFrame, l: int = N, w: int = M) -> np.ndarray:
+    """P(goal | shot from cell) from v3 ``shot``/``shot_is_goal`` columns."""
+    return _xt.scoring_prob(encode_v3_actions(events), l, w)
+
+
+def action_prob(
+    events: pd.DataFrame, l: int = N, w: int = M
+) -> Tuple[np.ndarray, np.ndarray]:
+    """P(choose shot) and P(choose move) per cell, widened move set."""
+    return _xt.action_prob(encode_v3_actions(events), l, w)
+
+
+def move_transition_matrix(events: pd.DataFrame, l: int = N, w: int = M) -> np.ndarray:
+    """Successful-move transition matrix over the widened move set."""
+    return _xt.move_transition_matrix(encode_v3_actions(events), l, w)
+
+
+class ExpectedThreatV3(_xt.ExpectedThreat):
+    """xT fitted on raw Wyscout-v3 event frames.
+
+    Same engine, grid, solver and backends as
+    :class:`socceraction_tpu.xthreat.ExpectedThreat`; inputs are v3 frames
+    which are encoded on entry to ``fit`` and ``rate``.
+    """
+
+    def fit(self, events: pd.DataFrame) -> 'ExpectedThreatV3':
+        """Fit on a v3 event frame (metered coordinates)."""
+        super().fit(encode_v3_actions(events))
+        return self
+
+    def rate(
+        self, events: pd.DataFrame, use_interpolation: bool = False
+    ) -> np.ndarray:
+        """Rate successful widened-set move events; NaN elsewhere."""
+        return super().rate(encode_v3_actions(events), use_interpolation)
+
+
+def load_model(path: str, backend: Optional[str] = None) -> ExpectedThreatV3:
+    """Create a v3 model from a saved xT value surface (JSON 2-D matrix)."""
+    base = _xt.load_model(path, backend=backend)
+    model = ExpectedThreatV3(backend=base.backend)
+    model.xT = base.xT
+    model.w, model.l = base.w, base.l
+    return model

@@ -1,0 +1,191 @@
+"""Tests for the Wyscout-v3 → SPADL converter (intended semantics)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.spadl import config as spadlconfig
+from socceraction_tpu.spadl import wyscout_v3
+from socceraction_tpu.spadl.schema import SPADLSchema
+
+HOME, AWAY = 1, 2
+
+
+def _event(eid, minute, second, team, player, primary, x, y, **kw):
+    base = {
+        'id': eid,
+        'match_id': 9000,
+        'home_team_id': HOME,
+        'match_period': '1H',
+        'minute': minute,
+        'second': second,
+        'team_id': team,
+        'player_id': player,
+        'type_primary': primary,
+        'location_x': x,
+        'location_y': y,
+    }
+    base.update(kw)
+    return base
+
+
+@pytest.fixture(scope='module')
+def v3_events() -> pd.DataFrame:
+    rows = [
+        _event(101, 0, 5, HOME, 11, 'pass', 50, 50,
+               pass_end_location_x=60, pass_end_location_y=40, pass_accurate=1),
+        _event(102, 0, 10, HOME, 12, 'pass', 60, 40, type_cross=1,
+               pass_end_location_x=95, pass_end_location_y=20, pass_accurate=0),
+        _event(103, 0, 15, HOME, 13, 'touch', 62, 38),
+        _event(104, 0, 20, HOME, 13, 'pass', 65, 35, type_shot_assist=1,
+               pass_end_location_x=85, pass_end_location_y=45, pass_accurate=1),
+        _event(105, 0, 25, HOME, 14, 'shot', 85, 45,
+               shot_goal_zone='gc', shot_is_goal=1, shot_xg=0.3),
+        _event(106, 1, 0, AWAY, 21, 'pass', 50, 50,
+               pass_end_location_x=40, pass_end_location_y=60, pass_accurate=0),
+        _event(107, 1, 5, HOME, 15, 'interception', 55, 45),
+        _event(108, 1, 10, HOME, 16, 'duel', 50, 50,
+               ground_duel_duel_type='dribble', ground_duel_take_on=1.0,
+               ground_duel_kept_possession=1.0),
+        _event(109, 1, 20, HOME, 14, 'penalty', 88.5, 50,
+               shot_goal_zone='otr', shot_is_goal=0),
+        _event(110, 2, 0, AWAY, 22, 'free_kick', 30, 30,
+               type_free_kick_shot=1, shot_goal_zone='ol', shot_is_goal=0),
+        _event(111, 2, 10, AWAY, 23, 'infraction', 55, 45,
+               infraction_type='regular_foul'),
+        _event(112, 2, 20, HOME, 12, 'corner', 100, 100, pass_length=30,
+               pass_end_location_x=92, pass_end_location_y=50, pass_accurate=1),
+        _event(113, 2, 30, HOME, 11, 'pass', 60, 50,
+               pass_end_location_x=80, pass_end_location_y=30, pass_accurate=1),
+        _event(114, 2, 31, HOME, 14, 'offside', 80, 30),
+        _event(115, 2, 40, AWAY, 20, 'goal_kick', 5, 50,
+               pass_end_location_x=40, pass_end_location_y=60, pass_accurate=1),
+        _event(116, 3, 0, AWAY, 22, 'shot', 80, 50,
+               shot_goal_zone='gr', shot_is_goal=0),
+        _event(117, 3, 2, HOME, 1, 'shot_against', 95, 50, type_save=1),
+        _event(118, 3, 10, HOME, 17, 'acceleration', 55, 55),
+        _event(119, 3, 15, HOME, 17, 'pass', 60, 50,
+               pass_end_location_x=65, pass_end_location_y=45, pass_accurate=1),
+    ]
+    return pd.DataFrame(rows)
+
+
+@pytest.fixture(scope='module')
+def actions(v3_events) -> pd.DataFrame:
+    return wyscout_v3.convert_to_actions(v3_events, HOME)
+
+
+def _by_event(actions, eid):
+    rows = actions[actions['original_event_id'] == eid]
+    assert len(rows) == 1, f'event {eid}: {len(rows)} rows'
+    return rows.iloc[0]
+
+
+def test_schema_valid(actions):
+    SPADLSchema.validate(actions)
+    assert (actions['action_id'].to_numpy() == np.arange(len(actions))).all()
+
+
+def test_type_mapping(actions):
+    name = {eid: spadlconfig.actiontypes[_by_event(actions, eid)['type_id']]
+            for eid in (101, 102, 105, 107, 108, 109, 110, 111, 112, 115, 117, 118)}
+    assert name[101] == 'pass'
+    assert name[102] == 'cross'
+    assert name[105] == 'shot'
+    assert name[107] == 'interception'
+    assert name[108] == 'take_on'
+    assert name[109] == 'shot_penalty'
+    assert name[110] == 'shot_freekick'
+    assert name[111] == 'foul'
+    assert name[112] == 'corner_crossed'
+    assert name[115] == 'goalkick'
+    assert name[117] == 'keeper_save'
+    assert name[118] == 'dribble'
+
+
+def test_results(actions):
+    r = {eid: _by_event(actions, eid)['result_id']
+         for eid in (101, 102, 105, 108, 109, 110, 111, 113, 116, 117, 118)}
+    assert r[101] == spadlconfig.SUCCESS  # accurate pass
+    assert r[102] == spadlconfig.FAIL  # inaccurate cross
+    assert r[105] == spadlconfig.SUCCESS  # goal
+    assert r[108] == spadlconfig.SUCCESS  # duel won
+    assert r[109] == spadlconfig.FAIL  # missed penalty
+    assert r[110] == spadlconfig.FAIL  # missed freekick shot
+    assert r[111] == spadlconfig.SUCCESS  # foul
+    assert r[113] == spadlconfig.OFFSIDE  # pass before offside event
+    assert r[116] == spadlconfig.FAIL  # saved shot
+    assert r[117] == spadlconfig.SUCCESS  # keeper save
+    assert r[118] == spadlconfig.SUCCESS  # acceleration kept by same team
+
+
+def test_offside_event_removed(actions):
+    assert not (actions['original_event_id'] == 114).any()
+
+
+def test_home_coordinates_rescaled(actions):
+    # home-team goal at (0-100, y down) → SPADL meters, y flipped
+    a = _by_event(actions, 105)
+    assert a['start_x'] == pytest.approx(85 * 105 / 100)
+    assert a['start_y'] == pytest.approx((100 - 45) * 68 / 100)
+    # goal-zone 'gc' end → (100, 50) raw → (105, 34) m
+    assert a['end_x'] == pytest.approx(105.0)
+    assert a['end_y'] == pytest.approx(34.0)
+
+
+def test_away_coordinates_mirrored(actions):
+    # away-team actions are mirrored so both teams play left-to-right
+    a = _by_event(actions, 106)
+    assert a['start_x'] == pytest.approx(105 - 50 * 105 / 100)
+    assert a['start_y'] == pytest.approx(68 - (100 - 50) * 68 / 100)
+
+
+def test_touch_success_and_end_coordinates(actions):
+    # touch by home followed by home pass → dribble success ending at the
+    # next event's location
+    a = _by_event(actions, 103)
+    assert spadlconfig.actiontypes[a['type_id']] == 'dribble'
+    assert a['result_id'] == spadlconfig.SUCCESS
+    assert a['end_x'] == pytest.approx(65 * 105 / 100)
+    assert a['end_y'] == pytest.approx((100 - 35) * 68 / 100)
+
+
+def test_interception_end_coordinates(actions):
+    # interception by home; next event (duel, home) starts at (50, 50)
+    a = _by_event(actions, 107)
+    assert a['end_x'] == pytest.approx(50 * 105 / 100)
+    assert a['end_y'] == pytest.approx((100 - 50) * 68 / 100)
+
+
+def test_foul_end_equals_start(actions):
+    a = _by_event(actions, 111)
+    assert a['end_x'] == a['start_x']
+    assert a['end_y'] == a['start_y']
+
+
+def test_keeper_save_at_own_goal(actions):
+    a = _by_event(actions, 117)
+    assert a['start_x'] == a['end_x']
+    assert a['start_y'] == a['end_y']
+    # save happens near the keeper's own goal line
+    assert a['start_x'] < 20.0
+
+
+def test_period_relative_time(actions):
+    a = _by_event(actions, 101)
+    assert a['period_id'] == 1
+    assert a['time_seconds'] == pytest.approx(5.0)
+
+
+def test_home_team_id_from_column(v3_events):
+    actions = wyscout_v3.convert_to_actions(v3_events)
+    SPADLSchema.validate(actions)
+    with pytest.raises(ValueError):
+        wyscout_v3.convert_to_actions(v3_events.drop(columns=['home_team_id']))
+
+
+def test_add_expected_assists(v3_events):
+    out = wyscout_v3.add_expected_assists(v3_events)
+    xa = out.loc[out['id'] == 104, 'metric_xa']
+    assert xa.iloc[0] == pytest.approx(0.3)
+    assert out.loc[out['id'] == 101, 'metric_xa'].isna().all()

@@ -1,0 +1,92 @@
+"""Tests for the Wyscout-v3 xT variant (widened move set, dual backend)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu import xthreat_v3
+from socceraction_tpu.xthreat import NotFittedError
+
+
+@pytest.fixture(scope='module')
+def v3_frame() -> pd.DataFrame:
+    """Synthetic metered v3 frame exercising all six move primaries."""
+    rng = np.random.default_rng(7)
+    n = 240
+    primaries = rng.choice(
+        list(xthreat_v3.MOVE_PRIMARIES) + ['shot', 'infraction', 'shot_against'],
+        size=n,
+        p=[0.12] * 6 + [0.14, 0.07, 0.07],
+    )
+    is_shot = primaries == 'shot'
+    frame = pd.DataFrame(
+        {
+            'type_primary': primaries,
+            'result': rng.integers(0, 2, size=n),
+            'shot_is_goal': np.where(is_shot, rng.integers(0, 2, size=n), 0),
+            'start_x': rng.uniform(0, 105, size=n),
+            'start_y': rng.uniform(0, 68, size=n),
+            'end_x': rng.uniform(0, 105, size=n),
+            'end_y': rng.uniform(0, 68, size=n),
+        }
+    )
+    # park shots near goal so the scoring surface is meaningful
+    frame.loc[is_shot, 'start_x'] = rng.uniform(85, 105, size=int(is_shot.sum()))
+    return frame
+
+
+def test_move_selectors(v3_frame):
+    moves = xthreat_v3.get_move_actions(v3_frame)
+    assert set(moves['type_primary']) <= set(xthreat_v3.MOVE_PRIMARIES)
+    ok = xthreat_v3.get_successful_move_actions(v3_frame)
+    assert (ok['result'] == 1).all()
+    assert len(ok) < len(moves)
+
+
+def test_matrices_shapes(v3_frame):
+    p = xthreat_v3.scoring_prob(v3_frame, 8, 6)
+    assert p.shape == (6, 8)
+    shot_p, move_p = xthreat_v3.action_prob(v3_frame, 8, 6)
+    assert shot_p.shape == move_p.shape == (6, 8)
+    np.testing.assert_allclose(
+        (shot_p + move_p)[(shot_p + move_p) > 0].max(), 1.0, atol=1e-12
+    )
+    T = xthreat_v3.move_transition_matrix(v3_frame, 8, 6)
+    assert T.shape == (48, 48)
+    assert (T.sum(axis=1) <= 1.0 + 1e-9).all()
+
+
+def test_backend_parity(v3_frame):
+    ref = xthreat_v3.ExpectedThreatV3(l=8, w=6, backend='pandas').fit(v3_frame)
+    jx = xthreat_v3.ExpectedThreatV3(l=8, w=6, backend='jax').fit(v3_frame)
+    np.testing.assert_allclose(jx.xT, ref.xT, atol=1e-5)
+    r_ref = ref.rate(v3_frame)
+    r_jx = jx.rate(v3_frame)
+    np.testing.assert_allclose(r_jx, r_ref, atol=1e-5)
+
+
+def test_rate_nan_pattern(v3_frame):
+    model = xthreat_v3.ExpectedThreatV3(l=8, w=6, backend='pandas').fit(v3_frame)
+    ratings = model.rate(v3_frame)
+    successful_move = v3_frame['type_primary'].isin(xthreat_v3.MOVE_PRIMARIES) & (
+        v3_frame['result'] == 1
+    )
+    assert np.isfinite(ratings[successful_move.to_numpy()]).all()
+    assert np.isnan(ratings[~successful_move.to_numpy()]).all()
+
+
+def test_not_fitted(v3_frame):
+    with pytest.raises(NotFittedError):
+        xthreat_v3.ExpectedThreatV3(backend='pandas').rate(v3_frame)
+
+
+def test_save_load_roundtrip(tmp_path, v3_frame):
+    model = xthreat_v3.ExpectedThreatV3(l=8, w=6, backend='pandas').fit(v3_frame)
+    path = str(tmp_path / 'xt_v3.json')
+    model.save_model(path)
+    loaded = xthreat_v3.load_model(path, backend='pandas')
+    assert isinstance(loaded, xthreat_v3.ExpectedThreatV3)
+    np.testing.assert_allclose(loaded.xT, model.xT)
+    np.testing.assert_allclose(
+        loaded.rate(v3_frame), model.rate(v3_frame), equal_nan=True
+    )
