@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..spadl import config as spadlconfig
+from .segment import segment_sum
 
 __all__ = [
     'cell_indexes',
@@ -38,6 +39,7 @@ __all__ = [
     'XTProbabilities',
     'xt_probabilities',
     'solve_xt',
+    'solve_xt_matrix_free',
     'rate_actions',
     'interpolate_grid',
 ]
@@ -78,6 +80,93 @@ def _is_move(type_id: jax.Array) -> jax.Array:
     return m
 
 
+class _ActionStream(NamedTuple):
+    """Flattened, validity-masked view of an action batch (shared prologue)."""
+
+    start_flat: jax.Array  # (n,) flat start cell (junk where ~start_ok)
+    end_flat: jax.Array  # (n,) flat end cell (junk where ~end_ok)
+    is_shot: jax.Array  # (n,) masked shot predicate
+    is_goal: jax.Array  # (n,) masked goal predicate
+    is_move: jax.Array  # (n,) masked move predicate
+    is_success_move: jax.Array  # (n,) masked successful-move predicate
+
+
+def _action_stream(
+    type_id: jax.Array,
+    result_id: jax.Array,
+    start_x: jax.Array,
+    start_y: jax.Array,
+    end_x: jax.Array,
+    end_y: jax.Array,
+    mask: jax.Array,
+    l: int,
+    w: int,
+) -> _ActionStream:
+    """Flatten a batch and derive the masked xT event predicates.
+
+    NaN coordinates are excluded like the reference's ``_count`` NaN filter
+    (``xthreat.py:60-61``); transition pairs additionally require a valid
+    end location. This is the single source of the parity-critical mask
+    semantics for both the dense-count and matrix-free paths.
+    """
+    type_id = type_id.reshape(-1)
+    result_id = result_id.reshape(-1)
+    mask = mask.reshape(-1)
+    start_x, start_y = start_x.reshape(-1), start_y.reshape(-1)
+    end_x, end_y = end_x.reshape(-1), end_y.reshape(-1)
+
+    start_ok = ~(jnp.isnan(start_x) | jnp.isnan(start_y))
+    end_ok = start_ok & ~(jnp.isnan(end_x) | jnp.isnan(end_y))
+    start_flat = flat_indexes(jnp.nan_to_num(start_x), jnp.nan_to_num(start_y), l, w)
+    end_flat = flat_indexes(jnp.nan_to_num(end_x), jnp.nan_to_num(end_y), l, w)
+
+    is_shot = mask & start_ok & (type_id == spadlconfig.SHOT)
+    is_goal = is_shot & (result_id == spadlconfig.SUCCESS)
+    is_move = mask & start_ok & _is_move(type_id)
+    is_success_move = is_move & end_ok & (result_id == spadlconfig.SUCCESS)
+    return _ActionStream(
+        start_flat=start_flat,
+        end_flat=end_flat,
+        is_shot=is_shot,
+        is_goal=is_goal,
+        is_move=is_move,
+        is_success_move=is_success_move,
+    )
+
+
+def _cell_probabilities(
+    shots: jax.Array, goals: jax.Array, moves: jax.Array, l: int, w: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(p_score, p_shot, p_move) grids from the three count vectors."""
+    p_score = _safe_divide(goals, shots).reshape(w, l)
+    total = shots + moves
+    p_shot = _safe_divide(shots, total).reshape(w, l)
+    p_move = _safe_divide(moves, total).reshape(w, l)
+    return p_score, p_shot, p_move
+
+
+def _value_iteration(sweep, gs: jax.Array, eps: float, max_iter: int):
+    """``xT <- sweep(xT)`` to convergence inside a ``lax.while_loop``.
+
+    Convergence uses the reference's signed test ``any(new - old > eps)``
+    (``xthreat.py:303``; xT is monotonically non-decreasing so the signed
+    and absolute tests agree).
+    """
+
+    def cond(state):
+        _, diff_any, it = state
+        return diff_any & (it < max_iter)
+
+    def body(state):
+        xT, _, it = state
+        new = sweep(xT)
+        return new, jnp.any(new - xT > eps), it + 1
+
+    xT0 = jnp.zeros_like(gs)
+    xT, _, it = jax.lax.while_loop(cond, body, (xT0, jnp.bool_(True), jnp.int32(0)))
+    return xT, it
+
+
 @functools.partial(jax.jit, static_argnames=('l', 'w'))
 def xt_counts(
     type_id: jax.Array,
@@ -96,42 +185,19 @@ def xt_counts(
     All inputs are flat (or broadcastable-to-flat) arrays of identical shape;
     padded rows carry ``mask == False`` and contribute nothing.
     """
-    type_id = type_id.reshape(-1)
-    result_id = result_id.reshape(-1)
-    mask = mask.reshape(-1)
-    start_x, start_y = start_x.reshape(-1), start_y.reshape(-1)
-    end_x, end_y = end_x.reshape(-1), end_y.reshape(-1)
-
+    s = _action_stream(type_id, result_id, start_x, start_y, end_x, end_y, mask, l, w)
     n_cells = w * l
-    # NaN coordinates (e.g. missing end locations) are excluded like the
-    # reference's _count NaN filter (xthreat.py:60-61). Transition pairs
-    # additionally require a valid end location.
-    start_ok = ~(jnp.isnan(start_x) | jnp.isnan(start_y))
-    end_ok = start_ok & ~(jnp.isnan(end_x) | jnp.isnan(end_y))
-    sx = jnp.nan_to_num(start_x)
-    sy = jnp.nan_to_num(start_y)
-    ex = jnp.nan_to_num(end_x)
-    ey = jnp.nan_to_num(end_y)
-
-    start_flat = flat_indexes(sx, sy, l, w)
-    end_flat = flat_indexes(ex, ey, l, w)
-
-    is_shot = mask & start_ok & (type_id == spadlconfig.SHOT)
-    is_goal = is_shot & (result_id == spadlconfig.SUCCESS)
-    is_move = mask & start_ok & _is_move(type_id)
-    is_success_move = is_move & end_ok & (result_id == spadlconfig.SUCCESS)
-
     f32 = jnp.float32
     zeros = jnp.zeros(n_cells, dtype=f32)
-    shots = zeros.at[start_flat].add(is_shot.astype(f32))
-    goals = zeros.at[start_flat].add(is_goal.astype(f32))
-    moves = zeros.at[start_flat].add(is_move.astype(f32))
+    shots = zeros.at[s.start_flat].add(s.is_shot.astype(f32))
+    goals = zeros.at[s.start_flat].add(s.is_goal.astype(f32))
+    moves = zeros.at[s.start_flat].add(s.is_move.astype(f32))
 
-    pair = start_flat * n_cells + end_flat
+    pair = s.start_flat * n_cells + s.end_flat
     trans = (
         jnp.zeros(n_cells * n_cells, dtype=f32)
         .at[pair]
-        .add(is_success_move.astype(f32))
+        .add(s.is_success_move.astype(f32))
         .reshape(n_cells, n_cells)
     )
     return XTCounts(shots=shots, goals=goals, moves=moves, trans=trans)
@@ -154,10 +220,9 @@ def _safe_divide(a: jax.Array, b: jax.Array) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=('l', 'w'))
 def xt_probabilities(counts: XTCounts, *, l: int, w: int) -> XTProbabilities:
     """Turn (possibly psum-reduced) counts into the model's probabilities."""
-    p_score = _safe_divide(counts.goals, counts.shots).reshape(w, l)
-    total = counts.shots + counts.moves
-    p_shot = _safe_divide(counts.shots, total).reshape(w, l)
-    p_move = _safe_divide(counts.moves, total).reshape(w, l)
+    p_score, p_shot, p_move = _cell_probabilities(
+        counts.shots, counts.goals, counts.moves, l, w
+    )
     transition = _safe_divide(counts.trans, counts.moves[:, None])
     return XTProbabilities(p_score=p_score, p_shot=p_shot, p_move=p_move, transition=transition)
 
@@ -187,18 +252,77 @@ def solve_xt(
         payoff = (T @ xT.reshape(-1)).reshape(w, l)
         return gs + probs.p_move * payoff
 
-    def cond(state):
-        _, diff_any, it = state
-        return diff_any & (it < max_iter)
+    return _value_iteration(sweep, gs, eps, max_iter)
 
-    def body(state):
-        xT, _, it = state
-        new = sweep(xT)
-        return new, jnp.any(new - xT > eps), it + 1
 
-    xT0 = jnp.zeros_like(gs)
-    xT, _, it = jax.lax.while_loop(cond, body, (xT0, jnp.bool_(True), jnp.int32(0)))
-    return xT, it
+@functools.partial(jax.jit, static_argnames=('l', 'w', 'max_iter'))
+def solve_xt_matrix_free(
+    type_id: jax.Array,
+    result_id: jax.Array,
+    start_x: jax.Array,
+    start_y: jax.Array,
+    end_x: jax.Array,
+    end_y: jax.Array,
+    mask: jax.Array,
+    *,
+    l: int,
+    w: int,
+    eps: float = 1e-5,
+    max_iter: int = 1000,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Value iteration without materializing the transition matrix.
+
+    For fine grids the dense ``(w*l, w*l)`` transition matrix is intractable
+    (192×125 ⇒ 24000² = 2.3 GB fp32, overwhelmingly zeros). But the sweep
+
+    ``payoff[i] = Σ_j T[i, j] · xT[j]``  with  ``T[i, j] = C[i, j] / starts[i]``
+
+    never needs ``T``: summed over the *successful-move action stream*
+    instead of over cells, it is
+
+    ``payoff[i] = Σ_{moves m: start(m)=i} xT[end(m)] / starts[i]``
+
+    i.e. one gather at the move end cells and one scatter-add
+    (``segment_sum``) by start cell per sweep — ``O(n_actions)`` work and
+    ``O(w·l)`` memory instead of ``O((w·l)²)``. Both sides are additive
+    across device shards, so the multi-chip form is a per-shard
+    segment-sum followed by a ``psum`` of the payoff vector.
+
+    Returns
+    -------
+    (xT, n_iter, p_score, p_shot, p_move)
+        The converged ``(w, l)`` surface, iteration count, and the three
+        ``(w, l)`` probability matrices (the transition matrix is never
+        built).
+    """
+    s = _action_stream(type_id, result_id, start_x, start_y, end_x, end_y, mask, l, w)
+    n_cells = w * l
+    f32 = jnp.float32
+    # segment_sum dispatches to the Pallas blocked one-hot kernel on TPU
+    # (ops/segment.py) and XLA scatter elsewhere
+    shots = segment_sum(s.is_shot.astype(f32), s.start_flat, n_cells)
+    goals = segment_sum(s.is_goal.astype(f32), s.start_flat, n_cells)
+    moves = segment_sum(s.is_move.astype(f32), s.start_flat, n_cells)
+
+    p_score, p_shot, p_move = _cell_probabilities(shots, goals, moves, l, w)
+
+    # per-action sweep weight: 1/starts[start cell] for successful moves
+    # (every successful move is itself counted in moves, so the masked
+    # denominator is always >= 1)
+    starts_at = moves[s.start_flat]
+    wgt = jnp.where(
+        s.is_success_move, 1.0 / jnp.maximum(starts_at, 1.0), 0.0
+    ).astype(f32)
+
+    gs = p_score * p_shot
+
+    def sweep(xT: jax.Array) -> jax.Array:
+        contrib = xT.reshape(-1)[s.end_flat] * wgt
+        payoff = segment_sum(contrib, s.start_flat, n_cells)
+        return gs + p_move * payoff.reshape(w, l)
+
+    xT, it = _value_iteration(sweep, gs, eps, max_iter)
+    return xT, it, p_score, p_shot, p_move
 
 
 @functools.partial(jax.jit, static_argnames=('l', 'w'))

@@ -178,7 +178,18 @@ class ExpectedThreat:
         When True, store the value surface after every iteration in
         ``self.heatmaps`` like the reference. Implies host-stepped iteration
         on the JAX backend; leave False for large grids.
+    solver : {'dense', 'matrix-free'}, optional
+        ``'dense'`` materializes the ``(w*l, w*l)`` transition matrix and
+        sweeps with a mat-vec; ``'matrix-free'`` sweeps with a gather +
+        scatter-add over the successful-move action stream — ``O(actions)``
+        per sweep and ``O(w*l)`` memory, the only tractable form for fine
+        grids (192×125 ⇒ dense T is 2.3 GB fp32). Default: dense up to
+        4096 cells, matrix-free beyond. ``transition_matrix`` stays ``None``
+        on the matrix-free path.
     """
+
+    #: Cell count above which the auto solver goes matrix-free.
+    DENSE_CELL_LIMIT = 4096
 
     def __init__(
         self,
@@ -188,6 +199,7 @@ class ExpectedThreat:
         backend: Optional[str] = None,
         max_iter: int = 1000,
         keep_heatmaps: bool = False,
+        solver: Optional[str] = None,
     ) -> None:
         if backend is None:
             backend = 'jax' if _HAS_JAX else 'pandas'
@@ -195,12 +207,20 @@ class ExpectedThreat:
             raise ValueError(f'unknown backend {backend!r}')
         if backend == 'jax' and not _HAS_JAX:
             raise ImportError('JAX backend requested but jax is not importable')
+        if solver is not None and solver not in ('dense', 'matrix-free'):
+            raise ValueError(f'unknown solver {solver!r}')
         self.l = l
         self.w = w
         self.eps = eps
         self.backend = backend
         self.max_iter = max_iter
         self.keep_heatmaps = keep_heatmaps
+        self._solver = solver
+        if keep_heatmaps and backend == 'jax' and self.solver == 'matrix-free':
+            raise ValueError(
+                "keep_heatmaps on the JAX backend requires solver='dense' "
+                "(use backend='pandas' for matrix-free heatmaps)"
+            )
         self.n_iter: int = 0
         self.heatmaps: List[np.ndarray] = []
         self.xT: np.ndarray = np.zeros((w, l))
@@ -209,18 +229,28 @@ class ExpectedThreat:
         self.move_prob_matrix: Optional[np.ndarray] = None
         self.transition_matrix: Optional[np.ndarray] = None
 
+    @property
+    def solver(self) -> str:
+        """Active solver: as requested, else auto by the *current* grid size.
+
+        Auto selection tracks ``self.w``/``self.l`` so models whose grid is
+        set after construction (e.g. :func:`load_model`) still pick the
+        tractable solver on a later ``fit``.
+        """
+        if self._solver is not None:
+            return self._solver
+        return 'dense' if self.w * self.l <= self.DENSE_CELL_LIMIT else 'matrix-free'
+
     # -- fitting -----------------------------------------------------------
 
-    def _solve_numpy(self) -> None:
-        gs = self.scoring_prob_matrix * self.shot_prob_matrix
-        T = self.transition_matrix
+    def _value_iteration(self, sweep) -> None:
+        """Iterate ``xT <- sweep(xT)`` to convergence (shared host loop)."""
         xT = np.zeros((self.w, self.l))
         if self.keep_heatmaps:
             self.heatmaps.append(xT.copy())
         it = 0
         while it < self.max_iter:
-            payoff = (T @ xT.reshape(-1)).reshape(self.w, self.l)
-            new = gs + self.move_prob_matrix * payoff
+            new = sweep(xT)
             diff = new - xT
             xT = new
             it += 1
@@ -231,13 +261,87 @@ class ExpectedThreat:
         self.xT = xT
         self.n_iter = it
 
+    def _solve_numpy(self) -> None:
+        gs = self.scoring_prob_matrix * self.shot_prob_matrix
+        T = self.transition_matrix
+
+        def sweep(xT: np.ndarray) -> np.ndarray:
+            payoff = (T @ xT.reshape(-1)).reshape(self.w, self.l)
+            return gs + self.move_prob_matrix * payoff
+
+        self._value_iteration(sweep)
+
+    def _solve_numpy_matrix_free(self, actions: pd.DataFrame) -> None:
+        """Sweep by gather + weighted bincount over successful moves (no dense T)."""
+        moves = get_move_actions(actions)
+        sx = moves['start_x'].to_numpy(dtype=np.float64)
+        sy = moves['start_y'].to_numpy(dtype=np.float64)
+        ex = moves['end_x'].to_numpy(dtype=np.float64)
+        ey = moves['end_y'].to_numpy(dtype=np.float64)
+        start_ok = ~np.isnan(sx) & ~np.isnan(sy)
+        end_ok = start_ok & ~np.isnan(ex) & ~np.isnan(ey)
+        success = (moves['result_id'] == spadlconfig.SUCCESS).to_numpy() & end_ok
+
+        n_cells = self.w * self.l
+        start_counts = np.bincount(
+            _get_flat_indexes(sx[start_ok], sy[start_ok], self.l, self.w),
+            minlength=n_cells,
+        ).astype(np.float64)
+        pair_start = _get_flat_indexes(sx[success], sy[success], self.l, self.w)
+        pair_end = _get_flat_indexes(ex[success], ey[success], self.l, self.w)
+        # every successful move is itself counted in start_counts, so the
+        # denominator is always >= 1 here
+        wgt = 1.0 / start_counts[pair_start]
+
+        gs = self.scoring_prob_matrix * self.shot_prob_matrix
+
+        def sweep(xT: np.ndarray) -> np.ndarray:
+            payoff = np.bincount(
+                pair_start,
+                weights=xT.reshape(-1)[pair_end] * wgt,
+                minlength=n_cells,
+            )
+            return gs + self.move_prob_matrix * payoff.reshape(self.w, self.l)
+
+        self._value_iteration(sweep)
+
     def _fit_pandas(self, actions: pd.DataFrame) -> None:
         self.scoring_prob_matrix = scoring_prob(actions, self.l, self.w)
         self.shot_prob_matrix, self.move_prob_matrix = action_prob(actions, self.l, self.w)
-        self.transition_matrix = move_transition_matrix(actions, self.l, self.w)
-        self._solve_numpy()
+        if self.solver == 'matrix-free':
+            self.transition_matrix = None
+            self._solve_numpy_matrix_free(actions)
+        else:
+            self.transition_matrix = move_transition_matrix(actions, self.l, self.w)
+            self._solve_numpy()
 
     def _fit_jax(self, batch: 'ActionBatch') -> None:
+        if self.solver == 'matrix-free':
+            if self.keep_heatmaps:
+                raise ValueError(
+                    "keep_heatmaps on the JAX backend requires solver='dense' "
+                    "(use backend='pandas' for matrix-free heatmaps)"
+                )
+            xT, it, p_score, p_shot, p_move = _xtops.solve_xt_matrix_free(
+                batch.type_id,
+                batch.result_id,
+                batch.start_x,
+                batch.start_y,
+                batch.end_x,
+                batch.end_y,
+                batch.mask,
+                l=self.l,
+                w=self.w,
+                eps=self.eps,
+                max_iter=self.max_iter,
+            )
+            self.scoring_prob_matrix = np.asarray(p_score, dtype=np.float64)
+            self.shot_prob_matrix = np.asarray(p_shot, dtype=np.float64)
+            self.move_prob_matrix = np.asarray(p_move, dtype=np.float64)
+            self.transition_matrix = None
+            self.xT = np.asarray(xT, dtype=np.float64)
+            self.n_iter = int(it)
+            return
         counts = _xtops.xt_counts(
             batch.type_id,
             batch.result_id,

@@ -1,0 +1,99 @@
+"""Tests for the matrix-free xT solver (large-grid path)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu import xthreat
+from socceraction_tpu.spadl import config as spadlconfig
+
+
+@pytest.fixture(scope='module')
+def actions() -> pd.DataFrame:
+    rng = np.random.default_rng(11)
+    n = 600
+    type_id = rng.choice(
+        [spadlconfig.PASS, spadlconfig.DRIBBLE, spadlconfig.CROSS,
+         spadlconfig.SHOT, spadlconfig.actiontypes.index('foul')],
+        size=n,
+        p=[0.4, 0.2, 0.1, 0.15, 0.15],
+    )
+    df = pd.DataFrame(
+        {
+            'game_id': rng.integers(0, 4, size=n),
+            'type_id': type_id,
+            'result_id': rng.integers(0, 2, size=n),
+            'start_x': rng.uniform(0, 105, size=n),
+            'start_y': rng.uniform(0, 68, size=n),
+            'end_x': rng.uniform(0, 105, size=n),
+            'end_y': rng.uniform(0, 68, size=n),
+        }
+    )
+    shots = df['type_id'] == spadlconfig.SHOT
+    df.loc[shots, 'start_x'] = rng.uniform(80, 105, size=int(shots.sum()))
+    return df.sort_values('game_id').reset_index(drop=True)
+
+
+@pytest.mark.parametrize('backend', ['pandas', 'jax'])
+def test_matrix_free_matches_dense(actions, backend):
+    dense = xthreat.ExpectedThreat(l=16, w=12, backend=backend, solver='dense').fit(actions)
+    free = xthreat.ExpectedThreat(
+        l=16, w=12, backend=backend, solver='matrix-free'
+    ).fit(actions)
+    np.testing.assert_allclose(free.xT, dense.xT, atol=1e-5)
+    assert free.n_iter == dense.n_iter
+    assert free.transition_matrix is None
+    np.testing.assert_allclose(free.scoring_prob_matrix, dense.scoring_prob_matrix, atol=1e-6)
+    np.testing.assert_allclose(free.move_prob_matrix, dense.move_prob_matrix, atol=1e-6)
+    np.testing.assert_allclose(
+        free.rate(actions), dense.rate(actions), atol=1e-5, equal_nan=True
+    )
+
+
+def test_backend_parity_matrix_free(actions):
+    ref = xthreat.ExpectedThreat(l=16, w=12, backend='pandas', solver='matrix-free').fit(actions)
+    jx = xthreat.ExpectedThreat(l=16, w=12, backend='jax', solver='matrix-free').fit(actions)
+    np.testing.assert_allclose(jx.xT, ref.xT, atol=1e-5)
+
+
+def test_auto_solver_selection():
+    assert xthreat.ExpectedThreat(l=16, w=12).solver == 'dense'
+    assert xthreat.ExpectedThreat(l=192, w=125).solver == 'matrix-free'
+    with pytest.raises(ValueError):
+        xthreat.ExpectedThreat(solver='sparse-ish')
+
+
+@pytest.mark.parametrize('backend', ['pandas', 'jax'])
+def test_fine_grid_fit(actions, backend):
+    # 192x125 = 24000 cells: dense T would be 4.6 GB fp64 -- must not be
+    # materialized. The fit should run in O(actions) memory.
+    model = xthreat.ExpectedThreat(l=192, w=125, backend=backend).fit(actions)
+    assert model.solver == 'matrix-free'
+    assert model.transition_matrix is None
+    assert model.xT.shape == (125, 192)
+    assert np.isfinite(model.xT).all()
+    assert model.xT.max() > 0
+    ratings = model.rate(actions)
+    ok = (
+        actions['type_id'].isin([spadlconfig.PASS, spadlconfig.DRIBBLE, spadlconfig.CROSS])
+        & (actions['result_id'] == spadlconfig.SUCCESS)
+    ).to_numpy()
+    assert np.isfinite(ratings[ok]).all()
+
+
+def test_fine_grid_backend_parity(actions):
+    ref = xthreat.ExpectedThreat(l=96, w=64, backend='pandas').fit(actions)
+    jx = xthreat.ExpectedThreat(l=96, w=64, backend='jax').fit(actions)
+    assert ref.solver == jx.solver == 'matrix-free'
+    np.testing.assert_allclose(jx.xT, ref.xT, atol=1e-5)
+
+
+def test_keep_heatmaps_matrix_free(actions):
+    model = xthreat.ExpectedThreat(
+        l=16, w=12, backend='pandas', solver='matrix-free', keep_heatmaps=True
+    ).fit(actions)
+    assert len(model.heatmaps) == model.n_iter + 1
+    with pytest.raises(ValueError):
+        xthreat.ExpectedThreat(
+            l=16, w=12, backend='jax', solver='matrix-free', keep_heatmaps=True
+        ).fit(actions)
