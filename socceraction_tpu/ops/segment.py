@@ -118,10 +118,19 @@ def segment_sum_pallas(
 def segment_sum_xla(
     values: jax.Array, segment_ids: jax.Array, num_segments: int
 ) -> jax.Array:
-    """XLA scatter-add segment-sum (the portable fallback)."""
+    """XLA scatter-add segment-sum (the portable fallback).
+
+    ``mode='drop'`` matches the Pallas path: ids outside
+    ``[0, num_segments)`` — including negatives — contribute nothing
+    (default scatter semantics would wrap negative ids).
+    """
     values = values.reshape(-1).astype(jnp.float32)
     segment_ids = segment_ids.reshape(-1)
-    return jnp.zeros(num_segments, jnp.float32).at[segment_ids].add(values)
+    return (
+        jnp.zeros(num_segments, jnp.float32)
+        .at[segment_ids]
+        .add(values, mode='drop')
+    )
 
 
 def _method() -> str:
@@ -140,9 +149,8 @@ def segment_sum(
 ) -> jax.Array:
     """Sum ``values`` into ``num_segments`` buckets by ``segment_ids``.
 
-    Ids outside ``[0, num_segments)`` are dropped by the Pallas path; the
-    XLA path follows ``.at[].add`` mode='drop' semantics for out-of-range
-    ids. Dispatches per the module docstring.
+    Ids outside ``[0, num_segments)`` (including negatives) are dropped on
+    both paths. Dispatches per the module docstring.
     """
     method = method or _method()
     if method == 'auto':

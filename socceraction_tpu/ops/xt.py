@@ -23,7 +23,7 @@ pitch), exactly like reference ``xthreat.py:35-37``.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -255,7 +255,7 @@ def solve_xt(
     return _value_iteration(sweep, gs, eps, max_iter)
 
 
-@functools.partial(jax.jit, static_argnames=('l', 'w', 'max_iter'))
+@functools.partial(jax.jit, static_argnames=('l', 'w', 'max_iter', 'axis_name'))
 def solve_xt_matrix_free(
     type_id: jax.Array,
     result_id: jax.Array,
@@ -269,6 +269,7 @@ def solve_xt_matrix_free(
     w: int,
     eps: float = 1e-5,
     max_iter: int = 1000,
+    axis_name: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Value iteration without materializing the transition matrix.
 
@@ -285,8 +286,10 @@ def solve_xt_matrix_free(
     i.e. one gather at the move end cells and one scatter-add
     (``segment_sum``) by start cell per sweep — ``O(n_actions)`` work and
     ``O(w·l)`` memory instead of ``O((w·l)²)``. Both sides are additive
-    across device shards, so the multi-chip form is a per-shard
-    segment-sum followed by a ``psum`` of the payoff vector.
+    across device shards: with ``axis_name`` set (inside ``shard_map``
+    over a game-sharded batch), the count vectors and each sweep's payoff
+    are ``psum``-reduced over that axis, so every device iterates the
+    identical global surface while touching only its local actions.
 
     Returns
     -------
@@ -298,17 +301,21 @@ def solve_xt_matrix_free(
     s = _action_stream(type_id, result_id, start_x, start_y, end_x, end_y, mask, l, w)
     n_cells = w * l
     f32 = jnp.float32
+
+    def _allreduce(x: jax.Array) -> jax.Array:
+        return jax.lax.psum(x, axis_name) if axis_name else x
+
     # segment_sum dispatches to the Pallas blocked one-hot kernel on TPU
     # (ops/segment.py) and XLA scatter elsewhere
-    shots = segment_sum(s.is_shot.astype(f32), s.start_flat, n_cells)
-    goals = segment_sum(s.is_goal.astype(f32), s.start_flat, n_cells)
-    moves = segment_sum(s.is_move.astype(f32), s.start_flat, n_cells)
+    shots = _allreduce(segment_sum(s.is_shot.astype(f32), s.start_flat, n_cells))
+    goals = _allreduce(segment_sum(s.is_goal.astype(f32), s.start_flat, n_cells))
+    moves = _allreduce(segment_sum(s.is_move.astype(f32), s.start_flat, n_cells))
 
     p_score, p_shot, p_move = _cell_probabilities(shots, goals, moves, l, w)
 
     # per-action sweep weight: 1/starts[start cell] for successful moves
-    # (every successful move is itself counted in moves, so the masked
-    # denominator is always >= 1)
+    # (every successful move is itself counted in the *global* moves
+    # vector, so the masked denominator is always >= 1)
     starts_at = moves[s.start_flat]
     wgt = jnp.where(
         s.is_success_move, 1.0 / jnp.maximum(starts_at, 1.0), 0.0
@@ -318,7 +325,7 @@ def solve_xt_matrix_free(
 
     def sweep(xT: jax.Array) -> jax.Array:
         contrib = xT.reshape(-1)[s.end_flat] * wgt
-        payoff = segment_sum(contrib, s.start_flat, n_cells)
+        payoff = _allreduce(segment_sum(contrib, s.start_flat, n_cells))
         return gs + p_move * payoff.reshape(w, l)
 
     xT, it = _value_iteration(sweep, gs, eps, max_iter)

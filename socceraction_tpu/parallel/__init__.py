@@ -23,7 +23,7 @@ from .mesh import (
     replicated,
     shard_batch,
 )
-from .xt import sharded_xt_counts, sharded_xt_fit
+from .xt import sharded_xt_counts, sharded_xt_fit, sharded_xt_fit_matrix_free
 from .vaep import make_train_step, sharded_rate, train_distributed
 
 __all__ = [
@@ -34,6 +34,7 @@ __all__ = [
     'shard_batch',
     'sharded_xt_counts',
     'sharded_xt_fit',
+    'sharded_xt_fit_matrix_free',
     'make_train_step',
     'sharded_rate',
     'train_distributed',

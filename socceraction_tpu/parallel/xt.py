@@ -22,11 +22,12 @@ from ..ops.xt import (
     XTCounts,
     XTProbabilities,
     solve_xt,
+    solve_xt_matrix_free,
     xt_counts,
     xt_probabilities,
 )
 
-__all__ = ['sharded_xt_counts', 'sharded_xt_fit']
+__all__ = ['sharded_xt_counts', 'sharded_xt_fit', 'sharded_xt_fit_matrix_free']
 
 
 def _local_counts(batch: ActionBatch, l: int, w: int) -> XTCounts:
@@ -81,3 +82,46 @@ def sharded_xt_fit(
     rep = NamedSharding(mesh, P())
     grid = jax.device_put(grid, rep)
     return grid, probs, it
+
+
+def sharded_xt_fit_matrix_free(
+    batch: ActionBatch,
+    mesh: Mesh,
+    *,
+    l: int,
+    w: int,
+    eps: float = 1e-5,
+    max_iter: int = 1000,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fine-grid sharded xT fit: per-shard segment-sums, psum'd sweeps.
+
+    The matrix-free twin of :func:`sharded_xt_fit` for grids whose dense
+    transition matrix is intractable (e.g. 192×125). Each device
+    segment-sums its local game shard; the count vectors and every
+    value-iteration payoff are ``psum``-reduced over the ``'games'`` axis,
+    so all devices iterate the identical global surface
+    (:func:`~socceraction_tpu.ops.xt.solve_xt_matrix_free` with
+    ``axis_name='games'``).
+
+    Returns ``(grid, n_iterations)``; the grid is replicated.
+    """
+
+    def local_fit(b: ActionBatch):
+        xT, it, _, _, _ = solve_xt_matrix_free(
+            b.type_id,
+            b.result_id,
+            b.start_x,
+            b.start_y,
+            b.end_x,
+            b.end_y,
+            b.mask,
+            l=l,
+            w=w,
+            eps=eps,
+            max_iter=max_iter,
+            axis_name='games',
+        )
+        return xT, it
+
+    fn = jax.shard_map(local_fit, mesh=mesh, in_specs=P('games'), out_specs=P())
+    return fn(batch)

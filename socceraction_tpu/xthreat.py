@@ -125,30 +125,45 @@ def action_prob(
     return _safe_divide(shotmatrix, total), _safe_divide(movematrix, total)
 
 
-def move_transition_matrix(actions: pd.DataFrame, l: int = N, w: int = M) -> np.ndarray:
-    """P(successful move from cell i ends in cell j).
+def _successful_move_pairs(
+    actions: pd.DataFrame, l: int, w: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """``(start_counts, pair_start, pair_end)`` of the move stream.
 
-    Normalized by the count of *all* moves started in cell i (successful or
-    not), like reference ``xthreat.py:206-216``.
+    The single source of the parity-critical NaN-mask / flat-index /
+    normalization semantics for the pandas backend (shared by the dense
+    transition-matrix build and the matrix-free sweeps). Moves with NaN
+    coordinates are excluded (consistent with ``_count``'s NaN filter; the
+    reference's float->int cast on NaN here is undefined behavior that we
+    do not reproduce). ``start_counts`` counts *all* valid-start moves,
+    successful or not, like reference ``xthreat.py:206-216``; the pairs
+    cover only successful moves with valid end points.
     """
     moves = get_move_actions(actions)
     sx = moves['start_x'].to_numpy(dtype=np.float64)
     sy = moves['start_y'].to_numpy(dtype=np.float64)
     ex = moves['end_x'].to_numpy(dtype=np.float64)
     ey = moves['end_y'].to_numpy(dtype=np.float64)
-    # Moves with NaN coordinates are excluded (consistent with _count's NaN
-    # filter; the reference's float->int cast on NaN here is undefined
-    # behavior that we do not reproduce).
     start_ok = ~np.isnan(sx) & ~np.isnan(sy)
     end_ok = start_ok & ~np.isnan(ex) & ~np.isnan(ey)
-    start = _get_flat_indexes(sx[start_ok], sy[start_ok], l, w)
-    pair_start = _get_flat_indexes(sx[end_ok], sy[end_ok], l, w)
-    pair_end = _get_flat_indexes(ex[end_ok], ey[end_ok], l, w)
-    success = (moves['result_id'] == spadlconfig.SUCCESS).to_numpy()[end_ok]
+    success = (moves['result_id'] == spadlconfig.SUCCESS).to_numpy() & end_ok
 
+    start = _get_flat_indexes(sx[start_ok], sy[start_ok], l, w)
+    start_counts = np.bincount(start, minlength=w * l).astype(np.float64)
+    pair_start = _get_flat_indexes(sx[success], sy[success], l, w)
+    pair_end = _get_flat_indexes(ex[success], ey[success], l, w)
+    return start_counts, pair_start, pair_end
+
+
+def move_transition_matrix(actions: pd.DataFrame, l: int = N, w: int = M) -> np.ndarray:
+    """P(successful move from cell i ends in cell j).
+
+    Normalized by the count of *all* moves started in cell i (successful or
+    not), like reference ``xthreat.py:206-216``.
+    """
     n_cells = w * l
-    start_counts = np.bincount(start, minlength=n_cells).astype(np.float64)
-    pair = pair_start[success] * n_cells + pair_end[success]
+    start_counts, pair_start, pair_end = _successful_move_pairs(actions, l, w)
+    pair = pair_start * n_cells + pair_end
     counts = np.bincount(pair, minlength=n_cells * n_cells).reshape(n_cells, n_cells)
     return _safe_divide(counts.astype(np.float64), start_counts[:, None])
 
@@ -216,11 +231,9 @@ class ExpectedThreat:
         self.max_iter = max_iter
         self.keep_heatmaps = keep_heatmaps
         self._solver = solver
-        if keep_heatmaps and backend == 'jax' and self.solver == 'matrix-free':
-            raise ValueError(
-                "keep_heatmaps on the JAX backend requires solver='dense' "
-                "(use backend='pandas' for matrix-free heatmaps)"
-            )
+        # (keep_heatmaps + jax + matrix-free is rejected in _fit_jax: the
+        # solver auto-resolution tracks w/l, which may change after
+        # construction, so fit time is the only reliable point to check)
         self.n_iter: int = 0
         self.heatmaps: List[np.ndarray] = []
         self.xT: np.ndarray = np.zeros((w, l))
@@ -273,24 +286,12 @@ class ExpectedThreat:
 
     def _solve_numpy_matrix_free(self, actions: pd.DataFrame) -> None:
         """Sweep by gather + weighted bincount over successful moves (no dense T)."""
-        moves = get_move_actions(actions)
-        sx = moves['start_x'].to_numpy(dtype=np.float64)
-        sy = moves['start_y'].to_numpy(dtype=np.float64)
-        ex = moves['end_x'].to_numpy(dtype=np.float64)
-        ey = moves['end_y'].to_numpy(dtype=np.float64)
-        start_ok = ~np.isnan(sx) & ~np.isnan(sy)
-        end_ok = start_ok & ~np.isnan(ex) & ~np.isnan(ey)
-        success = (moves['result_id'] == spadlconfig.SUCCESS).to_numpy() & end_ok
-
         n_cells = self.w * self.l
-        start_counts = np.bincount(
-            _get_flat_indexes(sx[start_ok], sy[start_ok], self.l, self.w),
-            minlength=n_cells,
-        ).astype(np.float64)
-        pair_start = _get_flat_indexes(sx[success], sy[success], self.l, self.w)
-        pair_end = _get_flat_indexes(ex[success], ey[success], self.l, self.w)
+        start_counts, pair_start, pair_end = _successful_move_pairs(
+            actions, self.l, self.w
+        )
         # every successful move is itself counted in start_counts, so the
-        # denominator is always >= 1 here
+        # denominator is always >= 1
         wgt = 1.0 / start_counts[pair_start]
 
         gs = self.scoring_prob_matrix * self.shot_prob_matrix

@@ -134,3 +134,20 @@ def test_train_distributed_and_sharded_rate(batch, spadl_actions, home_team_id):
         unpack_values(single, batch),
         rtol=1e-4, atol=1e-5,
     )
+
+
+def test_sharded_matrix_free_fit_matches_single_device(batch):
+    from socceraction_tpu.ops.xt import solve_xt_matrix_free
+    from socceraction_tpu.parallel import sharded_xt_fit_matrix_free
+
+    mesh = make_mesh()
+    many = _multi_game(batch, 8)
+    sharded = shard_batch(many, mesh)
+    grid, it = sharded_xt_fit_matrix_free(sharded, mesh, l=24, w=16)
+
+    ref_grid, ref_it, _, _, _ = solve_xt_matrix_free(
+        many.type_id, many.result_id, many.start_x, many.start_y,
+        many.end_x, many.end_y, many.mask, l=24, w=16,
+    )
+    assert int(it) == int(ref_it)
+    np.testing.assert_allclose(np.asarray(grid), np.asarray(ref_grid), atol=1e-6)
