@@ -173,3 +173,62 @@ class MLPClassifier:
         X = jnp.asarray(np.asarray(X, dtype=np.float32))
         p1 = np.asarray(self.predict_proba_device(X))
         return np.stack([1.0 - p1, p1], axis=1)
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Save the fitted classifier to one ``.npz`` file.
+
+        Stores the flax parameter pytree (msgpack bytes), the input
+        standardization statistics and the hyperparameters; no reference
+        counterpart (the reference's VAEP classifiers have no save/load
+        API at all, SURVEY §5 "Checkpoint / resume").
+        """
+        import json
+
+        from flax import serialization
+
+        if self.params is None:
+            raise ValueError('cannot save an unfitted classifier')
+        hyper = {
+            'hidden': list(self.hidden),
+            'learning_rate': self.learning_rate,
+            'batch_size': self.batch_size,
+            'max_epochs': self.max_epochs,
+            'patience': self.patience,
+            'pos_weight': self.pos_weight,
+            'seed': self.seed,
+        }
+        # write through a handle so np.savez honors the exact path instead
+        # of appending '.npz'
+        with open(path, 'wb') as f:
+            np.savez(
+                f,
+                params_msgpack=np.frombuffer(
+                    serialization.to_bytes(self.params), dtype=np.uint8
+                ),
+                mean=self.mean_,
+                std=self.std_,
+                hyper_json=np.array(json.dumps(hyper)),
+            )
+
+    @classmethod
+    def load(cls, path: str) -> 'MLPClassifier':
+        """Load a classifier saved with :meth:`save`."""
+        import json
+
+        from flax import serialization
+
+        with np.load(path, allow_pickle=False) as data:
+            hyper = json.loads(str(data['hyper_json']))
+            mean = data['mean']
+            std = data['std']
+            raw = data['params_msgpack'].tobytes()
+        clf = cls(**hyper)
+        clf.mean_ = mean.astype(np.float32)
+        clf.std_ = std.astype(np.float32)
+        template = clf.module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, mean.shape[0]))
+        )
+        clf.params = serialization.from_bytes(template, raw)
+        return clf

@@ -331,3 +331,97 @@ class VAEP:
                 'auroc': roc_auc_score(y[col], y_hat[col]),
             }
         return scores
+
+    # -- persistence -------------------------------------------------------
+
+    def save_model(self, path: str) -> None:
+        """Save the fitted model (config + probability heads) to a directory.
+
+        The reference's VAEP classifiers have no save/load API (SURVEY §5
+        "Checkpoint / resume": model-level persistence exists for xT only);
+        this subsystem is new. MLP heads are stored as flax-msgpack ``.npz``
+        (:meth:`~socceraction_tpu.ml.mlp.MLPClassifier.save`), tree heads
+        with pickle. Feature transformers are stored *by name* and resolved
+        against the feature module on load, so only registry transformers
+        (not ad-hoc closures) round-trip.
+        """
+        import json
+        import os
+        import pickle
+
+        if not self._models:
+            raise NotFittedError('fit the model before saving')
+        for fn in self.xfns:
+            name = getattr(fn, '__name__', None)
+            if name is None or getattr(self._fs, name, None) is not fn:
+                raise ValueError(
+                    f'cannot serialize custom feature transformer {fn!r}; '
+                    'only named transformers from the feature module are '
+                    'supported'
+                )
+        os.makedirs(os.path.join(path, 'models'), exist_ok=True)
+        heads = {}
+        for col, model in self._models.items():
+            if isinstance(model, MLPClassifier):
+                heads[col] = 'mlp'
+                model.save(os.path.join(path, 'models', f'{col}.npz'))
+            else:
+                heads[col] = 'pickle'
+                with open(os.path.join(path, 'models', f'{col}.pkl'), 'wb') as f:
+                    pickle.dump(model, f)
+        meta = {
+            'format_version': 1,
+            'class': type(self).__name__,
+            'nb_prev_actions': self.nb_prev_actions,
+            'backend': self.backend,
+            'xfns': [fn.__name__ for fn in self.xfns],
+            'heads': heads,
+        }
+        with open(os.path.join(path, 'meta.json'), 'w') as f:
+            json.dump(meta, f, indent=2)
+
+    @classmethod
+    def _load_into(cls, path: str) -> 'VAEP':
+        import json
+        import os
+        import pickle
+
+        with open(os.path.join(path, 'meta.json')) as f:
+            meta = json.load(f)
+        model = cls(
+            xfns=[getattr(cls._fs, name) for name in meta['xfns']],
+            nb_prev_actions=meta['nb_prev_actions'],
+            backend=meta['backend'],
+        )
+        for col, kind in meta['heads'].items():
+            if kind == 'mlp':
+                model._models[col] = MLPClassifier.load(
+                    os.path.join(path, 'models', f'{col}.npz')
+                )
+            else:
+                with open(os.path.join(path, 'models', f'{col}.pkl'), 'rb') as f:
+                    model._models[col] = pickle.load(f)
+        return model
+
+
+def load_model(path: str) -> VAEP:
+    """Load a model saved with :meth:`VAEP.save_model`.
+
+    Dispatches on the stored class name, so Atomic-VAEP checkpoints come
+    back as :class:`~socceraction_tpu.atomic.vaep.base.AtomicVAEP`.
+    """
+    import json
+    import os
+
+    with open(os.path.join(path, 'meta.json')) as f:
+        meta = json.load(f)
+    if meta['class'] == 'AtomicVAEP':
+        from ..atomic.vaep.base import AtomicVAEP
+
+        return AtomicVAEP._load_into(path)
+    if meta['class'] != 'VAEP':
+        raise ValueError(
+            f'checkpoint was saved by unknown model class {meta["class"]!r}; '
+            'load it with <YourClass>._load_into(path)'
+        )
+    return VAEP._load_into(path)

@@ -1,0 +1,85 @@
+"""Tests for VAEP/MLP model persistence (new subsystem; no reference API)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.atomic.spadl import convert_to_atomic
+from socceraction_tpu.atomic.vaep.base import AtomicVAEP
+from socceraction_tpu.ml.mlp import MLPClassifier
+from socceraction_tpu.vaep.base import VAEP, NotFittedError, load_model
+
+
+@pytest.fixture(scope='module')
+def game(home_team_id):
+    return pd.Series({'game_id': 8657, 'home_team_id': home_team_id})
+
+
+def test_mlp_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 7)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=300) > 0).astype(np.float32)
+    clf = MLPClassifier(hidden=(16,), max_epochs=5, batch_size=64).fit(X, y)
+    path = str(tmp_path / 'clf.npz')
+    clf.save(path)
+    loaded = MLPClassifier.load(path)
+    assert loaded.hidden == (16,)
+    np.testing.assert_allclose(loaded.predict_proba(X), clf.predict_proba(X), atol=1e-6)
+
+
+def test_mlp_unfitted_save(tmp_path):
+    with pytest.raises(ValueError):
+        MLPClassifier().save(str(tmp_path / 'x.npz'))
+
+
+@pytest.mark.parametrize('learner', ['sklearn', 'mlp'])
+def test_vaep_roundtrip(tmp_path, game, spadl_actions, learner):
+    np.random.seed(0)
+    model = VAEP(backend='pandas')
+    X = model.compute_features(game, spadl_actions)
+    y = model.compute_labels(game, spadl_actions)
+    model.fit(X, y, learner=learner)
+    ratings = model.rate(game, spadl_actions, X)
+
+    path = str(tmp_path / 'vaep')
+    model.save_model(path)
+    loaded = load_model(path)
+    assert type(loaded) is VAEP
+    assert loaded.nb_prev_actions == model.nb_prev_actions
+    assert loaded.feature_names == model.feature_names
+    pd.testing.assert_frame_equal(loaded.rate(game, spadl_actions, X), ratings)
+
+
+def test_atomic_vaep_roundtrip(tmp_path, game, spadl_actions):
+    np.random.seed(0)
+    atomic_actions = convert_to_atomic(spadl_actions)
+    model = AtomicVAEP(backend='pandas')
+    X = model.compute_features(game, atomic_actions)
+    y = model.compute_labels(game, atomic_actions)
+    model.fit(X, y, learner='sklearn')
+
+    path = str(tmp_path / 'atomic')
+    model.save_model(path)
+    loaded = load_model(path)
+    assert type(loaded) is AtomicVAEP
+    pd.testing.assert_frame_equal(
+        loaded.rate(game, atomic_actions, X), model.rate(game, atomic_actions, X)
+    )
+
+
+def test_save_requires_fit(tmp_path, game):
+    with pytest.raises(NotFittedError):
+        VAEP(backend='pandas').save_model(str(tmp_path / 'x'))
+
+
+def test_save_rejects_custom_transformer(tmp_path, game, spadl_actions):
+    def my_feature(states):
+        return pd.DataFrame({'zero': np.zeros(len(states[0]))})
+
+    np.random.seed(0)
+    model = VAEP(backend='pandas', xfns=[my_feature])
+    X = model.compute_features(game, spadl_actions)
+    y = model.compute_labels(game, spadl_actions)
+    model.fit(X, y, learner='sklearn')
+    with pytest.raises(ValueError, match='custom feature transformer'):
+        model.save_model(str(tmp_path / 'x'))
