@@ -1,0 +1,78 @@
+"""Build an expected-goals (xG) model from SPADL shots.
+
+Library-API equivalent of the reference's
+``EXTRA-build-expected-goals-model.ipynb``: gamestate features restricted
+to shot actions, ``goal_from_shot`` labels, one binary classifier, Brier +
+ROC-AUC report. Runs against the checked-in StatsBomb fixture by default.
+
+    python examples/build_xg_model.py --learner sklearn
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# allow running from a source checkout without installation
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+import pandas as pd
+
+_FIXTURE = os.path.join(
+    os.path.dirname(__file__), os.pardir, 'tests', 'datasets', 'statsbomb', 'raw'
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--data', default=_FIXTURE, help='StatsBomb open-data root')
+    ap.add_argument('--learner', default='sklearn',
+                    choices=['sklearn', 'xgboost', 'mlp'])
+    args = ap.parse_args()
+
+    from sklearn.metrics import brier_score_loss, roc_auc_score
+
+    from socceraction_tpu.data.statsbomb import StatsBombLoader
+    from socceraction_tpu.ml.learners import LEARNERS
+    from socceraction_tpu.spadl import add_names, config as spadlcfg
+    from socceraction_tpu.spadl import statsbomb as sb_convert
+    from socceraction_tpu.vaep import features as fs
+    from socceraction_tpu.vaep.labels import goal_from_shot
+
+    xfns = [fs.actiontype_onehot, fs.bodypart_onehot, fs.startlocation,
+            fs.startpolar, fs.movement, fs.time_delta]
+
+    loader = StatsBombLoader(getter='local', root=args.data)
+    X_parts, y_parts = [], []
+    for comp in loader.competitions().itertuples(index=False):
+        for game in loader.games(comp.competition_id, comp.season_id).itertuples(index=False):
+            events = loader.events(game.game_id)
+            actions = add_names(
+                sb_convert.convert_to_actions(events, game.home_team_id)
+            )
+            states = fs.play_left_to_right(
+                fs.gamestates(actions, 2), game.home_team_id
+            )
+            feats = pd.concat([fn(states) for fn in xfns], axis=1)
+            labels = goal_from_shot(actions)
+            shots = actions['type_id'].isin(spadlcfg.SHOT_LIKE).to_numpy()
+            X_parts.append(feats[shots])
+            y_parts.append(labels[shots])
+    X = pd.concat(X_parts, ignore_index=True)
+    y = pd.concat(y_parts, ignore_index=True)['goal_from_shot']
+    print(f'{len(X)} shots, {int(y.sum())} goals')
+
+    clf = LEARNERS[args.learner](X, y.astype(int), eval_set=None)
+    p = clf.predict_proba(X)[:, 1]
+    print(f'train Brier {brier_score_loss(y, p):.5f}')
+    if y.nunique() > 1:
+        print(f'train AUC   {roc_auc_score(y, p):.5f}')
+    print('top xG shots:')
+    out = pd.DataFrame({'xG': p, 'goal': y.to_numpy()})
+    print(out.sort_values('xG', ascending=False).head(5).to_string(index=False))
+
+
+if __name__ == '__main__':
+    main()

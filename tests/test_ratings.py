@@ -1,0 +1,64 @@
+"""Tests for player-level rating aggregation (notebook-4 semantics)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.ratings import player_ratings
+
+
+@pytest.fixture()
+def rated():
+    return pd.DataFrame(
+        {
+            'player_id': [1, 1, 1, 2, 2, 3],
+            'vaep_value': [0.1, 0.2, np.nan, 0.4, 0.1, 0.05],
+            'offensive_value': [0.1, 0.1, 0.0, 0.3, 0.1, 0.05],
+            'defensive_value': [0.0, 0.1, 0.0, 0.1, 0.0, 0.0],
+        }
+    )
+
+
+def test_sums_and_counts(rated):
+    table = player_ratings(rated)
+    row1 = table[table['player_id'] == 1].iloc[0]
+    assert row1['count'] == 3
+    assert row1['vaep_value'] == pytest.approx(0.3)
+    # sorted by total vaep, descending
+    assert table['player_id'].tolist() == [2, 1, 3]
+
+
+def test_name_merge_prefers_nickname(rated):
+    players = pd.DataFrame(
+        {
+            'player_id': [1, 2, 3],
+            'player_name': ['Aaron Long', 'Bob Short', 'Cara Mid'],
+            'nickname': ['Az', '', None],
+        }
+    )
+    table = player_ratings(rated, players=players)
+    names = dict(zip(table['player_id'], table['player_name']))
+    assert names[1] == 'Az'  # nickname used when non-empty
+    assert names[2] == 'Bob Short'
+    assert names[3] == 'Cara Mid'
+    assert 'nickname' not in table.columns
+
+
+def test_minutes_normalization_and_cut(rated):
+    pg = pd.DataFrame(
+        {
+            'player_id': [1, 1, 2, 3],
+            'minutes_played': [90, 90, 270, 45],
+        }
+    )
+    table = player_ratings(rated, player_games=pg, min_minutes=180)
+    # player 3 (45 min) is cut; player 1 has exactly 180 -> cut too (strict >)
+    assert table['player_id'].tolist() == [2]
+    row = table.iloc[0]
+    assert row['vaep_rating'] == pytest.approx(0.5 * 90 / 270)
+    assert row['offensive_rating'] == pytest.approx(0.4 * 90 / 270)
+
+
+def test_requires_value_columns():
+    with pytest.raises(ValueError):
+        player_ratings(pd.DataFrame({'player_id': [1]}))
