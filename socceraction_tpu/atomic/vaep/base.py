@@ -53,6 +53,7 @@ class AtomicVAEP(VAEP):
     _compute_features_kernel = staticmethod(_atomicops.compute_features)
     _labels_kernel = staticmethod(_atomicops.scores_concedes)
     _formula_kernel = staticmethod(_atomicops.vaep_values)
+    _fused_registry = 'atomic'
 
     def _default_xfns(self) -> List[fs.FeatureTransfomer]:
         return list(xfns_default)

@@ -232,3 +232,30 @@ class MLPClassifier:
         )
         clf.params = serialization.from_bytes(template, raw)
         return clf
+
+    def predict_proba_device_batch(
+        self, batch, *, names, k, registry: str = 'standard'
+    ) -> jax.Array:
+        """P(y=1) per action of a packed batch via the fused first layer.
+
+        Equivalent to ``predict_proba_device(compute_features(batch, ...))``
+        but applies one-hot feature blocks as first-layer row gathers
+        (:mod:`socceraction_tpu.ops.fused`), never materializing the
+        feature tensor. ``names``/``k``/``registry`` must match the layout
+        the classifier was trained on ('standard' or 'atomic').
+        """
+        from ..ops.fused import REGISTRIES, fused_mlp_logits
+
+        if self.params is None:
+            raise ValueError('classifier is not fitted')
+        logits = fused_mlp_logits(
+            self.params,
+            batch,
+            names=tuple(names),
+            k=k,
+            hidden_layers=len(self.hidden),
+            mean=self.mean_,
+            std=self.std_,
+            registry=REGISTRIES[registry],
+        )
+        return jax.nn.sigmoid(logits)

@@ -1,0 +1,297 @@
+"""Fused first-layer MLP application: one-hot features as embedding gathers.
+
+With the default transformer set, the overwhelming majority of VAEP feature
+columns are one-hots (for ``k = 3``: 69 actiontype + 18 result + 414
+actiontype×result + 12 bodypart = 513 of 568 columns). Materializing that
+tensor costs ~1.9 GB of HBM per 850k actions and the first dense layer then
+multiplies mostly zeros.
+
+For a one-hot block, ``onehot(id) @ W == W[id]`` — a row gather. This
+module applies an MLP's first layer without ever materializing the one-hot
+columns:
+
+``h = b + Σ_blocks W_block[id_block] + x_dense @ W_dense``
+
+where only the small dense sub-tensor (time, locations, polar, movement,
+deltas, goalscore, ...) is built. Input standardization ``(x - μ)/σ`` is an
+affine map, so it folds into the weights (``W/σ``) and bias
+(``b - Σ_j μ_j W_j / σ_j``) and the gather identity still holds.
+
+The result is numerically the same computation reordered (parity ≤ 1e-6 of
+the materialized path); it is used by the flagship rating entry point, by
+:meth:`MLPClassifier.predict_proba_device_batch`, and by the jitted
+two-head rating path (:func:`fused_pair_probs`) behind ``VAEP.rate_batch``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..atomic.spadl import config as atomicconfig
+from ..spadl import config as spadlconfig
+from . import atomic as _atomicops
+from .atomic import ATOMIC_KERNELS, _AtomicStates
+from .features import KERNELS, _States
+
+__all__ = [
+    'FusedRegistry',
+    'STANDARD_REGISTRY',
+    'ATOMIC_REGISTRY',
+    'REGISTRIES',
+    'onehot_blocks',
+    'fused_mlp_logits',
+    'fused_pair_probs',
+]
+
+_N_TYPES = len(spadlconfig.actiontypes)
+_N_RESULTS = len(spadlconfig.results)
+_N_BODYPARTS = len(spadlconfig.bodyparts)
+
+
+class FusedRegistry(NamedTuple):
+    """How to fuse one feature family's layout into a first dense layer."""
+
+    kernels: Dict[str, Any]  # name -> dense-block kernel (feature registry)
+    make_states: Callable[[Any, int], Any]  # batch, k -> per-state views
+    onehot_specs: Dict[str, Tuple[int, Callable[[Any, int], jax.Array]]]
+    # name -> (columns per state, id extractor)
+
+
+#: Standard SPADL layout. The id spaces and type-major actiontype×result
+#: flattening match the column order emitted by
+#: :func:`socceraction_tpu.ops.features.compute_features`.
+STANDARD_REGISTRY = FusedRegistry(
+    kernels=KERNELS,
+    make_states=_States,
+    onehot_specs={
+        'actiontype_onehot': (_N_TYPES, lambda s, i: s.type_id[i]),
+        'result_onehot': (_N_RESULTS, lambda s, i: s.result_id[i]),
+        'actiontype_result_onehot': (
+            _N_TYPES * _N_RESULTS,
+            lambda s, i: s.type_id[i] * _N_RESULTS + s.result_id[i],
+        ),
+        'bodypart_onehot': (_N_BODYPARTS, lambda s, i: s.bodypart_id[i]),
+    },
+)
+
+# Atomic actiontype one-hot columns are *merged groups* (corner*/freekick*
+# subtypes share a column): map type id -> group index with a small LUT so
+# the group one-hot is still a single row gather.
+_ATOMIC_GROUP_OF_TYPE = jnp.asarray(
+    [
+        list(dict.fromkeys(atomicconfig.actiontypes)).index(t)
+        for t in atomicconfig.actiontypes
+    ],
+    dtype=jnp.int32,
+)
+_N_ATOMIC_GROUPS = int(_ATOMIC_GROUP_OF_TYPE.max()) + 1
+
+#: Atomic-SPADL layout (:mod:`socceraction_tpu.ops.atomic`).
+ATOMIC_REGISTRY = FusedRegistry(
+    kernels=ATOMIC_KERNELS,
+    make_states=_AtomicStates,
+    onehot_specs={
+        'actiontype_onehot': (
+            _N_ATOMIC_GROUPS,
+            lambda s, i: _ATOMIC_GROUP_OF_TYPE[s.type_id[i]],
+        ),
+        'bodypart_onehot': (
+            len(atomicconfig.bodyparts),
+            lambda s, i: s.bodypart_id[i],
+        ),
+    },
+)
+
+
+#: Name -> registry lookup (used by the model classes so they can refer to
+#: a registry without importing this module at class-definition time).
+REGISTRIES: Dict[str, FusedRegistry] = {
+    'standard': STANDARD_REGISTRY,
+    'atomic': ATOMIC_REGISTRY,
+}
+
+
+def onehot_blocks(
+    names: Tuple[str, ...], registry: FusedRegistry = STANDARD_REGISTRY
+) -> List[str]:
+    """The subset of ``names`` applied as gathers instead of matmuls."""
+    return [n for n in names if n in registry.onehot_specs]
+
+
+def fused_mlp_logits(
+    params: Any,
+    batch: Any,
+    *,
+    names: Tuple[str, ...],
+    k: int,
+    hidden_layers: int,
+    mean: Optional[jax.Array] = None,
+    std: Optional[jax.Array] = None,
+    registry: FusedRegistry = STANDARD_REGISTRY,
+) -> jax.Array:
+    """Logits of an :class:`~socceraction_tpu.ml.mlp._MLP` over a batch.
+
+    Equivalent to ``module.apply(params, standardize(compute_features(...)))``
+    but with one-hot feature blocks applied as first-layer row gathers.
+
+    Parameters
+    ----------
+    params
+        Flax param pytree of ``_MLP(hidden)`` (``Dense_0 ..
+        Dense_{hidden_layers}``; the last layer has one output unit).
+    batch
+        A packed :class:`~socceraction_tpu.core.batch.ActionBatch`.
+    names, k
+        Feature transformer names and game-state depth (must match the
+        feature layout the MLP was trained on).
+    hidden_layers
+        Number of hidden layers (``len(hidden)`` of the ``_MLP``).
+    mean, std
+        Optional standardization statistics over the feature columns; when
+        given they are folded into the first layer's weights and bias.
+    registry
+        Feature-family layout (:data:`STANDARD_REGISTRY` or
+        :data:`ATOMIC_REGISTRY`).
+
+    Returns
+    -------
+    jax.Array
+        ``(G, A)`` logits.
+    """
+    leaves = params['params']
+    d0 = leaves['Dense_0']
+    Wk = jnp.asarray(d0['kernel'])
+    bias = jnp.asarray(d0['bias'])
+    if std is not None:
+        Wk = Wk / jnp.asarray(std)[:, None]
+    if mean is not None:
+        bias = bias - jnp.asarray(mean) @ Wk
+
+    s = registry.make_states(batch, k)
+
+    # first pass: resolve the column layout (and build the dense blocks)
+    # so a kernel/layout mismatch raises before any slicing
+    layout: List[Tuple[Optional[Tuple[int, Callable]], Optional[jax.Array], int]] = []
+    off = 0
+    for name in names:
+        spec = registry.onehot_specs.get(name)
+        if spec is not None:
+            layout.append((spec, None, off))
+            off += spec[0] * k
+        else:
+            block = registry.kernels[name](s)
+            layout.append((None, block, off))
+            off += block.shape[-1]
+    if Wk.shape[0] != off:
+        raise ValueError(
+            f'first-layer kernel has {Wk.shape[0]} input rows but the '
+            f'feature layout ({names!r}, k={k}) emits {off} columns'
+        )
+
+    h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), jnp.float32) + bias
+    dense_blocks: List[jax.Array] = []
+    dense_spans: List[Tuple[int, int]] = []
+    for spec, block, off in layout:
+        if spec is not None:
+            per, get_ids = spec
+            for i in range(k):
+                rows = jax.lax.slice_in_dim(
+                    Wk, off + i * per, off + (i + 1) * per, axis=0
+                )
+                h = h + rows[get_ids(s, i)]
+        else:
+            dense_blocks.append(block)
+            dense_spans.append((off, block.shape[-1]))
+    if dense_blocks:
+        x_dense = jnp.concatenate(dense_blocks, axis=-1)
+        W_dense = jnp.concatenate(
+            [jax.lax.slice_in_dim(Wk, o, o + wd, axis=0) for o, wd in dense_spans],
+            axis=0,
+        )
+        h = h + x_dense @ W_dense
+
+    if hidden_layers == 0:
+        # no hidden layers: Dense_0 IS the (one-unit) output layer, so the
+        # fused h already holds the logits
+        return h[..., 0]
+    x = jax.nn.relu(h)
+    for li in range(1, hidden_layers):
+        d = leaves[f'Dense_{li}']
+        x = jax.nn.relu(x @ jnp.asarray(d['kernel']) + jnp.asarray(d['bias']))
+    d_out = leaves[f'Dense_{hidden_layers}']
+    return (x @ jnp.asarray(d_out['kernel']) + jnp.asarray(d_out['bias']))[..., 0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=('names', 'k', 'hidden_layers', 'registry_name'),
+)
+def _pair_logits(
+    params_a,
+    params_b,
+    mean_a,
+    std_a,
+    mean_b,
+    std_b,
+    batch,
+    *,
+    names,
+    k,
+    hidden_layers,
+    registry_name,
+):
+    registry = REGISTRIES[registry_name]
+    a = fused_mlp_logits(
+        params_a, batch, names=names, k=k, hidden_layers=hidden_layers,
+        mean=mean_a, std=std_a, registry=registry,
+    )
+    b = fused_mlp_logits(
+        params_b, batch, names=names, k=k, hidden_layers=hidden_layers,
+        mean=mean_b, std=std_b, registry=registry,
+    )
+    return jax.nn.sigmoid(a), jax.nn.sigmoid(b)
+
+
+def fused_pair_probs(
+    clf_a,
+    clf_b,
+    batch,
+    *,
+    names: Tuple[str, ...],
+    k: int,
+    registry_name: str = 'standard',
+) -> Tuple[jax.Array, jax.Array]:
+    """Probabilities of two same-architecture MLP heads in one jitted call.
+
+    ``VAEP.rate_batch`` rates with a scores head and a concedes head over
+    the same batch; tracing both through one ``jit`` lets XLA share the
+    per-state views and dense feature blocks between them instead of
+    computing them twice (eager per-head calls cannot CSE across calls).
+    Falls back to per-head calls when the heads' depths differ.
+    """
+    if clf_a.hidden != clf_b.hidden:
+        return (
+            clf_a.predict_proba_device_batch(
+                batch, names=names, k=k, registry=registry_name
+            ),
+            clf_b.predict_proba_device_batch(
+                batch, names=names, k=k, registry=registry_name
+            ),
+        )
+    return _pair_logits(
+        clf_a.params,
+        clf_b.params,
+        jnp.asarray(clf_a.mean_),
+        jnp.asarray(clf_a.std_),
+        jnp.asarray(clf_b.mean_),
+        jnp.asarray(clf_b.std_),
+        batch,
+        names=tuple(names),
+        k=k,
+        hidden_layers=len(clf_a.hidden),
+        registry_name=registry_name,
+    )

@@ -92,6 +92,7 @@ class VAEP:
     _labels_kernel = staticmethod(_labops.scores_concedes)
     _formula_kernel = staticmethod(_formulaops.vaep_values)
     _label_columns = ('scores', 'concedes')
+    _fused_registry = 'standard'  # ops.fused layout of this feature family
 
     def __init__(
         self,
@@ -305,16 +306,45 @@ class VAEP:
         )
         return self._vaep.value(actions, p_scores, p_concedes)
 
+    def _can_fuse(self) -> bool:
+        """True when the fused (no feature materialization) path applies:
+        every label head is an MLP and the feature family has a fused
+        layout registered in :mod:`socceraction_tpu.ops.fused`."""
+        return (
+            bool(self._models)
+            and self._fused_registry is not None
+            and all(isinstance(m, MLPClassifier) for m in self._models.values())
+        )
+
     def rate_batch(self, batch: ActionBatch):
         """Device rating of a packed multi-game batch -> ``(G, A, 3)``.
 
         With 'mlp' models the entire pipeline (features, probabilities,
-        formula) runs on device without host transfers.
+        formula) runs on device without host transfers — and the one-hot
+        feature blocks (~90% of the columns) are applied as first-layer
+        embedding gathers (:mod:`socceraction_tpu.ops.fused`), so the
+        feature tensor is never materialized.
         """
         if not self._models:
             raise NotFittedError('fit the model before calling rate')
-        feats = self.compute_features_batch(batch)
-        probs = self._estimate_probabilities_batch(feats)
+        if self._can_fuse():
+            from ..ops.fused import fused_pair_probs
+
+            # one jitted trace for both heads so XLA shares the per-state
+            # views and dense feature blocks between them
+            cols = list(self._label_columns)
+            pair = fused_pair_probs(
+                self._models[cols[0]],
+                self._models[cols[1]],
+                batch,
+                names=self._kernel_names(),
+                k=self.nb_prev_actions,
+                registry_name=self._fused_registry,
+            )
+            probs = dict(zip(cols, pair))
+        else:
+            feats = self.compute_features_batch(batch)
+            probs = self._estimate_probabilities_batch(feats)
         return self._formula_kernel(
             batch, probs[self._label_columns[0]], probs[self._label_columns[1]]
         )

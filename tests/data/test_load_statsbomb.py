@@ -90,3 +90,24 @@ def test_malformed_json_raises(tmp_path):
     loader = StatsBombLoader(getter='local', root=str(tmp_path))
     with pytest.raises(ParseError):
         loader.competitions()
+
+
+def test_remote_without_statsbombpy(monkeypatch):
+    """Optional-dependency behavior (SURVEY §4 tier 4): without statsbombpy
+    the remote getter raises ImportError and the local getter still works."""
+    import importlib
+    import sys
+
+    import socceraction_tpu.data.statsbomb.loader as loader_mod
+
+    monkeypatch.setitem(sys.modules, 'statsbombpy', None)
+    reloaded = importlib.reload(loader_mod)
+    try:
+        assert reloaded.sb is None
+        with pytest.raises(ImportError, match='statsbombpy'):
+            reloaded.StatsBombLoader(getter='remote')
+        local = reloaded.StatsBombLoader(getter='local', root=DATA_DIR)
+        assert len(local.competitions()) == 1
+    finally:
+        monkeypatch.delitem(sys.modules, 'statsbombpy', raising=False)
+        importlib.reload(loader_mod)

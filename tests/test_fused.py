@@ -1,0 +1,159 @@
+"""Tests for the fused first-layer MLP path (one-hots as embedding gathers)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax
+import jax.numpy as jnp
+
+from socceraction_tpu.core.batch import pack_actions
+from socceraction_tpu.core.synthetic import synthetic_batch
+from socceraction_tpu.ml.mlp import MLPClassifier, _MLP
+from socceraction_tpu.ops.features import compute_features
+from socceraction_tpu.ops.fused import fused_mlp_logits, onehot_blocks
+
+NAMES = (
+    'actiontype_onehot',
+    'result_onehot',
+    'actiontype_result_onehot',
+    'bodypart_onehot',
+    'time',
+    'startlocation',
+    'endlocation',
+    'startpolar',
+    'endpolar',
+    'movement',
+    'team',
+    'time_delta',
+    'space_delta',
+    'goalscore',
+)
+K = 3
+
+
+def _params(n_features, hidden=(32, 16), seed=0):
+    module = _MLP(hidden)
+    return module, module.init(jax.random.PRNGKey(seed), jnp.zeros((1, n_features)))
+
+
+def test_onehot_blocks():
+    assert onehot_blocks(NAMES) == [
+        'actiontype_onehot', 'result_onehot',
+        'actiontype_result_onehot', 'bodypart_onehot',
+    ]
+    assert onehot_blocks(('time', 'movement')) == []
+
+
+def test_fused_matches_materialized():
+    batch = synthetic_batch(n_games=4, n_actions=256, seed=3)
+    feats = compute_features(batch, names=NAMES, k=K)
+    module, params = _params(feats.shape[-1])
+    ref = module.apply(params, feats)
+    out = fused_mlp_logits(params, batch, names=NAMES, k=K, hidden_layers=2)
+    # same computation reordered: f32 accumulation differs slightly
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3)
+
+
+def test_fused_with_standardization():
+    batch = synthetic_batch(n_games=2, n_actions=128, seed=5)
+    feats = compute_features(batch, names=NAMES, k=K)
+    rng = np.random.default_rng(0)
+    mean = rng.normal(size=feats.shape[-1]).astype(np.float32)
+    std = rng.uniform(0.5, 2.0, size=feats.shape[-1]).astype(np.float32)
+    module, params = _params(feats.shape[-1])
+    ref = module.apply(params, (feats - mean) / std)
+    out = fused_mlp_logits(
+        params, batch, names=NAMES, k=K, hidden_layers=2, mean=mean, std=std
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3)
+
+
+def test_fused_rejects_wrong_layout():
+    batch = synthetic_batch(n_games=1, n_actions=64, seed=0)
+    _, params = _params(10)
+    with pytest.raises(ValueError, match='feature layout'):
+        fused_mlp_logits(params, batch, names=NAMES, k=K, hidden_layers=2)
+
+
+def test_vaep_rate_batch_uses_fused(spadl_actions, home_team_id, monkeypatch):
+    """rate_batch with MLP heads matches the materialized-features path."""
+    from socceraction_tpu.vaep.base import VAEP
+
+    game = pd.Series({'game_id': 8657, 'home_team_id': home_team_id})
+    np.random.seed(0)
+    model = VAEP()
+    X = model.compute_features(game, spadl_actions)
+    y = model.compute_labels(game, spadl_actions)
+    model.fit(X, y, learner='mlp')
+    assert model._can_fuse()
+
+    batch = model._pack(spadl_actions, home_team_id)
+    fused_vals = np.asarray(model.rate_batch(batch))
+
+    # force the materialized path and compare
+    monkeypatch.setattr(model, '_can_fuse', lambda: False)
+    ref_vals = np.asarray(model.rate_batch(batch))
+    np.testing.assert_allclose(fused_vals, ref_vals, atol=1e-5)
+
+
+def test_atomic_vaep_fused_matches_materialized(spadl_actions, home_team_id, monkeypatch):
+    from socceraction_tpu.atomic.spadl import convert_to_atomic
+    from socceraction_tpu.atomic.vaep.base import AtomicVAEP
+
+    game = pd.Series({'game_id': 8657, 'home_team_id': home_team_id})
+    np.random.seed(0)
+    atomic_actions = convert_to_atomic(spadl_actions)
+    model = AtomicVAEP()
+    X = model.compute_features(game, atomic_actions)
+    y = model.compute_labels(game, atomic_actions)
+    model.fit(X, y, learner='mlp')
+    assert model._can_fuse()  # atomic layout is registered too
+
+    batch = model._pack(atomic_actions, home_team_id)
+    fused_vals = np.asarray(model.rate_batch(batch))
+    monkeypatch.setattr(model, '_can_fuse', lambda: False)
+    ref_vals = np.asarray(model.rate_batch(batch))
+    np.testing.assert_allclose(fused_vals, ref_vals, atol=1e-5)
+
+
+def test_fused_no_hidden_layers():
+    """hidden=() makes Dense_0 the output layer; the fused h IS the logits."""
+    batch = synthetic_batch(n_games=2, n_actions=128, seed=7)
+    feats = compute_features(batch, names=NAMES, k=K)
+    module, params = _params(feats.shape[-1], hidden=())
+    ref = module.apply(params, feats)
+    out = fused_mlp_logits(params, batch, names=NAMES, k=K, hidden_layers=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3)
+
+
+def test_fused_pair_probs_shares_and_falls_back():
+    from socceraction_tpu.ops.fused import fused_pair_probs
+
+    batch = synthetic_batch(n_games=2, n_actions=128, seed=9)
+    feats = compute_features(batch, names=NAMES, k=K)
+    F = feats.shape[-1]
+
+    def make_clf(hidden, seed):
+        clf = MLPClassifier(hidden=hidden)
+        _, clf.params = _params(F, hidden=hidden, seed=seed)
+        clf.mean_ = np.zeros(F, np.float32)
+        clf.std_ = np.ones(F, np.float32)
+        return clf
+
+    a, b = make_clf((32, 16), 0), make_clf((32, 16), 1)
+    pa, pb = fused_pair_probs(a, b, batch, names=NAMES, k=K)
+    np.testing.assert_allclose(
+        np.asarray(pa),
+        np.asarray(a.predict_proba_device_batch(batch, names=NAMES, k=K)),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pb),
+        np.asarray(b.predict_proba_device_batch(batch, names=NAMES, k=K)),
+        atol=1e-5,
+    )
+    # differing depths fall back to per-head calls
+    c = make_clf((8,), 2)
+    pa2, pc = fused_pair_probs(a, c, batch, names=NAMES, k=K)
+    np.testing.assert_allclose(np.asarray(pa2), np.asarray(pa), atol=1e-6)
+    assert pc.shape == pa.shape
