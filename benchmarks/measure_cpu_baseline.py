@@ -1,0 +1,133 @@
+"""Measure the CPU pandas-oracle throughput of the valuation hot paths.
+
+The reference publishes no throughput numbers (BASELINE.md), so the pandas
+backend measured here is the denominator for the TPU speedups. Synthetic
+SPADL seasons stand in for WC2018-scale data (64 games × ~1.6k actions ≈
+one group stage; scale with --games).
+
+    python benchmarks/measure_cpu_baseline.py --games 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+import pandas as pd
+
+
+def synthetic_spadl(n_games: int, n_actions: int, seed: int = 0) -> pd.DataFrame:
+    from socceraction_tpu.spadl import config as spadlconfig
+
+    rng = np.random.default_rng(seed)
+    n = n_games * n_actions
+    type_id = rng.choice(
+        [spadlconfig.PASS, spadlconfig.DRIBBLE, spadlconfig.CROSS,
+         spadlconfig.SHOT, spadlconfig.actiontypes.index('foul'),
+         spadlconfig.actiontypes.index('interception')],
+        size=n, p=[0.45, 0.2, 0.08, 0.07, 0.1, 0.1],
+    )
+    df = pd.DataFrame(
+        {
+            'game_id': np.repeat(np.arange(n_games), n_actions),
+            'original_event_id': np.arange(n, dtype=np.int64).astype(object),
+            'action_id': np.tile(np.arange(n_actions), n_games),
+            'period_id': np.tile(
+                np.where(np.arange(n_actions) < n_actions // 2, 1, 2), n_games
+            ),
+            'time_seconds': np.tile(
+                np.linspace(0, 2700, n_actions), n_games
+            ),
+            'team_id': rng.choice([10, 20], size=n),
+            'player_id': rng.integers(1, 23, size=n),
+            'start_x': rng.uniform(0, 105, size=n),
+            'start_y': rng.uniform(0, 68, size=n),
+            'end_x': rng.uniform(0, 105, size=n),
+            'end_y': rng.uniform(0, 68, size=n),
+            'type_id': type_id.astype(np.int64),
+            'result_id': rng.integers(0, 2, size=n).astype(np.int64),
+            'bodypart_id': rng.integers(0, 4, size=n).astype(np.int64),
+        }
+    )
+    return df
+
+
+def timed(fn, repeat: int = 3):
+    best = float('inf')
+    out = None
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--games', type=int, default=64)
+    ap.add_argument('--actions', type=int, default=1600)
+    ap.add_argument('--repeat', type=int, default=3)
+    args = ap.parse_args()
+
+    from socceraction_tpu import xthreat
+    from socceraction_tpu.spadl import add_names
+    from socceraction_tpu.vaep.base import VAEP
+
+    df = synthetic_spadl(args.games, args.actions)
+    n = len(df)
+    games = [
+        (pd.Series({'game_id': gid, 'home_team_id': 10}), g)
+        for gid, g in df.groupby('game_id')
+    ]
+    results = {}
+
+    # xT fit + rate, pandas backend, 16x12
+    model = xthreat.ExpectedThreat(backend='pandas')
+    dt, _ = timed(lambda: model.fit(df), args.repeat)
+    results['xt_fit_16x12_actions_per_sec'] = n / dt
+    dt, _ = timed(lambda: model.rate(df), args.repeat)
+    results['xt_rate_16x12_actions_per_sec'] = n / dt
+
+    # xT fine grid 192x125, matrix-free numpy solver
+    fine = xthreat.ExpectedThreat(l=192, w=125, backend='pandas')
+    dt, _ = timed(lambda: fine.fit(df), 1)
+    results['xt_fit_192x125_actions_per_sec'] = n / dt
+    results['xt_fit_192x125_iters'] = fine.n_iter
+
+    # VAEP per-game pipeline (features -> probabilities -> formula), the
+    # reference's notebook-4 loop shape, with a fitted sklearn head
+    np.random.seed(0)
+    vaep = VAEP(backend='pandas')
+    sample_game, sample_actions = games[0]
+    X = vaep.compute_features(sample_game, sample_actions)
+    y = vaep.compute_labels(sample_game, sample_actions)
+    vaep.fit(X, y, learner='sklearn')
+
+    def rate_all():
+        for game, actions in games:
+            vaep.rate(game, actions)
+
+    dt, _ = timed(rate_all, 1)
+    results['vaep_rate_pandas_actions_per_sec'] = n / dt
+
+    def features_all():
+        for game, actions in games:
+            vaep.compute_features(game, actions)
+
+    dt, _ = timed(features_all, 1)
+    results['vaep_features_pandas_actions_per_sec'] = n / dt
+
+    results['n_actions'] = n
+    results['n_games'] = args.games
+    for key, value in results.items():
+        print(json.dumps({'metric': key, 'value': round(float(value), 1)}))
+
+
+if __name__ == '__main__':
+    main()

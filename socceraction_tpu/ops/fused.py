@@ -80,15 +80,14 @@ STANDARD_REGISTRY = FusedRegistry(
 
 # Atomic actiontype one-hot columns are *merged groups* (corner*/freekick*
 # subtypes share a column): map type id -> group index with a small LUT so
-# the group one-hot is still a single row gather.
-_ATOMIC_GROUP_OF_TYPE = jnp.asarray(
-    [
-        list(dict.fromkeys(atomicconfig.actiontypes)).index(t)
-        for t in atomicconfig.actiontypes
-    ],
-    dtype=jnp.int32,
-)
-_N_ATOMIC_GROUPS = int(_ATOMIC_GROUP_OF_TYPE.max()) + 1
+# the group one-hot is still a single row gather. Derived from the kernel's
+# own group table so the two paths cannot diverge.
+_N_ATOMIC_GROUPS = len(_atomicops._ONEHOT_GROUPS)
+_atomic_group_lut = [0] * len(atomicconfig.actiontypes)
+for _g, (_, _ids) in enumerate(_atomicops._ONEHOT_GROUPS):
+    for _t in _ids:
+        _atomic_group_lut[_t] = _g
+_ATOMIC_GROUP_OF_TYPE = jnp.asarray(_atomic_group_lut, dtype=jnp.int32)
 
 #: Atomic-SPADL layout (:mod:`socceraction_tpu.ops.atomic`).
 ATOMIC_REGISTRY = FusedRegistry(
@@ -271,9 +270,13 @@ def fused_pair_probs(
     the same batch; tracing both through one ``jit`` lets XLA share the
     per-state views and dense feature blocks between them instead of
     computing them twice (eager per-head calls cannot CSE across calls).
-    Falls back to per-head calls when the heads' depths differ.
+    Falls back to per-head calls when the heads' *depths* differ (widths
+    may differ -- they come from the traced params).
     """
-    if clf_a.hidden != clf_b.hidden:
+    for clf in (clf_a, clf_b):
+        if clf.params is None or clf.mean_ is None or clf.std_ is None:
+            raise ValueError('classifier is not fitted')
+    if len(clf_a.hidden) != len(clf_b.hidden):
         return (
             clf_a.predict_proba_device_batch(
                 batch, names=names, k=k, registry=registry_name

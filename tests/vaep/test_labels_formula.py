@@ -143,3 +143,76 @@ def test_formula_priors_and_resets():
     np.testing.assert_allclose(v['defensive_value'][6], -0.05)
     # time gaps are 5s (< 10s cutoff): row 1 same team keeps prev probability
     np.testing.assert_allclose(v['offensive_value'][1], 0.0)
+
+
+def test_labels_do_not_leak_across_games(spadl_actions, home_team_id):
+    """Game-boundary correctness (SURVEY §7 hard part #3): a goal early in
+    game B must not appear in the lookahead window of game A's tail."""
+    import jax.numpy as jnp
+
+    from socceraction_tpu.core.batch import pack_actions
+    from socceraction_tpu.ops.labels import scores_concedes
+    from socceraction_tpu.spadl import config as spadlconfig
+    from socceraction_tpu.vaep import labels as lab
+
+    # game A: no goals at all; game B: opens with a goal
+    a = spadl_actions.copy()
+    a['game_id'] = 1
+    a['result_id'] = spadlconfig.FAIL  # kill every goal in game A
+    b = spadl_actions.copy()
+    b['game_id'] = 2
+    b.loc[b.index[0], 'type_id'] = spadlconfig.SHOT
+    b.loc[b.index[0], 'result_id'] = spadlconfig.SUCCESS
+
+    both = pd.concat([a, b], ignore_index=True)
+    batch, _ = pack_actions(both, {1: home_team_id, 2: home_team_id})
+    scores, concedes = scores_concedes(batch)
+    mask = np.asarray(batch.mask)
+
+    # game A (batch row 0) has no positive labels anywhere — especially not
+    # in its last nr_actions rows adjacent to game B in the flat layout
+    assert not np.asarray(scores)[0][mask[0]].any()
+    assert not np.asarray(concedes)[0][mask[0]].any()
+    # game B agrees with the single-game pandas oracle
+    exp = lab.scores(add_names(b.reset_index(drop=True)))['scores'].to_numpy()
+    np.testing.assert_array_equal(np.asarray(scores)[1][mask[1]], exp)
+
+
+def test_formula_does_not_leak_across_games(spadl_actions, home_team_id):
+    """The lag-1 'previous action' of each game's first row must not be the
+    previous game's last row when games share a packed batch."""
+    from socceraction_tpu.core.batch import pack_actions, unpack_values
+    from socceraction_tpu.ops.formula import vaep_values
+    from socceraction_tpu.vaep import formula as vf
+    from socceraction_tpu.spadl.utils import add_names
+
+    rng = np.random.default_rng(0)
+    a = spadl_actions.copy()
+    a['game_id'] = 1
+    b = spadl_actions.copy()
+    b['game_id'] = 2
+    both = pd.concat([a, b], ignore_index=True)
+    p_scores = pd.Series(rng.uniform(0, 1, len(both)))
+    p_concedes = pd.Series(rng.uniform(0, 1, len(both)))
+
+    batch, _ = pack_actions(both, {1: home_team_id, 2: home_team_id})
+    import jax.numpy as jnp
+
+    n = len(a)
+    ps = jnp.zeros(batch.mask.shape).at[0, :n].set(p_scores[:n].to_numpy()).at[1, :n].set(
+        p_scores[n:].to_numpy()
+    )
+    pc = jnp.zeros(batch.mask.shape).at[0, :n].set(p_concedes[:n].to_numpy()).at[1, :n].set(
+        p_concedes[n:].to_numpy()
+    )
+    out = unpack_values(vaep_values(batch, ps, pc), batch)
+
+    # oracle: each game valued independently (per-game pandas calls)
+    ref_a = vf.value(add_names(a), p_scores[:n], p_concedes[:n])
+    ref_b = vf.value(
+        add_names(b.reset_index(drop=True)),
+        p_scores[n:].reset_index(drop=True),
+        p_concedes[n:].reset_index(drop=True),
+    )
+    ref = pd.concat([ref_a, ref_b], ignore_index=True).to_numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
