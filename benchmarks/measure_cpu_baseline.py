@@ -76,7 +76,6 @@ def main() -> None:
     args = ap.parse_args()
 
     from socceraction_tpu import xthreat
-    from socceraction_tpu.spadl import add_names
     from socceraction_tpu.vaep.base import VAEP
 
     df = synthetic_spadl(args.games, args.actions)

@@ -9,6 +9,7 @@ injury-time ``_expand_minute`` helper (``:57-79``) and the exception types
 from __future__ import annotations
 
 import json
+import re
 from abc import ABC, abstractmethod
 from typing import Any, Dict, List, Union
 from urllib.request import urlopen
@@ -31,6 +32,12 @@ class ParseError(Exception):
 
 class MissingDataError(Exception):
     """Raised when a field is missing in the input data."""
+
+
+def _snake(name: str) -> str:
+    """camelCase / PascalCase -> snake_case (shared by the feed parsers)."""
+    step = re.sub('(.)([A-Z][a-z]+)', r'\1_\2', name)
+    return re.sub('([a-z0-9])([A-Z])', r'\1_\2', step).lower()
 
 
 def _remoteloadjson(path: str) -> JSONType:

@@ -8,17 +8,11 @@ always embedded and can be supplied from the file path instead.
 from __future__ import annotations
 
 import json
-import re
 from datetime import datetime, timedelta
 from typing import Any, Dict, Optional, Tuple
 
-from ...base import MissingDataError
+from ...base import MissingDataError, _snake
 from .base import OptaParser, _get_end_x, _get_end_y, assertget
-
-
-def _snake(name: str) -> str:
-    step = re.sub('(.)([A-Z][a-z]+)', r'\1_\2', name)
-    return re.sub('([a-z0-9])([A-Z])', r'\1_\2', step).lower()
 
 
 class WhoScoredParser(OptaParser):

@@ -1,0 +1,130 @@
+"""Tests for the Wyscout v3 raw-event flattener and its converter handoff."""
+
+import json
+
+import pandas as pd
+import pytest
+
+from socceraction_tpu.data.wyscout import flatten_v3_events, load_v3_events
+from socceraction_tpu.spadl import wyscout_v3
+from socceraction_tpu.spadl.schema import SPADLSchema
+
+
+def _raw_events():
+    return [
+        {
+            'id': 1001,
+            'matchId': 9000,
+            'matchPeriod': '1H',
+            'minute': 0,
+            'second': 10,
+            'team': {'id': 1, 'name': 'Home FC'},
+            'player': {'id': 11, 'name': 'A. Passer'},
+            'location': {'x': 50, 'y': 50},
+            'type': {'primary': 'pass', 'secondary': []},
+            'pass': {
+                'accurate': True,
+                'endLocation': {'x': 62, 'y': 41},
+                'height': None,
+                'length': 14.2,
+            },
+        },
+        {
+            'id': 1002,
+            'matchId': 9000,
+            'matchPeriod': '1H',
+            'minute': 0,
+            'second': 16,
+            'team': {'id': 1, 'name': 'Home FC'},
+            'player': {'id': 12, 'name': 'B. Winger'},
+            'location': {'x': 62, 'y': 41},
+            'type': {'primary': 'pass', 'secondary': ['cross', 'head_pass']},
+            'pass': {
+                'accurate': False,
+                'endLocation': {'x': 92, 'y': 30},
+                'height': 'high',
+                'length': 30.0,
+            },
+        },
+        {
+            'id': 1003,
+            'matchId': 9000,
+            'matchPeriod': '1H',
+            'minute': 1,
+            'second': 2,
+            'team': {'id': 2, 'name': 'Away FC'},
+            'player': {'id': 21, 'name': 'C. Striker'},
+            'location': {'x': 85, 'y': 48},
+            'type': {'primary': 'shot', 'secondary': []},
+            'shot': {'isGoal': 1, 'onTarget': True, 'goalZone': 'gc', 'xg': 0.31},
+        },
+        {
+            'id': 1004,
+            'matchId': 9000,
+            'matchPeriod': '2H',
+            'minute': 50,
+            'second': 30,
+            'team': {'id': 2, 'name': 'Away FC'},
+            'player': {'id': 22, 'name': 'D. Duelist'},
+            'location': {'x': 40, 'y': 60},
+            'type': {'primary': 'duel', 'secondary': ['ground_duel']},
+            'groundDuel': {
+                'duelType': 'dribble',
+                'takeOn': True,
+                'keptPossession': True,
+                'relatedDuelId': None,
+            },
+        },
+    ]
+
+
+def test_flatten_columns():
+    df = flatten_v3_events(_raw_events())
+    assert len(df) == 4
+    # nested paths -> snake_case flat columns
+    assert df.loc[0, 'type_primary'] == 'pass'
+    assert df.loc[0, 'pass_end_location_x'] == 62
+    assert df.loc[0, 'pass_accurate'] == True  # noqa: E712
+    assert df.loc[2, 'shot_is_goal'] == 1
+    assert df.loc[2, 'shot_goal_zone'] == 'gc'
+    assert df.loc[3, 'ground_duel_duel_type'] == 'dribble'
+    assert df.loc[3, 'ground_duel_kept_possession'] == True  # noqa: E712
+    assert df.loc[0, 'match_period'] == '1H'
+    assert df.loc[0, 'team_id'] == 1 and df.loc[0, 'player_id'] == 11
+
+
+def test_secondary_flags_dense():
+    df = flatten_v3_events(_raw_events())
+    # flags exist for every event, 0 where the label is absent
+    assert df['type_cross'].tolist() == [0, 1, 0, 0]
+    assert df['type_head_pass'].tolist() == [0, 1, 0, 0]
+    assert df['type_ground_duel'].tolist() == [0, 0, 0, 1]
+
+
+def test_flattened_frame_converts_to_spadl():
+    df = flatten_v3_events(_raw_events())
+    df = df.rename(columns={})  # converter reads match_id/minute/second directly
+    actions = wyscout_v3.convert_to_actions(df, home_team_id=1)
+    SPADLSchema.validate(actions)
+    # cross detected through the secondary flag
+    from socceraction_tpu.spadl import config as spadlconfig
+
+    by_event = {
+        eid: spadlconfig.actiontypes[tid]
+        for eid, tid in zip(actions['original_event_id'], actions['type_id'])
+    }
+    assert by_event[1001] == 'pass'
+    assert by_event[1002] == 'cross'
+    assert by_event[1003] == 'shot'
+    assert by_event[1004] == 'take_on'
+
+
+def test_load_v3_events(tmp_path):
+    path = tmp_path / 'match.json'
+    path.write_text(json.dumps({'events': _raw_events()}))
+    df = load_v3_events(str(path))
+    assert len(df) == 4
+    # bare-array feeds work too
+    path2 = tmp_path / 'bare.json'
+    path2.write_text(json.dumps(_raw_events()))
+    assert len(load_v3_events(str(path2))) == 4
