@@ -477,6 +477,53 @@ class ExpectedThreat:
 
     predict = rate  # deprecated alias kept for API parity (xthreat.py:380)
 
+    def interpolator(self, kind: str = 'linear'):
+        """A callable interpolating the xT surface over the pitch.
+
+        API parity: reference ``xthreat.py:327-350`` (an ``interp2d``-style
+        wrapper: called with 1-D ``xs``/``ys`` meter coordinates, returns
+        the ``(len(ys), len(xs))`` interpolated surface). Built on
+        ``scipy.interpolate.RegularGridInterpolator`` (``interp2d`` was
+        removed from SciPy) with the same cell-centered sample points and
+        edge extrapolation.
+
+        Parameters
+        ----------
+        kind : {'linear', 'cubic', 'quintic'}
+            Spline order, as in the reference.
+        """
+        try:
+            from scipy.interpolate import RegularGridInterpolator
+        except ImportError as exc:  # pragma: no cover
+            raise ImportError('Interpolation requires scipy to be installed.') from exc
+
+        methods = {'linear': 'linear', 'cubic': 'cubic', 'quintic': 'quintic'}
+        if kind not in methods:
+            raise ValueError(f'kind must be one of {sorted(methods)}, got {kind!r}')
+
+        cell_l = spadlconfig.field_length / self.l
+        cell_w = spadlconfig.field_width / self.w
+        xs = np.arange(0.0, spadlconfig.field_length, cell_l) + 0.5 * cell_l
+        ys = np.arange(0.0, spadlconfig.field_width, cell_w) + 0.5 * cell_w
+        # grid row 0 is the TOP of the pitch: flip to ascending-y order
+        interp = RegularGridInterpolator(
+            (ys, xs),
+            self.xT[::-1],
+            method=methods[kind],
+            bounds_error=False,
+            fill_value=None,  # extrapolate at the borders like interp2d
+        )
+
+        def f(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+            x = np.asarray(x, dtype=np.float64)
+            y = np.asarray(y, dtype=np.float64)
+            gx, gy = np.meshgrid(x, y)
+            return interp(np.stack([gy.ravel(), gx.ravel()], axis=-1)).reshape(
+                len(y), len(x)
+            )
+
+        return f
+
     # -- persistence -------------------------------------------------------
 
     def save_model(self, filepath: str, overwrite: bool = True) -> None:
