@@ -1,17 +1,30 @@
-"""StatsBomb event stream → SPADL converter.
+"""StatsBomb event stream → SPADL converter (columnar).
 
 Parity: reference ``socceraction/spadl/statsbomb.py:12-322`` with the
-upstream (``_sa``) post-processing semantics (see :mod:`.base`). The
-vectorizable core — period-relative clock, the 120×80 → 105×68 coordinate
-rescale with y-flip, sorting and the direction/clearance fixes — runs
-columnar; the per-event ``extra``-dict parsing necessarily stays host-side
-(ragged JSON), organized as one parser function per StatsBomb event type.
+upstream (``_sa``) post-processing semantics (see :mod:`.base`). Same
+observable semantics, different engineering: the reference parses each
+event's ragged ``extra`` JSON row-by-row through one Python parser function
+per event type; here the scalar leaves the decisions depend on are dug out
+of the dicts once (``_extract_scalars``) and every type/result/bodypart
+decision is an ``np.select`` over columnar masks, first-match-wins
+reproducing the reference's if/elif precedence — the same design as the
+Wyscout converter (:mod:`.wyscout`).
+
+Stages:
+
+1. pull the decision-relevant scalar leaves out of ``extra`` (one host-side
+   pass over the ragged dicts — the only non-columnar step)
+2. period-relative clock + 120×80 yard-cell → 105×68 m rescale with y-flip
+3. columnar type/result/bodypart decision tables
+4. drop non-actions, sort, shared post-processing (direction of play,
+   clearances, dribbles)
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict, Tuple
 
+import numpy as np
 import pandas as pd
 
 from . import config as spadlconfig
@@ -20,7 +33,219 @@ from .schema import SPADLSchema
 
 __all__ = ['convert_to_actions']
 
-Location = Tuple[float, float]
+#: flat column name → path of keys into the ``extra`` dict
+_EXTRA_SCALARS: Dict[str, Tuple[str, ...]] = {
+    'pass_type': ('pass', 'type', 'name'),
+    'pass_height': ('pass', 'height', 'name'),
+    'pass_cross': ('pass', 'cross'),
+    'pass_outcome': ('pass', 'outcome', 'name'),
+    'pass_bodypart': ('pass', 'body_part', 'name'),
+    'dribble_outcome': ('dribble', 'outcome', 'name'),
+    'foul_card': ('foul_committed', 'card', 'name'),
+    'duel_type': ('duel', 'type', 'name'),
+    'duel_outcome': ('duel', 'outcome', 'name'),
+    'interception_outcome': ('interception', 'outcome', 'name'),
+    'shot_type': ('shot', 'type', 'name'),
+    'shot_outcome': ('shot', 'outcome', 'name'),
+    'shot_bodypart': ('shot', 'body_part', 'name'),
+    'keeper_type': ('goalkeeper', 'type', 'name'),
+    'keeper_outcome': ('goalkeeper', 'outcome', 'name'),
+    'keeper_bodypart': ('goalkeeper', 'body_part', 'name'),
+}
+
+#: a duel/interception with one of these outcomes went to the opponent
+_LOST = ('Lost In Play', 'Lost Out')
+
+
+def _dig(d: Any, path: Tuple[str, ...]) -> Any:
+    for key in path:
+        if not isinstance(d, dict):
+            return None
+        d = d.get(key)
+    return d
+
+
+def _extract_scalars(extra: pd.Series) -> pd.DataFrame:
+    """Flatten the ragged ``extra`` dicts into scalar decision columns."""
+    return pd.DataFrame(
+        {
+            name: [_dig(d, path) for d in extra]
+            for name, path in _EXTRA_SCALARS.items()
+        },
+        index=extra.index,
+        dtype=object,
+    )
+
+
+def _period_clock(events: pd.DataFrame) -> pd.Series:
+    """Clock relative to the period start (regular period lengths assumed)."""
+    offsets = np.select(
+        [events['period_id'] == p for p in (2, 3, 4, 5)],
+        [45 * 60, 90 * 60, 105 * 60, 120 * 60],
+        default=0,
+    )
+    return 60 * events['minute'] + events['second'] - offsets
+
+
+def _to_meters(coords: pd.Series) -> Tuple[pd.Series, pd.Series]:
+    """(x, y) yard-cell pairs → meters on the 105×68 pitch, y flipped.
+
+    StatsBomb's pitch is a 120×80 grid of 1-yard cells indexed from (1, 1);
+    cell centers are rescaled onto the metric pitch.
+    """
+    x = pd.Series([c[0] if c else 1 for c in coords], index=coords.index)
+    y = pd.Series([c[1] if c else 1 for c in coords], index=coords.index)
+    x_m = (x.clip(1, 120) - 1) / 119 * spadlconfig.field_length
+    y_m = spadlconfig.field_width - (y.clip(1, 80) - 1) / 79 * spadlconfig.field_width
+    return x_m, y_m
+
+
+def _end_coordinates(events: pd.DataFrame) -> pd.Series:
+    """End location: pass/shot/carry target if present, else the start."""
+
+    def end_of(start: Any, extra: Dict[str, Any]) -> Any:
+        for family in ('pass', 'shot', 'carry'):
+            leaf = extra.get(family)
+            if isinstance(leaf, dict) and 'end_location' in leaf:
+                return leaf['end_location']
+        return start
+
+    return pd.Series(
+        [end_of(loc, x) for loc, x in zip(events['location'], events['extra'])],
+        index=events.index,
+        dtype=object,
+    )
+
+
+def _bodypart_ids(relevant: pd.Series) -> np.ndarray:
+    """Map raw StatsBomb body-part names onto the 4-entry SPADL vocabulary."""
+    names = np.select(
+        [
+            relevant.isna(),
+            relevant.str.contains('Head', na=False),
+            relevant.str.contains('Foot', na=False) | (relevant == 'Drop Kick'),
+        ],
+        ['foot', 'head', 'foot'],
+        default='other',
+    )
+    lookup = {name: i for i, name in enumerate(spadlconfig.bodyparts)}
+    return pd.Series(names, index=relevant.index).map(lookup).to_numpy()
+
+
+def _classify(
+    events: pd.DataFrame,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Columnar (type_id, result_id, bodypart_id) decision tables."""
+    tn = events['type_name']
+    x = _extract_scalars(events['extra'])
+
+    is_pass = tn == 'Pass'
+    is_shot = tn == 'Shot'
+    is_keeper = tn == 'Goal Keeper'
+    is_tackle = (tn == 'Duel') & (x['duel_type'] == 'Tackle')
+    is_cross = np.array([bool(v) for v in x['pass_cross']])
+    high_or_cross = (x['pass_height'] == 'High Pass') | is_cross
+    card = x['foul_card'].fillna('').astype(str)
+
+    type_names = np.select(
+        [
+            is_pass & (x['pass_type'] == 'Free Kick') & high_or_cross,
+            is_pass & (x['pass_type'] == 'Free Kick'),
+            is_pass & (x['pass_type'] == 'Corner') & high_or_cross,
+            is_pass & (x['pass_type'] == 'Corner'),
+            is_pass & (x['pass_type'] == 'Goal Kick'),
+            is_pass & (x['pass_type'] == 'Throw-in'),
+            is_pass & is_cross,
+            is_pass,
+            tn == 'Dribble',
+            tn == 'Carry',
+            tn == 'Foul Committed',
+            is_tackle,
+            tn == 'Interception',
+            is_shot & (x['shot_type'] == 'Free Kick'),
+            is_shot & (x['shot_type'] == 'Penalty'),
+            is_shot,
+            tn == 'Own Goal Against',
+            is_keeper & (x['keeper_type'] == 'Shot Saved'),
+            is_keeper & x['keeper_type'].isin(('Collected', 'Keeper Sweeper')),
+            is_keeper & (x['keeper_type'] == 'Punch'),
+            tn == 'Clearance',
+            tn == 'Miscontrol',
+        ],
+        [
+            'freekick_crossed',
+            'freekick_short',
+            'corner_crossed',
+            'corner_short',
+            'goalkick',
+            'throw_in',
+            'cross',
+            'pass',
+            'take_on',
+            'dribble',
+            'foul',
+            'tackle',
+            'interception',
+            'shot_freekick',
+            'shot_penalty',
+            'shot',
+            'bad_touch',
+            'keeper_save',
+            'keeper_claim',
+            'keeper_punch',
+            'clearance',
+            'bad_touch',
+        ],
+        default='non_action',
+    )
+
+    result_names = np.select(
+        [
+            is_pass & x['pass_outcome'].isin(('Incomplete', 'Out')),
+            is_pass & (x['pass_outcome'] == 'Pass Offside'),
+            (tn == 'Dribble') & (x['dribble_outcome'] == 'Incomplete'),
+            (tn == 'Foul Committed') & card.str.contains('Yellow'),
+            (tn == 'Foul Committed') & card.str.contains('Red'),
+            is_tackle & x['duel_outcome'].isin(_LOST),
+            (tn == 'Interception') & x['interception_outcome'].isin(_LOST),
+            is_shot & (x['shot_outcome'] != 'Goal'),
+            tn == 'Own Goal Against',
+            is_keeper & x['keeper_outcome'].isin(('In Play Danger', 'No Touch')),
+            tn == 'Miscontrol',
+        ],
+        [
+            'fail',
+            'offside',
+            'fail',
+            'yellow_card',
+            'red_card',
+            'fail',
+            'fail',
+            'fail',
+            'owngoal',
+            'fail',
+            'fail',
+        ],
+        default='success',
+    )
+
+    relevant_bodypart = pd.Series(
+        np.select(
+            [is_pass, is_shot, is_keeper],
+            [x['pass_bodypart'], x['shot_bodypart'], x['keeper_bodypart']],
+            default=None,
+        ),
+        index=events.index,
+        dtype=object,
+    )
+
+    type_lookup = {name: i for i, name in enumerate(spadlconfig.actiontypes)}
+    result_lookup = {name: i for i, name in enumerate(spadlconfig.results)}
+    return (
+        pd.Series(type_names, index=events.index).map(type_lookup).to_numpy(),
+        pd.Series(result_names, index=events.index).map(result_lookup).to_numpy(),
+        _bodypart_ids(relevant_bodypart),
+    )
 
 
 def convert_to_actions(events: pd.DataFrame, home_team_id) -> pd.DataFrame:
@@ -39,48 +264,31 @@ def convert_to_actions(events: pd.DataFrame, home_team_id) -> pd.DataFrame:
     pd.DataFrame
         The game's actions in SPADL format.
     """
-    actions = pd.DataFrame()
-
     events = events.copy()
     events['extra'] = events['extra'].apply(lambda d: d if isinstance(d, dict) else {})
     events = events.fillna(0)
 
-    actions['game_id'] = events['game_id']
-    actions['original_event_id'] = events['event_id']
-    actions['period_id'] = events['period_id']
+    start_x, start_y = _to_meters(events['location'])
+    end_x, end_y = _to_meters(_end_coordinates(events))
+    type_ids, result_ids, bodypart_ids = _classify(events)
 
-    # Clock relative to the period start (regular period lengths assumed).
-    actions['time_seconds'] = (
-        60 * events['minute']
-        + events['second']
-        - ((events['period_id'] > 1) * 45 * 60)
-        - ((events['period_id'] > 2) * 45 * 60)
-        - ((events['period_id'] > 3) * 15 * 60)
-        - ((events['period_id'] > 4) * 15 * 60)
+    actions = pd.DataFrame(
+        {
+            'game_id': events['game_id'],
+            'original_event_id': events['event_id'],
+            'period_id': events['period_id'],
+            'time_seconds': _period_clock(events),
+            'team_id': events['team_id'],
+            'player_id': events['player_id'],
+            'start_x': start_x,
+            'start_y': start_y,
+            'end_x': end_x,
+            'end_y': end_y,
+            'type_id': type_ids,
+            'result_id': result_ids,
+            'bodypart_id': bodypart_ids,
+        }
     )
-    actions['team_id'] = events['team_id']
-    actions['player_id'] = events['player_id']
-
-    # StatsBomb's pitch is a 120x80 grid of 1-yard cells indexed from (1, 1);
-    # rescale cell centers onto the 105x68 m pitch and flip the y axis.
-    actions['start_x'] = events['location'].apply(lambda x: x[0] if x else 1).clip(1, 120)
-    actions['start_y'] = events['location'].apply(lambda x: x[1] if x else 1).clip(1, 80)
-    actions['start_x'] = (actions['start_x'] - 1) / 119 * spadlconfig.field_length
-    actions['start_y'] = (
-        spadlconfig.field_width - (actions['start_y'] - 1) / 79 * spadlconfig.field_width
-    )
-
-    end_location = events[['location', 'extra']].apply(_get_end_location, axis=1)
-    actions['end_x'] = end_location.apply(lambda x: x[0] if x else 1).clip(1, 120)
-    actions['end_y'] = end_location.apply(lambda x: x[1] if x else 1).clip(1, 80)
-    actions['end_x'] = (actions['end_x'] - 1) / 119 * spadlconfig.field_length
-    actions['end_y'] = (
-        spadlconfig.field_width - (actions['end_y'] - 1) / 79 * spadlconfig.field_width
-    )
-
-    actions[['type_id', 'result_id', 'bodypart_id']] = events[
-        ['type_name', 'extra']
-    ].apply(_parse_event, axis=1, result_type='expand')
 
     actions = (
         actions[actions['type_id'] != spadlconfig.NON_ACTION]
@@ -94,153 +302,3 @@ def convert_to_actions(events: pd.DataFrame, home_team_id) -> pd.DataFrame:
     actions = _add_dribbles(actions)
 
     return SPADLSchema.validate(actions)
-
-
-def _get_end_location(q: Tuple[Any, Dict[str, Any]]) -> Any:
-    start_location, extra = q
-    for event in ('pass', 'shot', 'carry'):
-        if event in extra and 'end_location' in extra[event]:
-            return extra[event]['end_location']
-    return start_location
-
-
-def _bodypart_name(bp: Any) -> str:
-    if bp is None:
-        return 'foot'
-    if 'Head' in bp:
-        return 'head'
-    if 'Foot' in bp or bp == 'Drop Kick':
-        return 'foot'
-    return 'other'
-
-
-def _parse_pass(extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    p = extra.get('pass', {})
-    ptype = p.get('type', {}).get('name')
-    height = p.get('height', {}).get('name')
-    cross = p.get('cross')
-    if ptype == 'Free Kick':
-        a = 'freekick_crossed' if (height == 'High Pass' or cross) else 'freekick_short'
-    elif ptype == 'Corner':
-        a = 'corner_crossed' if (height == 'High Pass' or cross) else 'corner_short'
-    elif ptype == 'Goal Kick':
-        a = 'goalkick'
-    elif ptype == 'Throw-in':
-        a = 'throw_in'
-    elif cross:
-        a = 'cross'
-    else:
-        a = 'pass'
-
-    outcome = p.get('outcome', {}).get('name')
-    if outcome in ('Incomplete', 'Out'):
-        r = 'fail'
-    elif outcome == 'Pass Offside':
-        r = 'offside'
-    else:
-        r = 'success'
-    return a, r, _bodypart_name(p.get('body_part', {}).get('name'))
-
-
-def _parse_dribble(extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    outcome = extra.get('dribble', {}).get('outcome', {}).get('name')
-    return 'take_on', 'fail' if outcome == 'Incomplete' else 'success', 'foot'
-
-
-def _parse_carry(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    return 'dribble', 'success', 'foot'
-
-
-def _parse_foul(extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    card = extra.get('foul_committed', {}).get('card', {}).get('name', '')
-    if 'Yellow' in card:
-        r = 'yellow_card'
-    elif 'Red' in card:
-        r = 'red_card'
-    else:
-        r = 'success'
-    return 'foul', r, 'foot'
-
-
-def _parse_duel(extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    if extra.get('duel', {}).get('type', {}).get('name') == 'Tackle':
-        outcome = extra.get('duel', {}).get('outcome', {}).get('name')
-        r = 'fail' if outcome in ('Lost In Play', 'Lost Out') else 'success'
-        return 'tackle', r, 'foot'
-    return _parse_non_action(extra)
-
-
-def _parse_interception(extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    outcome = extra.get('interception', {}).get('outcome', {}).get('name')
-    r = 'fail' if outcome in ('Lost In Play', 'Lost Out') else 'success'
-    return 'interception', r, 'foot'
-
-
-def _parse_shot(extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    s = extra.get('shot', {})
-    stype = s.get('type', {}).get('name')
-    if stype == 'Free Kick':
-        a = 'shot_freekick'
-    elif stype == 'Penalty':
-        a = 'shot_penalty'
-    else:
-        a = 'shot'
-    r = 'success' if s.get('outcome', {}).get('name') == 'Goal' else 'fail'
-    return a, r, _bodypart_name(s.get('body_part', {}).get('name'))
-
-
-def _parse_own_goal(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    return 'bad_touch', 'owngoal', 'foot'
-
-
-def _parse_goalkeeper(extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    g = extra.get('goalkeeper', {})
-    gtype = g.get('type', {}).get('name')
-    if gtype == 'Shot Saved':
-        a = 'keeper_save'
-    elif gtype in ('Collected', 'Keeper Sweeper'):
-        a = 'keeper_claim'
-    elif gtype == 'Punch':
-        a = 'keeper_punch'
-    else:
-        a = 'non_action'
-    outcome = g.get('outcome', {}).get('name', 'x')
-    r = 'fail' if outcome in ('In Play Danger', 'No Touch') else 'success'
-    return a, r, _bodypart_name(g.get('body_part', {}).get('name'))
-
-
-def _parse_clearance(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    return 'clearance', 'success', 'foot'
-
-
-def _parse_miscontrol(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    return 'bad_touch', 'fail', 'foot'
-
-
-def _parse_non_action(_extra: Dict[str, Any]) -> Tuple[str, str, str]:
-    return 'non_action', 'success', 'foot'
-
-
-_EVENT_PARSERS = {
-    'Pass': _parse_pass,
-    'Dribble': _parse_dribble,
-    'Carry': _parse_carry,
-    'Foul Committed': _parse_foul,
-    'Duel': _parse_duel,
-    'Interception': _parse_interception,
-    'Shot': _parse_shot,
-    'Own Goal Against': _parse_own_goal,
-    'Goal Keeper': _parse_goalkeeper,
-    'Clearance': _parse_clearance,
-    'Miscontrol': _parse_miscontrol,
-}
-
-
-def _parse_event(q: Tuple[str, Dict[str, Any]]) -> Tuple[int, int, int]:
-    type_name, extra = q
-    a, r, b = _EVENT_PARSERS.get(type_name, _parse_non_action)(extra)
-    return (
-        spadlconfig.actiontypes.index(a),
-        spadlconfig.results.index(r),
-        spadlconfig.bodyparts.index(b),
-    )

@@ -15,15 +15,13 @@ import sys
 def pytest_configure(config):
     if os.environ.get('SOCCERACTION_TPU_TEST_ENV') == '1':
         return
-    env = dict(os.environ)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from socceraction_tpu.utils.env import cpu_device_env
+
+    # override=False: an --xla_force_host_platform_device_count already in
+    # XLA_FLAGS wins, so callers can pin their own mesh size
+    env = cpu_device_env(8, override=False)
     env['SOCCERACTION_TPU_TEST_ENV'] = '1'
-    env['JAX_PLATFORMS'] = 'cpu'
-    env['PALLAS_AXON_POOL_IPS'] = ''  # skip remote-TPU plugin registration
-    xla_flags = env.get('XLA_FLAGS', '')
-    if '--xla_force_host_platform_device_count' not in xla_flags:
-        env['XLA_FLAGS'] = (
-            xla_flags + ' --xla_force_host_platform_device_count=8'
-        ).strip()
     # pytest has already dup2'd fd 1/2 into its capture files; restore them
     # so the re-exec'd run writes to the real terminal.
     capman = config.pluginmanager.getplugin('capturemanager')
@@ -43,26 +41,39 @@ DATA_DIR = Path(__file__).parent / 'datasets'
 
 @pytest.fixture(scope='session')
 def spadl_actions() -> pd.DataFrame:
-    """The 200-action golden SPADL snapshot (game 8657, home team 782)."""
+    """The 200-action golden SPADL snapshot (game 8657).
+
+    Provenance: vendored VERBATIM from the reference's checked-in golden
+    test data (reference ``tests/datasets/spadl/spadl.json``; byte-identical)
+    so it can serve as the bit-exact oracle. The reference generated it with
+    ``create_spadl(8657, 777)`` (reference tests/datasets/download.py:303);
+    team 777 does not occur in game 8657 (teams are 782 and 768), so every
+    action was coordinate-mirrored during that conversion. Tests treat the
+    frame purely as a fixed SPADL input, so the orientation quirk is
+    irrelevant to what they assert.
+    """
     df = pd.read_json(DATA_DIR / 'spadl' / 'spadl.json')
     return df
 
 
 @pytest.fixture(scope='session')
 def atomic_spadl_actions() -> pd.DataFrame:
-    """The golden Atomic-SPADL snapshot derived from the same game."""
+    """The golden Atomic-SPADL snapshot for the same game.
+
+    Vendored verbatim from the reference's golden data (byte-identical),
+    same provenance as :func:`spadl_actions`.
+    """
     df = pd.read_json(DATA_DIR / 'spadl' / 'atomic_spadl.json')
     return df
 
 
 @pytest.fixture(scope='session')
 def home_team_id() -> int:
-    """Home team used for the golden snapshot game.
+    """Home team id tests pass alongside the golden snapshot.
 
-    Note: the reference generated the snapshot with ``create_spadl(8657, 777)``
-    (reference tests/datasets/download.py:303) but team 777 does not occur in
-    game 8657 (teams are 782 and 768), so every action was mirrored during
-    conversion. We use 782 -- the game's actual home side -- so that
-    direction-sensitive tests exercise both branches.
+    We use 782 -- the game's actual home side -- so that direction-sensitive
+    code paths exercise both the mirrored and unmirrored branches (the
+    snapshot itself contains both teams' actions). This does NOT claim the
+    snapshot was generated with 782; see :func:`spadl_actions` provenance.
     """
     return 782
