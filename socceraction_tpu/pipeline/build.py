@@ -88,14 +88,26 @@ def build_spadl_store(
                     players = loader.players(game_id)
                 with timed('pipeline/convert'):
                     actions = convert(events, row.home_team_id)
+                # inside the guarded region: a failure in the atomic
+                # conversion or the writes must also be skippable, and no
+                # metadata is appended for a partially-written game
+                store.put_actions(game_id, actions)
+                if atomic:
+                    store.put(
+                        f'atomic_actions/game_{game_id}', convert_to_atomic(actions)
+                    )
             except Exception:
                 if on_error == 'skip':
                     logger.warning('skipping game %s', game_id, exc_info=True)
+                    # drop any partially-written frames so keys()/game_ids()
+                    # never enumerate a corrupt game
+                    for key in (f'actions/game_{game_id}', f'atomic_actions/game_{game_id}'):
+                        try:
+                            store.delete(key)
+                        except Exception:
+                            logger.warning('could not clean up %s', key, exc_info=True)
                     continue
                 raise
-            store.put_actions(game_id, actions)
-            if atomic:
-                store.put(f'atomic_actions/game_{game_id}', convert_to_atomic(actions))
             # metadata recorded only for games whose actions made it into the
             # store, so games()/teams()/players() never reference a missing
             # actions/game_<id> key

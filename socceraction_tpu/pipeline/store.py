@@ -41,6 +41,34 @@ def _infer_engine(path: str, engine: Optional[str]) -> str:
     return 'parquet'
 
 
+def _looks_like_store(path: str) -> bool:
+    """Whether an existing directory is plausibly a parquet SeasonStore.
+
+    ``mode='w'`` recursively deletes ``path``; unlike HDF5's 'w' (which
+    truncates one file) that could wipe an unrelated directory on a typo,
+    so deletion is only allowed for an empty directory or one whose
+    contents are store-shaped (an ``actions`` subdir / ``*.parquet``
+    files / subdirs of them).
+    """
+    entries = os.listdir(path)
+    if not entries:
+        return True
+    if 'actions' in entries:
+        return True
+
+    def parquet_only(directory: str, depth: int = 0) -> bool:
+        for name in os.listdir(directory):
+            full = os.path.join(directory, name)
+            if os.path.isdir(full):
+                if depth >= 2 or not parquet_only(full, depth + 1):
+                    return False
+            elif not name.endswith('.parquet'):
+                return False
+        return True
+
+    return parquet_only(path)
+
+
 class SeasonStore:
     """A keyed DataFrame store holding one or more converted seasons.
 
@@ -53,7 +81,9 @@ class SeasonStore:
         'parquet'.
     mode : {'a', 'r', 'w'}
         'w' truncates an existing store, 'a' appends/overwrites keys,
-        'r' is read-only.
+        'r' is read-only. With the parquet engine, 'w' refuses to delete a
+        pre-existing directory that does not look like a store (see
+        :func:`_looks_like_store`).
     """
 
     def __init__(self, path: str, engine: Optional[str] = None, mode: str = 'a') -> None:
@@ -72,6 +102,13 @@ class SeasonStore:
             self._h5 = h5py.File(path, h5_mode)
         else:
             if mode == 'w' and os.path.isdir(path):
+                if not _looks_like_store(path):
+                    raise ValueError(
+                        f'refusing to overwrite {path!r}: existing directory '
+                        'does not look like a SeasonStore (expected an '
+                        "'actions' subdirectory or only .parquet content); "
+                        'delete it manually if this is intended'
+                    )
                 import shutil
 
                 shutil.rmtree(path)
@@ -129,6 +166,18 @@ class SeasonStore:
         group = self._h5[key]
         cols = json.loads(group.attrs['columns'])
         return pd.DataFrame({col: _read_column(group, col) for col in cols})
+
+    def delete(self, key: str) -> None:
+        """Remove ``key`` from the store; no-op if it does not exist."""
+        self._check_writable()
+        if self.engine == 'parquet':
+            path = self._parquet_path(key)
+            if os.path.exists(path):
+                os.unlink(path)
+            return
+        assert self._h5 is not None
+        if key in self._h5:
+            del self._h5[key]
 
     def keys(self) -> List[str]:
         """All keys in the store ('actions/game_<id>' entries included)."""
@@ -200,7 +249,12 @@ def _write_column(group: Any, name: str, series: pd.Series) -> None:
         ds = group.create_dataset(name, data=data)
         ds.attrs['codec'] = 'datetime'
     elif values.dtype == object or values.dtype.kind in ('U', 'S'):
-        encoded = [json.dumps(None if _isna(v) else v) for v in values]
+        # numpy scalars surviving in object columns (np.bool_, np.int32, ...
+        # from provider parsers) are not JSON-serializable; unwrap them.
+        encoded = [
+            json.dumps(None if _isna(v) else v, default=_unwrap_numpy)
+            for v in values
+        ]
         ds = group.create_dataset(
             name, data=encoded, dtype=h5py.string_dtype(encoding='utf-8')
         )
@@ -238,3 +292,11 @@ def _isna(v: Any) -> bool:
         return bool(pd.isna(v))
     except (TypeError, ValueError):
         return False
+
+
+def _unwrap_numpy(o: Any) -> Any:
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f'Object of type {type(o).__name__} is not JSON serializable')

@@ -41,10 +41,13 @@ def player_ratings(
         (e.g. from
         :func:`~socceraction_tpu.data.statsbomb.extract_player_games`).
         When given, adds ``*_rating`` columns normalized to 90 minutes and
-        drops players below ``min_minutes``.
+        drops players with ``min_minutes`` total minutes or fewer.
     min_minutes : float
-        Minimum total minutes to keep a player in the normalized table
-        (reference notebook: 180, "at least two full games").
+        Cut-off on total minutes for the normalized table; the boundary is
+        EXCLUSIVE (strictly more than ``min_minutes`` survives), matching
+        the reference notebook's ``minutes_played > 180`` filter
+        (reference public-notebooks/4-compute-vaep-values-and-top-players.ipynb,
+        comment "at least two full games").
 
     Returns
     -------

@@ -7,14 +7,25 @@ framework needs them, so this package provides:
 - :mod:`socceraction_tpu.utils.profiling` -- ``jax.profiler``-backed trace
   contexts, named-scope annotation for XLA ops, and a lightweight wall-clock
   timer registry for host-side stages.
+- :mod:`socceraction_tpu.utils.env` -- the clean virtual-CPU subprocess
+  environment recipe shared by the test tier, the driver dryrun, and the
+  benchmark fallback.
+
+The profiling symbols are re-exported lazily (PEP 562): ``env`` is imported
+by jax-free bootstrap processes (tests/conftest.py, bench.py) that must not
+pay — or depend on — a ``jax`` import.
 """
 
-from socceraction_tpu.utils.profiling import (
-    Timer,
-    annotate,
-    profile_trace,
-    timed,
-    timer_report,
-)
+from socceraction_tpu.utils.env import cpu_device_env
 
-__all__ = ['Timer', 'annotate', 'profile_trace', 'timed', 'timer_report']
+__all__ = ['Timer', 'annotate', 'cpu_device_env', 'profile_trace', 'timed', 'timer_report']
+
+_PROFILING_SYMBOLS = ('Timer', 'annotate', 'profile_trace', 'timed', 'timer_report')
+
+
+def __getattr__(name):
+    if name in _PROFILING_SYMBOLS:
+        from socceraction_tpu.utils import profiling
+
+        return getattr(profiling, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,56 @@
+"""Clean virtual-CPU JAX environments for subprocess bootstrapping.
+
+In this image a ``sitecustomize`` hook registers the remote-TPU ("axon")
+PJRT plugin at interpreter startup and latches ``JAX_PLATFORMS`` before
+any user code runs, so a process that needs a CPU device mesh must be
+*started* with the right environment — mutating ``os.environ`` inside the
+process is too late. This is the single source of truth for that recipe;
+it is shared by ``tests/conftest.py`` (the multi-device test tier),
+``__graft_entry__.dryrun_multichip`` (the driver's mesh dryrun), and
+``bench.py`` (the degraded CPU-fallback path).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Mapping, MutableMapping, Optional
+
+__all__ = ['cpu_device_env']
+
+_DEVICE_COUNT_FLAG = re.compile(r'--xla_force_host_platform_device_count=\d+')
+
+
+def cpu_device_env(
+    n_devices: Optional[int] = None,
+    *,
+    base: Optional[Mapping[str, str]] = None,
+    override: bool = True,
+) -> MutableMapping[str, str]:
+    """Environment for a clean ``n_devices``-virtual-CPU JAX subprocess.
+
+    Parameters
+    ----------
+    n_devices : int, optional
+        Requested ``--xla_force_host_platform_device_count``. ``None``
+        strips any existing count flag (single-device CPU).
+    base : mapping, optional
+        Environment to derive from; defaults to ``os.environ``.
+    override : bool
+        When False, an ``--xla_force_host_platform_device_count`` already
+        present in ``XLA_FLAGS`` is preserved instead of replaced (used by
+        the test tier so callers can pin their own mesh size).
+    """
+    env = dict(os.environ if base is None else base)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PALLAS_AXON_POOL_IPS'] = ''  # skip remote-TPU plugin registration
+    flags = env.get('XLA_FLAGS', '')
+    had_count = _DEVICE_COUNT_FLAG.search(flags) is not None
+    if n_devices is None or (had_count and not override):
+        if n_devices is None:
+            flags = _DEVICE_COUNT_FLAG.sub('', flags)
+    else:
+        flags = _DEVICE_COUNT_FLAG.sub('', flags)
+        flags = f'{flags} --xla_force_host_platform_device_count={int(n_devices)}'
+    env['XLA_FLAGS'] = ' '.join(flags.split())
+    return env

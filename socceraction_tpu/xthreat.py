@@ -487,6 +487,16 @@ class ExpectedThreat:
         removed from SciPy) with the same cell-centered sample points and
         edge extrapolation.
 
+        Known deviation (documented in PARITY.md): the returned ``f(x, y)``
+        is correctly oriented in pitch coordinates — the surface is flipped
+        (``self.xT[::-1]``) because grid row 0 is the *top* of the pitch.
+        The reference's interpolator skips that flip, returning a
+        y-mirrored function whose flip only cancels against the
+        ``grid[w-1-yc, xc]`` indexing inside the reference's own
+        ``rate()``; callers porting the reference's direct-interpolator
+        usage get y-mirrored values there, but not here.
+        ``rate(use_interpolation=True)`` matches the reference either way.
+
         Parameters
         ----------
         kind : {'linear', 'cubic', 'quintic'}

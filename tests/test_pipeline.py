@@ -126,3 +126,47 @@ def test_build_on_error_skip(tmp_path):
         assert store.game_ids() == []
         with pytest.raises(RuntimeError):
             build_spadl_store(loader, store, convert=broken_convert)
+
+
+def test_build_on_error_skip_after_partial_write(tmp_path):
+    """A failure AFTER actions were written must not leave a corrupt game.
+
+    With on_error='skip', keys()/game_ids() must never enumerate a game
+    whose write was interrupted (the partial frames are deleted), and no
+    metadata row may reference it.
+    """
+    loader = StatsBombLoader(getter='local', root=DATA_DIR)
+
+    class FailingAtomicStore(SeasonStore):
+        def put(self, key, frame):
+            if key.startswith('atomic_actions/'):
+                raise RuntimeError('boom in atomic put')
+            super().put(key, frame)
+
+    st = FailingAtomicStore(str(tmp_path / 'store'), mode='w')
+    build_spadl_store(loader, st, atomic=True, on_error='skip')
+    assert st.game_ids() == []
+    assert len(st.games()) == 0
+    assert not any(k.startswith('actions/') for k in st.keys())
+
+
+def test_store_delete(tmp_path):
+    for path in (str(tmp_path / 's'), str(tmp_path / 's.h5')):
+        with SeasonStore(path, mode='w') as s:
+            s.put('games', pd.DataFrame({'game_id': [1]}))
+            assert 'games' in s
+            s.delete('games')
+            assert 'games' not in s
+            s.delete('games')  # idempotent
+    with SeasonStore(str(tmp_path / 's'), mode='r') as s:
+        with pytest.raises(OSError):
+            s.delete('anything')
+
+
+def test_mode_w_refuses_non_store_dir(tmp_path):
+    precious = tmp_path / 'precious'
+    precious.mkdir()
+    (precious / 'thesis.docx').write_text('x')
+    with pytest.raises(ValueError, match='refusing to overwrite'):
+        SeasonStore(str(precious), mode='w')
+    assert (precious / 'thesis.docx').exists()

@@ -52,7 +52,9 @@ def test_minutes_normalization_and_cut(rated):
         }
     )
     table = player_ratings(rated, player_games=pg, min_minutes=180)
-    # player 3 (45 min) is cut; player 1 has exactly 180 -> cut too (strict >)
+    # player 3 (45 min) is cut; player 1 has exactly 180 -> also cut: the
+    # boundary is exclusive, matching the reference notebook's strict
+    # `minutes_played > 180` filter
     assert table['player_id'].tolist() == [2]
     row = table.iloc[0]
     assert row['vaep_rating'] == pytest.approx(0.5 * 90 / 270)

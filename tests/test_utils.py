@@ -40,3 +40,33 @@ def test_timer_report_reset():
         pass
     assert 'stage/b' in timer_report(reset=True)
     assert 'stage/b' not in timer_report()
+
+
+def test_cpu_device_env_forces_count():
+    from socceraction_tpu.utils.env import cpu_device_env
+
+    base = {'XLA_FLAGS': '--foo --xla_force_host_platform_device_count=4', 'PATH': '/x'}
+    env = cpu_device_env(8, base=base)
+    assert env['JAX_PLATFORMS'] == 'cpu'
+    assert env['PALLAS_AXON_POOL_IPS'] == ''
+    assert env['XLA_FLAGS'] == '--foo --xla_force_host_platform_device_count=8'
+    assert env['PATH'] == '/x'
+
+
+def test_cpu_device_env_preserves_existing_when_not_overriding():
+    from socceraction_tpu.utils.env import cpu_device_env
+
+    base = {'XLA_FLAGS': '--xla_force_host_platform_device_count=4'}
+    env = cpu_device_env(8, base=base, override=False)
+    assert env['XLA_FLAGS'] == '--xla_force_host_platform_device_count=4'
+    # but absent -> added
+    env2 = cpu_device_env(8, base={}, override=False)
+    assert env2['XLA_FLAGS'] == '--xla_force_host_platform_device_count=8'
+
+
+def test_cpu_device_env_strips_count():
+    from socceraction_tpu.utils.env import cpu_device_env
+
+    base = {'XLA_FLAGS': '--bar --xla_force_host_platform_device_count=4'}
+    env = cpu_device_env(None, base=base)
+    assert env['XLA_FLAGS'] == '--bar'

@@ -1,5 +1,7 @@
 """End-to-end tests of the VAEP model class (both backends, both model types)."""
 
+import warnings
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -95,15 +97,51 @@ def test_rate_backend_parity(fitted, game, spadl_actions):
     np.testing.assert_allclose(out.to_numpy(), ref.to_numpy(), atol=1e-5, rtol=1e-4)
 
 
-def test_score_metrics(fitted):
-    model, X, y = fitted
-    s = model.score(X, y)
+@pytest.fixture(scope='module')
+def fitted_two_class(game, spadl_actions):
+    """Fitted on a frame whose labels contain BOTH classes in BOTH columns.
+
+    The golden snippet has one goal (by the home side), so ``scores`` has
+    positives but ``concedes`` is single-class and ROC-AUC undefined; we
+    turn one away-team action mid-game into a successful shot so every
+    label column is two-class.
+    """
+    from socceraction_tpu.spadl import config as spadl
+
+    actions = spadl_actions.copy()
+    # the away goal must be preceded by home actions inside the 10-action
+    # label window, otherwise nothing ever "concedes" (the snapshot has
+    # long same-team runs)
+    team = actions['team_id'].to_numpy()
+    flip = next(
+        i
+        for i in range(10, len(actions))
+        if team[i] == 768 and (team[i - 9 : i] == 782).sum() >= 3
+    )
+    actions.loc[flip, ['type_id', 'result_id']] = [
+        spadl.actiontypes.index('shot'),
+        spadl.results.index('success'),
+    ]
+    np.random.seed(0)
+    model = VAEP(backend='pandas')
+    X = model.compute_features(game, actions)
+    y = model.compute_labels(game, actions)
+    model.fit(X, y, learner='sklearn')
+    return model, X, y
+
+
+def test_score_metrics(fitted_two_class):
+    model, X, y = fitted_two_class
+    assert (y.nunique() == 2).all(), 'fixture must produce two-class labels'
+    with warnings.catch_warnings():
+        # ROC-AUC must be defined: no UndefinedMetricWarning may fire
+        warnings.simplefilter('error')
+        s = model.score(X, y)
     for col in ('scores', 'concedes'):
-        assert 0 <= s[col]['brier'] <= 1
-        # the 200-action snippet has goal-free label columns, for which
-        # ROC-AUC is undefined; assert it only when both classes occur
-        if y[col].nunique() > 1:
-            assert 0 <= s[col]['auroc'] <= 1
+        # training-set fit of a gradient-boosted model on 200 actions:
+        # clearly better than chance, calibrated probabilities
+        assert 0.0 <= s[col]['brier'] <= 0.15
+        assert 0.7 <= s[col]['auroc'] <= 1.0
 
 
 def test_mlp_learner_and_fused_rate_batch(game, spadl_actions, home_team_id):
