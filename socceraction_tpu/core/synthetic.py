@@ -91,3 +91,94 @@ def synthetic_batch(
         game_id=jnp.arange(G, dtype=jnp.int32),
         row_index=jnp.asarray(row_index),
     )
+
+
+def synthetic_actions_frame(
+    game_id: int = 1,
+    *,
+    home_team_id: int = 100,
+    away_team_id: int = 200,
+    n_actions: int = 1600,
+    seed: int = 0,
+):
+    """A schema-valid synthetic SPADL DataFrame for one game.
+
+    Statistically plausible: possession alternates in runs, passes
+    dominate, ~25 shots/game with ~10% conversion so label/formula paths
+    see real goals. Used by the synthetic stand-in store
+    (``tests/datasets/make_synthetic_store.py``) that lets the @e2e tier
+    execute without network egress.
+    """
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    n = int(n_actions)
+
+    # possession runs: geometric lengths, alternating teams
+    team_id = np.empty(n, dtype=np.int64)
+    pos = 0
+    team = home_team_id if rng.integers(2) else away_team_id
+    while pos < n:
+        run = 1 + rng.geometric(0.22)
+        team_id[pos : pos + run] = team
+        team = away_team_id if team == home_team_id else home_team_id
+        pos += run
+
+    n_types = len(spadlconfig.actiontypes)
+    probs = np.full(n_types, 0.012)
+    probs[spadlconfig.PASS] = 0.50
+    probs[spadlconfig.DRIBBLE] = 0.22
+    probs[spadlconfig.SHOT] = 0.015
+    probs /= probs.sum()
+    type_id = rng.choice(n_types, size=n, p=probs)
+
+    result_id = np.where(rng.random(n) < 0.75, spadlconfig.SUCCESS, spadlconfig.FAIL)
+    shots = type_id == spadlconfig.SHOT
+    result_id[shots] = np.where(
+        rng.random(shots.sum()) < 0.10, spadlconfig.SUCCESS, spadlconfig.FAIL
+    )
+
+    half = n // 2
+    period_id = np.where(np.arange(n) < half, 1, 2)
+    time_seconds = np.concatenate(
+        [
+            np.sort(rng.uniform(0, 45 * 60, size=half)),
+            np.sort(rng.uniform(0, 45 * 60, size=n - half)),
+        ]
+    )
+
+    L, W = spadlconfig.field_length, spadlconfig.field_width
+    # positions drift like a bounded random walk so dribbles/passes move
+    start_x = np.clip(np.cumsum(rng.normal(0, 9, size=n)) % (2 * L), 0, None)
+    start_x = np.where(start_x > L, 2 * L - start_x, start_x)
+    start_y = np.clip(np.cumsum(rng.normal(0, 6, size=n)) % (2 * W), 0, None)
+    start_y = np.where(start_y > W, 2 * W - start_y, start_y)
+    end_x = np.clip(start_x + rng.normal(4, 10, size=n), 0, L)
+    end_y = np.clip(start_y + rng.normal(0, 7, size=n), 0, W)
+
+    players = {
+        home_team_id: np.arange(1, 12) + home_team_id * 1000,
+        away_team_id: np.arange(1, 12) + away_team_id * 1000,
+    }
+    player_id = np.array([rng.choice(players[t]) for t in team_id])
+
+    return pd.DataFrame(
+        {
+            'game_id': np.full(n, game_id, dtype=np.int64),
+            'original_event_id': [f'synth-{game_id}-{i}' for i in range(n)],
+            'action_id': np.arange(n, dtype=np.int64),
+            'period_id': period_id.astype(np.int64),
+            'time_seconds': time_seconds,
+            'team_id': team_id,
+            'player_id': player_id.astype(np.int64),
+            'start_x': start_x.astype(np.float64),
+            'start_y': start_y.astype(np.float64),
+            'end_x': end_x.astype(np.float64),
+            'end_y': end_y.astype(np.float64),
+            'type_id': type_id.astype(np.int64),
+            'result_id': result_id.astype(np.int64),
+            'bodypart_id': rng.choice(
+                len(spadlconfig.bodyparts), size=n, p=[0.85, 0.08, 0.05, 0.02]
+            ).astype(np.int64),
+        }
+    )

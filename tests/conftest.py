@@ -68,6 +68,35 @@ def atomic_spadl_actions() -> pd.DataFrame:
 
 
 @pytest.fixture(scope='session')
+def sb_worldcup_store():
+    """Read-only handle on the real WC2018 per-game SPADL store.
+
+    The @e2e tier's data source (reference fixture ``sb_worldcup_data``,
+    upstream tests/conftest.py). Built by ``tests/datasets/download.py``
+    from the StatsBomb open data; skips when the store is absent (e.g. in
+    an air-gapped environment).
+    """
+    from socceraction_tpu.pipeline import SeasonStore
+
+    path = Path(
+        os.environ.get(
+            'SOCCERACTION_TPU_WC_STORE',
+            DATA_DIR / 'statsbomb' / 'spadl-WorldCup-2018.h5',
+        )
+    )
+    if not path.exists():
+        pytest.skip(
+            'WC2018 SPADL store not available; run '
+            '`python tests/datasets/download.py` (requires network egress) '
+            'or point SOCCERACTION_TPU_WC_STORE at a stand-in store '
+            '(tests/datasets/make_synthetic_store.py)'
+        )
+    store = SeasonStore(str(path), mode='r')
+    yield store
+    store.close()
+
+
+@pytest.fixture(scope='session')
 def home_team_id() -> int:
     """Home team id tests pass alongside the golden snapshot.
 

@@ -1,0 +1,110 @@
+"""Fetch the public datasets and build the WC2018 SPADL store for e2e tests.
+
+Counterpart of the reference's dataset pipeline (reference
+``tests/datasets/download.py:39-152``), rebuilt on this package's own
+pipeline layer: the StatsBomb open-data archive is downloaded and unpacked
+into the local-directory layout the loader understands, then
+:func:`socceraction_tpu.pipeline.build_spadl_store` converts the FIFA World
+Cup 2018 competition into the per-game HDF5 store
+(``spadl-WorldCup-2018.h5``) that the ``@e2e`` test tier and the quality
+report consume. The Wyscout public dataset is fetched through
+:class:`~socceraction_tpu.data.wyscout.PublicWyscoutLoader`'s own figshare
+download.
+
+Requires network egress; in an air-gapped environment the e2e tests skip
+with a pointer to this script. All downloads are cached — re-running is a
+no-op when the artifacts exist.
+
+Usage::
+
+    python tests/datasets/download.py [statsbomb|wyscout|all]
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import sys
+import zipfile
+from urllib.request import urlopen
+
+logging.basicConfig(level=logging.INFO, format='%(levelname)s %(message)s')
+logger = logging.getLogger('download')
+
+DATA_DIR = os.path.dirname(os.path.abspath(__file__))
+OPEN_DATA_URL = 'https://github.com/statsbomb/open-data/archive/master.zip'
+OPEN_DATA_DIR = os.path.join(DATA_DIR, 'statsbomb', 'open-data')
+WORLDCUP_STORE = os.path.join(DATA_DIR, 'statsbomb', 'spadl-WorldCup-2018.h5')
+WYSCOUT_DIR = os.path.join(DATA_DIR, 'wyscout_public', 'raw')
+
+#: StatsBomb open-data ids of the FIFA World Cup 2018 competition
+WORLDCUP_COMPETITION_ID = 43
+WORLDCUP_SEASON_ID = 3
+
+
+def download_statsbomb_data(force: bool = False) -> str:
+    """Download + unpack the StatsBomb open-data archive (cached)."""
+    if os.path.isdir(OPEN_DATA_DIR) and not force:
+        logger.info('StatsBomb open data already present at %s', OPEN_DATA_DIR)
+        return OPEN_DATA_DIR
+    tmp = os.path.join(DATA_DIR, 'statsbomb', 'tmp')
+    os.makedirs(tmp, exist_ok=True)
+    archive = os.path.join(tmp, 'open-data-master.zip')
+    logger.info('downloading %s (several GB, be patient)', OPEN_DATA_URL)
+    with urlopen(OPEN_DATA_URL) as response, open(archive, 'wb') as out:
+        shutil.copyfileobj(response, out)
+    logger.info('unpacking %s', archive)
+    with zipfile.ZipFile(archive) as zf:
+        zf.extractall(tmp)
+    if os.path.isdir(OPEN_DATA_DIR):
+        shutil.rmtree(OPEN_DATA_DIR)
+    os.rename(os.path.join(tmp, 'open-data-master', 'data'), OPEN_DATA_DIR)
+    shutil.rmtree(tmp)
+    logger.info('open data ready at %s', OPEN_DATA_DIR)
+    return OPEN_DATA_DIR
+
+
+def build_worldcup_store(force: bool = False) -> str:
+    """Convert WC2018 into the per-game SPADL + Atomic-SPADL HDF5 store."""
+    if os.path.exists(WORLDCUP_STORE) and not force:
+        logger.info('WC2018 store already present at %s', WORLDCUP_STORE)
+        return WORLDCUP_STORE
+    from socceraction_tpu.data.statsbomb import StatsBombLoader
+    from socceraction_tpu.pipeline import SeasonStore, build_spadl_store
+
+    loader = StatsBombLoader(getter='local', root=OPEN_DATA_DIR)
+    with SeasonStore(WORLDCUP_STORE, mode='w') as store:
+        build_spadl_store(
+            loader,
+            store,
+            competitions=[(WORLDCUP_COMPETITION_ID, WORLDCUP_SEASON_ID)],
+            atomic=True,
+            on_error='skip',
+        )
+        n = len(store.game_ids())
+    logger.info('WC2018 store built: %d games at %s', n, WORLDCUP_STORE)
+    return WORLDCUP_STORE
+
+
+def download_wyscout_data() -> str:
+    """Fetch the Wyscout public dataset via the loader's figshare download."""
+    from socceraction_tpu.data.wyscout import PublicWyscoutLoader
+
+    os.makedirs(WYSCOUT_DIR, exist_ok=True)
+    PublicWyscoutLoader(root=WYSCOUT_DIR)  # __init__ downloads + indexes
+    logger.info('Wyscout public data ready at %s', WYSCOUT_DIR)
+    return WYSCOUT_DIR
+
+
+def main(argv) -> None:
+    what = argv[1] if len(argv) > 1 else 'statsbomb'
+    if what in ('statsbomb', 'all'):
+        download_statsbomb_data()
+        build_worldcup_store()
+    if what in ('wyscout', 'all'):
+        download_wyscout_data()
+
+
+if __name__ == '__main__':
+    main(sys.argv)

@@ -1,0 +1,75 @@
+"""Build a synthetic 64-game stand-in for the WC2018 SPADL store.
+
+Lets the @e2e tier execute in an air-gapped environment: same HDF5 key
+layout as the real store built by ``download.py`` (``games``/``teams``/
+``players``/``actions/game_<id>`` + vocab tables) but filled with
+statistically plausible synthetic games
+(:func:`socceraction_tpu.core.synthetic.synthetic_actions_frame`). A
+``meta`` table marks the store synthetic so quality-parity assertions
+against the reference's published numbers know to skip.
+
+Usage::
+
+    python tests/datasets/make_synthetic_store.py [path] [n_games]
+    SOCCERACTION_TPU_WC_STORE=<path> pytest tests/ -m e2e
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pandas as pd
+
+DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), 'statsbomb', 'spadl-synthetic.h5'
+)
+
+
+def make_synthetic_store(path: str = DEFAULT_PATH, n_games: int = 64):
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.pipeline import SeasonStore
+    from socceraction_tpu.spadl import config as spadlcfg
+
+    games, teams, players = [], {}, []
+    with SeasonStore(path, mode='w') as store:
+        store.put('actiontypes', spadlcfg.actiontypes_df())
+        store.put('results', spadlcfg.results_df())
+        store.put('bodyparts', spadlcfg.bodyparts_df())
+        for i in range(n_games):
+            game_id = 9000 + i
+            home, away = 100 + 2 * (i % 16), 101 + 2 * (i % 16)
+            actions = synthetic_actions_frame(
+                game_id, home_team_id=home, away_team_id=away, seed=i
+            )
+            store.put_actions(game_id, actions)
+            games.append(
+                {'game_id': game_id, 'home_team_id': home, 'away_team_id': away}
+            )
+            for t in (home, away):
+                teams[t] = {'team_id': t, 'team_name': f'Team {t}'}
+                players.extend(
+                    {
+                        'game_id': game_id,
+                        'team_id': t,
+                        'player_id': t * 1000 + j,
+                        'player_name': f'Player {t}-{j}',
+                        'minutes_played': 90,
+                    }
+                    for j in range(1, 12)
+                )
+        store.put('games', pd.DataFrame(games))
+        store.put('teams', pd.DataFrame(list(teams.values())))
+        store.put('players', pd.DataFrame(players))
+        store.put('meta', pd.DataFrame({'synthetic': [True]}))
+    return path
+
+
+if __name__ == '__main__':
+    sys.path.insert(
+        0,
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    )
+    path = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_PATH
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    print(make_synthetic_store(path, n))
