@@ -1,0 +1,256 @@
+"""On-chip experiment: why is the fused rating path slower than materialized?
+
+Round-2 bench (BENCH_r02.json, TPU v5 lite): fused 15.1M actions/s vs
+materialized 43.1M. Hypothesis: the fused path issues 4 one-hot blocks x
+k=3 states = 12 separate row-gathers, each producing a (G, A, H) f32
+intermediate chained through ``h +=`` — ~12 HBM round-trips of a ~435 MB
+tensor, far more traffic than the materialized path's one 1.9 GB feature
+tensor write + read.
+
+Variant measured here: fold the one-hot blocks of each state into ONE
+combined table indexed by ``(type * R + result) * B + bodypart``
+(23*6*4 = 552 rows x H — VMEM-resident), so the one-hot contribution is a
+single gather per state (3 total instead of 12):
+
+``W_combined[c] = W_at[t(c)] + W_res[r(c)] + W_atr[t(c)*R + r(c)] + W_bp[b(c)]``
+
+Numerically the same sum, reassociated.
+
+Measured (TPU v5 lite, 512 games x 1664 actions = 851,968 valid actions,
+10-call mean; run-to-run tunnel variance ~±15%):
+
+==================  ===========  ==============
+variant             ms/call      M actions/s
+==================  ===========  ==============
+fused, 12 gathers   44.0 - 60.3   14.1 - 19.4
+combined, 3 gathers 18.2 - 22.2   38.3 - 46.9
+materialized        19.8 - 22.6   37.7 - 43.0
+==================  ===========  ==============
+
+Conclusion (acted on in round 3): the combined fold is the fastest form
+and became the library implementation of ``ops/fused.fused_mlp_logits``
+(so the 'fused' variant measured by ``bench.py`` IS the combined form);
+the per-block form survives only here, inline, as the documented
+counterexample. The ~1.6e-2 divergence of gather paths vs materialized on
+TPU is the *materialized* path's default-precision bf16 matmul over the
+513 one-hot columns — the gathers are exact f32 row sums (CPU tests pin
+them to <=1e-6 of the f32 materialized path).
+
+Usage: python benchmarks/fused_experiment.py [--games 512]
+Prints per-variant seconds/call and actions/sec.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _K, _NAMES, entry
+from socceraction_tpu.core.synthetic import synthetic_batch
+from socceraction_tpu.ml.mlp import _MLP
+from socceraction_tpu.ops.features import KERNELS, _States
+from socceraction_tpu.ops.formula import vaep_values
+from socceraction_tpu.spadl import config as spadlconfig
+
+_T = len(spadlconfig.actiontypes)
+_R = len(spadlconfig.results)
+_B = len(spadlconfig.bodyparts)
+
+_ONEHOT = {
+    'actiontype_onehot': _T,
+    'result_onehot': _R,
+    'actiontype_result_onehot': _T * _R,
+    'bodypart_onehot': _B,
+}
+
+
+def perblock_mlp_logits(params, batch, *, names, k, hidden_layers):
+    """The round-2 gather-per-block fused form (the documented loser).
+
+    Kept inline so the regression stays measurable after ``ops/fused.py``
+    switched to the combined-table fold.
+    """
+    leaves = params['params']
+    d0 = leaves['Dense_0']
+    Wk = jnp.asarray(d0['kernel'])
+    bias = jnp.asarray(d0['bias'])
+    s = _States(batch, k)
+
+    extractors = {
+        'actiontype_onehot': lambda s, i: s.type_id[i],
+        'result_onehot': lambda s, i: s.result_id[i],
+        'actiontype_result_onehot': lambda s, i: s.type_id[i] * _R + s.result_id[i],
+        'bodypart_onehot': lambda s, i: s.bodypart_id[i],
+    }
+
+    h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), jnp.float32) + bias
+    dense_blocks, dense_spans = [], []
+    off = 0
+    for name in names:
+        if name in _ONEHOT:
+            per = _ONEHOT[name]
+            for i in range(k):
+                rows = jax.lax.slice_in_dim(
+                    Wk, off + i * per, off + (i + 1) * per, axis=0
+                )
+                h = h + rows[extractors[name](s, i)]
+            off += per * k
+        else:
+            block = KERNELS[name](s)
+            dense_blocks.append(block)
+            dense_spans.append((off, block.shape[-1]))
+            off += block.shape[-1]
+    if dense_blocks:
+        x_dense = jnp.concatenate(dense_blocks, axis=-1)
+        W_dense = jnp.concatenate(
+            [jax.lax.slice_in_dim(Wk, o, o + w, axis=0) for o, w in dense_spans],
+            axis=0,
+        )
+        h = h + x_dense @ W_dense
+
+    x = jax.nn.relu(h)
+    for li in range(1, hidden_layers):
+        d = leaves[f'Dense_{li}']
+        x = jax.nn.relu(x @ jnp.asarray(d['kernel']) + jnp.asarray(d['bias']))
+    d_out = leaves[f'Dense_{hidden_layers}']
+    return (x @ jnp.asarray(d_out['kernel']) + jnp.asarray(d_out['bias']))[..., 0]
+
+
+def combined_mlp_logits(params, batch, *, names, k, hidden_layers):
+    """fused_mlp_logits with per-state combined one-hot tables."""
+    leaves = params['params']
+    d0 = leaves['Dense_0']
+    Wk = jnp.asarray(d0['kernel'])
+    bias = jnp.asarray(d0['bias'])
+    s = _States(batch, k)
+
+    # layout pass
+    onehot_slices = {}  # name -> offset
+    dense_blocks, dense_spans = [], []
+    off = 0
+    for name in names:
+        if name in _ONEHOT:
+            onehot_slices[name] = off
+            off += _ONEHOT[name] * k
+        else:
+            block = KERNELS[name](s)
+            dense_blocks.append(block)
+            dense_spans.append((off, block.shape[-1]))
+            off += block.shape[-1]
+    assert Wk.shape[0] == off, (Wk.shape, off)
+
+    # combined table per state: 552 rows, each the sum of the four blocks'
+    # rows for that (type, result, bodypart) combo
+    c = jnp.arange(_T * _R * _B)
+    t_of = c // (_R * _B)
+    r_of = (c // _B) % _R
+    tr_of = c // _B
+    b_of = c % _B
+    rows_of = {
+        'actiontype_onehot': t_of,
+        'result_onehot': r_of,
+        'actiontype_result_onehot': tr_of,
+        'bodypart_onehot': b_of,
+    }
+
+    h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), jnp.float32) + bias
+    for i in range(k):
+        table = jnp.zeros((_T * _R * _B, Wk.shape[1]), jnp.float32)
+        for name, off0 in onehot_slices.items():
+            per = _ONEHOT[name]
+            rows = jax.lax.slice_in_dim(
+                Wk, off0 + i * per, off0 + (i + 1) * per, axis=0
+            )
+            table = table + rows[rows_of[name]]
+        ids = (s.type_id[i] * _R + s.result_id[i]) * _B + s.bodypart_id[i]
+        h = h + table[ids]
+
+    if dense_blocks:
+        x_dense = jnp.concatenate(dense_blocks, axis=-1)
+        W_dense = jnp.concatenate(
+            [jax.lax.slice_in_dim(Wk, o, o + w, axis=0) for o, w in dense_spans],
+            axis=0,
+        )
+        h = h + x_dense @ W_dense
+
+    x = jax.nn.relu(h)
+    for li in range(1, hidden_layers):
+        d = leaves[f'Dense_{li}']
+        x = jax.nn.relu(x @ jnp.asarray(d['kernel']) + jnp.asarray(d['bias']))
+    d_out = leaves[f'Dense_{hidden_layers}']
+    return (x @ jnp.asarray(d_out['kernel']) + jnp.asarray(d_out['bias']))[..., 0]
+
+
+def measure(fn, args, n_iters=10):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n_iters, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--games', type=int, default=512)
+    ap.add_argument('--iters', type=int, default=10)
+    args = ap.parse_args()
+
+    print('devices:', jax.devices())
+    fused_forward, (params, _) = entry()
+    batch = synthetic_batch(n_games=args.games, n_actions=1664, seed=1)
+    total = int(batch.total_actions)
+    print(f'batch: {args.games} games x 1664, {total} valid actions')
+
+    module = _MLP((128, 128))
+    from socceraction_tpu.ops.features import compute_features
+
+    def materialized_forward(params, b):
+        feats = compute_features(b, names=_NAMES, k=_K)
+        p_s = jax.nn.sigmoid(module.apply(params['scores'], feats))
+        p_c = jax.nn.sigmoid(module.apply(params['concedes'], feats))
+        return vaep_values(b, p_s, p_c)
+
+    def combined_forward(params, b):
+        p_s = jax.nn.sigmoid(
+            combined_mlp_logits(params['scores'], b, names=_NAMES, k=_K, hidden_layers=2)
+        )
+        p_c = jax.nn.sigmoid(
+            combined_mlp_logits(params['concedes'], b, names=_NAMES, k=_K, hidden_layers=2)
+        )
+        return vaep_values(b, p_s, p_c)
+
+    def perblock_forward(params, b):
+        p_s = jax.nn.sigmoid(
+            perblock_mlp_logits(params['scores'], b, names=_NAMES, k=_K, hidden_layers=2)
+        )
+        p_c = jax.nn.sigmoid(
+            perblock_mlp_logits(params['concedes'], b, names=_NAMES, k=_K, hidden_layers=2)
+        )
+        return vaep_values(b, p_s, p_c)
+
+    results = {}
+    outs = {}
+    for name, fn in [
+        ('fused_12gather', perblock_forward),
+        ('combined_3gather', combined_forward),
+        ('library_fused', fused_forward),
+        ('materialized', materialized_forward),
+    ]:
+        dt, out = measure(jax.jit(fn), (params, batch), args.iters)
+        results[name] = dt
+        outs[name] = out
+        print(f'{name:>18}: {dt * 1e3:8.2f} ms/call  {total / dt / 1e6:8.1f}M actions/s')
+
+    # parity
+    ref = outs['materialized']
+    for name in ('fused_12gather', 'combined_3gather', 'library_fused'):
+        d = jnp.nanmax(jnp.abs(outs[name] - ref))
+        print(f'max |{name} - materialized| = {float(d):.3e}')
+
+
+if __name__ == '__main__':
+    main()

@@ -8,19 +8,39 @@ multiplies mostly zeros.
 
 For a one-hot block, ``onehot(id) @ W == W[id]`` — a row gather. This
 module applies an MLP's first layer without ever materializing the one-hot
-columns:
+columns. Crucially, *all* one-hot blocks of one game state are folded into
+a **single combined table** before the gather: every one-hot id in a state
+is a function of the (type, result, bodypart) triple, so
 
-``h = b + Σ_blocks W_block[id_block] + x_dense @ W_dense``
+``W_combined[(t·R + r)·B + b] = W_at[t] + W_res[r] + W_atr[t·R + r] + W_bp[b]``
+
+is a tiny ``(T·R·B = 552, H)`` table (VMEM-resident) and the whole one-hot
+contribution of state ``i`` is ONE row gather:
+
+``h = bias + Σ_{i<k} W_combined_i[combo_id_i] + x_dense @ W_dense``
 
 where only the small dense sub-tensor (time, locations, polar, movement,
 deltas, goalscore, ...) is built. Input standardization ``(x - μ)/σ`` is an
 affine map, so it folds into the weights (``W/σ``) and bias
 (``b - Σ_j μ_j W_j / σ_j``) and the gather identity still holds.
 
+Why the fold matters on TPU (measured, v5 lite, 512 games × 1664 actions,
+``benchmarks/fused_experiment.py``): the gather-per-block form issues
+4 blocks × 3 states = 12 chained gathers, each materializing a
+``(G, A, H)`` f32 intermediate through ``h +=`` — ~12 HBM round-trips of a
+~435 MB tensor, and measured **14.1M actions/s**, 2.7× *slower* than just
+materializing the feature tensor (37.7M). The combined-table form does 3
+gathers total and measures **42.4M actions/s** — the fastest path, and the
+one exported as the flagship (``__graft_entry__.entry``). On TPU it is
+also *more accurate* than the materialized path, whose big
+``(G·A, 568) @ (568, H)`` matmul runs in default-precision bf16 passes;
+the gathers are exact f32 row additions.
+
 The result is numerically the same computation reordered (parity ≤ 1e-6 of
-the materialized path); it is used by the flagship rating entry point, by
-:meth:`MLPClassifier.predict_proba_device_batch`, and by the jitted
-two-head rating path (:func:`fused_pair_probs`) behind ``VAEP.rate_batch``.
+the materialized path in f32); it is used by the flagship rating entry
+point, by :meth:`MLPClassifier.predict_proba_device_batch`, and by the
+jitted two-head rating path (:func:`fused_pair_probs`) behind
+``VAEP.rate_batch``.
 """
 
 from __future__ import annotations
@@ -53,12 +73,24 @@ _N_BODYPARTS = len(spadlconfig.bodyparts)
 
 
 class FusedRegistry(NamedTuple):
-    """How to fuse one feature family's layout into a first dense layer."""
+    """How to fuse one feature family's layout into a first dense layer.
+
+    ``combo_size``/``combo_ids``/``combo_rows`` describe the *combined
+    table* fold (module docstring): every one-hot id in a state is a
+    function of one small combined categorical id (``combo_ids``), and
+    ``combo_rows[name]`` maps the enumerated combo indices ``0..combo_size``
+    to the block's own row ids so the per-block weight rows can be summed
+    into one table.
+    """
 
     kernels: Dict[str, Any]  # name -> dense-block kernel (feature registry)
     make_states: Callable[[Any, int], Any]  # batch, k -> per-state views
     onehot_specs: Dict[str, Tuple[int, Callable[[Any, int], jax.Array]]]
     # name -> (columns per state, id extractor)
+    combo_size: int  # rows of the combined per-state table
+    combo_ids: Callable[[Any, int], jax.Array]  # states, i -> (G, A) combo id
+    combo_rows: Dict[str, Callable[[jax.Array], jax.Array]]
+    # name -> (combo indices -> block row ids)
 
 
 #: Standard SPADL layout. The id spaces and type-major actiontype×result
@@ -76,6 +108,16 @@ STANDARD_REGISTRY = FusedRegistry(
         ),
         'bodypart_onehot': (_N_BODYPARTS, lambda s, i: s.bodypart_id[i]),
     },
+    combo_size=_N_TYPES * _N_RESULTS * _N_BODYPARTS,
+    combo_ids=lambda s, i: (
+        s.type_id[i] * _N_RESULTS + s.result_id[i]
+    ) * _N_BODYPARTS + s.bodypart_id[i],
+    combo_rows={
+        'actiontype_onehot': lambda c: c // (_N_RESULTS * _N_BODYPARTS),
+        'result_onehot': lambda c: (c // _N_BODYPARTS) % _N_RESULTS,
+        'actiontype_result_onehot': lambda c: c // _N_BODYPARTS,
+        'bodypart_onehot': lambda c: c % _N_BODYPARTS,
+    },
 )
 
 # Atomic actiontype one-hot columns are *merged groups* (corner*/freekick*
@@ -89,6 +131,8 @@ for _g, (_, _ids) in enumerate(_atomicops._ONEHOT_GROUPS):
         _atomic_group_lut[_t] = _g
 _ATOMIC_GROUP_OF_TYPE = jnp.asarray(_atomic_group_lut, dtype=jnp.int32)
 
+_N_ATOMIC_BODYPARTS = len(atomicconfig.bodyparts)
+
 #: Atomic-SPADL layout (:mod:`socceraction_tpu.ops.atomic`).
 ATOMIC_REGISTRY = FusedRegistry(
     kernels=ATOMIC_KERNELS,
@@ -99,9 +143,18 @@ ATOMIC_REGISTRY = FusedRegistry(
             lambda s, i: _ATOMIC_GROUP_OF_TYPE[s.type_id[i]],
         ),
         'bodypart_onehot': (
-            len(atomicconfig.bodyparts),
+            _N_ATOMIC_BODYPARTS,
             lambda s, i: s.bodypart_id[i],
         ),
+    },
+    combo_size=_N_ATOMIC_GROUPS * _N_ATOMIC_BODYPARTS,
+    combo_ids=lambda s, i: (
+        _ATOMIC_GROUP_OF_TYPE[s.type_id[i]] * _N_ATOMIC_BODYPARTS
+        + s.bodypart_id[i]
+    ),
+    combo_rows={
+        'actiontype_onehot': lambda c: c // _N_ATOMIC_BODYPARTS,
+        'bodypart_onehot': lambda c: c % _N_ATOMIC_BODYPARTS,
     },
 )
 
@@ -174,16 +227,16 @@ def fused_mlp_logits(
 
     # first pass: resolve the column layout (and build the dense blocks)
     # so a kernel/layout mismatch raises before any slicing
-    layout: List[Tuple[Optional[Tuple[int, Callable]], Optional[jax.Array], int]] = []
+    layout: List[Tuple[str, Optional[Tuple[int, Callable]], Optional[jax.Array], int]] = []
     off = 0
     for name in names:
         spec = registry.onehot_specs.get(name)
         if spec is not None:
-            layout.append((spec, None, off))
+            layout.append((name, spec, None, off))
             off += spec[0] * k
         else:
             block = registry.kernels[name](s)
-            layout.append((None, block, off))
+            layout.append((name, None, block, off))
             off += block.shape[-1]
     if Wk.shape[0] != off:
         raise ValueError(
@@ -192,19 +245,34 @@ def fused_mlp_logits(
         )
 
     h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), jnp.float32) + bias
+    onehot_layout = [
+        (name, spec, off) for name, spec, _, off in layout if spec is not None
+    ]
     dense_blocks: List[jax.Array] = []
     dense_spans: List[Tuple[int, int]] = []
-    for spec, block, off in layout:
-        if spec is not None:
-            per, get_ids = spec
-            for i in range(k):
+    for name, spec, block, off in layout:
+        if spec is None:
+            dense_blocks.append(block)
+            dense_spans.append((off, block.shape[-1]))
+
+    if onehot_layout:
+        # Fold every one-hot block of a state into ONE combined
+        # (combo_size, H) table so the whole one-hot contribution is a
+        # single row gather per state — one (G, A, H) intermediate per
+        # state instead of one per block per state (module docstring;
+        # measured 3× on a v5e). Table build cost is combo_size × H.
+        combo = jnp.arange(registry.combo_size)
+        combo_rows = {
+            name: registry.combo_rows[name](combo) for name, _, _ in onehot_layout
+        }
+        for i in range(k):
+            table = jnp.zeros((registry.combo_size, Wk.shape[1]), jnp.float32)
+            for name, (per, _), off in onehot_layout:
                 rows = jax.lax.slice_in_dim(
                     Wk, off + i * per, off + (i + 1) * per, axis=0
                 )
-                h = h + rows[get_ids(s, i)]
-        else:
-            dense_blocks.append(block)
-            dense_spans.append((off, block.shape[-1]))
+                table = table + rows[combo_rows[name]]
+            h = h + table[registry.combo_ids(s, i)]
     if dense_blocks:
         x_dense = jnp.concatenate(dense_blocks, axis=-1)
         W_dense = jnp.concatenate(
