@@ -19,26 +19,37 @@ contraction*:
   (grid iterates chunks fastest), so the accumulator never round-trips HBM.
 
 Cost is ``n_padded × n_segments_padded`` MACs — pure MXU work with no
-serialization. Measured on a v4 chip with an 850k-action stream (20-call
-mean, vs the XLA scatter):
+serialization. Measured on a **TPU v5 lite** (the chip this image
+benches on) with an 851,968-action stream, 20-call mean, vs the XLA
+scatter (``benchmarks/segment_crossover.py`` — rerun it to re-derive this
+table on a different chip generation):
 
 =============  ========  =======  =========
 num_segments   Pallas     XLA     speed-up
 =============  ========  =======  =========
-192 (16×12)    4.3 ms    15.5 ms   3.6×
-2 048          8.7 ms    20.8 ms   2.4×
-24 000         56 ms     23.8 ms   0.4×
+192 (16×12)     0.04 ms   0.04 ms   1.0×
+2 048           8.3 ms   20.6 ms    2.5×
+4 096          12.9 ms    9.0 ms    0.7×
+8 192          21.6 ms    9.0 ms    0.4×
+24 000 (192×125) 56.2 ms  9.2 ms    0.2×
 =============  ========  =======  =========
+
+The shape of the table: XLA's scatter is *conflict*-serialized, so its
+cost falls as segments grow (fewer colliding indices per bucket) and
+flattens near ~9 ms, while the Pallas one-hot work grows linearly with
+segments. On the v5e the kernels tie at the 192-cell default grid
+(both memory-bound reading the stream), Pallas wins ~2.5× in the
+few-thousand-segment band, and XLA wins beyond ~3k segments —
+:func:`segment_sum` auto-dispatches Pallas on TPU up to
+:data:`PALLAS_MAX_SEGMENTS` (2048, the last measured Pallas win; the
+round-2 value 8192 came from v4 measurements and is wrong for v5e),
+XLA scatter otherwise. Override with ``SOCCERACTION_TPU_SEGMENT=
+pallas|xla`` (the ``pallas`` override on CPU runs in interpret mode,
+which is how the unit tests exercise the kernel without a TPU).
 
 The contraction runs at ``Precision.HIGHEST`` (f32 multi-pass on the MXU;
 the default bf16 passes cost ~2e-3 relative error, far beyond the
 framework's 1e-5 parity contract — measured relerr at HIGHEST is ≤ 2e-6).
-Past ~8k segments the one-hot work grows linearly while scatter cost is
-flat, so :func:`segment_sum` auto-dispatches: Pallas on TPU up to
-:data:`PALLAS_MAX_SEGMENTS`, XLA scatter otherwise. Override with
-``SOCCERACTION_TPU_SEGMENT=pallas|xla`` (the ``pallas`` override on CPU
-runs in interpret mode, which is how the unit tests exercise the kernel
-without a TPU).
 """
 
 from __future__ import annotations
@@ -56,7 +67,8 @@ __all__ = ['segment_sum', 'segment_sum_pallas', 'segment_sum_xla']
 
 CHUNK = 512  # actions per grid step
 SEG_BLOCK = 1024  # segment (grid-cell) lanes per grid step
-PALLAS_MAX_SEGMENTS = 8192  # crossover to XLA scatter (see module docstring)
+PALLAS_MAX_SEGMENTS = 2048  # crossover to XLA scatter, measured on v5e
+# (module docstring; re-derive with benchmarks/segment_crossover.py)
 
 
 def _kernel(ids_ref, vals_ref, out_ref):
