@@ -38,8 +38,9 @@ import time
 BASELINE_ACTIONS_PER_SEC = 1_000_000.0
 
 # Generous: first remote TPU compile of the fused program is ~20-40s per
-# kernel shape and can take minutes for big programs.
-_CHILD_DEADLINE_S = float(os.environ.get('SOCCERACTION_TPU_BENCH_DEADLINE', 420))
+# kernel shape and can take minutes for big programs (and round 3 added
+# the extra BASELINE configs: two xT fits at 3k-game scale + a train step).
+_CHILD_DEADLINE_S = float(os.environ.get('SOCCERACTION_TPU_BENCH_DEADLINE', 540))
 _RETRY_DELAY_S = float(os.environ.get('SOCCERACTION_TPU_BENCH_RETRY_DELAY', 30))
 
 
@@ -58,6 +59,55 @@ def _measure(fn, args, *, n_iters: int = 10) -> float:
         out = fn(*args)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / n_iters
+
+
+# Peak specs for roofline context, per device_kind prefix. v5 lite (v5e):
+# 197 TFLOP/s bf16 MXU, 819 GB/s HBM (public TPU spec sheet numbers).
+_PEAKS = {
+    'TPU v5 lite': {'tflops_bf16': 197.0, 'hbm_gb_s': 819.0},
+    'TPU v5': {'tflops_bf16': 459.0, 'hbm_gb_s': 1228.0},
+    'TPU v4': {'tflops_bf16': 275.0, 'hbm_gb_s': 1228.0},
+}
+
+
+def _cost_analysis(jitted, args):
+    """XLA's own (flops, bytes accessed) for a compiled fn, or Nones."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns one dict per device
+            cost = cost[0]
+        return float(cost.get('flops', 0.0)), float(cost.get('bytes accessed', 0.0))
+    except Exception:
+        return None, None
+
+
+def _roofline(device_kind, dt, flops, bytes_accessed):
+    """Achieved vs peak context; which wall (if any) the kernel is near.
+
+    Numbers come from XLA's cost analysis: 'bytes accessed' counts every
+    buffer touch including VMEM-resident reuse, so the memory ratio can
+    legitimately exceed 1.0 — values near/above 1 mean the kernel is
+    memory-traffic dominated, not that HBM physically moved that much.
+    """
+    peaks = next(
+        (v for prefix, v in _PEAKS.items() if device_kind.startswith(prefix)), None
+    )
+    out = {}
+    if flops:
+        out['xla_cost_tflops'] = round(flops / dt / 1e12, 2)
+    if bytes_accessed:
+        out['xla_cost_bytes_gb_s'] = round(bytes_accessed / dt / 1e9, 1)
+    if peaks and flops is not None and bytes_accessed is not None:
+        mxu = flops / dt / 1e12 / peaks['tflops_bf16']
+        mem = bytes_accessed / dt / 1e9 / peaks['hbm_gb_s']
+        out['mxu_ratio_vs_peak'] = round(mxu, 3)
+        out['mem_ratio_vs_hbm_peak'] = round(mem, 3)  # can exceed 1: see docstring
+        out['bound'] = (
+            'memory-traffic' if mem > max(mxu, 0.5)
+            else 'mxu' if mxu > 0.5
+            else 'neither (gather/VPU/overhead limited)'
+        )
+    return out
 
 
 def bench_impl() -> dict:
@@ -88,13 +138,18 @@ def bench_impl() -> dict:
     batch = synthetic_batch(n_games=n_games, n_actions=1664, seed=1)
     total_actions = int(batch.total_actions)
 
-    dt_fused = _measure(jax.jit(fused_forward), (params, batch))
-    dt_mat = _measure(jax.jit(materialized_forward), (params, batch))
+    fused_jit = jax.jit(fused_forward)
+    mat_jit = jax.jit(materialized_forward)
+    dt_fused = _measure(fused_jit, (params, batch))
+    dt_mat = _measure(mat_jit, (params, batch))
 
     fused_aps = total_actions / dt_fused
     mat_aps = total_actions / dt_mat
+    # The flagship (entry()) is the fused combined-table path; since round 3
+    # it is measured fastest (BENCH_r02's 2.8x regression was the old
+    # gather-per-block form — see benchmarks/fused_experiment.py).
     best = max(fused_aps, mat_aps)
-    return {
+    result = {
         'metric': 'vaep_rate_actions_per_sec',
         'value': round(best, 1),
         'unit': 'actions/sec',
@@ -104,7 +159,124 @@ def bench_impl() -> dict:
         'total_actions': total_actions,
         'fused_actions_per_sec': round(fused_aps, 1),
         'materialized_actions_per_sec': round(mat_aps, 1),
+        'flagship': 'fused',
+        'flagship_is_fastest': bool(fused_aps >= mat_aps),
     }
+
+    flops, bytes_acc = _cost_analysis(fused_jit, (params, batch))
+    roof = _roofline(device_kind, dt_fused, flops, bytes_acc)
+    if roof:
+        result['roofline_fused'] = roof
+
+    try:
+        result['extra_configs'] = _bench_extra_configs()
+    except Exception as e:  # extras must never sink the headline metric
+        result['extra_configs_error'] = f'{type(e).__name__}: {e}'
+    return result
+
+
+def _bench_extra_configs() -> dict:
+    """The remaining BASELINE.json configs, measured on this chip.
+
+    - xT 16x12 dense fit (counts + transition matrix + value iteration)
+    - xT 192x125 matrix-free fit, forced 100 sweeps, at ~3k-game scale
+    - fused distributed-form VAEP MLP train step (features + labels +
+      two-head loss + adam as one XLA computation)
+    """
+    import functools
+
+    import jax
+
+    from __graft_entry__ import _K, _NAMES
+    from socceraction_tpu.core.synthetic import synthetic_batch
+    from socceraction_tpu.ops.features import compute_features
+    from socceraction_tpu.ops.xt import (
+        solve_xt,
+        solve_xt_matrix_free,
+        xt_counts,
+        xt_probabilities,
+    )
+
+    out = {}
+
+    # --- xT at full-open-data scale (~3k games, BASELINE config 4) --------
+    season = synthetic_batch(n_games=3072, n_actions=1664, seed=2)
+    n_actions = int(season.total_actions)
+    xt_args = (
+        season.type_id, season.result_id,
+        season.start_x, season.start_y, season.end_x, season.end_y,
+        season.mask,
+    )
+
+    @jax.jit
+    def fit_16x12(*args):
+        counts = xt_counts(*args, l=16, w=12)
+        probs = xt_probabilities(counts, l=16, w=12)
+        return solve_xt(probs)
+
+    dt = _measure(fit_16x12, xt_args, n_iters=5)
+    _, it = fit_16x12(*xt_args)
+    out['xt_fit_16x12_dense'] = {
+        'games': 3072,
+        'actions': n_actions,
+        'seconds_per_fit': round(dt, 4),
+        'iterations': int(it),
+        'actions_per_sec': round(n_actions / dt, 1),
+    }
+
+    # eps=0 can never be undershot by a positive diff, so the while_loop
+    # runs max_iter=100 sweeps (the BASELINE "100-iter" config) — unless
+    # the f32 surface hits an exact fixed point first, so divide by the
+    # *actual* iteration count the solver reports, not by 100.
+    mf = jax.jit(
+        functools.partial(
+            solve_xt_matrix_free, l=192, w=125, eps=0.0, max_iter=100
+        )
+    )
+    dt_mf = _measure(mf, xt_args, n_iters=3)
+    n_iters_mf = int(mf(*xt_args)[1])
+    out['xt_fit_192x125_matrix_free_100iter'] = {
+        'games': 3072,
+        'actions': n_actions,
+        'grid': '192x125 (24000 cells)',
+        'seconds_per_fit': round(dt_mf, 4),
+        'iterations': n_iters_mf,
+        'sweep_iters_per_sec': round(n_iters_mf / dt_mf, 1),
+    }
+
+    # --- fused VAEP MLP train step (BASELINE config 5's kernel) -----------
+    from socceraction_tpu.parallel import make_mesh, make_train_step, shard_batch
+
+    mesh = make_mesh(n_devices=1)
+    batch = synthetic_batch(n_games=512, n_actions=1664, seed=3)
+    sharded = shard_batch(batch, mesh)
+    init_fn, step_fn, _ = make_train_step(mesh, _NAMES, k=_K, hidden=(128, 128))
+    n_features = int(
+        compute_features.eval_shape(sharded, names=_NAMES, k=_K).shape[-1]
+    )
+    params, opt_state = init_fn(jax.random.PRNGKey(0), n_features)
+
+    # step_fn donates (params, opt_state); time it by stepping in a chain
+    import time as _time
+
+    params, opt_state, loss = step_fn(params, opt_state, sharded)
+    jax.block_until_ready(loss)
+    n_steps = 10
+    t0 = _time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step_fn(params, opt_state, sharded)
+    jax.block_until_ready(loss)
+    dt_step = (_time.perf_counter() - t0) / n_steps
+    total = int(batch.total_actions)
+    out['vaep_mlp_train_step'] = {
+        'games': 512,
+        'actions': total,
+        'features': n_features,
+        'seconds_per_step': round(dt_step, 4),
+        'actions_per_sec': round(total / dt_step, 1),
+        'final_loss_finite': bool(jax.numpy.isfinite(loss)),
+    }
+    return out
 
 
 # --------------------------------------------------------------------------
