@@ -1,0 +1,116 @@
+"""Worker process for the multi-process distributed test.
+
+Launched by ``tests/test_distributed.py`` as N ``jax.distributed``-
+initialized CPU processes with 4 virtual devices each (gloo collectives
+over the coordinator): SURVEY §4's "multi-node without a real cluster"
+tier. On a TPU pod the same library calls run unchanged — the mesh simply
+spans hosts over ICI/DCN instead of processes over localhost.
+
+Each worker:
+
+1. joins the coordination service and builds the global (games, model)
+   mesh over all ``num_processes * 4`` devices,
+2. shards a season of 8 *distinct* synthetic games over the process
+   boundary and runs the psum'd xT fit,
+3. checks the distributed grid against its own unsharded single-device
+   fit (the cross-process collectives must not change the values),
+4. runs two fused distributed VAEP train steps (feature/label kernels +
+   two-head MLP loss + adam) over the global mesh and checks the loss
+   decreases,
+5. prints one ``DIST_OK`` line; the parent test asserts all workers
+   print identical numbers.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    port = int(sys.argv[3])
+
+    import jax
+
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    jax.distributed.initialize(
+        f'127.0.0.1:{port}', num_processes=num_processes, process_id=process_id
+    )
+
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.batch import pack_actions
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.ops.features import compute_features
+    from socceraction_tpu.ops.xt import solve_xt, xt_counts, xt_probabilities
+    from socceraction_tpu.parallel import (
+        make_mesh,
+        make_train_step,
+        shard_batch,
+        sharded_xt_fit,
+    )
+
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    assert n_local == 4, f'worker expected 4 local devices, got {n_local}'
+    assert n_global == 4 * num_processes, (
+        f'expected {4 * num_processes} global devices, got {n_global}'
+    )
+
+    # identical deterministic season in every process (as a real multi-host
+    # pipeline would read identical global inputs from shared storage)
+    frames = [
+        synthetic_actions_frame(
+            game_id=1000 + g, home_team_id=100, away_team_id=200,
+            n_actions=320 + 48 * g, seed=g,
+        )
+        for g in range(8)
+    ]
+    df = pd.concat(frames, ignore_index=True)
+    season, _ = pack_actions(
+        df, home_team_ids={g: 100 for g in df['game_id'].unique()}
+    )
+
+    mesh = make_mesh()
+    assert mesh.shape['games'] * mesh.shape['model'] == n_global
+
+    # --- distributed xT fit across the process boundary -------------------
+    sharded = shard_batch(season, mesh)
+    grid, _, it = sharded_xt_fit(sharded, mesh, l=16, w=12)
+    grid = np.asarray(jax.device_get(grid))
+
+    # unsharded single-device reference inside this same process
+    local = xt_counts(
+        season.type_id, season.result_id,
+        season.start_x, season.start_y, season.end_x, season.end_y,
+        season.mask, l=16, w=12,
+    )
+    ref_grid, _ = solve_xt(xt_probabilities(local, l=16, w=12))
+    np.testing.assert_allclose(grid, np.asarray(ref_grid), atol=1e-6)
+
+    # --- distributed VAEP train step across the process boundary ----------
+    names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'team')
+    init_fn, step_fn, _ = make_train_step(mesh, names, k=3, hidden=(32, 32))
+    n_features = int(
+        compute_features.eval_shape(sharded, names=names, k=3).shape[-1]
+    )
+    params, opt_state = init_fn(jax.random.PRNGKey(0), n_features)
+    params, opt_state, loss1 = step_fn(params, opt_state, sharded)
+    _, _, loss2 = step_fn(params, opt_state, sharded)
+    loss1, loss2 = float(loss1), float(loss2)
+    assert np.isfinite(loss1) and np.isfinite(loss2)
+    assert loss2 < loss1, (loss1, loss2)
+
+    print(
+        f'DIST_OK pid={process_id} nprocs={num_processes} '
+        f'global_devices={n_global} mesh={dict(mesh.shape)} '
+        f'grid_sum={grid.sum():.8f} iters={int(it)} '
+        f'loss1={loss1:.8f} loss2={loss2:.8f}',
+        flush=True,
+    )
+
+
+if __name__ == '__main__':
+    main()

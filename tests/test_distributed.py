@@ -1,0 +1,85 @@
+"""Multi-process distributed tier: 2 ``jax.distributed`` processes × 4 devices.
+
+SURVEY §4's "multi-node without a real cluster" standin: the single-process
+8-device mesh tests (``tests/test_parallel.py``) exercise the ICI-analog
+collectives; this tier additionally crosses a real *process* boundary —
+separate runtimes joined through the JAX coordination service with gloo
+CPU collectives, the faithful localhost analog of a multi-host TPU pod
+over DCN (``docs/design.md`` "Distributed backend"). The library code
+under test (``make_mesh``/``shard_batch``/``sharded_xt_fit``/
+``make_train_step``) is byte-identical to what a pod would run; only the
+backend ('cpu' + gloo vs 'tpu' + ICI/DCN) differs.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from socceraction_tpu.utils.env import cpu_device_env
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'distributed_worker.py')
+_N_PROCESSES = 2
+_TIMEOUT_S = 300
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    env = cpu_device_env(4)
+    env['PYTHONPATH'] = _REPO_ROOT + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else ''
+    )
+    return env
+
+
+@pytest.mark.slow
+def test_two_process_distributed_fit_and_train():
+    # bounded by communicate(timeout=_TIMEOUT_S) below, not pytest-timeout
+    # (not installed in this image)
+    port = _free_port()
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(_N_PROCESSES), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(_N_PROCESSES)
+    ]
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=_TIMEOUT_S)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    for pid, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, (
+            f'worker {pid} failed (rc={p.returncode}):\n{out[-4000:]}'
+        )
+        assert f'DIST_OK pid={pid}' in out, f'worker {pid} output:\n{out[-4000:]}'
+
+    # all workers must agree on every replicated result bit-for-bit as
+    # printed (global devices, mesh, xT grid, iteration count, losses)
+    payloads = []
+    for out in outputs:
+        (line,) = [l for l in out.splitlines() if l.startswith('DIST_OK')]
+        payloads.append(re.sub(r'pid=\d+', 'pid=*', line))
+    assert payloads[0] == payloads[1], f'workers disagree:\n{payloads}'
+    assert f'global_devices={4 * _N_PROCESSES}' in payloads[0]

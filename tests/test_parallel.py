@@ -2,14 +2,21 @@
 
 Stands in for multi-chip TPU (SURVEY §4): the same pjit/shard_map code
 paths run over ``--xla_force_host_platform_device_count=8``.
+
+Every sharded computation here is checked against the *unsharded* run of
+the same kernel on the concatenated season of 8 **distinct** synthetic
+games (different lengths, contents and possession patterns per shard) —
+symmetric inputs such as one game tiled 8× could hide shard-mixing bugs
+(wrong axis, off-by-one in shard_map) whose errors cancel out.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
+import pandas as pd
 import pytest
 
 from socceraction_tpu.core.batch import pack_actions, unpack_values
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
 from socceraction_tpu.ops.xt import solve_xt, xt_counts, xt_probabilities
 from socceraction_tpu.parallel import (
     make_mesh,
@@ -23,18 +30,44 @@ from socceraction_tpu.parallel import (
 )
 from socceraction_tpu.vaep.base import VAEP
 
+_HOME, _AWAY = 100, 200
+_N_GAMES = 8
+
+
+def _season_frame(n_games=_N_GAMES):
+    """Concatenated SPADL frame of ``n_games`` distinct synthetic games."""
+    frames = [
+        synthetic_actions_frame(
+            game_id=1000 + g,
+            home_team_id=_HOME,
+            away_team_id=_AWAY,
+            # distinct lengths -> asymmetric padding masks across shards
+            n_actions=320 + 48 * g,
+            seed=g,
+        )
+        for g in range(n_games)
+    ]
+    return pd.concat(frames, ignore_index=True)
+
+
+@pytest.fixture(scope='module')
+def season_df():
+    return _season_frame()
+
+
+@pytest.fixture(scope='module')
+def season(season_df):
+    """The 8 distinct games packed into one (8, A) batch."""
+    batch, _ = pack_actions(
+        season_df, home_team_ids={g: _HOME for g in season_df['game_id'].unique()}
+    )
+    return batch
+
 
 @pytest.fixture(scope='module')
 def batch(spadl_actions, home_team_id):
     b, _ = pack_actions(spadl_actions, home_team_id=home_team_id)
     return b
-
-
-def _multi_game(batch, n):
-    """Tile one game into an n-game batch (distinct but equal games)."""
-    return jax.tree.map(
-        lambda x: jnp.concatenate([x] * n, axis=0), batch
-    )
 
 
 def test_mesh_shapes():
@@ -45,6 +78,15 @@ def test_mesh_shapes():
     assert mesh2.shape == {'games': 4, 'model': 2}
 
 
+def test_season_games_are_distinct(season):
+    # guard: the fixture must NOT degrade into tiled copies of one game
+    lengths = np.asarray(season.n_actions)
+    assert len(set(lengths.tolist())) == _N_GAMES
+    t0 = np.asarray(season.type_id[0, :320])
+    t1 = np.asarray(season.type_id[1, :320])
+    assert (t0 != t1).any()
+
+
 def test_pad_games_is_inert(batch):
     padded = pad_games(batch, 8)
     assert padded.n_games == 8
@@ -52,32 +94,29 @@ def test_pad_games_is_inert(batch):
     assert padded.total_actions == batch.total_actions
 
 
-def test_sharded_xt_counts_match_single_device(batch):
+def test_sharded_xt_counts_match_single_device(season):
     mesh = make_mesh()
-    many = _multi_game(batch, 8)
-    sharded = shard_batch(many, mesh)
+    sharded = shard_batch(season, mesh)
     counts = sharded_xt_counts(sharded, mesh, l=16, w=12)
 
     local = xt_counts(
-        batch.type_id, batch.result_id,
-        batch.start_x, batch.start_y, batch.end_x, batch.end_y,
-        batch.mask, l=16, w=12,
+        season.type_id, season.result_id,
+        season.start_x, season.start_y, season.end_x, season.end_y,
+        season.mask, l=16, w=12,
     )
-    np.testing.assert_allclose(np.asarray(counts.shots), 8 * np.asarray(local.shots))
-    np.testing.assert_allclose(np.asarray(counts.trans), 8 * np.asarray(local.trans))
+    np.testing.assert_allclose(np.asarray(counts.shots), np.asarray(local.shots))
+    np.testing.assert_allclose(np.asarray(counts.trans), np.asarray(local.trans))
 
 
-def test_sharded_xt_fit_matches_replicated_probabilities(batch):
+def test_sharded_xt_fit_matches_unsharded(season):
     mesh = make_mesh()
-    many = _multi_game(batch, 8)
-    sharded = shard_batch(many, mesh)
+    sharded = shard_batch(season, mesh)
     grid, probs, it = sharded_xt_fit(sharded, mesh, l=16, w=12)
 
-    # counts scaled by 8 -> identical probabilities -> identical grid
     local = xt_counts(
-        batch.type_id, batch.result_id,
-        batch.start_x, batch.start_y, batch.end_x, batch.end_y,
-        batch.mask, l=16, w=12,
+        season.type_id, season.result_id,
+        season.start_x, season.start_y, season.end_x, season.end_y,
+        season.mask, l=16, w=12,
     )
     probs1 = xt_probabilities(local, l=16, w=12)
     grid1, _ = solve_xt(probs1)
@@ -86,9 +125,9 @@ def test_sharded_xt_fit_matches_replicated_probabilities(batch):
 
 
 @pytest.mark.parametrize('model_parallel', [1, 2])
-def test_distributed_train_step_runs(batch, model_parallel):
+def test_distributed_train_step_runs(season, model_parallel):
     mesh = make_mesh(model_parallel=model_parallel)
-    many = shard_batch(_multi_game(batch, mesh.shape['games']), mesh)
+    many = shard_batch(season, mesh)
     names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'team')
     init_fn, step_fn, place = make_train_step(mesh, names, k=3, hidden=(32, 32))
     from socceraction_tpu.ops.features import compute_features
@@ -100,19 +139,10 @@ def test_distributed_train_step_runs(batch, model_parallel):
     assert float(loss2) < float(loss1)
 
 
-def test_train_distributed_and_sharded_rate(batch, spadl_actions, home_team_id):
+def test_train_distributed_and_sharded_rate(season, season_df):
     mesh = make_mesh()
-    import pandas as pd
-
-    frames = []
-    for g in range(8):
-        f = spadl_actions.copy()
-        f['game_id'] = 1000 + g
-        frames.append(f)
-    many_df = pd.concat(frames, ignore_index=True)
-    many, _ = pack_actions(many_df, home_team_id=home_team_id)
     names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'team')
-    models = train_distributed(many, mesh, names, k=3, hidden=(16,), epochs=3)
+    models = train_distributed(season, mesh, names, k=3, hidden=(16,), epochs=3)
 
     model = VAEP(backend='jax', nb_prev_actions=3)
     model.xfns = [
@@ -120,34 +150,34 @@ def test_train_distributed_and_sharded_rate(batch, spadl_actions, home_team_id):
         for n in names
     ]
     model._models = models
-    values, sharded = sharded_rate(model, many, mesh)
-    assert values.shape == (8, batch.max_actions, 3)
+    values, sharded = sharded_rate(model, season, mesh)
+    assert values.shape == (_N_GAMES, season.max_actions, 3)
 
     flat = unpack_values(values, sharded)
-    assert flat.shape[0] == 8 * len(spadl_actions)
+    assert flat.shape[0] == len(season_df)
     assert np.isfinite(flat).all()
 
-    # vs. unsharded rate of one game
-    single = model.rate_batch(batch)
+    # the sharded rating of the asymmetric season must equal the unsharded
+    # rating of the same batch, row for row
+    unsharded = model.rate_batch(season)
     np.testing.assert_allclose(
-        flat[: len(spadl_actions)],
-        unpack_values(single, batch),
+        flat,
+        unpack_values(unsharded, season),
         rtol=1e-4, atol=1e-5,
     )
 
 
-def test_sharded_matrix_free_fit_matches_single_device(batch):
+def test_sharded_matrix_free_fit_matches_unsharded(season):
     from socceraction_tpu.ops.xt import solve_xt_matrix_free
     from socceraction_tpu.parallel import sharded_xt_fit_matrix_free
 
     mesh = make_mesh()
-    many = _multi_game(batch, 8)
-    sharded = shard_batch(many, mesh)
+    sharded = shard_batch(season, mesh)
     grid, it = sharded_xt_fit_matrix_free(sharded, mesh, l=24, w=16)
 
     ref_grid, ref_it, _, _, _ = solve_xt_matrix_free(
-        many.type_id, many.result_id, many.start_x, many.start_y,
-        many.end_x, many.end_y, many.mask, l=24, w=16,
+        season.type_id, season.result_id, season.start_x, season.start_y,
+        season.end_x, season.end_y, season.mask, l=24, w=16,
     )
     assert int(it) == int(ref_it)
     np.testing.assert_allclose(np.asarray(grid), np.asarray(ref_grid), atol=1e-6)
