@@ -103,11 +103,25 @@ def synthetic_actions_frame(
 ):
     """A schema-valid synthetic SPADL DataFrame for one game.
 
-    Statistically plausible: possession alternates in runs, passes
-    dominate, ~25 shots/game with ~10% conversion so label/formula paths
-    see real goals. Used by the synthetic stand-in store
+    Statistically plausible AND **learnable**: the generator plants the
+    same feature→label structure real soccer has, so models trained on
+    these games must beat chance on held-out games (the air-gapped stand-in
+    for the reference's real-data quality tier — see QUALITY.md):
+
+    - possession alternates in runs; the home team attacks left→right,
+      the away team right→left;
+    - **shot hazard rises with proximity to the attacking goal**
+      (``p_shot ∝ exp(-dist/11 m)``), so shots cluster in the box;
+    - **shot conversion falls with distance** (``P(goal|shot) ∝
+      exp(-dist/9 m)``), so P(score in next 10 actions) is genuinely
+      predictable from location/type features;
+    - pass/dribble success falls with attempted distance, giving the
+      result features real signal too.
+
+    Used by the synthetic stand-in store
     (``tests/datasets/make_synthetic_store.py``) that lets the @e2e tier
-    execute without network egress.
+    execute without network egress, and by
+    ``tests/test_quality_synthetic.py`` (held-out AUC floor).
     """
     import pandas as pd
 
@@ -123,20 +137,6 @@ def synthetic_actions_frame(
         team_id[pos : pos + run] = team
         team = away_team_id if team == home_team_id else home_team_id
         pos += run
-
-    n_types = len(spadlconfig.actiontypes)
-    probs = np.full(n_types, 0.012)
-    probs[spadlconfig.PASS] = 0.50
-    probs[spadlconfig.DRIBBLE] = 0.22
-    probs[spadlconfig.SHOT] = 0.015
-    probs /= probs.sum()
-    type_id = rng.choice(n_types, size=n, p=probs)
-
-    result_id = np.where(rng.random(n) < 0.75, spadlconfig.SUCCESS, spadlconfig.FAIL)
-    shots = type_id == spadlconfig.SHOT
-    result_id[shots] = np.where(
-        rng.random(shots.sum()) < 0.10, spadlconfig.SUCCESS, spadlconfig.FAIL
-    )
 
     half = n // 2
     period_id = np.where(np.arange(n) < half, 1, 2)
@@ -155,6 +155,41 @@ def synthetic_actions_frame(
     start_y = np.where(start_y > W, 2 * W - start_y, start_y)
     end_x = np.clip(start_x + rng.normal(4, 10, size=n), 0, L)
     end_y = np.clip(start_y + rng.normal(0, 7, size=n), 0, W)
+
+    # distance from the action's start to the goal its team attacks
+    attacks_right = team_id == home_team_id
+    goal_x = np.where(attacks_right, L, 0.0)
+    dist_goal = np.hypot(start_x - goal_x, start_y - W / 2)
+
+    # action types: shot hazard decays with distance to the attacked goal
+    # (~20-30 shots/game, overwhelmingly inside ~25 m); the rest of the
+    # vocabulary keeps the pass/dribble-dominated mix
+    n_types = len(spadlconfig.actiontypes)
+    probs = np.full(n_types, 0.012)
+    probs[spadlconfig.PASS] = 0.50
+    probs[spadlconfig.DRIBBLE] = 0.22
+    probs[spadlconfig.SHOT] = 0.0
+    probs /= probs.sum()
+    type_id = rng.choice(n_types, size=n, p=probs)
+    p_shot = 0.32 * np.exp(-dist_goal / 11.0)
+    type_id = np.where(rng.random(n) < p_shot, spadlconfig.SHOT, type_id)
+
+    # results: shots convert by proximity; moves succeed by attempted
+    # length (long balls fail more often). ALL shot-like types (open play,
+    # penalty, freekick) get the distance rule — a "successful"
+    # shot_penalty IS a goal to the label kernels, so giving set-piece
+    # shots the generic ~90% move-success rate would scatter dozens of
+    # position-independent goals per game and bury the planted signal.
+    move_len = np.hypot(end_x - start_x, end_y - start_y)
+    p_success = np.clip(0.92 - 0.012 * move_len, 0.3, 0.95)
+    result_id = np.where(
+        rng.random(n) < p_success, spadlconfig.SUCCESS, spadlconfig.FAIL
+    )
+    shot_like = spadlconfig.shot_like_mask[type_id]
+    p_goal = np.clip(0.45 * np.exp(-dist_goal[shot_like] / 9.0), 0.02, 0.6)
+    result_id[shot_like] = np.where(
+        rng.random(shot_like.sum()) < p_goal, spadlconfig.SUCCESS, spadlconfig.FAIL
+    )
 
     players = {
         home_team_id: np.arange(1, 12) + home_team_id * 1000,

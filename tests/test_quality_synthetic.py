@@ -1,0 +1,97 @@
+"""Air-gapped quality tier: held-out AUC floor on learnable synthetic games.
+
+The reference's quality numbers (P(scores) AUC 0.85998, P(concedes)
+0.88888 — BASELINE.md) are measured on the real WC2018 data, which this
+environment cannot download (no network egress; see QUALITY.md). This
+tier is the strongest quality assertion that can *execute* here: the
+synthetic generator plants real feature→label structure (shot hazard and
+conversion decay with distance to goal —
+:func:`socceraction_tpu.core.synthetic.synthetic_actions_frame`), so a
+trained P(scores)/P(concedes) head must beat chance on *held-out* games.
+A shuffled-label control pins the floor: the same pipeline on destroyed
+labels must sit at chance, proving the AUC comes from learned structure,
+not leakage.
+
+Unlike ``tests/test_e2e_worldcup.py`` (which needs a store on disk), this
+runs unconditionally in the default suite.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.vaep import VAEP
+
+pytestmark = pytest.mark.slow
+
+_HOME, _AWAY = 100, 200
+_N_TRAIN, _N_TEST = 24, 8
+# batch 2048 -> ~9 steps/epoch on 18k train rows; the default 8192 gives
+# the adam loop too few steps to converge on a season this small.
+# Measured held-out AUC with these settings: scores 0.734, concedes 0.714
+# (QUALITY.md).
+_MLP_PARAMS = dict(batch_size=2048, max_epochs=100, patience=10)
+
+
+@pytest.fixture(scope='module')
+def season():
+    """(games_df, {game_id: actions}) for 32 distinct synthetic games."""
+    games, actions = [], {}
+    for i in range(_N_TRAIN + _N_TEST):
+        gid = 7000 + i
+        games.append({'game_id': gid, 'home_team_id': _HOME, 'away_team_id': _AWAY})
+        actions[gid] = synthetic_actions_frame(
+            gid, home_team_id=_HOME, away_team_id=_AWAY, n_actions=1000, seed=i
+        )
+    return pd.DataFrame(games), actions
+
+
+@pytest.fixture(scope='module')
+def fitted(season):
+    games, actions = season
+    model = VAEP(nb_prev_actions=3, backend='jax')
+
+    def stack(fn, subset):
+        return pd.concat(
+            [fn(g, actions[g.game_id]) for g in subset.itertuples()],
+            ignore_index=True,
+        )
+
+    train = games.iloc[:_N_TRAIN]
+    test = games.iloc[_N_TRAIN:]
+    X_tr = stack(model.compute_features, train)
+    y_tr = stack(model.compute_labels, train)
+    model.fit(X_tr, y_tr, learner='mlp', tree_params=_MLP_PARAMS)
+    X_te = stack(model.compute_features, test)
+    y_te = stack(model.compute_labels, test)
+    return model, X_tr, y_tr, X_te, y_te
+
+
+def test_heldout_auc_beats_chance(fitted):
+    """Both probability heads clear AUC 0.6 on 8 held-out games."""
+    model, _, _, X_te, y_te = fitted
+    metrics = model.score(X_te, y_te)
+    assert metrics['scores']['auroc'] > 0.6, metrics
+    assert metrics['concedes']['auroc'] > 0.6, metrics
+    # calibration sanity: rare-event Brier should be small
+    assert metrics['scores']['brier'] < 0.10, metrics
+    assert metrics['concedes']['brier'] < 0.10, metrics
+
+
+def test_shuffled_label_control_sits_at_chance(fitted, season):
+    """Destroying the labels kills the AUC — the signal is real structure.
+
+    Guards against metric leakage (e.g. a feature that encodes the label):
+    a model trained on permuted labels must NOT beat chance on the intact
+    held-out labels by more than noise.
+    """
+    model, X_tr, y_tr, X_te, y_te = fitted
+    rng = np.random.default_rng(0)
+    y_shuf = y_tr.apply(lambda c: rng.permutation(c.to_numpy())).astype(bool)
+    control = VAEP(nb_prev_actions=3, backend='jax')
+    control.xfns = model.xfns
+    control.fit(X_tr, y_shuf, learner='mlp', tree_params=_MLP_PARAMS)
+    metrics = control.score(X_te, y_te)
+    assert metrics['scores']['auroc'] < 0.58, metrics
+    assert metrics['concedes']['auroc'] < 0.58, metrics
