@@ -17,7 +17,6 @@ import sys
 # allow running from a source checkout without installation
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
-import numpy as np
 import pandas as pd
 
 _FIXTURE = os.path.join(

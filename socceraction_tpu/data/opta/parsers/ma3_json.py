@@ -8,7 +8,7 @@ MA3 feeds carry one game's event stream; lineups are encoded as
 from __future__ import annotations
 
 from datetime import datetime
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 import pandas as pd
 

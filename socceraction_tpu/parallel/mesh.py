@@ -15,7 +15,7 @@ frequent collectives (gradient psum) ride ICI.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

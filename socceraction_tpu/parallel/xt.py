@@ -14,7 +14,6 @@ import functools
 from typing import Tuple
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.batch import ActionBatch

@@ -9,7 +9,7 @@ qualifier-set membership tests precomputed once as boolean arrays.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Dict, List
 
 import numpy as np
 import pandas as pd

@@ -13,7 +13,6 @@ import numpy as np
 import pandas as pd
 
 from ..config import CORNER_PRIOR, PENALTY_PRIOR, SAMEPHASE_SECONDS
-from ..spadl import config as spadlconfig
 
 _samephase_nb: float = SAMEPHASE_SECONDS
 

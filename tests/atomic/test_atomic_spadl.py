@@ -10,7 +10,6 @@ actions beyond the 200-action cut).
 
 import numpy as np
 import pandas as pd
-import pytest
 
 from socceraction_tpu.atomic import spadl as atomicspadl
 

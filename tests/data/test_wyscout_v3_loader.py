@@ -2,8 +2,6 @@
 
 import json
 
-import pandas as pd
-import pytest
 
 from socceraction_tpu.data.wyscout import flatten_v3_events, load_v3_events
 from socceraction_tpu.spadl import wyscout_v3

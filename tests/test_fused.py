@@ -6,7 +6,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from socceraction_tpu.core.batch import pack_actions
 from socceraction_tpu.core.synthetic import synthetic_batch
 from socceraction_tpu.ml.mlp import MLPClassifier, _MLP
 from socceraction_tpu.ops.features import compute_features

@@ -1,0 +1,191 @@
+"""Dependency-free lint gate (AST-based).
+
+This image ships no third-party linter (no ruff/flake8/pyflakes/mypy and
+no package installs allowed), so the repo carries its own minimal one.
+It enforces a small set of high-signal rules; when mypy/ruff ARE
+available (declared in ``pyproject.toml`` dev extras for environments
+with egress), ``make check`` runs them on top of this gate.
+
+Rules:
+
+- **unused-import** — a name imported at module level and never
+  referenced (``__init__.py`` re-exports are exempt when listed in
+  ``__all__`` or imported with ``from x import y as y``).
+- **bare-except** — ``except:`` without an exception class.
+- **mutable-default** — ``def f(x=[])`` / ``{}`` / ``set()`` defaults.
+- **tab-indent / trailing-whitespace** — whitespace hygiene.
+- **syntax-error** — the file must parse.
+
+Usage: ``python tools/lint.py [paths...]`` (defaults to the package,
+tests, tools, benchmarks, examples and the repo-root scripts). Exits
+non-zero on findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+DEFAULT_TARGETS = [
+    'socceraction_tpu',
+    'tests',
+    'tools',
+    'benchmarks',
+    'examples',
+    'bench.py',
+    '__graft_entry__.py',
+]
+
+
+def iter_py_files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith('.py'):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if not d.startswith(('.', '__pycache__'))]
+                for f in sorted(files):
+                    if f.endswith('.py'):
+                        yield os.path.join(root, f)
+
+
+class _ImportCollector(ast.NodeVisitor):
+    """Collect module-level imported names and every referenced name."""
+
+    def __init__(self) -> None:
+        self.imports: List[Tuple[str, int, str]] = []  # (name, lineno, shown)
+        self.explicit_reexports: set = set()  # `from x import y as y`
+        self.used: set = set()
+        self.string_annotations: List[str] = []
+        self._depth = 0
+
+    def visit_Import(self, node: ast.Import) -> None:
+        if self._depth == 0:
+            for a in node.names:
+                name = (a.asname or a.name).split('.')[0]
+                self.imports.append((name, node.lineno, a.name))
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if self._depth == 0 and node.module != '__future__':
+            for a in node.names:
+                if a.name == '*':
+                    continue
+                name = a.asname or a.name
+                self.imports.append((name, node.lineno, a.name))
+                if a.asname is not None and a.asname == a.name:
+                    self.explicit_reexports.add(name)
+        self.generic_visit(node)
+
+    def _enter(self, node) -> None:
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _enter
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # record the root name of dotted access (np.foo -> np)
+        n = node
+        while isinstance(n, ast.Attribute):
+            n = n.value
+        if isinstance(n, ast.Name):
+            self.used.add(n.id)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        # string annotations / forward refs may reference imports
+        if isinstance(node.value, str):
+            self.string_annotations.append(node.value)
+        self.generic_visit(node)
+
+
+def _module_all(tree: ast.Module) -> set:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == '__all__':
+                    try:
+                        return set(ast.literal_eval(node.value))
+                    except (ValueError, SyntaxError):
+                        return set()
+    return set()
+
+
+def check_file(path: str) -> List[str]:
+    problems: List[str] = []
+    with open(path, encoding='utf-8') as f:
+        src = f.read()
+
+    for i, line in enumerate(src.splitlines(), 1):
+        stripped = line.rstrip('\n')
+        if stripped != stripped.rstrip():
+            problems.append(f'{path}:{i}: trailing whitespace')
+        if stripped.startswith('\t') or stripped.lstrip(' ').startswith('\t'):
+            problems.append(f'{path}:{i}: tab indentation')
+
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return problems + [f'{path}:{e.lineno}: syntax error: {e.msg}']
+
+    # unused imports
+    col = _ImportCollector()
+    col.visit(tree)
+    exported = _module_all(tree)
+    is_init = os.path.basename(path) == '__init__.py'
+    annotation_blob = '\n'.join(col.string_annotations)
+    for name, lineno, shown in col.imports:
+        if name in col.used or name in exported or name in col.explicit_reexports:
+            continue
+        if name.startswith('_'):
+            continue  # conventional "imported for side effect/alias" marker
+        if is_init and not exported:
+            continue  # __init__ without __all__: imports ARE the API
+        if name in annotation_blob:
+            continue  # referenced from a string annotation / docstring doctest
+        problems.append(f'{path}:{lineno}: unused import {shown!r}')
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f'{path}:{node.lineno}: bare except')
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ('list', 'dict', 'set')
+                    and not d.args
+                    and not d.keywords
+                ):
+                    problems.append(
+                        f'{path}:{node.lineno}: mutable default argument '
+                        f'in {node.name}()'
+                    )
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    targets = argv or DEFAULT_TARGETS
+    n_files = 0
+    problems: List[str] = []
+    for path in iter_py_files(targets):
+        n_files += 1
+        problems.extend(check_file(path))
+    for p in problems:
+        print(p)
+    print(f'lint: {n_files} files, {len(problems)} problem(s)')
+    return 1 if problems else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
