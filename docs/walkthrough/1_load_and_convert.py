@@ -1,0 +1,111 @@
+"""Walkthrough 1/4 — load raw events, convert to SPADL, build a season store.
+
+Mirrors the reference's ``public-notebooks/1-load-and-convert-statsbomb-
+data.ipynb``: provider loader → SPADL converter → per-game store. Runs
+against the checked-in one-game StatsBomb fixture plus a synthetic
+16-game season so it works with zero network egress; pass ``--data`` to
+use a real StatsBomb open-data clone instead.
+
+    python docs/walkthrough/1_load_and_convert.py [--data DIR] [--store PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir, os.pardir))
+
+_FIXTURE = os.path.join(
+    os.path.dirname(__file__), os.pardir, os.pardir,
+    'tests', 'datasets', 'statsbomb', 'raw',
+)
+DEFAULT_STORE = '/tmp/socceraction_tpu_walkthrough.h5'
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--data', default=_FIXTURE, help='StatsBomb open-data root')
+    ap.add_argument('--store', default=DEFAULT_STORE)
+    args = ap.parse_args()
+
+    import pandas as pd
+
+    from socceraction_tpu.atomic.spadl import convert_to_atomic
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.data.statsbomb import StatsBombLoader
+    from socceraction_tpu.pipeline import SeasonStore
+    from socceraction_tpu.spadl import config as spadlcfg
+    from socceraction_tpu.spadl.statsbomb import convert_to_actions
+
+    # ------------------------------------------------------------------
+    # 1. the loader: 5 pandera-validated frames per provider
+    #    (reference notebook 1, cells 2-6)
+    # ------------------------------------------------------------------
+    loader = StatsBombLoader(getter='local', root=args.data)
+    competitions = loader.competitions()
+    print(f'competitions: {len(competitions)}')
+    comp = competitions.iloc[0]
+    games = loader.games(comp.competition_id, comp.season_id)
+    print(f'games in {comp.competition_name}/{comp.season_name}: {len(games)}')
+
+    game = games.iloc[0]
+    teams = loader.teams(game.game_id)
+    players = loader.players(game.game_id)
+    events = loader.events(game.game_id)
+    print(
+        f'game {game.game_id}: {len(events)} raw events, '
+        f'{len(teams)} teams, {len(players)} players'
+    )
+
+    # ------------------------------------------------------------------
+    # 2. SPADL conversion: ragged provider events -> rectangular actions
+    #    (reference notebook 1, cell 8; converter is columnar here)
+    # ------------------------------------------------------------------
+    actions = convert_to_actions(events, game.home_team_id)
+    print(f'SPADL actions: {len(actions)} rows x {len(actions.columns)} cols')
+    named = actions.merge(spadlcfg.actiontypes_df(), how='left')
+    print('top action types:')
+    print(named.type_name.value_counts().head(5).to_string())
+
+    atomic = convert_to_atomic(actions)
+    print(f'Atomic-SPADL: {len(atomic)} rows (~2x: receivals, goals, ... inserted)')
+
+    # ------------------------------------------------------------------
+    # 3. the season store: per-game actions + metadata under one path
+    #    (reference notebook 1 last cells; HDF5 or parquet engine)
+    # ------------------------------------------------------------------
+    with SeasonStore(args.store, mode='w') as store:
+        store.put('actiontypes', spadlcfg.actiontypes_df())
+        store.put('results', spadlcfg.results_df())
+        store.put('bodyparts', spadlcfg.bodyparts_df())
+        store.put_actions(game.game_id, actions)
+
+        # pad the season with synthetic games so the downstream
+        # walkthrough steps have a full season without network egress
+        rows = [
+            {
+                'game_id': game.game_id,
+                'home_team_id': game.home_team_id,
+                'away_team_id': game.away_team_id,
+            }
+        ]
+        for i in range(16):
+            gid = 9000 + i
+            home, away = 100 + 2 * i, 101 + 2 * i
+            store.put_actions(
+                gid,
+                synthetic_actions_frame(
+                    gid, home_team_id=home, away_team_id=away, seed=i
+                ),
+            )
+            rows.append({'game_id': gid, 'home_team_id': home, 'away_team_id': away})
+        store.put('games', pd.DataFrame(rows))
+        n = len(store.game_ids())
+    print(f'stored {n} games at {args.store}')
+    print('next: python docs/walkthrough/2_features_and_labels.py')
+
+
+if __name__ == '__main__':
+    main()

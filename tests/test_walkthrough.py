@@ -1,0 +1,48 @@
+"""The walkthrough scripts must stay runnable, in order, without egress.
+
+They are the repo's narrative documentation (docs/walkthrough/README.md,
+mirroring the reference's public notebooks 1-4); a doc a new user cannot
+execute is worse than none, so the suite runs the whole sequence.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WT = os.path.join(_ROOT, 'docs', 'walkthrough')
+
+_SCRIPTS = [
+    '1_load_and_convert.py',
+    '2_features_and_labels.py',
+    '3_train_probability_models.py',
+    '4_rate_and_rank_players.py',
+]
+
+
+def test_walkthrough_sequence(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp('walkthrough')
+    store = str(tmp / 'store.h5')
+    ckpt = str(tmp / 'vaep_ckpt')
+    extra = {
+        '1_load_and_convert.py': ['--store', store],
+        '2_features_and_labels.py': ['--store', store],
+        '3_train_probability_models.py': ['--store', store, '--checkpoint', ckpt],
+        '4_rate_and_rank_players.py': ['--store', store, '--checkpoint', ckpt],
+    }
+    for script in _SCRIPTS:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_WT, script)] + extra[script],
+            capture_output=True,
+            text=True,
+            timeout=560,
+            cwd=_ROOT,
+        )
+        assert proc.returncode == 0, (
+            f'{script} failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}'
+        )
+    assert 'walkthrough complete' in proc.stdout
