@@ -1,0 +1,206 @@
+"""On-chip experiment: can bf16 intermediates beat the f32 rating path?
+
+The round-3 roofline (bench.py) shows the fused rating forward is
+memory-traffic dominated (XLA bytes-accessed ~1.9x HBM peak equivalent,
+MXU at 2%): the big tensors are the (G, A, 128) first-layer activations
+and the two hidden-layer activations per head, all f32. Casting the
+hidden pipeline to bf16 halves those bytes; the gathers/bias stay f32
+(exactness) and only the post-h activations drop precision.
+
+Variants:
+
+- ``f32``            — the shipped combined-table path, imported straight
+                       from ``__graft_entry__.entry()`` (ops/fused.py), so
+                       the control can never drift from the library
+- ``bf16_hidden``    — h computed f32, hidden matmuls + activations bf16,
+                       logits back to f32 before sigmoid (hand-rolled: the
+                       library has no hidden_dtype knob yet)
+- ``stacked_heads``  — both heads' tables/dense/bias stacked to width 2H:
+                       one gather per state for BOTH heads (halves gather
+                       count; same bytes), hidden layers per-head slices
+
+Also reports max |Δvaep| vs the f32 control, since bf16 is only
+shippable behind an opt-in flag if the error story is understood.
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/precision_experiment.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _K, _NAMES, entry
+from socceraction_tpu.core.synthetic import synthetic_batch
+from socceraction_tpu.ops.features import KERNELS, _States
+from socceraction_tpu.ops.formula import vaep_values
+from socceraction_tpu.spadl import config as spadlconfig
+
+_T = len(spadlconfig.actiontypes)
+_R = len(spadlconfig.results)
+_B = len(spadlconfig.bodyparts)
+_N_COMBO = _T * _R * _B
+
+_ONEHOT = {
+    'actiontype_onehot': _T,
+    'result_onehot': _R,
+    'actiontype_result_onehot': _T * _R,
+    'bodypart_onehot': _B,
+}
+
+
+def _layout(names, s, Wk_rows):
+    """(onehot entries, dense blocks, dense spans) for the default layout."""
+    onehot, dense_blocks, dense_spans = [], [], []
+    off = 0
+    for name in names:
+        if name in _ONEHOT:
+            onehot.append((name, _ONEHOT[name], off))
+            off += _ONEHOT[name] * _K
+        else:
+            block = KERNELS[name](s)
+            dense_blocks.append(block)
+            dense_spans.append((off, block.shape[-1]))
+            off += block.shape[-1]
+    assert off == Wk_rows
+    return onehot, dense_blocks, dense_spans
+
+
+def _combined_tables(Wk, onehot, k):
+    """Per-state (552, H) combined tables."""
+    c = jnp.arange(_N_COMBO)
+    rows_of = {
+        'actiontype_onehot': c // (_R * _B),
+        'result_onehot': (c // _B) % _R,
+        'actiontype_result_onehot': c // _B,
+        'bodypart_onehot': c % _B,
+    }
+    tables = []
+    for i in range(k):
+        t = jnp.zeros((_N_COMBO, Wk.shape[1]), jnp.float32)
+        for name, per, off in onehot:
+            rows = jax.lax.slice_in_dim(Wk, off + i * per, off + (i + 1) * per, axis=0)
+            t = t + rows[rows_of[name]]
+        tables.append(t)
+    return tables
+
+
+def _combo_ids(s, i):
+    return (s.type_id[i] * _R + s.result_id[i]) * _B + s.bodypart_id[i]
+
+
+def head_logits(params, batch, s, *, hidden_dtype=None):
+    """Combined-table head with optional bf16 hidden pipeline."""
+    leaves = params['params']
+    Wk = jnp.asarray(leaves['Dense_0']['kernel'])
+    bias = jnp.asarray(leaves['Dense_0']['bias'])
+    onehot, dense_blocks, dense_spans = _layout(_NAMES, s, Wk.shape[0])
+    tables = _combined_tables(Wk, onehot, _K)
+
+    h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), jnp.float32) + bias
+    for i in range(_K):
+        h = h + tables[i][_combo_ids(s, i)]
+    x_dense = jnp.concatenate(dense_blocks, axis=-1)
+    W_dense = jnp.concatenate(
+        [jax.lax.slice_in_dim(Wk, o, o + w, axis=0) for o, w in dense_spans], axis=0
+    )
+    h = h + x_dense @ W_dense
+
+    x = jax.nn.relu(h)
+    if hidden_dtype is not None:
+        x = x.astype(hidden_dtype)
+    for li in range(1, 3):
+        d = leaves[f'Dense_{li}']
+        k_, b_ = jnp.asarray(d['kernel']), jnp.asarray(d['bias'])
+        if li < 2:  # hidden layer
+            if hidden_dtype is not None:
+                k_, b_ = k_.astype(hidden_dtype), b_.astype(hidden_dtype)
+            x = jax.nn.relu(x @ k_ + b_)
+        else:  # logit head: accumulate back in f32
+            x = x.astype(jnp.float32) @ k_ + b_
+    return x[..., 0]
+
+
+def stacked_heads_values(params, batch):
+    """One gather per state for BOTH heads (tables stacked to width 2H)."""
+    s = _States(batch, _K)
+    la, lb = params['scores']['params'], params['concedes']['params']
+    Wk = jnp.concatenate(
+        [jnp.asarray(la['Dense_0']['kernel']), jnp.asarray(lb['Dense_0']['kernel'])],
+        axis=1,
+    )  # (F, 2H)
+    bias = jnp.concatenate(
+        [jnp.asarray(la['Dense_0']['bias']), jnp.asarray(lb['Dense_0']['bias'])]
+    )
+    onehot, dense_blocks, dense_spans = _layout(_NAMES, s, Wk.shape[0])
+    tables = _combined_tables(Wk, onehot, _K)
+    h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), jnp.float32) + bias
+    for i in range(_K):
+        h = h + tables[i][_combo_ids(s, i)]
+    x_dense = jnp.concatenate(dense_blocks, axis=-1)
+    W_dense = jnp.concatenate(
+        [jax.lax.slice_in_dim(Wk, o, o + w, axis=0) for o, w in dense_spans], axis=0
+    )
+    h = h + x_dense @ W_dense
+    H = Wk.shape[1] // 2
+
+    logits = []
+    for leaves, sl in ((la, slice(0, H)), (lb, slice(H, 2 * H))):
+        x = jax.nn.relu(h[..., sl])
+        for li in range(1, 3):
+            d = leaves[f'Dense_{li}']
+            x = x @ jnp.asarray(d['kernel']) + jnp.asarray(d['bias'])
+            if li < 2:
+                x = jax.nn.relu(x)
+        logits.append(x[..., 0])
+    return vaep_values(batch, jax.nn.sigmoid(logits[0]), jax.nn.sigmoid(logits[1]))
+
+
+def measure(fn, args, n=10):
+    f = jax.jit(fn)
+    out = jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--games', type=int, default=512)
+    args = ap.parse_args()
+
+    print('devices:', jax.devices())
+    f32_forward, (params, _) = entry()  # the SHIPPED combined-table path
+    batch = synthetic_batch(n_games=args.games, n_actions=1664, seed=1)
+    total = int(batch.total_actions)
+
+    def bf16_forward(params, b):
+        s = _States(b, _K)
+        return vaep_values(
+            b,
+            jax.nn.sigmoid(head_logits(params['scores'], b, s, hidden_dtype=jnp.bfloat16)),
+            jax.nn.sigmoid(head_logits(params['concedes'], b, s, hidden_dtype=jnp.bfloat16)),
+        )
+
+    outs = {}
+    for name, fn in (
+        ('f32', f32_forward),
+        ('bf16_hidden', bf16_forward),
+        ('stacked_heads', stacked_heads_values),
+    ):
+        dt, out = measure(fn, (params, batch))
+        outs[name] = out
+        print(f'{name:>14}: {dt * 1e3:7.2f} ms  {total / dt / 1e6:7.1f}M actions/s')
+
+    ref = outs['f32']
+    for name in ('bf16_hidden', 'stacked_heads'):
+        print(f'max |{name} - f32| = {float(jnp.nanmax(jnp.abs(outs[name] - ref))):.3e}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,5 +1,19 @@
 """Columnar action-tensor runtime core."""
 
-from .batch import ActionBatch, pack_actions, pad_length, unpack_values
+from .batch import (
+    ActionBatch,
+    AtomicActionBatch,
+    pack_actions,
+    pack_atomic_actions,
+    pad_length,
+    unpack_values,
+)
 
-__all__ = ['ActionBatch', 'pack_actions', 'pad_length', 'unpack_values']
+__all__ = [
+    'ActionBatch',
+    'AtomicActionBatch',
+    'pack_actions',
+    'pack_atomic_actions',
+    'pad_length',
+    'unpack_values',
+]

@@ -67,6 +67,27 @@ def test_fused_with_standardization():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3)
 
 
+@pytest.mark.parametrize('k', [1, 2])
+@pytest.mark.parametrize(
+    'names',
+    [
+        # subsets exercise the combined-table fold with only SOME one-hot
+        # blocks present (the table then sums fewer weight rows but the
+        # full (t*R+r)*B+b combo id still indexes it)
+        ('result_onehot', 'time', 'bodypart_onehot'),
+        ('actiontype_result_onehot', 'movement'),
+        ('actiontype_onehot',),
+    ],
+)
+def test_fused_matches_materialized_on_subsets(names, k):
+    batch = synthetic_batch(n_games=2, n_actions=128, seed=7)
+    feats = compute_features(batch, names=names, k=k)
+    module, params = _params(feats.shape[-1])
+    ref = module.apply(params, feats)
+    out = fused_mlp_logits(params, batch, names=names, k=k, hidden_layers=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3)
+
+
 def test_fused_rejects_wrong_layout():
     batch = synthetic_batch(n_games=1, n_actions=64, seed=0)
     _, params = _params(10)

@@ -1,0 +1,104 @@
+"""The dependency-free lint gate must catch what it claims to catch.
+
+tools/lint.py is part of `make check`; a silent false-negative there
+weakens the whole gate, so its rules get the same test treatment as
+product code.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_ROOT / 'tools'))
+
+import lint  # noqa: E402
+
+
+def _problems(tmp_path, source, name='mod.py'):
+    f = tmp_path / name
+    f.write_text(source)
+    return lint.check_file(str(f))
+
+
+def test_unused_import_flagged(tmp_path):
+    probs = _problems(tmp_path, 'import os\nimport sys\nprint(sys.argv)\n')
+    assert len(probs) == 1 and "unused import 'os'" in probs[0]
+
+
+def test_future_and_underscore_imports_exempt(tmp_path):
+    probs = _problems(
+        tmp_path,
+        'from __future__ import annotations\nimport numpy as _np\n',
+    )
+    assert probs == []
+
+
+def test_dotted_use_counts(tmp_path):
+    probs = _problems(tmp_path, 'import numpy\nx = numpy.zeros(3)\n')
+    assert probs == []
+
+
+def test_explicit_reexport_exempt(tmp_path):
+    probs = _problems(tmp_path, 'from os import path as path\n')
+    assert probs == []
+
+
+def test_all_reexport_exempt(tmp_path):
+    probs = _problems(
+        tmp_path, "from os import path\n__all__ = ['path']\n"
+    )
+    assert probs == []
+
+
+def test_init_without_all_exempt(tmp_path):
+    probs = _problems(tmp_path, 'from os import path\n', name='__init__.py')
+    assert probs == []
+
+
+def test_bare_except_flagged(tmp_path):
+    probs = _problems(
+        tmp_path, 'try:\n    pass\nexcept:\n    pass\n'
+    )
+    assert len(probs) == 1 and 'bare except' in probs[0]
+
+
+def test_mutable_default_flagged(tmp_path):
+    probs = _problems(tmp_path, 'def f(x=[]):\n    return x\n')
+    assert len(probs) == 1 and 'mutable default' in probs[0]
+
+
+def test_function_scope_imports_ignored(tmp_path):
+    # function-level imports are deliberate (lazy deps); not flagged
+    probs = _problems(
+        tmp_path, 'def f():\n    import json\n    return 1\n'
+    )
+    assert probs == []
+
+
+def test_string_annotation_reference_exempt(tmp_path):
+    probs = _problems(
+        tmp_path,
+        'import numpy\n\ndef f(x: "numpy.ndarray") -> None:\n    pass\n',
+    )
+    assert probs == []
+
+
+def test_syntax_error_reported(tmp_path):
+    probs = _problems(tmp_path, 'def f(:\n')
+    assert any('syntax error' in p for p in probs)
+
+
+def test_whitespace_rules(tmp_path):
+    probs = _problems(tmp_path, 'x = 1 \n\ty = 2\n')
+    assert any('trailing whitespace' in p for p in probs)
+    assert any('tab indentation' in p for p in probs)
+
+
+def test_cli_green_on_repo():
+    """The repo itself must stay lint-clean (the gate's actual contract)."""
+    proc = subprocess.run(
+        [sys.executable, str(_ROOT / 'tools' / 'lint.py')],
+        capture_output=True, text=True, cwd=_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
