@@ -336,15 +336,15 @@ def main() -> None:
         return
 
     diagnostics = []
-    # attempt 1 + one retry on the inherited (TPU) environment. The retry
-    # after a HANG gets a reduced deadline so total wall time stays within
-    # one extra child-deadline of the original budget (the driver's own
-    # timeout is unknown; 'degrade instead of dying' must hold).
-    retry_deadlines = (_CHILD_DEADLINE_S, min(_CHILD_DEADLINE_S, 300.0))
+    # attempt 1 + one retry on the inherited (TPU) environment. A retry
+    # after a CRASH keeps the full deadline (cold TPU compiles legitimately
+    # take most of it); a retry after a HANG gets a reduced one, so the
+    # hung-tunnel worst case stays within one extra half-deadline of the
+    # original budget (the driver's own timeout is unknown; 'degrade
+    # instead of dying' must hold).
+    deadline_s = _CHILD_DEADLINE_S
     for attempt in range(2):
-        rc, result, tail = _run_child(
-            dict(os.environ), deadline_s=retry_deadlines[attempt]
-        )
+        rc, result, tail = _run_child(dict(os.environ), deadline_s=deadline_s)
         if rc == 0 and result is not None:
             if diagnostics:
                 result['diagnostics'] = diagnostics
@@ -352,8 +352,7 @@ def main() -> None:
             return
         if rc is None:
             diagnostics.append(
-                f'attempt {attempt + 1}: child exceeded '
-                f'{retry_deadlines[attempt]:.0f}s '
+                f'attempt {attempt + 1}: child exceeded {deadline_s:.0f}s '
                 '(abandoned, not killed); tail: ' + tail[-300:].replace('\n', ' | ')
             )
             if attempt == 0:
@@ -361,6 +360,7 @@ def main() -> None:
                 # it; the abandoned child keeps waiting and one fresh
                 # attempt after a pause can land (observed in round 3
                 # after a harness-timeout SIGTERM wedged the relay).
+                deadline_s = min(_CHILD_DEADLINE_S, 300.0)
                 time.sleep(2 * _RETRY_DELAY_S)
                 continue
             break

@@ -155,12 +155,20 @@ def _pack_frame(
     """
     if 'game_id' not in actions.columns:
         raise ValueError('actions frame must contain a game_id column')
-
-    # Stable game order: order of first appearance.
-    game_ids = list(dict.fromkeys(actions['game_id'].tolist()))
-    n_games = len(game_ids)
-    if n_games == 0:
+    if len(actions) == 0:
         raise ValueError('cannot pack an empty actions frame')
+
+    # Fully vectorized packing: one scatter per column instead of a
+    # per-game Python loop (the loop measured 0.56M actions/s on host —
+    # BELOW the 1M/s device rating target, making packing the bottleneck
+    # of any cold store -> rate pipeline).
+    # Stable game order: order of first appearance (factorize contract).
+    gi, game_index = pd.factorize(actions['game_id'], sort=False)
+    game_ids = list(game_index)
+    n_games = len(game_ids)
+    # position of each row within its game, in frame order
+    pos = actions.groupby(gi, sort=False).cumcount().to_numpy()
+    n_actions = np.bincount(gi, minlength=n_games).astype(np.int32)
 
     if home_team_ids is None:
         if home_team_id is not None:
@@ -172,35 +180,38 @@ def _pack_frame(
         else:
             raise ValueError('home_team_ids (or home_team_id) is required')
 
-    counts = actions.groupby('game_id', sort=False).size().reindex(game_ids)
-    longest = int(counts.max())
+    longest = int(n_actions.max())
     A = max_actions if max_actions is not None else pad_length(longest)
     if longest > A:
         raise ValueError(f'game of length {longest} exceeds max_actions={A}')
 
-    def alloc(dtype, fill=0):
-        return np.full((n_games, A), fill, dtype=dtype)
+    flat = gi * A + pos  # destination of every source row in a (G, A) grid
 
-    cols = {c: alloc(float_dtype) for c in float_cols}
-    cols.update({c: alloc(np.int32) for c in int_cols})
-    is_home = alloc(bool, False)
-    mask = alloc(bool, False)
-    row_index = alloc(np.int32, -1)
-    n_actions = np.zeros(n_games, dtype=np.int32)
+    def scatter(values, dtype, fill=0):
+        out = np.full(n_games * A, fill, dtype=dtype)
+        out[flat] = values
+        return out.reshape(n_games, A)
 
-    positions = pd.RangeIndex(len(actions))
-    grouped = dict(tuple(actions.set_index(positions).groupby('game_id', sort=False)))
-    for gi, gid in enumerate(game_ids):
-        g = grouped[gid]
-        n = len(g)
-        n_actions[gi] = n
-        for c in float_cols:
-            cols[c][gi, :n] = g[c].to_numpy(dtype=float_dtype)
-        for c in int_cols:
-            cols[c][gi, :n] = g[c].to_numpy(dtype=np.int64).astype(np.int32)
-        is_home[gi, :n] = (g['team_id'] == home_team_ids[gid]).to_numpy()
-        mask[gi, :n] = True
-        row_index[gi, :n] = g.index.to_numpy(dtype=np.int64).astype(np.int32)
+    cols = {
+        c: scatter(actions[c].to_numpy(dtype=float_dtype), float_dtype)
+        for c in float_cols
+    }
+    cols.update(
+        {
+            c: scatter(
+                actions[c].to_numpy(dtype=np.int64).astype(np.int32), np.int32
+            )
+            for c in int_cols
+        }
+    )
+    home_of_game = np.asarray([home_team_ids[g] for g in game_ids])
+    is_home = scatter(
+        actions['team_id'].to_numpy() == home_of_game[gi], bool, False
+    )
+    mask = scatter(np.ones(len(actions), dtype=bool), bool, False)
+    row_index = scatter(
+        np.arange(len(actions), dtype=np.int32), np.int32, -1
+    )
 
     jcols = {c: jnp.asarray(v) for c, v in cols.items()}
     batch = make_batch(

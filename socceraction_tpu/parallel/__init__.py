@@ -13,7 +13,11 @@ first-class subsystem built on ``jax.sharding``:
 - VAEP MLP training runs data-parallel (batch over ``games``) with
   optionally tensor-parallel hidden layers (weights over ``model``);
   XLA inserts the gradient all-reduce / activation collectives from the
-  sharding annotations.
+  sharding annotations,
+- for sequences too long for one device, the **action axis** itself can
+  shard over a ``(games, seq)`` mesh with halo-exchange kernels
+  (:mod:`~socceraction_tpu.parallel.sequence` — the action-stream analog
+  of ring attention).
 """
 
 from .mesh import (
@@ -25,6 +29,13 @@ from .mesh import (
 )
 from .xt import sharded_xt_counts, sharded_xt_fit, sharded_xt_fit_matrix_free
 from .vaep import make_train_step, sharded_rate, train_distributed
+from .sequence import (
+    make_sequence_mesh,
+    sequence_features,
+    sequence_labels,
+    sequence_values,
+    shard_batch_seq,
+)
 
 __all__ = [
     'make_mesh',
@@ -38,4 +49,9 @@ __all__ = [
     'make_train_step',
     'sharded_rate',
     'train_distributed',
+    'make_sequence_mesh',
+    'shard_batch_seq',
+    'sequence_features',
+    'sequence_labels',
+    'sequence_values',
 ]

@@ -1,0 +1,359 @@
+"""Sequence (context) parallelism: shard the ACTION axis across devices.
+
+The framework's default scale-out shards the game axis and keeps each
+game's action stream on one device — correct for SPADL's ~1.5-2.5k-action
+games (docs/design.md "Scale-out"). This module is the long-context path
+for when that assumption breaks (arbitrarily long tracking/event streams,
+or more devices than games): the `(G, A)` batch is sharded over a
+``(games, seq)`` mesh and every kernel runs shard-local with **halo
+exchange**, the action-stream analog of ring attention — communication
+cost is O(halo), not O(sequence).
+
+Why it decomposes: every cross-action dependence in the valuation stack
+is bounded (SURVEY §5 "Long-context"):
+
+- features look back ``k-1 ≤ 2`` actions (edge-clamped shifts),
+- labels look ahead ``nr_actions-1 ≤ 9`` actions (per-game tail clamp),
+- the VAEP formula lags exactly 1 action,
+- the only global dependence is ``goalscore``'s running score — a prefix
+  sum, solved with a per-shard reduction + exclusive cross-shard scan
+  (``all_gather`` of one scalar pair per (game, shard)).
+
+So each shard pulls ``HL = k-1`` columns from its left neighbor (none at
+``k = 1``) and ``HR = nr_actions-1`` from its right neighbor via
+``ppermute`` over ICI, the stateless feature kernels run unchanged on the extended local
+view, and the three sequence-global quantities (goalscore prefix, the
+game's first-action team, the per-game last-valid-row clamp) are
+reconstructed from one tiny collective each. Numerical results are
+asserted identical to the unsharded kernels in
+``tests/test_sequence_parallel.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.batch import ActionBatch
+from ..ops.features import KERNELS, _States
+from ..spadl import config as spadlconfig
+
+__all__ = [
+    'make_sequence_mesh',
+    'shard_batch_seq',
+    'sequence_features',
+    'sequence_labels',
+    'sequence_values',
+]
+
+_SEQ_FIELDS = (
+    'type_id', 'result_id', 'bodypart_id', 'period_id', 'is_home',
+    'time_seconds', 'start_x', 'start_y', 'end_x', 'end_y', 'mask',
+    'row_index',
+)
+
+
+def make_sequence_mesh(n_devices: int = None, seq_parallel: int = 2) -> Mesh:
+    """A ``(games, seq)`` mesh: data-parallel games × sequence shards."""
+    import numpy as np
+
+    devices = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    n = len(devices)
+    if n % seq_parallel != 0:
+        raise ValueError(f'seq_parallel={seq_parallel} does not divide {n} devices')
+    arr = np.asarray(devices).reshape(n // seq_parallel, seq_parallel)
+    return Mesh(arr, axis_names=('games', 'seq'))
+
+
+def shard_batch_seq(batch: ActionBatch, mesh: Mesh) -> ActionBatch:
+    """Place a batch with games over ``'games'`` AND actions over ``'seq'``.
+
+    The action axis must divide by the ``'seq'`` axis size (pad with
+    :func:`~socceraction_tpu.core.batch.pad_length` / ``max_actions`` at
+    pack time); the game axis is padded like
+    :func:`~socceraction_tpu.parallel.mesh.shard_batch`.
+    """
+    from .mesh import pad_games
+
+    batch = pad_games(batch, mesh.shape['games'])
+    if batch.max_actions % mesh.shape['seq'] != 0:
+        raise ValueError(
+            f'action axis {batch.max_actions} does not divide over '
+            f"seq={mesh.shape['seq']} shards; pack with a divisible max_actions"
+        )
+    seq_sh = NamedSharding(mesh, P('games', 'seq'))
+    game_sh = NamedSharding(mesh, P('games'))
+
+    def place(name, x):
+        return jax.device_put(x, seq_sh if name in _SEQ_FIELDS else game_sh)
+
+    return ActionBatch(
+        **{
+            name: place(name, getattr(batch, name))
+            for name in (*_SEQ_FIELDS, 'n_actions', 'game_id')
+        }
+    )
+
+
+# ---------------------------------------------------------------- halos ----
+
+
+def _check_halo(h: int, local_width: int) -> None:
+    if h > local_width:
+        raise ValueError(
+            f'halo width {h} exceeds the local shard width {local_width}; '
+            'a shard only holds its neighbor-adjacent columns once — use '
+            'fewer seq shards or a larger max_actions at pack time'
+        )
+
+
+def _left_halo(x: jax.Array, h: int, axis_name: str) -> jax.Array:
+    """``(G, h)`` columns owned by the left neighbor (edge: replicate col 0).
+
+    The edge fill IS the kernels' clamp semantics: the unsharded shifts
+    read ``max(j - i, 0)`` — row 0 of the game — and games are
+    left-aligned, so shard 0's first local column is the game's first row.
+    """
+    _check_halo(h, x.shape[1])
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    tail = x[:, -h:]
+    recv = jax.lax.ppermute(tail, axis_name, [(i, (i + 1) % n) for i in range(n)])
+    edge = jnp.broadcast_to(x[:, :1], (*x.shape[:-1], h))
+    return jnp.where(idx == 0, edge, recv)
+
+
+def _right_halo(x: jax.Array, h: int, axis_name: str) -> jax.Array:
+    """``(G, h)`` columns owned by the right neighbor (edge: replicate last)."""
+    _check_halo(h, x.shape[1])
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    head = x[:, :h]
+    recv = jax.lax.ppermute(head, axis_name, [(i, (i - 1) % n) for i in range(n)])
+    edge = jnp.broadcast_to(x[:, -1:], (*x.shape[:-1], h))
+    return jnp.where(idx == n - 1, edge, recv)
+
+
+def _extend(x: jax.Array, hl: int, hr: int, axis_name: str) -> jax.Array:
+    parts = []
+    if hl:
+        parts.append(_left_halo(x, hl, axis_name).astype(x.dtype))
+    parts.append(x)
+    if hr:
+        parts.append(_right_halo(x, hr, axis_name).astype(x.dtype))
+    return jnp.concatenate(parts, axis=1)
+
+
+def _extended_batch(batch: ActionBatch, hl: int, hr: int, axis_name: str) -> ActionBatch:
+    """Local batch whose action axis carries ``hl``/``hr`` halo columns."""
+    return batch.replace(
+        **{
+            f: _extend(getattr(batch, f), hl, hr, axis_name)
+            for f in _SEQ_FIELDS
+        }
+    )
+
+
+# ----------------------------------------------------------- goalscore ----
+
+
+def _goalscore_seq(batch: ActionBatch, axis_name: str) -> jax.Array:
+    """Cross-shard ``goalscore`` block: local cumsum + exclusive shard scan.
+
+    Mirrors ``ops.features._goalscore`` exactly, with the two global
+    quantities rebuilt from collectives: the game's first-action team
+    (column 0 of shard 0, via ``all_gather``) and the pre-shard goal
+    prefix (exclusive scan of per-shard counts).
+    """
+    type_id, result_id, team = batch.type_id, batch.result_id, batch.is_home
+    shot_like = (
+        (type_id == spadlconfig.SHOT)
+        | (type_id == spadlconfig.SHOT_PENALTY)
+        | (type_id == spadlconfig.SHOT_FREEKICK)
+    )
+    goals = shot_like & (result_id == spadlconfig.SUCCESS)
+    owngoals = shot_like & (result_id == spadlconfig.OWNGOAL)
+
+    # team "A" = team of the game's FIRST action = shard 0's column 0
+    firsts = jax.lax.all_gather(team[:, 0], axis_name)  # (n_seq, G)
+    teamisA = team == firsts[0][:, None]
+    f = jnp.float32
+    goalsA = (goals & teamisA) | (owngoals & ~teamisA)
+    goalsB = (goals & ~teamisA) | (owngoals & teamisA)
+
+    def prefixed(g):
+        local = jnp.cumsum(g.astype(f), axis=1) - g.astype(f)
+        sums = jax.lax.all_gather(g.astype(f).sum(axis=1), axis_name)  # (n, G)
+        n = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        before = (jnp.arange(n) < idx)[:, None]  # exclusive scan mask
+        return local + (sums * before).sum(axis=0)[:, None]
+
+    scoreA, scoreB = prefixed(goalsA), prefixed(goalsB)
+    team_score = jnp.where(teamisA, scoreA, scoreB)
+    opp_score = jnp.where(teamisA, scoreB, scoreA)
+    return jnp.stack([team_score, opp_score, team_score - opp_score], axis=-1)
+
+
+# ------------------------------------------------------------- kernels ----
+
+
+def sequence_features(
+    batch: ActionBatch, mesh: Mesh, *, names: Tuple[str, ...], k: int
+) -> jax.Array:
+    """``(G, A, F)`` features with the action axis sharded over ``'seq'``.
+
+    Identical values to
+    :func:`socceraction_tpu.ops.features.compute_features` on the
+    unsharded batch; communication is one ``HL``-column halo exchange
+    plus goalscore's scalar collectives.
+    """
+    hl = max(k - 1, 0)
+
+    def local(b: ActionBatch) -> jax.Array:
+        ext = _extended_batch(b, hl, 0, 'seq')
+        s = _States(ext, k)
+        blocks = []
+        for name in names:
+            if name == 'goalscore':
+                blocks.append(_goalscore_seq(b, 'seq'))
+            else:
+                blocks.append(KERNELS[name](s)[:, hl:])
+        return jnp.concatenate(blocks, axis=-1)
+
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(_batch_specs(),),
+            out_specs=P('games', 'seq', None),
+        )
+    )
+    return fn(batch)
+
+
+def sequence_labels(
+    batch: ActionBatch, mesh: Mesh, *, nr_actions: int = 10
+) -> Tuple[jax.Array, jax.Array]:
+    """``scores``/``concedes`` labels with the action axis sharded.
+
+    Identical values to :func:`socceraction_tpu.ops.labels.scores_concedes`
+    on valid rows (padded rows carry arbitrary values on both paths). The
+    per-game tail clamp (``min(j + i, last_valid)``) is evaluated in local
+    coordinates: shards left of the clamp gather true neighbor values from
+    the right halo, the shard containing it clamps exactly, and shards
+    past it hold only padding.
+    """
+    from ..ops.labels import _goal_masks
+
+    hr = nr_actions - 1
+
+    def local(b: ActionBatch) -> Tuple[jax.Array, jax.Array]:
+        goal, owngoal = _goal_masks(b.type_id, b.result_id)
+        team = b.is_home
+        goal_e = _extend(goal, 0, hr, 'seq')
+        owngoal_e = _extend(owngoal, 0, hr, 'seq')
+        team_e = _extend(team, 0, hr, 'seq')
+
+        A_loc = goal.shape[1]
+        offset = jax.lax.axis_index('seq') * A_loc
+        # per-game last valid row, in local coordinates (may be negative
+        # for pure-padding shards: those rows are masked downstream)
+        last_loc = (b.n_actions - 1 - offset)[:, None]
+
+        scores, concedes = goal, owngoal
+        for i in range(1, nr_actions):
+            idx = jnp.clip(
+                jnp.minimum(jnp.arange(A_loc) + i, last_loc), 0, A_loc + hr - 1
+            )
+            goal_i = jnp.take_along_axis(goal_e, idx, axis=1)
+            owngoal_i = jnp.take_along_axis(owngoal_e, idx, axis=1)
+            same = jnp.take_along_axis(team_e, idx, axis=1) == team
+            scores = scores | (goal_i & same) | (owngoal_i & ~same)
+            concedes = concedes | (goal_i & ~same) | (owngoal_i & same)
+        return scores, concedes
+
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(_batch_specs(),),
+            out_specs=(P('games', 'seq'), P('games', 'seq')),
+        )
+    )
+    return fn(batch)
+
+
+def sequence_values(
+    batch: ActionBatch, p_scores: jax.Array, p_concedes: jax.Array, mesh: Mesh
+) -> jax.Array:
+    """``(G, A, 3)`` VAEP values with the action axis sharded.
+
+    Identical to :func:`socceraction_tpu.ops.formula.vaep_values`; the
+    lag-1 dependence needs a single-column left halo on five arrays.
+    """
+    from ..config import CORNER_PRIOR, PENALTY_PRIOR, SAMEPHASE_SECONDS
+    from ..ops.formula import _CORNER_TYPES
+
+    def local(b: ActionBatch, ps: jax.Array, pc: jax.Array) -> jax.Array:
+        type_prev = _left_halo(b.type_id, 1, 'seq')
+        result_prev = _left_halo(b.result_id, 1, 'seq')
+        home_prev = _left_halo(b.is_home, 1, 'seq')
+        t_prev = _left_halo(b.time_seconds, 1, 'seq')
+        ps_prev = _left_halo(ps, 1, 'seq')
+        pc_prev = _left_halo(pc, 1, 'seq')
+
+        def lag(cur, halo):
+            return jnp.concatenate([halo, cur[:, :-1]], axis=1)
+
+        type_id = b.type_id
+        tp = lag(type_id, type_prev)
+        rp = lag(b.result_id, result_prev)
+        sameteam = lag(b.is_home, home_prev) == b.is_home
+        psp = lag(ps, ps_prev)
+        pcp = lag(pc, pc_prev)
+        toolong = jnp.abs(b.time_seconds - lag(b.time_seconds, t_prev)) > SAMEPHASE_SECONDS
+
+        prevgoal = (
+            (tp == spadlconfig.SHOT)
+            | (tp == spadlconfig.SHOT_PENALTY)
+            | (tp == spadlconfig.SHOT_FREEKICK)
+        ) & (rp == spadlconfig.SUCCESS)
+        reset = toolong | prevgoal
+
+        prev_scores = jnp.where(sameteam, psp, pcp)
+        prev_scores = jnp.where(reset, 0.0, prev_scores)
+        is_penalty = type_id == spadlconfig.SHOT_PENALTY
+        is_corner = (type_id == _CORNER_TYPES[0]) | (type_id == _CORNER_TYPES[1])
+        prev_scores = jnp.where(is_penalty, PENALTY_PRIOR, prev_scores)
+        prev_scores = jnp.where(is_corner, CORNER_PRIOR, prev_scores)
+
+        prev_concedes = jnp.where(sameteam, pcp, psp)
+        prev_concedes = jnp.where(reset, 0.0, prev_concedes)
+
+        offensive = ps - prev_scores
+        defensive = -(pc - prev_concedes)
+        return jnp.stack([offensive, defensive, offensive + defensive], axis=-1)
+
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(_batch_specs(), P('games', 'seq'), P('games', 'seq')),
+            out_specs=P('games', 'seq', None),
+        )
+    )
+    return fn(batch, p_scores, p_concedes)
+
+
+@functools.cache
+def _batch_specs() -> ActionBatch:
+    """PartitionSpec pytree for a sequence-sharded ActionBatch."""
+    specs = {f: P('games', 'seq') for f in _SEQ_FIELDS}
+    specs['n_actions'] = P('games')
+    specs['game_id'] = P('games')
+    return ActionBatch(**specs)
