@@ -212,15 +212,9 @@ def _space_delta(s: _States) -> jax.Array:
 
 
 def _goalscore(s: _States) -> jax.Array:
-    type_id = s.type_id[0]
-    result_id = s.result_id[0]
-    shot_like = (
-        (type_id == spadlconfig.SHOT)
-        | (type_id == spadlconfig.SHOT_PENALTY)
-        | (type_id == spadlconfig.SHOT_FREEKICK)
-    )
-    goals = shot_like & (result_id == spadlconfig.SUCCESS)
-    owngoals = shot_like & (result_id == spadlconfig.OWNGOAL)
+    from .labels import _goal_masks
+
+    goals, owngoals = _goal_masks(s.type_id[0], s.result_id[0])
     # team "A" is the team of the game's first action (reference
     # features.py:521); games are left-aligned so that is column 0.
     teamisA = s.is_home[0] == s.is_home[0][:, :1]

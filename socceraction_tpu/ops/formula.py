@@ -16,8 +16,9 @@ import jax.numpy as jnp
 from ..config import CORNER_PRIOR, PENALTY_PRIOR, SAMEPHASE_SECONDS
 from ..core.batch import ActionBatch
 from ..spadl import config as spadlconfig
+from .labels import _goal_masks
 
-__all__ = ['vaep_values']
+__all__ = ['vaep_values', 'vaep_core']
 
 _CORNER_TYPES = (
     spadlconfig.actiontypes.index('corner_crossed'),
@@ -25,30 +26,29 @@ _CORNER_TYPES = (
 )
 
 
-@jax.jit
-def vaep_values(
-    batch: ActionBatch, p_scores: jax.Array, p_concedes: jax.Array
+def vaep_core(
+    type_id: jax.Array,
+    time_seconds: jax.Array,
+    p_scores: jax.Array,
+    p_concedes: jax.Array,
+    *,
+    type_prev: jax.Array,
+    result_prev: jax.Array,
+    sameteam: jax.Array,
+    time_prev: jax.Array,
+    p_scores_prev: jax.Array,
+    p_concedes_prev: jax.Array,
 ) -> jax.Array:
-    """Compute ``(G, A, 3)``: offensive, defensive and total VAEP values."""
-    A = batch.type_id.shape[1]
-    prev = jnp.maximum(jnp.arange(A) - 1, 0)
+    """The formula given explicit lag-1 views — the single source of truth.
 
-    type_id = batch.type_id
-    type_prev = type_id[:, prev]
-    result_prev = batch.result_id[:, prev]
-    sameteam = batch.is_home[:, prev] == batch.is_home
-    p_scores_prev = p_scores[:, prev]
-    p_concedes_prev = p_concedes[:, prev]
-
-    t = batch.time_seconds
-    toolong = jnp.abs(t - t[:, prev]) > SAMEPHASE_SECONDS
-
-    prevgoal = (
-        (type_prev == spadlconfig.SHOT)
-        | (type_prev == spadlconfig.SHOT_PENALTY)
-        | (type_prev == spadlconfig.SHOT_FREEKICK)
-    ) & (result_prev == spadlconfig.SUCCESS)
-
+    :func:`vaep_values` derives the lags from a packed batch (clamped at
+    row 0); the sequence-parallel kernels
+    (:mod:`socceraction_tpu.parallel.sequence`) derive them from halo
+    exchanges. Both MUST flow through here so the formula can never
+    diverge between the sharded and unsharded paths.
+    """
+    toolong = jnp.abs(time_seconds - time_prev) > SAMEPHASE_SECONDS
+    prevgoal, _ = _goal_masks(type_prev, result_prev)
     reset = toolong | prevgoal
 
     prev_scores = jnp.where(sameteam, p_scores_prev, p_concedes_prev)
@@ -64,3 +64,25 @@ def vaep_values(
     offensive = p_scores - prev_scores
     defensive = -(p_concedes - prev_concedes)
     return jnp.stack([offensive, defensive, offensive + defensive], axis=-1)
+
+
+@jax.jit
+def vaep_values(
+    batch: ActionBatch, p_scores: jax.Array, p_concedes: jax.Array
+) -> jax.Array:
+    """Compute ``(G, A, 3)``: offensive, defensive and total VAEP values."""
+    A = batch.type_id.shape[1]
+    prev = jnp.maximum(jnp.arange(A) - 1, 0)
+    t = batch.time_seconds
+    return vaep_core(
+        batch.type_id,
+        t,
+        p_scores,
+        p_concedes,
+        type_prev=batch.type_id[:, prev],
+        result_prev=batch.result_id[:, prev],
+        sameteam=batch.is_home[:, prev] == batch.is_home,
+        time_prev=t[:, prev],
+        p_scores_prev=p_scores[:, prev],
+        p_concedes_prev=p_concedes[:, prev],
+    )

@@ -184,6 +184,7 @@ def fused_mlp_logits(
     mean: Optional[jax.Array] = None,
     std: Optional[jax.Array] = None,
     registry: FusedRegistry = STANDARD_REGISTRY,
+    dense_overrides: Optional[Dict[str, jax.Array]] = None,
 ) -> jax.Array:
     """Logits of an :class:`~socceraction_tpu.ml.mlp._MLP` over a batch.
 
@@ -208,6 +209,13 @@ def fused_mlp_logits(
     registry
         Feature-family layout (:data:`STANDARD_REGISTRY` or
         :data:`ATOMIC_REGISTRY`).
+    dense_overrides
+        Optional precomputed ``(G, A, width)`` blocks substituted for
+        named dense kernels. Used by sequence parallelism
+        (:mod:`socceraction_tpu.parallel.sequence`) to inject the
+        cross-shard-corrected ``goalscore`` block — the one dense kernel
+        whose value depends on the whole sequence, which a shard-local
+        evaluation would get wrong.
 
     Returns
     -------
@@ -235,7 +243,14 @@ def fused_mlp_logits(
             layout.append((name, spec, None, off))
             off += spec[0] * k
         else:
-            block = registry.kernels[name](s)
+            block = (dense_overrides or {}).get(name)
+            if block is None:
+                block = registry.kernels[name](s)
+            elif block.shape[:2] != batch.type_id.shape:
+                raise ValueError(
+                    f'dense override {name!r} has leading shape '
+                    f'{block.shape[:2]}, batch is {batch.type_id.shape}'
+                )
             layout.append((name, None, block, off))
             off += block.shape[-1]
     if Wk.shape[0] != off:

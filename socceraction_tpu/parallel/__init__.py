@@ -33,6 +33,7 @@ from .sequence import (
     make_sequence_mesh,
     sequence_features,
     sequence_labels,
+    sequence_rate,
     sequence_values,
     shard_batch_seq,
 )
@@ -53,5 +54,6 @@ __all__ = [
     'shard_batch_seq',
     'sequence_features',
     'sequence_labels',
+    'sequence_rate',
     'sequence_values',
 ]

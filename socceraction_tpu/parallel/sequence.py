@@ -40,7 +40,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.batch import ActionBatch
 from ..ops.features import KERNELS, _States
-from ..spadl import config as spadlconfig
 
 __all__ = [
     'make_sequence_mesh',
@@ -48,6 +47,7 @@ __all__ = [
     'sequence_features',
     'sequence_labels',
     'sequence_values',
+    'sequence_rate',
 ]
 
 _SEQ_FIELDS = (
@@ -148,12 +148,18 @@ def _extend(x: jax.Array, hl: int, hr: int, axis_name: str) -> jax.Array:
     return jnp.concatenate(parts, axis=1)
 
 
+#: The fields the per-state views (`ops.features._States`) actually read;
+#: ``mask``/``row_index`` are never consumed from an extended view, so
+#: exchanging their halos would be pure wasted ICI traffic.
+_STATE_FIELDS = tuple(f for f in _SEQ_FIELDS if f not in ('mask', 'row_index'))
+
+
 def _extended_batch(batch: ActionBatch, hl: int, hr: int, axis_name: str) -> ActionBatch:
     """Local batch whose action axis carries ``hl``/``hr`` halo columns."""
     return batch.replace(
         **{
             f: _extend(getattr(batch, f), hl, hr, axis_name)
-            for f in _SEQ_FIELDS
+            for f in _STATE_FIELDS
         }
     )
 
@@ -169,14 +175,10 @@ def _goalscore_seq(batch: ActionBatch, axis_name: str) -> jax.Array:
     (column 0 of shard 0, via ``all_gather``) and the pre-shard goal
     prefix (exclusive scan of per-shard counts).
     """
-    type_id, result_id, team = batch.type_id, batch.result_id, batch.is_home
-    shot_like = (
-        (type_id == spadlconfig.SHOT)
-        | (type_id == spadlconfig.SHOT_PENALTY)
-        | (type_id == spadlconfig.SHOT_FREEKICK)
-    )
-    goals = shot_like & (result_id == spadlconfig.SUCCESS)
-    owngoals = shot_like & (result_id == spadlconfig.OWNGOAL)
+    from ..ops.labels import _goal_masks
+
+    team = batch.is_home
+    goals, owngoals = _goal_masks(batch.type_id, batch.result_id)
 
     # team "A" = team of the game's FIRST action = shard 0's column 0
     firsts = jax.lax.all_gather(team[:, 0], axis_name)  # (n_seq, G)
@@ -293,51 +295,29 @@ def sequence_values(
 ) -> jax.Array:
     """``(G, A, 3)`` VAEP values with the action axis sharded.
 
-    Identical to :func:`socceraction_tpu.ops.formula.vaep_values`; the
-    lag-1 dependence needs a single-column left halo on five arrays.
+    Identical to :func:`socceraction_tpu.ops.formula.vaep_values` — both
+    flow through :func:`socceraction_tpu.ops.formula.vaep_core`; the
+    lag-1 dependence needs a single-column left halo on six arrays.
     """
-    from ..config import CORNER_PRIOR, PENALTY_PRIOR, SAMEPHASE_SECONDS
-    from ..ops.formula import _CORNER_TYPES
+    from ..ops.formula import vaep_core
 
     def local(b: ActionBatch, ps: jax.Array, pc: jax.Array) -> jax.Array:
-        type_prev = _left_halo(b.type_id, 1, 'seq')
-        result_prev = _left_halo(b.result_id, 1, 'seq')
-        home_prev = _left_halo(b.is_home, 1, 'seq')
-        t_prev = _left_halo(b.time_seconds, 1, 'seq')
-        ps_prev = _left_halo(ps, 1, 'seq')
-        pc_prev = _left_halo(pc, 1, 'seq')
-
-        def lag(cur, halo):
+        def lag(cur):
+            halo = _left_halo(cur, 1, 'seq')
             return jnp.concatenate([halo, cur[:, :-1]], axis=1)
 
-        type_id = b.type_id
-        tp = lag(type_id, type_prev)
-        rp = lag(b.result_id, result_prev)
-        sameteam = lag(b.is_home, home_prev) == b.is_home
-        psp = lag(ps, ps_prev)
-        pcp = lag(pc, pc_prev)
-        toolong = jnp.abs(b.time_seconds - lag(b.time_seconds, t_prev)) > SAMEPHASE_SECONDS
-
-        prevgoal = (
-            (tp == spadlconfig.SHOT)
-            | (tp == spadlconfig.SHOT_PENALTY)
-            | (tp == spadlconfig.SHOT_FREEKICK)
-        ) & (rp == spadlconfig.SUCCESS)
-        reset = toolong | prevgoal
-
-        prev_scores = jnp.where(sameteam, psp, pcp)
-        prev_scores = jnp.where(reset, 0.0, prev_scores)
-        is_penalty = type_id == spadlconfig.SHOT_PENALTY
-        is_corner = (type_id == _CORNER_TYPES[0]) | (type_id == _CORNER_TYPES[1])
-        prev_scores = jnp.where(is_penalty, PENALTY_PRIOR, prev_scores)
-        prev_scores = jnp.where(is_corner, CORNER_PRIOR, prev_scores)
-
-        prev_concedes = jnp.where(sameteam, pcp, psp)
-        prev_concedes = jnp.where(reset, 0.0, prev_concedes)
-
-        offensive = ps - prev_scores
-        defensive = -(pc - prev_concedes)
-        return jnp.stack([offensive, defensive, offensive + defensive], axis=-1)
+        return vaep_core(
+            b.type_id,
+            b.time_seconds,
+            ps,
+            pc,
+            type_prev=lag(b.type_id),
+            result_prev=lag(b.result_id),
+            sameteam=lag(b.is_home) == b.is_home,
+            time_prev=lag(b.time_seconds),
+            p_scores_prev=lag(ps),
+            p_concedes_prev=lag(pc),
+        )
 
     fn = jax.jit(
         jax.shard_map(
@@ -348,6 +328,97 @@ def sequence_values(
         )
     )
     return fn(batch, p_scores, p_concedes)
+
+
+def sequence_rate(model, batch: ActionBatch, mesh: Mesh) -> jax.Array:
+    """``(G, A, 3)`` VAEP values with the action axis sharded end-to-end.
+
+    The sequence-parallel twin of ``VAEP.rate_batch`` /
+    :func:`~socceraction_tpu.parallel.vaep.sharded_rate`: the fused
+    combined-table forward (:mod:`socceraction_tpu.ops.fused`) runs on
+    each shard's halo-extended view — probabilities for the ``k-1`` halo
+    columns come out of the same forward pass, so the formula's lag-1
+    needs no second collective — and only the bounded halos ever cross
+    ICI. ``model`` is a fitted VAEP (or AtomicVAEP) with MLP heads.
+    """
+    from ..ops.fused import REGISTRIES, fused_mlp_logits
+
+    if not model._can_fuse():
+        raise ValueError(
+            "sequence_rate needs fitted on-device MLP heads (learner='mlp')"
+        )
+    if model._fused_registry != 'standard':
+        raise NotImplementedError(
+            'sequence_rate implements the standard SPADL formula; the '
+            'atomic formula has different lag semantics (use the game-'
+            'sharded sharded_rate for AtomicVAEP)'
+        )
+    clf_s, clf_c = (model._models[c] for c in model._label_columns)
+    names = model._kernel_names()
+    k = model.nb_prev_actions
+    registry = REGISTRIES[model._fused_registry]
+    # the formula lags 1 action, and that previous column's OWN forward
+    # needs its k-1 lookback states, so the halo is k columns wide
+    hl = k
+
+    def local(b: ActionBatch) -> jax.Array:
+        ext = _extended_batch(b, hl, 0, 'seq')
+
+        # goalscore is the one dense block with whole-sequence dependence
+        # (running-score prefix): inject the cross-shard-corrected values,
+        # halo columns included, instead of the shard-local cumsum the
+        # kernel would compute
+        overrides = None
+        if 'goalscore' in names:
+            gs = _goalscore_seq(b, 'seq')  # (G, A_loc, 3), corrected
+            gs_ext = jnp.stack(
+                [_extend(gs[..., c], hl, 0, 'seq') for c in range(gs.shape[-1])],
+                axis=-1,
+            )
+            overrides = {'goalscore': gs_ext}
+
+        def probs(clf):
+            logits = fused_mlp_logits(
+                clf.params, ext, names=names, k=k,
+                hidden_layers=len(clf.hidden),
+                mean=clf.mean_, std=clf.std_, registry=registry,
+                dense_overrides=overrides,
+            )
+            return jax.nn.sigmoid(logits)
+
+        ps_e, pc_e = probs(clf_s), probs(clf_c)
+
+        from ..ops.formula import vaep_core
+
+        # lag-1 views: local column j's predecessor is extended column
+        # hl + j - 1 (the halo supplies j = 0's)
+        def lag(x_ext):
+            return jax.lax.slice_in_dim(
+                x_ext, hl - 1, hl - 1 + b.type_id.shape[1], axis=1
+            )
+
+        return vaep_core(
+            b.type_id,
+            b.time_seconds,
+            ps_e[:, hl:],
+            pc_e[:, hl:],
+            type_prev=lag(ext.type_id),
+            result_prev=lag(ext.result_id),
+            sameteam=lag(ext.is_home) == b.is_home,
+            time_prev=lag(ext.time_seconds),
+            p_scores_prev=lag(ps_e),
+            p_concedes_prev=lag(pc_e),
+        )
+
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(_batch_specs(),),
+            out_specs=P('games', 'seq', None),
+        )
+    )
+    return fn(batch)
 
 
 @functools.cache

@@ -112,6 +112,46 @@ def test_sequence_values_match_unsharded(batch, sharded, mesh):
     )
 
 
+@pytest.mark.parametrize('k', [1, 3])
+def test_sequence_rate_matches_rate_batch(batch, sharded, mesh, k):
+    """End-to-end sequence-sharded rating == the unsharded fused rating."""
+    from socceraction_tpu.parallel.sequence import sequence_rate
+    from socceraction_tpu.vaep.base import VAEP
+
+    model = VAEP(backend='jax', nb_prev_actions=k)
+    # tiny but real fit so heads carry non-degenerate weights + stats
+    games = pd.DataFrame(
+        {'game_id': [1000, 1001], 'home_team_id': [100, 100]}
+    )
+    frames = {
+        1000: synthetic_actions_frame(game_id=1000, n_actions=700, seed=0),
+        1001: synthetic_actions_frame(game_id=1001, n_actions=800, seed=1),
+    }
+    X = pd.concat(
+        [model.compute_features(g, frames[g.game_id]) for g in games.itertuples()]
+    )
+    y = pd.concat(
+        [model.compute_labels(g, frames[g.game_id]) for g in games.itertuples()]
+    )
+    model.fit(X, y, learner='mlp', tree_params=dict(max_epochs=2))
+
+    ref = model.rate_batch(batch)
+    out = sequence_rate(model, sharded, mesh)
+    mask = np.asarray(batch.mask)
+    np.testing.assert_allclose(
+        np.asarray(out)[mask], np.asarray(ref)[mask], rtol=1e-6, atol=1e-6
+    )
+
+
+def test_sequence_rate_rejects_tree_heads(batch, sharded, mesh):
+    from socceraction_tpu.parallel.sequence import sequence_rate
+    from socceraction_tpu.vaep.base import VAEP
+
+    model = VAEP(backend='jax')
+    with pytest.raises(ValueError, match='MLP heads'):
+        sequence_rate(model, sharded, mesh)
+
+
 def test_halo_wider_than_shard_raises(mesh):
     """nr_actions-1 > A/seq must fail with the named constraint, not a
     broadcast error from inside ppermute."""
