@@ -1,4 +1,4 @@
-"""Walkthrough 1/4 — load raw events, convert to SPADL, build a season store.
+"""Walkthrough 1/5 — load raw events, convert to SPADL, build a season store.
 
 Mirrors the reference's ``public-notebooks/1-load-and-convert-statsbomb-
 data.ipynb``: provider loader → SPADL converter → per-game store. Runs

@@ -1,4 +1,4 @@
-"""Walkthrough 2/4 — game-state features and scoring/conceding labels.
+"""Walkthrough 2/5 — game-state features and scoring/conceding labels.
 
 Mirrors the reference's ``public-notebooks/2-compute-features-and-
 labels.ipynb``: gamestates → feature transformers → scores/concedes

@@ -1,4 +1,4 @@
-"""Walkthrough 3/4 — train the P(scores)/P(concedes) probability models.
+"""Walkthrough 3/5 — train the P(scores)/P(concedes) probability models.
 
 Mirrors the reference's ``public-notebooks/3-estimate-scoring-and-
 conceding-probabilities.ipynb``: fit one binary classifier per label on

@@ -1,4 +1,4 @@
-"""Walkthrough 4/4 — rate every action, rank players, and fit xT.
+"""Walkthrough 4/5 — rate every action, rank players, and fit xT.
 
 Mirrors the reference's ``public-notebooks/4-analyze-player-ratings.ipynb``
 (VAEP values → per-player aggregation) and ``EXTRA-run-xT.ipynb``

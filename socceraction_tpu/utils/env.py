@@ -16,7 +16,7 @@ import os
 import re
 from typing import Mapping, MutableMapping, Optional
 
-__all__ = ['cpu_device_env']
+__all__ = ['cpu_device_env', 'run_distributed_cpu_workers']
 
 _DEVICE_COUNT_FLAG = re.compile(r'--xla_force_host_platform_device_count=\d+')
 
@@ -54,3 +54,67 @@ def cpu_device_env(
         flags = f'{flags} --xla_force_host_platform_device_count={int(n_devices)}'
     env['XLA_FLAGS'] = ' '.join(flags.split())
     return env
+
+
+def run_distributed_cpu_workers(
+    worker_path: str,
+    num_processes: int = 2,
+    *,
+    local_devices: int = 4,
+    timeout_s: float = 280.0,
+) -> list:
+    """Spawn ``num_processes`` ``jax.distributed`` CPU worker processes.
+
+    Shared by the multi-process test tier (``tests/test_distributed.py``)
+    and the scale-out walkthrough so the launch/collect/cleanup logic
+    cannot drift between them. Each worker is started as
+    ``python worker_path <process_id> <num_processes> <port>`` in a clean
+    ``local_devices``-virtual-CPU environment with this package's repo
+    root on ``PYTHONPATH``; a free coordinator port is picked here.
+
+    Returns the workers' combined stdout/stderr texts. Raises
+    ``RuntimeError`` naming the first failing worker if any exits
+    nonzero; on a hang, every still-running worker is killed before the
+    ``TimeoutExpired`` propagates.
+    """
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+
+    env = cpu_device_env(local_devices)
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env['PYTHONPATH'] = root + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else ''
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker_path, str(i), str(num_processes), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(num_processes)
+    ]
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout_s)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f'distributed worker {i} failed (rc={p.returncode}):\n'
+                + out[-3000:]
+            )
+    return outputs

@@ -15,64 +15,25 @@ from __future__ import annotations
 
 import os
 import re
-import socket
-import subprocess
-import sys
 
 import pytest
 
-from socceraction_tpu.utils.env import cpu_device_env
+from socceraction_tpu.utils.env import run_distributed_cpu_workers
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'distributed_worker.py')
 _N_PROCESSES = 2
-_TIMEOUT_S = 300
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
-
-
-def _worker_env() -> dict:
-    env = cpu_device_env(4)
-    env['PYTHONPATH'] = _REPO_ROOT + (
-        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else ''
-    )
-    return env
 
 
 @pytest.mark.slow
 def test_two_process_distributed_fit_and_train():
-    # bounded by communicate(timeout=_TIMEOUT_S) below, not pytest-timeout
-    # (not installed in this image)
-    port = _free_port()
-    env = _worker_env()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _WORKER, str(pid), str(_N_PROCESSES), str(port)],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in range(_N_PROCESSES)
-    ]
-    outputs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=_TIMEOUT_S)
-            outputs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    # bounded by run_distributed_cpu_workers' communicate timeout, not
+    # pytest-timeout (not installed in this image); nonzero worker exit
+    # raises RuntimeError with the worker's tail
+    outputs = run_distributed_cpu_workers(
+        _WORKER, _N_PROCESSES, local_devices=4, timeout_s=300
+    )
 
-    for pid, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, (
-            f'worker {pid} failed (rc={p.returncode}):\n{out[-4000:]}'
-        )
+    for pid, out in enumerate(outputs):
         assert f'DIST_OK pid={pid}' in out, f'worker {pid} output:\n{out[-4000:]}'
 
     # all workers must agree on every replicated result bit-for-bit as

@@ -21,6 +21,9 @@ _SCRIPTS = [
     '2_features_and_labels.py',
     '3_train_probability_models.py',
     '4_rate_and_rank_players.py',
+    # chapter 5 runs without --processes here: the two-process tier is
+    # already covered (and time-bounded) by tests/test_distributed.py
+    '5_scale_out.py',
 ]
 
 
@@ -33,6 +36,7 @@ def test_walkthrough_sequence(tmp_path_factory):
         '2_features_and_labels.py': ['--store', store],
         '3_train_probability_models.py': ['--store', store, '--checkpoint', ckpt],
         '4_rate_and_rank_players.py': ['--store', store, '--checkpoint', ckpt],
+        '5_scale_out.py': [],
     }
     for script in _SCRIPTS:
         proc = subprocess.run(
