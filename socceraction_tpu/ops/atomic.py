@@ -157,9 +157,7 @@ def _direction(s: _AtomicStates) -> jax.Array:
 
 
 def _goalscore(s: _AtomicStates) -> jax.Array:
-    type_id = s.type_id[0]
-    goals = type_id == atomicconfig.GOAL
-    owngoals = type_id == atomicconfig.OWNGOAL
+    goals, owngoals = _goal_masks(s.type_id[0])
     teamisA = s.is_home[0] == s.is_home[0][:, :1]
     goalsA = (goals & teamisA) | (owngoals & ~teamisA)
     goalsB = (goals & ~teamisA) | (owngoals & teamisA)
@@ -197,13 +195,22 @@ def compute_features(
     return jnp.concatenate(blocks, axis=-1)
 
 
+def _goal_masks(type_id: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Atomic goal predicates: goal/owngoal ARE action types (no result).
+
+    The single source of truth shared by the labels, the goalscore
+    feature, the formula's prev-goal reset and the sequence-parallel
+    kernels.
+    """
+    return type_id == atomicconfig.GOAL, type_id == atomicconfig.OWNGOAL
+
+
 @functools.partial(jax.jit, static_argnames=('nr_actions',))
 def scores_concedes(
     batch: AtomicActionBatch, *, nr_actions: int = LABEL_LOOKAHEAD
 ) -> Tuple[jax.Array, jax.Array]:
     """Atomic scores/concedes labels, shape ``(G, A)`` bool."""
-    goal = batch.type_id == atomicconfig.GOAL
-    owngoal = batch.type_id == atomicconfig.OWNGOAL
+    goal, owngoal = _goal_masks(batch.type_id)
     team = batch.is_home
     A = goal.shape[1]
     last = (batch.n_actions - 1)[:, None]
@@ -221,20 +228,20 @@ def scores_concedes(
     return scores, concedes
 
 
-@jax.jit
-def vaep_values(
-    batch: AtomicActionBatch, p_scores: jax.Array, p_concedes: jax.Array
+def vaep_core(
+    p_scores: jax.Array,
+    p_concedes: jax.Array,
+    *,
+    type_prev: jax.Array,
+    sameteam: jax.Array,
+    p_scores_prev: jax.Array,
+    p_concedes_prev: jax.Array,
 ) -> jax.Array:
-    """Atomic VAEP values ``(G, A, 3)``: no phase cutoff, no priors."""
-    A = batch.type_id.shape[1]
-    prev = jnp.maximum(jnp.arange(A) - 1, 0)
-
-    type_prev = batch.type_id[:, prev]
-    sameteam = batch.is_home[:, prev] == batch.is_home
-    p_scores_prev = p_scores[:, prev]
-    p_concedes_prev = p_concedes[:, prev]
-
-    prevgoal = (type_prev == atomicconfig.GOAL) | (type_prev == atomicconfig.OWNGOAL)
+    """The atomic formula given explicit lag-1 views (single source of
+    truth shared with the sequence-parallel path; cf.
+    ``ops.formula.vaep_core``)."""
+    goal_prev, owngoal_prev = _goal_masks(type_prev)
+    prevgoal = goal_prev | owngoal_prev
 
     prev_scores = jnp.where(sameteam, p_scores_prev, p_concedes_prev)
     prev_scores = jnp.where(prevgoal, 0.0, prev_scores)
@@ -244,3 +251,20 @@ def vaep_values(
     offensive = p_scores - prev_scores
     defensive = -(p_concedes - prev_concedes)
     return jnp.stack([offensive, defensive, offensive + defensive], axis=-1)
+
+
+@jax.jit
+def vaep_values(
+    batch: AtomicActionBatch, p_scores: jax.Array, p_concedes: jax.Array
+) -> jax.Array:
+    """Atomic VAEP values ``(G, A, 3)``: no phase cutoff, no priors."""
+    A = batch.type_id.shape[1]
+    prev = jnp.maximum(jnp.arange(A) - 1, 0)
+    return vaep_core(
+        p_scores,
+        p_concedes,
+        type_prev=batch.type_id[:, prev],
+        sameteam=batch.is_home[:, prev] == batch.is_home,
+        p_scores_prev=p_scores[:, prev],
+        p_concedes_prev=p_concedes[:, prev],
+    )

@@ -7,7 +7,10 @@ for when that assumption breaks (arbitrarily long tracking/event streams,
 or more devices than games): the `(G, A)` batch is sharded over a
 ``(games, seq)`` mesh and every kernel runs shard-local with **halo
 exchange**, the action-stream analog of ring attention — communication
-cost is O(halo), not O(sequence).
+cost is O(halo), not O(sequence). Both action families are supported:
+standard SPADL (:class:`~socceraction_tpu.core.batch.ActionBatch`) and
+Atomic-SPADL (:class:`~socceraction_tpu.core.batch.AtomicActionBatch`),
+dispatched on the batch type.
 
 Why it decomposes: every cross-action dependence in the valuation stack
 is bounded (SURVEY §5 "Long-context"):
@@ -21,10 +24,10 @@ is bounded (SURVEY §5 "Long-context"):
 
 So each shard pulls ``HL = k-1`` columns from its left neighbor (none at
 ``k = 1``) and ``HR = nr_actions-1`` from its right neighbor via
-``ppermute`` over ICI, the stateless feature kernels run unchanged on the extended local
-view, and the three sequence-global quantities (goalscore prefix, the
-game's first-action team, the per-game last-valid-row clamp) are
-reconstructed from one tiny collective each. Numerical results are
+``ppermute`` over ICI, the stateless feature kernels run unchanged on the
+extended local view, and the three sequence-global quantities (goalscore
+prefix, the game's first-action team, the per-game last-valid-row clamp)
+are reconstructed from one tiny collective each. Numerical results are
 asserted identical to the unsharded kernels in
 ``tests/test_sequence_parallel.py``.
 """
@@ -32,14 +35,13 @@ asserted identical to the unsharded kernels in
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.batch import ActionBatch
-from ..ops.features import KERNELS, _States
+from ..core.batch import ActionBatch, AtomicActionBatch
 
 __all__ = [
     'make_sequence_mesh',
@@ -50,11 +52,110 @@ __all__ = [
     'sequence_rate',
 ]
 
-_SEQ_FIELDS = (
-    'type_id', 'result_id', 'bodypart_id', 'period_id', 'is_home',
-    'time_seconds', 'start_x', 'start_y', 'end_x', 'end_y', 'mask',
-    'row_index',
-)
+
+# ------------------------------------------------------------- families ----
+
+
+class _Family(NamedTuple):
+    """Everything family-specific the sequence kernels need.
+
+    ``formula`` takes ``(get, lag, p_scores, p_concedes, psp, pcp)`` where
+    ``get(field)`` returns the local column and ``lag(field)`` its lag-1
+    view (halo-fed), and must flow through the family's ``vaep_core`` so
+    sharded and unsharded formulas cannot diverge.
+    """
+
+    name: str
+    batch_cls: type
+    seq_fields: Tuple[str, ...]  # every (G, A) field of the batch
+    state_fields: Tuple[str, ...]  # the subset the state views consume
+    make_states: Callable[[Any, int], Any]
+    kernels: Dict[str, Callable]
+    goal_masks: Callable[[Any], Tuple[jax.Array, jax.Array]]  # batch -> (goals, owngoals)
+    formula: Callable
+
+
+def _standard_formula(get, lag, ps, pc, psp, pcp):
+    from ..ops.formula import vaep_core
+
+    return vaep_core(
+        get('type_id'),
+        get('time_seconds'),
+        ps,
+        pc,
+        type_prev=lag('type_id'),
+        result_prev=lag('result_id'),
+        sameteam=lag('is_home') == get('is_home'),
+        time_prev=lag('time_seconds'),
+        p_scores_prev=psp,
+        p_concedes_prev=pcp,
+    )
+
+
+def _atomic_formula(get, lag, ps, pc, psp, pcp):
+    from ..ops.atomic import vaep_core
+
+    return vaep_core(
+        ps,
+        pc,
+        type_prev=lag('type_id'),
+        sameteam=lag('is_home') == get('is_home'),
+        p_scores_prev=psp,
+        p_concedes_prev=pcp,
+    )
+
+
+@functools.cache
+def _standard_family() -> _Family:
+    from ..ops.features import KERNELS, _States
+    from ..ops.labels import _goal_masks
+
+    seq = (
+        'type_id', 'result_id', 'bodypart_id', 'period_id', 'is_home',
+        'time_seconds', 'start_x', 'start_y', 'end_x', 'end_y', 'mask',
+        'row_index',
+    )
+    return _Family(
+        name='standard',
+        batch_cls=ActionBatch,
+        seq_fields=seq,
+        state_fields=tuple(f for f in seq if f not in ('mask', 'row_index')),
+        make_states=_States,
+        kernels=KERNELS,
+        goal_masks=lambda b: _goal_masks(b.type_id, b.result_id),
+        formula=_standard_formula,
+    )
+
+
+@functools.cache
+def _atomic_family() -> _Family:
+    from ..ops.atomic import ATOMIC_KERNELS, _AtomicStates, _goal_masks
+
+    seq = (
+        'type_id', 'bodypart_id', 'period_id', 'is_home', 'time_seconds',
+        'x', 'y', 'dx', 'dy', 'mask', 'row_index',
+    )
+    return _Family(
+        name='atomic',
+        batch_cls=AtomicActionBatch,
+        seq_fields=seq,
+        state_fields=tuple(f for f in seq if f not in ('mask', 'row_index')),
+        make_states=_AtomicStates,
+        kernels=ATOMIC_KERNELS,
+        goal_masks=lambda b: _goal_masks(b.type_id),
+        formula=_atomic_formula,
+    )
+
+
+def _family_of(batch: Any) -> _Family:
+    if isinstance(batch, AtomicActionBatch):
+        return _atomic_family()
+    if isinstance(batch, ActionBatch):
+        return _standard_family()
+    raise TypeError(f'not an action batch: {type(batch).__name__}')
+
+
+# ----------------------------------------------------------------- mesh ----
 
 
 def make_sequence_mesh(n_devices: int = None, seq_parallel: int = 2) -> Mesh:
@@ -69,16 +170,18 @@ def make_sequence_mesh(n_devices: int = None, seq_parallel: int = 2) -> Mesh:
     return Mesh(arr, axis_names=('games', 'seq'))
 
 
-def shard_batch_seq(batch: ActionBatch, mesh: Mesh) -> ActionBatch:
+def shard_batch_seq(batch: Any, mesh: Mesh) -> Any:
     """Place a batch with games over ``'games'`` AND actions over ``'seq'``.
 
-    The action axis must divide by the ``'seq'`` axis size (pad with
+    Accepts standard and atomic batches. The action axis must divide by
+    the ``'seq'`` axis size (pad with
     :func:`~socceraction_tpu.core.batch.pad_length` / ``max_actions`` at
     pack time); the game axis is padded like
     :func:`~socceraction_tpu.parallel.mesh.shard_batch`.
     """
     from .mesh import pad_games
 
+    fam = _family_of(batch)
     batch = pad_games(batch, mesh.shape['games'])
     if batch.max_actions % mesh.shape['seq'] != 0:
         raise ValueError(
@@ -89,14 +192,22 @@ def shard_batch_seq(batch: ActionBatch, mesh: Mesh) -> ActionBatch:
     game_sh = NamedSharding(mesh, P('games'))
 
     def place(name, x):
-        return jax.device_put(x, seq_sh if name in _SEQ_FIELDS else game_sh)
+        return jax.device_put(x, seq_sh if name in fam.seq_fields else game_sh)
 
-    return ActionBatch(
+    return fam.batch_cls(
         **{
             name: place(name, getattr(batch, name))
-            for name in (*_SEQ_FIELDS, 'n_actions', 'game_id')
+            for name in (*fam.seq_fields, 'n_actions', 'game_id')
         }
     )
+
+
+def _batch_specs(fam: _Family) -> Any:
+    """PartitionSpec pytree for a sequence-sharded batch of ``fam``."""
+    specs = {f: P('games', 'seq') for f in fam.seq_fields}
+    specs['n_actions'] = P('games')
+    specs['game_id'] = P('games')
+    return fam.batch_cls(**specs)
 
 
 # ---------------------------------------------------------------- halos ----
@@ -148,18 +259,17 @@ def _extend(x: jax.Array, hl: int, hr: int, axis_name: str) -> jax.Array:
     return jnp.concatenate(parts, axis=1)
 
 
-#: The fields the per-state views (`ops.features._States`) actually read;
-#: ``mask``/``row_index`` are never consumed from an extended view, so
-#: exchanging their halos would be pure wasted ICI traffic.
-_STATE_FIELDS = tuple(f for f in _SEQ_FIELDS if f not in ('mask', 'row_index'))
+def _extended_batch(fam: _Family, batch: Any, hl: int, hr: int, axis_name: str) -> Any:
+    """Local batch whose state fields carry ``hl``/``hr`` halo columns.
 
-
-def _extended_batch(batch: ActionBatch, hl: int, hr: int, axis_name: str) -> ActionBatch:
-    """Local batch whose action axis carries ``hl``/``hr`` halo columns."""
+    Only ``fam.state_fields`` are exchanged — ``mask``/``row_index`` are
+    never read from an extended view, so their halos would be pure wasted
+    ICI traffic.
+    """
     return batch.replace(
         **{
             f: _extend(getattr(batch, f), hl, hr, axis_name)
-            for f in _STATE_FIELDS
+            for f in fam.state_fields
         }
     )
 
@@ -167,18 +277,16 @@ def _extended_batch(batch: ActionBatch, hl: int, hr: int, axis_name: str) -> Act
 # ----------------------------------------------------------- goalscore ----
 
 
-def _goalscore_seq(batch: ActionBatch, axis_name: str) -> jax.Array:
+def _goalscore_seq(fam: _Family, batch: Any, axis_name: str) -> jax.Array:
     """Cross-shard ``goalscore`` block: local cumsum + exclusive shard scan.
 
-    Mirrors ``ops.features._goalscore`` exactly, with the two global
-    quantities rebuilt from collectives: the game's first-action team
-    (column 0 of shard 0, via ``all_gather``) and the pre-shard goal
+    Mirrors the family's ``_goalscore`` kernel exactly, with the two
+    global quantities rebuilt from collectives: the game's first-action
+    team (column 0 of shard 0, via ``all_gather``) and the pre-shard goal
     prefix (exclusive scan of per-shard counts).
     """
-    from ..ops.labels import _goal_masks
-
     team = batch.is_home
-    goals, owngoals = _goal_masks(batch.type_id, batch.result_id)
+    goals, owngoals = fam.goal_masks(batch)
 
     # team "A" = team of the game's FIRST action = shard 0's column 0
     firsts = jax.lax.all_gather(team[:, 0], axis_name)  # (n_seq, G)
@@ -205,33 +313,33 @@ def _goalscore_seq(batch: ActionBatch, axis_name: str) -> jax.Array:
 
 
 def sequence_features(
-    batch: ActionBatch, mesh: Mesh, *, names: Tuple[str, ...], k: int
+    batch: Any, mesh: Mesh, *, names: Tuple[str, ...], k: int
 ) -> jax.Array:
     """``(G, A, F)`` features with the action axis sharded over ``'seq'``.
 
-    Identical values to
-    :func:`socceraction_tpu.ops.features.compute_features` on the
-    unsharded batch; communication is one ``HL``-column halo exchange
-    plus goalscore's scalar collectives.
+    Identical values to the family's unsharded ``compute_features``;
+    communication is one ``HL``-column halo exchange plus goalscore's
+    scalar collectives.
     """
+    fam = _family_of(batch)
     hl = max(k - 1, 0)
 
-    def local(b: ActionBatch) -> jax.Array:
-        ext = _extended_batch(b, hl, 0, 'seq')
-        s = _States(ext, k)
+    def local(b) -> jax.Array:
+        ext = _extended_batch(fam, b, hl, 0, 'seq')
+        s = fam.make_states(ext, k)
         blocks = []
         for name in names:
             if name == 'goalscore':
-                blocks.append(_goalscore_seq(b, 'seq'))
+                blocks.append(_goalscore_seq(fam, b, 'seq'))
             else:
-                blocks.append(KERNELS[name](s)[:, hl:])
+                blocks.append(fam.kernels[name](s)[:, hl:])
         return jnp.concatenate(blocks, axis=-1)
 
     fn = jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(_batch_specs(),),
+            in_specs=(_batch_specs(fam),),
             out_specs=P('games', 'seq', None),
         )
     )
@@ -239,23 +347,22 @@ def sequence_features(
 
 
 def sequence_labels(
-    batch: ActionBatch, mesh: Mesh, *, nr_actions: int = 10
+    batch: Any, mesh: Mesh, *, nr_actions: int = 10
 ) -> Tuple[jax.Array, jax.Array]:
     """``scores``/``concedes`` labels with the action axis sharded.
 
-    Identical values to :func:`socceraction_tpu.ops.labels.scores_concedes`
-    on valid rows (padded rows carry arbitrary values on both paths). The
+    Identical values to the family's unsharded ``scores_concedes`` on
+    valid rows (padded rows carry arbitrary values on both paths). The
     per-game tail clamp (``min(j + i, last_valid)``) is evaluated in local
     coordinates: shards left of the clamp gather true neighbor values from
     the right halo, the shard containing it clamps exactly, and shards
     past it hold only padding.
     """
-    from ..ops.labels import _goal_masks
-
+    fam = _family_of(batch)
     hr = nr_actions - 1
 
-    def local(b: ActionBatch) -> Tuple[jax.Array, jax.Array]:
-        goal, owngoal = _goal_masks(b.type_id, b.result_id)
+    def local(b) -> Tuple[jax.Array, jax.Array]:
+        goal, owngoal = fam.goal_masks(b)
         team = b.is_home
         goal_e = _extend(goal, 0, hr, 'seq')
         owngoal_e = _extend(owngoal, 0, hr, 'seq')
@@ -283,7 +390,7 @@ def sequence_labels(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(_batch_specs(),),
+            in_specs=(_batch_specs(fam),),
             out_specs=(P('games', 'seq'), P('games', 'seq')),
         )
     )
@@ -291,67 +398,64 @@ def sequence_labels(
 
 
 def sequence_values(
-    batch: ActionBatch, p_scores: jax.Array, p_concedes: jax.Array, mesh: Mesh
+    batch: Any, p_scores: jax.Array, p_concedes: jax.Array, mesh: Mesh
 ) -> jax.Array:
     """``(G, A, 3)`` VAEP values with the action axis sharded.
 
-    Identical to :func:`socceraction_tpu.ops.formula.vaep_values` — both
-    flow through :func:`socceraction_tpu.ops.formula.vaep_core`; the
-    lag-1 dependence needs a single-column left halo on six arrays.
+    Identical to the family's unsharded ``vaep_values`` — both flow
+    through the family's ``vaep_core``; the lag-1 dependence needs a
+    single-column left halo.
     """
-    from ..ops.formula import vaep_core
+    fam = _family_of(batch)
 
-    def local(b: ActionBatch, ps: jax.Array, pc: jax.Array) -> jax.Array:
-        def lag(cur):
+    def local(b, ps: jax.Array, pc: jax.Array) -> jax.Array:
+        def lag_arr(cur):
             halo = _left_halo(cur, 1, 'seq')
             return jnp.concatenate([halo, cur[:, :-1]], axis=1)
 
-        return vaep_core(
-            b.type_id,
-            b.time_seconds,
+        return fam.formula(
+            lambda f: getattr(b, f),
+            lambda f: lag_arr(getattr(b, f)),
             ps,
             pc,
-            type_prev=lag(b.type_id),
-            result_prev=lag(b.result_id),
-            sameteam=lag(b.is_home) == b.is_home,
-            time_prev=lag(b.time_seconds),
-            p_scores_prev=lag(ps),
-            p_concedes_prev=lag(pc),
+            lag_arr(ps),
+            lag_arr(pc),
         )
 
     fn = jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(_batch_specs(), P('games', 'seq'), P('games', 'seq')),
+            in_specs=(_batch_specs(fam), P('games', 'seq'), P('games', 'seq')),
             out_specs=P('games', 'seq', None),
         )
     )
     return fn(batch, p_scores, p_concedes)
 
 
-def sequence_rate(model, batch: ActionBatch, mesh: Mesh) -> jax.Array:
+def sequence_rate(model, batch: Any, mesh: Mesh) -> jax.Array:
     """``(G, A, 3)`` VAEP values with the action axis sharded end-to-end.
 
     The sequence-parallel twin of ``VAEP.rate_batch`` /
-    :func:`~socceraction_tpu.parallel.vaep.sharded_rate`: the fused
-    combined-table forward (:mod:`socceraction_tpu.ops.fused`) runs on
-    each shard's halo-extended view — probabilities for the ``k-1`` halo
-    columns come out of the same forward pass, so the formula's lag-1
-    needs no second collective — and only the bounded halos ever cross
-    ICI. ``model`` is a fitted VAEP (or AtomicVAEP) with MLP heads.
+    :func:`~socceraction_tpu.parallel.vaep.sharded_rate` for both
+    families: the fused combined-table forward
+    (:mod:`socceraction_tpu.ops.fused`) runs on each shard's
+    halo-extended view — probabilities for the halo columns come out of
+    the same forward pass, so the formula's lag-1 needs no second
+    collective — and only the bounded halos ever cross ICI. ``model`` is
+    a fitted VAEP or AtomicVAEP with MLP heads.
     """
     from ..ops.fused import REGISTRIES, fused_mlp_logits
 
+    fam = _family_of(batch)
     if not model._can_fuse():
         raise ValueError(
             "sequence_rate needs fitted on-device MLP heads (learner='mlp')"
         )
-    if model._fused_registry != 'standard':
-        raise NotImplementedError(
-            'sequence_rate implements the standard SPADL formula; the '
-            'atomic formula has different lag semantics (use the game-'
-            'sharded sharded_rate for AtomicVAEP)'
+    if model._fused_registry != fam.name:
+        raise ValueError(
+            f'model feature family {model._fused_registry!r} does not match '
+            f'the batch family {fam.name!r}'
         )
     clf_s, clf_c = (model._models[c] for c in model._label_columns)
     names = model._kernel_names()
@@ -361,8 +465,8 @@ def sequence_rate(model, batch: ActionBatch, mesh: Mesh) -> jax.Array:
     # needs its k-1 lookback states, so the halo is k columns wide
     hl = k
 
-    def local(b: ActionBatch) -> jax.Array:
-        ext = _extended_batch(b, hl, 0, 'seq')
+    def local(b) -> jax.Array:
+        ext = _extended_batch(fam, b, hl, 0, 'seq')
 
         # goalscore is the one dense block with whole-sequence dependence
         # (running-score prefix): inject the cross-shard-corrected values,
@@ -370,7 +474,7 @@ def sequence_rate(model, batch: ActionBatch, mesh: Mesh) -> jax.Array:
         # kernel would compute
         overrides = None
         if 'goalscore' in names:
-            gs = _goalscore_seq(b, 'seq')  # (G, A_loc, 3), corrected
+            gs = _goalscore_seq(fam, b, 'seq')  # (G, A_loc, 3), corrected
             gs_ext = jnp.stack(
                 [_extend(gs[..., c], hl, 0, 'seq') for c in range(gs.shape[-1])],
                 axis=-1,
@@ -388,43 +492,28 @@ def sequence_rate(model, batch: ActionBatch, mesh: Mesh) -> jax.Array:
 
         ps_e, pc_e = probs(clf_s), probs(clf_c)
 
-        from ..ops.formula import vaep_core
-
         # lag-1 views: local column j's predecessor is extended column
         # hl + j - 1 (the halo supplies j = 0's)
-        def lag(x_ext):
+        def lag_ext(x_ext):
             return jax.lax.slice_in_dim(
                 x_ext, hl - 1, hl - 1 + b.type_id.shape[1], axis=1
             )
 
-        return vaep_core(
-            b.type_id,
-            b.time_seconds,
+        return fam.formula(
+            lambda f: getattr(b, f),
+            lambda f: lag_ext(getattr(ext, f)),
             ps_e[:, hl:],
             pc_e[:, hl:],
-            type_prev=lag(ext.type_id),
-            result_prev=lag(ext.result_id),
-            sameteam=lag(ext.is_home) == b.is_home,
-            time_prev=lag(ext.time_seconds),
-            p_scores_prev=lag(ps_e),
-            p_concedes_prev=lag(pc_e),
+            lag_ext(ps_e),
+            lag_ext(pc_e),
         )
 
     fn = jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(_batch_specs(),),
+            in_specs=(_batch_specs(fam),),
             out_specs=P('games', 'seq', None),
         )
     )
     return fn(batch)
-
-
-@functools.cache
-def _batch_specs() -> ActionBatch:
-    """PartitionSpec pytree for a sequence-sharded ActionBatch."""
-    specs = {f: P('games', 'seq') for f in _SEQ_FIELDS}
-    specs['n_actions'] = P('games')
-    specs['game_id'] = P('games')
-    return ActionBatch(**specs)

@@ -152,6 +152,139 @@ def test_sequence_rate_rejects_tree_heads(batch, sharded, mesh):
         sequence_rate(model, sharded, mesh)
 
 
+# ----------------------------------------------------------- atomic ------
+
+_ATOMIC_NAMES = (
+    'actiontype_onehot',
+    'bodypart_onehot',
+    'time',
+    'team',
+    'time_delta',
+    'location',
+    'polar',
+    'movement_polar',
+    'direction',
+    'goalscore',
+)
+
+
+@pytest.fixture(scope='module')
+def atomic_batch():
+    from socceraction_tpu.atomic.spadl import convert_to_atomic
+    from socceraction_tpu.core.batch import pack_atomic_actions
+
+    frames = [
+        convert_to_atomic(
+            synthetic_actions_frame(game_id=1000 + g, n_actions=400 + 40 * g, seed=g)
+        )
+        for g in range(2)
+    ]
+    df = pd.concat(frames, ignore_index=True)
+    b, _ = pack_atomic_actions(
+        df, home_team_ids={g: 100 for g in df['game_id'].unique()},
+        max_actions=1024,
+    )
+    return b
+
+
+@pytest.fixture(scope='module')
+def atomic_sharded(atomic_batch, mesh):
+    return shard_batch_seq(atomic_batch, mesh)
+
+
+def test_atomic_sequence_features_match_unsharded(atomic_batch, atomic_sharded, mesh):
+    from socceraction_tpu.ops import atomic as atomic_ops
+
+    ref = atomic_ops.compute_features(atomic_batch, names=_ATOMIC_NAMES, k=3)
+    out = sequence_features(atomic_sharded, mesh, names=_ATOMIC_NAMES, k=3)
+    mask = np.asarray(atomic_batch.mask)
+    np.testing.assert_allclose(
+        np.asarray(out)[mask], np.asarray(ref)[mask], rtol=0, atol=0
+    )
+
+
+def test_atomic_sequence_labels_match_unsharded(atomic_batch, atomic_sharded, mesh):
+    from socceraction_tpu.ops import atomic as atomic_ops
+
+    ref_s, ref_c = atomic_ops.scores_concedes(atomic_batch)
+    out_s, out_c = sequence_labels(atomic_sharded, mesh)
+    mask = np.asarray(atomic_batch.mask)
+    np.testing.assert_array_equal(np.asarray(out_s)[mask], np.asarray(ref_s)[mask])
+    np.testing.assert_array_equal(np.asarray(out_c)[mask], np.asarray(ref_c)[mask])
+
+
+def test_atomic_sequence_rate_matches_rate_batch(atomic_batch, atomic_sharded, mesh):
+    from socceraction_tpu.atomic.spadl import convert_to_atomic
+    from socceraction_tpu.atomic.vaep import AtomicVAEP
+    from socceraction_tpu.parallel.sequence import sequence_rate
+
+    model = AtomicVAEP(backend='jax', nb_prev_actions=3)
+    games = pd.DataFrame({'game_id': [1000, 1001], 'home_team_id': [100, 100]})
+    frames = {
+        gid: convert_to_atomic(
+            synthetic_actions_frame(game_id=gid, n_actions=400 + 40 * i, seed=i)
+        )
+        for i, gid in enumerate([1000, 1001])
+    }
+    X = pd.concat(
+        [model.compute_features(g, frames[g.game_id]) for g in games.itertuples()]
+    )
+    y = pd.concat(
+        [model.compute_labels(g, frames[g.game_id]) for g in games.itertuples()]
+    )
+    model.fit(X, y, learner='mlp', tree_params=dict(max_epochs=2))
+
+    ref = model.rate_batch(atomic_batch)
+    out = sequence_rate(model, atomic_sharded, mesh)
+    mask = np.asarray(atomic_batch.mask)
+    np.testing.assert_allclose(
+        np.asarray(out)[mask], np.asarray(ref)[mask], rtol=1e-6, atol=1e-6
+    )
+
+
+def test_atomic_sequence_values_match_unsharded(atomic_batch, atomic_sharded, mesh):
+    """The atomic formula dispatch (sequence_values path), not just rate."""
+    from socceraction_tpu.ops import atomic as atomic_ops
+
+    rng = np.random.default_rng(3)
+    ps = rng.uniform(size=atomic_batch.type_id.shape).astype(np.float32)
+    pc = rng.uniform(size=atomic_batch.type_id.shape).astype(np.float32)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P('games', 'seq'))
+    ref = atomic_ops.vaep_values(atomic_batch, jnp.asarray(ps), jnp.asarray(pc))
+    out = sequence_values(
+        atomic_sharded,
+        jax.device_put(jnp.asarray(ps), sh),
+        jax.device_put(jnp.asarray(pc), sh),
+        mesh,
+    )
+    mask = np.asarray(atomic_batch.mask)
+    np.testing.assert_allclose(
+        np.asarray(out)[mask], np.asarray(ref)[mask], rtol=0, atol=0
+    )
+
+
+def test_sequence_rate_rejects_family_mismatch(atomic_sharded, mesh):
+    """A fused-capable STANDARD model on an ATOMIC batch must hit the
+    family-mismatch check specifically (not an earlier unfitted error)."""
+    from socceraction_tpu.ml.mlp import MLPClassifier
+    from socceraction_tpu.parallel.sequence import sequence_rate
+    from socceraction_tpu.vaep.base import VAEP
+
+    model = VAEP(backend='jax')
+    # minimally 'fitted' MLP heads so _can_fuse() passes and the family
+    # check is the first thing that can fail
+    clf = MLPClassifier(hidden=(4,))
+    clf.params = {'params': {}}
+    clf.mean_ = np.zeros(1, np.float32)
+    clf.std_ = np.ones(1, np.float32)
+    model._models = {'scores': clf, 'concedes': clf}
+    with pytest.raises(ValueError, match='family'):
+        sequence_rate(model, atomic_sharded, mesh)
+
+
 def test_halo_wider_than_shard_raises(mesh):
     """nr_actions-1 > A/seq must fail with the named constraint, not a
     broadcast error from inside ppermute."""
