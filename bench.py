@@ -133,8 +133,11 @@ def bench_impl() -> dict:
         return vaep_values(batch, p_scores, p_concedes)
 
     # ~850k valid actions; materialized feature tensor (G, A, 568) fp32
-    # ≈ 1.9 GB in HBM — the fused path never builds it.
-    n_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_GAMES', 512))
+    # ≈ 1.9 GB in HBM — the fused path never builds it. The CPU-fallback
+    # path (degraded mode when the TPU tunnel is wedged) shrinks the batch
+    # so the child still reports within the parent's deadline.
+    default_games = 512 if platform == 'tpu' else 64
+    n_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_GAMES', default_games))
     batch = synthetic_batch(n_games=n_games, n_actions=1664, seed=1)
     total_actions = int(batch.total_actions)
 
@@ -168,10 +171,15 @@ def bench_impl() -> dict:
     if roof:
         result['roofline_fused'] = roof
 
-    try:
-        result['extra_configs'] = _bench_extra_configs()
-    except Exception as e:  # extras must never sink the headline metric
-        result['extra_configs_error'] = f'{type(e).__name__}: {e}'
+    if platform == 'tpu':
+        try:
+            result['extra_configs'] = _bench_extra_configs()
+        except Exception as e:  # extras must never sink the headline metric
+            result['extra_configs_error'] = f'{type(e).__name__}: {e}'
+    else:
+        result['extra_configs_skipped'] = (
+            'extras run at 3k-game scale and only make sense on the chip'
+        )
     return result
 
 
