@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.batch import ActionBatch
 from ..ml.mlp import MLPClassifier, _MLP
 from ..ops.features import compute_features
+from ..ops.fused import fused_mlp_logits
 from ..ops.labels import scores_concedes
 from .mesh import shard_batch
 
@@ -107,11 +108,21 @@ def make_train_step(
         return params, opt_state
 
     def loss_fn(params, batch: ActionBatch):
-        feats = compute_features(batch, names=names, k=k)
+        # the fused combined-table forward (ops/fused.py) avoids
+        # materializing the (G, A, F) feature tensor in HBM; autodiff
+        # turns the first-layer row gathers into scatter-adds over the
+        # small (T*R*B, H) tables, so the backward pass stays fused too
         ys, yc = scores_concedes(batch, nr_actions=nr_actions)
         mask = batch.mask
-        l_s = _masked_bce(module.apply(params['scores'], feats), ys, mask)
-        l_c = _masked_bce(module.apply(params['concedes'], feats), yc, mask)
+        logits = {
+            head: fused_mlp_logits(
+                params[head], batch, names=names, k=k,
+                hidden_layers=len(hidden),
+            )
+            for head in ('scores', 'concedes')
+        }
+        l_s = _masked_bce(logits['scores'], ys, mask)
+        l_c = _masked_bce(logits['concedes'], yc, mask)
         return l_s + l_c
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))

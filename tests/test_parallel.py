@@ -139,6 +139,46 @@ def test_distributed_train_step_runs(season, model_parallel):
     assert float(loss2) < float(loss1)
 
 
+def test_fused_train_loss_matches_materialized(season):
+    """The fused-forward training loss and its grads equal the
+    materialized-feature form (same computation reordered)."""
+    import jax.numpy as jnp
+    import optax
+
+    from socceraction_tpu.ml.mlp import _MLP
+    from socceraction_tpu.ops.features import compute_features
+    from socceraction_tpu.ops.fused import fused_mlp_logits
+    from socceraction_tpu.ops.labels import scores_concedes
+    from socceraction_tpu.parallel.vaep import _masked_bce
+
+    names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'team')
+    k = 3
+    module = _MLP((16, 16))
+    feats = compute_features(season, names=names, k=k)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, feats.shape[-1])))
+    ys, _ = scores_concedes(season)
+    mask = season.mask
+
+    def loss_mat(p):
+        return _masked_bce(module.apply(p, feats), ys, mask)
+
+    def loss_fused(p):
+        return _masked_bce(
+            fused_mlp_logits(p, season, names=names, k=k, hidden_layers=2),
+            ys,
+            mask,
+        )
+
+    l1, g1 = jax.value_and_grad(loss_mat)(params)
+    l2, g2 = jax.value_and_grad(loss_fused)(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    flat1 = jax.tree.leaves(g1)
+    flat2 = jax.tree.leaves(g2)
+    assert float(optax.global_norm(g1)) > 0
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
 def test_train_distributed_and_sharded_rate(season, season_df):
     mesh = make_mesh()
     names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'team')
