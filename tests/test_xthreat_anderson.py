@@ -1,0 +1,108 @@
+"""Anderson-accelerated xT solving: same fixed point, fewer sweeps.
+
+The sweep is an affine contraction, so Anderson mixing (PAPERS.md's
+accelerated-value-iteration literature) must converge to the plain
+solver's surface; these tests pin the fixed point, the sweep-count win,
+and the API guards.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu import xthreat as xt
+from socceraction_tpu.core.batch import pack_actions
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.ops.xt import (
+    solve_xt,
+    solve_xt_matrix_free,
+    xt_counts,
+    xt_probabilities,
+)
+
+
+@pytest.fixture(scope='module')
+def season():
+    frames = [
+        synthetic_actions_frame(game_id=1000 + g, n_actions=1200, seed=g)
+        for g in range(8)
+    ]
+    df = pd.concat(frames, ignore_index=True)
+    batch, _ = pack_actions(
+        df, home_team_ids={g: 100 for g in df['game_id'].unique()}
+    )
+    return df, batch
+
+
+def test_anderson_dense_matches_plain(season):
+    _, batch = season
+    counts = xt_counts(
+        batch.type_id, batch.result_id,
+        batch.start_x, batch.start_y, batch.end_x, batch.end_y,
+        batch.mask, l=16, w=12,
+    )
+    probs = xt_probabilities(counts, l=16, w=12)
+    grid_plain, it_plain = solve_xt(probs)
+    grid_acc, it_acc = solve_xt(probs, accelerate=True)
+    np.testing.assert_allclose(
+        np.asarray(grid_acc), np.asarray(grid_plain), atol=5e-5
+    )
+    assert int(it_acc) < int(it_plain), (int(it_acc), int(it_plain))
+
+
+def test_anderson_matrix_free_matches_plain(season):
+    _, batch = season
+    args = (
+        batch.type_id, batch.result_id,
+        batch.start_x, batch.start_y, batch.end_x, batch.end_y, batch.mask,
+    )
+    grid_plain, it_plain, *_ = solve_xt_matrix_free(*args, l=24, w=16)
+    grid_acc, it_acc, *_ = solve_xt_matrix_free(*args, l=24, w=16, accelerate=True)
+    np.testing.assert_allclose(
+        np.asarray(grid_acc), np.asarray(grid_plain), atol=5e-5
+    )
+    assert int(it_acc) < int(it_plain), (int(it_acc), int(it_plain))
+
+
+def test_model_level_accelerate(season):
+    df, _ = season
+    ltr = df  # synthetic frames are already orientation-consistent per team
+    plain = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(ltr)
+    acc = xt.ExpectedThreat(l=16, w=12, backend='jax', accelerate=True).fit(ltr)
+    np.testing.assert_allclose(acc.xT, plain.xT, atol=5e-5)
+    assert acc.n_iter < plain.n_iter
+    # ratings flow through the same grid
+    r_plain = plain.rate(ltr)
+    r_acc = acc.rate(ltr)
+    np.testing.assert_allclose(r_acc, r_plain, atol=5e-5, equal_nan=True)
+
+
+def test_accelerate_guards(season):
+    df, _ = season
+    with pytest.raises(ValueError, match='JAX-backend'):
+        xt.ExpectedThreat(backend='pandas', accelerate=True)
+    with pytest.raises(ValueError, match='Picard'):
+        xt.ExpectedThreat(backend='jax', accelerate=True, keep_heatmaps=True)
+    # attributes are public and mutable: the guard must also hold at fit
+    # time, not just in __init__ (codebase convention, xthreat.py)
+    model = xt.ExpectedThreat(backend='jax', accelerate=True)
+    model.keep_heatmaps = True
+    with pytest.raises(ValueError, match='Picard'):
+        model.fit(df)
+    model2 = xt.ExpectedThreat(backend='jax', accelerate=True)
+    model2.backend = 'pandas'
+    with pytest.raises(ValueError, match='JAX-backend'):
+        model2.fit(df)
+
+
+def test_anderson_respects_max_iter(season):
+    """n_sweeps must never exceed max_iter (bench relies on this)."""
+    _, batch = season
+    counts = xt_counts(
+        batch.type_id, batch.result_id,
+        batch.start_x, batch.start_y, batch.end_x, batch.end_y,
+        batch.mask, l=16, w=12,
+    )
+    probs = xt_probabilities(counts, l=16, w=12)
+    _, it = solve_xt(probs, eps=0.0, max_iter=7, accelerate=True)
+    assert int(it) == 7
