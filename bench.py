@@ -252,6 +252,22 @@ def _bench_extra_configs() -> dict:
         'sweep_iters_per_sec': round(n_iters_mf / dt_mf, 1),
     }
 
+    # converged fine-grid fit with Anderson acceleration (opt-in solver;
+    # same fixed point, fewer sweeps — ops/xt.py:_value_iteration_anderson)
+    mf_acc = jax.jit(
+        functools.partial(
+            solve_xt_matrix_free, l=192, w=125, eps=1e-5, max_iter=100,
+            accelerate=True,
+        )
+    )
+    dt_acc = _measure(mf_acc, xt_args, n_iters=3)
+    out['xt_fit_192x125_anderson_converged'] = {
+        'games': 3072,
+        'eps': 1e-5,
+        'seconds_per_fit': round(dt_acc, 4),
+        'sweeps': int(mf_acc(*xt_args)[1]),
+    }
+
     # --- fused VAEP MLP train step (BASELINE config 5's kernel) -----------
     from socceraction_tpu.parallel import make_mesh, make_train_step, shard_batch
 
