@@ -261,11 +261,15 @@ def _bench_extra_configs() -> dict:
         )
     )
     dt_acc = _measure(mf_acc, xt_args, n_iters=3)
+    sweeps_acc = int(mf_acc(*xt_args)[1])
     out['xt_fit_192x125_anderson_converged'] = {
         'games': 3072,
         'eps': 1e-5,
         'seconds_per_fit': round(dt_acc, 4),
-        'sweeps': int(mf_acc(*xt_args)[1]),
+        'sweeps': sweeps_acc,
+        # sweeps == max_iter means the cap exited the loop, not eps —
+        # then this is NOT a converged-cost measurement
+        'converged': sweeps_acc < 100,
     }
 
     # --- fused VAEP MLP train step (BASELINE config 5's kernel) -----------

@@ -168,6 +168,23 @@ def move_transition_matrix(actions: pd.DataFrame, l: int = N, w: int = M) -> np.
     return _safe_divide(counts.astype(np.float64), start_counts[:, None])
 
 
+def _validate_accelerate(accelerate: bool, backend: str, keep_heatmaps: bool) -> None:
+    """Shared by ``__init__`` and ``fit`` (public attributes are mutable)."""
+    if not accelerate:
+        return
+    if backend != 'jax':
+        raise ValueError(
+            'accelerate=True (Anderson-accelerated value iteration) is a '
+            "JAX-backend feature; the pandas backend keeps the reference's "
+            'plain iteration'
+        )
+    if keep_heatmaps:
+        raise ValueError(
+            'keep_heatmaps records the plain Picard iterate sequence; '
+            'Anderson iterates are a different (non-monotone) sequence'
+        )
+
+
 # ---------------------------------------------------------------------------
 # Model class
 # ---------------------------------------------------------------------------
@@ -233,17 +250,7 @@ class ExpectedThreat:
             raise ImportError('JAX backend requested but jax is not importable')
         if solver is not None and solver not in ('dense', 'matrix-free'):
             raise ValueError(f'unknown solver {solver!r}')
-        if accelerate and backend != 'jax':
-            raise ValueError(
-                'accelerate=True (Anderson-accelerated value iteration) is a '
-                "JAX-backend feature; the pandas backend keeps the reference's "
-                'plain iteration'
-            )
-        if accelerate and keep_heatmaps:
-            raise ValueError(
-                'keep_heatmaps records the plain Picard iterate sequence; '
-                'Anderson iterates are a different (non-monotone) sequence'
-            )
+        _validate_accelerate(accelerate, backend, keep_heatmaps)
         self.l = l
         self.w = w
         self.eps = eps
@@ -421,19 +428,7 @@ class ExpectedThreat:
         # keep_heatmaps are plain public attributes that may have been
         # mutated since construction (same rationale as the matrix-free/
         # keep_heatmaps check living in _fit_jax)
-        if self.accelerate:
-            if self.backend != 'jax':
-                raise ValueError(
-                    'accelerate=True (Anderson-accelerated value iteration) '
-                    "is a JAX-backend feature; the pandas backend keeps the "
-                    "reference's plain iteration"
-                )
-            if self.keep_heatmaps:
-                raise ValueError(
-                    'keep_heatmaps records the plain Picard iterate '
-                    'sequence; Anderson iterates are a different '
-                    '(non-monotone) sequence'
-                )
+        _validate_accelerate(self.accelerate, self.backend, self.keep_heatmaps)
         if self.backend == 'jax':
             self._fit_jax(self._as_batch(actions))
         else:

@@ -1,0 +1,40 @@
+"""The example scripts must stay runnable (same contract as the walkthrough).
+
+They are referenced from README as the notebook-equivalent entry points;
+a stale example is a broken front door.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EX = os.path.join(_ROOT, 'examples')
+
+
+@pytest.mark.parametrize(
+    'script, args',
+    [
+        ('run_xt_pipeline.py', []),
+        ('build_xg_model.py', []),
+        ('run_vaep_pipeline.py', ['--learner', 'mlp']),
+        ('run_vaep_pipeline.py', ['--atomic', '--learner', 'mlp']),
+    ],
+)
+def test_example_runs(script, args, tmp_path):
+    if 'run_vaep_pipeline' in script:
+        args = args + ['--store', str(tmp_path / 'store')]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_EX, script)] + args,
+        capture_output=True,
+        text=True,
+        timeout=560,
+        cwd=_ROOT,
+    )
+    assert proc.returncode == 0, (
+        f'{script} {args} failed:\n{proc.stdout[-2500:]}\n{proc.stderr[-2500:]}'
+    )
