@@ -171,6 +171,11 @@ def bench_impl() -> dict:
     if roof:
         result['roofline_fused'] = roof
 
+    # Emit the headline NOW, before the slow extra configs: if the extras
+    # overrun the parent's child deadline, the parent salvages this line
+    # from the abandoned child's log instead of degrading to CPU.
+    print(json.dumps({**result, 'extra_configs_pending': True}), flush=True)
+
     if platform == 'tpu':
         try:
             result['extra_configs'] = _bench_extra_configs()
@@ -379,6 +384,18 @@ def main() -> None:
             print(json.dumps(result))
             return
         if rc is None:
+            if result is not None:
+                # the child emitted the headline before the slow extras
+                # overran the deadline: report it rather than degrading
+                result.pop('extra_configs_pending', None)
+                result['extra_configs_error'] = (
+                    f'extras exceeded the {deadline_s:.0f}s child deadline '
+                    '(headline salvaged from the abandoned child)'
+                )
+                if diagnostics:
+                    result['diagnostics'] = diagnostics
+                print(json.dumps(result))
+                return
             diagnostics.append(
                 f'attempt {attempt + 1}: child exceeded {deadline_s:.0f}s '
                 '(abandoned, not killed); tail: ' + tail[-300:].replace('\n', ' | ')
