@@ -59,6 +59,7 @@ def iter_batches(
     float_dtype: Any = 'float32',
     device: Optional[Any] = None,
     drop_remainder: bool = False,
+    prefetch: int = 0,
 ) -> Iterator[Tuple[ActionBatch, List[Any]]]:
     """Stream the store in fixed-size game chunks.
 
@@ -66,23 +67,61 @@ def iter_batches(
     ``(games_per_batch, max_actions)`` device shapes so a jitted consumer
     compiles exactly once; ``drop_remainder`` skips the final short chunk
     to keep the game axis static too.
+
+    ``prefetch > 0`` reads and packs up to that many chunks ahead on a
+    background thread (bounded queue): host IO/packing then overlaps the
+    consumer even when it *blocks* on device results — JAX's async
+    dispatch alone only overlaps while the consumer returns promptly.
+    ``prefetch=2`` is classic double buffering into HBM (SURVEY §7's
+    streaming loader).
     """
     if game_ids is None:
         game_ids = store.game_ids()
     home = _home_team_ids(store)
-    for lo in range(0, len(game_ids), games_per_batch):
-        chunk = list(game_ids[lo : lo + games_per_batch])
-        if drop_remainder and len(chunk) < games_per_batch:
+
+    def produce() -> Iterator[Tuple[ActionBatch, List[Any]]]:
+        for lo in range(0, len(game_ids), games_per_batch):
+            chunk = list(game_ids[lo : lo + games_per_batch])
+            if drop_remainder and len(chunk) < games_per_batch:
+                return
+            with timed('pipeline/read_actions'):
+                actions = pd.concat(
+                    [store.get_actions(gid) for gid in chunk], ignore_index=True
+                )
+            with timed('pipeline/pack'):
+                yield pack_actions(
+                    actions,
+                    {gid: home[gid] for gid in chunk},
+                    max_actions=max_actions,
+                    float_dtype=float_dtype,
+                    device=device,
+                )
+
+    if prefetch <= 0:
+        yield from produce()
+        return
+
+    import queue
+    import threading
+
+    q: 'queue.Queue' = queue.Queue(maxsize=prefetch)
+    _END = object()
+    failure: List[BaseException] = []
+
+    def worker() -> None:
+        try:
+            for item in produce():
+                q.put(item)
+        except BaseException as e:  # re-raised on the consumer thread
+            failure.append(e)
+        finally:
+            q.put(_END)
+
+    threading.Thread(target=worker, daemon=True, name='iter_batches').start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if failure:
+                raise failure[0]
             return
-        with timed('pipeline/read_actions'):
-            actions = pd.concat(
-                [store.get_actions(gid) for gid in chunk], ignore_index=True
-            )
-        with timed('pipeline/pack'):
-            yield pack_actions(
-                actions,
-                {gid: home[gid] for gid in chunk},
-                max_actions=max_actions,
-                float_dtype=float_dtype,
-                device=device,
-            )
+        yield item

@@ -114,6 +114,36 @@ def test_iter_batches_static_shapes(tmp_path, spadl_actions):
         assert [b.n_games for b, _ in chunks] == [2]
         assert all(b.max_actions == 256 for b, _ in chunks)
 
+        # the background-thread prefetcher must yield identical batches in
+        # the same order as the synchronous path
+        pre = list(iter_batches(store, 2, max_actions=256, prefetch=2))
+        assert [ids for _, ids in pre] == [ids for _, ids in list(
+            iter_batches(store, 2, max_actions=256)
+        )]
+        for (b1, _), (b2, _) in zip(pre, iter_batches(store, 2, max_actions=256)):
+            np.testing.assert_array_equal(
+                np.asarray(b1.type_id), np.asarray(b2.type_id)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(b1.row_index), np.asarray(b2.row_index)
+            )
+
+
+def test_iter_batches_prefetch_propagates_errors(tmp_path, spadl_actions):
+    with SeasonStore(str(tmp_path / 'store'), mode='w') as store:
+        df = spadl_actions.copy()
+        df['game_id'] = 1
+        store.put_actions(1, df)
+        store.put(
+            'games', pd.DataFrame([{'game_id': 1, 'home_team_id': 782}])
+        )
+        it = iter_batches(
+            store, 1, max_actions=256, game_ids=[1, 999], prefetch=2
+        )
+        next(it)  # game 1 is fine
+        with pytest.raises(Exception):  # missing game 999 raises on consume
+            list(it)
+
 
 def test_build_on_error_skip(tmp_path):
     loader = StatsBombLoader(getter='local', root=DATA_DIR)
