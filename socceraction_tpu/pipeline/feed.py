@@ -2,9 +2,12 @@
 
 The streaming path (:func:`iter_batches`) reads the next chunk's parquet/
 hdf5 frames and packs them on the host while the device works on the
-current chunk (JAX dispatch is asynchronous, so handing the next batch to a
-jitted consumer overlaps host IO with device compute -- double buffering
-without explicit threads).
+current chunk. With ``prefetch=0`` the overlap comes from JAX's
+asynchronous dispatch alone (the consumer must return promptly); with
+``prefetch > 0`` a background worker thread reads/packs ahead through a
+bounded queue, so the overlap also holds when the consumer blocks on
+device results. The worker is cancelled (stop event + queue drain) when
+the consumer closes the generator early.
 """
 
 from __future__ import annotations
@@ -107,21 +110,44 @@ def iter_batches(
     q: 'queue.Queue' = queue.Queue(maxsize=prefetch)
     _END = object()
     failure: List[BaseException] = []
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up when the consumer signalled stop."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker() -> None:
         try:
             for item in produce():
-                q.put(item)
+                if not _put(item):
+                    return  # consumer closed the generator early
         except BaseException as e:  # re-raised on the consumer thread
             failure.append(e)
         finally:
-            q.put(_END)
+            _put(_END)
 
     threading.Thread(target=worker, daemon=True, name='iter_batches').start()
-    while True:
-        item = q.get()
-        if item is _END:
-            if failure:
-                raise failure[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        # consumer stopped early (break / next() / GeneratorExit): unblock
+        # and retire the worker instead of leaking it (and the packed
+        # device batches it holds) on the full queue
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

@@ -129,6 +129,32 @@ def test_iter_batches_static_shapes(tmp_path, spadl_actions):
             )
 
 
+def test_iter_batches_prefetch_early_exit_retires_worker(tmp_path, spadl_actions):
+    """Breaking out of the loop must not leak a blocked producer thread."""
+    import threading
+    import time
+
+    with SeasonStore(str(tmp_path / 'store'), mode='w') as store:
+        games = []
+        for gid in range(1, 7):
+            df = spadl_actions.copy()
+            df['game_id'] = gid
+            store.put_actions(gid, df)
+            games.append({'game_id': gid, 'home_team_id': 782})
+        store.put('games', pd.DataFrame(games))
+
+        it = iter_batches(store, 1, max_actions=256, prefetch=2)
+        next(it)
+        it.close()  # what a `break` does via GeneratorExit
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            alive = [t for t in threading.enumerate() if t.name == 'iter_batches']
+            if not alive:
+                break
+            time.sleep(0.05)
+        assert not alive, 'prefetch worker thread leaked after early exit'
+
+
 def test_iter_batches_prefetch_propagates_errors(tmp_path, spadl_actions):
     with SeasonStore(str(tmp_path / 'store'), mode='w') as store:
         df = spadl_actions.copy()
