@@ -68,6 +68,7 @@ def sharded_xt_fit(
     w: int = 12,
     eps: float = 1e-5,
     max_iter: int = 1000,
+    accelerate: bool = False,
 ) -> Tuple[jax.Array, XTProbabilities, jax.Array]:
     """Fit xT on a game-sharded batch: psum'd counts, replicated solve.
 
@@ -77,7 +78,7 @@ def sharded_xt_fit(
     """
     counts = sharded_xt_counts(batch, mesh, l=l, w=w)
     probs = xt_probabilities(counts, l=l, w=w)
-    grid, it = solve_xt(probs, eps=eps, max_iter=max_iter)
+    grid, it = solve_xt(probs, eps=eps, max_iter=max_iter, accelerate=accelerate)
     rep = NamedSharding(mesh, P())
     grid = jax.device_put(grid, rep)
     return grid, probs, it
@@ -91,6 +92,7 @@ def sharded_xt_fit_matrix_free(
     w: int,
     eps: float = 1e-5,
     max_iter: int = 1000,
+    accelerate: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fine-grid sharded xT fit: per-shard segment-sums, psum'd sweeps.
 
@@ -119,6 +121,7 @@ def sharded_xt_fit_matrix_free(
             eps=eps,
             max_iter=max_iter,
             axis_name='games',
+            accelerate=accelerate,
         )
         return xT, it
 

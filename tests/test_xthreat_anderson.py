@@ -77,6 +77,35 @@ def test_model_level_accelerate(season):
     np.testing.assert_allclose(r_acc, r_plain, atol=5e-5, equal_nan=True)
 
 
+def test_sharded_anderson_matches_unsharded(season):
+    """Accelerated + sharded: psum'd sweeps inside the Anderson loop must
+    still land on the plain unsharded fixed point."""
+    import jax
+
+    from socceraction_tpu.parallel import (
+        make_mesh,
+        shard_batch,
+        sharded_xt_fit_matrix_free,
+    )
+
+    assert len(jax.devices()) == 8
+    _, batch = season
+    mesh = make_mesh()
+    sharded = shard_batch(batch, mesh)
+    grid_acc, it_acc = sharded_xt_fit_matrix_free(
+        sharded, mesh, l=24, w=16, accelerate=True
+    )
+    ref_grid, ref_it, *_ = solve_xt_matrix_free(
+        batch.type_id, batch.result_id,
+        batch.start_x, batch.start_y, batch.end_x, batch.end_y, batch.mask,
+        l=24, w=16,
+    )
+    np.testing.assert_allclose(
+        np.asarray(grid_acc), np.asarray(ref_grid), atol=5e-5
+    )
+    assert int(it_acc) < int(ref_it)
+
+
 def test_accelerate_guards(season):
     df, _ = season
     with pytest.raises(ValueError, match='JAX-backend'):
