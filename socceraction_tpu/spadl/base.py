@@ -109,3 +109,11 @@ def _add_dribbles(actions: pd.DataFrame) -> pd.DataFrame:
     )
     actions['action_id'] = range(len(actions))
     return actions
+
+
+def _single_event(event) -> pd.DataFrame:
+    """Wrap a per-row ``pd.Series`` (the reference's row-wise API) as a frame.
+
+    Shared by the Wyscout converters' row-wise ``determine_*`` wrappers.
+    """
+    return pd.DataFrame([event]) if isinstance(event, pd.Series) else event

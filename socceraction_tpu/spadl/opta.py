@@ -207,3 +207,22 @@ def _fix_owngoals(actions: pd.DataFrame) -> pd.DataFrame:
     )
     actions.loc[owngoal, 'type_id'] = spadlconfig.actiontypes.index('bad_touch')
     return actions
+
+
+# Deprecated pre-1.2 re-exports (reference ``spadl/opta.py:166-248``): the
+# loader and raw-data schemas moved to :mod:`socceraction_tpu.data.opta`
+# but remain importable here with a DeprecationWarning.
+from ._deprecated import deprecated_reexports as _deprecated_reexports
+
+__getattr__ = _deprecated_reexports(
+    __name__,
+    'socceraction_tpu.data.opta',
+    (
+        'OptaLoader',
+        'OptaCompetitionSchema',
+        'OptaGameSchema',
+        'OptaPlayerSchema',
+        'OptaTeamSchema',
+        'OptaEventSchema',
+    ),
+)

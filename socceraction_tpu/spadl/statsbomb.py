@@ -302,3 +302,24 @@ def convert_to_actions(events: pd.DataFrame, home_team_id) -> pd.DataFrame:
     actions = _add_dribbles(actions)
 
     return SPADLSchema.validate(actions)
+
+
+# Deprecated pre-1.2 re-exports (reference ``spadl/statsbomb.py:325-413``):
+# the loader, ``extract_player_games`` and the raw-data schemas moved to
+# :mod:`socceraction_tpu.data.statsbomb` but remain importable here with a
+# DeprecationWarning.
+from ._deprecated import deprecated_reexports as _deprecated_reexports
+
+__getattr__ = _deprecated_reexports(
+    __name__,
+    'socceraction_tpu.data.statsbomb',
+    (
+        'StatsBombLoader',
+        'extract_player_games',
+        'StatsBombCompetitionSchema',
+        'StatsBombGameSchema',
+        'StatsBombPlayerSchema',
+        'StatsBombTeamSchema',
+        'StatsBombEventSchema',
+    ),
+)

@@ -44,3 +44,11 @@ def play_left_to_right(actions: pd.DataFrame, home_team_id) -> pd.DataFrame:
         A copy with away-team coordinates mirrored in both axes.
     """
     return _fix_direction_of_play(actions.copy(), home_team_id)
+
+
+#: Alias kept for reference compatibility: upstream renamed the canonical
+#: two-argument function to ``play_left_to_right_sa`` when the fork
+#: repurposed the unsuffixed name (reference ``spadl/utils.py:31-57``,
+#: SURVEY.md section 0). Here the unsuffixed name already carries the
+#: canonical semantics, so both names point at the same function.
+play_left_to_right_sa = play_left_to_right

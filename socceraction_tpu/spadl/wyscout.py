@@ -10,8 +10,8 @@ host-side before the frame crosses into the packed tensor pipeline.
 
 Pipeline stages:
 
-1. tag list → boolean tag columns (``_tag_frame``)
-2. positions list → raw start/end coordinates (``_position_columns``)
+1. tag list → boolean tag columns (``get_tagsdf``)
+2. positions list → raw start/end coordinates (``make_new_positions``)
 3. event surgery on the raw (0-100)² Wyscout pitch: shot end-coordinate
    estimation from goal-zone tags, duel rewriting, interception-pass
    splitting, offside attachment, touch & simulation rewriting
@@ -19,6 +19,14 @@ Pipeline stages:
 5. coordinate rescale to 105×68 m (y flipped) + goalkick/foul/keeper-save
    repairs
 6. shared post-processing (direction of play, clearances, dribbles)
+
+Every stage is exported under the reference's public name (``get_tagsdf``,
+``fix_wyscout_events``, ``create_df_actions``, ``fix_actions``, …,
+reference ``spadl/wyscout.py:58-898``) so pipelines written against the
+reference keep working; the per-row ``determine_*`` functions are thin
+wrappers over the columnar decision tables. The deprecated loader/schema
+re-exports (reference ``spadl/wyscout.py:901-991``) are served lazily via
+module ``__getattr__`` with the same :class:`DeprecationWarning`.
 """
 
 from __future__ import annotations
@@ -33,11 +41,54 @@ from .base import (
     _add_dribbles,
     _fix_clearances,
     _fix_direction_of_play,
+    _single_event,
     min_dribble_length,
 )
 from .schema import SPADLSchema
 
-__all__ = ['convert_to_actions']
+__all__ = [
+    'convert_to_actions',
+    'get_tagsdf',
+    'make_new_positions',
+    'fix_wyscout_events',
+    'create_shot_coordinates',
+    'convert_duels',
+    'insert_interception_passes',
+    'add_offside_variable',
+    'convert_touches',
+    'convert_simulations',
+    'create_df_actions',
+    'determine_bodypart_id',
+    'determine_type_id',
+    'determine_result_id',
+    'remove_non_actions',
+    'fix_actions',
+    'fix_goalkick_coordinates',
+    'adjust_goalkick_result',
+    'fix_foul_coordinates',
+    'fix_keeper_save_coordinates',
+    'remove_keeper_goal_actions',
+]
+
+# Deprecated pre-1.2 re-exports (reference ``spadl/wyscout.py:901-991``):
+# the loaders and raw-data schemas moved to
+# :mod:`socceraction_tpu.data.wyscout` but remain importable here with a
+# DeprecationWarning.
+from ._deprecated import deprecated_reexports as _deprecated_reexports
+
+__getattr__ = _deprecated_reexports(
+    __name__,
+    'socceraction_tpu.data.wyscout',
+    (
+        'WyscoutLoader',
+        'PublicWyscoutLoader',
+        'WyscoutCompetitionSchema',
+        'WyscoutGameSchema',
+        'WyscoutPlayerSchema',
+        'WyscoutTeamSchema',
+        'WyscoutEventSchema',
+    ),
+)
 
 #: Wyscout tag id → boolean column name (reference ``spadl/wyscout.py:78-138``).
 WYSCOUT_TAGS: Dict[int, str] = {
@@ -121,16 +172,11 @@ def convert_to_actions(events: pd.DataFrame, home_team_id: int) -> pd.DataFrame:
     pd.DataFrame
         The game's actions in SPADL format.
     """
-    events = pd.concat([events.reset_index(drop=True), _tag_frame(events)], axis=1)
-    events = _position_columns(events)
-    events = _estimate_shot_end_coordinates(events)
-    events = _rewrite_duels(events)
-    events = _split_interception_passes(events)
-    events = _attach_offsides(events)
-    events = _rewrite_touches(events)
-    events = _rewrite_simulations(events)
-    actions = _build_actions(events)
-    actions = _rescale_and_repair(actions)
+    events = pd.concat([events.reset_index(drop=True), get_tagsdf(events)], axis=1)
+    events = make_new_positions(events)
+    events = fix_wyscout_events(events)
+    actions = create_df_actions(events)
+    actions = fix_actions(actions)
     actions = _fix_direction_of_play(actions, home_team_id)
     actions = _fix_clearances(actions)
     actions['action_id'] = range(len(actions))
@@ -138,7 +184,7 @@ def convert_to_actions(events: pd.DataFrame, home_team_id: int) -> pd.DataFrame:
     return SPADLSchema.validate(actions)
 
 
-def _tag_frame(events: pd.DataFrame) -> pd.DataFrame:
+def get_tagsdf(events: pd.DataFrame) -> pd.DataFrame:
     """Expand each event's tag list into one boolean column per known tag."""
     tag_sets: List[Set[int]] = [
         {t['id'] for t in tags} for tags in events['tags']
@@ -152,7 +198,7 @@ def _tag_frame(events: pd.DataFrame) -> pd.DataFrame:
     return pd.DataFrame(data, index=range(len(tag_sets)))
 
 
-def _position_columns(events: pd.DataFrame) -> pd.DataFrame:
+def make_new_positions(events: pd.DataFrame) -> pd.DataFrame:
     """Extract start/end coordinates from each event's ``positions`` list.
 
     Two entries give start and end; a single entry is both; an empty list
@@ -191,7 +237,24 @@ _SHOT_END_ESTIMATES: List[Tuple[List[str], float, float]] = [
 ]
 
 
-def _estimate_shot_end_coordinates(events: pd.DataFrame) -> pd.DataFrame:
+def fix_wyscout_events(df_events: pd.DataFrame) -> pd.DataFrame:
+    """Event surgery on the raw (0-100)² Wyscout pitch.
+
+    Chains the six rewriting stages in the reference's order
+    (``spadl/wyscout.py:184-206``): shot end-coordinate estimation, duel
+    rewriting, interception-pass splitting, offside attachment, touch and
+    simulation rewriting.
+    """
+    df_events = create_shot_coordinates(df_events)
+    df_events = convert_duels(df_events)
+    df_events = insert_interception_passes(df_events)
+    df_events = add_offside_variable(df_events)
+    df_events = convert_touches(df_events)
+    df_events = convert_simulations(df_events)
+    return df_events
+
+
+def create_shot_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     """Estimate shot end coordinates from the goal-zone tags."""
     for columns, end_x, end_y in _SHOT_END_ESTIMATES:
         mask = np.logical_or.reduce([events[c].to_numpy() for c in columns])
@@ -203,7 +266,7 @@ def _estimate_shot_end_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     return events
 
 
-def _rewrite_duels(events: pd.DataFrame) -> pd.DataFrame:
+def convert_duels(events: pd.DataFrame) -> pd.DataFrame:
     """Rewrite duel events (type 1).
 
     A pair of duel rows followed by a ball-out-of-field row (subtype 50) in
@@ -246,7 +309,7 @@ def _rewrite_duels(events: pd.DataFrame) -> pd.DataFrame:
     return events[events['type_id'] != 1].reset_index(drop=True)
 
 
-def _split_interception_passes(events: pd.DataFrame) -> pd.DataFrame:
+def insert_interception_passes(events: pd.DataFrame) -> pd.DataFrame:
     """Split a pass that is also tagged as an interception into two events.
 
     The interception copy keeps only the interception tag, gets type 0 /
@@ -267,7 +330,7 @@ def _split_interception_passes(events: pd.DataFrame) -> pd.DataFrame:
     ).reset_index(drop=True)
 
 
-def _attach_offsides(events: pd.DataFrame) -> pd.DataFrame:
+def add_offside_variable(events: pd.DataFrame) -> pd.DataFrame:
     """Fold offside events (type 6) into the preceding pass as a flag."""
     events['offside'] = 0
     nxt = events.shift(-1)
@@ -276,7 +339,7 @@ def _attach_offsides(events: pd.DataFrame) -> pd.DataFrame:
     return events[events['type_id'] != 6].reset_index(drop=True)
 
 
-def _rewrite_touches(events: pd.DataFrame) -> pd.DataFrame:
+def convert_touches(events: pd.DataFrame) -> pd.DataFrame:
     """Turn touches that directly reach another player into passes.
 
     A touch (subtype 72, not an interception) whose end location coincides
@@ -301,7 +364,7 @@ def _rewrite_touches(events: pd.DataFrame) -> pd.DataFrame:
     return events
 
 
-def _rewrite_simulations(events: pd.DataFrame) -> pd.DataFrame:
+def convert_simulations(events: pd.DataFrame) -> pd.DataFrame:
     """Rewrite simulation events (subtype 25).
 
     A simulation directly after a failed take-on is dropped (the take-on
@@ -333,15 +396,12 @@ def _first_match(
     return np.select([np.asarray(c, dtype=bool) for c in conditions], choices, default)
 
 
-def _build_actions(events: pd.DataFrame) -> pd.DataFrame:
-    """Determine SPADL type/result/bodypart columnar and drop non-actions."""
-    at = spadlconfig.actiontypes.index
+def _bodypart_ids(events: pd.DataFrame) -> np.ndarray:
+    """Columnar bodypart decision table (reference ``spadl/wyscout.py:579``)."""
     bp = spadlconfig.bodyparts.index
-
     type_id = events['type_id']
     subtype_id = events['subtype_id']
-
-    bodypart_id = _first_match(
+    return _first_match(
         [
             subtype_id.isin([81, 36, 21, 90, 91]),
             subtype_id == 82,
@@ -351,7 +411,13 @@ def _build_actions(events: pd.DataFrame) -> pd.DataFrame:
         default=bp('foot'),
     )
 
-    action_type = _first_match(
+
+def _type_ids(events: pd.DataFrame) -> np.ndarray:
+    """Columnar action-type decision table (reference ``spadl/wyscout.py:603``)."""
+    at = spadlconfig.actiontypes.index
+    type_id = events['type_id']
+    subtype_id = events['subtype_id']
+    return _first_match(
         [
             events['own_goal'],
             (type_id == 8) & (subtype_id == 80),
@@ -399,7 +465,12 @@ def _build_actions(events: pd.DataFrame) -> pd.DataFrame:
         default=at('non_action'),
     )
 
-    result_id = _first_match(
+
+def _result_ids(events: pd.DataFrame) -> np.ndarray:
+    """Columnar result decision table (reference ``spadl/wyscout.py:666``)."""
+    type_id = events['type_id']
+    subtype_id = events['subtype_id']
+    return _first_match(
         [
             events['offside'] == 1,
             type_id == 2,
@@ -425,67 +496,126 @@ def _build_actions(events: pd.DataFrame) -> pd.DataFrame:
         default=spadlconfig.SUCCESS,
     )
 
-    actions = pd.DataFrame(
+
+def determine_bodypart_id(event) -> int:
+    """Bodypart id of one Wyscout event (row-wise reference API)."""
+    return int(_bodypart_ids(_single_event(event))[0])
+
+
+def determine_type_id(event) -> int:
+    """SPADL action-type id of one Wyscout event (row-wise reference API)."""
+    return int(_type_ids(_single_event(event))[0])
+
+
+def determine_result_id(event) -> int:
+    """SPADL result id of one Wyscout event (row-wise reference API)."""
+    return int(_result_ids(_single_event(event))[0])
+
+
+def create_df_actions(df_events: pd.DataFrame) -> pd.DataFrame:
+    """Build the raw SPADL action frame and drop non-actions.
+
+    Type/result/bodypart come from the columnar decision tables; like the
+    reference (``spadl/wyscout.py:542-576``) the remaining non-actions are
+    removed before returning.
+    """
+    df_actions = pd.DataFrame(
         {
-            'game_id': events['game_id'],
-            'original_event_id': events['event_id'].astype(object),
-            'period_id': events['period_id'],
-            'time_seconds': events['milliseconds'] / 1000,
-            'team_id': events['team_id'],
-            'player_id': events['player_id'],
-            'start_x': events['start_x'],
-            'start_y': events['start_y'],
-            'end_x': events['end_x'],
-            'end_y': events['end_y'],
-            'bodypart_id': bodypart_id,
-            'type_id': action_type,
-            'result_id': result_id,
+            'game_id': df_events['game_id'],
+            'original_event_id': df_events['event_id'].astype(object),
+            'period_id': df_events['period_id'],
+            'time_seconds': df_events['milliseconds'] / 1000,
+            'team_id': df_events['team_id'],
+            'player_id': df_events['player_id'],
+            'start_x': df_events['start_x'],
+            'start_y': df_events['start_y'],
+            'end_x': df_events['end_x'],
+            'end_y': df_events['end_y'],
+            'bodypart_id': _bodypart_ids(df_events),
+            'type_id': _type_ids(df_events),
+            'result_id': _result_ids(df_events),
         }
     )
-    keep = actions['type_id'] != spadlconfig.NON_ACTION
-    return actions[keep].reset_index(drop=True)
+    return remove_non_actions(df_actions)
 
 
-def _rescale_and_repair(actions: pd.DataFrame) -> pd.DataFrame:
-    """Rescale (0-100)² coordinates to 105×68 m and repair special cases."""
+def remove_non_actions(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Drop rows typed ``non_action``."""
+    keep = df_actions['type_id'] != spadlconfig.NON_ACTION
+    return df_actions[keep].reset_index(drop=True)
+
+
+def fix_actions(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Rescale (0-100)² coordinates to 105×68 m and repair special cases.
+
+    Same repair chain and order as the reference
+    (``spadl/wyscout.py:722-760``): goalkick coordinates, goalkick results,
+    foul coordinates, keeper-save coordinates, post-goal keeper-save
+    removal.
+    """
     length, width = spadlconfig.field_length, spadlconfig.field_width
     for c in ('start_x', 'end_x'):
-        actions[c] = (actions[c] * length / 100).clip(0, length)
+        df_actions[c] = (df_actions[c] * length / 100).clip(0, length)
     for c in ('start_y', 'end_y'):
         # Wyscout's y axis runs top-to-bottom.
-        actions[c] = ((100 - actions[c]) * width / 100).clip(0, width)
+        df_actions[c] = ((100 - df_actions[c]) * width / 100).clip(0, width)
+    df_actions = fix_goalkick_coordinates(df_actions)
+    df_actions = adjust_goalkick_result(df_actions)
+    df_actions = fix_foul_coordinates(df_actions)
+    df_actions = fix_keeper_save_coordinates(df_actions)
+    df_actions = remove_keeper_goal_actions(df_actions)
+    return df_actions.reset_index(drop=True)
 
+
+def fix_goalkick_coordinates(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Goalkicks start from a fixed point in front of goal."""
+    goalkick = df_actions['type_id'] == spadlconfig.actiontypes.index('goalkick')
+    df_actions.loc[goalkick, 'start_x'] = 5.0
+    df_actions.loc[goalkick, 'start_y'] = 34.0
+    return df_actions
+
+
+def adjust_goalkick_result(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Goalkick result: retained possession = success."""
+    goalkick = df_actions['type_id'] == spadlconfig.actiontypes.index('goalkick')
+    nxt = df_actions.shift(-1)
+    keeps_ball = df_actions['team_id'] == nxt['team_id']
+    df_actions.loc[goalkick & keeps_ball, 'result_id'] = spadlconfig.SUCCESS
+    df_actions.loc[goalkick & ~keeps_ball, 'result_id'] = spadlconfig.FAIL
+    return df_actions
+
+
+def fix_foul_coordinates(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Fouls happen in place: end coordinates equal start coordinates."""
+    foul = df_actions['type_id'] == spadlconfig.actiontypes.index('foul')
+    df_actions.loc[foul, 'end_x'] = df_actions.loc[foul, 'start_x']
+    df_actions.loc[foul, 'end_y'] = df_actions.loc[foul, 'start_y']
+    return df_actions
+
+
+def fix_keeper_save_coordinates(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Mirror keeper-save coordinates to the keeper's own goal.
+
+    Coordinates are recorded from the shooter's perspective; mirror them
+    and collapse the save to a point.
+    """
+    length, width = spadlconfig.field_length, spadlconfig.field_width
+    save = df_actions['type_id'] == spadlconfig.actiontypes.index('keeper_save')
+    df_actions.loc[save, 'end_x'] = length - df_actions.loc[save, 'end_x']
+    df_actions.loc[save, 'end_y'] = width - df_actions.loc[save, 'end_y']
+    df_actions.loc[save, 'start_x'] = df_actions.loc[save, 'end_x']
+    df_actions.loc[save, 'start_y'] = df_actions.loc[save, 'end_y']
+    return df_actions
+
+
+def remove_keeper_goal_actions(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Drop the keeper's pick-up directly after a conceded goal."""
     at = spadlconfig.actiontypes.index
-
-    # Goalkicks: start from a fixed point in front of goal.
-    goalkick = actions['type_id'] == at('goalkick')
-    actions.loc[goalkick, 'start_x'] = 5.0
-    actions.loc[goalkick, 'start_y'] = 34.0
-
-    # Goalkick result: retained possession = success.
-    nxt = actions.shift(-1)
-    keeps_ball = actions['team_id'] == nxt['team_id']
-    actions.loc[goalkick & keeps_ball, 'result_id'] = spadlconfig.SUCCESS
-    actions.loc[goalkick & ~keeps_ball, 'result_id'] = spadlconfig.FAIL
-
-    # Fouls happen in place.
-    foul = actions['type_id'] == at('foul')
-    actions.loc[foul, 'end_x'] = actions.loc[foul, 'start_x']
-    actions.loc[foul, 'end_y'] = actions.loc[foul, 'start_y']
-
-    # Keeper saves: coordinates are recorded from the shooter's perspective;
-    # mirror them to the keeper's own goal and collapse to a point.
-    save = actions['type_id'] == at('keeper_save')
-    actions.loc[save, 'end_x'] = length - actions.loc[save, 'end_x']
-    actions.loc[save, 'end_y'] = width - actions.loc[save, 'end_y']
-    actions.loc[save, 'start_x'] = actions.loc[save, 'end_x']
-    actions.loc[save, 'start_y'] = actions.loc[save, 'end_y']
-
-    # Drop the keeper's pick-up directly after a conceded goal.
-    prev = actions.shift(1)
-    same_phase = prev['time_seconds'] + 10 > actions['time_seconds']
+    save = df_actions['type_id'] == at('keeper_save')
+    prev = df_actions.shift(1)
+    same_phase = prev['time_seconds'] + 10 > df_actions['time_seconds']
     prev_goal = prev['type_id'].isin(
         [at('shot'), at('shot_penalty'), at('shot_freekick')]
     ) & (prev['result_id'] == spadlconfig.SUCCESS)
     drop = same_phase & prev_goal & save
-    return actions[~drop.fillna(False)].reset_index(drop=True)
+    return df_actions[~drop.fillna(False)].reset_index(drop=True)

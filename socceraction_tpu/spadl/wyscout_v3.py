@@ -42,10 +42,39 @@ import numpy as np
 import pandas as pd
 
 from . import config as spadlconfig
-from .base import _add_dribbles, _fix_clearances, _fix_direction_of_play
+from .base import (
+    _add_dribbles,
+    _fix_clearances,
+    _fix_direction_of_play,
+    _single_event,
+)
 from .schema import SPADLSchema
 
-__all__ = ['convert_to_actions', 'add_expected_assists']
+# Keeper-save mirroring is identical across feed versions; the v2 module
+# owns the implementation and this module re-exports it.
+from .wyscout import fix_keeper_save_coordinates  # noqa: F401
+
+__all__ = [
+    'convert_to_actions',
+    'add_expected_assists',
+    'make_new_positions',
+    'fix_wyscout_events',
+    'create_shot_coordinates',
+    'convert_duels',
+    'insert_interception_coordinates',
+    'insert_fairplay_coordinates',
+    'insert_coordinates_edge_cases',
+    'add_offside_variable',
+    'convert_touches',
+    'convert_accelerations',
+    'create_df_actions',
+    'determine_bodypart_id',
+    'determine_type_id',
+    'determine_result_id',
+    'fix_actions',
+    'fix_foul_coordinates',
+    'fix_keeper_save_coordinates',
+]
 
 #: matchPeriod string → SPADL period id.
 _PERIODS = {'1H': 1, '2H': 2, 'E1': 3, 'E2': 4, 'P': 5}
@@ -80,7 +109,7 @@ _KEEP_PRIMARIES = [
 ]
 #: "possession lost / play stops" next-event primaries (reference :614-617).
 #: Note 'offside' is unreachable here — offside rows are dropped by
-#: ``_attach_offsides`` before touch/acceleration inference runs, exactly
+#: ``add_offside_variable`` before touch/acceleration inference runs, exactly
 #: like the reference surgery order (``:144-146``); kept for parity.
 _LOSE_PRIMARIES = ['game_interruption', 'infraction', 'offside', 'shot_against']
 
@@ -126,22 +155,35 @@ def convert_to_actions(
             )
         home_team_id = events['home_team_id'].iloc[0]
     events = events.reset_index(drop=True).copy()
-    events = _position_columns(events)
-    events = _estimate_shot_end_coordinates(events)
-    events = _rewrite_duels(events)
-    events = _insert_interception_coordinates(events)
-    events = _attach_offsides(events)
-    events = _infer_touch_results(events)
-    events = _infer_acceleration_results(events)
-    events = _insert_fairplay_coordinates(events)
-    events = _backfill_move_end_coordinates(events)
-    actions = _build_actions(events)
-    actions = _rescale_and_repair(actions)
+    events = make_new_positions(events)
+    events = fix_wyscout_events(events)
+    actions = create_df_actions(events)
+    actions = fix_actions(actions)
     actions = _fix_direction_of_play(actions, home_team_id)
     actions = _fix_clearances(actions)
     actions['action_id'] = range(len(actions))
     actions = _add_dribbles(actions)
     return SPADLSchema.validate(actions)
+
+
+def fix_wyscout_events(df_events: pd.DataFrame) -> pd.DataFrame:
+    """Event surgery on the raw (0-100)² Wyscout-v3 pitch.
+
+    Chains the rewriting stages in the reference's order
+    (``spadl/wyscout_v3.py:128-153``), with one documented deviation:
+    :func:`add_expected_assists` is NOT part of the chain here — it
+    requires a ``shot_xg`` feed column that not every v3 export carries,
+    so xA attachment is a separate opt-in step.
+    """
+    df_events = create_shot_coordinates(df_events)
+    df_events = convert_duels(df_events)
+    df_events = insert_interception_coordinates(df_events)
+    df_events = add_offside_variable(df_events)
+    df_events = convert_touches(df_events)
+    df_events = convert_accelerations(df_events)
+    df_events = insert_fairplay_coordinates(df_events)
+    df_events = insert_coordinates_edge_cases(df_events)
+    return df_events
 
 
 def add_expected_assists(events: pd.DataFrame) -> pd.DataFrame:
@@ -162,7 +204,7 @@ def add_expected_assists(events: pd.DataFrame) -> pd.DataFrame:
 # ---------------------------------------------------------------------------
 
 
-def _position_columns(events: pd.DataFrame) -> pd.DataFrame:
+def make_new_positions(events: pd.DataFrame) -> pd.DataFrame:
     """Select start/end coordinates per event family (reference :76-103).
 
     Blocked passes end where they start; pass-like events end at
@@ -199,7 +241,7 @@ def _position_columns(events: pd.DataFrame) -> pd.DataFrame:
     return events
 
 
-def _estimate_shot_end_coordinates(events: pd.DataFrame) -> pd.DataFrame:
+def create_shot_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     """Estimate shot end points from the goal-zone code (reference :155-203)."""
     zone = _str_col(events, 'shot_goal_zone')
     known = zone.map(lambda z: _GOAL_ZONE_COORDS.get(z))
@@ -212,7 +254,7 @@ def _estimate_shot_end_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     return events
 
 
-def _rewrite_duels(events: pd.DataFrame) -> pd.DataFrame:
+def convert_duels(events: pd.DataFrame) -> pd.DataFrame:
     """Duels → dribble/take_on with outcome flags (reference :226-304).
 
     A ground duel of duel-type ``dribble`` becomes a dribbling action
@@ -275,7 +317,7 @@ def _rewrite_duels(events: pd.DataFrame) -> pd.DataFrame:
     return events.reset_index(drop=True)
 
 
-def _insert_interception_coordinates(events: pd.DataFrame) -> pd.DataFrame:
+def insert_interception_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     """Interceptions end at the next event's start (reference :387-412)."""
     nxt_x = events['start_x'].shift(-1)
     nxt_y = events['start_y'].shift(-1)
@@ -288,7 +330,7 @@ def _insert_interception_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     return events
 
 
-def _attach_offsides(events: pd.DataFrame) -> pd.DataFrame:
+def add_offside_variable(events: pd.DataFrame) -> pd.DataFrame:
     """Mark passes followed by an offside; drop offside events (reference :513-544)."""
     nxt_primary = events['type_primary'].astype(str).shift(-1)
     primary = _str_col(events, 'type_primary')
@@ -299,7 +341,7 @@ def _attach_offsides(events: pd.DataFrame) -> pd.DataFrame:
     return events.reset_index(drop=True)
 
 
-def _infer_touch_results(events: pd.DataFrame) -> pd.DataFrame:
+def convert_touches(events: pd.DataFrame) -> pd.DataFrame:
     """Touch success from the next event (reference :590-658).
 
     A touch keeps possession when the same team acts next (or a duel
@@ -310,7 +352,7 @@ def _infer_touch_results(events: pd.DataFrame) -> pd.DataFrame:
     return _infer_followup_results(events, 'touch', 'touch_success', 'touch_fail')
 
 
-def _infer_acceleration_results(events: pd.DataFrame) -> pd.DataFrame:
+def convert_accelerations(events: pd.DataFrame) -> pd.DataFrame:
     """Acceleration success from the next event (reference :661-723)."""
     return _infer_followup_results(
         events, 'acceleration', 'acceleration_success', 'acceleration_fail'
@@ -350,7 +392,7 @@ def _infer_followup_results(
     return events
 
 
-def _insert_fairplay_coordinates(events: pd.DataFrame) -> pd.DataFrame:
+def insert_fairplay_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     """Give game interruptions before fairplay events coordinates (reference :414-447)."""
     primary = _str_col(events, 'type_primary')
     prv_x = events['start_x'].shift(1)
@@ -371,7 +413,7 @@ def _insert_fairplay_coordinates(events: pd.DataFrame) -> pd.DataFrame:
     return events
 
 
-def _backfill_move_end_coordinates(events: pd.DataFrame) -> pd.DataFrame:
+def insert_coordinates_edge_cases(events: pd.DataFrame) -> pd.DataFrame:
     """Remaining move actions without an end point end in place (reference :449-475)."""
     primary = _str_col(events, 'type_primary')
     move = primary.isin(['pass', 'carry', 'cross', 'acceleration', 'dribble', 'take_on'])
@@ -404,7 +446,7 @@ def _time_seconds(events: pd.DataFrame) -> pd.Series:
     return (total - offset).clip(lower=0.0)
 
 
-def _build_actions(events: pd.DataFrame) -> pd.DataFrame:
+def create_df_actions(events: pd.DataFrame) -> pd.DataFrame:
     primary = _str_col(events, 'type_primary')
     type_id = _determine_type_ids(events, primary)
     result_id = _determine_result_ids(events, primary, type_id)
@@ -575,7 +617,7 @@ def _determine_bodypart_ids(events: pd.DataFrame, primary: pd.Series) -> pd.Seri
     )
 
 
-def _rescale_and_repair(actions: pd.DataFrame) -> pd.DataFrame:
+def fix_actions(actions: pd.DataFrame) -> pd.DataFrame:
     """(0-100)² → 105×68 m with y flip, plus coordinate repairs.
 
     Reference ``:901-937`` (rescale + keeper-save inversion) and ``:960-976``
@@ -587,17 +629,42 @@ def _rescale_and_repair(actions: pd.DataFrame) -> pd.DataFrame:
     actions['end_x'] = (actions['end_x'] * length / 100).clip(0, length)
     actions['start_y'] = ((100 - actions['start_y']) * width / 100).clip(0, width)
     actions['end_y'] = ((100 - actions['end_y']) * width / 100).clip(0, width)
-
-    # fouls (and any other still-endless action) end where they start
-    no_end = actions['end_x'].isna() | actions['end_y'].isna()
-    actions.loc[no_end, 'end_x'] = actions.loc[no_end, 'start_x']
-    actions.loc[no_end, 'end_y'] = actions.loc[no_end, 'start_y']
-
-    # keeper saves happen at the keeper's own goal: mirror the shot's end
-    # point and collapse the action onto it
-    saves = actions['type_id'] == spadlconfig.actiontypes.index('keeper_save')
-    actions.loc[saves, 'end_x'] = length - actions.loc[saves, 'end_x']
-    actions.loc[saves, 'end_y'] = width - actions.loc[saves, 'end_y']
-    actions.loc[saves, 'start_x'] = actions.loc[saves, 'end_x']
-    actions.loc[saves, 'start_y'] = actions.loc[saves, 'end_y']
+    actions = fix_foul_coordinates(actions)
+    actions = fix_keeper_save_coordinates(actions)
     return actions
+
+
+def fix_foul_coordinates(df_actions: pd.DataFrame) -> pd.DataFrame:
+    """Fouls (and any other still-endless action) end where they start."""
+    no_end = df_actions['end_x'].isna() | df_actions['end_y'].isna()
+    df_actions.loc[no_end, 'end_x'] = df_actions.loc[no_end, 'start_x']
+    df_actions.loc[no_end, 'end_y'] = df_actions.loc[no_end, 'start_y']
+    return df_actions
+
+
+
+
+
+def determine_type_id(event) -> int:
+    """SPADL action-type id of one Wyscout-v3 event (row-wise reference API).
+
+    Documented deviation: the reference's WIP ``determine_type_id`` returns
+    string *names* (``spadl/wyscout_v3.py:832-833``, see SURVEY.md §0); the
+    intended semantics — and this implementation — return the vocabulary id.
+    """
+    ev = _single_event(event)
+    return int(_determine_type_ids(ev, _str_col(ev, 'type_primary')).iloc[0])
+
+
+def determine_result_id(event) -> int:
+    """SPADL result id of one Wyscout-v3 event (row-wise reference API)."""
+    ev = _single_event(event)
+    primary = _str_col(ev, 'type_primary')
+    type_id = _determine_type_ids(ev, primary)
+    return int(_determine_result_ids(ev, primary, type_id).iloc[0])
+
+
+def determine_bodypart_id(event) -> int:
+    """SPADL bodypart id of one Wyscout-v3 event (row-wise reference API)."""
+    ev = _single_event(event)
+    return int(_determine_bodypart_ids(ev, _str_col(ev, 'type_primary')).iloc[0])

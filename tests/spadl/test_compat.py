@@ -1,0 +1,229 @@
+"""Reference API-surface compatibility.
+
+The reference exposes every Wyscout converter stage as a public module
+function (``socceraction/spadl/wyscout.py:58-898``) and re-exports each
+provider's loader/schemas from its converter module with a
+DeprecationWarning (``spadl/statsbomb.py:325-413``, ``spadl/opta.py``,
+``spadl/wyscout.py:901-991``). These tests pin that a pipeline written
+against the reference's names keeps working here.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import pandas as pd
+import pytest
+
+from socceraction_tpu.spadl import config as spadlconfig
+from socceraction_tpu.spadl import opta as sp_opta
+from socceraction_tpu.spadl import statsbomb as sp_statsbomb
+from socceraction_tpu.spadl import utils as sp_utils
+from socceraction_tpu.spadl import wyscout as wy
+from socceraction_tpu.spadl import wyscout_v3 as wy3
+
+# every public stage name the reference module exports
+REFERENCE_WYSCOUT_STAGES = [
+    'get_tagsdf',
+    'make_new_positions',
+    'fix_wyscout_events',
+    'create_shot_coordinates',
+    'convert_duels',
+    'insert_interception_passes',
+    'add_offside_variable',
+    'convert_simulations',
+    'convert_touches',
+    'create_df_actions',
+    'determine_bodypart_id',
+    'determine_type_id',
+    'determine_result_id',
+    'remove_non_actions',
+    'fix_actions',
+    'fix_goalkick_coordinates',
+    'fix_foul_coordinates',
+    'fix_keeper_save_coordinates',
+    'remove_keeper_goal_actions',
+    'adjust_goalkick_result',
+]
+
+REFERENCE_WYSCOUT_V3_STAGES = [
+    'make_new_positions',
+    'fix_wyscout_events',
+    'create_shot_coordinates',
+    'add_expected_assists',
+    'convert_duels',
+    'insert_interception_coordinates',
+    'insert_fairplay_coordinates',
+    'insert_coordinates_edge_cases',
+    'add_offside_variable',
+    'convert_touches',
+    'convert_accelerations',
+    'create_df_actions',
+    'determine_bodypart_id',
+    'determine_type_id',
+    'determine_result_id',
+    'fix_actions',
+    'fix_foul_coordinates',
+    'fix_keeper_save_coordinates',
+]
+
+
+@pytest.mark.parametrize('name', REFERENCE_WYSCOUT_STAGES)
+def test_wyscout_stage_is_public(name):
+    assert callable(getattr(wy, name))
+    assert name in wy.__all__
+
+
+@pytest.mark.parametrize('name', REFERENCE_WYSCOUT_V3_STAGES)
+def test_wyscout_v3_stage_is_public(name):
+    assert callable(getattr(wy3, name))
+    assert name in wy3.__all__
+
+
+@pytest.mark.parametrize(
+    ('module', 'name', 'target'),
+    [
+        (sp_statsbomb, 'StatsBombLoader', 'socceraction_tpu.data.statsbomb'),
+        (sp_statsbomb, 'extract_player_games', 'socceraction_tpu.data.statsbomb'),
+        (sp_statsbomb, 'StatsBombEventSchema', 'socceraction_tpu.data.statsbomb'),
+        (sp_opta, 'OptaLoader', 'socceraction_tpu.data.opta'),
+        (sp_opta, 'OptaEventSchema', 'socceraction_tpu.data.opta'),
+        (wy, 'WyscoutLoader', 'socceraction_tpu.data.wyscout'),
+        (wy, 'PublicWyscoutLoader', 'socceraction_tpu.data.wyscout'),
+        (wy, 'WyscoutEventSchema', 'socceraction_tpu.data.wyscout'),
+    ],
+)
+def test_deprecated_reexport_warns_and_resolves(module, name, target):
+    import importlib
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        obj = getattr(module, name)
+    assert any(issubclass(w.category, DeprecationWarning) for w in caught)
+    assert obj is getattr(importlib.import_module(target), name)
+
+
+def test_unknown_attribute_still_raises():
+    with pytest.raises(AttributeError):
+        sp_statsbomb.NoSuchThing
+
+
+def test_play_left_to_right_sa_alias():
+    assert sp_utils.play_left_to_right_sa is sp_utils.play_left_to_right
+
+
+def _wyscout_pass_event(**overrides):
+    base = {
+        'type_id': 8,
+        'subtype_id': 85,
+        'head/body': False,
+        'own_goal': False,
+        'goal': False,
+        'high': False,
+        'accurate': True,
+        'not_accurate': False,
+        'interception': False,
+        'clearance': False,
+        'offside': 0,
+        'take_on_left': False,
+        'take_on_right': False,
+        'sliding_tackle': False,
+    }
+    base.update(overrides)
+    return pd.Series(base)
+
+
+class TestRowWiseDetermineFns:
+    """The per-row wrappers must agree with the columnar decision tables."""
+
+    def test_pass(self):
+        ev = _wyscout_pass_event()
+        assert wy.determine_type_id(ev) == spadlconfig.actiontypes.index('pass')
+        assert wy.determine_result_id(ev) == spadlconfig.SUCCESS
+        assert wy.determine_bodypart_id(ev) == spadlconfig.bodyparts.index('foot')
+
+    def test_cross(self):
+        ev = _wyscout_pass_event(subtype_id=80, accurate=False, not_accurate=True)
+        assert wy.determine_type_id(ev) == spadlconfig.actiontypes.index('cross')
+        assert wy.determine_result_id(ev) == spadlconfig.FAIL
+
+    def test_headed_shot(self):
+        ev = _wyscout_pass_event(type_id=10, subtype_id=100, goal=True)
+        ev['head/body'] = True
+        assert wy.determine_type_id(ev) == spadlconfig.actiontypes.index('shot')
+        assert wy.determine_result_id(ev) == spadlconfig.SUCCESS
+        assert wy.determine_bodypart_id(ev) == spadlconfig.bodyparts.index(
+            'head/other'
+        )
+
+    def test_foul(self):
+        ev = _wyscout_pass_event(type_id=2, subtype_id=20)
+        assert wy.determine_type_id(ev) == spadlconfig.actiontypes.index('foul')
+        assert wy.determine_result_id(ev) == spadlconfig.SUCCESS
+
+
+class TestV3RowWiseDetermineFns:
+    def test_pass(self):
+        ev = pd.Series(
+            {
+                'type_primary': 'pass',
+                'pass_accurate': 1,
+            }
+        )
+        assert wy3.determine_type_id(ev) == spadlconfig.actiontypes.index('pass')
+        assert wy3.determine_result_id(ev) == spadlconfig.SUCCESS
+        assert wy3.determine_bodypart_id(ev) == spadlconfig.FOOT
+
+
+def test_stage_composition_matches_convert(wyscout_events):
+    """Driving the public stages by hand reproduces ``convert_to_actions``."""
+    from socceraction_tpu.spadl.base import (
+        _add_dribbles,
+        _fix_clearances,
+        _fix_direction_of_play,
+    )
+    from socceraction_tpu.spadl.schema import SPADLSchema
+
+    events = wyscout_events
+    home_team_id = events['team_id'].iloc[0]
+
+    via_stages = pd.concat(
+        [events.reset_index(drop=True), wy.get_tagsdf(events)], axis=1
+    )
+    via_stages = wy.make_new_positions(via_stages)
+    via_stages = wy.fix_wyscout_events(via_stages)
+    actions = wy.create_df_actions(via_stages)
+    actions = wy.fix_actions(actions)
+    assert len(actions) > 0
+    # finish with the same shared post-processing convert_to_actions applies
+    actions = _fix_direction_of_play(actions, home_team_id)
+    actions = _fix_clearances(actions)
+    actions['action_id'] = range(len(actions))
+    actions = SPADLSchema.validate(_add_dribbles(actions))
+
+    direct = wy.convert_to_actions(events, home_team_id=home_team_id)
+    pd.testing.assert_frame_equal(actions, direct)
+
+
+@pytest.fixture()
+def wyscout_events():
+    """A small hand-built Wyscout-v2 event frame (one period, one team)."""
+    rows = [
+        {
+            'game_id': 1,
+            'event_id': i,
+            'period_id': 1,
+            'milliseconds': 1000 * i,
+            'team_id': 777 if i % 3 else 778,
+            'player_id': 10 + i,
+            'type_id': 8,
+            'subtype_id': 85,
+            'tags': [{'id': 1801}],
+            'positions': [
+                {'x': 30 + i, 'y': 40},
+                {'x': 35 + i, 'y': 45},
+            ],
+        }
+        for i in range(8)
+    ]
+    return pd.DataFrame(rows)
