@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.batch import ActionBatch
 from ..ml.mlp import MLPClassifier, _MLP
 from ..ops.features import compute_features
-from ..ops.fused import fused_mlp_logits
+from ..ops.fused import fused_pair_logits
 from ..ops.labels import scores_concedes
 from .mesh import shard_batch
 
@@ -109,20 +109,19 @@ def make_train_step(
 
     def loss_fn(params, batch: ActionBatch):
         # the fused combined-table forward (ops/fused.py) avoids
-        # materializing the (G, A, F) feature tensor in HBM; autodiff
-        # turns the first-layer row gathers into scatter-adds over the
-        # small (T*R*B, H) tables, so the backward pass stays fused too
+        # materializing the (G, A, F) feature tensor in HBM, and the
+        # stacked two-head fold computes ONE gather per state for both
+        # heads; autodiff turns the first-layer row gathers into
+        # scatter-adds over the small (T*R*B, 2H) tables, so the backward
+        # pass stays fused too
         ys, yc = scores_concedes(batch, nr_actions=nr_actions)
         mask = batch.mask
-        logits = {
-            head: fused_mlp_logits(
-                params[head], batch, names=names, k=k,
-                hidden_layers=len(hidden),
-            )
-            for head in ('scores', 'concedes')
-        }
-        l_s = _masked_bce(logits['scores'], ys, mask)
-        l_c = _masked_bce(logits['concedes'], yc, mask)
+        logit_s, logit_c = fused_pair_logits(
+            params['scores'], params['concedes'], batch, names=names, k=k,
+            hidden_layers_a=len(hidden), hidden_layers_b=len(hidden),
+        )
+        l_s = _masked_bce(logit_s, ys, mask)
+        l_c = _masked_bce(logit_c, yc, mask)
         return l_s + l_c
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))

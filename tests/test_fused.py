@@ -146,7 +146,13 @@ def test_fused_no_hidden_layers():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3)
 
 
-def test_fused_pair_probs_shares_and_falls_back():
+def test_fused_pair_probs_stacked_matches_per_head():
+    """The stacked two-head fold equals independent per-head evaluation.
+
+    Stacking both heads' first layers (``fused_pair_logits``) must be a
+    pure reordering; heads with different widths AND depths share only the
+    first-layer fold, so they are exercised too.
+    """
     from socceraction_tpu.ops.fused import fused_pair_probs
 
     batch = synthetic_batch(n_games=2, n_actions=128, seed=9)
@@ -172,8 +178,30 @@ def test_fused_pair_probs_shares_and_falls_back():
         np.asarray(b.predict_proba_device_batch(batch, names=NAMES, k=K)),
         atol=1e-5,
     )
-    # differing depths fall back to per-head calls
+    # heads of different width and depth share only the stacked first layer
     c = make_clf((8,), 2)
     pa2, pc = fused_pair_probs(a, c, batch, names=NAMES, k=K)
     np.testing.assert_allclose(np.asarray(pa2), np.asarray(pa), atol=1e-6)
-    assert pc.shape == pa.shape
+    np.testing.assert_allclose(
+        np.asarray(pc),
+        np.asarray(c.predict_proba_device_batch(batch, names=NAMES, k=K)),
+        atol=1e-5,
+    )
+
+    # nontrivial standardization must fold per head, not leak across heads
+    rng = np.random.default_rng(3)
+    a.mean_ = rng.normal(size=F).astype(np.float32)
+    a.std_ = (1 + rng.random(F)).astype(np.float32)
+    c.mean_ = rng.normal(size=F).astype(np.float32)
+    c.std_ = (1 + rng.random(F)).astype(np.float32)
+    pa3, pc3 = fused_pair_probs(a, c, batch, names=NAMES, k=K)
+    np.testing.assert_allclose(
+        np.asarray(pa3),
+        np.asarray(a.predict_proba_device_batch(batch, names=NAMES, k=K)),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pc3),
+        np.asarray(c.predict_proba_device_batch(batch, names=NAMES, k=K)),
+        atol=1e-5,
+    )

@@ -24,6 +24,7 @@ _SCRIPTS = [
     # chapter 5 runs without --processes here: the two-process tier is
     # already covered (and time-bounded) by tests/test_distributed.py
     '5_scale_out.py',
+    '6_atomic_pipeline.py',
 ]
 
 
@@ -37,6 +38,7 @@ def test_walkthrough_sequence(tmp_path_factory):
         '3_train_probability_models.py': ['--store', store, '--checkpoint', ckpt],
         '4_rate_and_rank_players.py': ['--store', store, '--checkpoint', ckpt],
         '5_scale_out.py': [],
+        '6_atomic_pipeline.py': ['--store', store],
     }
     for script in _SCRIPTS:
         proc = subprocess.run(
@@ -49,4 +51,4 @@ def test_walkthrough_sequence(tmp_path_factory):
         assert proc.returncode == 0, (
             f'{script} failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}'
         )
-    assert 'walkthrough complete' in proc.stdout
+    assert 'atomic walkthrough complete' in proc.stdout
