@@ -1,0 +1,86 @@
+"""On-chip stage breakdown of the flagship rating forward.
+
+Times cumulative prefixes of the stacked two-head pipeline (first layer
+only → +hidden chains → +formula) so each stage's marginal cost on the
+v5e is visible, plus the dense-blocks-only and gathers-only first-layer
+parts. Guides where further fusion could pay (e.g. a monolithic Pallas
+kernel that never writes the (G, A, 2H) activations to HBM).
+
+Usage (from the repo root): PYTHONPATH=. python benchmarks/stage_breakdown.py
+(on the axon image, append the axon sitecustomize dir to PYTHONPATH so the
+remote-TPU plugin registers)
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _K, _NAMES, entry
+from bench import _measure  # the host-fetch marginal timer (bench.py docstring)
+from socceraction_tpu.core.synthetic import synthetic_batch
+from socceraction_tpu.ops.fused import (
+    STANDARD_REGISTRY,
+    _fused_first_layer,
+    _hidden_chain,
+    _standardized_first_layer,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--games', type=int, default=512)
+    args = ap.parse_args()
+    print('devices:', jax.devices())
+    full, (params, _) = entry()
+    batch = synthetic_batch(n_games=args.games, n_actions=1664, seed=1)
+    total = int(batch.total_actions)
+
+    def stacked_first_layer(params, batch):
+        Wk_a, bias_a = _standardized_first_layer(params['scores']['params'], None, None)
+        Wk_b, bias_b = _standardized_first_layer(params['concedes']['params'], None, None)
+        Wk = jnp.concatenate([Wk_a, Wk_b], axis=1)
+        bias = jnp.concatenate([bias_a, bias_b])
+        s = STANDARD_REGISTRY.make_states(batch, _K)
+        return _fused_first_layer(
+            Wk, bias, s, batch, names=_NAMES, k=_K, registry=STANDARD_REGISTRY
+        )
+
+    def first_plus_hidden(params, batch):
+        h = stacked_first_layer(params, batch)
+        H = h.shape[-1] // 2
+        return (
+            _hidden_chain(params['scores']['params'], h[..., :H], 2),
+            _hidden_chain(params['concedes']['params'], h[..., H:], 2),
+        )
+
+    def dense_blocks_only(params, batch):
+        s = STANDARD_REGISTRY.make_states(batch, _K)
+        blocks = [
+            STANDARD_REGISTRY.kernels[n](s)
+            for n in _NAMES
+            if n not in STANDARD_REGISTRY.onehot_specs
+        ]
+        return jnp.concatenate(blocks, axis=-1)
+
+    stages = [
+        ('dense feature blocks only', dense_blocks_only),
+        ('first layer (gathers + dense matmul)', stacked_first_layer),
+        ('+ hidden chains (logits)', first_plus_hidden),
+        ('full forward (+sigmoid+formula)', full),
+    ]
+    prev = 0.0
+    for name, fn in stages:
+        dt = _measure(jax.jit(fn), (params, batch))
+        print(
+            f'{name:>40}: {dt * 1e3:7.2f} ms  '
+            f'(marginal {max(dt - prev, 0) * 1e3:6.2f} ms)  '
+            f'{total / dt / 1e6:7.1f}M actions/s'
+        )
+        prev = dt
+
+
+if __name__ == '__main__':
+    main()

@@ -1,5 +1,6 @@
 """Atomic-VAEP: the VAEP framework over atomic actions."""
 
+from . import features, formula, labels  # noqa: F401
 from .base import AtomicVAEP
 
-__all__ = ['AtomicVAEP']
+__all__ = ['AtomicVAEP', 'features', 'labels', 'formula']

@@ -15,13 +15,15 @@ from .config import (
     results_df,
 )
 from .schema import SPADLSchema
-from .utils import add_names, play_left_to_right
+from . import config  # noqa: F401
+from .utils import add_names, play_left_to_right, play_left_to_right_sa
 from . import statsbomb  # noqa: F401  (provider converters)
 from . import wyscout  # noqa: F401
 from . import wyscout_v3  # noqa: F401
 from . import opta  # noqa: F401
 
 __all__ = [
+    'config',
     'statsbomb',
     'wyscout',
     'wyscout_v3',
@@ -37,4 +39,5 @@ __all__ = [
     'SPADLSchema',
     'add_names',
     'play_left_to_right',
+    'play_left_to_right_sa',
 ]

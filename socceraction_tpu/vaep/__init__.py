@@ -1,5 +1,13 @@
 """The VAEP action-valuation framework."""
 
+from . import features, formula, labels  # noqa: F401
 from .base import VAEP, NotFittedError, xfns_default
 
-__all__ = ['VAEP', 'NotFittedError', 'xfns_default']
+__all__ = [
+    'VAEP',
+    'NotFittedError',
+    'xfns_default',
+    'features',
+    'labels',
+    'formula',
+]
