@@ -1,11 +1,10 @@
 """Build an expected-goals (xG) model from SPADL shots.
 
-Library-API equivalent of the reference's
-``EXTRA-build-expected-goals-model.ipynb``: gamestate features restricted
-to shot actions, ``goal_from_shot`` labels, one binary classifier, Brier +
-ROC-AUC report. Runs against the checked-in StatsBomb fixture by default.
+Drives :class:`socceraction_tpu.xg.XGModel` — the library-API form of the
+reference's ``EXTRA-build-expected-goals-model.ipynb`` — against the
+checked-in StatsBomb fixture by default.
 
-    python examples/build_xg_model.py --learner sklearn
+    python examples/build_xg_model.py --learner logistic
 """
 
 from __future__ import annotations
@@ -27,50 +26,44 @@ _FIXTURE = os.path.join(
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument('--data', default=_FIXTURE, help='StatsBomb open-data root')
-    ap.add_argument('--learner', default='sklearn',
-                    choices=['sklearn', 'xgboost', 'mlp'])
+    ap.add_argument('--learner', default='logistic',
+                    choices=['logistic', 'sklearn', 'xgboost', 'mlp'])
     args = ap.parse_args()
 
-    from sklearn.metrics import brier_score_loss, roc_auc_score
-
     from socceraction_tpu.data.statsbomb import StatsBombLoader
-    from socceraction_tpu.ml.learners import LEARNERS
-    from socceraction_tpu.spadl import add_names, config as spadlcfg
     from socceraction_tpu.spadl import statsbomb as sb_convert
-    from socceraction_tpu.vaep import features as fs
-    from socceraction_tpu.vaep.labels import goal_from_shot
+    from socceraction_tpu.xg import XGModel
 
-    xfns = [fs.actiontype_onehot, fs.bodypart_onehot, fs.startlocation,
-            fs.startpolar, fs.movement, fs.time_delta]
-
+    model = XGModel()
     loader = StatsBombLoader(getter='local', root=args.data)
-    X_parts, y_parts = [], []
+    games, actions = [], {}
     for comp in loader.competitions().itertuples(index=False):
         for game in loader.games(comp.competition_id, comp.season_id).itertuples(index=False):
             events = loader.events(game.game_id)
-            actions = add_names(
-                sb_convert.convert_to_actions(events, game.home_team_id)
+            games.append(game)
+            actions[game.game_id] = sb_convert.convert_to_actions(
+                events, game.home_team_id
             )
-            states = fs.play_left_to_right(
-                fs.gamestates(actions, 2), game.home_team_id
-            )
-            feats = pd.concat([fn(states) for fn in xfns], axis=1)
-            labels = goal_from_shot(actions)
-            shots = actions['type_id'].isin(spadlcfg.SHOT_LIKE).to_numpy()
-            X_parts.append(feats[shots])
-            y_parts.append(labels[shots])
-    X = pd.concat(X_parts, ignore_index=True)
-    y = pd.concat(y_parts, ignore_index=True)['goal_from_shot']
-    print(f'{len(X)} shots, {int(y.sum())} goals')
 
-    clf = LEARNERS[args.learner](X, y.astype(int), eval_set=None)
-    p = clf.predict_proba(X)[:, 1]
-    print(f'train Brier {brier_score_loss(y, p):.5f}')
-    if y.nunique() > 1:
-        print(f'train AUC   {roc_auc_score(y, p):.5f}')
-    print('top xG shots:')
-    out = pd.DataFrame({'xG': p, 'goal': y.to_numpy()})
-    print(out.sort_values('xG', ascending=False).head(5).to_string(index=False))
+    X = pd.concat(
+        [model.compute_features(g, actions[g.game_id]) for g in games],
+        ignore_index=True,
+    )
+    y = pd.concat(
+        [model.compute_labels(g, actions[g.game_id]) for g in games],
+        ignore_index=True,
+    )
+    print(f'{len(X)} shots, {int(y.goal.sum())} goals')
+
+    model.fit(X, y, learner=args.learner)
+    metrics = model.score(X, y)
+    for k, v in metrics.items():
+        print(f'train {k}: {v:.5f}')
+
+    g = games[0]
+    rated = model.estimate(g, actions[g.game_id]).dropna()
+    print('top xG shots of the first game:')
+    print(rated.sort_values('xg', ascending=False).head(5).to_string())
 
 
 if __name__ == '__main__':

@@ -12,6 +12,7 @@ around a columnar action-tensor runtime executed with JAX/XLA on TPU:
 - :mod:`socceraction_tpu.ops` -- the JAX/XLA kernels for the valuation hot
   paths (xT value iteration, VAEP feature/label/formula transforms).
 - :mod:`socceraction_tpu.xthreat` -- the Expected Threat (xT) model.
+- :mod:`socceraction_tpu.xg` -- expected-goals models over SPADL shots.
 """
 
 __version__ = '0.1.0'

@@ -1,0 +1,155 @@
+"""xG model over SPADL shots (reference EXTRA notebook as library API)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.spadl import config as spadlconfig
+from socceraction_tpu.xg import XGModel, xfns_default
+
+
+class _Game:
+    def __init__(self, game_id, home_team_id):
+        self.game_id = game_id
+        self.home_team_id = home_team_id
+
+
+@pytest.fixture(scope='module')
+def season():
+    games, actions = [], {}
+    for i in range(8):
+        gid, home, away = 100 + i, 200 + 2 * i, 201 + 2 * i
+        games.append(_Game(gid, home))
+        actions[gid] = synthetic_actions_frame(
+            gid, home_team_id=home, away_team_id=away, seed=i, n_actions=1200
+        )
+    return games, actions
+
+
+@pytest.fixture(scope='module')
+def fitted(season):
+    games, actions = season
+    model = XGModel()
+    X = pd.concat(
+        [model.compute_features(g, actions[g.game_id]) for g in games[:-2]],
+        ignore_index=True,
+    )
+    y = pd.concat(
+        [model.compute_labels(g, actions[g.game_id]) for g in games[:-2]],
+        ignore_index=True,
+    )
+    model.fit(X, y, learner='logistic')
+    return model, X, y
+
+
+def test_features_one_row_per_shot(season):
+    games, actions = season
+    model = XGModel()
+    g = games[0]
+    X = model.compute_features(g, actions[g.game_id])
+    n_shots = actions[g.game_id]['type_id'].isin(spadlconfig.SHOT_LIKE).sum()
+    assert len(X) == n_shots
+    assert list(X.columns) == model.feature_column_names()
+
+
+def test_leak_filter_drops_shot_own_columns():
+    names = XGModel().feature_column_names()
+    assert not any(n.startswith('type_') and n.endswith('_a0') for n in names)
+    for leaked in ('dx_a0', 'dy_a0', 'movement_a0'):
+        assert leaked not in names
+    # the previous action's columns survive
+    assert any(n.endswith('_a1') for n in names)
+    # disabling the filter restores the full matrix
+    full = XGModel(drop_leaky=False).feature_column_names()
+    assert 'dx_a0' in full and len(full) > len(names)
+
+
+def test_labels_match_shot_results(season):
+    games, actions = season
+    model = XGModel()
+    g = games[0]
+    y = model.compute_labels(g, actions[g.game_id])
+    a = actions[g.game_id]
+    shots = a['type_id'].isin(spadlconfig.SHOT_LIKE)
+    expected = (a.loc[shots, 'result_id'] == spadlconfig.SUCCESS).to_numpy()
+    np.testing.assert_array_equal(y['goal'].to_numpy(), expected)
+
+
+def test_fit_estimate_nan_pattern(season, fitted):
+    games, actions = season
+    model, _, _ = fitted
+    g = games[-1]
+    out = model.estimate(g, actions[g.game_id])
+    shots = actions[g.game_id]['type_id'].isin(spadlconfig.SHOT_LIKE).to_numpy()
+    assert out['xg'].notna().to_numpy().tolist() == shots.tolist()
+    vals = out['xg'].dropna()
+    assert ((vals >= 0) & (vals <= 1)).all()
+
+
+def test_held_out_quality_beats_chance(season, fitted):
+    """Synthetic shots encode distance-dependent conversion (QUALITY.md);
+    a fitted xG model must recover it on held-out games."""
+    games, actions = season
+    model, _, _ = fitted
+    X = pd.concat(
+        [model.compute_features(g, actions[g.game_id]) for g in games[-2:]],
+        ignore_index=True,
+    )
+    y = pd.concat(
+        [model.compute_labels(g, actions[g.game_id]) for g in games[-2:]],
+        ignore_index=True,
+    )
+    assert y['goal'].nunique() == 2, 'need both classes in the held-out pool'
+    metrics = model.score(X, y)
+    assert metrics['auroc'] > 0.55
+    assert 0 < metrics['brier'] < 0.25
+
+
+def test_unknown_learner_raises(fitted):
+    model, X, y = fitted
+    with pytest.raises(ValueError, match='unknown learner'):
+        XGModel().fit(X, y, learner='nope')
+
+
+def test_unfitted_raises(season):
+    games, actions = season
+    with pytest.raises(ValueError, match='fit'):
+        XGModel().estimate(games[0], actions[games[0].game_id])
+
+
+def test_xgboost_learner_if_available(fitted):
+    pytest.importorskip('xgboost')
+    model, X, y = fitted
+    m = XGModel().fit(X, y, learner='xgboost')
+    assert m.clf is not None
+
+
+def test_default_xfns_match_notebook_recipe():
+    names = [f.__name__ for f in xfns_default]
+    assert names == [
+        'actiontype_onehot',
+        'bodypart_onehot',
+        'startlocation',
+        'movement',
+        'space_delta',
+        'startpolar',
+        'team',
+    ]
+
+
+def test_non_default_index_frames_are_normalized(season, fitted):
+    """A filtered frame (non-RangeIndex) must not misalign the features."""
+    games, actions = season
+    model, _, _ = fitted
+    g = games[0]
+    a = actions[g.game_id]
+    filtered = a[a['period_id'] == 1]  # keeps the original sparse index
+    X = model.compute_features(g, filtered)
+    n_shots = filtered['type_id'].isin(spadlconfig.SHOT_LIKE).sum()
+    assert len(X) == n_shots
+    est = model.estimate(g, filtered)
+    assert len(est) == len(filtered)
+    assert (est.index == filtered.index).all()
