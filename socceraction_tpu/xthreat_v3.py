@@ -33,6 +33,7 @@ from .spadl import config as spadlconfig
 
 __all__ = [
     'MOVE_PRIMARIES',
+    'ExpectedThreat',
     'ExpectedThreatV3',
     'encode_v3_actions',
     'get_move_actions',
@@ -152,6 +153,11 @@ class ExpectedThreatV3(_xt.ExpectedThreat):
     ) -> np.ndarray:
         """Rate successful widened-set move events; NaN elsewhere."""
         return super().rate(encode_v3_actions(events), use_interpolation)
+
+
+#: Reference-name alias: the reference's ``xthreat_v3.py`` exports the class
+#: as ``ExpectedThreat`` (same name as the standard module's class).
+ExpectedThreat = ExpectedThreatV3
 
 
 def load_model(path: str, backend: Optional[str] = None) -> ExpectedThreatV3:
