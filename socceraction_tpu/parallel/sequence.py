@@ -445,7 +445,7 @@ def sequence_rate(model, batch: Any, mesh: Mesh) -> jax.Array:
     collective — and only the bounded halos ever cross ICI. ``model`` is
     a fitted VAEP or AtomicVAEP with MLP heads.
     """
-    from ..ops.fused import REGISTRIES, fused_mlp_logits
+    from ..ops.fused import REGISTRIES, fused_pair_logits
 
     fam = _family_of(batch)
     if not model._can_fuse():
@@ -481,16 +481,17 @@ def sequence_rate(model, batch: Any, mesh: Mesh) -> jax.Array:
             )
             overrides = {'goalscore': gs_ext}
 
-        def probs(clf):
-            logits = fused_mlp_logits(
-                clf.params, ext, names=names, k=k,
-                hidden_layers=len(clf.hidden),
-                mean=clf.mean_, std=clf.std_, registry=registry,
-                dense_overrides=overrides,
-            )
-            return jax.nn.sigmoid(logits)
-
-        ps_e, pc_e = probs(clf_s), probs(clf_c)
+        # stacked two-head fold: one combined-table gather per state and
+        # one dense matmul serve both heads (ops/fused.py module NOTE)
+        logit_s, logit_c = fused_pair_logits(
+            clf_s.params, clf_c.params, ext, names=names, k=k,
+            hidden_layers_a=len(clf_s.hidden),
+            hidden_layers_b=len(clf_c.hidden),
+            mean_a=clf_s.mean_, std_a=clf_s.std_,
+            mean_b=clf_c.mean_, std_b=clf_c.std_,
+            registry=registry, dense_overrides=overrides,
+        )
+        ps_e, pc_e = jax.nn.sigmoid(logit_s), jax.nn.sigmoid(logit_c)
 
         # lag-1 views: local column j's predecessor is extended column
         # hl + j - 1 (the halo supplies j = 0's)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import warnings
 
+import numpy as np
 import pandas as pd
 import pytest
 
@@ -227,3 +228,42 @@ def wyscout_events():
         for i in range(8)
     ]
     return pd.DataFrame(rows)
+
+
+def test_determine_fns_fuzz_against_columnar_tables():
+    """Row-wise wrappers must equal the columnar decision tables on a
+    randomized sweep of the (type, subtype, tags) space."""
+    rng = np.random.default_rng(7)
+    n = 400
+    frame = pd.DataFrame(
+        {
+            'type_id': rng.choice([0, 1, 2, 3, 6, 8, 9, 10], size=n),
+            'subtype_id': rng.choice(
+                [0, 10, 11, 20, 25, 30, 31, 32, 33, 34, 35, 36, 50,
+                 70, 71, 72, 80, 81, 82, 85, 90, 91, 100],
+                size=n,
+            ),
+        }
+    )
+    for col in [
+        'head/body', 'own_goal', 'goal', 'high', 'accurate', 'not_accurate',
+        'interception', 'clearance', 'take_on_left', 'take_on_right',
+        'sliding_tackle',
+    ]:
+        frame[col] = rng.random(n) < 0.2
+    frame['offside'] = (rng.random(n) < 0.1).astype(int)
+
+    from socceraction_tpu.spadl.wyscout import (
+        _bodypart_ids,
+        _result_ids,
+        _type_ids,
+    )
+
+    types = _type_ids(frame)
+    results = _result_ids(frame)
+    bodyparts = _bodypart_ids(frame)
+    for i in range(n):
+        row = frame.iloc[i]
+        assert wy.determine_type_id(row) == types[i]
+        assert wy.determine_result_id(row) == results[i]
+        assert wy.determine_bodypart_id(row) == bodyparts[i]
