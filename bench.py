@@ -202,14 +202,17 @@ def bench_impl() -> dict:
     # from the abandoned child's log instead of degrading to CPU.
     print(json.dumps({**result, 'extra_configs_pending': True}), flush=True)
 
-    if platform == 'tpu':
+    force_extras = os.environ.get('SOCCERACTION_TPU_BENCH_FORCE_EXTRAS') == '1'
+    if platform == 'tpu' or force_extras:
         try:
             result['extra_configs'] = _bench_extra_configs()
         except Exception as e:  # extras must never sink the headline metric
             result['extra_configs_error'] = f'{type(e).__name__}: {e}'
     else:
         result['extra_configs_skipped'] = (
-            'extras run at 3k-game scale and only make sense on the chip'
+            'extras run at 3k-game scale and only make sense on the chip '
+            '(set SOCCERACTION_TPU_BENCH_FORCE_EXTRAS=1 plus the '
+            '*_XT_GAMES/*_STEP_GAMES knobs to drive them elsewhere)'
         )
     return result
 
@@ -238,8 +241,13 @@ def _bench_extra_configs() -> dict:
 
     out = {}
 
+    # scale knobs: chip-scale defaults, env-overridable so the whole extras
+    # path can be driven end-to-end on CPU (tests, degraded environments)
+    xt_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_XT_GAMES', 3072))
+    step_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_STEP_GAMES', 512))
+
     # --- xT at full-open-data scale (~3k games, BASELINE config 4) --------
-    season = synthetic_batch(n_games=3072, n_actions=1664, seed=2)
+    season = synthetic_batch(n_games=xt_games, n_actions=1664, seed=2)
     n_actions = int(season.total_actions)
     xt_args = (
         season.type_id, season.result_id,
@@ -256,7 +264,7 @@ def _bench_extra_configs() -> dict:
     dt = _measure(fit_16x12, xt_args, n_iters=5)
     _, it = fit_16x12(*xt_args)
     out['xt_fit_16x12_dense'] = {
-        'games': 3072,
+        'games': xt_games,
         'actions': n_actions,
         'seconds_per_fit': round(dt, 4),
         'iterations': int(it),
@@ -275,7 +283,7 @@ def _bench_extra_configs() -> dict:
     dt_mf = _measure(mf, xt_args, n_iters=3)
     n_iters_mf = int(mf(*xt_args)[1])
     out['xt_fit_192x125_matrix_free_100iter'] = {
-        'games': 3072,
+        'games': xt_games,
         'actions': n_actions,
         'grid': '192x125 (24000 cells)',
         'seconds_per_fit': round(dt_mf, 4),
@@ -294,7 +302,7 @@ def _bench_extra_configs() -> dict:
     dt_acc = _measure(mf_acc, xt_args, n_iters=3)
     sweeps_acc = int(mf_acc(*xt_args)[1])
     out['xt_fit_192x125_anderson_converged'] = {
-        'games': 3072,
+        'games': xt_games,
         'eps': 1e-5,
         'seconds_per_fit': round(dt_acc, 4),
         'sweeps': sweeps_acc,
@@ -307,7 +315,7 @@ def _bench_extra_configs() -> dict:
     from socceraction_tpu.parallel import make_mesh, make_train_step, shard_batch
 
     mesh = make_mesh(n_devices=1)
-    batch = synthetic_batch(n_games=512, n_actions=1664, seed=3)
+    batch = synthetic_batch(n_games=step_games, n_actions=1664, seed=3)
     sharded = shard_batch(batch, mesh)
     init_fn, step_fn, _ = make_train_step(mesh, _NAMES, k=_K, hidden=(128, 128))
     n_features = int(
@@ -326,13 +334,36 @@ def _bench_extra_configs() -> dict:
         params, opt_state, loss = step_fn(params, opt_state, sharded)
     float(loss)  # the params chain serializes steps; the fetch forces the last
     dt_step = (_time.perf_counter() - t0) / n_steps
+
+    # Chained steps cannot pipeline (each consumes the previous params),
+    # so through the remote tunnel every step pays the full per-execution
+    # round trip (~100 ms class) that the throughput paths amortize away.
+    # Calibrate that latency with a trivially small chained kernel so the
+    # reported step time can be read as latency + compute.
+    tiny = jax.numpy.zeros((8,), jax.numpy.float32)
+    bump = jax.jit(lambda x: x + 1.0)
+    tiny = bump(tiny)
+    float(tiny[0])
+    t0 = _time.perf_counter()
+    for _ in range(n_steps):
+        tiny = bump(tiny)
+    float(tiny[0])
+    chain_latency = (_time.perf_counter() - t0) / n_steps
     total = int(batch.total_actions)
+    compute_s = max(dt_step - chain_latency, 0.0)
     out['vaep_mlp_train_step'] = {
-        'games': 512,
+        'games': step_games,
         'actions': total,
         'features': n_features,
         'seconds_per_step': round(dt_step, 4),
         'actions_per_sec': round(total / dt_step, 1),
+        # the serialized-chain round trip baked into every step; on local
+        # (non-tunnel) TPU hardware this term vanishes
+        'chained_exec_latency_s': round(chain_latency, 4),
+        'est_compute_s_per_step': round(compute_s, 4),
+        'est_actions_per_sec_excl_latency': round(
+            total / compute_s, 1
+        ) if compute_s > 1e-4 else None,
         'final_loss_finite': bool(jax.numpy.isfinite(loss)),
     }
     return out
@@ -347,7 +378,11 @@ def _cpu_env() -> dict:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from socceraction_tpu.utils.env import cpu_device_env
 
-    return cpu_device_env(None)
+    env = cpu_device_env(None)
+    # never let a force-extras request follow us into the degraded CPU
+    # fallback: chip-scale extras on CPU would blow the child deadline
+    env.pop('SOCCERACTION_TPU_BENCH_FORCE_EXTRAS', None)
+    return env
 
 
 def _run_child(env: dict, deadline_s: float = None) -> tuple:
@@ -445,7 +480,10 @@ def main() -> None:
     # degraded mode: clean-environment CPU child so the driver still gets a
     # parseable measurement instead of a traceback
     rc, result, tail = _run_child(_cpu_env())
-    if rc == 0 and result is not None:
+    if result is not None and (rc == 0 or rc is None):
+        # rc None = the fallback child overran the deadline after emitting
+        # its headline line; salvage it like the primary attempts do
+        result.pop('extra_configs_pending', None)
         result['degraded'] = 'tpu_unavailable_cpu_fallback'
         result['diagnostics'] = diagnostics
         print(json.dumps(result))
