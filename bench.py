@@ -329,26 +329,36 @@ def _bench_extra_configs() -> dict:
     params, opt_state, loss = step_fn(params, opt_state, sharded)
     float(loss)  # fetch barrier (block_until_ready is unreliable on axon)
     n_steps = 10
-    t0 = _time.perf_counter()
-    for _ in range(n_steps):
-        params, opt_state, loss = step_fn(params, opt_state, sharded)
-    float(loss)  # the params chain serializes steps; the fetch forces the last
-    dt_step = (_time.perf_counter() - t0) / n_steps
+
+    def timed_steps():
+        nonlocal params, opt_state, loss
+        t0 = _time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, loss = step_fn(params, opt_state, sharded)
+        float(loss)  # the params chain serializes; the fetch forces the last
+        return (_time.perf_counter() - t0) / n_steps
+
+    # min-of-two against transient tunnel stalls, like _measure
+    dt_step = min(timed_steps(), timed_steps())
 
     # Chained steps cannot pipeline (each consumes the previous params),
     # so through the remote tunnel every step pays the full per-execution
     # round trip (~100 ms class) that the throughput paths amortize away.
     # Calibrate that latency with a trivially small chained kernel so the
     # reported step time can be read as latency + compute.
-    tiny = jax.numpy.zeros((8,), jax.numpy.float32)
     bump = jax.jit(lambda x: x + 1.0)
-    tiny = bump(tiny)
+    tiny = bump(jax.numpy.zeros((8,), jax.numpy.float32))
     float(tiny[0])
-    t0 = _time.perf_counter()
-    for _ in range(n_steps):
-        tiny = bump(tiny)
-    float(tiny[0])
-    chain_latency = (_time.perf_counter() - t0) / n_steps
+
+    def timed_chain():
+        nonlocal tiny
+        t0 = _time.perf_counter()
+        for _ in range(n_steps):
+            tiny = bump(tiny)
+        float(tiny[0])
+        return (_time.perf_counter() - t0) / n_steps
+
+    chain_latency = min(timed_chain(), timed_chain())
     total = int(batch.total_actions)
     compute_s = max(dt_step - chain_latency, 0.0)
     out['vaep_mlp_train_step'] = {
@@ -379,9 +389,16 @@ def _cpu_env() -> dict:
     from socceraction_tpu.utils.env import cpu_device_env
 
     env = cpu_device_env(None)
-    # never let a force-extras request follow us into the degraded CPU
-    # fallback: chip-scale extras on CPU would blow the child deadline
-    env.pop('SOCCERACTION_TPU_BENCH_FORCE_EXTRAS', None)
+    # never let chip-scale knobs follow us into the degraded CPU fallback:
+    # forced extras or a TPU-sized game count on CPU would blow the child
+    # deadline — the fallback must always run at the CPU-sized defaults
+    for knob in (
+        'SOCCERACTION_TPU_BENCH_FORCE_EXTRAS',
+        'SOCCERACTION_TPU_BENCH_GAMES',
+        'SOCCERACTION_TPU_BENCH_XT_GAMES',
+        'SOCCERACTION_TPU_BENCH_STEP_GAMES',
+    ):
+        env.pop(knob, None)
     return env
 
 
