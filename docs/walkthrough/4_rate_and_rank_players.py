@@ -61,11 +61,20 @@ def main() -> None:
         '(includes compile on first call)'
     )
 
-    # per-game DataFrame API (reference-style) for the last game
+    # per-game DataFrame API (reference-style) for the last game, with the
+    # built-in timer registry around it (utils/profiling.py — the pipeline
+    # store/pack stages record into the same registry)
+    from socceraction_tpu.utils.profiling import timed, timer_report
+
     game = games.iloc[-1]
     actions = store.get_actions(game.game_id)
-    ratings = model.rate(game, actions)
+    with timed('walkthrough/rate_one_game'):
+        ratings = model.rate(game, actions)
     print(f'game {game.game_id} rating columns: {list(ratings.columns)}')
+    report = timer_report()
+    print('timer registry (name: count, total s):')
+    for name, stats in report.items():
+        print(f'  {name}: {stats["count"]:.0f} calls, {stats["total_s"]:.3f} s')
 
     # ------------------------------------------------------------------
     # 2. aggregate to player rankings (notebook 4's final table)
