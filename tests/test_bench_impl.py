@@ -1,0 +1,85 @@
+"""The driver-facing bench script must stay runnable and parseable.
+
+``bench.py`` is the artifact the round driver executes; a regression that
+breaks its child (`--impl`) or the shape of its JSON line would silently
+cost the round's benchmark. This drives the child end-to-end on CPU with
+tiny scale knobs and pins the output contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_impl(extra_env):
+    from socceraction_tpu.utils.env import cpu_device_env
+
+    # the clean-CPU recipe has one source of truth; ambient bench knobs
+    # must not leak in (bench.py's _cpu_env strips them for the same reason)
+    env = cpu_device_env(None)
+    for knob in (
+        'SOCCERACTION_TPU_BENCH_FORCE_EXTRAS',
+        'SOCCERACTION_TPU_BENCH_GAMES',
+        'SOCCERACTION_TPU_BENCH_XT_GAMES',
+        'SOCCERACTION_TPU_BENCH_STEP_GAMES',
+    ):
+        env.pop(knob, None)
+    env['SOCCERACTION_TPU_BENCH_GAMES'] = '4'
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, 'bench.py'), '--impl'],
+        env=env,
+        cwd=_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=520,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith('{')]
+    assert lines, proc.stdout[-2000:]
+    return json.loads(lines[-1])
+
+
+def test_impl_headline_contract():
+    d = _run_impl({})
+    assert d['metric'] == 'vaep_rate_actions_per_sec'
+    assert d['value'] > 0
+    assert d['unit'] == 'actions/sec'
+    # vs_baseline is rounded to 3 decimals in the report
+    assert d['vs_baseline'] == pytest.approx(d['value'] / 1_000_000, abs=5e-4)
+    assert {'fused_actions_per_sec', 'materialized_actions_per_sec'} <= set(d)
+    # off-chip default: extras are skipped, not attempted
+    assert 'extra_configs_skipped' in d
+
+
+def test_impl_forced_extras_contract():
+    d = _run_impl(
+        {
+            'SOCCERACTION_TPU_BENCH_FORCE_EXTRAS': '1',
+            'SOCCERACTION_TPU_BENCH_XT_GAMES': '8',
+            'SOCCERACTION_TPU_BENCH_STEP_GAMES': '4',
+        }
+    )
+    extras = d.get('extra_configs')
+    assert extras, d.get('extra_configs_error')
+    assert set(extras) == {
+        'xt_fit_16x12_dense',
+        'xt_fit_192x125_matrix_free_100iter',
+        'xt_fit_192x125_anderson_converged',
+        'vaep_mlp_train_step',
+    }
+    step = extras['vaep_mlp_train_step']
+    assert step['final_loss_finite'] is True
+    assert step['seconds_per_step'] > 0
+    # the latency split must be internally consistent
+    assert step['chained_exec_latency_s'] >= 0
+    assert step['est_compute_s_per_step'] <= step['seconds_per_step'] + 1e-9
