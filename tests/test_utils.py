@@ -70,3 +70,27 @@ def test_cpu_device_env_strips_count():
     base = {'XLA_FLAGS': '--bar --xla_force_host_platform_device_count=4'}
     env = cpu_device_env(None, base=base)
     assert env['XLA_FLAGS'] == '--bar'
+
+
+def test_tpu_doctor_reports_cpu_environment():
+    """tools/tpu_doctor.py must classify a clean CPU env as 'cpu' (rc 0)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from socceraction_tpu.utils.env import cpu_device_env
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, 'tools', 'tpu_doctor.py'),
+         '--grace', '90'],
+        env=cpu_device_env(None),
+        capture_output=True,
+        text=True,
+        timeout=150,
+        cwd=root,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d['status'] == 'cpu' and d['ok'] is True

@@ -1,0 +1,140 @@
+"""TPU tunnel doctor: report what the accelerator path is actually doing.
+
+The remote-TPU ("axon") tunnel in this image fails in ways that look like
+hangs: a connecting client can block silently inside backend init for
+20-30 minutes before resolving to UNAVAILABLE, and killed clients wedge
+the tunnel for everyone (see docs/developing.md "Benchmarking on the
+remote TPU"). This tool probes the backend in a *subprocess* so the
+probing never wedges the calling process, and classifies the result:
+
+- ``up``            — devices resolved and a tiny computation round-tripped
+- ``connecting``    — the probe is still blocked after ``--grace`` seconds
+                      (the tunnel may resolve in ~20-30 min; the probe is
+                      left to finish on its own, never killed)
+- ``unavailable``   — backend init failed fast
+- ``cpu``           — no TPU plugin registered (CPU-only environment)
+
+Exit code is 0 for ``up``/``cpu``, 1 otherwise, so scripts can gate on it:
+
+    python tools/tpu_doctor.py [--grace 30] [--wait] [--interval 120]
+
+``--wait`` keeps polling until ``up``/``cpu``, with exactly ONE probe
+subprocess outstanding at any time: a probe that is still connecting is
+re-checked on the next cycle, never duplicated — piling extra clients
+onto a wedged tunnel is precisely the failure mode this tool exists to
+avoid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_PROBE = """
+import json, time
+t0 = time.time()
+try:
+    import jax
+    platform = jax.devices()[0].platform
+    import jax.numpy as jnp
+    value = float(jnp.sum(jnp.arange(64.0)))
+    print(json.dumps({'platform': platform, 'ok': value == 2016.0,
+                      'seconds': round(time.time() - t0, 1)}), flush=True)
+except RuntimeError as e:
+    print(json.dumps({'error': str(e)[:200],
+                      'seconds': round(time.time() - t0, 1)}), flush=True)
+"""
+
+
+def _start_probe():
+    """Launch one probe subprocess; returns (process, log_path)."""
+    logf = tempfile.NamedTemporaryFile(
+        mode='w', suffix='.log', prefix='tpu_doctor_', delete=False
+    )
+    proc = subprocess.Popen(
+        [sys.executable, '-c', _PROBE],
+        stdout=logf,
+        stderr=subprocess.STDOUT,
+    )
+    logf.close()  # the child holds its own fd; the parent never writes
+    return proc, logf.name
+
+
+def _classify(proc, log_path: str, grace_s: float):
+    """Wait up to ``grace_s`` for the probe; None while still connecting."""
+    deadline = time.monotonic() + grace_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(1.0)
+    if proc.poll() is None:
+        return None  # still blocked — caller re-checks later, never kills
+    with open(log_path) as f:
+        out = f.read()
+    os.unlink(log_path)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if 'platform' in d:
+            status = 'up' if d['platform'] == 'tpu' else 'cpu'
+            return {'status': status, **d}
+        if 'error' in d:
+            return {'status': 'unavailable', **d}
+    return {'status': 'unavailable', 'detail': out[-300:]}
+
+
+def probe(grace_s: float) -> dict:
+    """One-shot probe used by scripts: spawn, classify within the grace."""
+    proc, log_path = _start_probe()
+    result = _classify(proc, log_path, grace_s)
+    if result is None:
+        return {
+            'status': 'connecting',
+            'detail': f'probe still blocked after {grace_s:.0f}s '
+                      '(left to resolve on its own; tunnel wedges can take '
+                      '20-30 min to clear)',
+            'probe_log': log_path,
+        }
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--grace', type=float, default=30.0,
+                    help='seconds before a blocked probe is called connecting')
+    ap.add_argument('--wait', action='store_true',
+                    help='keep polling until the backend is up')
+    ap.add_argument('--interval', type=float, default=120.0,
+                    help='seconds between checks with --wait')
+    args = ap.parse_args()
+
+    proc, log_path = _start_probe()
+    while True:
+        result = _classify(proc, log_path, args.grace)
+        if result is None:
+            print(json.dumps({
+                'status': 'connecting',
+                'detail': 'probe still blocked (left to resolve on its own; '
+                          'tunnel wedges can take 20-30 min to clear)',
+                'probe_log': log_path,
+            }), flush=True)
+            if not args.wait:
+                sys.exit(1)
+            time.sleep(args.interval)
+            continue  # re-check the SAME probe; never stack a second client
+        print(json.dumps(result), flush=True)
+        if result['status'] in ('up', 'cpu'):
+            sys.exit(0)
+        if not args.wait:
+            sys.exit(1)
+        time.sleep(args.interval)
+        proc, log_path = _start_probe()  # previous probe resolved; next one
+
+
+if __name__ == '__main__':
+    main()
