@@ -498,9 +498,14 @@ def main() -> None:
     # parseable measurement instead of a traceback
     rc, result, tail = _run_child(_cpu_env())
     if result is not None and (rc == 0 or rc is None):
-        # rc None = the fallback child overran the deadline after emitting
-        # its headline line; salvage it like the primary attempts do
-        result.pop('extra_configs_pending', None)
+        if result.pop('extra_configs_pending', None) and rc is None:
+            # the fallback child overran the deadline after emitting its
+            # headline; annotate the abandoned extras like the primary
+            # attempts' salvage does
+            result['extra_configs_error'] = (
+                'extras exceeded the fallback child deadline '
+                '(headline salvaged from the abandoned child)'
+            )
         result['degraded'] = 'tpu_unavailable_cpu_fallback'
         result['diagnostics'] = diagnostics
         print(json.dumps(result))

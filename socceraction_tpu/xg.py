@@ -130,9 +130,11 @@ class XGModel:
         """``goal`` label per shot: the shot scored.
 
         Delegates to :func:`~socceraction_tpu.vaep.labels.goal_from_shot`
-        so the goal definition cannot drift from the VAEP labels.
+        so the goal definition cannot drift from the VAEP labels. Labels
+        need no game states, so none are built (unlike the feature path).
         """
-        actions, _, shots = self._shot_states(game, game_actions)
+        actions = spadlutils.add_names(game_actions.reset_index(drop=True))
+        shots = actions['type_id'].isin(spadlconfig.SHOT_LIKE).to_numpy()
         goal = goal_from_shot(actions)['goal_from_shot'].to_numpy()
         return pd.DataFrame({'goal': goal[shots]})
 

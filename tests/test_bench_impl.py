@@ -21,18 +21,12 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_impl(extra_env):
-    from socceraction_tpu.utils.env import cpu_device_env
+    sys.path.insert(0, _ROOT)
+    from bench import _cpu_env
 
-    # the clean-CPU recipe has one source of truth; ambient bench knobs
-    # must not leak in (bench.py's _cpu_env strips them for the same reason)
-    env = cpu_device_env(None)
-    for knob in (
-        'SOCCERACTION_TPU_BENCH_FORCE_EXTRAS',
-        'SOCCERACTION_TPU_BENCH_GAMES',
-        'SOCCERACTION_TPU_BENCH_XT_GAMES',
-        'SOCCERACTION_TPU_BENCH_STEP_GAMES',
-    ):
-        env.pop(knob, None)
+    # bench's own fallback env builder is the single source of truth for
+    # the clean-CPU recipe AND the ambient-knob stripping
+    env = _cpu_env()
     env['SOCCERACTION_TPU_BENCH_GAMES'] = '4'
     env.update(extra_env)
     proc = subprocess.run(

@@ -1,0 +1,50 @@
+"""The README quickstart must run as written.
+
+Extracts the first python code block from README.md, substitutes the
+placeholder data root with the checked-in StatsBomb fixture and the /tmp
+paths with a pytest tmpdir, and executes it in a subprocess. A quickstart
+a new user cannot paste-and-run is worse than none (same policy as the
+walkthrough and example guards).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_readme_quickstart_runs(tmp_path):
+    readme = open(os.path.join(_ROOT, 'README.md')).read()
+    blocks = re.findall(r'```python\n(.*?)```', readme, flags=re.DOTALL)
+    assert blocks, 'README has no python quickstart block'
+    code = blocks[0]
+    assert 'build_spadl_store' in code  # the block this test pins
+
+    # the placeholders this test knows how to rewrite must be the ONLY ones
+    placeholders = ["'.../open-data/data'", "'/tmp/season_store'", "'/tmp/vaep_ckpt'"]
+    for ph in placeholders:
+        assert ph in code, f'expected quickstart placeholder {ph} missing'
+    fixture = os.path.join(_ROOT, 'tests', 'datasets', 'statsbomb', 'raw')
+    code = code.replace("'.../open-data/data'", repr(fixture))
+    code = code.replace("'/tmp/season_store'", repr(str(tmp_path / 'store')))
+    code = code.replace("'/tmp/vaep_ckpt'", repr(str(tmp_path / 'ckpt')))
+    assert '...' not in code, (
+        'README quickstart contains a placeholder this test does not rewrite'
+    )
+
+    proc = subprocess.run(
+        [sys.executable, '-c', code],
+        capture_output=True,
+        text=True,
+        timeout=520,
+        cwd=_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]

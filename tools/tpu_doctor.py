@@ -88,21 +88,6 @@ def _classify(proc, log_path: str, grace_s: float):
     return {'status': 'unavailable', 'detail': out[-300:]}
 
 
-def probe(grace_s: float) -> dict:
-    """One-shot probe used by scripts: spawn, classify within the grace."""
-    proc, log_path = _start_probe()
-    result = _classify(proc, log_path, grace_s)
-    if result is None:
-        return {
-            'status': 'connecting',
-            'detail': f'probe still blocked after {grace_s:.0f}s '
-                      '(left to resolve on its own; tunnel wedges can take '
-                      '20-30 min to clear)',
-            'probe_log': log_path,
-        }
-    return result
-
-
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument('--grace', type=float, default=30.0,
