@@ -30,8 +30,10 @@ SPADL frame like every other provider converter:
 5. shared post-processing: direction of play, clearances, action ids,
    dribble synthesis, schema validation (upstream ``_sa`` semantics)
 
-The xA enrichment (``:206-223``) does not belong in a SPADL frame; it is
-exposed separately as :func:`add_expected_assists`.
+The xA enrichment (``:206-223``) never lands in the SPADL frame itself:
+:func:`fix_wyscout_events` attaches it to the *events* when the feed
+carries ``shot_xg`` (reference behavior) and skips it otherwise, and
+:func:`add_expected_assists` stays callable on its own.
 """
 
 from __future__ import annotations
@@ -170,12 +172,15 @@ def fix_wyscout_events(df_events: pd.DataFrame) -> pd.DataFrame:
     """Event surgery on the raw (0-100)² Wyscout-v3 pitch.
 
     Chains the rewriting stages in the reference's order
-    (``spadl/wyscout_v3.py:128-153``), with one documented deviation:
-    :func:`add_expected_assists` is NOT part of the chain here — it
+    (``spadl/wyscout_v3.py:128-153``). :func:`add_expected_assists`
     requires a ``shot_xg`` feed column that not every v3 export carries,
-    so xA attachment is a separate opt-in step.
+    so it runs conditionally: feeds that carry the column get the
+    reference behavior (the xA column on the returned events), feeds
+    that don't simply skip the stage instead of erroring.
     """
     df_events = create_shot_coordinates(df_events)
+    if 'shot_xg' in df_events.columns:
+        df_events = add_expected_assists(df_events)
     df_events = convert_duels(df_events)
     df_events = insert_interception_coordinates(df_events)
     df_events = add_offside_variable(df_events)

@@ -163,7 +163,8 @@ class XGModel:
                 f'unknown learner {learner!r}; choose from {sorted(learners)}'
             )
         yv = (y['goal'] if isinstance(y, pd.DataFrame) else y).astype(int)
-        self.clf = learners[learner](X, yv, eval_set=None, **kwargs)
+        kwargs.setdefault('eval_set', None)  # caller-supplied eval_set wins
+        self.clf = learners[learner](X, yv, **kwargs)
         return self
 
     def estimate(self, game, game_actions: pd.DataFrame) -> pd.DataFrame:

@@ -189,3 +189,14 @@ def test_add_expected_assists(v3_events):
     xa = out.loc[out['id'] == 104, 'metric_xa']
     assert xa.iloc[0] == pytest.approx(0.3)
     assert out.loc[out['id'] == 101, 'metric_xa'].isna().all()
+
+
+def test_fix_events_attaches_xa_when_feed_carries_shot_xg(v3_events):
+    # feeds WITH shot_xg get the reference chain's xA column...
+    fixed = wyscout_v3.fix_wyscout_events(wyscout_v3.make_new_positions(v3_events))
+    assert fixed.loc[fixed['id'] == 104, 'metric_xa'].iloc[0] == pytest.approx(0.3)
+    # ...and feeds WITHOUT it skip the stage instead of erroring
+    bare = wyscout_v3.fix_wyscout_events(
+        wyscout_v3.make_new_positions(v3_events.drop(columns=['shot_xg']))
+    )
+    assert 'metric_xa' not in bare.columns
