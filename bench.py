@@ -18,11 +18,17 @@ north_star). Extra keys carry the per-path numbers, platform, and any
 degradation diagnostics.
 
 Robustness (the round-1 bench died rc=1 on a transient axon-tunnel
-failure): the measurement runs in a child process. On child failure the
-parent retries once after a delay, then falls back to a clean-environment
-CPU child; a hung child (wedged tunnel) is abandoned — never killed, a
-killed TPU client wedges the tunnel further — and the CPU fallback result
-is reported instead. The parent always exits 0 with a JSON line.
+failure; the round-3 bench burned 840s of child deadlines discovering a
+wedged tunnel): the parent first TRIAGES the accelerator path with
+``tools/tpu_doctor.py``'s subprocess probe (~60s bound) and goes straight
+to the CPU fallback when the tunnel is wedged or unavailable. When the
+chip is reachable, the measurement runs in a child process with a
+persistent XLA compilation cache (warm retries skip the multi-minute
+compiles). On child failure the parent retries once after a delay, then
+falls back to a clean-environment CPU child; a hung child (wedged tunnel)
+is abandoned — never killed, a killed TPU client wedges the tunnel
+further — and the CPU fallback result is reported instead. The parent
+always exits 0 with a JSON line.
 """
 
 from __future__ import annotations
@@ -49,8 +55,11 @@ _RETRY_DELAY_S = float(os.environ.get('SOCCERACTION_TPU_BENCH_RETRY_DELAY', 30))
 # --------------------------------------------------------------------------
 
 
-def _measure(fn, args, *, n_iters: int = 10) -> float:
-    """Wall-clock seconds per call of ``fn(*args)`` after warmup.
+def _measure(fn, args, *, n_iters: int = 10) -> tuple:
+    """(wall-clock seconds per call of ``fn(*args)`` after warmup, reliable).
+
+    The second element is False when the marginal estimate degenerated
+    (t_big <= t_small) and the raw mean was reported instead.
 
     Uses a HOST FETCH as the completion barrier, not
     ``jax.block_until_ready``: on the remote-TPU ("axon") platform,
@@ -84,7 +93,17 @@ def _measure(fn, args, *, n_iters: int = 10) -> float:
     # estimates both stalling has not been observed).
     t_small = min(timed(1) for _ in range(2))
     t_big = min(timed(n_iters) for _ in range(2))
-    return max((t_big - t_small) / (n_iters - 1), 1e-9)
+    return _per_call(t_small, t_big, n_iters)
+
+
+def _per_call(t_small: float, t_big: float, n_iters: int) -> tuple:
+    """(seconds per call, reliable) from the two timing aggregates."""
+    if t_big <= t_small:
+        # Per-call time is below the timing noise floor at this scale: the
+        # marginal estimate is meaningless (and clamping it would report an
+        # absurd ~1e9x throughput). Fall back to the raw mean and say so.
+        return t_big / n_iters, False
+    return (t_big - t_small) / (n_iters - 1), True
 
 
 # Peak specs for roofline context, per device_kind prefix. v5 lite (v5e):
@@ -169,8 +188,8 @@ def bench_impl() -> dict:
 
     fused_jit = jax.jit(fused_forward)
     mat_jit = jax.jit(materialized_forward)
-    dt_fused = _measure(fused_jit, (params, batch))
-    dt_mat = _measure(mat_jit, (params, batch))
+    dt_fused, fused_reliable = _measure(fused_jit, (params, batch))
+    dt_mat, mat_reliable = _measure(mat_jit, (params, batch))
 
     fused_aps = total_actions / dt_fused
     mat_aps = total_actions / dt_mat
@@ -191,6 +210,11 @@ def bench_impl() -> dict:
         'flagship': 'fused',
         'flagship_is_fastest': bool(fused_aps >= mat_aps),
     }
+    if not (fused_reliable and mat_reliable):
+        result['measurement_unreliable'] = (
+            'marginal-time estimate degenerated (t_big <= t_small); '
+            'raw mean reported'
+        )
 
     flops, bytes_acc = _cost_analysis(fused_jit, (params, batch))
     roof = _roofline(device_kind, dt_fused, flops, bytes_acc)
@@ -261,7 +285,7 @@ def _bench_extra_configs() -> dict:
         probs = xt_probabilities(counts, l=16, w=12)
         return solve_xt(probs)
 
-    dt = _measure(fit_16x12, xt_args, n_iters=5)
+    dt, reliable = _measure(fit_16x12, xt_args, n_iters=5)
     _, it = fit_16x12(*xt_args)
     out['xt_fit_16x12_dense'] = {
         'games': xt_games,
@@ -269,6 +293,7 @@ def _bench_extra_configs() -> dict:
         'seconds_per_fit': round(dt, 4),
         'iterations': int(it),
         'actions_per_sec': round(n_actions / dt, 1),
+        **({} if reliable else {'measurement_unreliable': True}),
     }
 
     # eps=0 can never be undershot by a positive diff, so the while_loop
@@ -280,7 +305,7 @@ def _bench_extra_configs() -> dict:
             solve_xt_matrix_free, l=192, w=125, eps=0.0, max_iter=100
         )
     )
-    dt_mf = _measure(mf, xt_args, n_iters=3)
+    dt_mf, mf_reliable = _measure(mf, xt_args, n_iters=3)
     n_iters_mf = int(mf(*xt_args)[1])
     out['xt_fit_192x125_matrix_free_100iter'] = {
         'games': xt_games,
@@ -289,6 +314,7 @@ def _bench_extra_configs() -> dict:
         'seconds_per_fit': round(dt_mf, 4),
         'iterations': n_iters_mf,
         'sweep_iters_per_sec': round(n_iters_mf / dt_mf, 1),
+        **({} if mf_reliable else {'measurement_unreliable': True}),
     }
 
     # converged fine-grid fit with Anderson acceleration (opt-in solver;
@@ -299,7 +325,7 @@ def _bench_extra_configs() -> dict:
             accelerate=True,
         )
     )
-    dt_acc = _measure(mf_acc, xt_args, n_iters=3)
+    dt_acc, acc_reliable = _measure(mf_acc, xt_args, n_iters=3)
     sweeps_acc = int(mf_acc(*xt_args)[1])
     out['xt_fit_192x125_anderson_converged'] = {
         'games': xt_games,
@@ -309,6 +335,7 @@ def _bench_extra_configs() -> dict:
         # sweeps == max_iter means the cap exited the loop, not eps —
         # then this is NOT a converged-cost measurement
         'converged': sweeps_acc < 100,
+        **({} if acc_reliable else {'measurement_unreliable': True}),
     }
 
     # --- fused VAEP MLP train step (BASELINE config 5's kernel) -----------
@@ -402,9 +429,46 @@ def _cpu_env() -> dict:
     return env
 
 
+def _triage_tunnel() -> dict:
+    """Classify the accelerator path BEFORE spending any child deadline on it.
+
+    Round 3 burned 840s of child deadlines (540 + 300) discovering a
+    wedged tunnel; ``tools/tpu_doctor.py``'s subprocess probe classifies
+    the same condition in ~60s without wedging anything (the probe is
+    abandoned, never killed, if it blocks). When the environment already
+    forces CPU there is nothing to probe.
+    """
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    axon_disabled = os.environ.get('PALLAS_AXON_POOL_IPS', 'unset') == ''
+    if platforms and 'tpu' not in platforms and axon_disabled:
+        # JAX_PLATFORMS alone is not trustworthy: the axon sitecustomize
+        # hook latches the platform back to the remote-TPU plugin unless
+        # PALLAS_AXON_POOL_IPS='' also disables registration (this is the
+        # cpu_device_env recipe, utils/env.py).
+        return {'status': 'cpu', 'detail': f'JAX_PLATFORMS={platforms}, axon disabled'}
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, 'tools'))
+    try:
+        from tpu_doctor import triage
+    except Exception as e:  # triage is an optimization, never a gate
+        return {'status': 'unknown', 'detail': f'tpu_doctor unavailable: {e}'}
+    t0 = time.monotonic()
+    grace = float(os.environ.get('SOCCERACTION_TPU_BENCH_TRIAGE_GRACE', 60))
+    out = triage(grace_s=grace)
+    out['triage_seconds'] = round(time.monotonic() - t0, 1)
+    return out
+
+
 def _run_child(env: dict, deadline_s: float = None) -> tuple:
     """Run ``bench.py --impl``; return (rc_or_None_if_hung, last_json_or_None, tail)."""
     here = os.path.dirname(os.path.abspath(__file__))
+    # Persistent XLA compilation cache: a warm retry after a crash or hang
+    # skips the multi-minute cold compiles and fits easily inside the
+    # child deadline. Shared across TPU/CPU children (cache keys differ
+    # by platform); .cache/ is gitignored.
+    env.setdefault(
+        'JAX_COMPILATION_CACHE_DIR', os.path.join(here, '.cache', 'jax')
+    )
     with tempfile.NamedTemporaryFile(
         mode='w+', suffix='.log', prefix='bench_child_', delete=False
     ) as logf:
@@ -447,6 +511,17 @@ def main() -> None:
         return
 
     diagnostics = []
+    triage = _triage_tunnel()
+    diagnostics.append(
+        'triage: ' + json.dumps(triage, sort_keys=True)
+    )
+    if triage['status'] in ('connecting', 'unavailable'):
+        # The tunnel is wedged or down: skip the TPU attempts entirely
+        # (they would each eat a full child deadline rediscovering this)
+        # and report the CPU fallback with the sub-minute triage on record.
+        _cpu_fallback(diagnostics)
+        return
+
     # attempt 1 + one retry on the inherited (TPU) environment. A retry
     # after a CRASH keeps the full deadline (cold TPU compiles legitimately
     # take most of it); a retry after a HANG gets a reduced one, so the
@@ -494,8 +569,12 @@ def main() -> None:
         if attempt == 0:
             time.sleep(_RETRY_DELAY_S)
 
-    # degraded mode: clean-environment CPU child so the driver still gets a
-    # parseable measurement instead of a traceback
+    _cpu_fallback(diagnostics)
+
+
+def _cpu_fallback(diagnostics: list) -> None:
+    """Degraded mode: clean-environment CPU child so the driver still gets
+    a parseable measurement instead of a traceback."""
     rc, result, tail = _run_child(_cpu_env())
     if result is not None and (rc == 0 or rc is None):
         if result.pop('extra_configs_pending', None) and rc is None:

@@ -73,7 +73,7 @@ def main():
     ]
     prev = 0.0
     for name, fn in stages:
-        dt = _measure(jax.jit(fn), (params, batch))
+        dt, _ = _measure(jax.jit(fn), (params, batch))
         print(
             f'{name:>40}: {dt * 1e3:7.2f} ms  '
             f'(marginal {max(dt - prev, 0) * 1e3:6.2f} ms)  '

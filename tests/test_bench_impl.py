@@ -43,6 +43,30 @@ def _run_impl(extra_env):
     return json.loads(lines[-1])
 
 
+def test_per_call_marginal_and_degenerate():
+    sys.path.insert(0, _ROOT)
+    from bench import _per_call
+
+    dt, reliable = _per_call(0.1, 1.0, 10)
+    assert reliable and dt == pytest.approx(0.9 / 9)
+    # t_big <= t_small: marginal is meaningless — raw mean, flagged
+    dt, reliable = _per_call(0.5, 0.4, 10)
+    assert not reliable and dt == pytest.approx(0.04)
+
+
+def test_triage_short_circuits_on_forced_cpu(monkeypatch):
+    sys.path.insert(0, _ROOT)
+    from bench import _triage_tunnel
+
+    # the cpu_device_env recipe: platform forced AND axon plugin disabled
+    monkeypatch.setenv('JAX_PLATFORMS', 'cpu')
+    monkeypatch.setenv('PALLAS_AXON_POOL_IPS', '')
+    out = _triage_tunnel()
+    # no probe subprocess: the env already rules out a TPU path
+    assert out['status'] == 'cpu'
+    assert 'triage_seconds' not in out
+
+
 def test_impl_headline_contract():
     d = _run_impl({})
     assert d['metric'] == 'vaep_rate_actions_per_sec'

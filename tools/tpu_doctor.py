@@ -88,6 +88,27 @@ def _classify(proc, log_path: str, grace_s: float):
     return {'status': 'unavailable', 'detail': out[-300:]}
 
 
+def triage(grace_s: float = 30.0) -> dict:
+    """One-shot classification for callers (bench.py) that gate on tunnel health.
+
+    Launches a single probe subprocess and waits up to ``grace_s``. A probe
+    still blocked after the grace window is ABANDONED, never killed (a
+    killed axon client wedges the tunnel); it resolves on its own and its
+    log stays on disk for inspection. Returns the same status dicts the
+    CLI prints: ``up`` / ``cpu`` / ``unavailable`` / ``connecting``.
+    """
+    proc, log_path = _start_probe()
+    result = _classify(proc, log_path, grace_s)
+    if result is None:
+        return {
+            'status': 'connecting',
+            'detail': 'probe still blocked after grace window '
+                      '(abandoned to resolve on its own, never killed)',
+            'probe_log': log_path,
+        }
+    return result
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument('--grace', type=float, default=30.0,
