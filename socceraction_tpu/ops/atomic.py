@@ -43,7 +43,8 @@ class _AtomicStates:
 
     def __init__(self, batch: AtomicActionBatch, k: int):
         self.k = k
-        f = jnp.float32
+        # follow the packed float dtype (see ops.features._States)
+        f = self.f = batch.time_seconds.dtype
         a0_home = batch.is_home
         self.a0_home = a0_home
 
@@ -74,7 +75,7 @@ class _AtomicStates:
 
 
 def _actiontype(s: _AtomicStates) -> jax.Array:
-    return _stack([s.type_id[i].astype(jnp.float32) for i in range(s.k)])
+    return _stack([s.type_id[i].astype(s.f) for i in range(s.k)], s.f)
 
 
 def _actiontype_onehot(s: _AtomicStates) -> jax.Array:
@@ -84,18 +85,18 @@ def _actiontype_onehot(s: _AtomicStates) -> jax.Array:
             col = s.type_id[i] == ids[0]
             for t in ids[1:]:
                 col = col | (s.type_id[i] == t)
-            cols.append(col.astype(jnp.float32))
-    return _stack(cols)
+            cols.append(col.astype(s.f))
+    return _stack(cols, s.f)
 
 
 def _bodypart(s: _AtomicStates) -> jax.Array:
-    return _stack([s.bodypart_id[i].astype(jnp.float32) for i in range(s.k)])
+    return _stack([s.bodypart_id[i].astype(s.f) for i in range(s.k)], s.f)
 
 
 def _bodypart_onehot(s: _AtomicStates) -> jax.Array:
     return jnp.concatenate(
         [
-            jax.nn.one_hot(s.bodypart_id[i], _N_BODYPARTS, dtype=jnp.float32)
+            jax.nn.one_hot(s.bodypart_id[i], _N_BODYPARTS, dtype=s.f)
             for i in range(s.k)
         ],
         axis=-1,
@@ -107,16 +108,20 @@ def _time(s: _AtomicStates) -> jax.Array:
     for i in range(s.k):
         overall = (s.period_id[i] - 1) * 45 * 60 + s.time_seconds[i]
         cols += [s.period_id[i], s.time_seconds[i], overall]
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _team(s: _AtomicStates) -> jax.Array:
-    return _stack([(s.is_home[i] == s.is_home[0]) for i in range(1, s.k)], s.is_home[0])
+    return _stack(
+        [(s.is_home[i] == s.is_home[0]) for i in range(1, s.k)], s.f, s.is_home[0]
+    )
 
 
 def _time_delta(s: _AtomicStates) -> jax.Array:
     return _stack(
-        [s.time_seconds[0] - s.time_seconds[i] for i in range(1, s.k)], s.is_home[0]
+        [s.time_seconds[0] - s.time_seconds[i] for i in range(1, s.k)],
+        s.f,
+        s.is_home[0],
     )
 
 
@@ -124,7 +129,7 @@ def _location(s: _AtomicStates) -> jax.Array:
     cols = []
     for i in range(s.k):
         cols += [s.x[i], s.y[i]]
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _polar(s: _AtomicStates) -> jax.Array:
@@ -134,7 +139,7 @@ def _polar(s: _AtomicStates) -> jax.Array:
         dy = jnp.abs(_GOAL_Y - s.y[i])
         cols.append(jnp.sqrt(dx**2 + dy**2))
         cols.append(jnp.nan_to_num(jnp.arctan(dy / dx)))
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _movement_polar(s: _AtomicStates) -> jax.Array:
@@ -143,7 +148,7 @@ def _movement_polar(s: _AtomicStates) -> jax.Array:
         d = jnp.sqrt(s.dx[i] ** 2 + s.dy[i] ** 2)
         angle = jnp.where(s.dy[i] == 0, 0.0, jnp.arctan2(s.dy[i], s.dx[i]))
         cols += [d, angle]
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _direction(s: _AtomicStates) -> jax.Array:
@@ -153,7 +158,7 @@ def _direction(s: _AtomicStates) -> jax.Array:
         safe = jnp.where(total > 0, total, 1.0)
         cols.append(jnp.where(total > 0, s.dx[i] / safe, s.dx[i]))
         cols.append(jnp.where(total > 0, s.dy[i] / safe, s.dy[i]))
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _goalscore(s: _AtomicStates) -> jax.Array:
@@ -161,12 +166,12 @@ def _goalscore(s: _AtomicStates) -> jax.Array:
     teamisA = s.is_home[0] == s.is_home[0][:, :1]
     goalsA = (goals & teamisA) | (owngoals & ~teamisA)
     goalsB = (goals & ~teamisA) | (owngoals & teamisA)
-    f = jnp.float32
+    f = s.f
     scoreA = jnp.cumsum(goalsA.astype(f), axis=1) - goalsA.astype(f)
     scoreB = jnp.cumsum(goalsB.astype(f), axis=1) - goalsB.astype(f)
     team_score = jnp.where(teamisA, scoreA, scoreB)
     opp_score = jnp.where(teamisA, scoreB, scoreA)
-    return _stack([team_score, opp_score, team_score - opp_score])
+    return _stack([team_score, opp_score, team_score - opp_score], s.f)
 
 
 ATOMIC_KERNELS: Dict[str, object] = {

@@ -57,7 +57,10 @@ class _States:
 
     def __init__(self, batch: ActionBatch, k: int):
         self.k = k
-        f = jnp.float32
+        # Follow the packed float dtype: float32 in production, float64
+        # when packed with float_dtype=np.float64 under JAX x64 (the
+        # device-kernel parity audit, tests/test_float64_audit.py).
+        f = self.f = batch.time_seconds.dtype
         a0_home = batch.is_home  # (G, A): flip decided by the current action
         self.a0_home = a0_home
 
@@ -79,38 +82,38 @@ class _States:
         self.end_y = [ltr(_shift_gather(batch.end_y, i).astype(f), W) for i in range(k)]
 
 
-def _stack(cols: List[jax.Array], like: jax.Array = None) -> jax.Array:
-    """Stack per-column ``(G, A)`` arrays into a ``(G, A, F)`` block.
+def _stack(cols: List[jax.Array], f, like: jax.Array = None) -> jax.Array:
+    """Stack per-column ``(G, A)`` arrays into a ``(G, A, F)`` block of dtype ``f``.
 
     An empty column list yields a zero-width block (state features with
     ``nb_prev_actions == 1``), matching the pandas backend's empty frames.
     """
     if not cols:
-        return jnp.zeros((*like.shape, 0), dtype=jnp.float32)
-    return jnp.stack(cols, axis=-1).astype(jnp.float32)
+        return jnp.zeros((*like.shape, 0), dtype=f)
+    return jnp.stack(cols, axis=-1).astype(f)
 
 
 # --- per-transformer blocks (names match the pandas transformers) ----------
 
 
 def _actiontype(s: _States) -> jax.Array:
-    return _stack([s.type_id[i].astype(jnp.float32) for i in range(s.k)])
+    return _stack([s.type_id[i].astype(s.f) for i in range(s.k)], s.f)
 
 
 def _actiontype_onehot(s: _States) -> jax.Array:
     return jnp.concatenate(
-        [jax.nn.one_hot(s.type_id[i], _N_TYPES, dtype=jnp.float32) for i in range(s.k)],
+        [jax.nn.one_hot(s.type_id[i], _N_TYPES, dtype=s.f) for i in range(s.k)],
         axis=-1,
     )
 
 
 def _result(s: _States) -> jax.Array:
-    return _stack([s.result_id[i].astype(jnp.float32) for i in range(s.k)])
+    return _stack([s.result_id[i].astype(s.f) for i in range(s.k)], s.f)
 
 
 def _result_onehot(s: _States) -> jax.Array:
     return jnp.concatenate(
-        [jax.nn.one_hot(s.result_id[i], _N_RESULTS, dtype=jnp.float32) for i in range(s.k)],
+        [jax.nn.one_hot(s.result_id[i], _N_RESULTS, dtype=s.f) for i in range(s.k)],
         axis=-1,
     )
 
@@ -118,21 +121,21 @@ def _result_onehot(s: _States) -> jax.Array:
 def _actiontype_result_onehot(s: _States) -> jax.Array:
     blocks = []
     for i in range(s.k):
-        ty = jax.nn.one_hot(s.type_id[i], _N_TYPES, dtype=jnp.float32)
-        re = jax.nn.one_hot(s.result_id[i], _N_RESULTS, dtype=jnp.float32)
+        ty = jax.nn.one_hot(s.type_id[i], _N_TYPES, dtype=s.f)
+        re = jax.nn.one_hot(s.result_id[i], _N_RESULTS, dtype=s.f)
         # type-major flattening matches the reference's nested column loop
         blocks.append((ty[..., :, None] * re[..., None, :]).reshape(*ty.shape[:-1], -1))
     return jnp.concatenate(blocks, axis=-1)
 
 
 def _bodypart(s: _States) -> jax.Array:
-    return _stack([s.bodypart_id[i].astype(jnp.float32) for i in range(s.k)])
+    return _stack([s.bodypart_id[i].astype(s.f) for i in range(s.k)], s.f)
 
 
 def _bodypart_onehot(s: _States) -> jax.Array:
     return jnp.concatenate(
         [
-            jax.nn.one_hot(s.bodypart_id[i], _N_BODYPARTS, dtype=jnp.float32)
+            jax.nn.one_hot(s.bodypart_id[i], _N_BODYPARTS, dtype=s.f)
             for i in range(s.k)
         ],
         axis=-1,
@@ -144,21 +147,21 @@ def _time(s: _States) -> jax.Array:
     for i in range(s.k):
         overall = (s.period_id[i] - 1) * 45 * 60 + s.time_seconds[i]
         cols += [s.period_id[i], s.time_seconds[i], overall]
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _startlocation(s: _States) -> jax.Array:
     cols = []
     for i in range(s.k):
         cols += [s.start_x[i], s.start_y[i]]
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _endlocation(s: _States) -> jax.Array:
     cols = []
     for i in range(s.k):
         cols += [s.end_x[i], s.end_y[i]]
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _polar(x: jax.Array, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -173,14 +176,14 @@ def _startpolar(s: _States) -> jax.Array:
     cols = []
     for i in range(s.k):
         cols += list(_polar(s.start_x[i], s.start_y[i]))
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _endpolar(s: _States) -> jax.Array:
     cols = []
     for i in range(s.k):
         cols += list(_polar(s.end_x[i], s.end_y[i]))
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _movement(s: _States) -> jax.Array:
@@ -189,16 +192,20 @@ def _movement(s: _States) -> jax.Array:
         dx = s.end_x[i] - s.start_x[i]
         dy = s.end_y[i] - s.start_y[i]
         cols += [dx, dy, jnp.sqrt(dx**2 + dy**2)]
-    return _stack(cols)
+    return _stack(cols, s.f)
 
 
 def _team(s: _States) -> jax.Array:
-    return _stack([(s.is_home[i] == s.is_home[0]) for i in range(1, s.k)], s.is_home[0])
+    return _stack(
+        [(s.is_home[i] == s.is_home[0]) for i in range(1, s.k)], s.f, s.is_home[0]
+    )
 
 
 def _time_delta(s: _States) -> jax.Array:
     return _stack(
-        [s.time_seconds[0] - s.time_seconds[i] for i in range(1, s.k)], s.is_home[0]
+        [s.time_seconds[0] - s.time_seconds[i] for i in range(1, s.k)],
+        s.f,
+        s.is_home[0],
     )
 
 
@@ -208,7 +215,7 @@ def _space_delta(s: _States) -> jax.Array:
         dx = s.end_x[i] - s.start_x[0]
         dy = s.end_y[i] - s.start_y[0]
         cols += [dx, dy, jnp.sqrt(dx**2 + dy**2)]
-    return _stack(cols, s.is_home[0])
+    return _stack(cols, s.f, s.is_home[0])
 
 
 def _goalscore(s: _States) -> jax.Array:
@@ -220,12 +227,12 @@ def _goalscore(s: _States) -> jax.Array:
     teamisA = s.is_home[0] == s.is_home[0][:, :1]
     goalsA = (goals & teamisA) | (owngoals & ~teamisA)
     goalsB = (goals & ~teamisA) | (owngoals & teamisA)
-    f = jnp.float32
+    f = s.f
     scoreA = jnp.cumsum(goalsA.astype(f), axis=1) - goalsA.astype(f)
     scoreB = jnp.cumsum(goalsB.astype(f), axis=1) - goalsB.astype(f)
     team_score = jnp.where(teamisA, scoreA, scoreB)
     opp_score = jnp.where(teamisA, scoreB, scoreA)
-    return _stack([team_score, opp_score, team_score - opp_score])
+    return _stack([team_score, opp_score, team_score - opp_score], s.f)
 
 
 KERNELS: Dict[str, object] = {

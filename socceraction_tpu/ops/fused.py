@@ -301,7 +301,7 @@ def _fused_first_layer(
             f'feature layout ({names!r}, k={k}) emits {off} columns'
         )
 
-    h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), jnp.float32) + bias
+    h = jnp.zeros((*batch.type_id.shape, Wk.shape[1]), Wk.dtype) + bias
     onehot_layout = [
         (name, spec, off) for name, spec, _, off in layout if spec is not None
     ]
@@ -323,7 +323,7 @@ def _fused_first_layer(
             name: registry.combo_rows[name](combo) for name, _, _ in onehot_layout
         }
         for i in range(k):
-            table = jnp.zeros((registry.combo_size, Wk.shape[1]), jnp.float32)
+            table = jnp.zeros((registry.combo_size, Wk.shape[1]), Wk.dtype)
             for name, (per, _), off in onehot_layout:
                 rows = jax.lax.slice_in_dim(
                     Wk, off + i * per, off + (i + 1) * per, axis=0

@@ -1,0 +1,129 @@
+"""Subprocess body of the float64 device-kernel audit.
+
+Launched by ``tests/test_float64_audit.py`` with ``JAX_ENABLE_X64=1`` on a
+CPU backend (x64 is a process-global JAX config in this jax version, so it
+cannot be toggled inside the main test process). Packs the synthetic
+learnable games with ``float_dtype=np.float64``, runs the DEVICE kernels
+(:mod:`socceraction_tpu.ops.features` / ``.labels`` / ``.formula`` and the
+fused pair path) at float64, and prints one JSON line of max-abs errors
+against the float64 pandas oracle.
+
+This is the proof that the e2e tier's 2e-3 float32 band
+(``tests/test_e2e_worldcup.py``) is pure rounding: at matched precision
+the kernels and the oracle agree to ~1e-12 (asserted at 1e-9, far inside
+BASELINE.json's 1e-5 contract).
+"""
+
+from __future__ import annotations
+
+import json
+import types
+
+import numpy as np
+import pandas as pd
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.config.jax_enable_x64, 'worker must run with JAX_ENABLE_X64=1'
+
+    from socceraction_tpu.core.batch import pack_actions, unpack_values
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.ml.mlp import _MLP
+    from socceraction_tpu.ops import formula as formula_ops
+    from socceraction_tpu.ops import labels as labels_ops
+    from socceraction_tpu.ops.features import compute_features
+    from socceraction_tpu.ops.fused import fused_pair_logits
+    from socceraction_tpu.spadl import utils as spadl_utils
+    from socceraction_tpu.vaep import VAEP
+    from socceraction_tpu.vaep import formula as formula_pd
+
+    K = 3
+    HOME = {1: 100, 2: 300}
+    frames = {
+        g: synthetic_actions_frame(
+            game_id=g, home_team_id=h, away_team_id=h + 100, n_actions=500, seed=g
+        )
+        for g, h in HOME.items()
+    }
+    allactions = pd.concat(frames.values(), ignore_index=True)
+
+    oracle = VAEP(nb_prev_actions=K, backend='pandas')
+    names = VAEP(nb_prev_actions=K, backend='jax')._kernel_names()
+
+    batch, _ = pack_actions(allactions, home_team_ids=HOME, float_dtype=np.float64)
+    assert batch.time_seconds.dtype == jnp.float64
+
+    def stack_oracle(fn):
+        return pd.concat(
+            [
+                fn(types.SimpleNamespace(game_id=g, home_team_id=h), frames[g])
+                for g, h in HOME.items()
+            ],
+            ignore_index=True,
+        )
+
+    out = {}
+
+    # --- features: the kernels must be float64 end-to-end -----------------
+    feats = compute_features(batch, names=names, k=K)
+    assert feats.dtype == jnp.float64, feats.dtype
+    dev_X = unpack_values(feats, batch)
+    ref_X = stack_oracle(oracle.compute_features).to_numpy(dtype=np.float64)
+    out['features_max_abs_err'] = float(np.abs(dev_X - ref_X).max())
+    out['n_features'] = int(dev_X.shape[1])
+
+    # --- labels: booleans, must match exactly -----------------------------
+    scores, concedes = labels_ops.scores_concedes(batch)
+    dev_y = np.stack(
+        [unpack_values(scores, batch), unpack_values(concedes, batch)], axis=1
+    ).astype(bool)
+    ref_y = stack_oracle(oracle.compute_labels)[['scores', 'concedes']].to_numpy()
+    out['labels_equal'] = bool((dev_y == ref_y).all())
+
+    # --- formula: float64 probabilities through vaep_values ---------------
+    rng = np.random.default_rng(7)
+    p_scores = jnp.asarray(rng.uniform(0.0, 0.25, size=batch.type_id.shape))
+    p_concedes = jnp.asarray(rng.uniform(0.0, 0.25, size=batch.type_id.shape))
+    dev_V = unpack_values(formula_ops.vaep_values(batch, p_scores, p_concedes), batch)
+    ps_flat = unpack_values(p_scores, batch)
+    pc_flat = unpack_values(p_concedes, batch)
+    refs, off = [], 0
+    for g in HOME:
+        named = spadl_utils.add_names(frames[g])
+        n = len(named)
+        refs.append(
+            formula_pd.value(
+                named,
+                pd.Series(ps_flat[off : off + n]),
+                pd.Series(pc_flat[off : off + n]),
+            ).to_numpy(dtype=np.float64)
+        )
+        off += n
+    out['formula_max_abs_err'] = float(np.abs(dev_V - np.concatenate(refs)).max())
+
+    # --- fused pair path: stacked-fold vs materialized, both float64 ------
+    module = _MLP((32, 16))
+    params_a = module.init(jax.random.PRNGKey(0), jnp.zeros((1, dev_X.shape[1])))
+    params_b = module.init(jax.random.PRNGKey(1), jnp.zeros((1, dev_X.shape[1])))
+    params_a, params_b = jax.tree.map(
+        lambda x: x.astype(jnp.float64), (params_a, params_b)
+    )
+    ref_a = module.apply(params_a, feats)
+    ref_b = module.apply(params_b, feats)
+    fused_a, fused_b = fused_pair_logits(
+        params_a, params_b, batch, names=names, k=K,
+        hidden_layers_a=2, hidden_layers_b=2,
+    )
+    assert fused_a.dtype == jnp.float64, fused_a.dtype
+    out['fused_pair_max_abs_err'] = float(
+        max(jnp.abs(fused_a - ref_a).max(), jnp.abs(fused_b - ref_b).max())
+    )
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == '__main__':
+    main()
