@@ -95,6 +95,87 @@ def test_whitespace_rules(tmp_path):
     assert any('tab indentation' in p for p in probs)
 
 
+def test_undefined_name_flagged(tmp_path):
+    probs = _problems(tmp_path, 'def f():\n    return missing_thing\n')
+    assert len(probs) == 1 and "undefined name 'missing_thing'" in probs[0]
+
+
+def test_undefined_name_respects_scope_chain(tmp_path):
+    probs = _problems(
+        tmp_path,
+        'import os\n'
+        'X = 3\n'
+        'def outer():\n'
+        '    y = os.sep\n'
+        '    def inner():\n'
+        '        return y + str(X) + later()\n'
+        '    return inner\n'
+        'def later():\n'
+        '    return ""\n',
+    )
+    assert probs == []  # closure, module global, forward ref, builtin all fine
+
+
+def test_undefined_name_comprehension_and_walrus(tmp_path):
+    probs = _problems(
+        tmp_path,
+        'def f(xs):\n'
+        '    out = [x * 2 for x in xs if x]\n'
+        '    if (n := len(out)) > 2:\n'
+        '        return n\n'
+        '    return out\n',
+    )
+    assert probs == []
+
+
+def test_undefined_name_skipped_on_star_import(tmp_path):
+    probs = _problems(
+        tmp_path, 'from os.path import *\nprint(join("a", "b"))\n'
+    )
+    assert probs == []
+
+
+def test_unused_local_flagged(tmp_path):
+    probs = _problems(
+        tmp_path, 'def f():\n    x = 1\n    y = 2\n    return x\n'
+    )
+    assert len(probs) == 1 and "local variable 'y'" in probs[0]
+
+
+def test_unused_local_exemptions(tmp_path):
+    probs = _problems(
+        tmp_path,
+        'def f(items):\n'
+        '    _scratch = 1\n'                      # underscore prefix
+        '    a, b = 1, 2\n'                       # unpack targets
+        '    for i in range(3):\n'                # loop target
+        '        pass\n'
+        '    with open("x") as fh:\n'             # with target
+        '        pass\n'
+        '    return items\n',
+    )
+    assert probs == []
+
+
+def test_unused_local_used_by_closure_not_flagged(tmp_path):
+    probs = _problems(
+        tmp_path,
+        'def f():\n'
+        '    state = []\n'
+        '    def push(v):\n'
+        '        state.append(v)\n'
+        '    return push\n',
+    )
+    assert probs == []
+
+
+def test_unused_local_skipped_when_locals_called(tmp_path):
+    probs = _problems(
+        tmp_path, 'def f():\n    x = 1\n    return locals()\n'
+    )
+    assert probs == []
+
+
 def test_cli_green_on_repo():
     """The repo itself must stay lint-clean (the gate's actual contract)."""
     proc = subprocess.run(

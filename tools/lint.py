@@ -8,6 +8,11 @@ with egress), ``make check`` runs them on top of this gate.
 
 Rules:
 
+- **undefined-name** — a name is read but bound in no enclosing scope
+  (the highest-signal pyflakes rule; scope analysis below).
+- **unused-local** — a function-local bound by plain assignment and
+  never read (the second pyflakes staple). Loop/with/unpack targets and
+  ``_``-prefixed names are exempt.
 - **unused-import** — a name imported at module level and never
   referenced (``__init__.py`` re-exports are exempt when listed in
   ``__all__`` or imported with ``from x import y as y``).
@@ -15,6 +20,12 @@ Rules:
 - **mutable-default** — ``def f(x=[])`` / ``{}`` / ``set()`` defaults.
 - **tab-indent / trailing-whitespace** — whitespace hygiene.
 - **syntax-error** — the file must parse.
+
+The scope analysis is deliberately lenient where exactness would risk
+false positives: class-scope bindings stay visible to nested functions,
+comprehension targets leak to the enclosing scope, and a module with a
+star import (or any ``eval``/``exec``) opts out of undefined-name
+checking, a scope calling ``locals()``/``vars()`` out of unused-local.
 
 Usage: ``python tools/lint.py [paths...]`` (defaults to the package,
 tests, tools, benchmarks, examples and the repo-root scripts). Exits
@@ -24,9 +35,17 @@ non-zero on findings.
 from __future__ import annotations
 
 import ast
+import builtins
 import os
 import sys
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
+
+_BUILTIN_NAMES = set(dir(builtins)) | {
+    '__file__', '__name__', '__doc__', '__package__', '__spec__',
+    '__loader__', '__builtins__', '__path__', '__debug__',
+    '__annotations__', '__qualname__', '__module__', '__dict__',
+    '__class__',  # implicit cell in methods using zero-arg super()
+}
 
 DEFAULT_TARGETS = [
     'socceraction_tpu',
@@ -107,6 +126,289 @@ class _ImportCollector(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _Scope:
+    """One lexical scope: its bindings, reads, and unused-local candidates."""
+
+    def __init__(self, kind: str, parent: Optional['_Scope'], name: str = '') -> None:
+        self.kind = kind  # 'module' | 'function' | 'class' | 'comprehension'
+        self.parent = parent
+        self.name = name
+        self.children: List['_Scope'] = []
+        if parent is not None:
+            parent.children.append(self)
+        self.bindings: dict = {}  # name -> first binding lineno
+        self.loads: set = set()
+        self.assigns: dict = {}  # plain-assignment locals (unused-local pool)
+        self.params: set = set()
+        self.globals_nl: set = set()
+        self.dynamic = False  # locals()/vars() seen: skip unused-local here
+
+    def subtree_loads(self) -> set:
+        out = set(self.loads)
+        for c in self.children:
+            out |= c.subtree_loads()
+        return out
+
+    def iter_scopes(self) -> Iterator['_Scope']:
+        yield self
+        for c in self.children:
+            yield from c.iter_scopes()
+
+
+class _ScopeBuilder:
+    """Build the scope tree for undefined-name / unused-local analysis."""
+
+    def __init__(self) -> None:
+        self.module = _Scope('module', None)
+        self.load_sites: List[Tuple[str, int, _Scope]] = []
+        self.module_dynamic = False  # star import / eval / exec anywhere
+
+    def build(self, tree: ast.Module) -> '_ScopeBuilder':
+        self._visit_body(tree.body, self.module)
+        return self
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _visit(self, node: ast.AST, scope: _Scope) -> None:
+        meth = getattr(self, '_v_' + node.__class__.__name__, None)
+        if meth is not None:
+            meth(node, scope)
+        else:
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, scope)
+
+    def _visit_body(self, body, scope: _Scope) -> None:
+        for stmt in body:
+            self._visit(stmt, scope)
+
+    def _bind(self, name: str, lineno: int, scope: _Scope) -> None:
+        scope.bindings.setdefault(name, lineno)
+
+    # -- names --------------------------------------------------------------
+
+    def _v_Name(self, node: ast.Name, scope: _Scope) -> None:
+        if isinstance(node.ctx, ast.Load):
+            scope.loads.add(node.id)
+            self.load_sites.append((node.id, node.lineno, scope))
+            if node.id in ('locals', 'vars'):
+                scope.dynamic = True
+            elif node.id in ('eval', 'exec'):
+                self.module_dynamic = True
+        else:  # Store / Del — a del also implies the name was live
+            if isinstance(node.ctx, ast.Del):
+                scope.loads.add(node.id)
+            self._bind(node.id, node.lineno, scope)
+
+    # -- function-like scopes ----------------------------------------------
+
+    @staticmethod
+    def _all_args(a: ast.arguments) -> List[ast.arg]:
+        args = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        if a.vararg:
+            args.append(a.vararg)
+        if a.kwarg:
+            args.append(a.kwarg)
+        return args
+
+    def _v_FunctionDef(self, node, scope: _Scope) -> None:
+        self._bind(node.name, node.lineno, scope)
+        for dec in node.decorator_list:
+            self._visit(dec, scope)
+        a = node.args
+        for default in list(a.defaults) + [d for d in a.kw_defaults if d is not None]:
+            self._visit(default, scope)
+        for arg in self._all_args(a):
+            if arg.annotation is not None:
+                self._visit(arg.annotation, scope)
+        if node.returns is not None:
+            self._visit(node.returns, scope)
+        inner = _Scope('function', scope, node.name)
+        for arg in self._all_args(a):
+            inner.params.add(arg.arg)
+            self._bind(arg.arg, arg.lineno, inner)
+        self._visit_body(node.body, inner)
+
+    _v_AsyncFunctionDef = _v_FunctionDef
+
+    def _v_Lambda(self, node: ast.Lambda, scope: _Scope) -> None:
+        a = node.args
+        for default in list(a.defaults) + [d for d in a.kw_defaults if d is not None]:
+            self._visit(default, scope)
+        inner = _Scope('function', scope, '<lambda>')
+        for arg in self._all_args(a):
+            inner.params.add(arg.arg)
+            self._bind(arg.arg, node.lineno, inner)
+        self._visit(node.body, inner)
+
+    def _v_ClassDef(self, node: ast.ClassDef, scope: _Scope) -> None:
+        self._bind(node.name, node.lineno, scope)
+        for expr in node.decorator_list + node.bases + [k.value for k in node.keywords]:
+            self._visit(expr, scope)
+        inner = _Scope('class', scope, node.name)
+        self._visit_body(node.body, inner)
+
+    def _v_comp(self, node, scope: _Scope) -> None:
+        inner = _Scope('comprehension', scope, '<comp>')
+        first = True
+        for gen in node.generators:
+            self._visit(gen.iter, scope if first else inner)
+            first = False
+            self._target(gen.target, inner, simple=False)
+            for cond in gen.ifs:
+                self._visit(cond, inner)
+        if isinstance(node, ast.DictComp):
+            self._visit(node.key, inner)
+            self._visit(node.value, inner)
+        else:
+            self._visit(node.elt, inner)
+
+    _v_ListComp = _v_SetComp = _v_GeneratorExp = _v_DictComp = _v_comp
+
+    # -- bindings -----------------------------------------------------------
+
+    def _target(self, t: ast.AST, scope: _Scope, *, simple: bool) -> None:
+        if isinstance(t, ast.Name):
+            self._bind(t.id, t.lineno, scope)
+            if simple and scope.kind == 'function':
+                scope.assigns.setdefault(t.id, t.lineno)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, scope, simple=False)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value, scope, simple=False)
+        else:  # Subscript / Attribute target: container is read
+            self._visit(t, scope)
+
+    def _v_Assign(self, node: ast.Assign, scope: _Scope) -> None:
+        self._visit(node.value, scope)
+        for t in node.targets:
+            self._target(t, scope, simple=isinstance(t, ast.Name))
+
+    def _v_AugAssign(self, node: ast.AugAssign, scope: _Scope) -> None:
+        self._visit(node.value, scope)
+        if isinstance(node.target, ast.Name):
+            scope.loads.add(node.target.id)
+            self.load_sites.append((node.target.id, node.lineno, scope))
+            self._bind(node.target.id, node.lineno, scope)
+        else:
+            self._visit(node.target, scope)
+
+    def _v_AnnAssign(self, node: ast.AnnAssign, scope: _Scope) -> None:
+        if node.value is not None:
+            self._visit(node.value, scope)
+        self._visit(node.annotation, scope)
+        if isinstance(node.target, ast.Name):
+            self._bind(node.target.id, node.target.lineno, scope)
+        else:
+            self._visit(node.target, scope)
+
+    def _v_NamedExpr(self, node: ast.NamedExpr, scope: _Scope) -> None:
+        self._visit(node.value, scope)
+        target = scope  # PEP 572: walrus binds in the enclosing real scope
+        while target.kind == 'comprehension':
+            target = target.parent
+        self._bind(node.target.id, node.lineno, target)
+
+    def _v_For(self, node, scope: _Scope) -> None:
+        self._visit(node.iter, scope)
+        self._target(node.target, scope, simple=False)
+        self._visit_body(node.body, scope)
+        self._visit_body(node.orelse, scope)
+
+    _v_AsyncFor = _v_For
+
+    def _v_With(self, node, scope: _Scope) -> None:
+        for item in node.items:
+            self._visit(item.context_expr, scope)
+            if item.optional_vars is not None:
+                self._target(item.optional_vars, scope, simple=False)
+        self._visit_body(node.body, scope)
+
+    _v_AsyncWith = _v_With
+
+    def _v_ExceptHandler(self, node: ast.ExceptHandler, scope: _Scope) -> None:
+        if node.type is not None:
+            self._visit(node.type, scope)
+        if node.name:
+            self._bind(node.name, node.lineno, scope)
+        self._visit_body(node.body, scope)
+
+    def _v_Import(self, node: ast.Import, scope: _Scope) -> None:
+        for a in node.names:
+            self._bind((a.asname or a.name).split('.')[0], node.lineno, scope)
+
+    def _v_ImportFrom(self, node: ast.ImportFrom, scope: _Scope) -> None:
+        for a in node.names:
+            if a.name == '*':
+                self.module_dynamic = True
+                continue
+            self._bind(a.asname or a.name, node.lineno, scope)
+
+    def _v_Global(self, node: ast.Global, scope: _Scope) -> None:
+        scope.globals_nl.update(node.names)
+        for n in node.names:
+            self._bind(n, node.lineno, self.module)
+
+    def _v_Nonlocal(self, node: ast.Nonlocal, scope: _Scope) -> None:
+        scope.globals_nl.update(node.names)
+        p = scope.parent
+        while p is not None and p.kind != 'function':
+            p = p.parent
+        if p is not None:
+            for n in node.names:
+                self._bind(n, node.lineno, p)
+
+    # -- match-statement captures -------------------------------------------
+
+    def _v_MatchAs(self, node, scope: _Scope) -> None:
+        if node.pattern is not None:
+            self._visit(node.pattern, scope)
+        if node.name:
+            self._bind(node.name, node.lineno, scope)
+
+    def _v_MatchStar(self, node, scope: _Scope) -> None:
+        if node.name:
+            self._bind(node.name, node.lineno, scope)
+
+    def _v_MatchMapping(self, node, scope: _Scope) -> None:
+        for k in node.keys:
+            self._visit(k, scope)
+        for p in node.patterns:
+            self._visit(p, scope)
+        if node.rest:
+            self._bind(node.rest, node.lineno, scope)
+
+
+def check_scopes(tree: ast.Module, path: str) -> List[str]:
+    """undefined-name + unused-local findings for one parsed module."""
+    b = _ScopeBuilder().build(tree)
+    problems: List[str] = []
+
+    if not b.module_dynamic:
+        for name, lineno, scope in b.load_sites:
+            if name in _BUILTIN_NAMES:
+                continue
+            s: Optional[_Scope] = scope
+            while s is not None and name not in s.bindings:
+                s = s.parent
+            if s is None:
+                problems.append(f'{path}:{lineno}: undefined name {name!r}')
+
+    for scope in b.module.iter_scopes():
+        if scope.kind != 'function' or scope.dynamic:
+            continue
+        used = scope.subtree_loads()
+        for name, lineno in sorted(scope.assigns.items(), key=lambda kv: kv[1]):
+            if name.startswith('_') or name in used:
+                continue
+            if name in scope.params or name in scope.globals_nl:
+                continue
+            problems.append(
+                f'{path}:{lineno}: local variable {name!r} is assigned but never used'
+            )
+    return sorted(problems)
+
+
 def _module_all(tree: ast.Module) -> set:
     for node in tree.body:
         if isinstance(node, ast.Assign):
@@ -135,6 +437,8 @@ def check_file(path: str) -> List[str]:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         return problems + [f'{path}:{e.lineno}: syntax error: {e.msg}']
+
+    problems.extend(check_scopes(tree, path))
 
     # unused imports
     col = _ImportCollector()
