@@ -100,104 +100,234 @@ def synthetic_actions_frame(
     away_team_id: int = 200,
     n_actions: int = 1600,
     seed: int = 0,
+    include_latents: bool = False,
 ):
     """A schema-valid synthetic SPADL DataFrame for one game.
 
-    Statistically plausible AND **learnable**: the generator plants the
-    same feature→label structure real soccer has, so models trained on
-    these games must beat chance on held-out games (the air-gapped stand-in
-    for the reference's real-data quality tier — see QUALITY.md):
+    Statistically plausible AND **learnable**: the generator simulates
+    possession chains with the same *sequential* feature→label structure
+    real soccer has, so models trained on these games must beat chance on
+    held-out games (the air-gapped stand-in for the reference's real-data
+    quality tier — see QUALITY.md), and history-aware features must beat
+    location-only features (the ablation tier):
 
-    - possession alternates in runs; the home team attacks left→right,
-      the away team right→left;
-    - **shot hazard rises with proximity to the attacking goal**
-      (``p_shot ∝ exp(-dist/11 m)``), so shots cluster in the box;
-    - **shot conversion falls with distance** (``P(goal|shot) ∝
-      exp(-dist/9 m)``), so P(score in next 10 actions) is genuinely
-      predictable from location/type features;
-    - pass/dribble success falls with attempted distance, giving the
-      result features real signal too.
+    - **ball continuity**: each action starts where the previous one
+      ended; a turnover hands the ball to the other team *at that spot*,
+      so ``space_delta``/``startlocation`` chains carry real state;
+    - **momentum**: a latent state that rises with consecutive successful
+      actions and forward progress and resets on turnover. It multiplies
+      move success, shot hazard AND shot conversion, so the *recent
+      history* (previous results, forward progress, tempo — exactly what
+      the ``team``/``time_delta``/``space_delta`` context transformers
+      and the k>1 state copies expose) genuinely predicts P(goal in the
+      next 10 actions) beyond what the current location says;
+    - **build-up toward goal**: within a possession, moves drift toward
+      the attacked goal, so chains progress like real build-up play;
+    - **tempo**: possessions are fast breaks (short ``time_delta``,
+      higher conversion) or slow build-up, making inter-action time
+      predictive;
+    - **score effects**: a trailing team presses (higher shot hazard),
+      giving the ``goalscore`` feature forward-looking signal;
+    - shot hazard still decays with distance to the attacked goal and
+      conversion with shot distance, so location features keep their
+      baseline signal (and the xG tier its distance structure).
 
     Used by the synthetic stand-in store
     (``tests/datasets/make_synthetic_store.py``) that lets the @e2e tier
     execute without network egress, and by
-    ``tests/test_quality_synthetic.py`` (held-out AUC floor).
+    ``tests/test_quality_synthetic.py`` (held-out AUC floor + history
+    ablation).
     """
     import pandas as pd
 
     rng = np.random.default_rng(seed)
     n = int(n_actions)
-
-    # possession runs: geometric lengths, alternating teams
-    team_id = np.empty(n, dtype=np.int64)
-    pos = 0
-    team = home_team_id if rng.integers(2) else away_team_id
-    while pos < n:
-        run = 1 + rng.geometric(0.22)
-        team_id[pos : pos + run] = team
-        team = away_team_id if team == home_team_id else home_team_id
-        pos += run
-
+    L, W = spadlconfig.field_length, spadlconfig.field_width
     half = n // 2
-    period_id = np.where(np.arange(n) < half, 1, 2)
-    time_seconds = np.concatenate(
+
+    other = {home_team_id: away_team_id, away_team_id: home_team_id}
+    n_types = len(spadlconfig.actiontypes)
+    # occasional non-move vocabulary tail (throw-ins, fouls, clearances...)
+    tail_types = np.array(
         [
-            np.sort(rng.uniform(0, 45 * 60, size=half)),
-            np.sort(rng.uniform(0, 45 * 60, size=n - half)),
+            t for t in range(n_types)
+            if t not in (spadlconfig.PASS, spadlconfig.DRIBBLE, spadlconfig.SHOT)
         ]
     )
 
-    L, W = spadlconfig.field_length, spadlconfig.field_width
-    # positions drift like a bounded random walk so dribbles/passes move
-    start_x = np.clip(np.cumsum(rng.normal(0, 9, size=n)) % (2 * L), 0, None)
-    start_x = np.where(start_x > L, 2 * L - start_x, start_x)
-    start_y = np.clip(np.cumsum(rng.normal(0, 6, size=n)) % (2 * W), 0, None)
-    start_y = np.where(start_y > W, 2 * W - start_y, start_y)
-    end_x = np.clip(start_x + rng.normal(4, 10, size=n), 0, L)
-    end_y = np.clip(start_y + rng.normal(0, 7, size=n), 0, W)
+    team_id = np.empty(n, dtype=np.int64)
+    type_id = np.empty(n, dtype=np.int64)
+    result_id = np.empty(n, dtype=np.int64)
+    period_id = np.where(np.arange(n) < half, 1, 2).astype(np.int64)
+    time_seconds = np.empty(n, dtype=np.float64)
+    start_x = np.empty(n)
+    start_y = np.empty(n)
+    end_x = np.empty(n)
+    end_y = np.empty(n)
+    momentum_lat = np.empty(n)  # latent record (include_latents=True)
+    fast_lat = np.empty(n, dtype=bool)
 
-    # distance from the action's start to the goal its team attacks
-    attacks_right = team_id == home_team_id
-    goal_x = np.where(attacks_right, L, 0.0)
-    dist_goal = np.hypot(start_x - goal_x, start_y - W / 2)
+    # mutable match state
+    team = home_team_id if rng.integers(2) else away_team_id
+    x, y = L / 2.0, W / 2.0
+    t = 0.0
+    momentum = 0.0  # latent, in [0, 1]
+    fast_break = False
+    score = {home_team_id: 0, away_team_id: 0}
 
-    # action types: shot hazard decays with distance to the attacked goal
-    # (~20-30 shots/game, overwhelmingly inside ~25 m); the rest of the
-    # vocabulary keeps the pass/dribble-dominated mix
-    n_types = len(spadlconfig.actiontypes)
-    probs = np.full(n_types, 0.012)
-    probs[spadlconfig.PASS] = 0.50
-    probs[spadlconfig.DRIBBLE] = 0.22
-    probs[spadlconfig.SHOT] = 0.0
-    probs /= probs.sum()
-    type_id = rng.choice(n_types, size=n, p=probs)
-    p_shot = 0.32 * np.exp(-dist_goal / 11.0)
-    type_id = np.where(rng.random(n) < p_shot, spadlconfig.SHOT, type_id)
+    def new_possession(new_team, *, kickoff=False):
+        nonlocal team, momentum, fast_break, x, y
+        team = new_team
+        momentum = 0.0
+        fast_break = bool(rng.random() < 0.3)
+        if kickoff:
+            x, y = L / 2.0, W / 2.0
 
-    # results: shots convert by proximity; moves succeed by attempted
-    # length (long balls fail more often). ALL shot-like types (open play,
-    # penalty, freekick) get the distance rule — a "successful"
-    # shot_penalty IS a goal to the label kernels, so giving set-piece
-    # shots the generic ~90% move-success rate would scatter dozens of
-    # position-independent goals per game and bury the planted signal.
-    move_len = np.hypot(end_x - start_x, end_y - start_y)
-    p_success = np.clip(0.92 - 0.012 * move_len, 0.3, 0.95)
-    result_id = np.where(
-        rng.random(n) < p_success, spadlconfig.SUCCESS, spadlconfig.FAIL
-    )
-    shot_like = spadlconfig.shot_like_mask[type_id]
-    p_goal = np.clip(0.45 * np.exp(-dist_goal[shot_like] / 9.0), 0.02, 0.6)
-    result_id[shot_like] = np.where(
-        rng.random(shot_like.sum()) < p_goal, spadlconfig.SUCCESS, spadlconfig.FAIL
-    )
+    for i in range(n):
+        if i == half:  # second half: clock restarts, away kicks off
+            t = 0.0
+            new_possession(away_team_id, kickoff=True)
 
+        attacks_right = team == home_team_id
+        goal_x = L if attacks_right else 0.0
+        dist_goal = float(np.hypot(x - goal_x, y - W / 2.0))
+        trailing = score[team] < score[other[team]]
+
+        t += rng.uniform(1.0, 4.0) if fast_break else rng.uniform(2.0, 9.0)
+        time_seconds[i] = t
+        team_id[i] = team
+        start_x[i], start_y[i] = x, y
+        momentum_lat[i], fast_lat[i] = momentum, fast_break
+
+        # shot hazard: proximity x momentum x (pressing when trailing);
+        # on a fast break the shot comes EARLY, from range, because the
+        # defense is unset — location-only features cannot tell these
+        # high-value chances from hopeless long shots, history can
+        p_shot = (
+            0.10
+            * np.exp(-dist_goal / 11.0)
+            * (1.0 + 2.5 * momentum)
+            * (1.25 if trailing else 1.0)
+        )
+        if fast_break:
+            p_shot = max(p_shot, 0.18 * np.exp(-dist_goal / 30.0))
+        u = rng.random()
+        if u < p_shot:
+            a_type = spadlconfig.SHOT
+        elif u < p_shot + 0.08:
+            a_type = int(rng.choice(tail_types))
+        elif u < p_shot + 0.08 + (1 - p_shot - 0.08) * 0.72:
+            a_type = spadlconfig.PASS
+        else:
+            a_type = spadlconfig.DRIBBLE
+
+        # movement: build-up drifts toward the attacked goal
+        if a_type == spadlconfig.SHOT:
+            ex, ey = goal_x, W / 2.0 + rng.normal(0, 2.0)
+        else:
+            step = (
+                abs(rng.normal(14.0, 8.0))
+                if a_type == spadlconfig.PASS
+                else abs(rng.normal(6.0, 3.0))
+            )
+            to_goal_x = goal_x - x
+            to_goal_y = (W / 2.0 - y) * 0.4
+            norm = max(float(np.hypot(to_goal_x, to_goal_y)), 1e-6)
+            drift = 0.55 if not fast_break else 0.8  # breaks go forward
+            ex = x + step * (drift * to_goal_x / norm + rng.normal(0, 0.6))
+            ey = y + step * (drift * to_goal_y / norm + rng.normal(0, 0.6))
+        ex = float(np.clip(ex, 0.0, L))
+        ey = float(np.clip(ey, 0.0, W))
+        end_x[i], end_y[i] = ex, ey
+        type_id[i] = a_type
+
+        shot_like = bool(spadlconfig.shot_like_mask[a_type])
+        if shot_like:
+            # conversion: the *history* — not just where the shot is taken
+            # from — decides whether chances convert. Set-play shots decay
+            # steeply with distance but multiply with momentum (~4.5x);
+            # counterattack finishes face an unset defense, so distance
+            # hardly protects and the break itself sets the value. Both
+            # factors are invisible to location-only features — this is
+            # what the ablation tier asserts.
+            if fast_break:
+                p_goal = float(
+                    np.clip(
+                        0.16
+                        * np.exp(-dist_goal / 28.0)
+                        * (1.0 + 2.0 * momentum),
+                        0.01,
+                        0.55,
+                    )
+                )
+            else:
+                p_goal = float(
+                    np.clip(
+                        0.055
+                        * np.exp(-dist_goal / 10.0)
+                        * (1.0 + 3.5 * momentum),
+                        0.01,
+                        0.55,
+                    )
+                )
+            goal = rng.random() < p_goal
+            result_id[i] = spadlconfig.SUCCESS if goal else spadlconfig.FAIL
+            if goal:
+                score[team] += 1
+                t += rng.uniform(30.0, 60.0)  # celebration + restart
+                new_possession(other[team], kickoff=True)
+            else:
+                # miss: opponent restarts deep in their own territory
+                new_possession(other[team])
+                opp_right = team == home_team_id
+                x = rng.uniform(3.0, 14.0) if opp_right else rng.uniform(L - 14.0, L - 3.0)
+                y = rng.uniform(W * 0.25, W * 0.75)
+            continue
+
+        # moves: success decays with attempted length, rises with momentum
+        move_len = float(np.hypot(ex - x, ey - y))
+        p_success = float(
+            np.clip(0.89 - 0.011 * move_len + 0.12 * momentum, 0.35, 0.97)
+        )
+        ok = rng.random() < p_success
+        result_id[i] = spadlconfig.SUCCESS if ok else spadlconfig.FAIL
+        if ok:
+            forward = (ex - x) if attacks_right else (x - ex)
+            # SLOW decay: the state persists across the 10-action label
+            # window, so the noisy 3-action measurement the features give
+            # (recent results, forward progress, tempo) still predicts
+            # goals several actions ahead — short memory here would make
+            # momentum unpredictive at the label horizon
+            momentum = float(
+                np.clip(
+                    0.85 * momentum + 0.10 + (0.08 if forward > 6.0 else 0.0),
+                    0.0,
+                    1.0,
+                )
+            )
+            x, y = ex, ey
+            if rng.random() < 0.05:  # natural possession end (ball out etc.)
+                new_possession(other[team])
+        else:
+            x, y = ex, ey  # turnover at the failed action's end point
+            new_possession(other[team])
+            # a ball lost near one's own goal is a counterattack chance:
+            # the winning team starts with momentum and often breaks fast,
+            # so a deep failed action predicts conceding soon — the
+            # concedes head's planted sequential signal
+            won_goal_x = L if team == home_team_id else 0.0
+            if np.hypot(x - won_goal_x, y - W / 2.0) < 45.0:
+                momentum = 0.4
+                fast_break = bool(rng.random() < 0.6)
+
+    # clocks are strictly increasing within each period by construction
     players = {
         home_team_id: np.arange(1, 12) + home_team_id * 1000,
         away_team_id: np.arange(1, 12) + away_team_id * 1000,
     }
-    player_id = np.array([rng.choice(players[t]) for t in team_id])
+    player_id = np.array([rng.choice(players[tm]) for tm in team_id])
 
-    return pd.DataFrame(
+    frame = pd.DataFrame(
         {
             'game_id': np.full(n, game_id, dtype=np.int64),
             'original_event_id': [f'synth-{game_id}-{i}' for i in range(n)],
@@ -217,3 +347,10 @@ def synthetic_actions_frame(
             ).astype(np.int64),
         }
     )
+    if include_latents:
+        # the generator's hidden state at each action, for diagnostics and
+        # the ablation tier's oracle ceiling (NOT part of the SPADL schema;
+        # drop before passing to converters/stores)
+        frame['latent_momentum'] = momentum_lat
+        frame['latent_fast_break'] = fast_lat
+    return frame

@@ -1,16 +1,18 @@
-"""Air-gapped quality tier: held-out AUC floor on learnable synthetic games.
+"""Air-gapped quality tier: held-out AUC floor + history ablation.
 
 The reference's quality numbers (P(scores) AUC 0.85998, P(concedes)
 0.88888 — BASELINE.md) are measured on the real WC2018 data, which this
 environment cannot download (no network egress; see QUALITY.md). This
 tier is the strongest quality assertion that can *execute* here: the
-synthetic generator plants real feature→label structure (shot hazard and
-conversion decay with distance to goal —
-:func:`socceraction_tpu.core.synthetic.synthetic_actions_frame`), so a
-trained P(scores)/P(concedes) head must beat chance on *held-out* games.
-A shuffled-label control pins the floor: the same pipeline on destroyed
-labels must sit at chance, proving the AUC comes from learned structure,
-not leakage.
+synthetic generator simulates possession chains with momentum, tempo and
+counterattacks (:func:`socceraction_tpu.core.synthetic.synthetic_actions_frame`),
+so a trained P(scores)/P(concedes) head must beat chance on *held-out*
+games, and — because counterattack finishes convert on the strength of
+the break, not the shot location — history-aware features (k=3 states +
+the team/time_delta/space_delta context transformers) must beat
+location-only features (the ablation test). A shuffled-label control
+pins the floor: the same pipeline on destroyed labels must sit at
+chance, proving the AUC comes from learned structure, not leakage.
 
 Unlike ``tests/test_e2e_worldcup.py`` (which needs a store on disk), this
 runs unconditionally in the default suite.
@@ -26,17 +28,17 @@ from socceraction_tpu.vaep import VAEP
 pytestmark = pytest.mark.slow
 
 _HOME, _AWAY = 100, 200
-_N_TRAIN, _N_TEST = 24, 8
-# batch 2048 -> ~9 steps/epoch on 18k train rows; the default 8192 gives
+_N_TRAIN, _N_TEST = 36, 12
+# batch 2048 -> ~18 steps/epoch on 36k train rows; the default 8192 gives
 # the adam loop too few steps to converge on a season this small.
-# Measured held-out AUC with these settings: scores 0.734, concedes 0.714
-# (QUALITY.md).
+# Measured held-out AUC with these settings: QUALITY.md table.
 _MLP_PARAMS = dict(batch_size=2048, max_epochs=100, patience=10)
 
 
 @pytest.fixture(scope='module')
 def season():
-    """(games_df, {game_id: actions}) for 32 distinct synthetic games."""
+    """(games_df, {game_id: actions}) for 48 distinct synthetic games
+    (36 train + 12 held out)."""
     games, actions = [], {}
     for i in range(_N_TRAIN + _N_TEST):
         gid = 7000 + i
@@ -48,7 +50,8 @@ def season():
 
 
 @pytest.fixture(scope='module')
-def fitted(season):
+def k3_stacks(season):
+    """Train/test k=3 feature+label stacks, computed once for the tier."""
     games, actions = season
     model = VAEP(nb_prev_actions=3, backend='jax')
 
@@ -60,23 +63,77 @@ def fitted(season):
 
     train = games.iloc[:_N_TRAIN]
     test = games.iloc[_N_TRAIN:]
-    X_tr = stack(model.compute_features, train)
-    y_tr = stack(model.compute_labels, train)
+    return (
+        stack(model.compute_features, train),
+        stack(model.compute_labels, train),
+        stack(model.compute_features, test),
+        stack(model.compute_labels, test),
+    )
+
+
+@pytest.fixture(scope='module')
+def fitted(k3_stacks):
+    X_tr, y_tr, X_te, y_te = k3_stacks
+    model = VAEP(nb_prev_actions=3, backend='jax')
     model.fit(X_tr, y_tr, learner='mlp', tree_params=_MLP_PARAMS)
-    X_te = stack(model.compute_features, test)
-    y_te = stack(model.compute_labels, test)
     return model, X_tr, y_tr, X_te, y_te
 
 
 def test_heldout_auc_beats_chance(fitted):
-    """Both probability heads clear AUC 0.6 on 8 held-out games."""
+    """Both probability heads clear a real floor on 12 held-out games.
+
+    Measured on this season (QUALITY.md): mlp scores 0.771 / concedes
+    0.707, sklearn 0.797 / 0.801. Floors leave ~0.05 seed headroom.
+    """
     model, _, _, X_te, y_te = fitted
     metrics = model.score(X_te, y_te)
-    assert metrics['scores']['auroc'] > 0.6, metrics
-    assert metrics['concedes']['auroc'] > 0.6, metrics
+    assert metrics['scores']['auroc'] > 0.70, metrics
+    assert metrics['concedes']['auroc'] > 0.62, metrics
     # calibration sanity: rare-event Brier should be small
-    assert metrics['scores']['brier'] < 0.10, metrics
-    assert metrics['concedes']['brier'] < 0.10, metrics
+    assert metrics['scores']['brier'] < 0.06, metrics
+    assert metrics['concedes']['brier'] < 0.06, metrics
+
+
+def test_history_ablation_costs_auc(season, k3_stacks):
+    """Dropping the context transformers must cost measurable scores AUC.
+
+    k=3 (two previous game states + team/time_delta/space_delta) vs k=1
+    (current action only), same tree learner, same season. The generator's
+    counterattack finishes convert because of the *break* (small
+    time_deltas, long forward space_deltas), which location-only features
+    cannot see, so the gap is planted by construction (measured +0.02,
+    matching the latent-oracle ceiling — QUALITY.md). The concedes head is
+    NOT asserted: the conceding team's own action history cannot observe
+    the opponent's break, so its gap is structurally ~0.
+    """
+    games, actions = season
+    train, test = games.iloc[:_N_TRAIN], games.iloc[_N_TRAIN:]
+
+    def auc(k, stacks=None):
+        model = VAEP(nb_prev_actions=k, backend='jax')
+
+        def stack(fn, subset):
+            return pd.concat(
+                [fn(g, actions[g.game_id]) for g in subset.itertuples()],
+                ignore_index=True,
+            )
+
+        if stacks is None:
+            stacks = (
+                stack(model.compute_features, train),
+                stack(model.compute_labels, train),
+                stack(model.compute_features, test),
+                stack(model.compute_labels, test),
+            )
+        X_tr, y_tr, X_te, y_te = stacks
+        model.fit(X_tr, y_tr, learner='sklearn')
+        return model.score(X_te, y_te)['scores']['auroc']
+
+    full, ablated = auc(3, k3_stacks), auc(1)
+    assert full - ablated > 0.005, (full, ablated)
+    # the full tree model is also the tier's strongest head: near the 0.8
+    # band the verdict asked the synthetic ceiling to reach
+    assert full > 0.75, full
 
 
 def test_shuffled_label_control_sits_at_chance(fitted, season):

@@ -19,8 +19,11 @@ class _Game:
 
 @pytest.fixture(scope='module')
 def season():
+    # 12 games / 3 held out: ~130 held-out shots. Smaller pools put the
+    # held-out AUC's standard error near the quality floor itself
+    # (measured: 8g/2t logistic 0.544, 12g/3t 0.616 on the same generator)
     games, actions = [], {}
-    for i in range(8):
+    for i in range(12):
         gid, home, away = 100 + i, 200 + 2 * i, 201 + 2 * i
         games.append(_Game(gid, home))
         actions[gid] = synthetic_actions_frame(
@@ -29,16 +32,19 @@ def season():
     return games, actions
 
 
+_N_TEST = 3
+
+
 @pytest.fixture(scope='module')
 def fitted(season):
     games, actions = season
     model = XGModel()
     X = pd.concat(
-        [model.compute_features(g, actions[g.game_id]) for g in games[:-2]],
+        [model.compute_features(g, actions[g.game_id]) for g in games[:-_N_TEST]],
         ignore_index=True,
     )
     y = pd.concat(
-        [model.compute_labels(g, actions[g.game_id]) for g in games[:-2]],
+        [model.compute_labels(g, actions[g.game_id]) for g in games[:-_N_TEST]],
         ignore_index=True,
     )
     model.fit(X, y, learner='logistic')
@@ -91,15 +97,17 @@ def test_fit_estimate_nan_pattern(season, fitted):
 
 def test_held_out_quality_beats_chance(season, fitted):
     """Synthetic shots encode distance-dependent conversion (QUALITY.md);
-    a fitted xG model must recover it on held-out games."""
+    a fitted xG model must recover it on held-out games. Counterattack
+    finishes (round-4 generator) are location-independent by design, so
+    the pure-location ceiling here is lower than the VAEP tier's."""
     games, actions = season
     model, _, _ = fitted
     X = pd.concat(
-        [model.compute_features(g, actions[g.game_id]) for g in games[-2:]],
+        [model.compute_features(g, actions[g.game_id]) for g in games[-_N_TEST:]],
         ignore_index=True,
     )
     y = pd.concat(
-        [model.compute_labels(g, actions[g.game_id]) for g in games[-2:]],
+        [model.compute_labels(g, actions[g.game_id]) for g in games[-_N_TEST:]],
         ignore_index=True,
     )
     assert y['goal'].nunique() == 2, 'need both classes in the held-out pool'
