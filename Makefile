@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench
+.PHONY: check lint compile types test test-all e2e-synthetic bench coverage walkthrough-outputs
 
 check: compile lint types test
 
@@ -37,3 +37,20 @@ e2e-synthetic:
 
 bench:
 	$(PY) bench.py
+
+# regenerate the committed executed-walkthrough outputs (the repo's
+# analog of the reference's executed notebook cells; drift-checked by
+# tests/test_walkthrough.py)
+walkthrough-outputs:
+	$(PY) tools/capture_walkthrough.py
+
+# statement coverage of the default suite (mirrors the reference CI's
+# `coverage run` + codecov job). Same pattern as `types`: runs when the
+# coverage module is importable, says SKIPPED when not, never pretends.
+# With coverage installed, writes COVERAGE.md (worst-covered modules).
+coverage:
+	@if $(PY) -c "import coverage" 2>/dev/null; then \
+	  $(PY) tools/coverage_report.py; \
+	else \
+	  echo "coverage: SKIPPED - coverage.py not installed in this image (declared in [project.optional-dependencies] dev; runs in CI with egress)"; \
+	fi

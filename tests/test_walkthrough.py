@@ -1,12 +1,19 @@
-"""The walkthrough scripts must stay runnable, in order, without egress.
+"""The walkthrough scripts must stay runnable, in order, without egress —
+and must keep producing the committed executed outputs.
 
 They are the repo's narrative documentation (docs/walkthrough/README.md,
 mirroring the reference's public notebooks 1-4); a doc a new user cannot
-execute is worse than none, so the suite runs the whole sequence.
+execute is worse than none, so the suite runs the whole sequence. The
+committed ``docs/walkthrough/outputs/*.txt`` are the repo's analog of the
+reference's executed notebook cells (real numbers a reader sees without
+running anything); each live run is diffed against them on the
+*normalized* view (numbers → ``#``, paths → ``<path>``) so wording and
+structure are pinned while timings may vary. Regenerate with
+``make walkthrough-outputs`` after changing a chapter.
 """
 
+import itertools
 import os
-import subprocess
 import sys
 
 import pytest
@@ -14,41 +21,34 @@ import pytest
 pytestmark = pytest.mark.slow
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_WT = os.path.join(_ROOT, 'docs', 'walkthrough')
+sys.path.insert(0, os.path.join(_ROOT, 'tools'))
 
-_SCRIPTS = [
-    '1_load_and_convert.py',
-    '2_features_and_labels.py',
-    '3_train_probability_models.py',
-    '4_rate_and_rank_players.py',
-    # chapter 5 runs without --processes here: the two-process tier is
-    # already covered (and time-bounded) by tests/test_distributed.py
-    '5_scale_out.py',
-    '6_atomic_pipeline.py',
-]
+from capture_walkthrough import CHAPTERS, normalize, run_chapter  # noqa: E402
+
+_OUT = os.path.join(_ROOT, 'docs', 'walkthrough', 'outputs')
 
 
 def test_walkthrough_sequence(tmp_path_factory):
     tmp = tmp_path_factory.mktemp('walkthrough')
     store = str(tmp / 'store.h5')
     ckpt = str(tmp / 'vaep_ckpt')
-    extra = {
-        '1_load_and_convert.py': ['--store', store],
-        '2_features_and_labels.py': ['--store', store],
-        '3_train_probability_models.py': ['--store', store, '--checkpoint', ckpt],
-        '4_rate_and_rank_players.py': ['--store', store, '--checkpoint', ckpt],
-        '5_scale_out.py': [],
-        '6_atomic_pipeline.py': ['--store', store],
-    }
-    for script in _SCRIPTS:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(_WT, script)] + extra[script],
-            capture_output=True,
-            text=True,
-            timeout=560,
-            cwd=_ROOT,
+    for script in CHAPTERS:
+        out = run_chapter(script, store, ckpt)
+        committed = os.path.join(_OUT, script.replace('.py', '.txt'))
+        assert os.path.exists(committed), (
+            f'no committed output for {script}; run `make walkthrough-outputs`'
         )
-        assert proc.returncode == 0, (
-            f'{script} failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}'
+        with open(committed, encoding='utf-8') as f:
+            want = normalize(f.read())
+        got = normalize(out)
+        assert got == want, (
+            f'{script} output drifted from the committed record '
+            f'(docs/walkthrough/outputs/). If the change is intentional, '
+            f'regenerate with `make walkthrough-outputs`.\n'
+            + '\n'.join(
+                f'- {w!r}\n+ {g!r}'
+                for w, g in itertools.zip_longest(want, got)
+                if w != g
+            )[:2000]
         )
-    assert 'atomic walkthrough complete' in proc.stdout
+    assert 'atomic walkthrough complete' in out

@@ -1,0 +1,113 @@
+"""Capture executed walkthrough outputs — the repo's analog of the
+reference's executed notebook cells.
+
+The reference ships 9 notebooks WITH stored cell outputs
+(``/root/reference/public-notebooks/*.ipynb``), which act as its
+de-facto acceptance record: a reader sees real numbers without running
+anything. This tool runs the walkthrough chapters
+(``docs/walkthrough/*.py``) in order against a fresh synthetic store and
+commits each chapter's real stdout to ``docs/walkthrough/outputs/<n>.txt``.
+
+``tests/test_walkthrough.py`` re-runs the chapters and diffs the
+*normalized* output (numbers → ``#``, absolute paths → ``<path>``,
+whitespace stripped) against these files, so the committed record is
+drift-checked: wording, section structure and table shapes are pinned
+while timings and other volatile literals are free to vary.
+
+Regenerate with ``make walkthrough-outputs`` after changing a chapter or
+the synthetic generator.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WT = os.path.join(_ROOT, 'docs', 'walkthrough')
+_OUT = os.path.join(_WT, 'outputs')
+
+CHAPTERS = [
+    '1_load_and_convert.py',
+    '2_features_and_labels.py',
+    '3_train_probability_models.py',
+    '4_rate_and_rank_players.py',
+    # chapter 5 runs without --processes: the two-process tier is
+    # covered (and time-bounded) by tests/test_distributed.py
+    '5_scale_out.py',
+    '6_atomic_pipeline.py',
+]
+
+
+def chapter_args(store: str, ckpt: str) -> dict:
+    """Per-chapter CLI args (single source shared with the test)."""
+    return {
+        '1_load_and_convert.py': ['--store', store],
+        '2_features_and_labels.py': ['--store', store],
+        '3_train_probability_models.py': ['--store', store, '--checkpoint', ckpt],
+        '4_rate_and_rank_players.py': ['--store', store, '--checkpoint', ckpt],
+        '5_scale_out.py': [],
+        '6_atomic_pipeline.py': ['--store', store],
+    }
+
+
+_NUM = re.compile(r'-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?')
+_PATH = re.compile(r'/\S+')
+
+
+def normalize(text: str) -> list:
+    """The drift-checked view of a chapter's stdout.
+
+    Absolute paths → ``<path>``, numeric literals → ``#``, whitespace
+    runs collapsed (number widths drive pandas column alignment, so
+    alignment is as volatile as the numbers), blank lines dropped. What
+    remains — wording, section structure, table columns, label text —
+    is what the test pins.
+    """
+    out = []
+    for line in text.splitlines():
+        line = _PATH.sub('<path>', line)
+        line = _NUM.sub('#', line)
+        line = re.sub(r'\s+', ' ', line).strip()
+        if line:
+            out.append(line)
+    return out
+
+
+def run_chapter(script: str, store: str, ckpt: str, timeout: int = 560) -> str:
+    """Run one chapter; return its stdout (raises on nonzero exit)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_WT, script)]
+        + chapter_args(store, ckpt)[script],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=_ROOT,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f'{script} failed (rc={proc.returncode}):\n'
+            f'{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}'
+        )
+    return proc.stdout
+
+
+def main() -> int:
+    os.makedirs(_OUT, exist_ok=True)
+    with tempfile.TemporaryDirectory(prefix='walkthrough_capture_') as tmp:
+        store = os.path.join(tmp, 'store.h5')
+        ckpt = os.path.join(tmp, 'vaep_ckpt')
+        for script in CHAPTERS:
+            out = run_chapter(script, store, ckpt)
+            dest = os.path.join(_OUT, script.replace('.py', '.txt'))
+            with open(dest, 'w', encoding='utf-8') as f:
+                f.write(out)
+            print(f'{script}: {len(out.splitlines())} lines -> {dest}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -53,6 +53,7 @@ DEFAULT_TARGETS = [
     'tools',
     'benchmarks',
     'examples',
+    'docs/walkthrough',
     'bench.py',
     '__graft_entry__.py',
 ]
