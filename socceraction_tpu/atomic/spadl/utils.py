@@ -22,7 +22,7 @@ def add_names(actions: pd.DataFrame) -> pd.DataFrame:
     return AtomicSPADLSchema.validate(out)
 
 
-def play_left_to_right(actions: pd.DataFrame, home_team_id) -> pd.DataFrame:
+def play_left_to_right(actions: pd.DataFrame, home_team_id: int) -> pd.DataFrame:
     """Mirror the away team's actions so both teams play left-to-right.
 
     Flips locations to ``extent - v`` and negates displacements.

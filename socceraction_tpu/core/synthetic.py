@@ -7,8 +7,13 @@ benchmarks measure kernel throughput, not host packing.
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import jax.numpy as jnp
 import numpy as np
+
+if TYPE_CHECKING:  # pandas is imported lazily inside the frame generator
+    import pandas as pd
 
 from ..spadl import config as spadlconfig
 from .batch import ActionBatch
@@ -101,7 +106,7 @@ def synthetic_actions_frame(
     n_actions: int = 1600,
     seed: int = 0,
     include_latents: bool = False,
-):
+) -> 'pd.DataFrame':
     """A schema-valid synthetic SPADL DataFrame for one game.
 
     Statistically plausible AND **learnable**: the generator simulates

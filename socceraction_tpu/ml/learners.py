@@ -34,7 +34,13 @@ from .mlp import MLPClassifier
 EvalSet = Optional[List[Tuple[Any, Any]]]
 
 
-def fit_xgboost(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+def fit_xgboost(
+    X: Any,
+    y: Any,
+    eval_set: EvalSet = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+) -> Any:
     """xgboost with the reference's defaults (base.py:215-235).
 
     Written against the xgboost >= 2.0 API, where ``eval_metric`` and
@@ -57,7 +63,13 @@ def fit_xgboost(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=Non
     return model.fit(X, y, **fit_params)
 
 
-def fit_catboost(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+def fit_catboost(
+    X: Any,
+    y: Any,
+    eval_set: EvalSet = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+) -> Any:
     """catboost with the reference's defaults (base.py:237-261)."""
     if catboost is None:
         raise ImportError('catboost is not installed')
@@ -72,7 +84,13 @@ def fit_catboost(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=No
     return model.fit(X, y, **fit_params)
 
 
-def fit_lightgbm(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+def fit_lightgbm(
+    X: Any,
+    y: Any,
+    eval_set: EvalSet = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+) -> Any:
     """lightgbm with the reference's defaults (base.py:263-282)."""
     if lightgbm is None:
         raise ImportError('lightgbm is not installed')
@@ -90,7 +108,13 @@ def fit_lightgbm(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=No
     return model.fit(X, y, **fit_params)
 
 
-def fit_sklearn(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+def fit_sklearn(
+    X: Any,
+    y: Any,
+    eval_set: EvalSet = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+) -> Any:
     """Histogram gradient boosting from scikit-learn (always available).
 
     Mirrors the reference's learner shape: 100 boosting iterations of
@@ -102,7 +126,13 @@ def fit_sklearn(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=Non
     return model.fit(X, y, **(fit_params or {}))
 
 
-def fit_mlp(X, y, eval_set: EvalSet = None, tree_params=None, fit_params=None):
+def fit_mlp(
+    X: Any,
+    y: Any,
+    eval_set: EvalSet = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+) -> Any:
     """The on-device JAX MLP (see :class:`socceraction_tpu.ml.mlp.MLPClassifier`)."""
     model = MLPClassifier(**(tree_params or {}))
     es = eval_set[0] if eval_set else None

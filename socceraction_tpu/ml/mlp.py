@@ -14,7 +14,7 @@ by the caller, early stopping on a validation set with a patience window.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -168,7 +168,7 @@ class MLPClassifier:
         xn = (X - jnp.asarray(self.mean_)) / jnp.asarray(self.std_)
         return jax.nn.sigmoid(self.module.apply(self.params, xn))
 
-    def predict_proba(self, X) -> np.ndarray:
+    def predict_proba(self, X: Any) -> np.ndarray:
         """sklearn-style ``(n, 2)`` probability matrix on host."""
         X = jnp.asarray(np.asarray(X, dtype=np.float32))
         p1 = np.asarray(self.predict_proba_device(X))
@@ -234,7 +234,7 @@ class MLPClassifier:
         return clf
 
     def predict_proba_device_batch(
-        self, batch, *, names, k, registry: str = 'standard'
+        self, batch: Any, *, names: Tuple[str, ...], k: int, registry: str = 'standard'
     ) -> jax.Array:
         """P(y=1) per action of a packed batch via the fused first layer.
 

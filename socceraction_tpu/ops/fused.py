@@ -355,9 +355,9 @@ def _hidden_chain(leaves, h: jax.Array, hidden_layers: int) -> jax.Array:
 
 
 def fused_pair_logits(
-    params_a,
-    params_b,
-    batch,
+    params_a: Any,
+    params_b: Any,
+    batch: Any,
     *,
     names: Tuple[str, ...],
     k: int,
@@ -428,9 +428,9 @@ def _pair_probs(
 
 
 def fused_pair_probs(
-    clf_a,
-    clf_b,
-    batch,
+    clf_a: Any,
+    clf_b: Any,
+    batch: Any,
     *,
     names: Tuple[str, ...],
     k: int,

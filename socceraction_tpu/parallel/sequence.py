@@ -433,7 +433,7 @@ def sequence_values(
     return fn(batch, p_scores, p_concedes)
 
 
-def sequence_rate(model, batch: Any, mesh: Mesh) -> jax.Array:
+def sequence_rate(model: Any, batch: Any, mesh: Mesh) -> jax.Array:
     """``(G, A, 3)`` VAEP values with the action axis sharded end-to-end.
 
     The sequence-parallel twin of ``VAEP.rate_batch`` /

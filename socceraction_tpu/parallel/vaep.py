@@ -15,7 +15,7 @@ communication code.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Sequence, Tuple
+from typing import Any, Callable, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -83,7 +83,7 @@ def make_train_step(
     hidden: Sequence[int] = (128, 128),
     learning_rate: float = 1e-3,
     nr_actions: int = 10,
-):
+) -> Tuple[Callable, Callable, Callable]:
     """Build ``(init_fn, step_fn)`` for the fused distributed VAEP step.
 
     ``step_fn(params, opt_state, batch) -> (params, opt_state, loss)`` runs
@@ -174,7 +174,9 @@ def train_distributed(
     return models
 
 
-def sharded_rate(model, batch: ActionBatch, mesh: Mesh) -> Tuple[jax.Array, ActionBatch]:
+def sharded_rate(
+    model: Any, batch: ActionBatch, mesh: Mesh
+) -> Tuple[jax.Array, ActionBatch]:
     """Rate a batch with its game axis sharded over the mesh.
 
     ``model`` is a fitted :class:`~socceraction_tpu.vaep.base.VAEP` (or

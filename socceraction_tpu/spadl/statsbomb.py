@@ -248,7 +248,7 @@ def _classify(
     )
 
 
-def convert_to_actions(events: pd.DataFrame, home_team_id) -> pd.DataFrame:
+def convert_to_actions(events: pd.DataFrame, home_team_id: int) -> pd.DataFrame:
     """Convert StatsBomb events of one game to SPADL actions.
 
     Parameters

@@ -28,7 +28,7 @@ def add_names(actions: pd.DataFrame) -> pd.DataFrame:
     return SPADLSchema.validate(out)
 
 
-def play_left_to_right(actions: pd.DataFrame, home_team_id) -> pd.DataFrame:
+def play_left_to_right(actions: pd.DataFrame, home_team_id: int) -> pd.DataFrame:
     """Mirror the away team's actions so every team plays left-to-right.
 
     Parameters

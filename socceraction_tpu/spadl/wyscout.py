@@ -497,17 +497,17 @@ def _result_ids(events: pd.DataFrame) -> np.ndarray:
     )
 
 
-def determine_bodypart_id(event) -> int:
+def determine_bodypart_id(event: Any) -> int:
     """Bodypart id of one Wyscout event (row-wise reference API)."""
     return int(_bodypart_ids(_single_event(event))[0])
 
 
-def determine_type_id(event) -> int:
+def determine_type_id(event: Any) -> int:
     """SPADL action-type id of one Wyscout event (row-wise reference API)."""
     return int(_type_ids(_single_event(event))[0])
 
 
-def determine_result_id(event) -> int:
+def determine_result_id(event: Any) -> int:
     """SPADL result id of one Wyscout event (row-wise reference API)."""
     return int(_result_ids(_single_event(event))[0])
 

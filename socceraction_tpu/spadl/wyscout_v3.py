@@ -650,7 +650,7 @@ def fix_foul_coordinates(df_actions: pd.DataFrame) -> pd.DataFrame:
 
 
 
-def determine_type_id(event) -> int:
+def determine_type_id(event: Any) -> int:
     """SPADL action-type id of one Wyscout-v3 event (row-wise reference API).
 
     Documented deviation: the reference's WIP ``determine_type_id`` returns
@@ -661,7 +661,7 @@ def determine_type_id(event) -> int:
     return int(_determine_type_ids(ev, _str_col(ev, 'type_primary')).iloc[0])
 
 
-def determine_result_id(event) -> int:
+def determine_result_id(event: Any) -> int:
     """SPADL result id of one Wyscout-v3 event (row-wise reference API)."""
     ev = _single_event(event)
     primary = _str_col(ev, 'type_primary')
@@ -669,7 +669,7 @@ def determine_result_id(event) -> int:
     return int(_determine_result_ids(ev, primary, type_id).iloc[0])
 
 
-def determine_bodypart_id(event) -> int:
+def determine_bodypart_id(event: Any) -> int:
     """SPADL bodypart id of one Wyscout-v3 event (row-wise reference API)."""
     ev = _single_event(event)
     return int(_determine_bodypart_ids(ev, _str_col(ev, 'type_primary')).iloc[0])

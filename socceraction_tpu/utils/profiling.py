@@ -20,7 +20,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, Iterator
+from typing import Any, ContextManager, Dict, Iterator
 
 import jax
 
@@ -91,7 +91,7 @@ def timer_report(reset: bool = False) -> Dict[str, Dict[str, float]]:
     return report
 
 
-def annotate(name: str):
+def annotate(name: str) -> ContextManager[Any]:
     """Named scope visible in XLA profiles; usable inside jitted code.
 
     Example::

@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax
 import numpy as np
 import pandas as pd
 from sklearn.metrics import brier_score_loss, roc_auc_score
@@ -144,17 +145,17 @@ class VAEP:
         batch, _ = pack_actions(game_actions, home_team_id=home_team_id)
         return batch
 
-    def compute_features_batch(self, batch: ActionBatch):
+    def compute_features_batch(self, batch: ActionBatch) -> jax.Array:
         """Fused device computation of the ``(G, A, F)`` feature tensor."""
         return self._compute_features_kernel(
             batch, names=self._kernel_names(), k=self.nb_prev_actions
         )
 
-    def compute_labels_batch(self, batch: ActionBatch):
+    def compute_labels_batch(self, batch: ActionBatch) -> Tuple[jax.Array, jax.Array]:
         """Device computation of the ``(G, A)`` scores/concedes tensors."""
         return self._labels_kernel(batch)
 
-    def compute_features(self, game, game_actions: pd.DataFrame) -> pd.DataFrame:
+    def compute_features(self, game: Any, game_actions: pd.DataFrame) -> pd.DataFrame:
         """Feature representation of each game state of one game.
 
         Parameters
@@ -176,7 +177,7 @@ class VAEP:
         states = self._fs.play_left_to_right(states, game.home_team_id)
         return pd.concat([fn(states) for fn in self.xfns], axis=1)
 
-    def compute_labels(self, game, game_actions: pd.DataFrame) -> pd.DataFrame:
+    def compute_labels(self, game: Any, game_actions: pd.DataFrame) -> pd.DataFrame:
         """Scoring/conceding labels for each game state of one game."""
         if self.backend == 'jax':
             batch = self._pack(game_actions, game.home_team_id)
@@ -279,7 +280,7 @@ class VAEP:
 
     def rate(
         self,
-        game,
+        game: Any,
         game_actions: pd.DataFrame,
         game_states: Optional[pd.DataFrame] = None,
     ) -> pd.DataFrame:
@@ -316,7 +317,7 @@ class VAEP:
             and all(isinstance(m, MLPClassifier) for m in self._models.values())
         )
 
-    def rate_batch(self, batch: ActionBatch):
+    def rate_batch(self, batch: ActionBatch) -> jax.Array:
         """Device rating of a packed multi-game batch -> ``(G, A, 3)``.
 
         With 'mlp' models the entire pipeline (features, probabilities,

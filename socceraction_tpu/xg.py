@@ -19,7 +19,7 @@ in the notebook, plus the JAX MLP and the other boosters).
 from __future__ import annotations
 
 import re
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 import pandas as pd
@@ -121,12 +121,12 @@ class XGModel:
         """Feature columns after the notebook's leak filter."""
         return list(self._feature_names)
 
-    def compute_features(self, game, game_actions: pd.DataFrame) -> pd.DataFrame:
+    def compute_features(self, game: Any, game_actions: pd.DataFrame) -> pd.DataFrame:
         """Game-state features of the game's shots (one row per shot)."""
         _, states, shots = self._shot_states(game, game_actions)
         return self._shot_features(states, shots)
 
-    def compute_labels(self, game, game_actions: pd.DataFrame) -> pd.DataFrame:
+    def compute_labels(self, game: Any, game_actions: pd.DataFrame) -> pd.DataFrame:
         """``goal`` label per shot: the shot scored.
 
         Delegates to :func:`~socceraction_tpu.vaep.labels.goal_from_shot`
@@ -167,7 +167,7 @@ class XGModel:
         self.clf = learners[learner](X, yv, **kwargs)
         return self
 
-    def estimate(self, game, game_actions: pd.DataFrame) -> pd.DataFrame:
+    def estimate(self, game: Any, game_actions: pd.DataFrame) -> pd.DataFrame:
         """xG of every action: P(goal) for shots, NaN elsewhere.
 
         Returns a frame aligned with ``game_actions`` (like

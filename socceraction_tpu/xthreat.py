@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import List, Optional, Tuple, Union
+from typing import Callable, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -514,7 +514,7 @@ class ExpectedThreat:
 
     predict = rate  # deprecated alias kept for API parity (xthreat.py:380)
 
-    def interpolator(self, kind: str = 'linear'):
+    def interpolator(self, kind: str = 'linear') -> Callable[..., np.ndarray]:
         """A callable interpolating the xT surface over the pitch.
 
         API parity: reference ``xthreat.py:327-350`` (an ``interp2d``-style

@@ -176,6 +176,41 @@ def test_unused_local_skipped_when_locals_called(tmp_path):
     assert probs == []
 
 
+def _package_problems(tmp_path, source):
+    pkg = tmp_path / 'socceraction_tpu'
+    pkg.mkdir(exist_ok=True)
+    f = pkg / 'mod.py'
+    f.write_text(source)
+    return lint.check_file(str(f))
+
+
+def test_untyped_public_def_flagged_in_package(tmp_path):
+    probs = _package_problems(tmp_path, 'def f(x):\n    return x\n')
+    assert len(probs) == 1 and 'untyped public def f()' in probs[0]
+    assert 'x, return' in probs[0]
+
+
+def test_untyped_def_exemptions(tmp_path):
+    probs = _package_problems(
+        tmp_path,
+        'class C:\n'
+        '    def m(self, x: int) -> int:\n'      # self exempt
+        '        def nested(y):\n'               # nested exempt
+        '            return y\n'
+        '        return nested(x)\n'
+        'def _private(z):\n'                     # _private exempt
+        '    return z\n'
+        'def g(*args, **kwargs) -> None:\n'      # varargs exempt
+        '    pass\n',
+    )
+    assert probs == []
+
+
+def test_untyped_def_not_enforced_outside_package(tmp_path):
+    probs = _problems(tmp_path, 'def f(x):\n    return x\n')
+    assert probs == []  # tests/tools/benchmarks are out of scope
+
+
 def test_cli_green_on_repo():
     """The repo itself must stay lint-clean (the gate's actual contract)."""
     proc = subprocess.run(

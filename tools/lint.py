@@ -13,6 +13,13 @@ Rules:
 - **unused-local** — a function-local bound by plain assignment and
   never read (the second pyflakes staple). Loop/with/unpack targets and
   ``_``-prefixed names are exempt.
+- **untyped-def** — a PUBLIC module- or class-level function in the
+  package (``socceraction_tpu/``) missing a parameter or return
+  annotation: the statically-checkable slice of the reference's
+  ``disallow_untyped_defs`` mypy gate, enforced without mypy. Nested
+  helpers, ``_private`` defs, ``self``/``cls`` and ``*args``/``**kwargs``
+  are exempt; tests/tools/benchmarks are out of scope like the
+  reference's mypy gate (``[tool.mypy]`` covers the package only).
 - **unused-import** — a name imported at module level and never
   referenced (``__init__.py`` re-exports are exempt when listed in
   ``__all__`` or imported with ``from x import y as y``).
@@ -410,6 +417,45 @@ def check_scopes(tree: ast.Module, path: str) -> List[str]:
     return sorted(problems)
 
 
+def check_untyped_defs(tree: ast.Module, path: str) -> List[str]:
+    """Public top-level/class-level defs must carry full annotations."""
+    problems: List[str] = []
+
+    def check_def(node, owner: str = '') -> None:
+        if node.name.startswith('_'):
+            return
+        a = node.args
+        named = [x for x in a.posonlyargs + a.args + a.kwonlyargs
+                 if x.arg not in ('self', 'cls')]
+        missing = [x.arg for x in named if x.annotation is None]
+        if node.returns is None:
+            missing.append('return')
+        if missing:
+            problems.append(
+                f'{path}:{node.lineno}: untyped public def '
+                f'{owner}{node.name}() (missing: {", ".join(missing)})'
+            )
+
+    def walk_body(body, owner: str = '') -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_def(node, owner)  # nested defs deliberately not visited
+            elif isinstance(node, ast.ClassDef):
+                walk_body(node.body, owner=owner + node.name + '.')
+            elif isinstance(node, (ast.If, ast.Try)):
+                # optional-dependency / version-gate patterns still define
+                # public API: `try: ... def f(...)` must not escape the gate
+                for sub_body in (
+                    [node.body, node.orelse]
+                    + ([h.body for h in node.handlers] + [node.finalbody]
+                       if isinstance(node, ast.Try) else [])
+                ):
+                    walk_body(sub_body, owner)
+
+    walk_body(tree.body)
+    return problems
+
+
 def _module_all(tree: ast.Module) -> set:
     for node in tree.body:
         if isinstance(node, ast.Assign):
@@ -440,6 +486,8 @@ def check_file(path: str) -> List[str]:
         return problems + [f'{path}:{e.lineno}: syntax error: {e.msg}']
 
     problems.extend(check_scopes(tree, path))
+    if 'socceraction_tpu' in os.path.normpath(path).split(os.sep):
+        problems.extend(check_untyped_defs(tree, path))
 
     # unused imports
     col = _ImportCollector()
