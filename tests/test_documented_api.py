@@ -1,15 +1,23 @@
-"""Every symbol on the reference's documentation site must resolve here.
+"""Every symbol on the reference's documentation site must resolve here —
+and the drop-in entry points must keep the reference's call shapes.
 
-The list below is the union of all autodoc targets in the reference's
+The symbol list is the union of all autodoc targets in the reference's
 Sphinx module pages (``/root/reference/docs/modules/*.rst``), with the
 package renamed — the exact surface a reference user finds documented.
-Vendored (rather than scraped at test time) so the suite does not depend
-on the reference checkout existing.
+``REFERENCE_PARAMS`` additionally vendors the reference's parameter-name
+lists (AST-extracted from the reference sources) for the callables a
+migrating user invokes directly: the test asserts each still accepts the
+reference's parameters *in order* as a prefix (extra trailing
+defaulted/keyword-only extensions like ``backend=`` are allowed — they
+cannot break a reference call site). Both are vendored rather than
+scraped at test time so the suite does not depend on the reference
+checkout existing.
 """
 
 from __future__ import annotations
 
 import importlib
+import inspect
 
 import pytest
 
@@ -82,8 +90,56 @@ DOCUMENTED_API = [
 ]
 
 
-@pytest.mark.parametrize('dotted', DOCUMENTED_API)
-def test_documented_symbol_resolves(dotted):
+#: dotted symbol -> the reference's parameter names, in order (self
+#: dropped). Extracted from the reference sources by AST; a migrating
+#: call site using these names positionally or by keyword must work here.
+#: ``play_left_to_right``: this repo standardizes on the upstream ``_sa``
+#: two-argument form (actions, home_team_id) everywhere — the reference
+#: fork ships BOTH ``play_left_to_right(actions)`` and
+#: ``play_left_to_right_sa(actions, home_team_id)`` (SURVEY §0; the
+#: one-argument form cannot know the playing direction).
+REFERENCE_PARAMS = {
+    'socceraction_tpu.spadl.statsbomb.convert_to_actions': ['events', 'home_team_id'],
+    'socceraction_tpu.spadl.opta.convert_to_actions': ['events', 'home_team_id'],
+    'socceraction_tpu.spadl.wyscout.convert_to_actions': ['events', 'home_team_id'],
+    'socceraction_tpu.spadl.add_names': ['actions'],
+    'socceraction_tpu.spadl.play_left_to_right': ['actions', 'home_team_id'],
+    'socceraction_tpu.atomic.spadl.convert_to_atomic': ['actions'],
+    'socceraction_tpu.atomic.spadl.add_names': ['actions'],
+    'socceraction_tpu.atomic.spadl.play_left_to_right': ['actions', 'home_team_id'],
+    'socceraction_tpu.xthreat.ExpectedThreat.__init__': ['l', 'w', 'eps'],
+    'socceraction_tpu.xthreat.ExpectedThreat.fit': ['actions'],
+    'socceraction_tpu.xthreat.ExpectedThreat.rate': ['actions', 'use_interpolation'],
+    'socceraction_tpu.xthreat.ExpectedThreat.save_model': ['filepath', 'overwrite'],
+    'socceraction_tpu.xthreat.load_model': ['path'],
+    'socceraction_tpu.xthreat.get_move_actions': ['actions'],
+    'socceraction_tpu.xthreat.get_successful_move_actions': ['actions'],
+    'socceraction_tpu.xthreat.action_prob': ['actions', 'l', 'w'],
+    'socceraction_tpu.xthreat.scoring_prob': ['actions', 'l', 'w'],
+    'socceraction_tpu.xthreat.move_transition_matrix': ['actions', 'l', 'w'],
+    'socceraction_tpu.vaep.VAEP.__init__': ['xfns', 'nb_prev_actions'],
+    'socceraction_tpu.vaep.VAEP.fit': [
+        'X', 'y', 'learner', 'val_size', 'tree_params', 'fit_params',
+    ],
+    'socceraction_tpu.vaep.VAEP.rate': ['game', 'game_actions', 'game_states'],
+    'socceraction_tpu.vaep.VAEP.compute_features': ['game', 'game_actions'],
+    'socceraction_tpu.vaep.VAEP.compute_labels': ['game', 'game_actions'],
+    'socceraction_tpu.vaep.VAEP.score': ['X', 'y'],
+    'socceraction_tpu.atomic.vaep.AtomicVAEP.__init__': ['xfns', 'nb_prev_actions'],
+    'socceraction_tpu.data.statsbomb.StatsBombLoader.__init__': [
+        'getter', 'root', 'creds',
+    ],
+    'socceraction_tpu.data.wyscout.WyscoutLoader.__init__': [
+        'root', 'getter', 'feeds',
+    ],
+    'socceraction_tpu.data.wyscout.PublicWyscoutLoader.__init__': [
+        'root', 'download',
+    ],
+    'socceraction_tpu.data.opta.OptaLoader.__init__': ['root', 'parser', 'feeds'],
+}
+
+
+def _resolve(dotted):
     parts = dotted.split('.')
     obj = None
     rest: list = []
@@ -97,4 +153,36 @@ def test_documented_symbol_resolves(dotted):
     assert obj is not None, f'no importable prefix of {dotted}'
     for attr in rest:
         obj = getattr(obj, attr)  # AttributeError -> test failure
-    assert obj is not None
+    return obj
+
+
+@pytest.mark.parametrize('dotted', sorted(REFERENCE_PARAMS))
+def test_documented_signature_accepts_reference_calls(dotted):
+    fn = _resolve(dotted)
+    params = [
+        p for p in inspect.signature(fn).parameters.values()
+        if p.name not in ('self', 'cls')
+    ]
+    expected = REFERENCE_PARAMS[dotted]
+    names = [p.name for p in params]
+    assert names[: len(expected)] == expected, (
+        f'{dotted}: reference call shape {expected} broken by {names}'
+    )
+    # the reference calls these positionally too: a keyword-only prefix
+    # param would keep the names identical yet break positional call sites
+    for p in params[: len(expected)]:
+        assert p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY), (
+            f'{dotted}: prefix param {p.name!r} is {p.kind.name}'
+        )
+    # extensions beyond the reference shape must not break positional or
+    # keyword reference call sites: they need defaults
+    for p in params[len(expected):]:
+        assert (
+            p.default is not inspect.Parameter.empty
+            or p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+        ), f'{dotted}: extension param {p.name!r} has no default'
+
+
+@pytest.mark.parametrize('dotted', DOCUMENTED_API)
+def test_documented_symbol_resolves(dotted):
+    assert _resolve(dotted) is not None
