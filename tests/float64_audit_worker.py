@@ -4,9 +4,10 @@ Launched by ``tests/test_float64_audit.py`` with ``JAX_ENABLE_X64=1`` on a
 CPU backend (x64 is a process-global JAX config in this jax version, so it
 cannot be toggled inside the main test process). Packs the synthetic
 learnable games with ``float_dtype=np.float64``, runs the DEVICE kernels
-(:mod:`socceraction_tpu.ops.features` / ``.labels`` / ``.formula`` and the
-fused pair path) at float64, and prints one JSON line of max-abs errors
-against the float64 pandas oracle.
+of BOTH feature families (:mod:`socceraction_tpu.ops.features` /
+``.labels`` / ``.formula`` and the atomic family in ``ops.atomic``) plus
+the fused pair path at float64, and prints one JSON line of max-abs
+errors against the float64 pandas oracle.
 
 This is the proof that the e2e tier's 2e-3 float32 band
 (``tests/test_e2e_worldcup.py``) is pure rounding: at matched precision
@@ -22,39 +23,22 @@ import types
 import numpy as np
 import pandas as pd
 
+K = 3
+HOME = {1: 100, 2: 300}
 
-def main() -> None:
-    import jax
+
+def audit_family(frames, batch, oracle, kernel_names, ops_features,
+                 ops_labels, formula_device, add_names, formula_pd,
+                 rng, prefix=''):
+    """Features / labels / formula audit for one feature family.
+
+    ``frames`` are per-game pandas actions, ``batch`` the float64 pack of
+    their concatenation; the oracle is the family's pandas-backend model.
+    Returns the ``{<prefix>features_max_abs_err, ...}`` result keys.
+    """
     import jax.numpy as jnp
 
-    assert jax.config.jax_enable_x64, 'worker must run with JAX_ENABLE_X64=1'
-
-    from socceraction_tpu.core.batch import pack_actions, unpack_values
-    from socceraction_tpu.core.synthetic import synthetic_actions_frame
-    from socceraction_tpu.ml.mlp import _MLP
-    from socceraction_tpu.ops import formula as formula_ops
-    from socceraction_tpu.ops import labels as labels_ops
-    from socceraction_tpu.ops.features import compute_features
-    from socceraction_tpu.ops.fused import fused_pair_logits
-    from socceraction_tpu.spadl import utils as spadl_utils
-    from socceraction_tpu.vaep import VAEP
-    from socceraction_tpu.vaep import formula as formula_pd
-
-    K = 3
-    HOME = {1: 100, 2: 300}
-    frames = {
-        g: synthetic_actions_frame(
-            game_id=g, home_team_id=h, away_team_id=h + 100, n_actions=500, seed=g
-        )
-        for g, h in HOME.items()
-    }
-    allactions = pd.concat(frames.values(), ignore_index=True)
-
-    oracle = VAEP(nb_prev_actions=K, backend='pandas')
-    names = VAEP(nb_prev_actions=K, backend='jax')._kernel_names()
-
-    batch, _ = pack_actions(allactions, home_team_ids=HOME, float_dtype=np.float64)
-    assert batch.time_seconds.dtype == jnp.float64
+    from socceraction_tpu.core.batch import unpack_values
 
     def stack_oracle(fn):
         return pd.concat(
@@ -67,32 +51,27 @@ def main() -> None:
 
     out = {}
 
-    # --- features: the kernels must be float64 end-to-end -----------------
-    feats = compute_features(batch, names=names, k=K)
+    feats = ops_features(batch, names=kernel_names, k=K)
     assert feats.dtype == jnp.float64, feats.dtype
     dev_X = unpack_values(feats, batch)
     ref_X = stack_oracle(oracle.compute_features).to_numpy(dtype=np.float64)
-    out['features_max_abs_err'] = float(np.abs(dev_X - ref_X).max())
-    out['n_features'] = int(dev_X.shape[1])
+    out[f'{prefix}features_max_abs_err'] = float(np.abs(dev_X - ref_X).max())
 
-    # --- labels: booleans, must match exactly -----------------------------
-    scores, concedes = labels_ops.scores_concedes(batch)
+    scores, concedes = ops_labels(batch)
     dev_y = np.stack(
         [unpack_values(scores, batch), unpack_values(concedes, batch)], axis=1
     ).astype(bool)
     ref_y = stack_oracle(oracle.compute_labels)[['scores', 'concedes']].to_numpy()
-    out['labels_equal'] = bool((dev_y == ref_y).all())
+    out[f'{prefix}labels_equal'] = bool((dev_y == ref_y).all())
 
-    # --- formula: float64 probabilities through vaep_values ---------------
-    rng = np.random.default_rng(7)
     p_scores = jnp.asarray(rng.uniform(0.0, 0.25, size=batch.type_id.shape))
     p_concedes = jnp.asarray(rng.uniform(0.0, 0.25, size=batch.type_id.shape))
-    dev_V = unpack_values(formula_ops.vaep_values(batch, p_scores, p_concedes), batch)
+    dev_V = unpack_values(formula_device(batch, p_scores, p_concedes), batch)
     ps_flat = unpack_values(p_scores, batch)
     pc_flat = unpack_values(p_concedes, batch)
     refs, off = [], 0
     for g in HOME:
-        named = spadl_utils.add_names(frames[g])
+        named = add_names(frames[g])
         n = len(named)
         refs.append(
             formula_pd.value(
@@ -102,7 +81,71 @@ def main() -> None:
             ).to_numpy(dtype=np.float64)
         )
         off += n
-    out['formula_max_abs_err'] = float(np.abs(dev_V - np.concatenate(refs)).max())
+    out[f'{prefix}formula_max_abs_err'] = float(
+        np.abs(dev_V - np.concatenate(refs)).max()
+    )
+    return out, feats, dev_X
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.config.jax_enable_x64, 'worker must run with JAX_ENABLE_X64=1'
+
+    from socceraction_tpu.atomic.spadl import add_names as atomic_add_names
+    from socceraction_tpu.atomic.spadl import convert_to_atomic
+    from socceraction_tpu.atomic.vaep import AtomicVAEP
+    from socceraction_tpu.atomic.vaep import formula as atomic_formula_pd
+    from socceraction_tpu.core.batch import pack_actions, pack_atomic_actions
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.ml.mlp import _MLP
+    from socceraction_tpu.ops import atomic as atomic_ops
+    from socceraction_tpu.ops import formula as formula_ops
+    from socceraction_tpu.ops import labels as labels_ops
+    from socceraction_tpu.ops.features import compute_features
+    from socceraction_tpu.ops.fused import fused_pair_logits
+    from socceraction_tpu.spadl import utils as spadl_utils
+    from socceraction_tpu.vaep import VAEP
+    from socceraction_tpu.vaep import formula as formula_pd
+
+    rng = np.random.default_rng(7)
+    frames = {
+        g: synthetic_actions_frame(
+            game_id=g, home_team_id=h, away_team_id=h + 100, n_actions=500, seed=g
+        )
+        for g, h in HOME.items()
+    }
+
+    batch, _ = pack_actions(
+        pd.concat(frames.values(), ignore_index=True),
+        home_team_ids=HOME,
+        float_dtype=np.float64,
+    )
+    assert batch.time_seconds.dtype == jnp.float64
+    names = VAEP(nb_prev_actions=K, backend='jax')._kernel_names()
+    out, feats, dev_X = audit_family(
+        frames, batch, VAEP(nb_prev_actions=K, backend='pandas'), names,
+        compute_features, labels_ops.scores_concedes, formula_ops.vaep_values,
+        spadl_utils.add_names, formula_pd, rng,
+    )
+    out['n_features'] = int(dev_X.shape[1])
+
+    atomic_frames = {g: convert_to_atomic(frames[g]) for g in HOME}
+    a_batch, _ = pack_atomic_actions(
+        pd.concat(atomic_frames.values(), ignore_index=True),
+        home_team_ids=HOME,
+        float_dtype=np.float64,
+    )
+    assert a_batch.time_seconds.dtype == jnp.float64
+    a_out, _, _ = audit_family(
+        atomic_frames, a_batch, AtomicVAEP(nb_prev_actions=K, backend='pandas'),
+        AtomicVAEP(nb_prev_actions=K, backend='jax')._kernel_names(),
+        atomic_ops.compute_features, atomic_ops.scores_concedes,
+        atomic_ops.vaep_values, atomic_add_names, atomic_formula_pd, rng,
+        prefix='atomic_',
+    )
+    out.update(a_out)
 
     # --- fused pair path: stacked-fold vs materialized, both float64 ------
     module = _MLP((32, 16))

@@ -62,6 +62,13 @@ def test_formula_float64_parity(audit):
     assert audit['formula_max_abs_err'] < 1e-9, audit
 
 
+def test_atomic_family_float64_parity(audit):
+    """Same audit over the atomic family (ops/atomic vs atomic pandas)."""
+    assert audit['atomic_features_max_abs_err'] < 1e-9, audit
+    assert audit['atomic_labels_equal'] is True
+    assert audit['atomic_formula_max_abs_err'] < 1e-9, audit
+
+
 def test_fused_pair_float64_parity(audit):
     """The stacked-fold fused path is the SAME math as materialize-then-MLP.
 
