@@ -23,39 +23,21 @@ import pandas as pd
 
 
 def synthetic_spadl(n_games: int, n_actions: int, seed: int = 0) -> pd.DataFrame:
-    from socceraction_tpu.spadl import config as spadlconfig
+    """One season from the SAME possession-chain generator the quality
+    tier and the e2e stand-in store use, so the oracle denominator is
+    measured on the distribution the rest of the repo reports on."""
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
 
-    rng = np.random.default_rng(seed)
-    n = n_games * n_actions
-    type_id = rng.choice(
-        [spadlconfig.PASS, spadlconfig.DRIBBLE, spadlconfig.CROSS,
-         spadlconfig.SHOT, spadlconfig.actiontypes.index('foul'),
-         spadlconfig.actiontypes.index('interception')],
-        size=n, p=[0.45, 0.2, 0.08, 0.07, 0.1, 0.1],
+    return pd.concat(
+        [
+            synthetic_actions_frame(
+                g, home_team_id=10, away_team_id=20,
+                n_actions=n_actions, seed=seed + g,
+            )
+            for g in range(n_games)
+        ],
+        ignore_index=True,
     )
-    df = pd.DataFrame(
-        {
-            'game_id': np.repeat(np.arange(n_games), n_actions),
-            'original_event_id': np.arange(n, dtype=np.int64).astype(object),
-            'action_id': np.tile(np.arange(n_actions), n_games),
-            'period_id': np.tile(
-                np.where(np.arange(n_actions) < n_actions // 2, 1, 2), n_games
-            ),
-            'time_seconds': np.tile(
-                np.linspace(0, 2700, n_actions), n_games
-            ),
-            'team_id': rng.choice([10, 20], size=n),
-            'player_id': rng.integers(1, 23, size=n),
-            'start_x': rng.uniform(0, 105, size=n),
-            'start_y': rng.uniform(0, 68, size=n),
-            'end_x': rng.uniform(0, 105, size=n),
-            'end_y': rng.uniform(0, 68, size=n),
-            'type_id': type_id.astype(np.int64),
-            'result_id': rng.integers(0, 2, size=n).astype(np.int64),
-            'bodypart_id': rng.integers(0, 4, size=n).astype(np.int64),
-        }
-    )
-    return df
 
 
 def timed(fn, repeat: int = 3):

@@ -116,16 +116,29 @@ def test_ci_python_floor_matches_pyproject():
     m = re.search(r'requires-python\s*=\s*">=(\d+)\.(\d+)"', pyproject)
     assert m, 'pyproject.toml must declare requires-python'
     floor = (int(m.group(1)), int(m.group(2)))
+    def parse(v):
+        # unquoted YAML versions arrive as floats and are ambiguous
+        # (3.10 -> 3.1): require quoting rather than guess
+        assert isinstance(v, str), (
+            f'python-version {v!r} must be a quoted string in ci.yml'
+        )
+        # "3.x" / "3.12-dev" style pins are legal Actions syntax but not
+        # comparable against the floor: demand plain numeric pins here
+        assert re.fullmatch(r'\d+(\.\d+)*', v), (
+            f'python-version {v!r} is not a plain numeric pin'
+        )
+        return tuple(int(x) for x in v.split('.'))
+
     versions = set()
     for job in wf['jobs'].values():
         matrix = job.get('strategy', {}).get('matrix', {})
         for v in matrix.get('python-version', []):
-            versions.add(tuple(int(x) for x in str(v).split('.')))
+            versions.add(parse(v))
         for step in job.get('steps', []):
             v = step.get('with', {}).get('python-version')
-            # skip matrix expressions like ${{ matrix.python-version }}
-            if v and isinstance(v, str) and re.fullmatch(r'[\d.]+', v):
-                versions.add(tuple(int(x) for x in v.split('.')))
+            if v is None or (isinstance(v, str) and '${{' in v):
+                continue  # absent, or a matrix expression resolved above
+            versions.add(parse(v))
     assert versions, 'no python versions pinned in ci.yml'
     assert min(versions) >= floor, (
         f'ci.yml tests python {min(versions)} below requires-python {floor}'
