@@ -20,7 +20,7 @@ pytestmark = pytest.mark.slow
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_impl(extra_env):
+def _run_bench(extra_env, *, impl=True, timeout=520):
     sys.path.insert(0, _ROOT)
     from bench import _cpu_env
 
@@ -29,18 +29,19 @@ def _run_impl(extra_env):
     env = _cpu_env()
     env['SOCCERACTION_TPU_BENCH_GAMES'] = '4'
     env.update(extra_env)
+    argv = [sys.executable, os.path.join(_ROOT, 'bench.py')]
+    if impl:
+        argv.append('--impl')
     proc = subprocess.run(
-        [sys.executable, os.path.join(_ROOT, 'bench.py'), '--impl'],
-        env=env,
-        cwd=_ROOT,
-        capture_output=True,
-        text=True,
-        timeout=520,
+        argv, env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.splitlines() if l.startswith('{')]
     assert lines, proc.stdout[-2000:]
     return json.loads(lines[-1])
+
+
+_run_impl = _run_bench
 
 
 def test_per_call_marginal_and_degenerate():
@@ -65,6 +66,34 @@ def test_triage_short_circuits_on_forced_cpu(monkeypatch):
     # no probe subprocess: the env already rules out a TPU path
     assert out['status'] == 'cpu'
     assert 'triage_seconds' not in out
+
+
+def test_parent_end_to_end_on_forced_cpu():
+    """The PARENT flow: triage short-circuit -> attempt 1 succeeds, rc 0.
+
+    On the cpu_device_env recipe the triage must classify 'cpu' without a
+    probe subprocess and the first (inherited-env) child must land — no
+    degraded marker, triage recorded in diagnostics.
+    """
+    d = _run_bench(
+        {
+            # a parent-side failure path would otherwise stack a retry
+            # sleep plus another full child deadline past the pytest
+            # timeout, dying as opaque TimeoutExpired
+            'SOCCERACTION_TPU_BENCH_DEADLINE': '240',
+            'SOCCERACTION_TPU_BENCH_RETRY_DELAY': '0',
+        },
+        impl=False,
+        timeout=550,
+    )
+    assert d['metric'] == 'vaep_rate_actions_per_sec' and d['value'] > 0
+    assert 'degraded' not in d, d
+    triage_lines = [x for x in d.get('diagnostics', []) if x.startswith('triage:')]
+    assert len(triage_lines) == 1, d.get('diagnostics')
+    assert '"status": "cpu"' in triage_lines[0]
+    # no 'triage_seconds' = the no-probe SHORT-CIRCUIT ran, not a ~60s
+    # doctor probe that happened to answer 'cpu'
+    assert 'triage_seconds' not in triage_lines[0], triage_lines[0]
 
 
 def test_impl_headline_contract():
