@@ -119,9 +119,15 @@ def fit_sklearn(
 
     Mirrors the reference's learner shape: 100 boosting iterations of
     depth-3 trees with early stopping when a validation fraction is used.
+    Deterministic by default: this learner is this repo's own addition
+    (no reference behavior to preserve), and HistGB's internal randomness
+    (early-stopping split, binning subsample) would otherwise draw from
+    the global numpy RNG — pass ``random_state=None`` in ``tree_params``
+    to opt back into that.
     """
     if tree_params is None:
         tree_params = dict(max_iter=100, max_depth=3, early_stopping=eval_set is not None)
+    tree_params = {'random_state': 0, **tree_params}
     model = HistGradientBoostingClassifier(**tree_params)
     return model.fit(X, y, **(fit_params or {}))
 

@@ -75,15 +75,19 @@ def k3_stacks(season):
 def fitted(k3_stacks):
     X_tr, y_tr, X_te, y_te = k3_stacks
     model = VAEP(nb_prev_actions=3, backend='jax')
-    model.fit(X_tr, y_tr, learner='mlp', tree_params=_MLP_PARAMS)
+    # random_state pins the fit's 75/25 split (otherwise the global numpy
+    # RNG adds ~±0.01 AUC run-to-run noise) so the tier's measured
+    # numbers are deterministic
+    model.fit(X_tr, y_tr, learner='mlp', tree_params=_MLP_PARAMS, random_state=0)
     return model, X_tr, y_tr, X_te, y_te
 
 
 def test_heldout_auc_beats_chance(fitted):
     """Both probability heads clear a real floor on 12 held-out games.
 
-    Measured on this season (QUALITY.md): mlp scores 0.771 / concedes
-    0.707, sklearn 0.797 / 0.801. Floors leave ~0.05 seed headroom.
+    Measured on this season, deterministic (QUALITY.md): mlp scores 0.765
+    / concedes 0.724, sklearn 0.803 / 0.815. Floors leave headroom only
+    for cross-platform numeric drift — the fits are seeded.
     """
     model, _, _, X_te, y_te = fitted
     metrics = model.score(X_te, y_te)
@@ -126,7 +130,9 @@ def test_history_ablation_costs_auc(season, k3_stacks):
                 stack(model.compute_labels, test),
             )
         X_tr, y_tr, X_te, y_te = stacks
-        model.fit(X_tr, y_tr, learner='sklearn')
+        # random_state pins the fit split: split noise alone is ~±0.01
+        # AUC (QUALITY.md), comparable to the gap being asserted
+        model.fit(X_tr, y_tr, learner='sklearn', random_state=0)
         return model.score(X_te, y_te)['scores']['auroc']
 
     full, ablated = auc(3, k3_stacks), auc(1)
@@ -148,7 +154,9 @@ def test_shuffled_label_control_sits_at_chance(fitted, season):
     y_shuf = y_tr.apply(lambda c: rng.permutation(c.to_numpy())).astype(bool)
     control = VAEP(nb_prev_actions=3, backend='jax')
     control.xfns = model.xfns
-    control.fit(X_tr, y_shuf, learner='mlp', tree_params=_MLP_PARAMS)
+    control.fit(
+        X_tr, y_shuf, learner='mlp', tree_params=_MLP_PARAMS, random_state=1
+    )
     metrics = control.score(X_te, y_te)
     assert metrics['scores']['auroc'] < 0.58, metrics
     assert metrics['concedes']['auroc'] < 0.58, metrics
