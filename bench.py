@@ -447,9 +447,19 @@ def _triage_tunnel() -> dict:
         # cpu_device_env recipe, utils/env.py).
         return {'status': 'cpu', 'detail': f'JAX_PLATFORMS={platforms}, axon disabled'}
     here = os.path.dirname(os.path.abspath(__file__))
-    sys.path.insert(0, os.path.join(here, 'tools'))
     try:
-        from tpu_doctor import triage
+        # Load by file path rather than sys.path mutation so nothing else
+        # in this process (bench extras included) can be shadowed by a
+        # stray module named tpu_doctor.
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            'socceraction_tpu_bench._tpu_doctor',
+            os.path.join(here, 'tools', 'tpu_doctor.py'),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        triage = mod.triage
     except Exception as e:  # triage is an optimization, never a gate
         return {'status': 'unknown', 'detail': f'tpu_doctor unavailable: {e}'}
     t0 = time.monotonic()

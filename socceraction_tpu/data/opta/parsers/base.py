@@ -12,6 +12,8 @@ import json
 from abc import ABC
 from typing import Any, Dict, List, Optional, Tuple
 
+from .spec import derived
+
 __all__ = [
     'OptaParser',
     'OptaJSONParser',
@@ -121,3 +123,21 @@ def _get_end_y(qualifiers: Dict[int, Any]) -> Optional[float]:
         return None
     except ValueError:
         return None
+
+
+def _derive_end_x(record: Dict[str, Any], raw: Any) -> float:
+    return _get_end_x(record['qualifiers']) or record['start_x']
+
+
+def _derive_end_y(record: Dict[str, Any], raw: Any) -> float:
+    return _get_end_y(record['qualifiers']) or record['start_y']
+
+
+#: Spec fragment shared by every event feed: end coordinates derived
+#: from the qualifier dict (seeded by the parser), start-point fallback.
+END_COORD_FIELDS = (
+    derived('end_x', _derive_end_x),
+    derived('end_y', _derive_end_y),
+)
+
+

@@ -1,16 +1,34 @@
 """Parser for Opta F1 (fixtures) JSON feeds.
 
-Parity: reference ``socceraction/data/opta/parsers/f1_json.py:9-102``.
-The F1 feed lists a competition-season's fixtures.
+Parity: reference ``socceraction/data/opta/parsers/f1_json.py:9-102``,
+on the declarative spec engine: the competition header and fixture core
+are spec tables; only the per-side TeamData fold stays imperative.
 """
 
 from __future__ import annotations
 
-from datetime import datetime
 from typing import Any, Dict, Tuple
 
 from ...base import MissingDataError
 from .base import OptaJSONParser, assertget
+from .spec import Field, extract_record, ref_id, ts
+
+#: Competition/season header out of the OptaDocument attributes. The
+#: season's display name is just its id rendered as text.
+_COMPETITION_FIELDS = (
+    Field('season_id', 'season_id', int),
+    Field('season_name', 'season_id', str),
+    Field('competition_id', 'competition_id', int),
+    Field('competition_name', 'competition_name'),
+)
+
+#: Fixture core out of a MatchData node; home/away columns are folded
+#: in afterwards from the TeamData children.
+_GAME_FIELDS = (
+    Field('game_id', ('@attributes', 'uID'), ref_id),
+    Field('game_day', ('MatchInfo', '@attributes', 'MatchDay'), int),
+    Field('game_date', ('MatchInfo', 'Date'), ts('%Y-%m-%d %H:%M:%S')),
+)
 
 
 class F1JSONParser(OptaJSONParser):
@@ -26,44 +44,25 @@ class F1JSONParser(OptaJSONParser):
 
     def extract_competitions(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
         """Return ``{(competition_id, season_id): info}``."""
-        doc = self._get_doc()
-        attr = assertget(doc, '@attributes')
-        competition_id = int(assertget(attr, 'competition_id'))
-        season_id = int(assertget(attr, 'season_id'))
-        return {
-            (competition_id, season_id): dict(
-                season_id=season_id,
-                season_name=str(assertget(attr, 'season_id')),
-                competition_id=competition_id,
-                competition_name=assertget(attr, 'competition_name'),
-            )
-        }
+        attr = assertget(self._get_doc(), '@attributes')
+        record = extract_record(attr, _COMPETITION_FIELDS)
+        return {(record['competition_id'], record['season_id']): record}
 
     def extract_games(self) -> Dict[int, Dict[str, Any]]:
         """Return ``{game_id: info}`` for every fixture in the feed."""
         doc = self._get_doc()
         attr = assertget(doc, '@attributes')
-        competition_id = int(assertget(attr, 'competition_id'))
-        season_id = int(assertget(attr, 'season_id'))
+        context = {
+            'competition_id': int(assertget(attr, 'competition_id')),
+            'season_id': int(assertget(attr, 'season_id')),
+        }
         games = {}
         for match in assertget(doc, 'MatchData'):
-            match_attr = assertget(match, '@attributes')
-            info = assertget(match, 'MatchInfo')
-            info_attr = assertget(info, '@attributes')
-            game_id = int(assertget(match_attr, 'uID')[1:])
-            record: Dict[str, Any] = dict(
-                game_id=game_id,
-                competition_id=competition_id,
-                season_id=season_id,
-                game_day=int(assertget(info_attr, 'MatchDay')),
-                game_date=datetime.strptime(
-                    assertget(info, 'Date'), '%Y-%m-%d %H:%M:%S'
-                ),
-            )
+            record = extract_record(match, _GAME_FIELDS, seed=context)
             for team in assertget(match, 'TeamData'):
                 team_attr = assertget(team, '@attributes')
                 prefix = 'home' if assertget(team_attr, 'Side') == 'Home' else 'away'
-                record[f'{prefix}_team_id'] = int(assertget(team_attr, 'TeamRef')[1:])
+                record[f'{prefix}_team_id'] = ref_id(assertget(team_attr, 'TeamRef'))
                 record[f'{prefix}_score'] = int(assertget(team_attr, 'Score'))
-            games[game_id] = record
+            games[record['game_id']] = record
         return games

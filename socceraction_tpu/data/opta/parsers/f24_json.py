@@ -1,16 +1,24 @@
 """Parser for Opta F24 (match events) JSON feeds.
 
-Parity: reference ``socceraction/data/opta/parsers/f24_json.py:9-122``.
-The F24 feed holds one game's full event stream with qualifiers.
+Parity: reference ``socceraction/data/opta/parsers/f24_json.py:9-122``,
+re-architected onto the declarative spec engine: the record model lives
+in :mod:`.f24`, this module only locates the Game node inside the JSON
+envelope and feeds its attribute dicts through the shared specs.
 """
 
 from __future__ import annotations
 
-from datetime import datetime
 from typing import Any, Dict, Tuple
 
 from ...base import MissingDataError
-from .base import OptaJSONParser, _get_end_x, _get_end_y, assertget
+from .base import OptaJSONParser, assertget
+from .f24 import GAME_FIELDS, JSON_EVENT_FIELDS, event_seed
+from .spec import Field, extract_record, ts
+
+#: JSON-dialect game header: the UTC stamp nests under a locale key.
+_GAME_FIELDS = GAME_FIELDS + (
+    Field('game_date', ('game_date', 'locale'), ts('%Y-%m-%dT%H:%M:%S.%fZ')),
+)
 
 
 class F24JSONParser(OptaJSONParser):
@@ -26,58 +34,23 @@ class F24JSONParser(OptaJSONParser):
 
     def extract_games(self) -> Dict[int, Dict[str, Any]]:
         """Return ``{game_id: info}``."""
-        game = self._get_game()
-        attr = assertget(game, '@attributes')
-        game_id = int(assertget(attr, 'id'))
-        return {
-            game_id: dict(
-                game_id=game_id,
-                season_id=int(assertget(attr, 'season_id')),
-                competition_id=int(assertget(attr, 'competition_id')),
-                game_day=int(assertget(attr, 'matchday')),
-                game_date=datetime.strptime(
-                    assertget(assertget(attr, 'game_date'), 'locale'),
-                    '%Y-%m-%dT%H:%M:%S.%fZ',
-                ).replace(tzinfo=None),
-                home_team_id=int(assertget(attr, 'home_team_id')),
-                away_team_id=int(assertget(attr, 'away_team_id')),
-            )
-        }
+        attr = assertget(self._get_game(), '@attributes')
+        record = extract_record(attr, _GAME_FIELDS)
+        return {record['game_id']: record}
 
     def extract_events(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
         """Return ``{(game_id, event_id): info}``."""
         game = self._get_game()
-        game_attr = assertget(game, '@attributes')
-        game_id = int(assertget(game_attr, 'id'))
+        game_id = int(assertget(assertget(game, '@attributes'), 'id'))
         events = {}
         for element in assertget(game, 'Event'):
-            attr = element['@attributes']
-            ts_raw = attr['TimeStamp'].get('locale') if attr.get('TimeStamp') else None
-            timestamp = datetime.strptime(ts_raw, '%Y-%m-%dT%H:%M:%S.%fZ')
+            attr = assertget(element, '@attributes')
             qualifiers = {
                 int(q['@attributes']['qualifier_id']): q['@attributes']['value']
                 for q in element.get('Q', [])
             }
-            start_x = float(assertget(attr, 'x'))
-            start_y = float(assertget(attr, 'y'))
-            event_id = int(assertget(attr, 'id'))
-            events[(game_id, event_id)] = dict(
-                game_id=game_id,
-                event_id=event_id,
-                period_id=int(assertget(attr, 'period_id')),
-                team_id=int(assertget(attr, 'team_id')),
-                player_id=int(assertget(attr, 'player_id')),
-                type_id=int(assertget(attr, 'type_id')),
-                timestamp=timestamp,
-                minute=int(assertget(attr, 'min')),
-                second=int(assertget(attr, 'sec')),
-                outcome=bool(int(attr.get('outcome', 1))),
-                start_x=start_x,
-                start_y=start_y,
-                end_x=_get_end_x(qualifiers) or start_x,
-                end_y=_get_end_y(qualifiers) or start_y,
-                qualifiers=qualifiers,
-                assist=bool(int(attr.get('assist', 0))),
-                keypass=bool(int(attr.get('keypass', 0))),
+            record = extract_record(
+                attr, JSON_EVENT_FIELDS, seed=event_seed(game_id, qualifiers)
             )
+            events[(game_id, record['event_id'])] = record
         return events

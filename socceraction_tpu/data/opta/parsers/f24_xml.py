@@ -1,14 +1,26 @@
 """Parser for Opta F24 (match events) XML feeds.
 
-Parity: reference ``socceraction/data/opta/parsers/f24_xml.py:10-105``.
+Parity: reference ``socceraction/data/opta/parsers/f24_xml.py:10-105``,
+re-architected onto the declarative spec engine: the record model lives
+in :mod:`.f24`; this module adapts XML elements (attribute dicts,
+``Q`` children) into it.
 """
 
 from __future__ import annotations
 
-from datetime import datetime
 from typing import Any, Dict, Tuple
 
-from .base import OptaXMLParser, _get_end_x, _get_end_y, assertget
+from .base import OptaXMLParser, assertget
+from .f24 import GAME_FIELDS, XML_EVENT_FIELDS, event_seed
+from .spec import Field, extract_record, ts
+
+#: XML-dialect game header: naive seconds-resolution stamp plus the
+#: final score, which only this dialect carries.
+_GAME_FIELDS = GAME_FIELDS + (
+    Field('game_date', 'game_date', ts('%Y-%m-%dT%H:%M:%S')),
+    Field('home_score', 'home_score', int),
+    Field('away_score', 'away_score', int),
+)
 
 
 class F24XMLParser(OptaXMLParser):
@@ -17,57 +29,23 @@ class F24XMLParser(OptaXMLParser):
     def extract_games(self) -> Dict[int, Dict[str, Any]]:
         """Return ``{game_id: info}``."""
         game = self.root.find('Game')
-        attr = game.attrib
-        game_id = int(assertget(attr, 'id'))
-        return {
-            game_id: dict(
-                game_id=game_id,
-                season_id=int(assertget(attr, 'season_id')),
-                competition_id=int(assertget(attr, 'competition_id')),
-                game_day=int(assertget(attr, 'matchday')),
-                game_date=datetime.strptime(
-                    assertget(attr, 'game_date'), '%Y-%m-%dT%H:%M:%S'
-                ),
-                home_team_id=int(assertget(attr, 'home_team_id')),
-                away_team_id=int(assertget(attr, 'away_team_id')),
-                home_score=int(assertget(attr, 'home_score')),
-                away_score=int(assertget(attr, 'away_score')),
-            )
-        }
+        record = extract_record(dict(game.attrib), _GAME_FIELDS)
+        return {record['game_id']: record}
 
     def extract_events(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
         """Return ``{(game_id, event_id): info}``."""
         game = self.root.find('Game')
-        game_id = int(assertget(game.attrib, 'id'))
+        game_id = int(assertget(dict(game.attrib), 'id'))
         events = {}
         for element in game.iterchildren('Event'):
-            attr = dict(element.attrib)
-            event_id = int(assertget(attr, 'id'))
             qualifiers = {
                 int(q.attrib['qualifier_id']): q.attrib.get('value')
                 for q in element.iterchildren('Q')
             }
-            start_x = float(assertget(attr, 'x'))
-            start_y = float(assertget(attr, 'y'))
-            events[(game_id, event_id)] = dict(
-                game_id=game_id,
-                event_id=event_id,
-                period_id=int(assertget(attr, 'period_id')),
-                team_id=int(assertget(attr, 'team_id')),
-                player_id=int(attr['player_id']) if 'player_id' in attr else None,
-                type_id=int(assertget(attr, 'type_id')),
-                timestamp=datetime.strptime(
-                    assertget(attr, 'timestamp'), '%Y-%m-%dT%H:%M:%S.%f'
-                ),
-                minute=int(assertget(attr, 'min')),
-                second=int(assertget(attr, 'sec')),
-                outcome=bool(int(attr['outcome'])) if 'outcome' in attr else None,
-                start_x=start_x,
-                start_y=start_y,
-                end_x=_get_end_x(qualifiers) or start_x,
-                end_y=_get_end_y(qualifiers) or start_y,
-                qualifiers=qualifiers,
-                assist=bool(int(attr.get('assist', 0))),
-                keypass=bool(int(attr.get('keypass', 0))),
+            record = extract_record(
+                dict(element.attrib),
+                XML_EVENT_FIELDS,
+                seed=event_seed(game_id, qualifiers),
             )
+            events[(game_id, record['event_id'])] = record
         return events

@@ -12,6 +12,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ...base import MissingDataError
 from .base import OptaJSONParser, _team_on_side, assertget
+from .spec import extract_record
+from .statsperform import COMPETITION_FIELDS, SUBSTITUTION_FIELDS, TEAM_FIELDS
 
 
 def _person_name(obj: Dict[str, Any]) -> Optional[str]:
@@ -46,16 +48,8 @@ class MA1JSONParser(OptaJSONParser):
         """Return ``{(competition_id, season_id): info}``."""
         competitions = {}
         for match in self._get_matches():
-            info = self._match_info(match)
-            season = assertget(info, 'tournamentCalendar')
-            competition = assertget(info, 'competition')
-            key = (assertget(competition, 'id'), assertget(season, 'id'))
-            competitions[key] = dict(
-                season_id=key[1],
-                season_name=assertget(season, 'name'),
-                competition_id=key[0],
-                competition_name=assertget(competition, 'name'),
-            )
+            record = extract_record(self._match_info(match), COMPETITION_FIELDS)
+            competitions[(record['competition_id'], record['season_id'])] = record
         return competitions
 
     def extract_games(self) -> Dict[str, Dict[str, Any]]:
@@ -101,11 +95,8 @@ class MA1JSONParser(OptaJSONParser):
         for match in self._get_matches():
             info = self._match_info(match)
             for contestant in assertget(info, 'contestant'):
-                team_id = assertget(contestant, 'id')
-                teams[team_id] = dict(
-                    team_id=team_id,
-                    team_name=assertget(contestant, 'name'),
-                )
+                record = extract_record(contestant, TEAM_FIELDS)
+                teams[record['team_id']] = record
         return teams
 
     def extract_players(self) -> Dict[Tuple[str, str], Dict[str, Any]]:
@@ -181,13 +172,8 @@ class MA1JSONParser(OptaJSONParser):
             game_id = assertget(info, 'id')
             live = self._live_data(match)
             for e in live.get('substitute', []):
-                sub_id = assertget(e, 'playerOnId')
-                subs[(game_id, sub_id)] = dict(
-                    game_id=game_id,
-                    team_id=assertget(e, 'contestantId'),
-                    period_id=int(assertget(e, 'periodId')),
-                    minute=int(assertget(e, 'timeMin')),
-                    player_in_id=assertget(e, 'playerOnId'),
-                    player_out_id=assertget(e, 'playerOffId'),
+                record = extract_record(
+                    e, SUBSTITUTION_FIELDS, seed={'game_id': game_id}
                 )
+                subs[(game_id, record['player_in_id'])] = record
         return subs

@@ -13,13 +13,9 @@ from typing import Any, Dict, List, Tuple
 import pandas as pd
 
 from ...base import MissingDataError
-from .base import (
-    OptaJSONParser,
-    _get_end_x,
-    _get_end_y,
-    _team_on_side,
-    assertget,
-)
+from .base import OptaJSONParser, _team_on_side, assertget
+from .spec import extract_record
+from .statsperform import COMPETITION_FIELDS, EVENT_FIELDS, TEAM_FIELDS
 
 _POSITIONS = {
     1: 'Goalkeeper',
@@ -43,27 +39,10 @@ class MA3JSONParser(OptaJSONParser):
             return self.root['liveData']
         raise MissingDataError
 
-    @staticmethod
-    def _parse_timestamp(raw: str) -> datetime:
-        try:
-            return datetime.strptime(raw, '%Y-%m-%dT%H:%M:%S.%fZ')
-        except ValueError:
-            return datetime.strptime(raw, '%Y-%m-%dT%H:%M:%SZ')
-
     def extract_competitions(self) -> Dict[Tuple[str, str], Dict[str, Any]]:
         """Return ``{(competition_id, season_id): info}``."""
-        info = self._match_info()
-        season = assertget(info, 'tournamentCalendar')
-        competition = assertget(info, 'competition')
-        key = (assertget(competition, 'id'), assertget(season, 'id'))
-        return {
-            key: dict(
-                season_id=key[1],
-                season_name=assertget(season, 'name'),
-                competition_id=key[0],
-                competition_name=assertget(competition, 'name'),
-            )
-        }
+        record = extract_record(self._match_info(), COMPETITION_FIELDS)
+        return {(record['competition_id'], record['season_id']): record}
 
     def extract_games(self) -> Dict[str, Dict[str, Any]]:
         """Return ``{game_id: info}``."""
@@ -99,14 +78,10 @@ class MA3JSONParser(OptaJSONParser):
     def extract_teams(self) -> Dict[str, Dict[str, Any]]:
         """Return ``{team_id: info}``."""
         info = self._match_info()
-        teams = {}
-        for contestant in assertget(info, 'contestant'):
-            team_id = assertget(contestant, 'id')
-            teams[team_id] = dict(
-                team_id=team_id,
-                team_name=assertget(contestant, 'name'),
-            )
-        return teams
+        records = [
+            extract_record(c, TEAM_FIELDS) for c in assertget(info, 'contestant')
+        ]
+        return {r['team_id']: r for r in records}
 
     def extract_players(self) -> Dict[Tuple[str, str], Dict[str, Any]]:
         """Return ``{(game_id, player_id): info}`` (players with minutes > 0).
@@ -203,33 +178,16 @@ class MA3JSONParser(OptaJSONParser):
         game_id = assertget(info, 'id')
         events = {}
         for element in assertget(live, 'event'):
-            timestamp = self._parse_timestamp(assertget(element, 'timeStamp'))
             qualifiers = {
                 int(q['qualifierId']): q.get('value')
                 for q in element.get('qualifier', [])
             }
-            start_x = float(assertget(element, 'x'))
-            start_y = float(assertget(element, 'y'))
-            event_id = int(assertget(element, 'id'))
-            events[(game_id, event_id)] = dict(
-                game_id=game_id,
-                event_id=event_id,
-                period_id=int(assertget(element, 'periodId')),
-                team_id=assertget(element, 'contestantId'),
-                player_id=element.get('playerId'),
-                type_id=int(assertget(element, 'typeId')),
-                timestamp=timestamp,
-                minute=int(assertget(element, 'timeMin')),
-                second=int(assertget(element, 'timeSec')),
-                outcome=bool(int(element.get('outcome', 1))),
-                start_x=start_x,
-                start_y=start_y,
-                end_x=_get_end_x(qualifiers) or start_x,
-                end_y=_get_end_y(qualifiers) or start_y,
-                qualifiers=qualifiers,
-                assist=bool(int(element.get('assist', 0))),
-                keypass=bool(int(element.get('keyPass', 0))),
+            record = extract_record(
+                element,
+                EVENT_FIELDS,
+                seed={'game_id': game_id, 'qualifiers': qualifiers},
             )
+            events[(game_id, record['event_id'])] = record
         return events
 
     def extract_substitutions(self) -> Dict[Any, Dict[str, Any]]:

@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from ...base import MissingDataError, _snake
 from .base import OptaParser, _get_end_x, _get_end_y, assertget
+from .spec import Field, derived, extract_record, ts
 
 
 class WhoScoredParser(OptaParser):
@@ -65,46 +66,48 @@ class WhoScoredParser(OptaParser):
             period_minute = minute - limits[str(period_id - 1)]
         return (period_minute * 60 + int(event.get('second', 0))) * 1000
 
+    #: Game header straight off the match-centre root; scope ids come in
+    #: via the seed (path-supplied when not embedded in the JSON).
+    _GAME_FIELDS = (
+        Field('game_date', 'startTime', ts('%Y-%m-%dT%H:%M:%S')),
+        Field('home_team_id', ('home', 'teamId'), int),
+        Field('away_team_id', ('away', 'teamId'), int),
+        Field('home_score', ('home', 'scores', 'running'), int),
+        Field('away_score', ('away', 'scores', 'running'), int),
+        Field('duration', 'expandedMaxMinute', int, default=None),
+        Field('referee', ('referee', 'name'), default=None),
+        Field('venue', 'venueName', default=None),
+        Field('attendance', 'attendance', int, default=None),
+        Field('home_manager', ('home', 'managerName'), default=None),
+        Field('away_manager', ('away', 'managerName'), default=None),
+    )
+
+    _TEAM_FIELDS = (
+        Field('team_id', 'teamId', int),
+        Field('team_name', 'name'),
+    )
+
     def extract_games(self) -> Dict[int, Dict[str, Any]]:
         """Return ``{game_id: info}``."""
-        home = assertget(self.root, 'home')
-        away = assertget(self.root, 'away')
-        return {
-            self.game_id: dict(
-                game_id=self.game_id,
-                season_id=self.season_id,
-                competition_id=self.competition_id,
-                game_day=None,  # not in the data stream
-                game_date=datetime.strptime(
-                    assertget(self.root, 'startTime'), '%Y-%m-%dT%H:%M:%S'
-                ),
-                home_team_id=int(assertget(home, 'teamId')),
-                away_team_id=int(assertget(away, 'teamId')),
-                home_score=int(assertget(assertget(home, 'scores'), 'running')),
-                away_score=int(assertget(assertget(away, 'scores'), 'running')),
-                duration=int(self.root['expandedMaxMinute'])
-                if 'expandedMaxMinute' in self.root
-                else None,
-                referee=self.root.get('referee', {}).get('name'),
-                venue=self.root.get('venueName'),
-                attendance=int(self.root['attendance'])
-                if 'attendance' in self.root
-                else None,
-                home_manager=home.get('managerName'),
-                away_manager=away.get('managerName'),
-            )
-        }
+        record = extract_record(
+            self.root,
+            self._GAME_FIELDS,
+            seed={
+                'game_id': self.game_id,
+                'season_id': self.season_id,
+                'competition_id': self.competition_id,
+                'game_day': None,  # not in the data stream
+            },
+        )
+        return {self.game_id: record}
 
     def extract_teams(self) -> Dict[int, Dict[str, Any]]:
         """Return ``{team_id: info}``."""
-        teams = {}
-        for side in (self.root['home'], self.root['away']):
-            team_id = int(assertget(side, 'teamId'))
-            teams[team_id] = dict(
-                team_id=team_id,
-                team_name=assertget(side, 'name'),
-            )
-        return teams
+        records = [
+            extract_record(self.root[side], self._TEAM_FIELDS)
+            for side in ('home', 'away')
+        ]
+        return {r['team_id']: r for r in records}
 
     def extract_players(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
         """Return ``{(game_id, player_id): info}``."""
@@ -127,52 +130,77 @@ class WhoScoredParser(OptaParser):
                 )
         return players
 
+    def _event_fields(self, time_start: datetime) -> Tuple[Field, ...]:
+        """Event spec; closures carry feed-wide context (kickoff, periods)."""
+        return (
+            # Scraped files disagree on the id key's name.
+            derived(
+                'event_id',
+                lambda rec, raw: int(
+                    assertget(raw, 'id' if 'id' in raw else 'eventId')
+                ),
+            ),
+            derived('period_id', lambda rec, raw: self._period_id(raw)),
+            Field('team_id', 'teamId', int),
+            Field('player_id', 'playerId', int, default=None),
+            Field('type_id', ('type', 'value'), int),
+            Field('minute', 'expandedMinute', int),
+            Field('second', 'second', int, default=0),
+            # No true timestamp in the stream; reconstructed from the
+            # kickoff time for compatibility with other Opta feeds.
+            derived(
+                'timestamp',
+                lambda rec, raw: time_start
+                + timedelta(seconds=rec['minute'] * 60 + rec['second']),
+            ),
+            derived(
+                'outcome',
+                lambda rec, raw: bool(raw['outcomeType'].get('value'))
+                if 'outcomeType' in raw
+                else None,
+            ),
+            Field('start_x', 'x', float),
+            Field('start_y', 'y', float),
+            # The stream's own end point wins over the qualifier-derived one.
+            derived(
+                'end_x',
+                lambda rec, raw: raw.get('endX')
+                or _get_end_x(rec['qualifiers'])
+                or rec['start_x'],
+            ),
+            derived(
+                'end_y',
+                lambda rec, raw: raw.get('endY')
+                or _get_end_y(rec['qualifiers'])
+                or rec['start_y'],
+            ),
+            Field('related_player_id', 'relatedPlayerId', int, default=None),
+            Field('touch', 'isTouch', bool, default=False),
+            # NOTE: shot/goal are intentionally crossed to reproduce the
+            # reference's mapping (``parsers/whoscored.py:240-241``);
+            # downstream SPADL conversion keys off type_id, not these.
+            Field('shot', 'isGoal', bool, default=False),
+            Field('goal', 'isShot', bool, default=False),
+        )
+
     def extract_events(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
         """Return ``{(game_id, event_id): info}``."""
         time_start = datetime.strptime(
             assertget(self.root, 'startTime'), '%Y-%m-%dT%H:%M:%S'
         )
+        fields = self._event_fields(time_start)
         events = {}
         for attr in self.root['events']:
-            event_id = int(assertget(attr, 'id' if 'id' in attr else 'eventId'))
-            minute = int(assertget(attr, 'expandedMinute'))
-            second = int(attr.get('second', 0))
             qualifiers = {
                 int(q['type']['value']): q.get('value', True)
                 for q in attr.get('qualifiers', [])
             }
-            start_x = float(assertget(attr, 'x'))
-            start_y = float(assertget(attr, 'y'))
-            events[(self.game_id, event_id)] = dict(
-                game_id=self.game_id,
-                event_id=event_id,
-                period_id=self._period_id(attr),
-                team_id=int(assertget(attr, 'teamId')),
-                player_id=int(attr['playerId']) if 'playerId' in attr else None,
-                type_id=int(assertget(attr.get('type', {}), 'value')),
-                # No true timestamp in the stream; reconstructed from the
-                # kickoff time for compatibility with other Opta feeds.
-                timestamp=time_start + timedelta(seconds=minute * 60 + second),
-                minute=minute,
-                second=second,
-                outcome=bool(attr['outcomeType'].get('value'))
-                if 'outcomeType' in attr
-                else None,
-                start_x=start_x,
-                start_y=start_y,
-                end_x=attr.get('endX') or _get_end_x(qualifiers) or start_x,
-                end_y=attr.get('endY') or _get_end_y(qualifiers) or start_y,
-                qualifiers=qualifiers,
-                related_player_id=int(attr['relatedPlayerId'])
-                if 'relatedPlayerId' in attr
-                else None,
-                touch=bool(attr.get('isTouch', False)),
-                # NOTE: shot/goal are intentionally crossed to reproduce the
-                # reference's mapping (``parsers/whoscored.py:240-241``);
-                # downstream SPADL conversion keys off type_id, not these.
-                shot=bool(attr.get('isGoal', False)),
-                goal=bool(attr.get('isShot', False)),
+            record = extract_record(
+                attr,
+                fields,
+                seed={'game_id': self.game_id, 'qualifiers': qualifiers},
             )
+            events[(self.game_id, record['event_id'])] = record
         return events
 
     def extract_substitutions(self) -> Dict[Tuple[int, int], Dict[str, Any]]:

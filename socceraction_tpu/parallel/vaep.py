@@ -84,7 +84,7 @@ def make_train_step(
     learning_rate: float = 1e-3,
     nr_actions: int = 10,
 ) -> Tuple[Callable, Callable, Callable]:
-    """Build ``(init_fn, step_fn)`` for the fused distributed VAEP step.
+    """Build ``(init_fn, step_fn, place_batch)`` for the fused distributed VAEP step.
 
     ``step_fn(params, opt_state, batch) -> (params, opt_state, loss)`` runs
     features → labels → two-head MLP loss → grads → adam update as ONE

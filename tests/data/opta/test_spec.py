@@ -1,0 +1,85 @@
+"""Unit tests for the declarative field-spec engine behind the Opta parsers.
+
+The engine must keep the reference's ``assertget`` contract (missing or
+null source → AssertionError) while covering both reference fallback
+idioms with output-domain defaults (see
+``socceraction/data/opta/parsers/f24_json.py:67-122`` vs
+``f24_xml.py:38-104``: the same attribute is required in one dialect
+and optional in the other).
+"""
+
+from datetime import datetime
+
+import pytest
+
+from socceraction_tpu.data.opta.parsers.spec import (
+    Field,
+    derived,
+    extract_record,
+    flag,
+    ref_id,
+    ts,
+)
+
+
+def test_cast_and_path_walk():
+    raw = {'id': '7', 'nest': {'deep': {'x': '3.5'}}}
+    rec = extract_record(
+        raw,
+        (Field('event_id', 'id', int), Field('x', ('nest', 'deep', 'x'), float)),
+    )
+    assert rec == {'event_id': 7, 'x': 3.5}
+
+
+def test_missing_required_raises_assertget_style():
+    with pytest.raises(AssertionError, match='KeyError'):
+        extract_record({}, (Field('event_id', 'id', int),))
+
+
+def test_explicit_null_counts_as_missing():
+    # assertget uses .get + `assert value is not None`: JSON null and an
+    # absent key are the same condition.
+    with pytest.raises(AssertionError):
+        extract_record({'id': None}, (Field('event_id', 'id', int),))
+
+
+def test_default_is_output_domain_never_cast():
+    # default=True stands in for the reference's bool(int(attr.get('outcome', 1)))
+    rec = extract_record({}, (Field('outcome', 'outcome', flag, default=True),))
+    assert rec['outcome'] is True
+    rec = extract_record(
+        {'outcome': '0'}, (Field('outcome', 'outcome', flag, default=True),)
+    )
+    assert rec['outcome'] is False
+
+
+def test_default_none_emitted_without_cast():
+    rec = extract_record({}, (Field('player_id', 'player_id', int, default=None),))
+    assert rec['player_id'] is None
+
+
+def test_derived_sees_seed_and_prior_fields():
+    fields = (
+        Field('start_x', 'x', float),
+        derived('end_x', lambda rec, raw: rec['qualifiers'].get(140, rec['start_x'])),
+    )
+    rec = extract_record({'x': '10'}, fields, seed={'qualifiers': {140: 55.0}})
+    assert rec['end_x'] == 55.0
+    rec = extract_record({'x': '10'}, fields, seed={'qualifiers': {}})
+    assert rec['end_x'] == 10.0
+
+
+def test_ts_fallback_formats_and_tz_strip():
+    parse = ts('%Y-%m-%dT%H:%M:%S.%fZ', '%Y-%m-%dT%H:%M:%SZ')
+    assert parse('2018-06-14T15:00:00.123Z') == datetime(2018, 6, 14, 15, 0, 0, 123000)
+    assert parse('2018-06-14T15:00:00Z') == datetime(2018, 6, 14, 15, 0, 0)
+    with pytest.raises(ValueError):
+        parse('June 14th')
+    naive = ts('%Y%m%dT%H%M%S%z')('20180614T150000+0200')
+    assert naive.tzinfo is None
+
+
+def test_ref_id_and_flag_casts():
+    assert ref_id('g123456') == 123456
+    assert ref_id('t88') == 88
+    assert flag('1') is True and flag(0) is False

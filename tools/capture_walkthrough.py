@@ -55,7 +55,10 @@ def chapter_args(store: str, ckpt: str) -> dict:
 
 
 _NUM = re.compile(r'-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?')
-_PATH = re.compile(r'/\S+')
+# Path-like only: a leading slash not glued to a word (so 'actions/sec'
+# or 'scores/concedes' prose stays pinned) followed by at least one more
+# /-separated segment — matching real filesystem paths, not units.
+_PATH = re.compile(r'(?<![\w])/(?:[\w.\-]+/)+[\w.\-]*')
 
 
 def normalize(text: str) -> list:

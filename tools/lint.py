@@ -451,6 +451,13 @@ def check_untyped_defs(tree: ast.Module, path: str) -> List[str]:
                        if isinstance(node, ast.Try) else [])
                 ):
                     walk_body(sub_body, owner)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                walk_body(node.body, owner)
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                # loop-defined public defs are rare but legal; cover the
+                # body and the else-branch so nothing escapes the rule
+                walk_body(node.body, owner)
+                walk_body(node.orelse, owner)
 
     walk_body(tree.body)
     return problems
