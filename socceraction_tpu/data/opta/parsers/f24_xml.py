@@ -35,7 +35,7 @@ class F24XMLParser(OptaXMLParser):
     def extract_events(self) -> Dict[Tuple[int, int], Dict[str, Any]]:
         """Return ``{(game_id, event_id): info}``."""
         game = self.root.find('Game')
-        game_id = int(assertget(dict(game.attrib), 'id'))
+        game_id = int(assertget(game.attrib, 'id'))
         events = {}
         for element in game.iterchildren('Event'):
             qualifiers = {

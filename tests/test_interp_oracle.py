@@ -1,0 +1,154 @@
+"""Interpolation parity against scipy ``interp2d(kind='linear')`` semantics.
+
+The reference upsamples the xT surface with
+``scipy.interpolate.interp2d(x, y, z, kind='linear', bounds_error=False)``
+on the cell-center knot grid (``socceraction/xthreat.py:347-378``) and
+samples it at ``linspace(0, length, 1050) x linspace(0, width, 680)``
+(``:443-451``). scipy is absent from this image, so this module vendors
+the *semantics* as an exact oracle instead of the library:
+
+- ``interp2d(kind='linear')`` on a rectilinear grid builds a degree-1
+  ``RectBivariateSpline`` (FITPACK, s=0). A degree-1 interpolating
+  spline IS the tensor-product piecewise-linear interpolant through the
+  knots — no smoothing, no freedom.
+- With ``bounds_error=False`` and the default ``fill_value=None``,
+  points outside the knot hull are evaluated by FITPACK on the nearest
+  knot interval's polynomial — for degree 1, straight-line extension of
+  the border segment. The first/last output samples (pitch borders at
+  0 and 105/68) lie half a cell outside the knot hull, so border
+  extrapolation is exercised by the real sampling pattern, not just in
+  theory.
+
+The oracle below implements exactly that contract, independently of the
+package code (searchsorted per query point, no index clipping shared
+with the implementation), and replicates the reference's orientation
+convention: ``z`` rows are handed to interp2d as ascending-y even though
+the xT grid stores row 0 = top of pitch; the consumer then re-flips via
+``grid[w - 1 - yc]``. Agreement is asserted on random surfaces — planes
+(which any bilinear scheme reproduces) would not distinguish border
+handling.
+"""
+
+import numpy as np
+import pytest
+
+from socceraction_tpu.spadl import config as spadlconfig
+
+
+def interp2d_linear_oracle(x_knots, y_knots, z, xq, yq):
+    """Evaluate the interp2d-linear contract at ``xq`` x ``yq``.
+
+    Returns the ``(len(yq), len(xq))`` grid scipy's
+    ``interp2d(x_knots, y_knots, z, kind='linear', bounds_error=False)``
+    returns: tensor-product piecewise-linear through the knots,
+    border-segment extension outside them. Pure-python per-point
+    evaluation; deliberately shares no code with the implementation.
+    """
+    x_knots = np.asarray(x_knots, dtype=np.float64)
+    y_knots = np.asarray(y_knots, dtype=np.float64)
+    z = np.asarray(z, dtype=np.float64)
+    assert z.shape == (len(y_knots), len(x_knots))
+
+    def segment(knots, q):
+        # Index of the knot interval whose polynomial FITPACK evaluates:
+        # interior points use their containing interval, outside points
+        # the nearest end interval.
+        i = int(np.searchsorted(knots, q, side='right')) - 1
+        i = max(0, min(i, len(knots) - 2))
+        t = (q - knots[i]) / (knots[i + 1] - knots[i])  # may be <0 or >1
+        return i, t
+
+    out = np.empty((len(yq), len(xq)), dtype=np.float64)
+    for j, y in enumerate(yq):
+        iy, ty = segment(y_knots, y)
+        for i, x in enumerate(xq):
+            ix, tx = segment(x_knots, x)
+            z00 = z[iy, ix]
+            z01 = z[iy, ix + 1]
+            z10 = z[iy + 1, ix]
+            z11 = z[iy + 1, ix + 1]
+            out[j, i] = (
+                z00 * (1 - tx) * (1 - ty)
+                + z01 * tx * (1 - ty)
+                + z10 * (1 - tx) * ty
+                + z11 * tx * ty
+            )
+    return out
+
+
+def _reference_fine_grid(xT, l_out, w_out):
+    """The reference's interpolation chain, oracle-backed.
+
+    Mirrors ``interpolator()`` + ``rate(use_interpolation=True)``
+    (``xthreat.py:373-378,443-451``): knots at cell centers, ``z`` passed
+    in storage order, sampled on the 0..length/0..width linspaces.
+    """
+    w, l = xT.shape
+    cell_l = spadlconfig.field_length / l
+    cell_w = spadlconfig.field_width / w
+    x_knots = np.arange(0.0, spadlconfig.field_length, cell_l) + 0.5 * cell_l
+    y_knots = np.arange(0.0, spadlconfig.field_width, cell_w) + 0.5 * cell_w
+    xs = np.linspace(0.0, spadlconfig.field_length, l_out)
+    ys = np.linspace(0.0, spadlconfig.field_width, w_out)
+    return interp2d_linear_oracle(x_knots, y_knots, xT, xs, ys)
+
+
+# Small output grids keep the per-point oracle fast; 21x13 still hits
+# both borders and interior cells of every knot interval.
+CASES = [((12, 16), (52, 34)), ((5, 7), (21, 13)), ((3, 3), (11, 9))]
+
+
+@pytest.mark.parametrize('grid_shape,out_shape', CASES)
+def test_numpy_backend_matches_interp2d_oracle(grid_shape, out_shape):
+    from socceraction_tpu import xthreat
+
+    rng = np.random.default_rng(17)
+    w, l = grid_shape
+    (l_out, w_out) = out_shape
+    model = xthreat.ExpectedThreat(l=l, w=w, backend='pandas')
+    model.xT = rng.uniform(0.0, 0.3, size=(w, l))
+    ours = model._interpolate_numpy(l_out, w_out)
+    want = _reference_fine_grid(model.xT, l_out, w_out)
+    np.testing.assert_allclose(ours, want, atol=1e-12)
+
+
+@pytest.mark.parametrize('grid_shape,out_shape', CASES)
+def test_jax_kernel_matches_interp2d_oracle(grid_shape, out_shape):
+    import jax.numpy as jnp
+
+    from socceraction_tpu.ops import xt as xtops
+
+    rng = np.random.default_rng(23)
+    w, l = grid_shape
+    (l_out, w_out) = out_shape
+    xT = rng.uniform(0.0, 0.3, size=(w, l))
+    ours = np.asarray(xtops.interpolate_grid(jnp.asarray(xT), l_out, w_out))
+    want = _reference_fine_grid(xT, l_out, w_out)
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+
+def test_border_samples_are_extrapolated_not_clamped():
+    """The 0-coordinate sample must continue the border slope.
+
+    Distinguishes interp2d semantics from the common clamp-to-edge
+    bilinear: with knots at cell centers, the value AT the pitch border
+    lies half a cell outside the first knot and must follow the edge
+    segment's slope, not repeat the edge knot value.
+    """
+    from socceraction_tpu import xthreat
+
+    w, l = 4, 6
+    model = xthreat.ExpectedThreat(l=l, w=w, backend='pandas')
+    # Slope purely along x in physical orientation: column c has value c.
+    model.xT = np.tile(np.arange(l, dtype=np.float64), (w, 1))
+    fine = model._interpolate_numpy(2 * l + 1, w)
+    cell_l = spadlconfig.field_length / l
+    x_knots = np.arange(0.0, spadlconfig.field_length, cell_l) + 0.5 * cell_l
+    xs = np.linspace(0.0, spadlconfig.field_length, 2 * l + 1)
+    slope = 1.0 / cell_l
+    # Left border: xs[0]=0 sits 0.5*cell left of knot 0 (value 0).
+    assert fine[0, 0] == pytest.approx((xs[0] - x_knots[0]) * slope, abs=1e-12)
+    assert fine[0, 0] < 0.0  # extrapolated below the minimum knot value
+    # Right border: xs[-1]=105 sits 0.5*cell right of the last knot.
+    assert fine[0, -1] == pytest.approx((xs[-1] - x_knots[0]) * slope, abs=1e-12)
+    assert fine[0, -1] > l - 1  # above the maximum knot value

@@ -458,6 +458,9 @@ def check_untyped_defs(tree: ast.Module, path: str) -> List[str]:
                 # body and the else-branch so nothing escapes the rule
                 walk_body(node.body, owner)
                 walk_body(node.orelse, owner)
+            elif isinstance(node, ast.Match):
+                for case in node.cases:
+                    walk_body(case.body, owner)
 
     walk_body(tree.body)
     return problems
