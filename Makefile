@@ -8,9 +8,9 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench coverage walkthrough-outputs
+.PHONY: check lint compile types test test-all e2e-synthetic bench coverage walkthrough-outputs docs docs-check
 
-check: compile lint types test
+check: compile lint types docs-check test
 
 compile:
 	$(PY) -m compileall -q socceraction_tpu tests tools benchmarks examples bench.py __graft_entry__.py
@@ -43,6 +43,15 @@ bench:
 # tests/test_walkthrough.py)
 walkthrough-outputs:
 	$(PY) tools/capture_walkthrough.py
+
+# regenerate the committed API reference (docs/api/, one page per public
+# module; reference analog: the Sphinx autodoc pages in docs/api/*.rst).
+# docs-check fails when the committed pages drift from the AST surface.
+docs:
+	$(PY) tools/docgen.py
+
+docs-check:
+	$(PY) tools/docgen.py --check
 
 # statement coverage of the default suite (mirrors the reference CI's
 # `coverage run` + codecov job). Same pattern as `types`: runs when the

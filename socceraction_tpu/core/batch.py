@@ -81,10 +81,12 @@ class ActionBatch:
 
     @property
     def n_games(self) -> int:
+        """Number of games (leading axis) in the batch."""
         return self.type_id.shape[0]
 
     @property
     def max_actions(self) -> int:
+        """Padded per-game action capacity (second axis)."""
         return self.type_id.shape[1]
 
     @property

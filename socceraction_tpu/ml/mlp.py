@@ -88,6 +88,12 @@ class MLPClassifier:
         y: np.ndarray,
         eval_set: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ) -> 'MLPClassifier':
+        """Train with the reference's split/early-stop protocol.
+
+        Standardizes features, minimizes sigmoid BCE with adam, and -- when
+        ``eval_set`` is given -- early-stops on its loss exactly like the
+        gradient-boosted learners (reference ``vaep/base.py:199-213``).
+        """
         X = np.asarray(X, dtype=np.float32)
         y = np.asarray(y, dtype=np.float32)
         self.mean_ = X.mean(axis=0)

@@ -125,6 +125,7 @@ class SeasonStore:
         self.close()
 
     def close(self) -> None:
+        """Release the underlying HDF5 handle (idempotent)."""
         if self._h5 is not None:
             self._h5.close()
             self._h5 = None
@@ -228,12 +229,15 @@ class SeasonStore:
         return ids
 
     def games(self) -> pd.DataFrame:
+        """The store's games table (HDF5 key ``games``)."""
         return self.get('games')
 
     def teams(self) -> pd.DataFrame:
+        """The store's teams table (HDF5 key ``teams``)."""
         return self.get('teams')
 
     def players(self) -> pd.DataFrame:
+        """The store's players table (HDF5 key ``players``)."""
         return self.get('players')
 
 

@@ -452,6 +452,12 @@ def _time_seconds(events: pd.DataFrame) -> pd.Series:
 
 
 def create_df_actions(events: pd.DataFrame) -> pd.DataFrame:
+    """Flat v3 events -> SPADL action frame (reference ``:725-745``).
+
+    Applies the type/result/bodypart decision tables, drops non-actions
+    and orders by (game, period, time); coordinates are fixed later by
+    :func:`fix_actions`.
+    """
     primary = _str_col(events, 'type_primary')
     type_id = _determine_type_ids(events, primary)
     result_id = _determine_result_ids(events, primary, type_id)

@@ -39,12 +39,14 @@ class Timer:
         self._lock = threading.Lock()
 
     def add(self, elapsed_s: float) -> None:
+        """Record one timed interval of ``elapsed_s`` seconds."""
         with self._lock:
             self.count += 1
             self.total_s += elapsed_s
             self.max_s = max(self.max_s, elapsed_s)
 
     def as_dict(self) -> Dict[str, float]:
+        """Snapshot: count plus total/mean/max seconds."""
         return {
             'count': self.count,
             'total_s': self.total_s,

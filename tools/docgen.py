@@ -1,0 +1,286 @@
+"""Dependency-free API reference generator.
+
+The reference ships a 26-page Sphinx API reference built by autodoc
+(``/root/reference/docs/api/*.rst``); this image has no sphinx, so the
+same surface is generated from the AST instead (the ``tools/lint.py``
+pattern): one markdown page per public module under ``docs/api/``, every
+public class/function with its real signature and docstring. Output is
+deterministic — byte-stable across runs — so the committed pages are
+drift-checked by ``tests/test_api_docs.py`` exactly like the walkthrough
+outputs: regenerating must reproduce the tree, and a changed public
+surface fails the suite until the docs are regenerated.
+
+Usage::
+
+    python tools/docgen.py [--check] [--out docs/api]
+
+``--check`` writes nothing and exits 1 when the committed pages differ
+from what would be generated (the drift gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, Iterator, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = 'socceraction_tpu'
+
+
+def iter_modules(root: str) -> Iterator[Tuple[str, str]]:
+    """Yield ``(dotted_name, path)`` for every public module, sorted."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(os.path.join(root, PACKAGE)):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith('_') and d != '__pycache__')
+        for fn in sorted(filenames):
+            if not fn.endswith('.py'):
+                continue
+            stem = fn[:-3]
+            if stem.startswith('_') and stem != '__init__':
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), root)
+            dotted = rel[:-3].replace(os.sep, '.')
+            if dotted.endswith('.__init__'):
+                dotted = dotted[: -len('.__init__')]
+            out.append((dotted, os.path.join(dirpath, fn)))
+    return iter(sorted(out))
+
+
+def _signature(node: ast.AST) -> str:
+    """Render a def's signature from the AST (annotations + defaults)."""
+    a = node.args
+    parts: List[str] = []
+
+    def fmt(arg: ast.arg, default: Optional[ast.expr]) -> str:
+        s = arg.arg
+        if arg.annotation is not None:
+            s += ': ' + ast.unparse(arg.annotation)
+        if default is not None:
+            s += ' = ' + ast.unparse(default) if arg.annotation else '=' + ast.unparse(default)
+        return s
+
+    pos = a.posonlyargs + a.args
+    defaults: List[Optional[ast.expr]] = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+    for i, (arg, d) in enumerate(zip(pos, defaults)):
+        parts.append(fmt(arg, d))
+        if a.posonlyargs and i == len(a.posonlyargs) - 1:
+            parts.append('/')
+    if a.vararg is not None:
+        parts.append('*' + a.vararg.arg)
+    elif a.kwonlyargs:
+        parts.append('*')
+    for arg, d in zip(a.kwonlyargs, a.kw_defaults):
+        parts.append(fmt(arg, d))
+    if a.kwarg is not None:
+        parts.append('**' + a.kwarg.arg)
+    sig = '(' + ', '.join(parts) + ')'
+    if node.returns is not None:
+        sig += ' -> ' + ast.unparse(node.returns)
+    return sig
+
+
+def _module_all(tree: ast.Module) -> Optional[List[str]]:
+    """The module's declared export list, if statically resolvable.
+
+    Handles plain assignment, annotated assignment and ``__all__ += [...]``
+    extension; a non-literal value falls back to the underscore rule.
+    """
+    names: Optional[List[str]] = None
+    for node in tree.body:
+        target = value = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            target, value = node.target, node.value
+        if not (isinstance(target, ast.Name) and target.id == '__all__'):
+            continue
+        if value is None:
+            continue
+        try:
+            literal = list(ast.literal_eval(value))
+        except Exception:
+            return None
+        if isinstance(node, ast.AugAssign):
+            names = (names or []) + literal
+        else:
+            names = literal
+    return names
+
+
+def _walk_public(body: List[ast.stmt]) -> Iterator[ast.stmt]:
+    """Top-level statements incl. those under optional-dependency gates."""
+    for node in body:
+        if isinstance(node, (ast.If, ast.Try)):
+            sub: List[List[ast.stmt]] = [node.body, node.orelse]
+            if isinstance(node, ast.Try):
+                sub += [h.body for h in node.handlers] + [node.finalbody]
+            for b in sub:
+                yield from _walk_public(b)
+        else:
+            yield node
+
+
+def _first_line(doc: Optional[str]) -> str:
+    if not doc:
+        return ''
+    return doc.strip().splitlines()[0].strip()
+
+
+def _doc_block(doc: Optional[str]) -> List[str]:
+    """Render a docstring as markdown lines."""
+    if not doc:
+        return ['*Undocumented.*', '']
+    lines = [ln.rstrip() for ln in doc.strip().splitlines()]
+    return lines + ['']
+
+
+class ModuleDoc:
+    """Extracted public surface of one module."""
+
+    def __init__(self, dotted: str, path: str) -> None:
+        self.dotted = dotted
+        with open(path, encoding='utf-8') as fh:
+            self.tree = ast.parse(fh.read())
+        self.doc = ast.get_docstring(self.tree)
+        self.exported = _module_all(self.tree)
+        self.functions: List[ast.stmt] = []
+        self.classes: List[ast.ClassDef] = []
+        self.constants: List[str] = []
+        for node in _walk_public(self.tree.body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._is_public(node.name):
+                    self.functions.append(node)
+            elif isinstance(node, ast.ClassDef):
+                if self._is_public(node.name):
+                    self.classes.append(node)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and self._is_public(t.id) and t.id != '__all__':
+                        self.constants.append(t.id)
+
+    def _is_public(self, name: str) -> bool:
+        if self.exported is not None:
+            return name in self.exported
+        return not name.startswith('_')
+
+    def undocumented(self) -> List[str]:
+        """Public defs/classes without a docstring (drift-gated to zero)."""
+        missing = []
+        if not self.doc:
+            missing.append(self.dotted)
+        for fn in self.functions:
+            if not ast.get_docstring(fn):
+                missing.append(f'{self.dotted}.{fn.name}')
+        for cls in self.classes:
+            if not ast.get_docstring(cls):
+                missing.append(f'{self.dotted}.{cls.name}')
+            for node in cls.body:
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and not node.name.startswith('_')
+                    and not ast.get_docstring(node)
+                ):
+                    missing.append(f'{self.dotted}.{cls.name}.{node.name}')
+        return missing
+
+    def render(self) -> str:
+        out: List[str] = [f'# `{self.dotted}`', '']
+        out += _doc_block(self.doc)
+        if self.constants:
+            out += ['## Constants', '']
+            for name in self.constants:
+                out.append(f'- `{name}`')
+            out.append('')
+        for cls in self.classes:
+            bases = ', '.join(ast.unparse(b) for b in cls.bases)
+            suffix = f'({bases})' if bases else ''
+            out += [f'## class `{cls.name}{suffix}`', '']
+            out += _doc_block(ast.get_docstring(cls))
+            for node in cls.body:
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if node.name.startswith('_') and node.name != '__init__':
+                    continue
+                out += [f'### `{cls.name}.{node.name}{_signature(node)}`', '']
+                out += _doc_block(ast.get_docstring(node))
+        for fn in self.functions:
+            out += [f'## `{fn.name}{_signature(fn)}`', '']
+            out += _doc_block(ast.get_docstring(fn))
+        return '\n'.join(out).rstrip() + '\n'
+
+
+def generate(root: str) -> Dict[str, str]:
+    """Return ``{relative_page_path: content}`` for the whole package."""
+    pages: Dict[str, str] = {}
+    index: List[str] = [
+        '# API reference',
+        '',
+        'Generated by `tools/docgen.py` from the package AST and docstrings;',
+        'regenerate with `make docs`. One page per public module. Parity',
+        'columns and reference `file:line` citations live in the docstrings',
+        'themselves; `docs/api.md` is the hand-written layer map.',
+        '',
+        '| Module | Summary |',
+        '|---|---|',
+    ]
+    missing_all: List[str] = []
+    for dotted, path in iter_modules(root):
+        mod = ModuleDoc(dotted, path)
+        page = dotted + '.md'
+        pages[page] = mod.render()
+        index.append(f'| [`{dotted}`]({page}) | {_first_line(mod.doc)} |')
+        missing_all += mod.undocumented()
+    index.append('')
+    pages['index.md'] = '\n'.join(index)
+    if missing_all:
+        raise SystemExit(
+            'undocumented public symbols (add docstrings):\n  '
+            + '\n  '.join(missing_all)
+        )
+    return pages
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default=os.path.join(REPO, 'docs', 'api'))
+    ap.add_argument('--check', action='store_true', help='verify, write nothing')
+    args = ap.parse_args(argv)
+    pages = generate(REPO)
+    if args.check:
+        stale = []
+        for rel, content in pages.items():
+            path = os.path.join(args.out, rel)
+            try:
+                with open(path, encoding='utf-8') as fh:
+                    if fh.read() != content:
+                        stale.append(rel)
+            except FileNotFoundError:
+                stale.append(rel)
+        extra = [
+            fn
+            for fn in (os.listdir(args.out) if os.path.isdir(args.out) else [])
+            if fn.endswith('.md') and fn not in pages
+        ]
+        if stale or extra:
+            print('API docs drift: regenerate with `make docs`')
+            for rel in stale:
+                print(f'  stale/missing: {rel}')
+            for rel in extra:
+                print(f'  orphaned: {rel}')
+            return 1
+        print(f'docs/api up to date ({len(pages)} pages)')
+        return 0
+    os.makedirs(args.out, exist_ok=True)
+    for rel, content in pages.items():
+        with open(os.path.join(args.out, rel), 'w', encoding='utf-8') as fh:
+            fh.write(content)
+    print(f'wrote {len(pages)} pages to {args.out}')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main(sys.argv[1:]))
