@@ -158,24 +158,18 @@ def _roofline(device_kind, dt, flops, bytes_accessed):
 def bench_impl() -> dict:
     import jax
 
-    from __graft_entry__ import entry, _NAMES, _K
+    from __graft_entry__ import build_forward, example_inputs
     from socceraction_tpu.core.synthetic import synthetic_batch
-    from socceraction_tpu.ml.mlp import _MLP
-    from socceraction_tpu.ops.features import compute_features
-    from socceraction_tpu.ops.formula import vaep_values
+    from socceraction_tpu.ops.profile import preferred_rating_path
 
     platform = jax.devices()[0].platform
     device_kind = jax.devices()[0].device_kind
 
-    fused_forward, (params, _) = entry()
-
-    module = _MLP((128, 128))
-
-    def materialized_forward(params, batch):
-        feats = compute_features(batch, names=_NAMES, k=_K)
-        p_scores = jax.nn.sigmoid(module.apply(params['scores'], feats))
-        p_concedes = jax.nn.sigmoid(module.apply(params['concedes'], feats))
-        return vaep_values(batch, p_scores, p_concedes)
+    params, _ = example_inputs()
+    # measure BOTH candidate paths explicitly (entry() itself dispatches on
+    # the platform profile, so it cannot serve as "the fused one")
+    fused_forward = build_forward('fused')
+    materialized_forward = build_forward('materialized')
 
     # ~850k valid actions; materialized feature tensor (G, A, 568) fp32
     # ≈ 1.9 GB in HBM — the fused path never builds it. The CPU-fallback
@@ -193,22 +187,32 @@ def bench_impl() -> dict:
 
     fused_aps = total_actions / dt_fused
     mat_aps = total_actions / dt_mat
-    # The flagship (entry()) is the fused combined-table path; since round 3
-    # it is measured fastest (BENCH_r02's 2.8x regression was the old
-    # gather-per-block form — see benchmarks/fused_experiment.py).
-    best = max(fused_aps, mat_aps)
+    # The flagship is whatever the committed platform profile recorded as
+    # measured-fastest here (ops/profile.py) — the headline `value` is THAT
+    # path's rate, so a regression of the profiled choice can never hide
+    # behind max(): it shows up as flagship_is_fastest: false AND a lower
+    # headline, and the fix is re-running tools/update_platform_profile.py
+    # on the new artifact.
+    # respect_env=False: the artifact's flagship is always the PROFILE's
+    # choice — a debugging SOCCERACTION_TPU_RATING_PATH override must not
+    # silently relabel the headline's provenance
+    flagship = preferred_rating_path(platform, respect_env=False)
+    rates = {'fused': fused_aps, 'materialized': mat_aps}
+    flagship_aps = rates[flagship]
     result = {
         'metric': 'vaep_rate_actions_per_sec',
-        'value': round(best, 1),
+        'value': round(flagship_aps, 1),
         'unit': 'actions/sec',
-        'vs_baseline': round(best / BASELINE_ACTIONS_PER_SEC, 3),
+        'vs_baseline': round(flagship_aps / BASELINE_ACTIONS_PER_SEC, 3),
         'platform': platform,
         'device_kind': device_kind,
         'total_actions': total_actions,
         'fused_actions_per_sec': round(fused_aps, 1),
         'materialized_actions_per_sec': round(mat_aps, 1),
-        'flagship': 'fused',
-        'flagship_is_fastest': bool(fused_aps >= mat_aps),
+        'flagship': flagship,
+        'flagship_source': 'platform_profile',
+        'measured_winner': max(rates, key=rates.get),
+        'flagship_is_fastest': bool(flagship_aps >= max(rates.values())),
     }
     if not (fused_reliable and mat_reliable):
         result['measurement_unreliable'] = (
@@ -403,6 +407,93 @@ def _bench_extra_configs() -> dict:
         ) if compute_s > 1e-4 else None,
         'final_loss_finite': bool(jax.numpy.isfinite(loss)),
     }
+
+    out['cold_path_stream'] = _bench_cold_path()
+    return out
+
+
+def _bench_cold_path() -> dict:
+    """Cold start: season store on disk → stream → pack → rate end-to-end.
+
+    The headline metric times device rating on a RESIDENT batch; a user's
+    season starts on disk. This measures ``SeasonStore`` reads +
+    ``iter_batches(prefetch=1)`` host packing overlapped with the flagship
+    rating forward at ~3k-game scale, and attributes host time from the
+    pipeline timer registry so the artifact shows which side of the
+    pipeline bounds the cold rate (on this image's 1-core host it is the
+    read+pack side; the device hides behind it).
+    """
+    import time as _time
+
+    import jax
+
+    from __graft_entry__ import build_forward, example_inputs
+    from socceraction_tpu.core.synthetic import write_synthetic_season
+    from socceraction_tpu.ops.profile import preferred_rating_path
+    from socceraction_tpu.pipeline import SeasonStore, iter_batches
+    from socceraction_tpu.utils.profiling import timer_report
+
+    cold_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_GAMES', 3072))
+    chunk = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_CHUNK', 512))
+    n_actions = 1600  # per game on disk; packed to 1664 (lane multiple)
+    store_path = f'/tmp/socceraction_tpu_cold_{cold_games}x{n_actions}.h5'
+    out = {'games': cold_games, 'games_per_batch': chunk, 'prefetch': 1}
+    if os.path.exists(store_path):
+        # deterministic content (fixed seed): safe to reuse across runs,
+        # so repeat benches measure the pipeline, not the one-time build
+        out['store'] = 'cached'
+    else:
+        t0 = _time.perf_counter()
+        # build under a tmp name + atomic rename: an abandoned/killed child
+        # (this harness abandons overrunning children by design) must never
+        # leave a partial store that later runs would trust as 'cached'
+        # keep the .h5 suffix so SeasonStore's engine inference still
+        # picks hdf5 for the temporary name
+        tmp_path = store_path.replace('.h5', f'.building.{os.getpid()}.h5')
+        try:
+            write_synthetic_season(tmp_path, cold_games, n_actions)
+            os.replace(tmp_path, store_path)
+        finally:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
+        out['store'] = 'built'
+        out['store_build_s'] = round(_time.perf_counter() - t0, 1)
+
+    rating_path = preferred_rating_path(respect_env=False)
+    params, _ = example_inputs()
+    forward = jax.jit(build_forward(rating_path))
+    out['rating_path'] = rating_path
+
+    with SeasonStore(store_path, mode='r') as store:
+        timer_report(reset=True)
+        counts = []
+        last = None
+        t_first = None
+        t_start = _time.perf_counter()
+        for batch, _ids in iter_batches(
+            store, chunk, max_actions=1664, prefetch=1, drop_remainder=True
+        ):
+            last = forward(params, batch)
+            counts.append(batch.mask.sum())
+            if t_first is None:
+                t_first = _time.perf_counter() - t_start
+        # one sync at the end: per-chunk fetches would serialize the
+        # stream against the device and break the prefetch overlap
+        actions = int(sum(float(c) for c in counts))
+        jax.block_until_ready(last)
+        wall = _time.perf_counter() - t_start
+    timers = timer_report()
+    read_s = timers.get('pipeline/read_actions', {}).get('total_s', 0.0)
+    pack_s = timers.get('pipeline/pack', {}).get('total_s', 0.0)
+    out.update(
+        actions=actions,
+        wall_s=round(wall, 2),
+        actions_per_sec=round(actions / wall, 1),
+        first_batch_s=round(t_first, 2),  # includes the one jit compile
+        host_read_s=round(read_s, 2),
+        host_pack_s=round(pack_s, 2),
+        host_bound=bool(read_s + pack_s >= 0.85 * wall),
+    )
     return out
 
 
@@ -424,6 +515,9 @@ def _cpu_env() -> dict:
         'SOCCERACTION_TPU_BENCH_GAMES',
         'SOCCERACTION_TPU_BENCH_XT_GAMES',
         'SOCCERACTION_TPU_BENCH_STEP_GAMES',
+        'SOCCERACTION_TPU_BENCH_COLD_GAMES',
+        'SOCCERACTION_TPU_BENCH_COLD_CHUNK',
+        'SOCCERACTION_TPU_RATING_PATH',
     ):
         env.pop(knob, None)
     return env

@@ -18,7 +18,58 @@ if TYPE_CHECKING:  # pandas is imported lazily inside the frame generator
 from ..spadl import config as spadlconfig
 from .batch import ActionBatch
 
-__all__ = ['synthetic_batch']
+__all__ = ['synthetic_batch', 'write_synthetic_season']
+
+
+def _draw_spadl_columns(
+    rng: 'np.random.Generator', G: int, A: int, float_dtype: type, int_dtype: type
+) -> dict:
+    """Draw the marginal SPADL column distributions for a ``(G, A)`` grid.
+
+    Single source of the distributions shared by :func:`synthetic_batch`
+    (float32/int32 device tensors) and :func:`write_synthetic_season`
+    (float64/int64 store frames): action types loosely matching real SPADL
+    streams (passes dominate, then dribbles, a tail over the rest),
+    monotone period/clock, and end points as noisy displacements of start
+    points. Cast points sit exactly where :func:`synthetic_batch` always
+    had them so its draws stay bit-identical for a given seed.
+    """
+    n_types = len(spadlconfig.actiontypes)
+    probs = np.full(n_types, 0.02)
+    probs[spadlconfig.PASS] = 0.45
+    probs[spadlconfig.DRIBBLE] = 0.25
+    probs[spadlconfig.SHOT] = 0.03
+    probs /= probs.sum()
+
+    L, W = spadlconfig.field_length, spadlconfig.field_width
+    type_id = rng.choice(n_types, size=(G, A), p=probs).astype(int_dtype)
+    result_id = rng.choice(
+        len(spadlconfig.results), size=(G, A), p=[0.25, 0.68, 0.02, 0.02, 0.02, 0.01]
+    ).astype(int_dtype)
+    bodypart_id = rng.choice(
+        len(spadlconfig.bodyparts), size=(G, A), p=[0.85, 0.08, 0.05, 0.02]
+    ).astype(int_dtype)
+    period_id = np.sort(rng.integers(1, 5, size=(G, A)), axis=1).astype(int_dtype)
+    time_seconds = np.sort(
+        rng.uniform(0, 3000, size=(G, A)).astype(float_dtype), axis=1
+    )
+    start_x = rng.uniform(0, L, size=(G, A)).astype(float_dtype)
+    start_y = rng.uniform(0, W, size=(G, A)).astype(float_dtype)
+    end_x = np.clip(start_x + rng.normal(0, 12, size=(G, A)), 0, L).astype(float_dtype)
+    end_y = np.clip(start_y + rng.normal(0, 8, size=(G, A)), 0, W).astype(float_dtype)
+    is_home = rng.integers(0, 2, size=(G, A)).astype(bool)
+    return {
+        'type_id': type_id,
+        'result_id': result_id,
+        'bodypart_id': bodypart_id,
+        'period_id': period_id,
+        'time_seconds': time_seconds,
+        'start_x': start_x,
+        'start_y': start_y,
+        'end_x': end_x,
+        'end_y': end_y,
+        'is_home': is_home,
+    }
 
 
 def synthetic_batch(
@@ -45,32 +96,14 @@ def synthetic_batch(
     G, A = n_games, n_actions
     n_valid = max(2, int(A * fill))
 
-    # Action-type distribution loosely matching real SPADL streams:
-    # passes dominate, then dribbles, with a tail over the remaining vocab.
-    n_types = len(spadlconfig.actiontypes)
-    probs = np.full(n_types, 0.02)
-    probs[spadlconfig.PASS] = 0.45
-    probs[spadlconfig.DRIBBLE] = 0.25
-    probs[spadlconfig.SHOT] = 0.03
-    probs /= probs.sum()
-
-    type_id = rng.choice(n_types, size=(G, A), p=probs).astype(np.int32)
-    result_id = rng.choice(
-        len(spadlconfig.results), size=(G, A), p=[0.25, 0.68, 0.02, 0.02, 0.02, 0.01]
-    ).astype(np.int32)
-    bodypart_id = rng.choice(
-        len(spadlconfig.bodyparts), size=(G, A), p=[0.85, 0.08, 0.05, 0.02]
-    ).astype(np.int32)
-    period_id = np.sort(rng.integers(1, 5, size=(G, A)), axis=1).astype(np.int32)
-    time_seconds = np.sort(
-        rng.uniform(0, 3000, size=(G, A)).astype(np.float32), axis=1
+    cols = _draw_spadl_columns(rng, G, A, np.float32, np.int32)
+    type_id, result_id, bodypart_id, period_id = (
+        cols['type_id'], cols['result_id'], cols['bodypart_id'], cols['period_id']
     )
-    L, W = spadlconfig.field_length, spadlconfig.field_width
-    start_x = rng.uniform(0, L, size=(G, A)).astype(np.float32)
-    start_y = rng.uniform(0, W, size=(G, A)).astype(np.float32)
-    end_x = np.clip(start_x + rng.normal(0, 12, size=(G, A)), 0, L).astype(np.float32)
-    end_y = np.clip(start_y + rng.normal(0, 8, size=(G, A)), 0, W).astype(np.float32)
-    is_home = rng.integers(0, 2, size=(G, A)).astype(bool)
+    time_seconds = cols['time_seconds']
+    start_x, start_y = cols['start_x'], cols['start_y']
+    end_x, end_y = cols['end_x'], cols['end_y']
+    is_home = cols['is_home']
 
     mask = np.zeros((G, A), dtype=bool)
     mask[:, :n_valid] = True
@@ -359,3 +392,95 @@ def synthetic_actions_frame(
         frame['latent_momentum'] = momentum_lat
         frame['latent_fast_break'] = fast_lat
     return frame
+
+
+def write_synthetic_season(
+    path: str,
+    n_games: int = 3072,
+    n_actions: int = 1600,
+    *,
+    seed: int = 0,
+) -> str:
+    """Write an ``n_games`` synthetic season to a :class:`SeasonStore`.
+
+    The throughput companion of the per-game chain generator: draws the
+    whole season's SPADL columns **vectorized across games** (the same
+    marginal distributions as :func:`synthetic_batch`) and writes per-game
+    frames under the reference store layout (one ``actions/game_<id>`` key
+    per game plus ``games``/``teams``/``players`` and the vocab tables —
+    ``/root/reference``'s ``tests/datasets/download.py:63-125``). The
+    per-action possession-chain simulation of
+    :func:`synthetic_actions_frame` costs ~135 ms/game on one host core,
+    which at cold-path benchmark scale (3k games) would be ~7 minutes of
+    setup for a benchmark whose point is the *read → pack → rate* path;
+    this writer costs ~2 ms/game to draw. Quality tiers keep using the
+    chain generator; this one exists for IO/throughput benchmarks
+    (``bench.py`` cold path).
+
+    Games all have exactly ``n_actions`` valid actions. Returns ``path``.
+    """
+    import pandas as pd
+
+    from ..pipeline.store import SeasonStore
+
+    rng = np.random.default_rng(seed)
+    G, A = n_games, n_actions
+    cols = _draw_spadl_columns(rng, G, A, np.float64, np.int64)
+
+    game_ids = 9000 + np.arange(G)
+    home = 100 + 2 * (np.arange(G) % 16)
+    away = home + 1
+    # home/away alternate per action; player drawn from the acting team
+    team_id = np.where(cols['is_home'], home[:, None], away[:, None]).astype(np.int64)
+    player_id = team_id * 1000 + rng.integers(1, 12, size=(G, A))
+    action_id = np.arange(A, dtype=np.int64)
+
+    games, teams, players = [], {}, []
+    with SeasonStore(path, mode='w') as store:
+        store.put('actiontypes', spadlconfig.actiontypes_df())
+        store.put('results', spadlconfig.results_df())
+        store.put('bodyparts', spadlconfig.bodyparts_df())
+        for i in range(G):
+            gid = int(game_ids[i])
+            frame = pd.DataFrame(
+                {
+                    'game_id': np.full(A, gid, dtype=np.int64),
+                    'action_id': action_id,
+                    'period_id': cols['period_id'][i],
+                    'time_seconds': cols['time_seconds'][i],
+                    'team_id': team_id[i],
+                    'player_id': player_id[i],
+                    'start_x': cols['start_x'][i],
+                    'start_y': cols['start_y'][i],
+                    'end_x': cols['end_x'][i],
+                    'end_y': cols['end_y'][i],
+                    'type_id': cols['type_id'][i],
+                    'result_id': cols['result_id'][i],
+                    'bodypart_id': cols['bodypart_id'][i],
+                }
+            )
+            store.put_actions(gid, frame)
+            games.append(
+                {
+                    'game_id': gid,
+                    'home_team_id': int(home[i]),
+                    'away_team_id': int(away[i]),
+                }
+            )
+            for t in (int(home[i]), int(away[i])):
+                teams[t] = {'team_id': t, 'team_name': f'Team {t}'}
+        for t in teams:
+            players.extend(
+                {
+                    'team_id': t,
+                    'player_id': t * 1000 + j,
+                    'player_name': f'Player {t}-{j}',
+                    'minutes_played': 90,
+                }
+                for j in range(1, 12)
+            )
+        store.put('games', pd.DataFrame(games))
+        store.put('teams', pd.DataFrame(list(teams.values())))
+        store.put('players', pd.DataFrame(players))
+        store.put('meta', pd.DataFrame({'synthetic': [True]}))
+    return path

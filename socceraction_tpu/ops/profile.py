@@ -1,0 +1,165 @@
+"""Measured per-platform selection of the flagship rating path.
+
+The framework has two numerically-equivalent device rating paths (parity
+tests: ``tests/test_fused.py``):
+
+- ``'fused'`` — the combined-table embedding-gather form that never
+  materializes the one-hot feature tensor (:mod:`socceraction_tpu.ops.fused`)
+- ``'materialized'`` — build the full ``(G, A, F)`` feature tensor
+  (:mod:`socceraction_tpu.ops.features`) and run the MLP heads on it
+
+Which one is faster is a *hardware* question, not a design question:
+round-2 driver benchmarking caught the original gather-per-block fused form
+losing 2.8x to the materialized path on a real v5e chip even though it
+looked better on paper (``BENCH_r02.json``), and the combined-table rework
+that fixed it was only confirmed fastest on chip by a later capture
+(``BENCH_builder_r05.json``: 60.6M vs 41.8M actions/s on TPU v5 lite;
+``BENCH_r04.json``: 235.6k vs 122.9k on CPU).
+
+This module therefore makes the flagship *selected from recorded
+measurement*, never assumed: ``platform_profiles.json`` (committed next to
+this file, regenerated from bench artifacts by
+``tools/update_platform_profile.py``) records the measured winner per JAX
+platform, and every dispatch site — ``VAEP.rate_batch``,
+``__graft_entry__.entry`` and ``bench.py``'s flagship labeling — asks
+:func:`preferred_rating_path` instead of hard-coding a path. If a future
+chip generation flips the ordering, re-running the bench and the update
+tool re-points the flagship without touching dispatch code, and until the
+profile is updated ``bench.py`` reports ``flagship_is_fastest: false`` so
+the regression is visible in the artifact chain.
+
+The reference has no analogous machinery (it has a single CPU code path);
+this is TPU-build infrastructure with no reference counterpart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+__all__ = [
+    'RATING_PATHS',
+    'load_profiles',
+    'preferred_rating_path',
+    'record_measurement',
+]
+
+RATING_PATHS = ('fused', 'materialized')
+
+_ENV_OVERRIDE = 'SOCCERACTION_TPU_RATING_PATH'
+_PROFILE_FILE = os.path.join(os.path.dirname(__file__), 'platform_profiles.json')
+
+# Fallback when a platform has no profile entry: the combined-table fused
+# form won on every platform measured so far (tpu, cpu); an unmeasured
+# platform gets that prior until a bench artifact says otherwise.
+_DEFAULT_PATH = 'fused'
+
+
+# parsed-profile cache: the file is constant for the process lifetime and
+# preferred_rating_path sits on the per-batch rating path (VAEP.rate_batch),
+# so dispatch must not pay open+parse per call. record_measurement refreshes
+# the entry it rewrites.
+_cache: Dict[str, Dict[str, Any]] = {}
+
+
+def load_profiles(path: Optional[str] = None) -> Dict[str, Any]:
+    """Parsed ``platform_profiles.json`` (``{'platforms': {name: entry}}``)."""
+    path = path or _PROFILE_FILE
+    cached = _cache.get(path)
+    if cached is None:
+        with open(path) as f:
+            cached = _cache[path] = json.load(f)
+    return cached
+
+
+def _current_platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def preferred_rating_path(
+    platform: Optional[str] = None, *, respect_env: bool = True
+) -> str:
+    """The measured-fastest rating path for ``platform``.
+
+    Resolution order:
+
+    1. ``SOCCERACTION_TPU_RATING_PATH`` env var — ``'fused'`` or
+       ``'materialized'`` forces that path everywhere (``'auto'`` and
+       unset defer to the profile). Anything else raises ``ValueError``.
+       Skipped with ``respect_env=False`` (``bench.py`` uses this so the
+       artifact's ``flagship`` always reports the *profile's* choice, never
+       a debugging override).
+    2. The committed platform profile's entry for ``platform`` (default:
+       the current JAX backend's platform name).
+    3. ``'fused'`` for platforms with no recorded measurement — or with no
+       readable profile file at all (a wheel built without the data file
+       must degrade to the default, not crash ``VAEP.rate_batch``).
+    """
+    if respect_env:
+        override = os.environ.get(_ENV_OVERRIDE, 'auto').strip().lower() or 'auto'
+        if override != 'auto':
+            if override not in RATING_PATHS:
+                raise ValueError(
+                    f'{_ENV_OVERRIDE}={override!r}: expected one of '
+                    f"{RATING_PATHS + ('auto',)}"
+                )
+            return override
+    if platform is None:
+        platform = _current_platform()
+    try:
+        entry = load_profiles().get('platforms', {}).get(platform)
+    except (OSError, ValueError):
+        return _DEFAULT_PATH
+    if entry is None:
+        return _DEFAULT_PATH
+    path = entry['rating_path']
+    if path not in RATING_PATHS:  # guard a hand-edited profile
+        raise ValueError(
+            f'platform_profiles.json: invalid rating_path {path!r} '
+            f'for platform {platform!r}'
+        )
+    return path
+
+
+def record_measurement(
+    platform: str,
+    fused_actions_per_sec: float,
+    materialized_actions_per_sec: float,
+    source: str,
+    device_kind: Optional[str] = None,
+    path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Write ``platform``'s profile entry from a bench measurement.
+
+    The winner is derived from the two rates — callers cannot inject a
+    ``rating_path`` directly, so the committed profile always traces back
+    to a measurement (``source`` names the bench artifact it came from).
+    Returns the entry written.
+    """
+    profile_path = path or _PROFILE_FILE
+    try:
+        with open(profile_path) as f:  # bypass + refresh the parse cache
+            profiles = json.load(f)
+    except FileNotFoundError:
+        profiles = {'platforms': {}}
+    entry = {
+        'rating_path': (
+            'fused'
+            if fused_actions_per_sec >= materialized_actions_per_sec
+            else 'materialized'
+        ),
+        'fused_actions_per_sec': float(fused_actions_per_sec),
+        'materialized_actions_per_sec': float(materialized_actions_per_sec),
+        'source': source,
+    }
+    if device_kind is not None:
+        entry['device_kind'] = device_kind
+    profiles.setdefault('platforms', {})[platform] = entry
+    with open(profile_path, 'w') as f:
+        json.dump(profiles, f, indent=1, sort_keys=True)
+        f.write('\n')
+    _cache[profile_path] = profiles
+    return entry

@@ -92,13 +92,17 @@ def iter_batches(
                     [store.get_actions(gid) for gid in chunk], ignore_index=True
                 )
             with timed('pipeline/pack'):
-                yield pack_actions(
+                item = pack_actions(
                     actions,
                     {gid: home[gid] for gid in chunk},
                     max_actions=max_actions,
                     float_dtype=float_dtype,
                     device=device,
                 )
+            # yield OUTSIDE the timer: with prefetch the generator suspends
+            # here on the queue put / consumer, which would otherwise be
+            # charged to 'pipeline/pack' and invert bottleneck attribution
+            yield item
 
     if prefetch <= 0:
         yield from produce()

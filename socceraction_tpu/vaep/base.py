@@ -332,14 +332,20 @@ class VAEP:
         """Device rating of a packed multi-game batch -> ``(G, A, 3)``.
 
         With 'mlp' models the entire pipeline (features, probabilities,
-        formula) runs on device without host transfers — and the one-hot
+        formula) runs on device without host transfers — and, when the
+        platform profile (:mod:`socceraction_tpu.ops.profile`) records the
+        fused path as measured-fastest on this platform, the one-hot
         feature blocks (~90% of the columns) are applied as first-layer
         embedding gathers (:mod:`socceraction_tpu.ops.fused`), so the
-        feature tensor is never materialized.
+        feature tensor is never materialized. Both paths are numerically
+        equivalent (``tests/test_fused.py``); ``SOCCERACTION_TPU_RATING_PATH``
+        forces either one.
         """
         if not self._models:
             raise NotFittedError('fit the model before calling rate')
-        if self._can_fuse():
+        from ..ops.profile import preferred_rating_path
+
+        if self._can_fuse() and preferred_rating_path() == 'fused':
             from ..ops.fused import fused_pair_probs
 
             # one jitted trace for both heads so XLA shares the per-state

@@ -114,6 +114,8 @@ def test_impl_forced_extras_contract():
             'SOCCERACTION_TPU_BENCH_FORCE_EXTRAS': '1',
             'SOCCERACTION_TPU_BENCH_XT_GAMES': '8',
             'SOCCERACTION_TPU_BENCH_STEP_GAMES': '4',
+            'SOCCERACTION_TPU_BENCH_COLD_GAMES': '8',
+            'SOCCERACTION_TPU_BENCH_COLD_CHUNK': '4',
         }
     )
     extras = d.get('extra_configs')
@@ -123,6 +125,7 @@ def test_impl_forced_extras_contract():
         'xt_fit_192x125_matrix_free_100iter',
         'xt_fit_192x125_anderson_converged',
         'vaep_mlp_train_step',
+        'cold_path_stream',
     }
     step = extras['vaep_mlp_train_step']
     assert step['final_loss_finite'] is True
@@ -130,3 +133,11 @@ def test_impl_forced_extras_contract():
     # the latency split must be internally consistent
     assert step['chained_exec_latency_s'] >= 0
     assert step['est_compute_s_per_step'] <= step['seconds_per_step'] + 1e-9
+    cold = extras['cold_path_stream']
+    # 8 games x chunk 4, drop_remainder: both chunks complete, all actions
+    assert cold['games'] == 8 and cold['actions'] == 8 * 1600
+    assert cold['actions_per_sec'] > 0
+    assert cold['rating_path'] in ('fused', 'materialized')
+    # host attribution came from the pipeline timer registry
+    assert cold['host_read_s'] >= 0 and cold['host_pack_s'] >= 0
+    assert cold['first_batch_s'] <= cold['wall_s'] + 1e-9

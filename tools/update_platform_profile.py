@@ -1,0 +1,53 @@
+"""Regenerate the committed platform profile from a bench artifact.
+
+Usage::
+
+    python tools/update_platform_profile.py BENCH_builder_r05.json [...]
+
+Each artifact must be a ``bench.py`` output (either the raw JSON line or a
+driver wrapper with a ``parsed`` key) containing ``platform``,
+``fused_actions_per_sec`` and ``materialized_actions_per_sec``. The
+artifact's measured winner becomes that platform's ``rating_path`` in
+``socceraction_tpu/ops/platform_profiles.json`` — see
+:mod:`socceraction_tpu.ops.profile` for why selection is measurement-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from socceraction_tpu.ops.profile import record_measurement  # noqa: E402
+
+
+def _load_result(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if 'parsed' in data and isinstance(data['parsed'], dict):
+        data = data['parsed']  # driver wrapper (BENCH_r0N.json shape)
+    for key in ('platform', 'fused_actions_per_sec', 'materialized_actions_per_sec'):
+        if key not in data:
+            raise SystemExit(f'{path}: bench artifact missing {key!r}')
+    return data
+
+
+def main(argv: list) -> None:
+    if not argv:
+        raise SystemExit(__doc__)
+    for path in argv:
+        result = _load_result(path)
+        entry = record_measurement(
+            platform=result['platform'],
+            fused_actions_per_sec=result['fused_actions_per_sec'],
+            materialized_actions_per_sec=result['materialized_actions_per_sec'],
+            source=os.path.basename(path),
+            device_kind=result.get('device_kind'),
+        )
+        print(f"{result['platform']}: {json.dumps(entry)}")
+
+
+if __name__ == '__main__':
+    main(sys.argv[1:])
