@@ -435,8 +435,37 @@ def _bench_cold_path() -> dict:
 
     cold_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_GAMES', 3072))
     chunk = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_CHUNK', 512))
+    if cold_games < chunk:
+        # drop_remainder below would yield zero batches; a partial chunk
+        # measures nothing comparable, so shrink the chunk instead
+        chunk = cold_games
     n_actions = 1600  # per game on disk; packed to 1664 (lane multiple)
-    store_path = f'/tmp/socceraction_tpu_cold_{cold_games}x{n_actions}.h5'
+    # cache key includes a fingerprint of the drawing code: a change to
+    # the generator distributions must invalidate yesterday's store, or
+    # 'cached' and 'built' runs silently bench different data
+    import hashlib
+    import inspect
+
+    from socceraction_tpu.core import synthetic as _synth
+
+    gen_tag = hashlib.md5(
+        inspect.getsource(_synth._draw_spadl_columns).encode()
+        + inspect.getsource(_synth.write_synthetic_season).encode()
+    ).hexdigest()[:8]
+    store_path = (
+        f'/tmp/socceraction_tpu_cold_{cold_games}x{n_actions}_{gen_tag}.h5'
+    )
+    # a generator change re-tags the store; drop same-shape stores with a
+    # stale tag so /tmp holds at most one copy per shape
+    import glob
+
+    for old in glob.glob(f'/tmp/socceraction_tpu_cold_{cold_games}x{n_actions}_*.h5'):
+        if old != store_path and '.building.' not in old:
+            # never touch another builder's in-progress temp file
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
     out = {'games': cold_games, 'games_per_batch': chunk, 'prefetch': 1}
     if os.path.exists(store_path):
         # deterministic content (fixed seed): safe to reuse across runs,
