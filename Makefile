@@ -55,11 +55,16 @@ docs-check:
 
 # statement coverage of the default suite (mirrors the reference CI's
 # `coverage run` + codecov job). Same pattern as `types`: runs when the
-# coverage module is importable, says SKIPPED when not, never pretends.
-# With coverage installed, writes COVERAGE.md (worst-covered modules).
+# coverage module is importable -> coverage.py path; otherwise the stdlib
+# sys.monitoring tracer (tools/pycov.py, Python 3.12+) measures the same
+# suite so the number exists even in this air-gapped image. Both write
+# COVERAGE.md (worst-covered modules).
 coverage:
 	@if $(PY) -c "import coverage" 2>/dev/null; then \
 	  $(PY) tools/coverage_report.py; \
+	elif $(PY) -c "import sys; sys.exit(0 if sys.version_info >= (3, 12) else 1)"; then \
+	  echo "coverage: coverage.py not installed - using the stdlib sys.monitoring tracer (tools/pycov.py)"; \
+	  $(PY) tools/pycov.py; \
 	else \
-	  echo "coverage: SKIPPED - coverage.py not installed in this image (declared in [project.optional-dependencies] dev; runs in CI with egress)"; \
+	  echo "coverage: SKIPPED - needs coverage.py (any Python) or the stdlib sys.monitoring tracer (Python 3.12+)"; \
 	fi

@@ -1,0 +1,109 @@
+"""Every public annotation in the package must RESOLVE.
+
+The reference runs strict mypy in CI (reference ``noxfile.py:24-29``,
+``disallow_untyped_defs``); this image ships no mypy, so `make types`
+is an honest skip and the round-4 lint rule could only check that
+annotations are *present* (`tools/lint.py:check_untyped_defs`). This
+tier adds the first check that has ever *executed* against annotation
+content: :func:`typing.get_type_hints` evaluates every public
+function/method/attribute annotation in every package module under
+``from __future__ import annotations`` semantics, which catches the
+whole class of string-annotation rot mypy would catch first — names
+that don't exist, symbols dropped from a module, typos in forward
+references, imports that only exist under ``TYPE_CHECKING`` without a
+matching runtime guard.
+
+This is NOT a type checker (it proves the annotations are *evaluable*,
+not that the code matches them — `make types` stays the honest-skip
+gate for that); but unlike mypy it actually runs here, and it fails
+loudly the day an annotation goes stale.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+import typing
+
+import pytest
+
+import socceraction_tpu
+
+# modules whose import itself is environment-gated (none currently; keep
+# the mechanism so a future optional-dependency module can be listed)
+_SKIP_MODULES: set = set()
+
+# the repo's lazy-import convention: pandas (and friends) are imported
+# under TYPE_CHECKING and annotations reference them as strings. mypy
+# resolves those through the TYPE_CHECKING block; get_type_hints runs at
+# runtime where the module alias is absent, so supply the conventional
+# aliases explicitly. A genuinely stale name still fails.
+import numpy as _np  # noqa: E402
+import pandas as _pd  # noqa: E402
+
+_LAZY_ALIASES = {'pd': _pd, 'np': _np}
+
+
+def _iter_modules():
+    yield 'socceraction_tpu'
+    for info in pkgutil.walk_packages(
+        socceraction_tpu.__path__, prefix='socceraction_tpu.'
+    ):
+        if info.name not in _SKIP_MODULES:
+            yield info.name
+
+
+_MODULES = sorted(_iter_modules())
+
+
+def _public_objects(mod):
+    """Public functions/classes defined in (not re-exported into) mod."""
+    for name in dir(mod):
+        if name.startswith('_'):
+            continue
+        obj = getattr(mod, name)
+        if not (inspect.isfunction(obj) or inspect.isclass(obj)):
+            continue
+        if getattr(obj, '__module__', None) != mod.__name__:
+            continue
+        yield name, obj
+
+
+@pytest.mark.parametrize('modname', _MODULES)
+def test_public_annotations_resolve(modname):
+    mod = importlib.import_module(modname)
+    problems = []
+    for name, obj in _public_objects(mod):
+        targets = [(name, obj)]
+        if inspect.isclass(obj):
+            targets += [
+                (f'{name}.{m}', fn)
+                for m, fn in vars(obj).items()
+                if not m.startswith('_') and inspect.isfunction(fn)
+            ]
+        for label, fn in targets:
+            try:
+                typing.get_type_hints(fn, localns=_LAZY_ALIASES)
+            except Exception as exc:  # noqa: BLE001 - report, don't mask
+                problems.append(f'{modname}.{label}: {type(exc).__name__}: {exc}')
+    assert not problems, '\n'.join(problems)
+
+
+def test_module_level_annotations_resolve():
+    """Module-level variable annotations (config constants etc.) resolve."""
+    problems = []
+    for modname in _MODULES:
+        mod = importlib.import_module(modname)
+        try:
+            typing.get_type_hints(mod, localns=_LAZY_ALIASES)
+        except Exception as exc:  # noqa: BLE001
+            problems.append(f'{modname}: {type(exc).__name__}: {exc}')
+    assert not problems, '\n'.join(problems)
+
+
+def test_the_walk_found_the_package():
+    """Guard the walker itself: a packaging change that empties the module
+    list would silently make every test above vacuous."""
+    assert len(_MODULES) > 40, _MODULES
+    assert 'socceraction_tpu.vaep.base' in _MODULES
