@@ -53,9 +53,9 @@ OptaPlayerSchema = Schema(
         'team_id': Field(),
         'player_id': Field(),
         'player_name': Field(dtype='str'),
-        'is_starter': Field(dtype='bool', required=False),
-        'minutes_played': Field(required=False),
-        'jersey_number': Field(required=False),
+        'is_starter': Field(dtype='bool'),
+        'minutes_played': Field(dtype='int64'),
+        'jersey_number': Field(dtype='int64'),
         'starting_position': Field(dtype='str', required=False),
     },
     strict=False,
