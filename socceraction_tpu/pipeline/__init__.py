@@ -15,10 +15,21 @@ This package makes that convention first-class:
 - :func:`load_batch` / :func:`iter_batches` -- read stored games into
   packed :class:`~socceraction_tpu.core.ActionBatch` bundles, including a
   streaming iterator for feeding seasons through HBM in fixed-size chunks.
+- :func:`ensure_packed` / :class:`PackedSeason` -- the packed-season
+  memmap cache that removes the store parse from every pass but the
+  first (``iter_batches(..., packed_cache=True)``).
 """
 
 from socceraction_tpu.pipeline.build import build_spadl_store
 from socceraction_tpu.pipeline.feed import iter_batches, load_batch
+from socceraction_tpu.pipeline.packed import PackedSeason, ensure_packed
 from socceraction_tpu.pipeline.store import SeasonStore
 
-__all__ = ['SeasonStore', 'build_spadl_store', 'iter_batches', 'load_batch']
+__all__ = [
+    'PackedSeason',
+    'SeasonStore',
+    'build_spadl_store',
+    'ensure_packed',
+    'iter_batches',
+    'load_batch',
+]

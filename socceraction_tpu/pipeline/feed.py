@@ -24,8 +24,7 @@ __all__ = ['load_batch', 'iter_batches']
 
 
 def _home_team_ids(store: SeasonStore) -> dict:
-    games = store.games()
-    return dict(zip(games['game_id'], games['home_team_id']))
+    return store.home_team_ids()
 
 
 def load_batch(
@@ -63,6 +62,7 @@ def iter_batches(
     device: Optional[Any] = None,
     drop_remainder: bool = False,
     prefetch: int = 0,
+    packed_cache: Any = False,
 ) -> Iterator[Tuple[ActionBatch, List[Any]]]:
     """Stream the store in fixed-size game chunks.
 
@@ -77,16 +77,51 @@ def iter_batches(
     dispatch alone only overlaps while the consumer returns promptly.
     ``prefetch=2`` is classic double buffering into HBM (SURVEY §7's
     streaming loader).
+
+    ``packed_cache`` (False | True | path) serves chunks from the
+    season's packed memmap cache (:mod:`socceraction_tpu.pipeline.packed`)
+    instead of re-parsing the store: the first use builds the cache with
+    one store pass (timed ``pipeline/pack_cache_build``), every later
+    pass slices memmaps (timed ``pipeline/read_cache``) — the fix for the
+    host-read-bound cold path measured in ``BENCH_builder_r05.json``.
+    Requires ``max_actions``; batches are bit-identical to the uncached
+    path.
     """
     if game_ids is None:
         game_ids = store.game_ids()
-    home = _home_team_ids(store)
+
+    if packed_cache:
+        if max_actions is None:
+            raise ValueError('packed_cache requires max_actions')
+        from socceraction_tpu.pipeline.packed import ensure_packed
+
+        import os as _os
+
+        cache_dir = (
+            _os.fspath(packed_cache)
+            if isinstance(packed_cache, (str, _os.PathLike))
+            else None
+        )
+        season = ensure_packed(
+            store,
+            max_actions=max_actions,
+            float_dtype=float_dtype,
+            cache_dir=cache_dir,
+        )
+    else:
+        season = None
+        home = _home_team_ids(store)
 
     def produce() -> Iterator[Tuple[ActionBatch, List[Any]]]:
         for lo in range(0, len(game_ids), games_per_batch):
             chunk = list(game_ids[lo : lo + games_per_batch])
             if drop_remainder and len(chunk) < games_per_batch:
                 return
+            if season is not None:
+                with timed('pipeline/read_cache'):
+                    item = season.take(chunk, device=device)
+                yield item
+                continue
             with timed('pipeline/read_actions'):
                 actions = pd.concat(
                     [store.get_actions(gid) for gid in chunk], ignore_index=True

@@ -1,0 +1,245 @@
+"""Packed-season cache: serve :class:`ActionBatch` chunks from memmaps.
+
+The round-5 on-chip cold-path measurement (`BENCH_builder_r05.json`)
+attributed 52.9 s of a 60.5 s season pass to reading the reference-layout
+HDF5 store (per-game keys, pandas parse) — the device rates actions ~800×
+faster than the host can feed them. This module removes the parse from
+every pass but the first: the season is packed ONCE into exactly the
+`(G, A)` tensors :class:`ActionBatch` holds, written as one ``.npy`` per
+column, and later passes slice memmaps — no HDF5, no pandas, no per-game
+loop.
+
+Only the nine data columns and per-game ``n_actions`` are stored:
+packing left-aligns every game (``core/batch.py:_pack_frame``), so
+``mask`` is ``arange(A) < n_actions[:, None]`` and the chunk-local
+``row_index`` is the running valid-row offset plus the action position —
+both are reconstructed at slice time for ANY game subset, which is what
+lets one cache serve every ``games_per_batch``/``game_ids`` choice.
+
+Validity: the cache records a fingerprint of the backing store (size +
+mtime, summed over files for directory stores) plus the packed shape and
+dtype; a store rewrite or a different ``max_actions``/``float_dtype``
+target misses the cache and rebuilds. Builds go to a temp directory and
+are published with one ``os.replace`` so an interrupted build can never
+be mistaken for a cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from socceraction_tpu.core import ActionBatch
+from socceraction_tpu.pipeline.store import SeasonStore
+from socceraction_tpu.utils import timed
+
+__all__ = ['PackedSeason', 'ensure_packed', 'packed_cache_dir']
+
+_VERSION = 1
+_FLOAT_COLS = ('time_seconds', 'start_x', 'start_y', 'end_x', 'end_y')
+_INT_COLS = ('type_id', 'result_id', 'bodypart_id', 'period_id')
+_BOOL_COLS = ('is_home',)
+_ALL_COLS = _FLOAT_COLS + _INT_COLS + _BOOL_COLS
+
+
+def _store_fingerprint(path: str) -> Dict[str, int]:
+    """Cheap change-detection for a store file or directory."""
+    if os.path.isfile(path):
+        st = os.stat(path)
+        return {'size': st.st_size, 'mtime_ns': st.st_mtime_ns}
+    size = 0
+    mtime = 0
+    for dirpath, _dirs, files in os.walk(path):
+        for name in files:
+            st = os.stat(os.path.join(dirpath, name))
+            size += st.st_size
+            mtime = max(mtime, st.st_mtime_ns)
+    return {'size': size, 'mtime_ns': mtime}
+
+
+def packed_cache_dir(store_path: str, max_actions: int, float_dtype: Any) -> str:
+    """Default sidecar location, keyed by the packed shape and dtype."""
+    dt = np.dtype(float_dtype).name
+    base = store_path.rstrip('/').rstrip(os.sep)
+    return f'{base}.packed-v{_VERSION}-a{int(max_actions)}-{dt}'
+
+
+class PackedSeason:
+    """Read side of the cache: memmapped columns + slice-to-batch."""
+
+    def __init__(self, cache_dir: str) -> None:
+        self.cache_dir = cache_dir
+        with open(os.path.join(cache_dir, 'meta.json'), encoding='utf-8') as fh:
+            self.meta = json.load(fh)
+        self.max_actions = int(self.meta['max_actions'])
+        self.float_dtype = np.dtype(self.meta['float_dtype'])
+        self.game_ids: List[Any] = list(self.meta['game_ids'])
+        self._pos = {gid: i for i, gid in enumerate(self.game_ids)}
+        self._cols = {
+            c: np.load(os.path.join(cache_dir, f'{c}.npy'), mmap_mode='r')
+            for c in _ALL_COLS
+        }
+        self.n_actions = np.load(os.path.join(cache_dir, 'n_actions.npy'))
+
+    def valid_for(self, store_path: str) -> bool:
+        """True while the backing store is unchanged since the build."""
+        return self.meta.get('store_fingerprint') == _store_fingerprint(store_path)
+
+    def take(
+        self,
+        game_ids: Sequence[Any],
+        *,
+        device: Optional[Any] = None,
+    ) -> Tuple[ActionBatch, List[Any]]:
+        """Build the batch for these games (any subset, any order).
+
+        Bit-identical to packing the same games' frames with
+        :func:`socceraction_tpu.core.pack_actions` at the cached
+        ``max_actions``/``float_dtype`` (asserted by the pipeline tests).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        idx = np.asarray([self._pos[g] for g in game_ids])
+        A = self.max_actions
+        n_act = self.n_actions[idx]
+        # left-aligned packing: mask and chunk-local row_index derive
+        # from n_actions alone
+        ar = np.arange(A, dtype=np.int32)
+        mask = ar[None, :] < n_act[:, None]
+        offsets = (np.cumsum(n_act, dtype=np.int64) - n_act).astype(np.int32)
+        row_index = np.where(mask, offsets[:, None] + ar[None, :], -1).astype(
+            np.int32
+        )
+        cols = {c: jnp.asarray(self._cols[c][idx]) for c in _ALL_COLS}
+        batch = ActionBatch(
+            **cols,
+            mask=jnp.asarray(mask),
+            n_actions=jnp.asarray(n_act.astype(np.int32)),
+            game_id=jnp.arange(len(idx), dtype=jnp.int32),
+            row_index=jnp.asarray(row_index),
+        )
+        if device is not None:
+            batch = jax.device_put(batch, device)
+        return batch, list(game_ids)
+
+
+def ensure_packed(
+    store: SeasonStore,
+    *,
+    max_actions: int,
+    float_dtype: Any = 'float32',
+    cache_dir: Optional[str] = None,
+    build_chunk: int = 256,
+) -> PackedSeason:
+    """Open the store's packed cache, building it on a miss.
+
+    The build streams the store once in ``build_chunk``-game chunks
+    through the regular :func:`pack_actions` path (so the cached tensors
+    inherit its exact semantics) into preallocated ``.npy`` memmaps,
+    then publishes the directory atomically. Timed under
+    ``pipeline/pack_cache_build`` in the shared timer registry.
+    """
+    from socceraction_tpu.core import pack_actions
+
+    path = store.path
+    cache_dir = cache_dir or packed_cache_dir(path, max_actions, float_dtype)
+    ps = _try_open(cache_dir, path)
+    if ps is not None:
+        return ps
+
+    with timed('pipeline/pack_cache_build'):
+        game_ids = store.game_ids()
+        home = store.home_team_ids()
+        G, A = len(game_ids), int(max_actions)
+        fdt = np.dtype(float_dtype)
+
+        tmp = f'{cache_dir}.building.{os.getpid()}'
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            maps = {}
+            for c in _FLOAT_COLS:
+                maps[c] = np.lib.format.open_memmap(
+                    os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=fdt,
+                    shape=(G, A),
+                )
+            for c in _INT_COLS:
+                maps[c] = np.lib.format.open_memmap(
+                    os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=np.int32,
+                    shape=(G, A),
+                )
+            for c in _BOOL_COLS:
+                maps[c] = np.lib.format.open_memmap(
+                    os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=bool,
+                    shape=(G, A),
+                )
+            n_actions = np.zeros(G, dtype=np.int32)
+
+            import pandas as pd
+
+            for lo in range(0, G, build_chunk):
+                chunk = game_ids[lo : lo + build_chunk]
+                frames = [store.get_actions(gid) for gid in chunk]
+                batch, _ids = pack_actions(
+                    pd.concat(frames, ignore_index=True),
+                    {gid: home[gid] for gid in chunk},
+                    max_actions=A,
+                    float_dtype=fdt,
+                )
+                hi = lo + len(chunk)
+                for c in _ALL_COLS:
+                    maps[c][lo:hi] = np.asarray(getattr(batch, c))
+                n_actions[lo:hi] = np.asarray(batch.n_actions)
+            for m in maps.values():
+                m.flush()
+            np.save(os.path.join(tmp, 'n_actions.npy'), n_actions)
+            meta = {
+                'version': _VERSION,
+                'max_actions': A,
+                'float_dtype': fdt.name,
+                'game_ids': [_json_safe(g) for g in game_ids],
+                'store_fingerprint': _store_fingerprint(path),
+            }
+            with open(os.path.join(tmp, 'meta.json'), 'w', encoding='utf-8') as fh:
+                json.dump(meta, fh)
+            if os.path.isdir(cache_dir):
+                shutil.rmtree(cache_dir)
+            try:
+                os.replace(tmp, cache_dir)
+            except OSError:
+                # concurrent builder published first: use theirs if valid
+                ps = _try_open(cache_dir, path)
+                if ps is not None:
+                    return ps
+                raise
+        finally:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+    return PackedSeason(cache_dir)
+
+
+def _try_open(cache_dir: str, store_path: str) -> Optional[PackedSeason]:
+    """Open the cache if it is complete AND matches the store; else None.
+
+    A directory left by an interrupted delete/publish (missing meta.json
+    or arrays) must read as a miss so ensure_packed rebuilds it, never as
+    an error the caller has to clean up by hand.
+    """
+    if not os.path.isdir(cache_dir):
+        return None
+    try:
+        ps = PackedSeason(cache_dir)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return None
+    return ps if ps.valid_for(store_path) else None
+
+
+def _json_safe(gid: Any) -> Any:
+    """Game ids ride through meta.json; numpy scalars need unwrapping."""
+    return gid.item() if hasattr(gid, 'item') else gid

@@ -232,6 +232,15 @@ class SeasonStore:
         """The store's games table (HDF5 key ``games``)."""
         return self.get('games')
 
+    def home_team_ids(self) -> dict:
+        """Mapping ``game_id -> home_team_id`` from the games table.
+
+        The single source both batch-feeding paths (store stream and
+        packed cache) use to orient packing, so they can never diverge.
+        """
+        games = self.games()
+        return dict(zip(games['game_id'], games['home_team_id']))
+
     def teams(self) -> pd.DataFrame:
         """The store's teams table (HDF5 key ``teams``)."""
         return self.get('teams')

@@ -1,0 +1,141 @@
+"""Packed-season cache: bit-parity with the store path + lifecycle.
+
+The cache exists because the on-chip cold path measured host-read-bound
+(52.9 s of a 60.5 s season pass parsing HDF5 — `BENCH_builder_r05.json`);
+its contract is that serving from memmaps changes NOTHING but the speed:
+every field of every chunk is bit-identical to the uncached
+``iter_batches`` path for any games_per_batch, subset, or order.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.pipeline import (
+    PackedSeason,
+    SeasonStore,
+    ensure_packed,
+    iter_batches,
+)
+from socceraction_tpu.pipeline.packed import packed_cache_dir
+
+_A = 256
+_N_GAMES = 5
+
+
+@pytest.fixture(scope='module')
+def store_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp('packed') / 'store')
+    with SeasonStore(path, mode='w') as store:
+        games = []
+        for gid in range(1, _N_GAMES + 1):
+            df = synthetic_actions_frame(
+                gid, home_team_id=10, away_team_id=20, n_actions=200, seed=gid
+            )
+            store.put_actions(gid, df)
+            games.append({'game_id': gid, 'home_team_id': 10})
+        store.put('games', pd.DataFrame(games))
+    return path
+
+
+def _batches(store, **kw):
+    return list(iter_batches(store, 2, max_actions=_A, **kw))
+
+
+def _assert_batch_equal(a, b):
+    for f in dataclasses.fields(a):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f.name)),
+            np.asarray(getattr(b, f.name)),
+            err_msg=f.name,
+        )
+
+
+def test_cached_batches_bit_match_store_path(store_path):
+    with SeasonStore(store_path, mode='r') as store:
+        plain = _batches(store)
+        cached = _batches(store, packed_cache=True)
+    assert [ids for _, ids in plain] == [ids for _, ids in cached]
+    for (b1, _), (b2, _) in zip(plain, cached):
+        _assert_batch_equal(b1, b2)
+    assert os.path.isdir(packed_cache_dir(store_path, _A, 'float32'))
+
+
+def test_cache_serves_subsets_and_orders(store_path):
+    with SeasonStore(store_path, mode='r') as store:
+        season = ensure_packed(store, max_actions=_A)
+        # reversed subset through the cache vs a fresh pack of the same games
+        want = [4, 2]
+        batch, ids = season.take(want)
+        plain = list(
+            iter_batches(store, 2, game_ids=want, max_actions=_A)
+        )
+        assert ids == want and len(plain) == 1
+        _assert_batch_equal(batch, plain[0][0])
+
+
+def test_cache_reuse_and_invalidation(store_path):
+    with SeasonStore(store_path, mode='r') as store:
+        season = ensure_packed(store, max_actions=_A)
+        assert season.valid_for(store_path)
+        # a second ensure on an unchanged store is a pure open (same dir)
+        again = ensure_packed(store, max_actions=_A)
+        assert again.cache_dir == season.cache_dir
+
+    # touching the store invalidates: ensure() must rebuild, not serve stale
+    df = synthetic_actions_frame(
+        99, home_team_id=10, away_team_id=20, n_actions=150, seed=99
+    )
+    with SeasonStore(store_path, mode='a') as store:
+        store.put_actions(99, df)
+        games = store.games()
+        store.put(
+            'games',
+            pd.concat(
+                [games, pd.DataFrame([{'game_id': 99, 'home_team_id': 10}])],
+                ignore_index=True,
+            ),
+        )
+    assert not PackedSeason(season.cache_dir).valid_for(store_path)
+    with SeasonStore(store_path, mode='r') as store:
+        rebuilt = ensure_packed(store, max_actions=_A)
+    assert rebuilt.valid_for(store_path)
+    assert 99 in list(rebuilt.game_ids)
+
+
+def test_partial_cache_reads_as_miss_and_rebuilds(store_path):
+    """A directory left by an interrupted delete/publish (meta.json gone)
+    must rebuild transparently, never raise at open."""
+    with SeasonStore(store_path, mode='r') as store:
+        season = ensure_packed(store, max_actions=_A)
+        os.unlink(os.path.join(season.cache_dir, 'meta.json'))
+        rebuilt = ensure_packed(store, max_actions=_A)
+        assert rebuilt.valid_for(store_path)
+        batch, ids = rebuilt.take([1, 2])
+        assert ids == [1, 2]
+
+
+def test_distinct_shapes_get_distinct_caches(store_path):
+    with SeasonStore(store_path, mode='r') as store:
+        a = ensure_packed(store, max_actions=_A)
+        b = ensure_packed(store, max_actions=512)
+    assert a.cache_dir != b.cache_dir
+    assert b.max_actions == 512
+
+
+def test_packed_cache_requires_max_actions(store_path):
+    with SeasonStore(store_path, mode='r') as store:
+        with pytest.raises(ValueError, match='max_actions'):
+            next(iter(iter_batches(store, 2, packed_cache=True)))
+
+
+def test_prefetch_composes_with_cache(store_path):
+    with SeasonStore(store_path, mode='r') as store:
+        plain = _batches(store)
+        cached = _batches(store, packed_cache=True, prefetch=2)
+    for (b1, _), (b2, _) in zip(plain, cached):
+        _assert_batch_equal(b1, b2)
