@@ -187,6 +187,13 @@ def bench_impl() -> dict:
 
     fused_aps = total_actions / dt_fused
     mat_aps = total_actions / dt_mat
+
+    # the opt-in bf16 hidden pipeline: measured for the record but NEVER a
+    # flagship candidate (outside the f32 parity band — ops/profile.py
+    # OPT_IN_PATHS); users enable it explicitly via the env override
+    bf16_jit = jax.jit(build_forward('fused_bf16'))
+    dt_bf16, _bf16_reliable = _measure(bf16_jit, (params, batch))
+    bf16_aps = total_actions / dt_bf16
     # The flagship is whatever the committed platform profile recorded as
     # measured-fastest here (ops/profile.py) — the headline `value` is THAT
     # path's rate, so a regression of the profiled choice can never hide
@@ -209,6 +216,7 @@ def bench_impl() -> dict:
         'total_actions': total_actions,
         'fused_actions_per_sec': round(fused_aps, 1),
         'materialized_actions_per_sec': round(mat_aps, 1),
+        'fused_bf16_actions_per_sec': round(bf16_aps, 1),
         'flagship': flagship,
         'flagship_source': 'platform_profile',
         'measured_winner': max(rates, key=rates.get),

@@ -196,6 +196,7 @@ def fused_mlp_logits(
     std: Optional[jax.Array] = None,
     registry: FusedRegistry = STANDARD_REGISTRY,
     dense_overrides: Optional[Dict[str, jax.Array]] = None,
+    hidden_dtype: Optional[Any] = None,
 ) -> jax.Array:
     """Logits of an :class:`~socceraction_tpu.ml.mlp._MLP` over a batch.
 
@@ -227,6 +228,9 @@ def fused_mlp_logits(
         cross-shard-corrected ``goalscore`` block — the one dense kernel
         whose value depends on the whole sequence, which a shard-local
         evaluation would get wrong.
+    hidden_dtype
+        Optional narrow dtype for the post-relu hidden pipeline
+        (:func:`_hidden_chain`); the fused first layer stays f32.
 
     Returns
     -------
@@ -240,7 +244,7 @@ def fused_mlp_logits(
         Wk, bias, s, batch, names=names, k=k, registry=registry,
         dense_overrides=dense_overrides,
     )
-    return _hidden_chain(leaves, h, hidden_layers)
+    return _hidden_chain(leaves, h, hidden_layers, hidden_dtype)
 
 
 def _standardized_first_layer(leaves, mean, std) -> Tuple[jax.Array, jax.Array]:
@@ -340,17 +344,44 @@ def _fused_first_layer(
     return h
 
 
-def _hidden_chain(leaves, h: jax.Array, hidden_layers: int) -> jax.Array:
-    """Apply relu + the remaining dense layers to first-layer activations."""
+def _hidden_chain(
+    leaves,
+    h: jax.Array,
+    hidden_layers: int,
+    hidden_dtype: Optional[Any] = None,
+) -> jax.Array:
+    """Apply relu + the remaining dense layers to first-layer activations.
+
+    ``hidden_dtype`` (e.g. ``jnp.bfloat16``) casts the post-relu hidden
+    pipeline — activations and hidden-layer weights — to a narrower
+    dtype. The exact parts stay exact: the fused first layer (gathers +
+    dense matmul) runs in f32 before the cast, and the logit head
+    accumulates back in f32. Opt-in — see
+    :func:`socceraction_tpu.ops.profile.preferred_rating_path` for the
+    accuracy policy. Measured on the v5e (512×1664, 2026-07-31):
+    57.4M actions/s vs 57.2M f32 — NO material gain, because XLA already
+    fuses the hidden chain's relu+matmul without round-tripping the
+    ``(G, A, H)`` intermediates through HBM; the forward's memory bound
+    lives in the first-layer fold, not the hidden pipeline. Kept as an
+    opt-in so the negative result stays executable (the bench records a
+    ``fused_bf16_actions_per_sec`` column every run).
+    """
     if hidden_layers == 0:
         # no hidden layers: Dense_0 IS the (one-unit) output layer, so the
         # fused h already holds the logits
         return h[..., 0]
     x = jax.nn.relu(h)
+    if hidden_dtype is not None:
+        x = x.astype(hidden_dtype)
     for li in range(1, hidden_layers):
         d = leaves[f'Dense_{li}']
-        x = jax.nn.relu(x @ jnp.asarray(d['kernel']) + jnp.asarray(d['bias']))
+        kern, bias = jnp.asarray(d['kernel']), jnp.asarray(d['bias'])
+        if hidden_dtype is not None:
+            kern, bias = kern.astype(hidden_dtype), bias.astype(hidden_dtype)
+        x = jax.nn.relu(x @ kern + bias)
     d_out = leaves[f'Dense_{hidden_layers}']
+    if hidden_dtype is not None:
+        x = x.astype(h.dtype)  # logit head accumulates at full precision
     return (x @ jnp.asarray(d_out['kernel']) + jnp.asarray(d_out['bias']))[..., 0]
 
 
@@ -369,6 +400,7 @@ def fused_pair_logits(
     std_b: Optional[jax.Array] = None,
     registry: FusedRegistry = STANDARD_REGISTRY,
     dense_overrides: Optional[Dict[str, jax.Array]] = None,
+    hidden_dtype: Optional[Any] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Two heads' logits with the first layers stacked into one fold.
 
@@ -392,15 +424,16 @@ def fused_pair_logits(
         dense_overrides=dense_overrides,
     )
     return (
-        _hidden_chain(leaves_a, h[..., :h_a_width], hidden_layers_a),
-        _hidden_chain(leaves_b, h[..., h_a_width:], hidden_layers_b),
+        _hidden_chain(leaves_a, h[..., :h_a_width], hidden_layers_a, hidden_dtype),
+        _hidden_chain(leaves_b, h[..., h_a_width:], hidden_layers_b, hidden_dtype),
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        'names', 'k', 'hidden_layers_a', 'hidden_layers_b', 'registry_name'
+        'names', 'k', 'hidden_layers_a', 'hidden_layers_b', 'registry_name',
+        'hidden_dtype_name',
     ),
 )
 def _pair_probs(
@@ -417,12 +450,16 @@ def _pair_probs(
     hidden_layers_a,
     hidden_layers_b,
     registry_name,
+    hidden_dtype_name=None,
 ):
     a, b = fused_pair_logits(
         params_a, params_b, batch, names=names, k=k,
         hidden_layers_a=hidden_layers_a, hidden_layers_b=hidden_layers_b,
         mean_a=mean_a, std_a=std_a, mean_b=mean_b, std_b=std_b,
         registry=REGISTRIES[registry_name],
+        hidden_dtype=(
+            jnp.dtype(hidden_dtype_name) if hidden_dtype_name else None
+        ),
     )
     return jax.nn.sigmoid(a), jax.nn.sigmoid(b)
 
@@ -435,13 +472,15 @@ def fused_pair_probs(
     names: Tuple[str, ...],
     k: int,
     registry_name: str = 'standard',
+    hidden_dtype: Optional[Any] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Probabilities of two MLP heads in one jitted stacked-fold call.
 
     ``VAEP.rate_batch`` rates with a scores head and a concedes head over
     the same batch; :func:`fused_pair_logits` stacks their first layers so
     the per-state gathers and the dense feature blocks are computed once
-    for both. Head widths and depths may differ.
+    for both. Head widths and depths may differ. ``hidden_dtype`` opts
+    the hidden pipeline into a narrower dtype (:func:`_hidden_chain`).
     """
     for clf in (clf_a, clf_b):
         if clf.params is None or clf.mean_ is None or clf.std_ is None:
@@ -459,4 +498,7 @@ def fused_pair_probs(
         hidden_layers_a=len(clf_a.hidden),
         hidden_layers_b=len(clf_b.hidden),
         registry_name=registry_name,
+        hidden_dtype_name=(
+            jnp.dtype(hidden_dtype).name if hidden_dtype is not None else None
+        ),
     )

@@ -39,6 +39,7 @@ import os
 from typing import Any, Dict, Optional
 
 __all__ = [
+    'OPT_IN_PATHS',
     'RATING_PATHS',
     'load_profiles',
     'preferred_rating_path',
@@ -46,6 +47,11 @@ __all__ = [
 ]
 
 RATING_PATHS = ('fused', 'materialized')
+
+#: Paths a user may force via the env override but that the profile never
+#: auto-selects: opt-in accuracy trade-offs (bf16 hidden pipeline sits
+#: outside the f32 parity band — ops/fused.py:_hidden_chain).
+OPT_IN_PATHS = ('fused_bf16',)
 
 _ENV_OVERRIDE = 'SOCCERACTION_TPU_RATING_PATH'
 _PROFILE_FILE = os.path.join(os.path.dirname(__file__), 'platform_profiles.json')
@@ -86,9 +92,11 @@ def preferred_rating_path(
 
     Resolution order:
 
-    1. ``SOCCERACTION_TPU_RATING_PATH`` env var — ``'fused'`` or
-       ``'materialized'`` forces that path everywhere (``'auto'`` and
-       unset defer to the profile). Anything else raises ``ValueError``.
+    1. ``SOCCERACTION_TPU_RATING_PATH`` env var — ``'fused'``,
+       ``'materialized'`` or the opt-in ``'fused_bf16'`` forces that path
+       everywhere (``'auto'`` and unset defer to the profile; the profile
+       itself only ever selects parity-band paths). Anything else raises
+       ``ValueError``.
        Skipped with ``respect_env=False`` (``bench.py`` uses this so the
        artifact's ``flagship`` always reports the *profile's* choice, never
        a debugging override).
@@ -101,10 +109,10 @@ def preferred_rating_path(
     if respect_env:
         override = os.environ.get(_ENV_OVERRIDE, 'auto').strip().lower() or 'auto'
         if override != 'auto':
-            if override not in RATING_PATHS:
+            if override not in RATING_PATHS + OPT_IN_PATHS:
                 raise ValueError(
                     f'{_ENV_OVERRIDE}={override!r}: expected one of '
-                    f"{RATING_PATHS + ('auto',)}"
+                    f"{RATING_PATHS + OPT_IN_PATHS + ('auto',)}"
                 )
             return override
     if platform is None:

@@ -23,10 +23,6 @@ from socceraction_tpu.utils import timed
 __all__ = ['load_batch', 'iter_batches']
 
 
-def _home_team_ids(store: SeasonStore) -> dict:
-    return store.home_team_ids()
-
-
 def load_batch(
     store: SeasonStore,
     game_ids: Optional[Sequence[Any]] = None,
@@ -38,7 +34,7 @@ def load_batch(
     """Pack the given stored games (default: all) into one ActionBatch."""
     if game_ids is None:
         game_ids = store.game_ids()
-    home = _home_team_ids(store)
+    home = store.home_team_ids()
     with timed('pipeline/read_actions'):
         frames = [store.get_actions(gid) for gid in game_ids]
         actions = pd.concat(frames, ignore_index=True)
@@ -110,7 +106,7 @@ def iter_batches(
         )
     else:
         season = None
-        home = _home_team_ids(store)
+        home = store.home_team_ids()
 
     def produce() -> Iterator[Tuple[ActionBatch, List[Any]]]:
         for lo in range(0, len(game_ids), games_per_batch):

@@ -345,7 +345,10 @@ class VAEP:
             raise NotFittedError('fit the model before calling rate')
         from ..ops.profile import preferred_rating_path
 
-        if self._can_fuse() and preferred_rating_path() == 'fused':
+        path = preferred_rating_path()
+        if self._can_fuse() and path in ('fused', 'fused_bf16'):
+            import jax.numpy as jnp
+
             from ..ops.fused import fused_pair_probs
 
             # one jitted trace for both heads so XLA shares the per-state
@@ -358,6 +361,7 @@ class VAEP:
                 names=self._kernel_names(),
                 k=self.nb_prev_actions,
                 registry_name=self._fused_registry,
+                hidden_dtype=jnp.bfloat16 if path == 'fused_bf16' else None,
             )
             probs = dict(zip(cols, pair))
         else:
