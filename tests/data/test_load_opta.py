@@ -222,5 +222,88 @@ class TestWhoScoredLoader:
     def test_events(self, ws_loader):
         df = ws_loader.events(GAME)
         OptaEventSchema.validate(df)
-        # the pre-match team-setup event is absent from WhoScored scrapes
-        assert len(df) == 12
+        # the pre-match team-setup event is absent from WhoScored scrapes;
+        # the substitution incident appears as a type-19 event instead
+        assert len(df) == 13
+        assert (df['type_id'] == 19).sum() == 1
+
+
+class TestWhoScoredParser:
+    """Direct parser coverage for the extractors the loader does not call.
+
+    The loader tier exercises games/teams/players/events; substitutions,
+    formation positions and the aggregated team/player stat tables (the
+    reference's ``WhoScoredParser`` surface,
+    ``/root/reference/socceraction/data/opta/parsers/whoscored.py``) are
+    only reachable through the parser API, so they get their own tier.
+    """
+
+    @pytest.fixture()
+    def parser(self):
+        from socceraction_tpu.data.opta.parsers.whoscored import WhoScoredParser
+
+        return WhoScoredParser(
+            os.path.join(DATASETS, 'whoscored', '8-2017-501.json'),
+            competition_id=8, season_id=2017, game_id=GAME,
+        )
+
+    def test_scope_ids_must_be_derivable(self, tmp_path):
+        from socceraction_tpu.data.base import MissingDataError
+        from socceraction_tpu.data.opta.parsers.whoscored import WhoScoredParser
+
+        bare = tmp_path / 'bare.json'
+        bare.write_text('{"events": []}')
+        with pytest.raises(MissingDataError, match='competition_id'):
+            WhoScoredParser(str(bare))
+
+    def test_extract_substitutions(self, parser):
+        subs = parser.extract_substitutions()
+        assert (GAME, 13) in subs
+        sub = subs[(GAME, 13)]
+        assert sub['player_in_id'] == 13
+        assert sub['player_out_id'] == 11
+        assert sub['period_id'] == 2
+        # minute 70 of a 45-minute first half -> 25 minutes into period 2
+        assert sub['period_milliseconds'] == 25 * 60 * 1000
+
+    def test_extract_positions(self, parser):
+        pos = parser.extract_positions()
+        # one formation epoch per team covering every rostered player
+        assert all(key[0] == GAME for key in pos)
+        p1 = pos[(GAME, 1, 0)]
+        assert p1['formation_scheme'] == '433'
+        assert p1['player_position'] == 'GK'  # vertical 0, horizontal 5
+        assert p1['start_milliseconds'] == 0
+        assert p1['end_milliseconds'] == 95 * 60 * 1000
+
+    def test_extract_teamgamestats(self, parser):
+        stats = parser.extract_teamgamestats()
+        home = stats[(GAME, 100)]
+        away = stats[(GAME, 200)]
+        assert home['side'] == 'home' and away['side'] == 'away'
+        assert home['score'] == 2 and away['score'] == 1
+        assert home['shootout_score'] is None
+        # per-period series are summed; non-dict entries are dropped.
+        # NB the reference's *Success filter compares against snake_cased
+        # keys, so it never fires — pass_success staying present IS the
+        # parity behavior (reference whoscored.py:345)
+        assert home['possession'] == 55 and home['shots_total'] == 7
+        assert home['pass_success'] == 165
+        assert 'ratings' not in home
+
+    def test_extract_playergamestats(self, parser):
+        stats = parser.extract_playergamestats()
+        # starter playing the whole game
+        p1 = stats[(GAME, 1)]
+        assert p1['is_starter'] and p1['minutes_played'] == 95
+        # starter subbed off at 70
+        p11 = stats[(GAME, 11)]
+        assert p11['minutes_played'] == 70 and p11['minute_end'] == 70
+        # sub coming on at 70
+        p13 = stats[(GAME, 13)]
+        assert not p13['is_starter'] and p13['minutes_played'] == 25
+        # red card at 85 caps the minutes
+        p12 = stats[(GAME, 12)]
+        assert p12['minutes_played'] == 85
+        # aggregated stat columns survive snake-casing
+        assert p1['touches'] == 22

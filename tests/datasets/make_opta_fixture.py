@@ -539,6 +539,14 @@ def _ws_team(team_id: int, name: str, field: str, score: int, players: list) -> 
         'field': field,
         'managerName': f'Coach {name.split()[0]}',
         'scores': {'running': score, 'fulltime': score},
+        # real match-centre scrapes carry per-team aggregated stat series;
+        # the parser sums the per-period dicts and drops *Success ratios
+        'stats': {
+            'possession': {'0': 30, '1': 25},
+            'shotsTotal': {'0': 3, '1': 4},
+            'passSuccess': {'0': 80, '1': 85},
+            'ratings': 7.1,  # non-dict entries are ignored
+        },
         'players': roster,
         'incidentEvents': incidents,
         'formations': [
@@ -563,7 +571,9 @@ def _ws_json() -> dict:
             'eventId': eid - 1000,
             'type': {'value': tid, 'displayName': 'Event'},
             'period': {'value': per, 'displayName': f'Period{per}'},
-            'minute': mn if per == 1 else mn - 45,
+            # real scrapes carry the ABSOLUTE match minute; the parser
+            # subtracts periodMinuteLimits to get the in-period clock
+            'minute': mn,
             'expandedMinute': mn,
             'second': sc,
             'teamId': team,
@@ -581,6 +591,17 @@ def _ws_json() -> dict:
         if tid == 19:
             e['relatedPlayerId'] = 11
         ws_events.append(e)
+    # the substitution incident (sub 13 on for 11 at 70') appears as a
+    # type-19 event in the scrape stream
+    ws_events.append({
+        'id': 1981, 'eventId': 981,
+        'type': {'value': 19, 'displayName': 'SubstitutionOn'},
+        'period': {'value': 2, 'displayName': 'SecondHalf'},
+        'minute': 70, 'expandedMinute': 70, 'second': 0,
+        'teamId': AWAY, 'playerId': 13, 'relatedPlayerId': 11,
+        'outcomeType': {'value': 1}, 'x': 0.0, 'y': 0.0,
+        'isTouch': False, 'qualifiers': [],
+    })
     return {
         'startTime': '2017-08-11T19:45:00',
         'expandedMaxMinute': 95,
