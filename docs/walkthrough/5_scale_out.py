@@ -7,7 +7,10 @@ scale-out surface on a virtual 8-device CPU mesh so it runs anywhere:
 1. data-parallel xT fit over a ``(games, model)`` mesh (one ``psum``),
 2. distributed VAEP training, data-parallel games × tensor-parallel MLP,
 3. sequence parallelism: the ACTION axis sharded with halo exchange,
-4. (optional, ``--processes``) the same over two ``jax.distributed``
+4. feeding from disk: the packed-season memmap cache that removes the
+   store parse from every pass but the first (measured 10× on the v5e
+   cold path — BASELINE.md),
+5. (optional, ``--processes``) the same over two ``jax.distributed``
    processes — the localhost analog of a multi-host pod over DCN.
 
 On real hardware the identical calls run over ICI/DCN: swap nothing.
@@ -115,7 +118,41 @@ def main() -> None:
           'tests/test_sequence_parallel.py asserts bit-equality)')
 
     # ------------------------------------------------------------------
-    # 4. multi-process: the same library calls across process boundaries
+    # 4. feeding from disk: first pass builds the packed cache, every
+    #    later pass slices memmaps — bit-identical batches either way
+    # ------------------------------------------------------------------
+    import dataclasses
+    import tempfile
+
+    import numpy as np
+
+    from socceraction_tpu.pipeline import SeasonStore, iter_batches
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store_path = os.path.join(tmp, 'season')
+        with SeasonStore(store_path, mode='w') as store:
+            for f in frames:
+                store.put_actions(int(f.game_id.iloc[0]), f)
+            store.put('games', pd.DataFrame(
+                {'game_id': [int(f.game_id.iloc[0]) for f in frames],
+                 'home_team_id': 100}
+            ))
+        with SeasonStore(store_path, mode='r') as store:
+            plain = list(iter_batches(store, 4, max_actions=640))
+            cached = list(iter_batches(store, 4, max_actions=640,
+                                       packed_cache=True, prefetch=1))
+        same = len(plain) == len(cached) and all(
+            np.array_equal(
+                np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
+            )
+            for (a, _), (b, _) in zip(plain, cached)
+            for f in dataclasses.fields(a)
+        )
+        print(f'packed cache: {len(cached)} chunks served from memmaps, '
+              f'bit-identical to the store path: {same}')
+
+    # ------------------------------------------------------------------
+    # 5. multi-process: the same library calls across process boundaries
     # ------------------------------------------------------------------
     if args.processes:
         from socceraction_tpu.utils.env import run_distributed_cpu_workers

@@ -39,6 +39,7 @@ import os
 from typing import Any, Dict, Optional
 
 __all__ = [
+    'FUSED_PATH_HIDDEN_DTYPES',
     'OPT_IN_PATHS',
     'RATING_PATHS',
     'load_profiles',
@@ -52,6 +53,13 @@ RATING_PATHS = ('fused', 'materialized')
 #: auto-selects: opt-in accuracy trade-offs (bf16 hidden pipeline sits
 #: outside the f32 parity band — ops/fused.py:_hidden_chain).
 OPT_IN_PATHS = ('fused_bf16',)
+
+#: Paths served by the fused combined-table fold, mapped to the hidden
+#: pipeline dtype NAME they run ('None' = full precision). The single
+#: registry both ``VAEP.rate_batch`` and ``__graft_entry__.build_forward``
+#: dispatch on, so a new opt-in variant cannot silently fall through to
+#: the materialized branch in one of them.
+FUSED_PATH_HIDDEN_DTYPES = {'fused': None, 'fused_bf16': 'bfloat16'}
 
 _ENV_OVERRIDE = 'SOCCERACTION_TPU_RATING_PATH'
 _PROFILE_FILE = os.path.join(os.path.dirname(__file__), 'platform_profiles.json')

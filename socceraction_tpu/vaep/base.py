@@ -346,7 +346,9 @@ class VAEP:
         from ..ops.profile import preferred_rating_path
 
         path = preferred_rating_path()
-        if self._can_fuse() and path in ('fused', 'fused_bf16'):
+        from ..ops.profile import FUSED_PATH_HIDDEN_DTYPES
+
+        if self._can_fuse() and path in FUSED_PATH_HIDDEN_DTYPES:
             import jax.numpy as jnp
 
             from ..ops.fused import fused_pair_probs
@@ -361,7 +363,10 @@ class VAEP:
                 names=self._kernel_names(),
                 k=self.nb_prev_actions,
                 registry_name=self._fused_registry,
-                hidden_dtype=jnp.bfloat16 if path == 'fused_bf16' else None,
+                hidden_dtype=(
+                    jnp.dtype(FUSED_PATH_HIDDEN_DTYPES[path])
+                    if FUSED_PATH_HIDDEN_DTYPES[path] else None
+                ),
             )
             probs = dict(zip(cols, pair))
         else:
