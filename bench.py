@@ -232,13 +232,16 @@ def bench_impl() -> dict:
 
     # the opt-in bf16 hidden pipeline: measured for the record but NEVER a
     # flagship candidate (outside the f32 parity band — ops/profile.py
-    # OPT_IN_PATHS); runs AFTER the early emit so its extra compile can
-    # never cost the salvageable headline on a slow tunnel
-    bf16_jit = jax.jit(build_forward('fused_bf16'))
-    dt_bf16, bf16_reliable = _measure(bf16_jit, (params, batch))
-    result['fused_bf16_actions_per_sec'] = round(total_actions / dt_bf16, 1)
-    if not bf16_reliable:
-        result['fused_bf16_measurement_unreliable'] = True
+    # OPT_IN_PATHS); runs AFTER the early emit and fully guarded so
+    # neither slowness nor a raise can cost the salvageable headline
+    try:
+        bf16_jit = jax.jit(build_forward('fused_bf16'))
+        dt_bf16, bf16_reliable = _measure(bf16_jit, (params, batch))
+        result['fused_bf16_actions_per_sec'] = round(total_actions / dt_bf16, 1)
+        if not bf16_reliable:
+            result['fused_bf16_measurement_unreliable'] = True
+    except Exception as e:  # noqa: BLE001 - record, never fail the headline
+        result['fused_bf16_error'] = f'{type(e).__name__}: {e}'
 
     force_extras = os.environ.get('SOCCERACTION_TPU_BENCH_FORCE_EXTRAS') == '1'
     if platform == 'tpu' or force_extras:

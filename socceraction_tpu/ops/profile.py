@@ -13,7 +13,7 @@ round-2 driver benchmarking caught the original gather-per-block fused form
 losing 2.8x to the materialized path on a real v5e chip even though it
 looked better on paper (``BENCH_r02.json``), and the combined-table rework
 that fixed it was only confirmed fastest on chip by a later capture
-(``BENCH_builder_r05.json``: 60.6M vs 41.8M actions/s on TPU v5 lite;
+(``BENCH_builder_r05.json``: 66.7M vs 49.5M actions/s on TPU v5 lite;
 ``BENCH_r04.json``: 235.6k vs 122.9k on CPU).
 
 This module therefore makes the flagship *selected from recorded
@@ -41,6 +41,7 @@ from typing import Any, Dict, Optional
 __all__ = [
     'FUSED_PATH_HIDDEN_DTYPES',
     'OPT_IN_PATHS',
+    'hidden_dtype_for',
     'RATING_PATHS',
     'load_profiles',
     'preferred_rating_path',
@@ -49,17 +50,20 @@ __all__ = [
 
 RATING_PATHS = ('fused', 'materialized')
 
+#: Paths served by the fused combined-table fold, mapped to the hidden
+#: pipeline dtype NAME they run (``None`` = full precision). The single
+#: registry both ``VAEP.rate_batch`` and ``__graft_entry__.build_forward``
+#: dispatch on (via :func:`hidden_dtype_for`), so a new opt-in variant
+#: cannot silently fall through to the materialized branch in one of them.
+FUSED_PATH_HIDDEN_DTYPES = {'fused': None, 'fused_bf16': 'bfloat16'}
+
 #: Paths a user may force via the env override but that the profile never
 #: auto-selects: opt-in accuracy trade-offs (bf16 hidden pipeline sits
-#: outside the f32 parity band — ops/fused.py:_hidden_chain).
-OPT_IN_PATHS = ('fused_bf16',)
-
-#: Paths served by the fused combined-table fold, mapped to the hidden
-#: pipeline dtype NAME they run ('None' = full precision). The single
-#: registry both ``VAEP.rate_batch`` and ``__graft_entry__.build_forward``
-#: dispatch on, so a new opt-in variant cannot silently fall through to
-#: the materialized branch in one of them.
-FUSED_PATH_HIDDEN_DTYPES = {'fused': None, 'fused_bf16': 'bfloat16'}
+#: outside the f32 parity band — ops/fused.py:_hidden_chain). Derived
+#: from the registry: every narrowed fused variant is opt-in.
+OPT_IN_PATHS = tuple(
+    path for path, dt in FUSED_PATH_HIDDEN_DTYPES.items() if dt is not None
+)
 
 _ENV_OVERRIDE = 'SOCCERACTION_TPU_RATING_PATH'
 _PROFILE_FILE = os.path.join(os.path.dirname(__file__), 'platform_profiles.json')
@@ -91,6 +95,16 @@ def _current_platform() -> str:
     import jax
 
     return jax.devices()[0].platform
+
+
+def hidden_dtype_for(path: str) -> Optional[Any]:
+    """The jnp dtype of ``path``'s hidden pipeline, or ``None`` for full
+    precision. Raises ``KeyError`` for non-fused paths — callers dispatch
+    with ``path in FUSED_PATH_HIDDEN_DTYPES`` first."""
+    import jax.numpy as jnp
+
+    name = FUSED_PATH_HIDDEN_DTYPES[path]
+    return jnp.dtype(name) if name else None
 
 
 def preferred_rating_path(

@@ -346,11 +346,9 @@ class VAEP:
         from ..ops.profile import preferred_rating_path
 
         path = preferred_rating_path()
-        from ..ops.profile import FUSED_PATH_HIDDEN_DTYPES
+        from ..ops.profile import FUSED_PATH_HIDDEN_DTYPES, hidden_dtype_for
 
         if self._can_fuse() and path in FUSED_PATH_HIDDEN_DTYPES:
-            import jax.numpy as jnp
-
             from ..ops.fused import fused_pair_probs
 
             # one jitted trace for both heads so XLA shares the per-state
@@ -363,10 +361,7 @@ class VAEP:
                 names=self._kernel_names(),
                 k=self.nb_prev_actions,
                 registry_name=self._fused_registry,
-                hidden_dtype=(
-                    jnp.dtype(FUSED_PATH_HIDDEN_DTYPES[path])
-                    if FUSED_PATH_HIDDEN_DTYPES[path] else None
-                ),
+                hidden_dtype=hidden_dtype_for(path),
             )
             probs = dict(zip(cols, pair))
         else:

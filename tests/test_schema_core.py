@@ -1,0 +1,103 @@
+"""Unit tier for the dependency-free schema core.
+
+The reference delegates these behaviors to pandera (validated upstream by
+pandera's own suite); this repo's replacement (`socceraction_tpu/schema.py`)
+is the validation engine behind every loader/SPADL/atomic schema, so its
+failure modes get direct coverage here — the full suite only exercised its
+happy paths (67.9% statement coverage before this tier).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.schema import Field, Schema, SchemaError, numeric_dtype_kind
+
+
+class TestField:
+    def test_coerces_declared_dtype(self):
+        out = Field(dtype='int64').validate('x', pd.Series(['1', '2']))
+        assert out.dtype == np.int64 and list(out) == [1, 2]
+
+    def test_str_and_object_become_object(self):
+        for decl in ('str', 'object'):
+            out = Field(dtype=decl).validate('x', pd.Series([1, 'a']))
+            assert out.dtype == object
+
+    def test_uncoercible_raises(self):
+        with pytest.raises(SchemaError, match="column 'x': cannot coerce"):
+            Field(dtype='int64').validate('x', pd.Series(['a']))
+
+    def test_nulls_rejected_unless_nullable(self):
+        col = pd.Series([1.0, np.nan])
+        with pytest.raises(SchemaError, match='2 null values|1 null values'):
+            Field().validate('x', col)
+        assert Field(nullable=True).validate('x', col).isna().sum() == 1
+
+    def test_bounds_checked_on_non_null_values_only(self):
+        col = pd.Series([0.0, 5.0, np.nan])
+        Field(ge=0, le=5, nullable=True).validate('x', col)  # boundary ok
+        with pytest.raises(SchemaError, match='below minimum'):
+            Field(ge=1, nullable=True).validate('x', col)
+        with pytest.raises(SchemaError, match='above maximum'):
+            Field(le=4, nullable=True).validate('x', col)
+
+    def test_isin(self):
+        Field(isin=(1, 2)).validate('x', pd.Series([1, 2, 1]))
+        with pytest.raises(SchemaError, match='1 values outside allowed set'):
+            Field(isin=(1, 2)).validate('x', pd.Series([1, 3]))
+
+
+class TestSchema:
+    @pytest.fixture()
+    def schema(self):
+        return Schema(
+            fields={
+                'a': Field(dtype='int64'),
+                'b': Field(dtype='float64', nullable=True),
+                'c': Field(required=False),
+            }
+        )
+
+    def test_missing_required_column(self, schema):
+        with pytest.raises(SchemaError, match="missing required columns: \\['b'\\]"):
+            schema.validate(pd.DataFrame({'a': [1]}))
+
+    def test_optional_column_may_be_absent(self, schema):
+        out = schema.validate(pd.DataFrame({'a': [1], 'b': [1.5]}))
+        assert list(out.columns) == ['a', 'b']
+
+    def test_strict_rejects_unknown_columns(self, schema):
+        with pytest.raises(SchemaError, match="unexpected columns: \\['z'\\]"):
+            schema.validate(pd.DataFrame({'a': [1], 'b': [1.0], 'z': [0]}))
+
+    def test_non_strict_keeps_extras_after_declared(self):
+        schema = Schema(fields={'a': Field(dtype='int64')}, strict=False)
+        out = schema.validate(pd.DataFrame({'z': [9], 'a': ['3']}))
+        # canonical order: declared first, extras after; coercion applied
+        assert list(out.columns) == ['a', 'z']
+        assert out['a'].dtype == np.int64
+
+    def test_validate_returns_a_copy(self, schema):
+        df = pd.DataFrame({'a': pd.Series(['1'], dtype=object), 'b': [2.0]})
+        out = schema.validate(df)
+        assert df['a'].dtype == object  # input untouched
+        assert out['a'].dtype == np.int64
+
+    def test_columns_listing(self, schema):
+        assert list(schema.columns()) == ['a', 'b', 'c']
+        assert list(schema.columns(required_only=True)) == ['a', 'b']
+
+    def test_is_valid(self, schema):
+        assert schema.is_valid(pd.DataFrame({'a': [1], 'b': [0.5]}))
+        assert not schema.is_valid(pd.DataFrame({'a': [1]}))
+
+
+def test_numeric_dtype_kind():
+    assert numeric_dtype_kind('int32') == 'int'
+    assert numeric_dtype_kind(np.uint8) == 'int'
+    assert numeric_dtype_kind('float32') == 'float'
+    assert numeric_dtype_kind(np.dtype('bool')) == 'bool'
+    assert numeric_dtype_kind('object') == 'other'
