@@ -93,9 +93,7 @@ def build_spadl_store(
                 # metadata is appended for a partially-written game
                 store.put_actions(game_id, actions)
                 if atomic:
-                    store.put(
-                        f'atomic_actions/game_{game_id}', convert_to_atomic(actions)
-                    )
+                    store.put_atomic_actions(game_id, convert_to_atomic(actions))
             except Exception:
                 if on_error == 'skip':
                     logger.warning('skipping game %s', game_id, exc_info=True)

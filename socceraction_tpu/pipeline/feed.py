@@ -1,4 +1,4 @@
-"""Feed stored seasons to the device as packed :class:`ActionBatch` chunks.
+"""Feed stored seasons to the device as packed :class:`~socceraction_tpu.core.ActionBatch` chunks.
 
 The streaming path (:func:`iter_batches`) reads the next chunk's parquet/
 hdf5 frames and packs them on the host while the device works on the
@@ -16,7 +16,6 @@ from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
 import pandas as pd
 
-from socceraction_tpu.core import ActionBatch, pack_actions
 from socceraction_tpu.pipeline.store import SeasonStore
 from socceraction_tpu.utils import timed
 
@@ -30,16 +29,27 @@ def load_batch(
     max_actions: Optional[int] = None,
     float_dtype: Any = 'float32',
     device: Optional[Any] = None,
-) -> Tuple[ActionBatch, List[Any]]:
-    """Pack the given stored games (default: all) into one ActionBatch."""
+    family: str = 'standard',
+) -> Tuple[Any, List[Any]]:
+    """Pack the given stored games (default: all) into one batch.
+
+    ``family='standard'`` reads ``actions/game_<id>`` into an
+    :class:`ActionBatch`; ``family='atomic'`` reads the
+    ``atomic_actions/game_<id>`` keys ``build_spadl_store(atomic=True)``
+    writes into an :class:`~socceraction_tpu.core.AtomicActionBatch`.
+    """
+    from socceraction_tpu.pipeline.packed import FAMILIES
+
+    fam = FAMILIES[family]
     if game_ids is None:
         game_ids = store.game_ids()
     home = store.home_team_ids()
+    read = getattr(store, fam.reader)
     with timed('pipeline/read_actions'):
-        frames = [store.get_actions(gid) for gid in game_ids]
+        frames = [read(gid) for gid in game_ids]
         actions = pd.concat(frames, ignore_index=True)
     with timed('pipeline/pack'):
-        return pack_actions(
+        return fam.packer(
             actions,
             {gid: home[gid] for gid in game_ids},
             max_actions=max_actions,
@@ -59,7 +69,8 @@ def iter_batches(
     drop_remainder: bool = False,
     prefetch: int = 0,
     packed_cache: Any = False,
-) -> Iterator[Tuple[ActionBatch, List[Any]]]:
+    family: str = 'standard',
+) -> Iterator[Tuple[Any, List[Any]]]:
     """Stream the store in fixed-size game chunks.
 
     With ``max_actions`` set (recommended), every chunk has identical
@@ -82,7 +93,13 @@ def iter_batches(
     host-read-bound cold path measured in ``BENCH_builder_r05.json``.
     Requires ``max_actions``; batches are bit-identical to the uncached
     path.
+
+    ``family`` selects the SPADL family exactly as in :func:`load_batch`;
+    the packed cache is per-family.
     """
+    from socceraction_tpu.pipeline.packed import FAMILIES
+
+    fam = FAMILIES[family]
     if game_ids is None:
         game_ids = store.game_ids()
 
@@ -103,12 +120,13 @@ def iter_batches(
             max_actions=max_actions,
             float_dtype=float_dtype,
             cache_dir=cache_dir,
+            family=family,
         )
     else:
         season = None
         home = store.home_team_ids()
 
-    def produce() -> Iterator[Tuple[ActionBatch, List[Any]]]:
+    def produce() -> Iterator[Tuple[Any, List[Any]]]:
         for lo in range(0, len(game_ids), games_per_batch):
             chunk = list(game_ids[lo : lo + games_per_batch])
             if drop_remainder and len(chunk) < games_per_batch:
@@ -119,11 +137,12 @@ def iter_batches(
                 yield item
                 continue
             with timed('pipeline/read_actions'):
+                read = getattr(store, fam.reader)
                 actions = pd.concat(
-                    [store.get_actions(gid) for gid in chunk], ignore_index=True
+                    [read(gid) for gid in chunk], ignore_index=True
                 )
             with timed('pipeline/pack'):
-                item = pack_actions(
+                item = fam.packer(
                     actions,
                     {gid: home[gid] for gid in chunk},
                     max_actions=max_actions,

@@ -9,7 +9,8 @@ every pass but the first: the season is packed ONCE into exactly the
 column, and later passes slice memmaps — no HDF5, no pandas, no per-game
 loop.
 
-Only the nine data columns and per-game ``n_actions`` are stored:
+Only the family's data columns (nine standard / eight atomic) and
+per-game ``n_actions`` are stored:
 packing left-aligns every game (``core/batch.py:_pack_frame``), so
 ``mask`` is ``arange(A) < n_actions[:, None]`` and the chunk-local
 ``row_index`` is the running valid-row offset plus the action position —
@@ -33,17 +34,50 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from socceraction_tpu.core import ActionBatch
+from socceraction_tpu.core import (
+    ActionBatch,
+    AtomicActionBatch,
+    pack_actions,
+    pack_atomic_actions,
+)
 from socceraction_tpu.pipeline.store import SeasonStore
 from socceraction_tpu.utils import timed
 
-__all__ = ['PackedSeason', 'ensure_packed', 'packed_cache_dir']
+__all__ = ['FAMILIES', 'PackedSeason', 'ensure_packed', 'packed_cache_dir']
 
 _VERSION = 1
-_FLOAT_COLS = ('time_seconds', 'start_x', 'start_y', 'end_x', 'end_y')
-_INT_COLS = ('type_id', 'result_id', 'bodypart_id', 'period_id')
-_BOOL_COLS = ('is_home',)
-_ALL_COLS = _FLOAT_COLS + _INT_COLS + _BOOL_COLS
+
+
+class _Family:
+    """Column layout + packing recipe of one action family."""
+
+    def __init__(self, name, float_cols, int_cols, batch_cls, packer, reader):
+        self.name = name
+        self.float_cols = float_cols
+        self.int_cols = int_cols
+        self.bool_cols = ('is_home',)
+        self.all_cols = float_cols + int_cols + self.bool_cols
+        self.batch_cls = batch_cls
+        self.packer = packer
+        self.reader = reader  # SeasonStore method name for one game's frame
+
+
+#: The two SPADL families the pipeline can stream and cache. Column sets
+#: mirror ``core/batch.py`` (`_FLOAT_COLS`/`_ATOMIC_FLOAT_COLS` etc.).
+FAMILIES = {
+    'standard': _Family(
+        'standard',
+        ('time_seconds', 'start_x', 'start_y', 'end_x', 'end_y'),
+        ('type_id', 'result_id', 'bodypart_id', 'period_id'),
+        ActionBatch, pack_actions, 'get_actions',
+    ),
+    'atomic': _Family(
+        'atomic',
+        ('time_seconds', 'x', 'y', 'dx', 'dy'),
+        ('type_id', 'bodypart_id', 'period_id'),
+        AtomicActionBatch, pack_atomic_actions, 'get_atomic_actions',
+    ),
+}
 
 
 def _store_fingerprint(path: str) -> Dict[str, int]:
@@ -61,11 +95,14 @@ def _store_fingerprint(path: str) -> Dict[str, int]:
     return {'size': size, 'mtime_ns': mtime}
 
 
-def packed_cache_dir(store_path: str, max_actions: int, float_dtype: Any) -> str:
-    """Default sidecar location, keyed by the packed shape and dtype."""
+def packed_cache_dir(
+    store_path: str, max_actions: int, float_dtype: Any, family: str = 'standard'
+) -> str:
+    """Default sidecar location, keyed by family, packed shape and dtype."""
     dt = np.dtype(float_dtype).name
     base = store_path.rstrip('/').rstrip(os.sep)
-    return f'{base}.packed-v{_VERSION}-a{int(max_actions)}-{dt}'
+    fam = '' if family == 'standard' else f'-{family}'
+    return f'{base}.packed-v{_VERSION}{fam}-a{int(max_actions)}-{dt}'
 
 
 class PackedSeason:
@@ -75,13 +112,14 @@ class PackedSeason:
         self.cache_dir = cache_dir
         with open(os.path.join(cache_dir, 'meta.json'), encoding='utf-8') as fh:
             self.meta = json.load(fh)
+        self.family = FAMILIES[self.meta.get('family', 'standard')]
         self.max_actions = int(self.meta['max_actions'])
         self.float_dtype = np.dtype(self.meta['float_dtype'])
         self.game_ids: List[Any] = list(self.meta['game_ids'])
         self._pos = {gid: i for i, gid in enumerate(self.game_ids)}
         self._cols = {
             c: np.load(os.path.join(cache_dir, f'{c}.npy'), mmap_mode='r')
-            for c in _ALL_COLS
+            for c in self.family.all_cols
         }
         self.n_actions = np.load(os.path.join(cache_dir, 'n_actions.npy'))
 
@@ -94,12 +132,13 @@ class PackedSeason:
         game_ids: Sequence[Any],
         *,
         device: Optional[Any] = None,
-    ) -> Tuple[ActionBatch, List[Any]]:
+    ) -> Tuple[Any, List[Any]]:
         """Build the batch for these games (any subset, any order).
 
-        Bit-identical to packing the same games' frames with
-        :func:`socceraction_tpu.core.pack_actions` at the cached
-        ``max_actions``/``float_dtype`` (asserted by the pipeline tests).
+        Bit-identical to packing the same games' frames with the
+        family's packer (``pack_actions`` / ``pack_atomic_actions``) at
+        the cached ``max_actions``/``float_dtype`` (asserted by the
+        pipeline tests).
         """
         import jax
         import jax.numpy as jnp
@@ -115,8 +154,8 @@ class PackedSeason:
         row_index = np.where(mask, offsets[:, None] + ar[None, :], -1).astype(
             np.int32
         )
-        cols = {c: jnp.asarray(self._cols[c][idx]) for c in _ALL_COLS}
-        batch = ActionBatch(
+        cols = {c: jnp.asarray(self._cols[c][idx]) for c in self.family.all_cols}
+        batch = self.family.batch_cls(
             **cols,
             mask=jnp.asarray(mask),
             n_actions=jnp.asarray(n_act.astype(np.int32)),
@@ -135,22 +174,32 @@ def ensure_packed(
     float_dtype: Any = 'float32',
     cache_dir: Optional[str] = None,
     build_chunk: int = 256,
+    family: str = 'standard',
 ) -> PackedSeason:
     """Open the store's packed cache, building it on a miss.
 
     The build streams the store once in ``build_chunk``-game chunks
-    through the regular :func:`pack_actions` path (so the cached tensors
-    inherit its exact semantics) into preallocated ``.npy`` memmaps,
-    then publishes the directory atomically. Timed under
+    through the regular packing path of ``family`` (so the cached
+    tensors inherit its exact semantics) into preallocated ``.npy``
+    memmaps, then publishes the directory atomically. Timed under
     ``pipeline/pack_cache_build`` in the shared timer registry.
     """
-    from socceraction_tpu.core import pack_actions
-
+    fam = FAMILIES[family]
     path = store.path
-    cache_dir = cache_dir or packed_cache_dir(path, max_actions, float_dtype)
+    cache_dir = cache_dir or packed_cache_dir(
+        path, max_actions, float_dtype, family
+    )
     ps = _try_open(cache_dir, path)
     if ps is not None:
-        return ps
+        # an explicit cache_dir may point at a cache built for another
+        # family/shape/dtype; a mismatch is a miss, never silently-wrong
+        # batches
+        if (
+            ps.family.name == fam.name
+            and ps.max_actions == int(max_actions)
+            and ps.float_dtype == np.dtype(float_dtype)
+        ):
+            return ps
 
     with timed('pipeline/pack_cache_build'):
         game_ids = store.game_ids()
@@ -164,17 +213,17 @@ def ensure_packed(
         os.makedirs(tmp)
         try:
             maps = {}
-            for c in _FLOAT_COLS:
+            for c in fam.float_cols:
                 maps[c] = np.lib.format.open_memmap(
                     os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=fdt,
                     shape=(G, A),
                 )
-            for c in _INT_COLS:
+            for c in fam.int_cols:
                 maps[c] = np.lib.format.open_memmap(
                     os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=np.int32,
                     shape=(G, A),
                 )
-            for c in _BOOL_COLS:
+            for c in fam.bool_cols:
                 maps[c] = np.lib.format.open_memmap(
                     os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=bool,
                     shape=(G, A),
@@ -183,17 +232,18 @@ def ensure_packed(
 
             import pandas as pd
 
+            read = getattr(store, fam.reader)
             for lo in range(0, G, build_chunk):
                 chunk = game_ids[lo : lo + build_chunk]
-                frames = [store.get_actions(gid) for gid in chunk]
-                batch, _ids = pack_actions(
+                frames = [read(gid) for gid in chunk]
+                batch, _ids = fam.packer(
                     pd.concat(frames, ignore_index=True),
                     {gid: home[gid] for gid in chunk},
                     max_actions=A,
                     float_dtype=fdt,
                 )
                 hi = lo + len(chunk)
-                for c in _ALL_COLS:
+                for c in fam.all_cols:
                     maps[c][lo:hi] = np.asarray(getattr(batch, c))
                 n_actions[lo:hi] = np.asarray(batch.n_actions)
             for m in maps.values():
@@ -201,6 +251,7 @@ def ensure_packed(
             np.save(os.path.join(tmp, 'n_actions.npy'), n_actions)
             meta = {
                 'version': _VERSION,
+                'family': fam.name,
                 'max_actions': A,
                 'float_dtype': fdt.name,
                 'game_ids': [_json_safe(g) for g in game_ids],

@@ -218,6 +218,16 @@ class SeasonStore:
         """Read one game's action frame."""
         return self.get(f'actions/game_{game_id}')
 
+    def put_atomic_actions(self, game_id: Any, actions: pd.DataFrame) -> None:
+        """Store one game's Atomic-SPADL frame under
+        ``atomic_actions/game_<id>`` (the key ``build_spadl_store`` writes
+        with ``atomic=True``)."""
+        self.put(f'atomic_actions/game_{game_id}', actions)
+
+    def get_atomic_actions(self, game_id: Any) -> pd.DataFrame:
+        """Read one game's Atomic-SPADL frame."""
+        return self.get(f'atomic_actions/game_{game_id}')
+
     def game_ids(self) -> List[Any]:
         """All stored game ids, parsed back to int where possible."""
         ids: List[Any] = []

@@ -133,6 +133,61 @@ def test_packed_cache_requires_max_actions(store_path):
             next(iter(iter_batches(store, 2, packed_cache=True)))
 
 
+def test_atomic_family_streams_and_caches(store_path, tmp_path):
+    """family='atomic' reads the atomic keys, packs AtomicActionBatch,
+    and its packed cache is bit-identical to the direct pack — mirroring
+    the standard family's contract."""
+    from socceraction_tpu.atomic.spadl import convert_to_atomic
+    from socceraction_tpu.core import pack_atomic_actions
+
+    path = str(tmp_path / 'astore')
+    frames = {}
+    with SeasonStore(path, mode='w') as store:
+        games = []
+        for gid in range(1, 4):
+            df = synthetic_actions_frame(
+                gid, home_team_id=10, away_team_id=20, n_actions=150, seed=gid
+            )
+            atomic = convert_to_atomic(df)
+            frames[gid] = atomic
+            store.put_actions(gid, df)
+            store.put_atomic_actions(gid, atomic)
+            games.append({'game_id': gid, 'home_team_id': 10})
+        store.put('games', pd.DataFrame(games))
+
+    with SeasonStore(path, mode='r') as store:
+        plain = list(iter_batches(store, 2, max_actions=512, family='atomic'))
+        cached = list(iter_batches(store, 2, max_actions=512, family='atomic',
+                                   packed_cache=True))
+    assert [ids for _, ids in plain] == [[1, 2], [3]]
+    for (a, _), (b, _) in zip(plain, cached):
+        _assert_batch_equal(a, b)
+    # the first chunk equals a direct pack of the same atomic frames
+    ref, _ = pack_atomic_actions(
+        pd.concat([frames[1], frames[2]], ignore_index=True),
+        {1: 10, 2: 10}, max_actions=512,
+    )
+    _assert_batch_equal(plain[0][0], ref)
+    # family caches are distinct sidecars
+    from socceraction_tpu.pipeline.packed import packed_cache_dir
+
+    assert packed_cache_dir(path, 512, 'float32', 'atomic') != packed_cache_dir(
+        path, 512, 'float32'
+    )
+
+
+def test_explicit_cache_dir_family_mismatch_rebuilds(store_path, tmp_path):
+    """An explicit cache_dir built for another family/shape reads as a
+    miss — never silently-wrong batches."""
+    cache = str(tmp_path / 'shared-cache')
+    with SeasonStore(store_path, mode='r') as store:
+        std = ensure_packed(store, max_actions=_A, cache_dir=cache)
+        assert std.family.name == 'standard'
+        # same dir requested at a different shape: rebuilt, not reused
+        other = ensure_packed(store, max_actions=512, cache_dir=cache)
+        assert other.max_actions == 512
+
+
 def test_prefetch_composes_with_cache(store_path):
     with SeasonStore(store_path, mode='r') as store:
         plain = _batches(store)
