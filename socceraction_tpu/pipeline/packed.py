@@ -14,8 +14,19 @@ per-game ``n_actions`` are stored:
 packing left-aligns every game (``core/batch.py:_pack_frame``), so
 ``mask`` is ``arange(A) < n_actions[:, None]`` and the chunk-local
 ``row_index`` is the running valid-row offset plus the action position —
-both are reconstructed at slice time for ANY game subset, which is what
-lets one cache serve every ``games_per_batch``/``game_ids`` choice.
+both are reconstructed for ANY game subset, which is what lets one cache
+serve every ``games_per_batch``/``game_ids`` choice.
+
+The read side is transfer-aware. On this image the TPU sits behind a
+tunnel at ~150 MB/s host→device, and the first packed-pass capture
+(`BENCH_builder_r05b.json`) spent ~7 of its 8.6 s shipping 13 per-column
+arrays (~36 MB) per 512-game chunk while the device needed 0.09 s to rate
+it. :meth:`PackedSeason.take` therefore sends a minimal wire format —
+the float columns as ONE stacked transfer, the categorical ids narrowed
+to int8 (every SPADL vocabulary fits; int32 fallback otherwise), the
+bool flags, and the ``(G,)`` lengths — and a jitted device-side unpack
+rebuilds ``mask``/``row_index``/``game_id`` from ``n_actions`` alone:
+~21 MB and 4 transfers per chunk instead of ~36 MB and 13.
 
 Validity: the cache records a fingerprint of the backing store (size +
 mtime, summed over files for directory stores) plus the packed shape and
@@ -27,6 +38,7 @@ be mistaken for a cache.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import shutil
@@ -122,6 +134,17 @@ class PackedSeason:
             for c in self.family.all_cols
         }
         self.n_actions = np.load(os.path.join(cache_dir, 'n_actions.npy'))
+        # wire dtype for the id columns is a property of the CACHE, not
+        # of any one chunk: decided at build time (meta), or by one scan
+        # here for caches written before the key existed — never per
+        # take(), which would rescan every chunk and could flip the
+        # unpack program's input dtype (an extra compile) mid-stream
+        wire = self.meta.get('int_wire')
+        if wire is None:
+            wire = _int_wire_name(
+                self._cols[c] for c in self.family.int_cols
+            )
+        self._int_wire = np.dtype(wire)
 
     def valid_for(self, store_path: str) -> bool:
         """True while the backing store is unchanged since the build."""
@@ -138,33 +161,87 @@ class PackedSeason:
         Bit-identical to packing the same games' frames with the
         family's packer (``pack_actions`` / ``pack_atomic_actions``) at
         the cached ``max_actions``/``float_dtype`` (asserted by the
-        pipeline tests).
+        pipeline tests). Only the stacked float columns, int8-narrowed
+        id columns, flags and lengths cross the host→device link; the
+        derived fields are rebuilt on device (see module docstring).
         """
         import jax
         import jax.numpy as jnp
 
         idx = np.asarray([self._pos[g] for g in game_ids])
         A = self.max_actions
-        n_act = self.n_actions[idx]
-        # left-aligned packing: mask and chunk-local row_index derive
-        # from n_actions alone
-        ar = np.arange(A, dtype=np.int32)
-        mask = ar[None, :] < n_act[:, None]
-        offsets = (np.cumsum(n_act, dtype=np.int64) - n_act).astype(np.int32)
-        row_index = np.where(mask, offsets[:, None] + ar[None, :], -1).astype(
-            np.int32
+        fam = self.family
+        n_act = self.n_actions[idx].astype(np.int32)
+        floats = np.empty(
+            (len(fam.float_cols), len(idx), A), dtype=self.float_dtype
         )
-        cols = {c: jnp.asarray(self._cols[c][idx]) for c in self.family.all_cols}
-        batch = self.family.batch_cls(
-            **cols,
-            mask=jnp.asarray(mask),
-            n_actions=jnp.asarray(n_act.astype(np.int32)),
-            game_id=jnp.arange(len(idx), dtype=jnp.int32),
-            row_index=jnp.asarray(row_index),
+        for i, c in enumerate(fam.float_cols):
+            floats[i] = self._cols[c][idx]
+        ints = np.empty((len(fam.int_cols), len(idx), A), dtype=self._int_wire)
+        for i, c in enumerate(fam.int_cols):
+            ints[i] = self._cols[c][idx]
+        is_home = self._cols['is_home'][idx]
+        put = (
+            (lambda a: jax.device_put(a, device))
+            if device is not None
+            else jnp.asarray
         )
-        if device is not None:
-            batch = jax.device_put(batch, device)
+        batch = _device_unpack(fam.name)(
+            put(floats), put(ints), put(is_home), put(n_act)
+        )
         return batch, list(game_ids)
+
+
+def _int_wire_name(int_cols) -> str:
+    """``'int8'`` when every id column fits, else ``'int32'``.
+
+    Every SPADL vocabulary fits int8; a store with exotic ids ships
+    int32 (correct, merely wider on the wire).
+    """
+    for col in int_cols:
+        if col.size and (col.min() < -128 or col.max() > 127):
+            return 'int32'
+    return 'int8'
+
+
+@functools.lru_cache(maxsize=None)
+def _device_unpack(family_name: str) -> Any:
+    """Jitted wire → :class:`ActionBatch` rebuild for one family.
+
+    Matches the host packer bit for bit: ``mask`` by length comparison,
+    ``row_index`` as running valid-row offset (int32 cumsum — exact
+    until a single chunk holds 2**31 actions; a full season is ~5M),
+    ``game_id`` as the chunk-local iota, ids widened back to int32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fam = FAMILIES[family_name]
+
+    @jax.jit
+    def unpack(floats, ints, is_home, n_act):
+        _G, A = is_home.shape
+        ar = jnp.arange(A, dtype=jnp.int32)
+        mask = ar[None, :] < n_act[:, None]
+        offsets = jnp.cumsum(n_act) - n_act
+        row_index = jnp.where(mask, offsets[:, None] + ar[None, :], -1)
+        cols = {c: floats[i] for i, c in enumerate(fam.float_cols)}
+        cols.update(
+            {
+                c: ints[i].astype(jnp.int32)
+                for i, c in enumerate(fam.int_cols)
+            }
+        )
+        cols['is_home'] = is_home
+        return fam.batch_cls(
+            **cols,
+            mask=mask,
+            n_actions=n_act,
+            game_id=jnp.arange(is_home.shape[0], dtype=jnp.int32),
+            row_index=row_index.astype(jnp.int32),
+        )
+
+    return unpack
 
 
 def ensure_packed(
@@ -254,6 +331,7 @@ def ensure_packed(
                 'family': fam.name,
                 'max_actions': A,
                 'float_dtype': fdt.name,
+                'int_wire': _int_wire_name(maps[c] for c in fam.int_cols),
                 'game_ids': [_json_safe(g) for g in game_ids],
                 'store_fingerprint': _store_fingerprint(path),
             }

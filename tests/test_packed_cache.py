@@ -194,3 +194,48 @@ def test_prefetch_composes_with_cache(store_path):
         cached = _batches(store, packed_cache=True, prefetch=2)
     for (b1, _), (b2, _) in zip(plain, cached):
         _assert_batch_equal(b1, b2)
+
+
+def test_wire_dtype_is_a_cache_property(store_path, tmp_path):
+    """int8 narrowing is decided once per cache, not per chunk.
+
+    SPADL vocabularies always fit int8, so a normal build records
+    ``int_wire: int8`` in meta; a cache written before the key existed
+    (meta without it) must decide by one open-time scan; a store whose
+    ids exceed int8 must fall back to int32 and still round-trip the
+    values exactly.
+    """
+    import json
+
+    with SeasonStore(store_path, mode='r') as store:
+        season = ensure_packed(store, max_actions=_A)
+        assert season.meta['int_wire'] == 'int8'
+        assert season._int_wire == np.dtype('int8')
+
+        # pre-key cache: drop the key from meta, reopen -> scan decides
+        meta_path = os.path.join(season.cache_dir, 'meta.json')
+        with open(meta_path, encoding='utf-8') as fh:
+            meta = json.load(fh)
+        meta.pop('int_wire')
+        with open(meta_path, 'w', encoding='utf-8') as fh:
+            json.dump(meta, fh)
+        reopened = PackedSeason(season.cache_dir)
+        assert reopened._int_wire == np.dtype('int8')
+        batch, _ = reopened.take([1, 2])
+        ref, _ = season.take([1, 2])
+        _assert_batch_equal(batch, ref)
+
+    # exotic ids (> int8) force the int32 wire and stay exact
+    path = str(tmp_path / 'wide_store')
+    with SeasonStore(path, mode='w') as store:
+        df = synthetic_actions_frame(
+            1, home_team_id=10, away_team_id=20, n_actions=50, seed=1
+        )
+        df.loc[0, 'period_id'] = 4000
+        store.put_actions(1, df)
+        store.put('games', pd.DataFrame([{'game_id': 1, 'home_team_id': 10}]))
+    with SeasonStore(path, mode='r') as store:
+        wide = ensure_packed(store, max_actions=128)
+        assert wide.meta['int_wire'] == 'int32'
+        batch, _ = wide.take([1])
+        assert int(np.asarray(batch.period_id)[0, 0]) == 4000
