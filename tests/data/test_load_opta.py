@@ -307,3 +307,72 @@ class TestWhoScoredParser:
         assert p12['minutes_played'] == 85
         # aggregated stat columns survive snake-casing
         assert p1['touches'] == 22
+
+
+class TestF9JSONParser:
+    """Direct parser-surface tests for the blocks the loader tests don't
+    reach (reference ``data/opta/parsers/f9_json.py:232-301``)."""
+
+    FEED = os.path.join(DATASETS, 'opta', 'f7-8-2017-501.json')
+
+    def test_extract_teamgamestats(self):
+        from socceraction_tpu.data.opta.parsers.f9_json import F9JSONParser
+
+        stats = F9JSONParser(self.FEED).extract_teamgamestats()
+        assert len(stats) == 2
+        home = next(s for s in stats if s['side'] == 'Home')
+        away = next(s for s in stats if s['side'] == 'Away')
+        assert home['game_id'] == away['game_id'] == GAME
+        assert (home['team_id'], away['team_id']) == (100, 200)
+        assert (home['score'], away['score']) == (2, 1)
+        assert home['shootout_score'] is None
+        # per-team Stat children ride along as extra keys
+        assert home['goals_conceded'] == 1 and away['goals_conceded'] == 2
+
+    def test_missing_teamdata_raises(self, tmp_path):
+        import copy
+        import json
+
+        from socceraction_tpu.data.base import MissingDataError
+        from socceraction_tpu.data.opta.parsers.f9_json import F9JSONParser
+
+        with open(self.FEED, encoding='utf-8') as fh:
+            obj = json.load(fh)
+        broken = copy.deepcopy(obj)
+        del broken[0]['data']['OptaFeed']['OptaDocument'][0]['MatchData']['TeamData']
+        path = tmp_path / 'f9.json'
+        path.write_text(json.dumps(broken))
+        parser = F9JSONParser(str(path))
+        with pytest.raises(MissingDataError):
+            parser.extract_teamgamestats()
+        with pytest.raises(MissingDataError):
+            parser.extract_lineups()
+
+    def test_feed_without_optadocument_is_missing_data(self, tmp_path):
+        import json
+
+        from socceraction_tpu.data.base import MissingDataError
+        from socceraction_tpu.data.opta.parsers.f9_json import F9JSONParser
+
+        path = tmp_path / 'f9.json'
+        path.write_text(json.dumps([{'data': {'SomethingElse': {}}}]))
+        with pytest.raises(MissingDataError):
+            F9JSONParser(str(path)).extract_games()
+
+    def test_unknown_player_names_are_skipped(self, tmp_path):
+        import copy
+        import json
+
+        from socceraction_tpu.data.opta.parsers.f9_json import F9JSONParser
+
+        with open(self.FEED, encoding='utf-8') as fh:
+            obj = json.load(fh)
+        mod = copy.deepcopy(obj)
+        doc = mod[0]['data']['OptaFeed']['OptaDocument'][0]
+        first_team_players = doc['Team'][0]['Player']
+        first_team_players[0]['PersonName']['nameObj']['is_unknown'] = True
+        path = tmp_path / 'f9.json'
+        path.write_text(json.dumps(mod))
+        full = F9JSONParser(self.FEED).extract_players()
+        skipped = F9JSONParser(str(path)).extract_players()
+        assert len(skipped) == len(full) - 1

@@ -111,3 +111,44 @@ def test_remote_without_statsbombpy(monkeypatch):
     finally:
         monkeypatch.delitem(sys.modules, 'statsbombpy', raising=False)
         importlib.reload(loader_mod)
+
+
+def test_events_with_360_frames(SBL):
+    """load_360 left-merges the three-sixty feed onto the event stream.
+
+    Reference behavior (socceraction/data/statsbomb/loader.py events():
+    frames rename event_uuid/visible_area/freeze_frame and merge on
+    event_id): covered events carry their frame, all others NaN, and the
+    event count is unchanged by the merge.
+    """
+    df = SBL.events(GAME_ID, load_360=True)
+    assert len(df) == 27
+    assert 'visible_area_360' in df and 'freeze_frame_360' in df
+    covered = df[df['visible_area_360'].notna()].set_index('event_id')
+    assert set(covered.index) == {
+        '00000000-0000-0000-0000-000000000007',
+        '00000000-0000-0000-0000-000000000009',
+    }
+    frame = covered.loc['00000000-0000-0000-0000-000000000007']
+    assert frame['visible_area_360'][0] == 20.0
+    assert frame['freeze_frame_360'][0]['actor'] is True
+    assert frame['freeze_frame_360'][1]['keeper'] is True
+    # uncovered events merge to missing, not to an empty list
+    uncovered = df[df['visible_area_360'].isna()]
+    assert len(uncovered) == 25
+
+
+def test_events_with_empty_360_feed(tmp_path):
+    """A game whose three-sixty file is an empty list still loads: the
+    360 columns are added as all-missing instead of the merge failing."""
+    import shutil
+
+    root = tmp_path / 'raw'
+    shutil.copytree(DATA_DIR, root)
+    with open(root / 'three-sixty' / f'{GAME_ID}.json', 'w') as fh:
+        fh.write('[]')
+    loader = StatsBombLoader(getter='local', root=str(root))
+    df = loader.events(GAME_ID, load_360=True)
+    assert len(df) == 27
+    assert df['visible_area_360'].isna().all()
+    assert df['freeze_frame_360'].isna().all()

@@ -154,3 +154,113 @@ class TestWyscoutAPILoader:
         df = API.events(555001)
         WyscoutEventSchema.validate(df)
         assert len(df) == 5
+
+
+class TestWyscoutAPIFeedLayouts:
+    """The feed-dict degrees of freedom the reference supports
+    (``data/wyscout/loader.py:339-382``): a 'games' index feed vs an
+    events glob, a seasons glob without a 'competitions' feed, missing
+    detail files (warn + skip), and malformed feeds (ParseError)."""
+
+    @pytest.fixture()
+    def root(self, tmp_path):
+        import shutil
+
+        for name in ('competitions.json', 'seasons_77.json', 'events_555001.json'):
+            shutil.copy(os.path.join(API_DIR, name), tmp_path / name)
+        return tmp_path
+
+    def test_games_index_feed(self, root):
+        """A 'games' feed lists matchIds; details come from each game's
+        events feed rather than an events glob."""
+        import json
+
+        with open(root / 'matches_2021.json', 'w') as fh:
+            json.dump({'matches': [{'matchId': 555001}]}, fh)
+        loader = WyscoutLoader(
+            root=str(root),
+            getter='local',
+            feeds={
+                'games': 'matches_{season_id}.json',
+                'events': 'events_{game_id}.json',
+            },
+        )
+        df = loader.games(77, 2021)
+        assert len(df) == 1
+        assert df.iloc[0]['game_id'] == 555001
+        WyscoutGameSchema.validate(df)
+
+    def test_games_missing_detail_warns_and_skips(self, root):
+        import json
+
+        with open(root / 'matches_2021.json', 'w') as fh:
+            json.dump({'matches': [{'matchId': 555001}, {'matchId': 555999}]}, fh)
+        loader = WyscoutLoader(
+            root=str(root),
+            getter='local',
+            feeds={
+                'games': 'matches_{season_id}.json',
+                'events': 'events_{game_id}.json',
+            },
+        )
+        with pytest.warns(UserWarning, match='555999'):
+            df = loader.games(77, 2021)
+        assert list(df['game_id']) == [555001]
+
+    def test_competitions_from_seasons_glob(self, root):
+        """No 'competitions' feed: competitions() globs the seasons files."""
+        loader = WyscoutLoader(
+            root=str(root),
+            getter='local',
+            feeds={
+                'seasons': 'seasons_*.json',
+                'events': 'events_{game_id}.json',
+            },
+        )
+        df = loader.competitions()
+        assert len(df) == 1
+        assert df.iloc[0]['competition_id'] == 77
+        WyscoutCompetitionSchema.validate(df)
+
+    def test_malformed_feeds_raise_parse_error(self, root):
+        import json
+
+        from socceraction_tpu.data.base import ParseError
+
+        with open(root / 'competitions.json', 'w') as fh:
+            json.dump({'not_competitions': []}, fh)
+        loader = WyscoutLoader(
+            root=str(root),
+            getter='local',
+            feeds={
+                'competitions': 'competitions.json',
+                'seasons': 'seasons_{competition_id}.json',
+                'events': 'events_{game_id}.json',
+            },
+        )
+        with pytest.raises(ParseError):
+            loader.competitions()
+
+        with open(root / 'matches_2021.json', 'w') as fh:
+            json.dump({'wrong': True}, fh)
+        loader2 = WyscoutLoader(
+            root=str(root),
+            getter='local',
+            feeds={
+                'games': 'matches_{season_id}.json',
+                'events': 'events_{game_id}.json',
+            },
+        )
+        with pytest.raises(ParseError):
+            loader2.games(77, 2021)
+
+    def test_empty_glob_is_missing_data(self, root):
+        from socceraction_tpu.data.base import MissingDataError
+
+        loader = WyscoutLoader(
+            root=str(root),
+            getter='local',
+            feeds={'seasons': 'nonexistent_*.json', 'events': 'events_{game_id}.json'},
+        )
+        with pytest.raises(MissingDataError):
+            loader.competitions()
