@@ -445,9 +445,13 @@ def interpolate_grid(grid: jax.Array, l_out: int, w_out: int) -> jax.Array:
 
     Sample points follow reference ``rate(use_interpolation=True)``
     (``xthreat.py:443-451``): ``linspace(0, field_length, l_out)`` by
-    ``linspace(0, field_width, w_out)``, interpolated between cell centers
-    with linear extrapolation at the borders (the reference delegates to
-    ``scipy.interpolate.interp2d(kind='linear')``).
+    ``linspace(0, field_width, w_out)``, interpolated between cell centers.
+    Samples outside the cell-center hull (the half-cell pitch borders) are
+    CLAMPED to the edge centers, because that is what the reference's
+    ``scipy.interpolate.interp2d(kind='linear')`` actually did: FITPACK's
+    ``fpbisp`` clamps evaluation points into the knot range (verified
+    against scipy's degree-1 ``RectBivariateSpline`` in
+    ``tests/test_interp_oracle.py``), it never linearly extrapolates.
     """
     w, l = grid.shape
     cell_l = spadlconfig.field_length / l
@@ -461,7 +465,8 @@ def interpolate_grid(grid: jax.Array, l_out: int, w_out: int) -> jax.Array:
 
     def sample_axis(f: jax.Array, n: int) -> Tuple[jax.Array, jax.Array]:
         i0 = jnp.clip(jnp.floor(f).astype(jnp.int32), 0, n - 2)
-        t = f - i0
+        # t clamped to [0, 1]: FITPACK border behavior (see docstring)
+        t = jnp.clip(f - i0, 0.0, 1.0)
         return i0, t
 
     ix, tx = sample_axis(fx, l)

@@ -449,7 +449,13 @@ class ExpectedThreat:
         return fine, l, w
 
     def _interpolate_numpy(self, l_out: int, w_out: int) -> np.ndarray:
-        """Bilinear upsampling between cell centers with edge extrapolation."""
+        """Bilinear upsampling between cell centers, borders clamped.
+
+        Border samples clamp to the edge cell centers — the behavior of
+        the reference's FITPACK-backed ``interp2d(kind='linear')``
+        (``fpbisp`` clamps queries into the knot range; see
+        ``ops/xt.py:interpolate_grid`` and ``tests/test_interp_oracle.py``).
+        """
         cell_l = spadlconfig.field_length / self.l
         cell_w = spadlconfig.field_width / self.w
         xs = np.linspace(0.0, spadlconfig.field_length, l_out)
@@ -458,8 +464,8 @@ class ExpectedThreat:
         fy = (ys - 0.5 * cell_w) / cell_w
         ix = np.clip(np.floor(fx).astype(np.int64), 0, self.l - 2)
         iy = np.clip(np.floor(fy).astype(np.int64), 0, self.w - 2)
-        tx = fx - ix
-        ty = fy - iy
+        tx = np.clip(fx - ix, 0.0, 1.0)
+        ty = np.clip(fy - iy, 0.0, 1.0)
         r0 = self.w - 1 - iy
         r1 = self.w - 2 - iy
         g00 = self.xT[r0][:, ix]
@@ -521,8 +527,12 @@ class ExpectedThreat:
         wrapper: called with 1-D ``xs``/``ys`` meter coordinates, returns
         the ``(len(ys), len(xs))`` interpolated surface). Built on
         ``scipy.interpolate.RegularGridInterpolator`` (``interp2d`` was
-        removed from SciPy) with the same cell-centered sample points and
-        edge extrapolation.
+        removed from SciPy) with the same cell-centered sample points.
+        Queries outside the cell-center hull are clamped into it first,
+        reproducing FITPACK's border behavior (``fpbisp`` clamps, never
+        extrapolates) that the ``interp2d``-backed reference actually
+        had — where ``RegularGridInterpolator(fill_value=None)`` would
+        linearly extrapolate instead.
 
         Known deviation (documented in PARITY.md): the returned ``f(x, y)``
         is correctly oriented in pitch coordinates — the surface is flipped
@@ -541,7 +551,7 @@ class ExpectedThreat:
         """
         try:
             from scipy.interpolate import RegularGridInterpolator
-        except ImportError as exc:  # pragma: no cover
+        except ImportError as exc:
             raise ImportError('Interpolation requires scipy to be installed.') from exc
 
         methods = {'linear': 'linear', 'cubic': 'cubic', 'quintic': 'quintic'}
@@ -557,13 +567,17 @@ class ExpectedThreat:
             (ys, xs),
             self.xT[::-1],
             method=methods[kind],
+            # inert under the query clamp in f() below (every point is
+            # in-bounds); kept so a future unclamped call path degrades
+            # to extrapolation rather than NaNs
             bounds_error=False,
-            fill_value=None,  # extrapolate at the borders like interp2d
+            fill_value=None,
         )
 
         def f(x: np.ndarray, y: np.ndarray) -> np.ndarray:
-            x = np.asarray(x, dtype=np.float64)
-            y = np.asarray(y, dtype=np.float64)
+            # clamp into the knot hull: FITPACK border behavior (see above)
+            x = np.clip(np.asarray(x, dtype=np.float64), xs[0], xs[-1])
+            y = np.clip(np.asarray(y, dtype=np.float64), ys[0], ys[-1])
             gx, gy = np.meshgrid(x, y)
             return interp(np.stack([gy.ravel(), gx.ravel()], axis=-1)).reshape(
                 len(y), len(x)

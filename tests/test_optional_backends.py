@@ -1,0 +1,234 @@
+"""Stub-driven wiring tests for the optional-dependency surfaces.
+
+The three GBM libraries are absent from this image, so
+``ml/learners.py``'s fit wrappers have no executable tier without
+stubs: recording fakes pin the exact reference-default hyperparameters
+each wrapper passes through (reference
+``socceraction/vaep/base.py:215-282``). scipy, long believed absent,
+turns out to ship (scikit-learn depends on it) — so
+``ExpectedThreat.interpolator`` is driven BOTH ways here: through a
+recording fake that pins the ``RegularGridInterpolator`` wiring
+(cell-center knots, ascending-y value flip, FITPACK-style clamping)
+and unstubbed through the real scipy against the vendored interp2d
+oracle (``tests/test_interp_oracle.py``), so the scipy-backed path and
+the oracle-verified semantics can never drift apart silently.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from socceraction_tpu import xthreat
+from socceraction_tpu.ml import learners
+from tests.test_interp_oracle import interp2d_linear_oracle
+
+
+# ---------------------------------------------------------------------------
+# ExpectedThreat.interpolator via a faithful RegularGridInterpolator stub
+# ---------------------------------------------------------------------------
+
+
+class _FakeRGI:
+    """Linear RegularGridInterpolator fake backed by the vendored oracle.
+
+    ``interpolator()`` clamps queries into the knot hull before calling
+    the interpolant (FITPACK parity), so the fake only ever sees in-hull
+    points, where RGI-linear and the oracle agree exactly. Records the
+    construction arguments for the wiring assertions.
+    """
+
+    last = None
+
+    def __init__(self, points, values, method, bounds_error, fill_value):
+        self.points = points
+        self.values = np.asarray(values)
+        self.method = method
+        self.bounds_error = bounds_error
+        self.fill_value = fill_value
+        _FakeRGI.last = self
+
+    def __call__(self, pts):
+        ys, xs = self.points
+        out = np.empty(len(pts))
+        for k, (y, x) in enumerate(np.asarray(pts)):
+            out[k] = interp2d_linear_oracle(xs, ys, self.values, [x], [y])[0, 0]
+        return out
+
+
+@pytest.fixture()
+def fake_scipy(monkeypatch):
+    interpolate = types.ModuleType('scipy.interpolate')
+    interpolate.RegularGridInterpolator = _FakeRGI
+    scipy = types.ModuleType('scipy')
+    scipy.interpolate = interpolate
+    monkeypatch.setitem(sys.modules, 'scipy', scipy)
+    monkeypatch.setitem(sys.modules, 'scipy.interpolate', interpolate)
+    _FakeRGI.last = None
+    return interpolate
+
+
+def test_interpolator_wiring_and_oracle_agreement(fake_scipy):
+    from socceraction_tpu.spadl import config as spadlconfig
+
+    model = xthreat.ExpectedThreat(l=16, w=12)
+    rng = np.random.default_rng(7)
+    model.xT = rng.random((12, 16))
+
+    f = model.interpolator(kind='linear')
+    rgi = _FakeRGI.last
+    assert rgi is not None
+    # cell-center knots in ascending order, values flipped to ascending-y
+    ys, xs = rgi.points
+    cell_l = spadlconfig.field_length / 16
+    cell_w = spadlconfig.field_width / 12
+    np.testing.assert_allclose(xs, np.arange(16) * cell_l + cell_l / 2)
+    np.testing.assert_allclose(ys, np.arange(12) * cell_w + cell_w / 2)
+    np.testing.assert_array_equal(rgi.values, model.xT[::-1])
+    assert rgi.method == 'linear'
+    assert rgi.bounds_error is False
+    assert rgi.fill_value is None
+
+    # sampled surface (incl. the border samples half a cell outside the
+    # knot hull, clamped like FITPACK) matches the oracle contract exactly
+    xq = np.linspace(0.0, spadlconfig.field_length, 9)
+    yq = np.linspace(0.0, spadlconfig.field_width, 7)
+    got = f(xq, yq)
+    want = interp2d_linear_oracle(xs, ys, model.xT[::-1], xq, yq)
+    assert got.shape == (7, 9)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+
+
+def test_interpolator_rejects_unknown_kind(fake_scipy):
+    model = xthreat.ExpectedThreat()
+    with pytest.raises(ValueError, match='kind'):
+        model.interpolator(kind='septic')
+
+
+def test_interpolator_without_scipy_raises(monkeypatch):
+    # scipy IS importable in this image (scikit-learn depends on it), so
+    # absence must be simulated by blocking the cached submodule too
+    monkeypatch.setitem(sys.modules, 'scipy', None)
+    monkeypatch.setitem(sys.modules, 'scipy.interpolate', None)
+    model = xthreat.ExpectedThreat()
+    with pytest.raises(ImportError, match='scipy'):
+        model.interpolator()
+
+
+def test_real_scipy_interpolator_matches_oracle():
+    """The unstubbed scipy-backed interpolator agrees with the vendored
+    interp2d oracle on random surfaces, including the border samples half
+    a cell outside the knot hull (clamped into it, FITPACK-style)."""
+    pytest.importorskip('scipy.interpolate')
+    from socceraction_tpu.spadl import config as spadlconfig
+
+    model = xthreat.ExpectedThreat(l=16, w=12)
+    rng = np.random.default_rng(11)
+    model.xT = rng.random((12, 16))
+    f = model.interpolator(kind='linear')
+
+    cell_l = spadlconfig.field_length / 16
+    cell_w = spadlconfig.field_width / 12
+    xs = np.arange(16) * cell_l + cell_l / 2
+    ys = np.arange(12) * cell_w + cell_w / 2
+    xq = np.linspace(0.0, spadlconfig.field_length, 33)
+    yq = np.linspace(0.0, spadlconfig.field_width, 21)
+    got = f(xq, yq)
+    want = interp2d_linear_oracle(xs, ys, model.xT[::-1], xq, yq)
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# GBM fit wrappers via recording stubs: pin the reference defaults
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    """Classifier fake: records ctor/fit kwargs, returns itself from fit."""
+
+    def __init__(self, **kwargs):
+        self.ctor = kwargs
+
+    def fit(self, X, y, **kwargs):
+        self.fit_kwargs = kwargs
+        return self
+
+
+def test_fit_xgboost_reference_defaults(monkeypatch):
+    stub = types.SimpleNamespace(XGBClassifier=_Recorder)
+    monkeypatch.setattr(learners, 'xgboost', stub)
+    X, y = np.zeros((8, 2)), np.array([0, 1] * 4)
+
+    model = learners.fit_xgboost(X, y)
+    assert model.ctor == {
+        'n_estimators': 100,
+        'max_depth': 3,
+        'eval_metric': 'auc',
+    }
+    assert model.fit_kwargs == {'verbose': False}
+
+    # an eval set adds early stopping (ctor-level in xgboost >= 2.0)
+    es = [(X, y)]
+    model = learners.fit_xgboost(X, y, eval_set=es)
+    assert model.ctor['early_stopping_rounds'] == 10
+    assert model.fit_kwargs['eval_set'] is es
+
+
+def test_fit_catboost_reference_defaults(monkeypatch):
+    import pandas as pd
+
+    stub = types.SimpleNamespace(CatBoostClassifier=_Recorder)
+    monkeypatch.setattr(learners, 'catboost', stub)
+    X = pd.DataFrame(
+        {
+            'a': np.zeros(8),
+            'b': pd.Categorical(['x', 'y'] * 4),
+        }
+    )
+    y = np.array([0, 1] * 4)
+
+    model = learners.fit_catboost(X, y)
+    assert model.ctor == {
+        'eval_metric': 'BrierScore',
+        'loss_function': 'Logloss',
+        'iterations': 100,
+    }
+    # categorical columns detected by dtype, passed by position
+    assert model.fit_kwargs == {'cat_features': [1], 'verbose': False}
+
+    es = [(X, y)]
+    model = learners.fit_catboost(X, y, eval_set=es)
+    assert model.fit_kwargs['early_stopping_rounds'] == 10
+    assert model.fit_kwargs['eval_set'] is es
+
+
+def test_fit_lightgbm_reference_defaults(monkeypatch):
+    marker = object()
+    stub = types.SimpleNamespace(
+        LGBMClassifier=_Recorder,
+        early_stopping=lambda rounds, verbose: (marker, rounds, verbose),
+    )
+    monkeypatch.setattr(learners, 'lightgbm', stub)
+    X, y = np.zeros((8, 2)), np.array([0, 1] * 4)
+
+    model = learners.fit_lightgbm(X, y)
+    assert model.ctor == {'n_estimators': 100, 'max_depth': 3}
+    assert model.fit_kwargs == {'eval_metric': 'auc'}
+
+    # lightgbm >= 4: early stopping rides a callback, not a fit kwarg
+    es = [(X, y)]
+    model = learners.fit_lightgbm(X, y, eval_set=es)
+    assert model.fit_kwargs['eval_set'] is es
+    assert (marker, 10, False) in model.fit_kwargs['callbacks']
+    assert 'early_stopping_rounds' not in model.fit_kwargs
+
+
+@pytest.mark.parametrize(
+    'name', ['fit_xgboost', 'fit_catboost', 'fit_lightgbm']
+)
+def test_wrappers_raise_cleanly_when_lib_absent(monkeypatch, name):
+    lib = name.replace('fit_', '')
+    monkeypatch.setattr(learners, lib, None)
+    with pytest.raises(ImportError, match=lib):
+        getattr(learners, name)(np.zeros((2, 1)), np.array([0, 1]))
