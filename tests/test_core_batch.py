@@ -63,3 +63,26 @@ def test_pack_max_actions_overflow():
     df = _frame([1] * 5, [1.0] * 5)
     with pytest.raises(ValueError):
         pack_actions(df, home_team_ids={1: 10}, max_actions=4)
+
+
+def test_pack_rejects_malformed_frames():
+    df = pd.DataFrame({'not_game_id': [1]})
+    with pytest.raises(ValueError, match='game_id'):
+        pack_actions(df, home_team_id=1)
+    empty = pd.DataFrame({'game_id': pd.Series([], dtype='int64')})
+    with pytest.raises(ValueError, match='empty'):
+        pack_actions(empty, home_team_id=1)
+
+
+def test_pack_places_on_requested_device():
+    """Under the suite's 8-device CPU mesh, devices()[-1] is NOT the
+    default device, so this fails if device= is silently dropped."""
+    import jax
+
+    if len(jax.devices()) < 2:  # direct invocation outside conftest's env
+        pytest.skip('needs a multi-device backend to be non-vacuous')
+    frame = _frame([1] * 8, [5.0] * 8)
+    device = jax.devices()[-1]
+    assert device != jax.devices()[0]
+    batch, _ = pack_actions(frame, home_team_id=10, device=device)
+    assert batch.mask.devices() == {device}

@@ -1,0 +1,76 @@
+"""The synthetic season writer and the pipeline conveniences around it.
+
+``write_synthetic_season`` feeds the bench's cold-path measurement
+(``bench.py:_bench_cold_path``) but had no test tier of its own — a
+regression here would silently change what the committed BENCH artifacts
+measure. Pin the store layout, determinism, and the converter inference
+used by ``build_spadl_store``.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import write_synthetic_season
+from socceraction_tpu.pipeline import SeasonStore, load_batch
+
+
+def test_write_synthetic_season_layout_and_round_trip(tmp_path):
+    path = write_synthetic_season(str(tmp_path / 'season.h5'), 4, 192)
+    with SeasonStore(path, mode='r') as store:
+        ids = store.game_ids()
+        assert len(ids) == 4
+        games = store.get('games')
+        assert set(games.columns) >= {'game_id', 'home_team_id', 'away_team_id'}
+        # vocab tables ride along so downstream joins work offline
+        assert 'actiontypes' in store and 'results' in store and 'bodyparts' in store
+        teams = store.get('teams')
+        players = store.get('players')
+        assert set(games['home_team_id']) <= set(teams['team_id'])
+        assert len(players) == 11 * len(teams)
+
+        frame = store.get_actions(ids[0])
+        assert len(frame) == 192
+        # player ids are drawn from the acting team's roster convention
+        assert (frame['player_id'] // 1000 == frame['team_id']).all()
+
+        batch, got_ids = load_batch(store, max_actions=256)
+        assert got_ids == list(ids)
+        assert int(np.asarray(batch.mask).sum()) == 4 * 192
+
+
+def test_write_synthetic_season_is_deterministic(tmp_path):
+    a = write_synthetic_season(str(tmp_path / 'a.h5'), 3, 64)
+    b = write_synthetic_season(str(tmp_path / 'b.h5'), 3, 64)
+    with SeasonStore(a, mode='r') as sa, SeasonStore(b, mode='r') as sb:
+        for gid in sa.game_ids():
+            pd.testing.assert_frame_equal(sa.get_actions(gid), sb.get_actions(gid))
+    c = write_synthetic_season(str(tmp_path / 'c.h5'), 3, 64, seed=1)
+    with SeasonStore(a, mode='r') as sa, SeasonStore(c, mode='r') as sc:
+        gid = sa.game_ids()[0]
+        assert not sa.get_actions(gid).equals(sc.get_actions(gid))
+
+
+def test_default_converter_inference():
+    """``build_spadl_store`` infers the SPADL converter from the loader's
+    class name; unknown loaders must fail loudly, not guess."""
+    from socceraction_tpu.pipeline.build import _default_converter
+    from socceraction_tpu.spadl import opta, statsbomb, wyscout
+
+    class MyStatsBombLoader:
+        pass
+
+    class SomeWyscoutThing:
+        pass
+
+    class OptaFeedLoader:
+        pass
+
+    class Mystery:
+        pass
+
+    assert _default_converter(MyStatsBombLoader()) is statsbomb.convert_to_actions
+    assert _default_converter(SomeWyscoutThing()) is wyscout.convert_to_actions
+    assert _default_converter(OptaFeedLoader()) is opta.convert_to_actions
+    with pytest.raises(ValueError, match='convert='):
+        _default_converter(Mystery())

@@ -94,3 +94,21 @@ def test_tpu_doctor_reports_cpu_environment():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     d = json.loads(proc.stdout.strip().splitlines()[-1])
     assert d['status'] == 'cpu' and d['ok'] is True
+
+
+def test_profile_trace_writes_and_noops(tmp_path):
+    """profile_trace captures a jax.profiler trace; enabled=False no-ops."""
+    import jax.numpy as jnp
+
+    from socceraction_tpu.utils.profiling import profile_trace
+
+    off = tmp_path / 'off'
+    with profile_trace(str(off), enabled=False):
+        jnp.arange(8).sum().block_until_ready()
+    assert not off.exists()
+
+    on = tmp_path / 'on'
+    with profile_trace(str(on)):
+        jnp.arange(8).sum().block_until_ready()
+    written = list(on.rglob('*'))
+    assert any(p.is_file() for p in written)

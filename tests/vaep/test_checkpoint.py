@@ -83,3 +83,14 @@ def test_save_rejects_custom_transformer(tmp_path, game, spadl_actions):
     model.fit(X, y, learner='sklearn')
     with pytest.raises(ValueError, match='custom feature transformer'):
         model.save_model(str(tmp_path / 'x'))
+
+
+def test_mlp_unfitted_predict_raises():
+    import jax.numpy as jnp
+    import pytest
+
+    from socceraction_tpu.ml.mlp import MLPClassifier
+
+    clf = MLPClassifier(hidden=(4,))
+    with pytest.raises(ValueError, match='not fitted'):
+        clf.predict_proba_device(jnp.zeros((2, 3)))
