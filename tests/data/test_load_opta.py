@@ -376,3 +376,56 @@ class TestF9JSONParser:
         full = F9JSONParser(self.FEED).extract_players()
         skipped = F9JSONParser(str(path)).extract_players()
         assert len(skipped) == len(full) - 1
+
+
+class TestMA1JSONParser:
+    """The MA1 wire-format variants the loader fixture doesn't reach:
+    tournament-calendar feeds wrap matches in a 'match' LIST, single-match
+    feeds put 'matchInfo' at the root, anything else is MissingDataError
+    (reference ``data/opta/parsers/ma1_json.py:24-35``)."""
+
+    FIXTURE = os.path.join(DATASETS, 'statsperform', 'ma1-8-2017.json')
+
+    def test_match_list_variant_extracts_identically(self, tmp_path):
+        import json
+
+        from socceraction_tpu.data.opta.parsers.ma1_json import MA1JSONParser
+
+        with open(self.FIXTURE, encoding='utf-8') as fh:
+            single = json.load(fh)
+        wrapped = tmp_path / 'ma1_list.json'
+        wrapped.write_text(json.dumps({'match': [single]}))
+
+        a = MA1JSONParser(self.FIXTURE)
+        b = MA1JSONParser(str(wrapped))
+        assert a.extract_games() == b.extract_games()
+        assert a.extract_teams() == b.extract_teams()
+        assert a.extract_competitions() == b.extract_competitions()
+
+    def test_unrecognized_root_is_missing_data(self, tmp_path):
+        import json
+
+        from socceraction_tpu.data.base import MissingDataError
+        from socceraction_tpu.data.opta.parsers.ma1_json import MA1JSONParser
+
+        path = tmp_path / 'ma1_bad.json'
+        path.write_text(json.dumps({'somethingElse': 1}))
+        with pytest.raises(MissingDataError):
+            MA1JSONParser(str(path)).extract_games()
+
+    def test_match_without_lineup_is_skipped(self, tmp_path):
+        import copy
+        import json
+
+        from socceraction_tpu.data.opta.parsers.ma1_json import MA1JSONParser
+
+        with open(self.FIXTURE, encoding='utf-8') as fh:
+            single = json.load(fh)
+        stripped = copy.deepcopy(single)
+        del stripped['liveData']['lineUp']
+        path = tmp_path / 'ma1_nolineup.json'
+        path.write_text(json.dumps(stripped))
+        parser = MA1JSONParser(str(path))
+        assert parser.extract_players() == {}
+        # games/teams still extract from matchInfo alone
+        assert len(parser.extract_teams()) == 2

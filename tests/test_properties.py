@@ -68,7 +68,9 @@ def spadl_frames(draw):
                     'original_event_id': [None] * n,
                     'period_id': [1] * n,
                     'action_id': range(n),
-                    'time_seconds': np.arange(n, dtype=float),
+                    # globally unique across games so the round-trip
+                    # property below can detect cross-game swaps
+                    'time_seconds': 1000.0 * g + np.arange(n, dtype=float),
                     'team_id': [10 if h else 20 for h in is_home],
                     'player_id': [1] * n,
                     'start_x': [50.0] * n,
@@ -106,18 +108,19 @@ def test_labels_match_pandas_oracle_for_any_frame_and_lookahead(frame, k):
 @settings(**_SETTINGS)
 def test_pack_unpack_round_trips_any_row_order(frame, data):
     """unpack_values returns device results in the SOURCE frame's row
-    order for any interleaving of the games' rows."""
+    order for any interleaving of the games' rows.
+
+    The probe column is ``time_seconds`` — a column the packer ITSELF
+    scatters into the (G, A) layout — with values unique across the
+    whole frame, so a row_index that reversed a game or swapped two
+    interleaved games produces a mismatch (deriving the expectation
+    from ``batch.row_index`` instead would be tautological: unpack
+    inverts whatever permutation row_index encodes).
+    """
     order = data.draw(st.permutations(range(len(frame))))
     shuffled = frame.iloc[list(order)].reset_index(drop=True)
-    payload = np.arange(len(shuffled), dtype=np.float32)
-    shuffled = shuffled.assign(payload=payload)
     batch, _ = pack_actions(shuffled, home_team_id=10)
-    # scatter the payload into the packed layout (the suite's established
-    # host idiom, cf. tests/vaep/test_labels_formula.py), then unpack
-    import jax.numpy as jnp
-
-    rows = np.asarray(batch.row_index)
-    mask = np.asarray(batch.mask)
-    vals = np.zeros(mask.shape, dtype=np.float32)
-    vals[mask] = payload[rows[mask]]
-    np.testing.assert_array_equal(unpack_values(jnp.asarray(vals), batch), payload)
+    np.testing.assert_array_equal(
+        unpack_values(batch.time_seconds, batch),
+        shuffled['time_seconds'].to_numpy(dtype=np.float32),
+    )
