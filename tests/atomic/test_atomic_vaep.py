@@ -8,6 +8,7 @@ from socceraction_tpu.atomic.spadl import add_names
 from socceraction_tpu.atomic.vaep import AtomicVAEP
 from socceraction_tpu.atomic.vaep import features as fs
 from socceraction_tpu.atomic.vaep import formula as vaepformula
+from socceraction_tpu.atomic.spadl import config as atomicspadl
 from socceraction_tpu.atomic.vaep import labels as lab
 from socceraction_tpu.atomic.vaep.base import xfns_default
 
@@ -88,3 +89,20 @@ def test_formula_prevgoal_reset():
     # action after a goal: previous probabilities reset to 0
     assert v['offensive_value'].iloc[2] == pytest.approx(0.1)
     assert v['defensive_value'].iloc[2] == pytest.approx(-0.2)
+
+
+def test_goal_from_shot_microframe():
+    """xG label: a shot followed DIRECTLY by a goal event (atomic goals
+    are separate rows, not shot results — reference
+    ``atomic/vaep/labels.py:goal_from_shot``)."""
+    shot = atomicspadl.actiontypes.index('shot')
+    actions = pd.DataFrame(
+        {
+            'game_id': [1] * 5,
+            # shot -> goal (counts), shot -> pass (doesn't), trailing shot
+            'type_id': [shot, atomicspadl.GOAL, shot, 0, shot],
+            'team_id': [1, 1, 2, 2, 1],
+        }
+    )
+    g = lab.goal_from_shot(actions)
+    assert g['goal'].tolist() == [True, False, False, False, False]
