@@ -1,0 +1,40 @@
+"""The loader-shared IO helpers (reference ``data/base.py``)."""
+
+import io
+import json
+
+import pytest
+
+from socceraction_tpu.data import base
+
+
+def test_snake_case():
+    assert base._snake('matchPeriod') == 'match_period'
+    assert base._snake('PassRecipientId') == 'pass_recipient_id'
+    assert base._snake('xG') == 'x_g'
+    assert base._snake('already_snake') == 'already_snake'
+
+
+@pytest.mark.parametrize(
+    'minute,durations,expanded',
+    [
+        (30, [47, 49], 30),        # first half: no expansion
+        (46, [47, 49], 48),        # second half: +2' of H1 injury time
+        (45, [47, 49], 45),        # boundary: still the first half
+        (91, [47, 49, 16], 97),    # extra time: +2' and +4'
+    ],
+)
+def test_expand_minute(minute, durations, expanded):
+    assert base._expand_minute(minute, durations) == expanded
+
+
+def test_remoteloadjson_parses_url_payload(monkeypatch):
+    seen = []
+
+    def fake_urlopen(url):
+        seen.append(url)
+        return io.BytesIO(json.dumps({'ok': True}).encode())
+
+    monkeypatch.setattr(base, 'urlopen', fake_urlopen)
+    assert base._remoteloadjson('https://example.test/feed.json') == {'ok': True}
+    assert seen == ['https://example.test/feed.json']

@@ -221,3 +221,15 @@ def test_sharded_matrix_free_fit_matches_unsharded(season):
     )
     assert int(it) == int(ref_it)
     np.testing.assert_allclose(np.asarray(grid), np.asarray(ref_grid), atol=1e-6)
+
+
+def test_mesh_guard_rails():
+    import jax
+
+    with pytest.raises(ValueError, match='does not divide'):
+        make_mesh(model_parallel=3)  # 8 devices on the test mesh
+    small = make_mesh(n_devices=4)
+    assert small.devices.size == 4
+    explicit = make_mesh(devices=jax.devices()[:2])
+    assert explicit.devices.size == 2
+    assert explicit.axis_names == ('games', 'model')

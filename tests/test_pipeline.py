@@ -226,3 +226,29 @@ def test_mode_w_refuses_non_store_dir(tmp_path):
     with pytest.raises(ValueError, match='refusing to overwrite'):
         SeasonStore(str(precious), mode='w')
     assert (precious / 'thesis.docx').exists()
+
+
+def test_store_guard_rails(tmp_path):
+    """The refusal branches: invalid mode/engine, read of a missing
+    parquet dir, and the mode='w' replacement of a store-shaped dir that
+    is not already covered by test_mode_w_refuses_non_store_dir above."""
+    with pytest.raises(ValueError, match='mode'):
+        SeasonStore(str(tmp_path / 's'), mode='x')
+    with pytest.raises(ValueError, match='engine'):
+        SeasonStore(str(tmp_path / 's'), engine='csv')
+    with pytest.raises(FileNotFoundError):
+        SeasonStore(str(tmp_path / 'missing'), mode='r')
+
+    # a store-shaped directory IS replaced by mode='w'
+    store_dir = tmp_path / 'store'
+    with SeasonStore(str(store_dir), mode='w') as store:
+        store.put('games', pd.DataFrame({'game_id': [1], 'home_team_id': [10]}))
+    with SeasonStore(str(store_dir), mode='w') as store:
+        assert 'games' not in store
+
+    # __contains__ answers without raising for both hit and miss
+    with SeasonStore(str(store_dir), mode='a') as store:
+        store.put('games', pd.DataFrame({'game_id': [1], 'home_team_id': [10]}))
+        assert 'games' in store
+        assert 'nope' not in store
+
