@@ -429,3 +429,56 @@ class TestMA1JSONParser:
         assert parser.extract_players() == {}
         # games/teams still extract from matchInfo alone
         assert len(parser.extract_teams()) == 2
+
+
+def test_deepupdate_merges_all_shapes():
+    """_deepupdate drives multi-feed merging (F1+F9 views of one game):
+    lists extend, dicts recurse, sets union, scalars overwrite
+    (reference ``data/opta/loader.py:147-186``)."""
+    from socceraction_tpu.data.opta.loader import _deepupdate
+
+    target = {
+        'list': [1],
+        'dict': {'kept': 1, 'replaced': 'old'},
+        'set': {1},
+        'scalar': 'old',
+    }
+    src = {
+        'list': [2],
+        'dict': {'replaced': 'new', 'added': 2},
+        'set': {2},
+        'scalar': 'new',
+        'fresh_list': [9],
+        'fresh_dict': {'a': 1},
+        'fresh_set': {7},
+    }
+    _deepupdate(target, src)
+    assert target['list'] == [1, 2]
+    assert target['dict'] == {'kept': 1, 'replaced': 'new', 'added': 2}
+    assert target['set'] == {1, 2}
+    assert target['scalar'] == 'new'
+    assert target['fresh_list'] == [9] and target['fresh_dict'] == {'a': 1}
+    assert target['fresh_set'] == {7}
+    # fresh containers are deep copies, never aliases into src
+    src['fresh_list'].append(10)
+    assert target['fresh_list'] == [9]
+
+
+def test_custom_parser_dict_requires_feeds():
+    from socceraction_tpu.data.opta.parsers import F24JSONParser
+
+    with pytest.raises(ValueError, match='feed for each parser'):
+        OptaLoader(root='.', parser={'f24': F24JSONParser})
+    # explicit parser dict + feeds is the documented extension point
+    loader = OptaLoader(
+        root=os.path.join(DATASETS, 'opta'),
+        parser={'f24': F24JSONParser},
+        feeds={'f24': 'f7-{competition_id}-{season_id}-{game_id}.json'},
+    )
+    df = loader.events(GAME)
+    assert len(df) == 13
+
+
+def test_non_string_parser_rejected():
+    with pytest.raises(ValueError, match='parser'):
+        OptaLoader(root='.', parser=42)

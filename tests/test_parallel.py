@@ -224,8 +224,6 @@ def test_sharded_matrix_free_fit_matches_unsharded(season):
 
 
 def test_mesh_guard_rails():
-    import jax
-
     with pytest.raises(ValueError, match='does not divide'):
         make_mesh(model_parallel=3)  # 8 devices on the test mesh
     small = make_mesh(n_devices=4)
