@@ -1,0 +1,60 @@
+"""The shared Opta parser helpers (reference ``data/opta/parsers/base.py``)."""
+
+import pytest
+
+from socceraction_tpu.data.base import MissingDataError
+from socceraction_tpu.data.opta.parsers.base import (
+    _get_end_x,
+    _get_end_y,
+    _team_on_side,
+    assertget,
+)
+
+
+def test_assertget():
+    assert assertget({'a': 1}, 'a') == 1
+    with pytest.raises(AssertionError, match='missing'):
+        assertget({'a': 1}, 'missing')
+
+
+def test_team_on_side():
+    teams = [
+        {'position': 'home', 'id': 't1'},
+        {'position': 'away', 'id': 't2'},
+    ]
+    assert _team_on_side(teams, 'home') == 't1'
+    assert _team_on_side(teams, 'away') == 't2'
+    with pytest.raises(MissingDataError):
+        _team_on_side([{'position': 'home', 'id': 't1'}], 'away')
+
+
+@pytest.mark.parametrize(
+    'qualifiers,end_x,end_y',
+    [
+        ({140: '62.5', 141: '41.0'}, 62.5, 41.0),        # pass end point
+        ({146: '88.0', 147: '52.0'}, 88.0, 52.0),        # blocked shot
+        ({102: '48.0'}, 100.0, 48.0),                    # goal mouth: x is the goal line
+        ({}, None, None),                                # no end-coord qualifier
+        ({140: 'junk', 141: 'junk'}, None, None),        # unparseable values
+    ],
+)
+def test_end_coordinate_qualifiers(qualifiers, end_x, end_y):
+    assert _get_end_x(qualifiers) == end_x
+    assert _get_end_y(qualifiers) == end_y
+
+
+def test_zero_end_coordinate_falls_back_to_start_by_reference_quirk():
+    """An explicit 0.0 end coordinate is treated as missing.
+
+    Every reference call site derives ``end_x = _get_end_x(q) or start_x``
+    (``f24_json.py:95``, ``f24_xml.py:79``, ``ma3_json.py:273``), so a
+    pass to the goal line at x=0 inherits its start point. The spec
+    engine reproduces that ``or`` exactly (``parsers/base.py:
+    _derive_end_x``) — this is a PRESERVED reference quirk, not a bug to
+    fix here; changing it would diverge converted output from upstream.
+    """
+    from socceraction_tpu.data.opta.parsers.base import _derive_end_x, _derive_end_y
+
+    record = {'qualifiers': {140: '0', 141: '0'}, 'start_x': 33.0, 'start_y': 44.0}
+    assert _derive_end_x(record, None) == 33.0
+    assert _derive_end_y(record, None) == 44.0
