@@ -42,6 +42,30 @@ _SETTINGS = dict(
 )
 
 
+def _base_game_columns(draw, g):
+    """Shared per-game scaffold of both frame strategies.
+
+    Draws the game length and team assignment, and builds every column
+    whose convention both families share — including ``time_seconds``
+    made globally unique across games so the round-trip property can
+    detect cross-game swaps. One place to update when the packing
+    contract grows a column.
+    """
+    n = draw(st.integers(1, 24))
+    is_home = draw(st.lists(st.booleans(), min_size=n, max_size=n))
+    cols = {
+        'game_id': [100 + g] * n,
+        'original_event_id': [None] * n,
+        'period_id': [1] * n,
+        'action_id': range(n),
+        'time_seconds': 1000.0 * g + np.arange(n, dtype=float),
+        'team_id': [10 if h else 20 for h in is_home],
+        'player_id': [1] * n,
+        'bodypart_id': [0] * n,
+    }
+    return n, cols
+
+
 @st.composite
 def spadl_frames(draw):
     """A multi-game SPADL frame with adversarial shapes.
@@ -53,36 +77,18 @@ def spadl_frames(draw):
     n_games = draw(st.integers(1, 3))
     frames = []
     for g in range(n_games):
-        n = draw(st.integers(1, 24))
-        type_id = draw(
-            st.lists(st.sampled_from(_TYPES), min_size=n, max_size=n)
+        n, cols = _base_game_columns(draw, g)
+        cols.update(
+            type_id=draw(st.lists(st.sampled_from(_TYPES), min_size=n, max_size=n)),
+            result_id=draw(
+                st.lists(st.sampled_from(_RESULTS), min_size=n, max_size=n)
+            ),
+            start_x=[50.0] * n,
+            start_y=[30.0] * n,
+            end_x=[55.0] * n,
+            end_y=[32.0] * n,
         )
-        result_id = draw(
-            st.lists(st.sampled_from(_RESULTS), min_size=n, max_size=n)
-        )
-        is_home = draw(st.lists(st.booleans(), min_size=n, max_size=n))
-        frames.append(
-            pd.DataFrame(
-                {
-                    'game_id': [100 + g] * n,
-                    'original_event_id': [None] * n,
-                    'period_id': [1] * n,
-                    'action_id': range(n),
-                    # globally unique across games so the round-trip
-                    # property below can detect cross-game swaps
-                    'time_seconds': 1000.0 * g + np.arange(n, dtype=float),
-                    'team_id': [10 if h else 20 for h in is_home],
-                    'player_id': [1] * n,
-                    'start_x': [50.0] * n,
-                    'start_y': [30.0] * n,
-                    'end_x': [55.0] * n,
-                    'end_y': [32.0] * n,
-                    'type_id': type_id,
-                    'result_id': result_id,
-                    'bodypart_id': [0] * n,
-                }
-            )
-        )
+        frames.append(pd.DataFrame(cols))
     return pd.concat(frames, ignore_index=True)
 
 
@@ -124,3 +130,43 @@ def test_pack_unpack_round_trips_any_row_order(frame, data):
         unpack_values(batch.time_seconds, batch),
         shuffled['time_seconds'].to_numpy(dtype=np.float32),
     )
+
+
+@st.composite
+def atomic_frames(draw):
+    """Multi-game Atomic-SPADL frames; goals/owngoals are action TYPES."""
+    from socceraction_tpu.atomic.spadl import config as atomicconfig
+
+    types = [0, 1, atomicconfig.actiontypes.index('shot'),
+             atomicconfig.GOAL, atomicconfig.OWNGOAL]
+    n_games = draw(st.integers(1, 3))
+    frames = []
+    for g in range(n_games):
+        n, cols = _base_game_columns(draw, g)
+        cols.update(
+            type_id=draw(st.lists(st.sampled_from(types), min_size=n, max_size=n)),
+            x=[50.0] * n,
+            y=[30.0] * n,
+            dx=[5.0] * n,
+            dy=[2.0] * n,
+        )
+        frames.append(pd.DataFrame(cols))
+    return pd.concat(frames, ignore_index=True)
+
+
+@given(frame=atomic_frames(), k=st.integers(1, LABEL_LOOKAHEAD))
+@settings(**_SETTINGS)
+def test_atomic_labels_match_pandas_oracle(frame, k):
+    from socceraction_tpu.atomic.vaep import labels as atomiclab
+    from socceraction_tpu.core.batch import pack_atomic_actions
+    from socceraction_tpu.ops import atomic as atomicops
+
+    batch, ids = pack_atomic_actions(frame, home_team_id=10)
+    s, c = atomicops.scores_concedes(batch, nr_actions=k)
+    per_s, per_c = [], []
+    for gid in ids:
+        game = frame[frame['game_id'] == gid].reset_index(drop=True)
+        per_s.append(atomiclab.scores(game, nr_actions=k)['scores'].to_numpy())
+        per_c.append(atomiclab.concedes(game, nr_actions=k)['concedes'].to_numpy())
+    np.testing.assert_array_equal(unpack_values(s, batch), np.concatenate(per_s))
+    np.testing.assert_array_equal(unpack_values(c, batch), np.concatenate(per_c))
