@@ -193,3 +193,41 @@ def test_rate_batch_dispatches_on_profile(spadl_actions, home_team_id, monkeypat
     mat_vals = np.asarray(model.rate_batch(batch))
     assert not calls, 'materialized dispatch still hit the fused kernels'
     np.testing.assert_allclose(fused_vals, mat_vals, atol=1e-5)
+
+
+def test_unreadable_profile_degrades_to_default(monkeypatch, tmp_path):
+    """A wheel built without the data file must degrade to 'fused', not
+    crash VAEP.rate_batch (resolution rule 3)."""
+    monkeypatch.setattr(profile, '_PROFILE_FILE', str(tmp_path / 'missing.json'))
+    profile._cache.clear()
+    try:
+        assert profile.preferred_rating_path('tpu', respect_env=False) == 'fused'
+    finally:
+        profile._cache.clear()
+
+
+def test_hand_edited_profile_is_rejected(monkeypatch, tmp_path):
+    """An opt-in (or garbage) rating_path smuggled into the committed
+    profile raises instead of silently becoming the flagship."""
+    bad = tmp_path / 'profiles.json'
+    bad.write_text(
+        json.dumps({'platforms': {'tpu': {'rating_path': 'fused_bf16'}}})
+    )
+    monkeypatch.setattr(profile, '_PROFILE_FILE', str(bad))
+    profile._cache.clear()
+    try:
+        with pytest.raises(ValueError, match='invalid rating_path'):
+            profile.preferred_rating_path('tpu', respect_env=False)
+    finally:
+        profile._cache.clear()
+
+
+def test_hidden_dtype_mapping():
+    import jax.numpy as jnp
+
+    from socceraction_tpu.ops.profile import hidden_dtype_for
+
+    assert hidden_dtype_for('fused') is None
+    assert hidden_dtype_for('fused_bf16') == jnp.dtype('bfloat16')
+    with pytest.raises(KeyError):
+        hidden_dtype_for('materialized')
