@@ -129,10 +129,14 @@ def _cost_analysis(jitted, args):
 def _roofline(device_kind, dt, flops, bytes_accessed):
     """Achieved vs peak context; which wall (if any) the kernel is near.
 
-    Numbers come from XLA's cost analysis: 'bytes accessed' counts every
-    buffer touch including VMEM-resident reuse, so the memory ratio can
-    legitimately exceed 1.0 — values near/above 1 mean the kernel is
-    memory-traffic dominated, not that HBM physically moved that much.
+    Numbers come from XLA's cost analysis, which is an *upper-bound
+    estimate* of real traffic: 'bytes accessed' counts every buffer touch
+    including fusion-eliminated intermediates and VMEM-resident reuse, so
+    the memory ratio can legitimately exceed 1.0 — i.e. exceed physical
+    HBM peak (the committed r5 artifact reports 2.417). Values near/above
+    1 mean the kernel is memory-traffic dominated under the cost model,
+    NOT that HBM physically moved that much; the classification is
+    labelled ``bound_estimate`` accordingly (see benchmarks/README.md).
     """
     peaks = next(
         (v for prefix, v in _PEAKS.items() if device_kind.startswith(prefix)), None
@@ -147,10 +151,14 @@ def _roofline(device_kind, dt, flops, bytes_accessed):
         mem = bytes_accessed / dt / 1e9 / peaks['hbm_gb_s']
         out['mxu_ratio_vs_peak'] = round(mxu, 3)
         out['mem_ratio_vs_hbm_peak'] = round(mem, 3)  # can exceed 1: see docstring
-        out['bound'] = (
+        out['bound_estimate'] = (
             'memory-traffic' if mem > max(mxu, 0.5)
             else 'mxu' if mxu > 0.5
             else 'neither (gather/VPU/overhead limited)'
+        )
+        out['bound_estimate_basis'] = (
+            'XLA cost model; bytes include fusion-eliminated intermediates, '
+            'so mem ratio is an upper bound and may exceed physical HBM peak'
         )
     return out
 
@@ -425,16 +433,70 @@ def _bench_extra_configs() -> dict:
     return out
 
 
+def _stage_breakdown(timers: dict) -> dict:
+    """Per-stage host timings of one streamed pass, from the registry.
+
+    ``read_io_thread_s``/``decode_thread_s`` are summed across the
+    parallel reader's worker threads, so they can exceed the
+    ``read_s`` wall (that overlap is the point; they are zero on the
+    hdf5 engine, whose serial read is not stage-split). Queue depth is
+    sampled at every consumer take of the prefetch queue: mean near the
+    prefetch bound means the producer ran ahead, but a mean near zero is
+    ambiguous for a consumer that dispatches device work asynchronously
+    (it drains as fast as the producer fills either way) — use
+    ``feed_wait_s``, the consumer's measured block time on the queue,
+    to attribute host-boundedness.
+    """
+
+    def t(name: str) -> float:
+        return round(timers.get(name, {}).get('total_s', 0.0), 2)
+
+    qd = timers.get('pipeline/feed_queue_depth', {})
+    return {
+        'read_s': t('pipeline/read_actions'),
+        'read_io_thread_s': t('pipeline/read_io'),
+        'decode_thread_s': t('pipeline/decode'),
+        'pack_s': t('pipeline/pack'),
+        'transfer_dispatch_s': t('pipeline/transfer'),
+        'cache_write_s': t('pipeline/cache_write'),
+        'read_cache_s': t('pipeline/read_cache'),
+        # time the CONSUMER was blocked on the prefetch queue — the
+        # direct host-bound signal (stage sums overlap device compute on
+        # the worker thread, and queue depth reads ~0 for any consumer
+        # that dispatches asynchronously)
+        'feed_wait_s': t('pipeline/feed_wait'),
+        'queue_depth_mean': round(qd.get('mean_s', 0.0), 2),
+        'queue_depth_max': round(qd.get('max_s', 0.0), 2),
+    }
+
+
 def _bench_cold_path() -> dict:
     """Cold start: season store on disk → stream → pack → rate end-to-end.
 
     The headline metric times device rating on a RESIDENT batch; a user's
-    season starts on disk. This measures ``SeasonStore`` reads +
-    ``iter_batches(prefetch=1)`` host packing overlapped with the flagship
-    rating forward at ~3k-game scale, and attributes host time from the
-    pipeline timer registry so the artifact shows which side of the
-    pipeline bounds the cold rate (on this image's 1-core host it is the
-    read+pack side; the device hides behind it).
+    season starts on disk. Three passes at ~3k-game scale, all through
+    ``iter_batches(prefetch=2)`` (double-buffered read → pack → transfer
+    overlapped with the flagship rating forward):
+
+    1. **store pass** — the uncached stream off the parquet store
+       (thread-pool parallel per-game reads, wire-format transfer);
+    2. **overlapped build pass** — ``packed_cache=True`` on a cold cache:
+       the memmap cache is built as a side effect of the pass;
+    3. **packed steady pass** — the cache-hit shape every epoch ≥ 2
+       takes: memmap slices, no store parse.
+
+    Per-stage host time (read/decode/pack/transfer + queue depth) comes
+    from the pipeline timer registry, and ``host_bound`` flags ≥ 50% of
+    wall spent *actually waiting on the host*: the consumer's measured
+    block time on the prefetch queue (``feed_wait_s``), or the inline
+    stage fraction when no worker runs. The r5 artifact's
+    77.7%-host-read pass now reads ``host_bound: true`` instead of
+    hiding under the old 85% bar, while a device-bound pass whose
+    overlapped worker-thread stage sums merely exceed 50% does not flag
+    — its consumer never waits on the queue.
+
+    ``SOCCERACTION_TPU_BENCH_COLD_ENGINE=hdf5`` reproduces the legacy
+    reference-layout HDF5 store for comparison against pre-r6 artifacts.
     """
     import time as _time
 
@@ -443,11 +505,13 @@ def _bench_cold_path() -> dict:
     from __graft_entry__ import build_forward, example_inputs
     from socceraction_tpu.core.synthetic import write_synthetic_season
     from socceraction_tpu.ops.profile import preferred_rating_path
-    from socceraction_tpu.pipeline import SeasonStore, iter_batches
+    from socceraction_tpu.pipeline import SeasonStore, iter_batches, open_packed
     from socceraction_tpu.utils.profiling import timer_report
 
     cold_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_GAMES', 3072))
     chunk = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_CHUNK', 512))
+    prefetch = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_PREFETCH', 2))
+    engine = os.environ.get('SOCCERACTION_TPU_BENCH_COLD_ENGINE', 'parquet')
     if cold_games < chunk:
         # drop_remainder below would yield zero batches; a partial chunk
         # measures nothing comparable, so shrink the chunk instead
@@ -465,26 +529,40 @@ def _bench_cold_path() -> dict:
         inspect.getsource(_synth._draw_spadl_columns).encode()
         + inspect.getsource(_synth.write_synthetic_season).encode()
     ).hexdigest()[:8]
-    store_path = (
-        f'/tmp/socceraction_tpu_cold_{cold_games}x{n_actions}_{gen_tag}.h5'
-    )
+    import shutil as _shutil
+
+    suffix = '.h5' if engine == 'hdf5' else '.pq'
+    base = f'/tmp/socceraction_tpu_cold_{cold_games}x{n_actions}'
+    store_path = f'{base}_{gen_tag}{suffix}'
     # a generator change re-tags the store; drop same-shape stores with a
-    # stale tag so /tmp holds at most one copy per shape
+    # stale tag so /tmp holds at most one copy per shape AND engine —
+    # current-tag stores of the OTHER engine survive, so the
+    # parquet<->hdf5 A/B flips the env var exists for never rebuild (the
+    # glob also sees packed sidecars and in-progress temp names — both
+    # skipped: sidecars die with their store, temp files belong to a
+    # possibly-live builder)
     import glob
 
-    for old in glob.glob(f'/tmp/socceraction_tpu_cold_{cold_games}x{n_actions}_*.h5'):
-        if old != store_path and '.building.' not in old:
-            # never touch another builder's in-progress temp file
-            try:
-                os.unlink(old)
-            except OSError:
-                pass
-            # a retired store's packed sidecars (~190 MB each) go with it
-            import shutil as _shutil
-
-            for side in glob.glob(f'{old}.packed-*'):
-                _shutil.rmtree(side, ignore_errors=True)
-    out = {'games': cold_games, 'games_per_batch': chunk, 'prefetch': 1}
+    for old in glob.glob(f'{base}_*'):
+        if (
+            old.startswith(f'{base}_{gen_tag}')
+            or '.building.' in old
+            or '.packed-' in old
+        ):
+            continue
+        try:
+            _shutil.rmtree(old) if os.path.isdir(old) else os.unlink(old)
+        except OSError:
+            pass
+        # a retired store's packed sidecars (~190 MB each) go with it
+        for side in glob.glob(f'{old}.packed-*'):
+            _shutil.rmtree(side, ignore_errors=True)
+    out = {
+        'games': cold_games,
+        'games_per_batch': chunk,
+        'prefetch': prefetch,
+        'engine': engine,
+    }
     if os.path.exists(store_path):
         # deterministic content (fixed seed): safe to reuse across runs,
         # so repeat benches measure the pipeline, not the one-time build
@@ -493,40 +571,43 @@ def _bench_cold_path() -> dict:
         t0 = _time.perf_counter()
         # build under a tmp name + atomic rename: an abandoned/killed child
         # (this harness abandons overrunning children by design) must never
-        # leave a partial store that later runs would trust as 'cached'
-        # keep the .h5 suffix so SeasonStore's engine inference still
-        # picks hdf5 for the temporary name
-        tmp_path = store_path.replace('.h5', f'.building.{os.getpid()}.h5')
+        # leave a partial store that later runs would trust as 'cached'.
+        # The temp name keeps the engine suffix LAST so SeasonStore's
+        # inference picks the same engine for the temporary name.
+        tmp_path = f'{base}_{gen_tag}.building.{os.getpid()}{suffix}'
         try:
             write_synthetic_season(tmp_path, cold_games, n_actions)
             os.replace(tmp_path, store_path)
         finally:
-            if os.path.exists(tmp_path):
+            if os.path.isdir(tmp_path):
+                _shutil.rmtree(tmp_path, ignore_errors=True)
+            elif os.path.exists(tmp_path):
                 os.unlink(tmp_path)
         out['store'] = 'built'
         out['store_build_s'] = round(_time.perf_counter() - t0, 1)
+
+    # the overlapped-build pass below must measure a real cold build
+    for side in glob.glob(f'{store_path}.packed-*'):
+        if '.building.' not in side:
+            _shutil.rmtree(side, ignore_errors=True)
 
     rating_path = preferred_rating_path(respect_env=False)
     params, _ = example_inputs()
     forward = jax.jit(build_forward(rating_path))
     out['rating_path'] = rating_path
 
-    with SeasonStore(store_path, mode='r') as store:
-        # warm the one compile OUTSIDE both timed passes: otherwise the
-        # store pass carries it and the packed pass doesn't, inflating
-        # the reported cache speedup by the compile time
-        for warm, _ids in iter_batches(
-            store, chunk, max_actions=1664, drop_remainder=True
-        ):
-            jax.block_until_ready(forward(params, warm))
-            break
+    import jax.numpy as jnp
+
+    def rated_pass(store, **kw):
+        """One streamed pass: returns (actions, wall_s, first_batch_s, stages)."""
         timer_report(reset=True)
         counts = []
         last = None
         t_first = None
         t_start = _time.perf_counter()
         for batch, _ids in iter_batches(
-            store, chunk, max_actions=1664, prefetch=1, drop_remainder=True
+            store, chunk, max_actions=1664, prefetch=prefetch,
+            drop_remainder=True, **kw,
         ):
             last = forward(params, batch)
             counts.append(batch.mask.sum())
@@ -535,64 +616,80 @@ def _bench_cold_path() -> dict:
         # one sync at the end, and ONE device→host fetch for the total:
         # per-chunk fetches would serialize the stream against the
         # device, and over a tunnel each scalar fetch pays round-trip
-        # latency, which would land in the measured wall time
-        import jax.numpy as jnp
-
-        actions = int(jnp.stack(counts).sum())
-        jax.block_until_ready(last)
+        # latency, which would land in the measured wall time.
+        # A store with fewer than `chunk` games yields no batches under
+        # drop_remainder: degrade to 0 actions, never a stack of nothing.
+        actions = int(jnp.stack(counts).sum()) if counts else 0
+        if last is not None:
+            jax.block_until_ready(last)
         wall = _time.perf_counter() - t_start
-    timers = timer_report()
-    read_s = timers.get('pipeline/read_actions', {}).get('total_s', 0.0)
-    pack_s = timers.get('pipeline/pack', {}).get('total_s', 0.0)
-    out.update(
-        actions=actions,
-        wall_s=round(wall, 2),
-        actions_per_sec=round(actions / wall, 1),
-        first_batch_s=round(t_first, 2),  # includes the one jit compile
-        host_read_s=round(read_s, 2),
-        host_pack_s=round(pack_s, 2),
-        host_bound=bool(read_s + pack_s >= 0.85 * wall),
-    )
+        return actions, wall, t_first, _stage_breakdown(timer_report())
 
-    # the packed-season cache answer to the host-read bound: one build
-    # pass, then every later season pass slices memmaps (the shape real
-    # training takes — epoch 2..N never re-parse the store)
     with SeasonStore(store_path, mode='r') as store:
-        t0 = _time.perf_counter()
-        from socceraction_tpu.pipeline.packed import ensure_packed
-
-        season = ensure_packed(store, max_actions=1664)
-        build_s = _time.perf_counter() - t0
-        # warm the jitted device-side unpack (packed.py:_device_unpack)
-        # OUTSIDE the timed pass, exactly like the forward warm-up above:
-        # the store pass carries no such compile, so leaving it in would
-        # deflate the reported cache speedup
-        warm, _ids = season.take(store.game_ids()[:chunk])
-        jax.block_until_ready(forward(params, warm))
-        timer_report(reset=True)
-        counts = []
-        last = None
-        t_start = _time.perf_counter()
-        for batch, _ids in iter_batches(
-            store, chunk, max_actions=1664, prefetch=1, drop_remainder=True,
-            packed_cache=True,
+        # warm the compiles (forward + the wire-format device unpack)
+        # OUTSIDE every timed pass: otherwise the first pass carries them
+        # and the later ones don't, skewing every speedup ratio
+        for warm, _ids in iter_batches(
+            store, chunk, max_actions=1664, drop_remainder=True
         ):
-            last = forward(params, batch)
-            counts.append(batch.mask.sum())
-        actions2 = int(jnp.stack(counts).sum())
-        jax.block_until_ready(last)
-        wall2 = _time.perf_counter() - t_start
-    timers = timer_report()
-    out['packed_pass'] = {
-        'cache_build_s': round(build_s, 2),
-        'actions': actions2,
-        'wall_s': round(wall2, 2),
-        'actions_per_sec': round(actions2 / wall2, 1),
-        'host_read_s': round(
-            timers.get('pipeline/read_cache', {}).get('total_s', 0.0), 2
-        ),
-        'speedup_vs_store_pass': round(wall / wall2, 1),
-    }
+            jax.block_until_ready(forward(params, warm))
+            break
+
+        # --- pass 1: uncached store stream (the acceptance-gate number) --
+        actions, wall, t_first, stages = rated_pass(store)
+        host_s = stages['read_s'] + stages['pack_s']
+        host_fraction = host_s / wall if wall else 0.0
+        # host_bound flags at ≥50% (the old ≥85% bar let a 77.7%-host-
+        # read pass report false) of DIRECT waiting evidence: with a
+        # prefetch worker the read/pack sums overlap device compute, so
+        # feed_wait_s — the time this consumer actually blocked on the
+        # queue — is the honest signal; without a worker the inline
+        # stage fraction IS the wait.
+        waited = stages['feed_wait_s'] if prefetch > 0 else host_s
+        wait_fraction = waited / wall if wall else 0.0
+        out.update(
+            actions=actions,
+            wall_s=round(wall, 2),
+            actions_per_sec=round(actions / wall, 1),
+            first_batch_s=round(t_first, 2) if t_first is not None else None,
+            stages=stages,
+            # legacy aliases kept for artifact comparability (r1-r5)
+            host_read_s=stages['read_s'],
+            host_pack_s=stages['pack_s'],
+            host_fraction=round(host_fraction, 3),
+            host_wait_fraction=round(wait_fraction, 3),
+            host_bound=bool(wait_fraction >= 0.5),
+        )
+
+        # --- pass 2: cold cache, built OVERLAPPED with the stream --------
+        actions_b, wall_b, t_first_b, stages_b = rated_pass(
+            store, packed_cache=True
+        )
+        out['overlapped_build_pass'] = {
+            'actions': actions_b,
+            'wall_s': round(wall_b, 2),
+            'actions_per_sec': round(actions_b / wall_b, 1),
+            'first_batch_s': (
+                round(t_first_b, 2) if t_first_b is not None else None
+            ),
+            'stages': stages_b,
+            'cache_published': bool(
+                open_packed(store, max_actions=1664) is not None
+            ),
+        }
+
+        # --- pass 3: packed steady state (epoch ≥ 2's shape) -------------
+        actions2, wall2, _t_first2, stages2 = rated_pass(
+            store, packed_cache=True
+        )
+        out['packed_pass'] = {
+            'actions': actions2,
+            'wall_s': round(wall2, 2),
+            'actions_per_sec': round(actions2 / wall2, 1),
+            'stages': stages2,
+            'host_read_s': stages2['read_cache_s'],
+            'speedup_vs_store_pass': round(wall / wall2, 1) if wall2 else None,
+        }
     return out
 
 

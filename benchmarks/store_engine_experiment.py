@@ -22,10 +22,14 @@ parquet    0.55 s        745,156         24 MB
 Conclusion: the parquet engine (pyarrow, the SeasonStore default for
 non-``.h5`` paths) reads ~1.75x faster per game and halves the disk
 footprint; on a cold disk the 2x-smaller footprint compounds the gap.
-The bench's cold-path store stays HDF5 deliberately — it reproduces the
-reference's store layout (`tests/datasets/download.py` writes HDF5), so
-the committed cold numbers stay comparable to what a migrating user
-starts from — but a new deployment should prefer a parquet store path.
+This measurement is what promoted parquet to the bench cold path's
+measured default (PR 6): ``bench.py`` now builds its cold store as
+parquet and streams it through the thread-pool parallel reader
+(``SeasonStore.get_many``), with ``SOCCERACTION_TPU_BENCH_COLD_ENGINE=hdf5``
+as the escape hatch that reproduces the reference HDF5 layout
+(`tests/datasets/download.py` writes HDF5) for comparison against the
+r1-r5 artifacts. This script times one serial ``get`` per game — the
+engine floor, not the parallel reader.
 
 Usage::
 

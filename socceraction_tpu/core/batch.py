@@ -148,12 +148,20 @@ def _pack_frame(
     float_cols,
     int_cols,
     make_batch,
+    as_numpy=False,
 ):
     """Shared packing core: group by game, left-align, pad, build the batch.
 
     ``make_batch`` is the batch dataclass constructor, called with one
     keyword per packed column (``float_cols`` + ``int_cols``) plus
     ``is_home``, ``mask``, ``n_actions``, ``game_id`` and ``row_index``.
+
+    ``as_numpy=True`` keeps every field a host numpy array (a staging
+    batch): no implicit host→device copy happens inside the pack, so a
+    streaming feed can overlap the explicit transfer of chunk N+1 with
+    device compute on chunk N (``pipeline/feed.py``), and the packed-cache
+    builder can write columns straight into its memmaps without a device
+    round trip. Mutually exclusive with ``device``.
     """
     if 'game_id' not in actions.columns:
         raise ValueError('actions frame must contain a game_id column')
@@ -215,6 +223,18 @@ def _pack_frame(
         np.arange(len(actions), dtype=np.int32), np.int32, -1
     )
 
+    if as_numpy:
+        if device is not None:
+            raise ValueError('as_numpy and device are mutually exclusive')
+        return make_batch(
+            **cols,
+            is_home=is_home,
+            mask=mask,
+            n_actions=n_actions,
+            game_id=np.arange(n_games, dtype=np.int32),
+            row_index=row_index,
+        ), game_ids
+
     jcols = {c: jnp.asarray(v) for c, v in cols.items()}
     batch = make_batch(
         **jcols,
@@ -237,6 +257,7 @@ def pack_actions(
     max_actions: Optional[int] = None,
     float_dtype: Any = np.float32,
     device: Optional[Any] = None,
+    as_numpy: bool = False,
 ) -> Tuple[ActionBatch, List[Any]]:
     """Pack a SPADL DataFrame (one or many games) into an :class:`ActionBatch`.
 
@@ -258,6 +279,10 @@ def pack_actions(
         dtype of continuous fields (float32 on TPU, float64 for parity runs).
     device : optional
         If given, ``jax.device_put`` the batch onto this device/sharding.
+    as_numpy : bool
+        Return a host staging batch (every field a numpy array, no device
+        copy) for callers that transfer explicitly or write to memmaps;
+        mutually exclusive with ``device``.
 
     Returns
     -------
@@ -266,7 +291,7 @@ def pack_actions(
     """
     return _pack_frame(
         actions, home_team_ids, home_team_id, max_actions, float_dtype, device,
-        _FLOAT_COLS, _INT_COLS, ActionBatch,
+        _FLOAT_COLS, _INT_COLS, ActionBatch, as_numpy,
     )
 
 
@@ -278,6 +303,7 @@ def pack_atomic_actions(
     max_actions: Optional[int] = None,
     float_dtype: Any = np.float32,
     device: Optional[Any] = None,
+    as_numpy: bool = False,
 ) -> Tuple[AtomicActionBatch, List[Any]]:
     """Pack an Atomic-SPADL DataFrame into an :class:`AtomicActionBatch`.
 
@@ -286,7 +312,7 @@ def pack_atomic_actions(
     """
     return _pack_frame(
         actions, home_team_ids, home_team_id, max_actions, float_dtype, device,
-        _ATOMIC_FLOAT_COLS, _ATOMIC_INT_COLS, AtomicActionBatch,
+        _ATOMIC_FLOAT_COLS, _ATOMIC_INT_COLS, AtomicActionBatch, as_numpy,
     )
 
 

@@ -9,21 +9,21 @@ This package makes that convention first-class:
 
 - :class:`SeasonStore` -- a keyed DataFrame store with the reference's key
   layout and two engines: Parquet (default; Arrow is the host<->device
-  interchange format of the TPU runtime) and HDF5 via h5py.
+  interchange format of the TPU runtime, and per-game files fetch/decode
+  concurrently through :meth:`SeasonStore.get_many`) and HDF5 via h5py
+  for read-compat with reference-written stores.
 - :func:`build_spadl_store` -- loader + converter -> store, the library
   equivalent of the reference download pipeline.
 - :func:`load_batch` / :func:`iter_batches` -- read stored games into
   packed :class:`~socceraction_tpu.core.ActionBatch` bundles, including a
-  streaming iterator for feeding seasons through HBM in fixed-size chunks.
-- :func:`ensure_packed` / :class:`PackedSeason` -- the packed-season
-  memmap cache that removes the store parse from every pass but the
-  first (``iter_batches(..., packed_cache=True)``).
+  double-buffered streaming iterator (staged read -> pack -> transfer,
+  ``prefetch``-deep) for feeding seasons through HBM in fixed-size chunks.
+- :func:`ensure_packed` / :func:`open_packed` / :class:`PackedSeason` --
+  the packed-season memmap cache that removes the store parse from every
+  pass but the first (``iter_batches(..., packed_cache=True)``).
+- :func:`iter_packed_build` -- first-pass streaming that builds that
+  cache *overlapped* with the epoch instead of as an up-front pass.
 """
-
-from socceraction_tpu.pipeline.build import build_spadl_store
-from socceraction_tpu.pipeline.feed import iter_batches, load_batch
-from socceraction_tpu.pipeline.packed import PackedSeason, ensure_packed
-from socceraction_tpu.pipeline.store import SeasonStore
 
 __all__ = [
     'PackedSeason',
@@ -31,5 +31,42 @@ __all__ = [
     'build_spadl_store',
     'ensure_packed',
     'iter_batches',
+    'iter_packed_build',
     'load_batch',
+    'open_packed',
 ]
+
+#: symbol -> defining submodule, resolved lazily (PEP 562, mirroring
+#: socceraction_tpu.utils): `packed` imports the jax-backed core, and a
+#: jax-free data-prep process reading a store through SeasonStore /
+#: get_many must not pay — or depend on — a jax import just for the
+#: package import
+_EXPORTS = {
+    'PackedSeason': 'packed',
+    'SeasonStore': 'store',
+    'build_spadl_store': 'build',
+    'ensure_packed': 'packed',
+    'iter_batches': 'feed',
+    'iter_packed_build': 'build',
+    'load_batch': 'feed',
+    'open_packed': 'packed',
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        module = importlib.import_module(
+            f'socceraction_tpu.pipeline.{_EXPORTS[name]}'
+        )
+        value = getattr(module, name)
+        globals()[name] = value  # cache: __getattr__ runs at most once
+        return value
+    raise AttributeError(
+        f'module {__name__!r} has no attribute {name!r}'
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,15 +1,25 @@
-"""Build a :class:`SeasonStore` from a provider loader.
+"""Build season artifacts: provider loader → store, and store → packed cache.
 
-Library equivalent of the reference download pipeline
-(``tests/datasets/download.py:63-125``): iterate the requested
-competition/season pairs, convert each game's events to (Atomic-)SPADL and
-write the per-game frames plus the metadata and vocabulary tables.
+:func:`build_spadl_store` is the library equivalent of the reference
+download pipeline (``tests/datasets/download.py:63-125``): iterate the
+requested competition/season pairs, convert each game's events to
+(Atomic-)SPADL and write the per-game frames plus the metadata and
+vocabulary tables.
+
+:func:`iter_packed_build` is the *overlapped* builder of the packed-season
+memmap cache (:mod:`socceraction_tpu.pipeline.packed`): instead of a
+separate build pass before any device work starts, it streams the season
+chunk by chunk, ships each chunk to the device **and** writes the same
+column data into the cache memmaps as it goes, publishing the cache when
+the pass completes — so the first epoch pays for the cache instead of
+waiting on it, and first-batch latency is one chunk's read+pack, not
+cache-build-plus-read.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any, Callable, Iterable, Optional, Tuple
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import pandas as pd
 
@@ -18,7 +28,7 @@ from socceraction_tpu.utils import timed
 
 logger = logging.getLogger(__name__)
 
-__all__ = ['build_spadl_store']
+__all__ = ['build_spadl_store', 'iter_packed_build']
 
 
 def build_spadl_store(
@@ -126,6 +136,123 @@ def build_spadl_store(
         players = pd.concat(all_players, ignore_index=True)
         store.put('players', players.reset_index(drop=True))
     return store
+
+
+def iter_packed_build(
+    store: SeasonStore,
+    games_per_batch: int,
+    *,
+    max_actions: int,
+    float_dtype: Any = 'float32',
+    device: Optional[Any] = None,
+    drop_remainder: bool = False,
+    family: str = 'standard',
+    cache_dir: Optional[str] = None,
+) -> Iterator[Tuple[Any, List[Any]]]:
+    """Stream the whole store in chunks while building its packed cache.
+
+    Always covers the store's full ``game_ids()`` listing, in store
+    order — the cache addresses rows positionally in that order, so a
+    subset or reordered build would poison every later cache hit. Use
+    plain ``iter_batches`` for partial streams.
+
+    Yields exactly what ``iter_batches(store, games_per_batch, ...)``
+    yields for the full season (same chunking, same bit-identical
+    batches), but every chunk's packed columns are also written into a
+    :class:`~socceraction_tpu.pipeline.packed.PackedSeasonWriter` memmap
+    as a side effect, and the cache is published atomically when the
+    stream completes — the serial ``pipeline/pack_cache_build`` pass
+    disappears into the first epoch.
+
+    A ``drop_remainder`` tail is still packed and written (the cache
+    must cover every game) — it is just never yielded, and it is written
+    *before* the final full chunk's yield so stopping at the last batch
+    leaves the build complete. If the consumer
+    closes the stream early, an *incomplete* build is discarded (no
+    cache is published): completing it at close time could stall the
+    close by a near-full store pass, and an interrupted build must never
+    be mistaken for a cache. A build whose every chunk was already
+    written when the close lands (e.g. ``islice``/``break`` on the final
+    batch) IS published — finalizing there is just a flush and an atomic
+    rename, and the consumer already paid the full build cost.
+
+    Per-stage host costs land in the shared timer registry under the
+    same names as the plain streaming path (``pipeline/read_actions`` /
+    ``pipeline/pack`` / ``pipeline/transfer``) plus
+    ``pipeline/cache_write`` for the memmap stores.
+    """
+    from socceraction_tpu.pipeline.packed import (
+        FAMILIES,
+        PackedSeasonWriter,
+        _read_and_pack_chunk,
+        ship_host_batch,
+    )
+
+    fam = FAMILIES[family]
+    writer = PackedSeasonWriter(
+        store,
+        max_actions=max_actions,
+        float_dtype=float_dtype,
+        cache_dir=cache_dir,
+        family=family,
+    )
+    game_ids: Sequence[Any] = writer.game_ids
+    published = False
+    finalize_started = False
+    def _write_span(lo: int) -> Tuple[Any, List[Any]]:
+        chunk = list(game_ids[lo : lo + games_per_batch])
+        host = _read_and_pack_chunk(
+            store, fam, chunk, writer.home,
+            max_actions=max_actions, float_dtype=float_dtype,
+        )
+        with timed('pipeline/cache_write'):
+            writer.write_chunk(lo, host)
+        return host, chunk
+
+    spans = list(range(0, len(game_ids), games_per_batch))
+    # under drop_remainder the short tail is cached but never yielded;
+    # peel it off and write it BEFORE the last yield, so a consumer that
+    # stops at the final batch (islice/break) still leaves the build
+    # complete and the close path can publish
+    tail = None
+    if (
+        drop_remainder
+        and spans
+        and len(game_ids) - spans[-1] < games_per_batch
+    ):
+        tail = spans.pop()
+    try:
+        if tail is not None and not spans:
+            _write_span(tail)  # every chunk is short: cache-only pass
+        for i, lo in enumerate(spans):
+            host, chunk = _write_span(lo)
+            if tail is not None and i == len(spans) - 1:
+                _write_span(tail)
+            yield ship_host_batch(host, family=family, device=device), chunk
+        finalize_started = True
+        writer.finalize()
+        published = True
+    finally:
+        if not published:
+            # finalize_started: the main-body publish itself failed (and
+            # already cleaned up via its own finally) — re-attempting
+            # against the deleted temp dir would mask the original error
+            if writer.complete and not finalize_started:
+                # the consumer closed after the last batch was produced
+                # (islice / break on the final chunk): every row is
+                # already in the memmaps, so publishing costs one flush
+                # + rename — never throw a fully-paid build away.
+                # Best-effort: a failed publish degrades to no cache.
+                try:
+                    writer.finalize()
+                except Exception:
+                    logger.warning(
+                        'packed cache publish at close failed; discarding',
+                        exc_info=True,
+                    )
+                    writer.abort()
+            else:
+                writer.abort()
 
 
 def _default_converter(loader: Any) -> Callable[[pd.DataFrame, Any], pd.DataFrame]:

@@ -1,23 +1,40 @@
 """Feed stored seasons to the device as packed :class:`~socceraction_tpu.core.ActionBatch` chunks.
 
-The streaming path (:func:`iter_batches`) reads the next chunk's parquet/
-hdf5 frames and packs them on the host while the device works on the
-current chunk. With ``prefetch=0`` the overlap comes from JAX's
-asynchronous dispatch alone (the consumer must return promptly); with
-``prefetch > 0`` a background worker thread reads/packs ahead through a
-bounded queue, so the overlap also holds when the consumer blocks on
-device results. The worker is cancelled (stop event + queue drain) when
-the consumer closes the generator early.
+The streaming path (:func:`iter_batches`) is a staged, double-bufferable
+device feed:
+
+1. **read** — the next chunk's per-game files are fetched and decoded
+   concurrently through :meth:`SeasonStore.get_many` (thread-pool fan-out
+   on the parquet engine; ``pipeline/read_actions`` wall +
+   ``pipeline/read_io``/``pipeline/decode`` per-file stage timers);
+2. **pack** — the frames are packed into a host *staging* batch
+   (``as_numpy=True`` — no implicit device copy; ``pipeline/pack``);
+3. **transfer** — the staging batch is shipped over the minimal wire
+   format (stacked floats, int8-narrowed ids, flags, lengths) with
+   ``jax.device_put`` and rebuilt by a jitted device-side unpack
+   (:func:`~socceraction_tpu.pipeline.packed.ship_host_batch`;
+   ``pipeline/transfer``).
+
+With ``prefetch=0`` the overlap comes from JAX's asynchronous dispatch
+alone (the consumer must return promptly); with ``prefetch > 0`` a
+background worker thread runs all three stages ahead through a bounded
+queue, so the transfer of batch N+1 overlaps device compute on batch N
+even when the consumer blocks on device results — genuine double
+buffering at ``prefetch=2``. The queue depth observed at every consumer
+take is recorded under ``pipeline/feed_queue_depth``, and the time the
+consumer spends *blocked* on the queue under ``pipeline/feed_wait`` —
+the direct measure of a host-bound feed (a large wait fraction means the
+host could not keep the device fed; depth alone is ambiguous for
+consumers that dispatch asynchronously). The worker is cancelled (stop
+event + queue drain) when the consumer closes the generator early.
 """
 
 from __future__ import annotations
 
 from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
-import pandas as pd
-
 from socceraction_tpu.pipeline.store import SeasonStore
-from socceraction_tpu.utils import timed
+from socceraction_tpu.utils import record_value, timed
 
 __all__ = ['load_batch', 'iter_batches']
 
@@ -37,25 +54,26 @@ def load_batch(
     :class:`ActionBatch`; ``family='atomic'`` reads the
     ``atomic_actions/game_<id>`` keys ``build_spadl_store(atomic=True)``
     writes into an :class:`~socceraction_tpu.core.AtomicActionBatch`.
+    The per-game frames are fetched with the parallel multi-game reader
+    (:meth:`SeasonStore.get_many`) and shipped over the same minimal
+    wire format as the streaming path
+    (:func:`~socceraction_tpu.pipeline.packed.ship_host_batch`).
     """
-    from socceraction_tpu.pipeline.packed import FAMILIES
+    from socceraction_tpu.pipeline.packed import (
+        FAMILIES,
+        _read_and_pack_chunk,
+        ship_host_batch,
+    )
 
     fam = FAMILIES[family]
     if game_ids is None:
         game_ids = store.game_ids()
-    home = store.home_team_ids()
-    read = getattr(store, fam.reader)
-    with timed('pipeline/read_actions'):
-        frames = [read(gid) for gid in game_ids]
-        actions = pd.concat(frames, ignore_index=True)
-    with timed('pipeline/pack'):
-        return fam.packer(
-            actions,
-            {gid: home[gid] for gid in game_ids},
-            max_actions=max_actions,
-            float_dtype=float_dtype,
-            device=device,
-        )
+    game_ids = list(game_ids)
+    host = _read_and_pack_chunk(
+        store, fam, game_ids, store.home_team_ids(),
+        max_actions=max_actions, float_dtype=float_dtype,
+    )
+    return ship_host_batch(host, family=family, device=device), game_ids
 
 
 def iter_batches(
@@ -78,35 +96,52 @@ def iter_batches(
     compiles exactly once; ``drop_remainder`` skips the final short chunk
     to keep the game axis static too.
 
-    ``prefetch > 0`` reads and packs up to that many chunks ahead on a
-    background thread (bounded queue): host IO/packing then overlaps the
-    consumer even when it *blocks* on device results — JAX's async
-    dispatch alone only overlaps while the consumer returns promptly.
-    ``prefetch=2`` is classic double buffering into HBM (SURVEY §7's
-    streaming loader).
+    ``prefetch > 0`` runs the read → pack → transfer stages up to that
+    many chunks ahead on a background thread (bounded queue): host
+    IO/packing *and* the host→device transfer then overlap the consumer
+    even when it blocks on device results — JAX's async dispatch alone
+    only overlaps while the consumer returns promptly. ``prefetch=2`` is
+    classic double buffering into HBM (SURVEY §7's streaming loader).
+    ``prefetch=0`` is the synchronous fallback: same batches, same
+    order, no worker thread.
 
     ``packed_cache`` (False | True | path) serves chunks from the
     season's packed memmap cache (:mod:`socceraction_tpu.pipeline.packed`)
-    instead of re-parsing the store: the first use builds the cache with
-    one store pass (timed ``pipeline/pack_cache_build``), every later
-    pass slices memmaps (timed ``pipeline/read_cache``) — the fix for the
-    host-read-bound cold path measured in ``BENCH_builder_r05.json``.
-    Requires ``max_actions``; batches are bit-identical to the uncached
-    path.
+    instead of re-parsing the store. A cache hit slices memmaps (timed
+    ``pipeline/read_cache``). On a miss, a full-season stream (the
+    default ``game_ids``) builds the cache *overlapped* with this first
+    pass (:func:`~socceraction_tpu.pipeline.build.iter_packed_build`):
+    batches flow immediately and the cache publishes when the pass
+    completes, so the serial build pass disappears into epoch one. A
+    subset/reordered stream falls back to the serial
+    :func:`~socceraction_tpu.pipeline.packed.ensure_packed` build
+    (timed ``pipeline/pack_cache_build``). Requires ``max_actions``;
+    batches are bit-identical to the uncached path either way.
 
     ``family`` selects the SPADL family exactly as in :func:`load_batch`;
     the packed cache is per-family.
     """
-    from socceraction_tpu.pipeline.packed import FAMILIES
+    from socceraction_tpu.pipeline.packed import (
+        FAMILIES,
+        _read_and_pack_chunk,
+        ensure_packed,
+        open_packed,
+        ship_host_batch,
+    )
 
     fam = FAMILIES[family]
-    if game_ids is None:
-        game_ids = store.game_ids()
+    # the default game_ids is the store's full listing — a directory
+    # scan on the parquet engine, so it is deferred until a branch
+    # actually consumes it: the overlapped build lists exactly once
+    # (inside its writer, which addresses cache rows by that order) and
+    # the full-season check short-circuits on the default
+    full_season = game_ids is None
 
+    season = None
+    overlapped = None
     if packed_cache:
         if max_actions is None:
             raise ValueError('packed_cache requires max_actions')
-        from socceraction_tpu.pipeline.packed import ensure_packed
 
         import os as _os
 
@@ -115,43 +150,67 @@ def iter_batches(
             if isinstance(packed_cache, (str, _os.PathLike))
             else None
         )
-        season = ensure_packed(
+        season = open_packed(
             store,
             max_actions=max_actions,
             float_dtype=float_dtype,
             cache_dir=cache_dir,
             family=family,
         )
-    else:
-        season = None
-        home = store.home_team_ids()
+        if season is None:
+            if full_season or list(game_ids) == store.game_ids():
+                from socceraction_tpu.pipeline.build import iter_packed_build
+
+                overlapped = iter_packed_build(
+                    store,
+                    games_per_batch,
+                    max_actions=max_actions,
+                    float_dtype=float_dtype,
+                    device=device,
+                    drop_remainder=drop_remainder,
+                    family=family,
+                    cache_dir=cache_dir,
+                )
+            else:
+                season = ensure_packed(
+                    store,
+                    max_actions=max_actions,
+                    float_dtype=float_dtype,
+                    cache_dir=cache_dir,
+                    family=family,
+                )
+    if full_season and overlapped is None:
+        # a cache hit already carries the validated full listing (in the
+        # cache's own positional row order) — only the uncached stream
+        # needs a fresh directory scan
+        game_ids = (
+            list(season.game_ids) if season is not None else store.game_ids()
+        )
+    home = (
+        store.home_team_ids() if season is None and overlapped is None else None
+    )
 
     def produce() -> Iterator[Tuple[Any, List[Any]]]:
+        if overlapped is not None:
+            yield from overlapped
+            return
         for lo in range(0, len(game_ids), games_per_batch):
             chunk = list(game_ids[lo : lo + games_per_batch])
             if drop_remainder and len(chunk) < games_per_batch:
                 return
             if season is not None:
-                with timed('pipeline/read_cache'):
-                    item = season.take(chunk, device=device)
-                yield item
+                # take() times its own read_cache / transfer stages
+                yield season.take(chunk, device=device)
                 continue
-            with timed('pipeline/read_actions'):
-                read = getattr(store, fam.reader)
-                actions = pd.concat(
-                    [read(gid) for gid in chunk], ignore_index=True
-                )
-            with timed('pipeline/pack'):
-                item = fam.packer(
-                    actions,
-                    {gid: home[gid] for gid in chunk},
-                    max_actions=max_actions,
-                    float_dtype=float_dtype,
-                    device=device,
-                )
-            # yield OUTSIDE the timer: with prefetch the generator suspends
-            # here on the queue put / consumer, which would otherwise be
-            # charged to 'pipeline/pack' and invert bottleneck attribution
+            host = _read_and_pack_chunk(
+                store, fam, chunk, home,
+                max_actions=max_actions, float_dtype=float_dtype,
+            )
+            item = (ship_host_batch(host, family=family, device=device), chunk)
+            # yield OUTSIDE the timers: with prefetch the generator
+            # suspends here on the queue put / consumer, which would
+            # otherwise be charged to a stage and invert bottleneck
+            # attribution
             yield item
 
     if prefetch <= 0:
@@ -177,19 +236,44 @@ def iter_batches(
         return False
 
     def worker() -> None:
+        src = produce()
         try:
-            for item in produce():
-                if not _put(item):
+            for item in src:
+                # re-check stop AFTER a successful put: the consumer's
+                # close-time queue drain can free a slot and wake a
+                # blocked put, and advancing the source past its last
+                # item would then complete (and publish) an overlapped
+                # build the consumer just abandoned
+                if not _put(item) or stop.is_set():
                     return  # consumer closed the generator early
         except BaseException as e:  # re-raised on the consumer thread
             failure.append(e)
         finally:
-            _put(_END)
+            # close the source generator HERE, on the worker thread: for
+            # the overlapped build this deterministically discards the
+            # partial cache (or publishes a complete one) instead of
+            # leaving it to GC finalization. The END sentinel must go
+            # out even if close itself fails — a swallowed close error
+            # with no sentinel would hang the consumer on q.get()
+            try:
+                src.close()
+            except BaseException as e:
+                if not failure:
+                    failure.append(e)
+            finally:
+                _put(_END)
 
     threading.Thread(target=worker, daemon=True, name='iter_batches').start()
     try:
         while True:
-            item = q.get()
+            record_value('pipeline/feed_queue_depth', q.qsize())
+            # feed_wait accumulates the time the CONSUMER was blocked on
+            # the queue — the direct measure of a host-bound feed, robust
+            # where stage sums (which overlap device compute on the
+            # worker) and the depth gauge (near zero for any consumer
+            # that dispatches asynchronously) both mislead
+            with timed('pipeline/feed_wait'):
+                item = q.get()
             if item is _END:
                 if failure:
                     raise failure[0]

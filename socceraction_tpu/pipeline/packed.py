@@ -39,6 +39,7 @@ be mistaken for a cache.
 from __future__ import annotations
 
 import functools
+import itertools
 import json
 import os
 import shutil
@@ -55,7 +56,15 @@ from socceraction_tpu.core import (
 from socceraction_tpu.pipeline.store import SeasonStore
 from socceraction_tpu.utils import timed
 
-__all__ = ['FAMILIES', 'PackedSeason', 'ensure_packed', 'packed_cache_dir']
+__all__ = [
+    'FAMILIES',
+    'PackedSeason',
+    'PackedSeasonWriter',
+    'ensure_packed',
+    'open_packed',
+    'packed_cache_dir',
+    'ship_host_batch',
+]
 
 _VERSION = 1
 
@@ -63,7 +72,9 @@ _VERSION = 1
 class _Family:
     """Column layout + packing recipe of one action family."""
 
-    def __init__(self, name, float_cols, int_cols, batch_cls, packer, reader):
+    def __init__(
+        self, name, float_cols, int_cols, batch_cls, packer, key_prefix
+    ):
         self.name = name
         self.float_cols = float_cols
         self.int_cols = int_cols
@@ -71,7 +82,16 @@ class _Family:
         self.all_cols = float_cols + int_cols + self.bool_cols
         self.batch_cls = batch_cls
         self.packer = packer
-        self.reader = reader  # SeasonStore method name for one game's frame
+        self.key_prefix = key_prefix  # store key group of the per-game frames
+        #: the columns the packer actually touches — streamed reads
+        #: project to these so the engines never decode the rest
+        #: (player ids, event ids, ...): game grouping, the is_home
+        #: source, then the packed columns themselves
+        self.read_columns = ('game_id', 'team_id') + float_cols + int_cols
+
+    def game_keys(self, game_ids: Sequence[Any]) -> List[str]:
+        """Store keys of these games' frames, for batched ``get_many``."""
+        return [f'{self.key_prefix}/game_{gid}' for gid in game_ids]
 
 
 #: The two SPADL families the pipeline can stream and cache. Column sets
@@ -81,15 +101,117 @@ FAMILIES = {
         'standard',
         ('time_seconds', 'start_x', 'start_y', 'end_x', 'end_y'),
         ('type_id', 'result_id', 'bodypart_id', 'period_id'),
-        ActionBatch, pack_actions, 'get_actions',
+        ActionBatch, pack_actions, 'actions',
     ),
     'atomic': _Family(
         'atomic',
         ('time_seconds', 'x', 'y', 'dx', 'dy'),
         ('type_id', 'bodypart_id', 'period_id'),
-        AtomicActionBatch, pack_atomic_actions, 'get_atomic_actions',
+        AtomicActionBatch, pack_atomic_actions, 'atomic_actions',
     ),
 }
+
+
+def require_chunk_ids(got: Sequence[Any], want: Sequence[Any]) -> None:
+    """Packing a chunk must return exactly the requested games, in order.
+
+    A game whose stored frame is empty (or whose ``game_id`` column
+    disagrees with its store key) silently vanishes from the packer's
+    factorize; rows written to the cache or yielded under the wrong game
+    would follow. The old serial build failed on the resulting shape
+    mismatch — the incremental writer and the streaming feed must fail
+    just as loudly, never publish or yield misaligned rows.
+    """
+    if list(got) != list(want):
+        raise ValueError(
+            f'packed games {list(got)!r} != requested chunk {list(want)!r}: '
+            'a game frame is empty, missing, or mislabelled in the store'
+        )
+
+
+def _read_and_pack_chunk(
+    store: SeasonStore,
+    fam: '_Family',
+    chunk: Sequence[Any],
+    home: Dict[Any, Any],
+    *,
+    max_actions: Optional[int],
+    float_dtype: Any,
+) -> Any:
+    """One chunk's projected store read + host-staging pack, id-verified.
+
+    The single definition is what keeps the cache builders and the
+    streamed feed bit-identical: every path reads the same projected
+    columns, packs with the same arguments, and fails loudly on a
+    missing/empty/mislabelled game. Stage costs land under the shared
+    ``pipeline/read_actions`` / ``pipeline/pack`` timers.
+    """
+    with timed('pipeline/read_actions'):
+        actions = store.get_concat(
+            fam.game_keys(chunk), columns=fam.read_columns
+        )
+    with timed('pipeline/pack'):
+        host, ids = fam.packer(
+            actions,
+            {gid: home[gid] for gid in chunk},
+            max_actions=max_actions,
+            float_dtype=float_dtype,
+            as_numpy=True,
+        )
+    require_chunk_ids(ids, chunk)
+    return host
+
+
+#: distinguishes concurrent writers within one process (an early-closed
+#: overlapped build aborts asynchronously and must never rmtree a newer
+#: sibling's identically-named temp directory)
+_BUILD_SEQ = itertools.count()
+
+
+def _host_tag() -> str:
+    """Alphanumeric host token for build temp names (pids are only
+    meaningful on the host — or in the PID namespace — that issued
+    them)."""
+    import socket
+
+    return ''.join(
+        ch for ch in socket.gethostname() if ch.isalnum()
+    )[:32] or 'host'
+
+
+def _sweep_dead_builds(cache_dir: str) -> None:
+    """Reclaim ``{cache_dir}.building.<host>-<pid>.<seq>`` orphans.
+
+    A SIGKILLed build skips :meth:`PackedSeasonWriter.abort`, and the
+    per-process sequence suffix means no later writer ever reuses the
+    name — without this sweep an interrupted build's memmaps (~hundreds
+    of MB) would sit next to the store forever. Only THIS host's dirs
+    are judged (a pid probe says nothing about a process on another
+    machine sharing the filesystem, and rmtree'ing a live remote
+    builder's dir would fail its finalize); dirs whose pid is alive or
+    unverifiable are a possibly-live concurrent builder and left alone.
+    """
+    import glob
+
+    prefix = f'{cache_dir}.building.'
+    host = _host_tag()
+    for path in glob.glob(f'{glob.escape(prefix)}*'):
+        token = path[len(prefix):].split('.', 1)[0]
+        owner, sep, pid_s = token.rpartition('-')
+        if not sep or owner != host:
+            continue  # another host's build (or unknown format)
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid == os.getpid():
+            continue  # a live sibling writer in this very process
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            continue  # e.g. EPERM: pid alive under another user
 
 
 def _store_fingerprint(path: str) -> Dict[str, int]:
@@ -144,6 +266,33 @@ class PackedSeason:
             wire = _int_wire_name(
                 self._cols[c] for c in self.family.int_cols
             )
+            # persist the scanned answer so a legacy cache (written before
+            # the key existed) pays the whole-column scan once, not on
+            # every construction; atomically, and best-effort — a
+            # read-only cache simply scans again next open
+            self.meta['int_wire'] = wire
+            try:
+                import threading
+
+                # pid alone is not unique: two feeds (or a prefetch
+                # worker and the main thread) opening the same legacy
+                # cache concurrently would interleave into one temp file
+                # and os.replace garbled JSON over meta.json
+                tmp = os.path.join(
+                    cache_dir,
+                    'meta.json.tmp.'
+                    f'{os.getpid()}.{threading.get_ident()}',
+                )
+                with open(tmp, 'w', encoding='utf-8') as fh:
+                    json.dump(self.meta, fh)
+                os.replace(tmp, os.path.join(cache_dir, 'meta.json'))
+            except OSError:
+                # best-effort persistence, but never strand the temp
+                # file inside the published cache directory
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
         self._int_wire = np.dtype(wire)
 
     def valid_for(self, store_path: str) -> bool:
@@ -164,31 +313,28 @@ class PackedSeason:
         pipeline tests). Only the stacked float columns, int8-narrowed
         id columns, flags and lengths cross the host→device link; the
         derived fields are rebuilt on device (see module docstring).
-        """
-        import jax
-        import jax.numpy as jnp
 
-        idx = np.asarray([self._pos[g] for g in game_ids])
-        A = self.max_actions
+        The memmap gather is timed under ``pipeline/read_cache`` and the
+        device dispatch under ``pipeline/transfer`` in the shared timer
+        registry.
+        """
         fam = self.family
-        n_act = self.n_actions[idx].astype(np.int32)
-        floats = np.empty(
-            (len(fam.float_cols), len(idx), A), dtype=self.float_dtype
-        )
-        for i, c in enumerate(fam.float_cols):
-            floats[i] = self._cols[c][idx]
-        ints = np.empty((len(fam.int_cols), len(idx), A), dtype=self._int_wire)
-        for i, c in enumerate(fam.int_cols):
-            ints[i] = self._cols[c][idx]
-        is_home = self._cols['is_home'][idx]
-        put = (
-            (lambda a: jax.device_put(a, device))
-            if device is not None
-            else jnp.asarray
-        )
-        batch = _device_unpack(fam.name)(
-            put(floats), put(ints), put(is_home), put(n_act)
-        )
+        with timed('pipeline/read_cache'):
+            idx = np.asarray([self._pos[g] for g in game_ids])
+            A = self.max_actions
+            n_act = self.n_actions[idx].astype(np.int32)
+            floats = np.empty(
+                (len(fam.float_cols), len(idx), A), dtype=self.float_dtype
+            )
+            for i, c in enumerate(fam.float_cols):
+                floats[i] = self._cols[c][idx]
+            ints = np.empty(
+                (len(fam.int_cols), len(idx), A), dtype=self._int_wire
+            )
+            for i, c in enumerate(fam.int_cols):
+                ints[i] = self._cols[c][idx]
+            is_home = self._cols['is_home'][idx]
+        batch = _ship_wire(fam, floats, ints, is_home, n_act, device)
         return batch, list(game_ids)
 
 
@@ -204,26 +350,119 @@ def _int_wire_name(int_cols) -> str:
     return 'int8'
 
 
+def _ship_wire(fam, floats, ints, is_home, n_act, device) -> Any:
+    """Transfer the wire arrays and rebuild the batch on device.
+
+    Dispatch time (``jax.device_put`` of the four wire arrays + the
+    jitted unpack launch) is recorded under ``pipeline/transfer``; the
+    transfers themselves are asynchronous, so on an accelerator the wall
+    time of the actual copy overlaps downstream host work.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    with timed('pipeline/transfer'):
+        put = (
+            (lambda a: jax.device_put(a, device))
+            if device is not None
+            else jnp.asarray
+        )
+        return _device_unpack(fam.name)(
+            put(floats), put(ints), put(is_home), put(n_act)
+        )
+
+
+def ship_host_batch(
+    batch: Any, *, family: str = 'standard', device: Optional[Any] = None
+) -> Any:
+    """Send a host staging batch to the device via the minimal wire format.
+
+    ``batch`` must be a numpy-backed batch from the family's packer with
+    ``as_numpy=True`` whose games occupy *contiguous* source-frame row
+    runs (the packer left-aligns per game but keeps frame-order
+    ``row_index``, so an interleaved multi-game frame does NOT qualify —
+    every internal caller reads via ``get_concat``, which concatenates
+    whole games; a violation raises rather than silently rewriting the
+    attribution): only the stacked float columns,
+    the id columns narrowed to their wire dtype, the ``is_home`` flags
+    and the ``(G,)`` lengths are transferred, and the jitted device-side
+    unpack rebuilds ``mask``/``row_index``/``game_id`` bit-identically
+    from ``n_actions`` — the same ~21 MB / 4-transfer wire
+    :meth:`PackedSeason.take` uses, now shared by the streaming store
+    path so the cold pass stops shipping ~36 MB and 13 arrays per chunk.
+
+    The wire dtype is re-decided per chunk (one numpy min/max over the
+    stacked ids — the cache path instead pins it in ``meta.json``): a
+    stream whose later chunk exceeds int8 widens to int32 for that chunk
+    only. Values are exact either way (everything is int32 again on
+    device), and since the jit cache keys on input dtype there are at
+    most two compiled unpack variants per family, not one per flip.
+    """
+    fam = FAMILIES[family]
+    # the device unpack rebuilds row_index as a cumsum of n_actions; that
+    # is only bit-identical to the host packer's frame positions when each
+    # game's rows are contiguous in the source frame. row_index is
+    # strictly increasing per game (frame order), so first == offset and
+    # last == offset + n - 1 proves contiguity in O(games)
+    n_act = np.asarray(batch.n_actions)
+    row_index = np.asarray(batch.row_index)
+    if row_index.shape[1]:
+        offsets = np.cumsum(n_act) - n_act
+        rows = np.arange(len(n_act))
+        first = row_index[rows, 0]
+        last = row_index[rows, np.maximum(n_act - 1, 0)]
+        if not np.all(
+            (n_act == 0)
+            | ((first == offsets) & (last == offsets + n_act - 1))
+        ):
+            raise ValueError(
+                'ship_host_batch requires each game to occupy a '
+                'contiguous row run of the source frame (row_index is '
+                'rebuilt from a length cumsum on device); pack games '
+                'from per-game frames via get_concat, or transfer the '
+                'full batch instead'
+            )
+    floats = np.stack([np.asarray(getattr(batch, c)) for c in fam.float_cols])
+    ints = np.stack([np.asarray(getattr(batch, c)) for c in fam.int_cols])
+    wire = np.dtype(_int_wire_name(iter(ints)))
+    if wire != ints.dtype:
+        ints = ints.astype(wire)
+    return _ship_wire(
+        fam,
+        floats,
+        ints,
+        np.asarray(batch.is_home),
+        np.asarray(batch.n_actions),
+        device,
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _device_unpack(family_name: str) -> Any:
     """Jitted wire → :class:`ActionBatch` rebuild for one family.
 
     Matches the host packer bit for bit: ``mask`` by length comparison,
-    ``row_index`` as running valid-row offset (int32 cumsum — exact
-    until a single chunk holds 2**31 actions; a full season is ~5M),
-    ``game_id`` as the chunk-local iota, ids widened back to int32.
+    ``row_index`` as running valid-row offset, ``game_id`` as the
+    chunk-local iota, ids widened back to int32. The offset cumsum runs
+    in int64 where the runtime provides it (x64 mode), so the
+    intermediate can no longer overflow on >2³¹-action chunks; the
+    ``row_index`` *field* is int32 by contract either way, exactly like
+    the host packer's ``np.arange(len(actions), dtype=np.int32)``.
     """
     import jax
     import jax.numpy as jnp
 
     fam = FAMILIES[family_name]
+    # jnp.int64 requested under x64-disabled JAX would warn and truncate
+    # on every trace; resolve the widest available accumulator up front
+    acc_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
     @jax.jit
     def unpack(floats, ints, is_home, n_act):
         _G, A = is_home.shape
         ar = jnp.arange(A, dtype=jnp.int32)
         mask = ar[None, :] < n_act[:, None]
-        offsets = jnp.cumsum(n_act) - n_act
+        offsets = jnp.cumsum(n_act, dtype=acc_dtype) - n_act
         row_index = jnp.where(mask, offsets[:, None] + ar[None, :], -1)
         cols = {c: floats[i] for i, c in enumerate(fam.float_cols)}
         cols.update(
@@ -244,6 +483,221 @@ def _device_unpack(family_name: str) -> Any:
     return unpack
 
 
+class PackedSeasonWriter:
+    """Write side of the cache: incremental chunk writes + atomic publish.
+
+    Factors the build out of :func:`ensure_packed` so it can run in two
+    shapes: the serial one-pass build (``ensure_packed`` on a miss) and
+    the *overlapped* build (:func:`~socceraction_tpu.pipeline.build.iter_packed_build`),
+    where each streamed chunk is written into the memmaps while the same
+    chunk is already being shipped to the device — the cache then costs
+    no extra store pass at all.
+
+    Rows are addressed by position in ``self.game_ids`` (the store's
+    ``game_ids()`` order, which is the order every later
+    :meth:`PackedSeason.take` resolves against). Nothing is visible to
+    readers until :meth:`finalize` publishes the temp directory with one
+    ``os.replace``; :meth:`abort` (or ``finalize`` never running — the
+    overlapped build's early-close path) leaves no cache behind.
+    """
+
+    def __init__(
+        self,
+        store: SeasonStore,
+        *,
+        max_actions: int,
+        float_dtype: Any = 'float32',
+        cache_dir: Optional[str] = None,
+        family: str = 'standard',
+    ) -> None:
+        self.family = FAMILIES[family]
+        self.store_path = store.path
+        # fingerprint BEFORE the first read: the overlapped build streams
+        # at the consumer's pace (an epoch can take minutes), so a store
+        # rewritten mid-build must leave the published cache invalid —
+        # fingerprinting at finalize would bless pre-rewrite rows against
+        # the post-rewrite store
+        self._fingerprint = _store_fingerprint(store.path)
+        self.cache_dir = cache_dir or packed_cache_dir(
+            store.path, max_actions, float_dtype, family
+        )
+        self.max_actions = int(max_actions)
+        self.float_dtype = np.dtype(float_dtype)
+        # always the store's own full listing: rows are addressed by
+        # position in store order, so building from a caller-supplied
+        # subset would publish a fingerprint-valid cache that KeyErrors
+        # every later full-season take
+        self.game_ids: List[Any] = store.game_ids()
+        self.home = store.home_team_ids()
+        self._written = np.zeros(len(self.game_ids), dtype=bool)
+        G, A = len(self.game_ids), self.max_actions
+        _sweep_dead_builds(self.cache_dir)
+        self._tmp = (
+            f'{self.cache_dir}.building.'
+            f'{_host_tag()}-{os.getpid()}.{next(_BUILD_SEQ)}'
+        )
+        if os.path.isdir(self._tmp):
+            shutil.rmtree(self._tmp)
+        os.makedirs(self._tmp)
+        self._maps: Dict[str, Any] = {}
+        # preallocation can fail partway (ENOSPC on the G×A memmaps);
+        # callers only guard with abort() AFTER construction, and the
+        # dead-pid sweep skips this live process — clean up here or each
+        # same-process retry strands another temp dir of column files
+        try:
+            for c in self.family.float_cols:
+                self._maps[c] = np.lib.format.open_memmap(
+                    os.path.join(self._tmp, f'{c}.npy'), mode='w+',
+                    dtype=self.float_dtype, shape=(G, A),
+                )
+            for c in self.family.int_cols:
+                self._maps[c] = np.lib.format.open_memmap(
+                    os.path.join(self._tmp, f'{c}.npy'), mode='w+',
+                    dtype=np.int32, shape=(G, A),
+                )
+            for c in self.family.bool_cols:
+                self._maps[c] = np.lib.format.open_memmap(
+                    os.path.join(self._tmp, f'{c}.npy'), mode='w+',
+                    dtype=bool, shape=(G, A),
+                )
+            self._n_actions = np.zeros(G, dtype=np.int32)
+        except BaseException:
+            self.abort()
+            raise
+
+    @property
+    def complete(self) -> bool:
+        """True once every game's rows have been written."""
+        return bool(self._written.all())
+
+    def write_chunk(self, lo: int, batch: Any) -> None:
+        """Stream one packed chunk (games ``lo:lo+G_chunk`` of
+        ``self.game_ids``, any batch whose fields convert via
+        ``np.asarray`` — host staging batches avoid a device fetch) into
+        the column memmaps."""
+        hi = lo + batch.is_home.shape[0]
+        for c in self.family.all_cols:
+            self._maps[c][lo:hi] = np.asarray(getattr(batch, c))
+        self._n_actions[lo:hi] = np.asarray(batch.n_actions)
+        self._written[lo:hi] = True
+
+    def write_missing(self, store: SeasonStore, build_chunk: int = 256) -> None:
+        """Pack and write every game not covered by a prior
+        :meth:`write_chunk` (e.g. a ``drop_remainder`` tail the stream
+        never yielded), reading the store in ``build_chunk`` spans."""
+        missing = np.flatnonzero(~self._written)
+        for span_lo in range(0, len(missing), build_chunk):
+            span = missing[span_lo : span_lo + build_chunk]
+            # contiguous runs within the span write in one slice each
+            runs: List[List[int]] = []
+            for i in span:
+                if runs and runs[-1][-1] == i - 1:
+                    runs[-1].append(int(i))
+                else:
+                    runs.append([int(i)])
+            for run in runs:
+                chunk = [self.game_ids[i] for i in run]
+                batch = _read_and_pack_chunk(
+                    store, self.family, chunk, self.home,
+                    max_actions=self.max_actions,
+                    float_dtype=self.float_dtype,
+                )
+                self.write_chunk(run[0], batch)
+
+    def finalize(self) -> PackedSeason:
+        """Flush, write ``meta.json`` and publish atomically.
+
+        Every game must have been written (``write_chunk`` /
+        ``write_missing``); a gap raises instead of publishing a cache
+        that would serve zeros. If a concurrent builder published first,
+        its (valid) cache is returned instead.
+        """
+        if not self._written.all():
+            self.abort()
+            raise RuntimeError(
+                f'{int((~self._written).sum())} games were never written; '
+                'call write_missing(store) before finalize()'
+            )
+        try:
+            for m in self._maps.values():
+                m.flush()
+            np.save(os.path.join(self._tmp, 'n_actions.npy'), self._n_actions)
+            meta = {
+                'version': _VERSION,
+                'family': self.family.name,
+                'max_actions': self.max_actions,
+                'float_dtype': self.float_dtype.name,
+                'int_wire': _int_wire_name(
+                    self._maps[c] for c in self.family.int_cols
+                ),
+                'game_ids': [_json_safe(g) for g in self.game_ids],
+                'store_fingerprint': self._fingerprint,
+            }
+            with open(
+                os.path.join(self._tmp, 'meta.json'), 'w', encoding='utf-8'
+            ) as fh:
+                json.dump(meta, fh)
+            if os.path.isdir(self.cache_dir):
+                shutil.rmtree(self.cache_dir)
+            try:
+                os.replace(self._tmp, self.cache_dir)
+            except OSError:
+                # concurrent builder published first: use theirs if valid
+                ps = _try_open(self.cache_dir, self.store_path)
+                if ps is not None:
+                    return ps
+                raise
+        finally:
+            self.abort()
+        return PackedSeason(self.cache_dir)
+
+    def abort(self) -> None:
+        """Drop the in-progress temp directory (idempotent, never raises).
+
+        Runs on close/error paths — a cleanup failure (open memmap
+        handle, NFS silly-rename) must not replace the original error or
+        kill the feed's worker thread before its END sentinel goes out;
+        a leftover dir is reclaimed by the next build's dead-pid sweep.
+        """
+        self._maps = {}
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+
+def open_packed(
+    store: SeasonStore,
+    *,
+    max_actions: int,
+    float_dtype: Any = 'float32',
+    cache_dir: Optional[str] = None,
+    family: str = 'standard',
+) -> Optional[PackedSeason]:
+    """Open the store's packed cache if present, valid and matching.
+
+    The no-build half of :func:`ensure_packed`: returns ``None`` on any
+    miss (absent/partial directory, stale store fingerprint, or a cache
+    built for another family/shape/dtype) so callers can choose *how* to
+    build — ``ensure_packed`` builds serially, the feed's first pass
+    builds overlapped.
+    """
+    fam = FAMILIES[family]
+    cache_dir = cache_dir or packed_cache_dir(
+        store.path, max_actions, float_dtype, family
+    )
+    ps = _try_open(cache_dir, store.path)
+    if ps is None:
+        return None
+    # an explicit cache_dir may point at a cache built for another
+    # family/shape/dtype; a mismatch is a miss, never silently-wrong
+    # batches
+    if (
+        ps.family.name == fam.name
+        and ps.max_actions == int(max_actions)
+        and ps.float_dtype == np.dtype(float_dtype)
+    ):
+        return ps
+    return None
+
+
 def ensure_packed(
     store: SeasonStore,
     *,
@@ -255,102 +709,42 @@ def ensure_packed(
 ) -> PackedSeason:
     """Open the store's packed cache, building it on a miss.
 
-    The build streams the store once in ``build_chunk``-game chunks
-    through the regular packing path of ``family`` (so the cached
-    tensors inherit its exact semantics) into preallocated ``.npy``
-    memmaps, then publishes the directory atomically. Timed under
-    ``pipeline/pack_cache_build`` in the shared timer registry.
+    The build streams the store once in ``build_chunk``-game chunks —
+    fetched with the parallel multi-game reader
+    (:meth:`SeasonStore.get_many`) and packed host-side
+    (``as_numpy=True``, no device round trip) — into preallocated
+    ``.npy`` memmaps, then publishes the directory atomically. Timed
+    under ``pipeline/pack_cache_build`` in the shared timer registry.
+
+    For the streaming first pass, prefer
+    ``iter_batches(..., packed_cache=True)``: on a miss it builds this
+    same cache *overlapped* with the first epoch instead of as an
+    up-front pass.
     """
-    fam = FAMILIES[family]
-    path = store.path
-    cache_dir = cache_dir or packed_cache_dir(
-        path, max_actions, float_dtype, family
+    ps = open_packed(
+        store,
+        max_actions=max_actions,
+        float_dtype=float_dtype,
+        cache_dir=cache_dir,
+        family=family,
     )
-    ps = _try_open(cache_dir, path)
     if ps is not None:
-        # an explicit cache_dir may point at a cache built for another
-        # family/shape/dtype; a mismatch is a miss, never silently-wrong
-        # batches
-        if (
-            ps.family.name == fam.name
-            and ps.max_actions == int(max_actions)
-            and ps.float_dtype == np.dtype(float_dtype)
-        ):
-            return ps
+        return ps
 
     with timed('pipeline/pack_cache_build'):
-        game_ids = store.game_ids()
-        home = store.home_team_ids()
-        G, A = len(game_ids), int(max_actions)
-        fdt = np.dtype(float_dtype)
-
-        tmp = f'{cache_dir}.building.{os.getpid()}'
-        if os.path.isdir(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
+        writer = PackedSeasonWriter(
+            store,
+            max_actions=max_actions,
+            float_dtype=float_dtype,
+            cache_dir=cache_dir,
+            family=family,
+        )
         try:
-            maps = {}
-            for c in fam.float_cols:
-                maps[c] = np.lib.format.open_memmap(
-                    os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=fdt,
-                    shape=(G, A),
-                )
-            for c in fam.int_cols:
-                maps[c] = np.lib.format.open_memmap(
-                    os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=np.int32,
-                    shape=(G, A),
-                )
-            for c in fam.bool_cols:
-                maps[c] = np.lib.format.open_memmap(
-                    os.path.join(tmp, f'{c}.npy'), mode='w+', dtype=bool,
-                    shape=(G, A),
-                )
-            n_actions = np.zeros(G, dtype=np.int32)
-
-            import pandas as pd
-
-            read = getattr(store, fam.reader)
-            for lo in range(0, G, build_chunk):
-                chunk = game_ids[lo : lo + build_chunk]
-                frames = [read(gid) for gid in chunk]
-                batch, _ids = fam.packer(
-                    pd.concat(frames, ignore_index=True),
-                    {gid: home[gid] for gid in chunk},
-                    max_actions=A,
-                    float_dtype=fdt,
-                )
-                hi = lo + len(chunk)
-                for c in fam.all_cols:
-                    maps[c][lo:hi] = np.asarray(getattr(batch, c))
-                n_actions[lo:hi] = np.asarray(batch.n_actions)
-            for m in maps.values():
-                m.flush()
-            np.save(os.path.join(tmp, 'n_actions.npy'), n_actions)
-            meta = {
-                'version': _VERSION,
-                'family': fam.name,
-                'max_actions': A,
-                'float_dtype': fdt.name,
-                'int_wire': _int_wire_name(maps[c] for c in fam.int_cols),
-                'game_ids': [_json_safe(g) for g in game_ids],
-                'store_fingerprint': _store_fingerprint(path),
-            }
-            with open(os.path.join(tmp, 'meta.json'), 'w', encoding='utf-8') as fh:
-                json.dump(meta, fh)
-            if os.path.isdir(cache_dir):
-                shutil.rmtree(cache_dir)
-            try:
-                os.replace(tmp, cache_dir)
-            except OSError:
-                # concurrent builder published first: use theirs if valid
-                ps = _try_open(cache_dir, path)
-                if ps is not None:
-                    return ps
-                raise
-        finally:
-            if os.path.isdir(tmp):
-                shutil.rmtree(tmp)
-    return PackedSeason(cache_dir)
+            writer.write_missing(store, build_chunk=build_chunk)
+            return writer.finalize()
+        except BaseException:
+            writer.abort()
+            raise
 
 
 def _try_open(cache_dir: str, store_path: str) -> Optional[PackedSeason]:

@@ -18,9 +18,19 @@ pay — or depend on — a ``jax`` import.
 
 from socceraction_tpu.utils.env import cpu_device_env
 
-__all__ = ['Timer', 'annotate', 'cpu_device_env', 'profile_trace', 'timed', 'timer_report']
+__all__ = [
+    'Timer',
+    'annotate',
+    'cpu_device_env',
+    'profile_trace',
+    'record_value',
+    'timed',
+    'timer_report',
+]
 
-_PROFILING_SYMBOLS = ('Timer', 'annotate', 'profile_trace', 'timed', 'timer_report')
+_PROFILING_SYMBOLS = (
+    'Timer', 'annotate', 'profile_trace', 'record_value', 'timed', 'timer_report'
+)
 
 
 def __getattr__(name):

@@ -22,7 +22,11 @@ import threading
 import time
 from typing import Any, ContextManager, Dict, Iterator
 
-import jax
+# jax is imported lazily, only by the paths that need it (device
+# synchronization, named scopes, profiler traces): the wall-clock timer
+# registry itself must stay importable by jax-free processes — the
+# SeasonStore read path times its stages from data-prep/bootstrap
+# contexts that must not pay, or depend on, a jax import
 
 _registry_lock = threading.Lock()
 _timers: Dict[str, 'Timer'] = {}
@@ -77,11 +81,27 @@ def timed(name: str, *, block_until_ready: bool = False) -> Iterator[Timer]:
         yield timer
     finally:
         if block_until_ready:
+            import jax
+
             # jax.effects_barrier() only waits on *effectful* computations;
             # pure async dispatches leave no runtime token, so block on the
             # live arrays themselves to charge device time to this stage.
             jax.block_until_ready(jax.live_arrays())
         timer.add(time.perf_counter() - t0)
+
+
+def record_value(name: str, value: float) -> None:
+    """Record a dimensionless sample (gauge) into the shared registry.
+
+    The registry's accumulators are unit-agnostic: ``count``/``total_s``/
+    ``mean_s``/``max_s`` read as count/total/mean/max of whatever was
+    recorded. Used for non-time series that want the same report plumbing
+    as the stage timers — e.g. ``pipeline/feed_queue_depth``, where each
+    sample is the prefetch queue depth observed at one consumer take, so
+    ``mean_s`` is the average buffered-chunk count (producer ahead) and a
+    mean near zero means the consumer is starved (host-bound feed).
+    """
+    _get_timer(name).add(float(value))
 
 
 def timer_report(reset: bool = False) -> Dict[str, Dict[str, float]]:
@@ -101,6 +121,8 @@ def annotate(name: str) -> ContextManager[Any]:
         with annotate('xt/solve'):
             grid = solve_xt(probs, eps=eps)
     """
+    import jax
+
     return jax.named_scope(name)
 
 
@@ -120,6 +142,8 @@ def profile_trace(
     if not enabled:
         yield
         return
+    import jax
+
     jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
     try:
         yield

@@ -20,6 +20,7 @@ from socceraction_tpu.pipeline import (
     SeasonStore,
     ensure_packed,
     iter_batches,
+    open_packed,
 )
 from socceraction_tpu.pipeline.packed import packed_cache_dir
 
@@ -188,6 +189,126 @@ def test_explicit_cache_dir_family_mismatch_rebuilds(store_path, tmp_path):
         assert other.max_actions == 512
 
 
+def _drop_cache(store_path):
+    import shutil
+
+    cache = packed_cache_dir(store_path, _A, 'float32')
+    shutil.rmtree(cache, ignore_errors=True)
+    return cache
+
+
+def test_overlapped_build_first_pass_bit_matches_serial(store_path):
+    """A cold-cache ``packed_cache=True`` full-season stream must yield
+    batches bit-identical to the serial-build-then-take path, publish a
+    valid cache when it completes, and serve the next pass as a pure
+    hit."""
+    _drop_cache(store_path)
+    with SeasonStore(store_path, mode='r') as store:
+        assert open_packed(store, max_actions=_A) is None
+        overlapped = _batches(store, packed_cache=True)  # builds as it streams
+        season = open_packed(store, max_actions=_A)
+        assert season is not None and season.valid_for(store_path)
+        # serial reference: ensure_packed is now a pure open; its takes
+        # must match what the overlapped pass already yielded
+        serial = _batches(store, packed_cache=True)
+    assert [ids for _, ids in overlapped] == [ids for _, ids in serial]
+    for (a, _), (b, _) in zip(overlapped, serial):
+        _assert_batch_equal(a, b)
+
+
+def test_overlapped_build_early_close_never_publishes_partial(store_path):
+    """Abandoning the first pass mid-stream must discard an INCOMPLETE
+    build (a partial cache would serve zeros) and leave no temp
+    directory behind. A build whose chunks were all written by close
+    time may legitimately publish — but then only a complete cache that
+    bit-matches the store. A completed pass afterwards builds normally."""
+    import glob
+    import time
+
+    cache = _drop_cache(store_path)
+    with SeasonStore(store_path, mode='r') as store:
+        # prefetch=0: the generator is exactly one chunk ahead of the
+        # consumer, so a close after the first of three batches is a
+        # guaranteed-incomplete build — deterministic abort
+        it = iter_batches(store, 2, max_actions=_A, packed_cache=True)
+        next(it)
+        it.close()
+        assert open_packed(store, max_actions=_A) is None
+        assert not glob.glob(f'{cache}.building.*')
+
+        # prefetch=1: whether the worker wrote every chunk before the
+        # close landed is timing-dependent; both outcomes are legal but
+        # a PARTIAL cache never is — anything published must bit-match
+        it = iter_batches(
+            store, 2, max_actions=_A, packed_cache=True, prefetch=1
+        )
+        next(it)
+        it.close()
+        # the prefetch worker retires asynchronously; poll briefly
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not glob.glob(f'{cache}.building.*'):
+                break
+            time.sleep(0.05)
+        assert not glob.glob(f'{cache}.building.*')
+        plain = _batches(store)
+        if open_packed(store, max_actions=_A) is not None:
+            served = _batches(store, packed_cache=True)  # cache hit
+            for (a, _), (b, _) in zip(plain, served):
+                _assert_batch_equal(a, b)
+            _drop_cache(store_path)
+
+        rebuilt = _batches(store, packed_cache=True)
+        assert open_packed(store, max_actions=_A) is not None
+    for (a, _), (b, _) in zip(plain, rebuilt):
+        _assert_batch_equal(a, b)
+
+
+def test_overlapped_build_close_after_last_batch_publishes(store_path):
+    """A consumer that takes every batch but closes the generator
+    instead of exhausting it (``islice``/``break`` on the final chunk)
+    has paid for the whole build — the cache must publish, complete."""
+    _drop_cache(store_path)
+    with SeasonStore(store_path, mode='r') as store:
+        n_games = len(store.game_ids())
+        n_chunks = (n_games + 1) // 2
+        it = iter_batches(store, 2, max_actions=_A, packed_cache=True)
+        for _ in range(n_chunks):
+            next(it)
+        it.close()  # closed at the last yield, never exhausted
+        season = open_packed(store, max_actions=_A)
+        assert season is not None
+        assert list(season.game_ids) == store.game_ids()
+        plain = _batches(store)
+        served = _batches(store, packed_cache=True)
+    for (a, _), (b, _) in zip(plain, served):
+        _assert_batch_equal(a, b)
+
+
+def test_subset_stream_on_cache_miss_falls_back_to_serial_build(store_path):
+    """A reordered/subset ``game_ids`` stream cannot build overlapped
+    (the cache must cover the whole season in store order); it must fall
+    back to the serial build and still serve bit-identical batches."""
+    _drop_cache(store_path)
+    want = [4, 2, 1]
+    with SeasonStore(store_path, mode='r') as store:
+        cached = list(
+            iter_batches(
+                store, 2, game_ids=want, max_actions=_A, packed_cache=True
+            )
+        )
+        plain = list(iter_batches(store, 2, game_ids=want, max_actions=_A))
+        season = open_packed(store, max_actions=_A)
+        # the serial fallback builds the FULL season cache, subset or not
+        # (the module store may hold extra games written by earlier tests)
+        assert season is not None
+        assert list(season.game_ids) == store.game_ids()
+        assert set(want) < set(season.game_ids)
+    assert [ids for _, ids in cached] == [[4, 2], [1]]
+    for (a, _), (b, _) in zip(cached, plain):
+        _assert_batch_equal(a, b)
+
+
 def test_prefetch_composes_with_cache(store_path):
     with SeasonStore(store_path, mode='r') as store:
         plain = _batches(store)
@@ -239,3 +360,126 @@ def test_wire_dtype_is_a_cache_property(store_path, tmp_path):
         assert wide.meta['int_wire'] == 'int32'
         batch, _ = wide.take([1])
         assert int(np.asarray(batch.period_id)[0, 0]) == 4000
+
+
+def _tiny_store(path, n_games=4):
+    with SeasonStore(path, mode='w') as store:
+        for gid in range(1, n_games + 1):
+            store.put_actions(
+                gid,
+                synthetic_actions_frame(
+                    gid, home_team_id=10, away_team_id=20,
+                    n_actions=50, seed=gid,
+                ),
+            )
+        store.put(
+            'games',
+            pd.DataFrame(
+                [{'game_id': g, 'home_team_id': 10} for g in range(1, n_games + 1)]
+            ),
+        )
+    return path
+
+
+def test_store_mutation_mid_build_invalidates_cache(tmp_path):
+    """The overlapped build streams at the consumer's pace; a store
+    rewritten while the stream is in flight must leave the published
+    cache invalid (fingerprint captured before the first read), never
+    bless pre-rewrite rows against the post-rewrite store."""
+    path = _tiny_store(str(tmp_path / 'store'))
+    with SeasonStore(path, mode='r') as store:
+        it = iter_batches(store, 2, max_actions=_A, packed_cache=True)
+        next(it)  # first chunk already read and written to the memmaps
+        with SeasonStore(path, mode='a') as writer:
+            writer.put_actions(
+                1,
+                synthetic_actions_frame(
+                    1, home_team_id=10, away_team_id=20,
+                    n_actions=60, seed=77,
+                ),
+            )
+        list(it)  # drain: the build completes and publishes
+        assert open_packed(store, max_actions=_A) is None
+
+
+def test_interrupted_build_temp_dirs_are_reclaimed(tmp_path):
+    """A SIGKILLed build never runs abort(), and the per-process sequence
+    suffix means no later writer reuses its temp name — the next writer
+    for the same cache must sweep THIS host's dead-pid leftovers, and
+    only those (a pid probe says nothing about another machine sharing
+    the filesystem, or a live sibling in this process)."""
+    import subprocess
+
+    from socceraction_tpu.pipeline.packed import _host_tag
+
+    path = _tiny_store(str(tmp_path / 'store'))
+    cache = packed_cache_dir(path, _A, 'float32')
+    proc = subprocess.Popen(['sleep', '0'])
+    proc.wait()
+    dead = f'{cache}.building.{_host_tag()}-{proc.pid}.0'
+    live = f'{cache}.building.{_host_tag()}-{os.getpid()}.999'
+    foreign = f'{cache}.building.otherhostname-{proc.pid}.0'
+    for d in (dead, live, foreign):
+        os.makedirs(d)
+    try:
+        with SeasonStore(path, mode='r') as store:
+            ensure_packed(store, max_actions=_A)
+        assert not os.path.isdir(dead)
+        assert os.path.isdir(live)  # same-pid sibling: possibly live
+        assert os.path.isdir(foreign)  # another host's build: untouched
+    finally:
+        for d in (dead, live, foreign):
+            if os.path.isdir(d):
+                import shutil
+
+                shutil.rmtree(d)
+
+
+def test_ship_host_batch_rejects_interleaved_frames():
+    """The wire rebuilds row_index from a length cumsum on device; a
+    frame whose game rows interleave would get its rows silently
+    re-attributed — ship_host_batch must raise, and the contiguous
+    per-game concat every internal reader produces must still ship."""
+    from socceraction_tpu.core import pack_actions
+    from socceraction_tpu.pipeline.packed import ship_host_batch
+
+    df1 = synthetic_actions_frame(
+        1, home_team_id=10, away_team_id=20, n_actions=4, seed=1
+    )
+    df2 = synthetic_actions_frame(
+        2, home_team_id=10, away_team_id=20, n_actions=4, seed=2
+    )
+    both = pd.concat([df1, df2], ignore_index=True)
+    homes = {1: 10, 2: 10}
+
+    inter = both.iloc[[0, 4, 1, 5, 2, 6, 3, 7]].reset_index(drop=True)
+    host, _ = pack_actions(inter, homes, max_actions=8, as_numpy=True)
+    with pytest.raises(ValueError, match='contiguous'):
+        ship_host_batch(host)
+
+    ok, _ = pack_actions(both, homes, max_actions=8, as_numpy=True)
+    shipped = ship_host_batch(ok)
+    np.testing.assert_array_equal(
+        np.asarray(shipped.row_index), np.asarray(ok.row_index)
+    )
+
+
+def test_drop_remainder_close_on_last_batch_still_publishes(tmp_path):
+    """The never-yielded drop_remainder tail is written before the final
+    yield: a consumer that breaks on the last batch of an overlapped
+    build must still get a complete, published cache."""
+    path = _tiny_store(str(tmp_path / 'store'), n_games=5)
+    with SeasonStore(path, mode='r') as store:
+        it = iter_batches(
+            store, 2, max_actions=_A, packed_cache=True, drop_remainder=True
+        )
+        next(it)
+        next(it)  # both full chunks taken; the 1-game tail never yields
+        it.close()
+        season = open_packed(store, max_actions=_A)
+        assert season is not None
+        assert list(season.game_ids) == store.game_ids()  # tail covered
+        plain = _batches(store)
+        served = _batches(store, packed_cache=True)
+    for (a, _), (b, _) in zip(plain, served):
+        _assert_batch_equal(a, b)
