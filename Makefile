@@ -17,6 +17,7 @@ compile:
 
 lint:
 	$(PY) tools/lint.py
+	$(PY) tools/check_metric_names.py
 
 types:
 	@$(PY) -c "import mypy" 2>/dev/null \

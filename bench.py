@@ -207,6 +207,17 @@ def bench_impl() -> dict:
     flagship = preferred_rating_path(platform, respect_env=False)
     rates = {'fused': fused_aps, 'materialized': mat_aps}
     flagship_aps = rates[flagship]
+    # run provenance for the artifact: device topology + selected config
+    # (obs/trace.py run_manifest — the same manifest a RunLog opens with)
+    from socceraction_tpu.obs import run_manifest
+
+    manifest = run_manifest(
+        config={
+            'n_games': n_games,
+            'total_actions': total_actions,
+            'rating_path': flagship,
+        }
+    )
     result = {
         'metric': 'vaep_rate_actions_per_sec',
         'value': round(flagship_aps, 1),
@@ -221,6 +232,7 @@ def bench_impl() -> dict:
         'flagship_source': 'platform_profile',
         'measured_winner': max(rates, key=rates.get),
         'flagship_is_fastest': bool(flagship_aps >= max(rates.values())),
+        'run_manifest': manifest,
     }
     if not (fused_reliable and mat_reliable):
         result['measurement_unreliable'] = (
@@ -263,6 +275,20 @@ def bench_impl() -> dict:
             '(set SOCCERACTION_TPU_BENCH_FORCE_EXTRAS=1 plus the '
             '*_XT_GAMES/*_STEP_GAMES knobs to drive them elsewhere)'
         )
+    # the headline rates land in the registry LAST — after the extras,
+    # whose cold-path passes reset the registry between streamed passes
+    # (recording them earlier would leave zeroed husks in the snapshot on
+    # exactly the runs where the extras execute)
+    from socceraction_tpu.obs import REGISTRY, gauge, snapshot_dict
+
+    for rate_path, aps in rates.items():
+        gauge('bench/rate_actions_per_sec', unit='actions/s').set(
+            aps, path=rate_path, platform=platform
+        )
+    # typed snapshot of everything still live in the registry: the
+    # headline rates plus, when the extras ran, the last streamed pass's
+    # stage histogram — compact form, no per-bucket rows
+    result['metric_snapshot'] = snapshot_dict(REGISTRY.snapshot(), buckets=False)
     return result
 
 
@@ -433,8 +459,15 @@ def _bench_extra_configs() -> dict:
     return out
 
 
-def _stage_breakdown(timers: dict) -> dict:
-    """Per-stage host timings of one streamed pass, from the registry.
+def _stage_breakdown(snap) -> dict:
+    """Per-stage host timings of one streamed pass, from the typed snapshot.
+
+    ``snap`` is a :class:`socceraction_tpu.obs.metrics.RegistrySnapshot`:
+    stages are addressed as labeled series of the
+    ``pipeline/stage_seconds`` histogram and queue depth as the true
+    ``pipeline/feed_queue_depth`` gauge — no string-prefix scraping of a
+    flat report, and a renamed stage label fails loudly as a zero (the
+    tests pin the label set) instead of silently matching.
 
     ``read_io_thread_s``/``decode_thread_s`` are summed across the
     parallel reader's worker threads, so they can exceed the
@@ -448,25 +481,26 @@ def _stage_breakdown(timers: dict) -> dict:
     to attribute host-boundedness.
     """
 
-    def t(name: str) -> float:
-        return round(timers.get(name, {}).get('total_s', 0.0), 2)
+    def stage(name: str) -> float:
+        return round(snap.value('pipeline/stage_seconds', stage=name), 2)
 
-    qd = timers.get('pipeline/feed_queue_depth', {})
+    qd = snap.series('pipeline/feed_queue_depth')
+    sampled = qd is not None and qd.count > 0
     return {
-        'read_s': t('pipeline/read_actions'),
-        'read_io_thread_s': t('pipeline/read_io'),
-        'decode_thread_s': t('pipeline/decode'),
-        'pack_s': t('pipeline/pack'),
-        'transfer_dispatch_s': t('pipeline/transfer'),
-        'cache_write_s': t('pipeline/cache_write'),
-        'read_cache_s': t('pipeline/read_cache'),
+        'read_s': stage('read'),
+        'read_io_thread_s': stage('read_io'),
+        'decode_thread_s': stage('decode'),
+        'pack_s': stage('pack'),
+        'transfer_dispatch_s': stage('transfer'),
+        'cache_write_s': stage('cache_write'),
+        'read_cache_s': stage('read_cache'),
         # time the CONSUMER was blocked on the prefetch queue — the
         # direct host-bound signal (stage sums overlap device compute on
         # the worker thread, and queue depth reads ~0 for any consumer
         # that dispatches asynchronously)
-        'feed_wait_s': t('pipeline/feed_wait'),
-        'queue_depth_mean': round(qd.get('mean_s', 0.0), 2),
-        'queue_depth_max': round(qd.get('max_s', 0.0), 2),
+        'feed_wait_s': stage('feed_wait'),
+        'queue_depth_mean': round(qd.mean, 2) if sampled else 0.0,
+        'queue_depth_max': round(qd.max, 2) if sampled else 0.0,
     }
 
 
@@ -486,7 +520,9 @@ def _bench_cold_path() -> dict:
        takes: memmap slices, no store parse.
 
     Per-stage host time (read/decode/pack/transfer + queue depth) comes
-    from the pipeline timer registry, and ``host_bound`` flags ≥ 50% of
+    from the typed obs registry snapshot (labeled
+    ``pipeline/stage_seconds`` histogram + ``pipeline/feed_queue_depth``
+    gauge), and ``host_bound`` flags ≥ 50% of
     wall spent *actually waiting on the host*: the consumer's measured
     block time on the prefetch queue (``feed_wait_s``), or the inline
     stage fraction when no worker runs. The r5 artifact's
@@ -504,9 +540,9 @@ def _bench_cold_path() -> dict:
 
     from __graft_entry__ import build_forward, example_inputs
     from socceraction_tpu.core.synthetic import write_synthetic_season
+    from socceraction_tpu.obs import REGISTRY
     from socceraction_tpu.ops.profile import preferred_rating_path
     from socceraction_tpu.pipeline import SeasonStore, iter_batches, open_packed
-    from socceraction_tpu.utils.profiling import timer_report
 
     cold_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_GAMES', 3072))
     chunk = int(os.environ.get('SOCCERACTION_TPU_BENCH_COLD_CHUNK', 512))
@@ -600,7 +636,7 @@ def _bench_cold_path() -> dict:
 
     def rated_pass(store, **kw):
         """One streamed pass: returns (actions, wall_s, first_batch_s, stages)."""
-        timer_report(reset=True)
+        REGISTRY.reset()
         counts = []
         last = None
         t_first = None
@@ -623,7 +659,7 @@ def _bench_cold_path() -> dict:
         if last is not None:
             jax.block_until_ready(last)
         wall = _time.perf_counter() - t_start
-        return actions, wall, t_first, _stage_breakdown(timer_report())
+        return actions, wall, t_first, _stage_breakdown(REGISTRY.snapshot())
 
     with SeasonStore(store_path, mode='r') as store:
         # warm the compiles (forward + the wire-format device unpack)

@@ -1,0 +1,71 @@
+"""Observability subsystem: labeled metrics, spans, run logs, exporters.
+
+The telemetry substrate the serving/scaling PRs emit into, in three
+dependency-light modules (stdlib only; jax is touched lazily and only
+where a caller asks for device sync or named scopes):
+
+- :mod:`socceraction_tpu.obs.metrics` — typed ``Counter``/``Gauge``/
+  ``Histogram`` instruments with low-cardinality labels and unit
+  metadata in a thread-safe process registry (:data:`REGISTRY`), plus
+  the typed :meth:`~socceraction_tpu.obs.metrics.MetricRegistry.snapshot`
+  query API.
+- :mod:`socceraction_tpu.obs.trace` — nestable :func:`span` timing
+  contexts that bridge into ``jax.named_scope``, and the run-scoped
+  :class:`RunLog` JSONL sink (manifest, span events, metric snapshots,
+  rotation).
+- :mod:`socceraction_tpu.obs.export` — Prometheus-text and JSON
+  exposition, plus the legacy ``timer_report`` compatibility shape.
+
+``socceraction_tpu.utils.profiling`` is a thin façade over this package:
+its ``timed``/``record_value``/``timer_report`` keep working and now
+record here. Symbols are re-exported lazily (PEP 562) so jax-free
+bootstrap processes importing one module never pay for the others.
+"""
+
+from typing import Any
+
+__all__ = [
+    'CardinalityError',
+    'Counter',
+    'Gauge',
+    'Histogram',
+    'MetricRegistry',
+    'REGISTRY',
+    'RegistrySnapshot',
+    'RunLog',
+    'Span',
+    'counter',
+    'current_runlog',
+    'gauge',
+    'histogram',
+    'prometheus_text',
+    'run_manifest',
+    'snapshot_dict',
+    'span',
+    'timed_labels',
+    'timer_report_compat',
+]
+
+_HOMES = {
+    'metrics': (
+        'CardinalityError', 'Counter', 'Gauge', 'Histogram', 'MetricRegistry',
+        'REGISTRY', 'RegistrySnapshot', 'counter', 'gauge', 'histogram',
+        'timed_labels',
+    ),
+    'trace': ('RunLog', 'Span', 'current_runlog', 'run_manifest', 'span'),
+    'export': ('prometheus_text', 'snapshot_dict', 'timer_report_compat'),
+}
+_HOME_BY_SYMBOL = {
+    name: module for module, names in _HOMES.items() for name in names
+}
+
+
+def __getattr__(name: str) -> Any:
+    module = _HOME_BY_SYMBOL.get(name)
+    if module is None:
+        raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+    import importlib
+
+    return getattr(
+        importlib.import_module(f'socceraction_tpu.obs.{module}'), name
+    )

@@ -1,0 +1,186 @@
+"""Exposition formats for a :class:`~socceraction_tpu.obs.metrics.RegistrySnapshot`.
+
+Two wire formats plus one compatibility shim:
+
+- :func:`prometheus_text` — Prometheus text exposition (version 0.0.4):
+  ``# HELP``/``# TYPE`` headers, counters suffixed ``_total``, histograms
+  as cumulative ``_bucket{le=...}`` rows plus ``_sum``/``_count``. Metric
+  names translate from the registry's ``area/stage`` convention by
+  ``/ → _`` with the unit appended per Prometheus naming practice
+  (``pipeline/stage_seconds`` stays ``pipeline_stage_seconds``;
+  ``pipeline/feed_queue_depth`` (unit ``chunks``) becomes
+  ``pipeline_feed_queue_depth_chunks``).
+- :func:`snapshot_dict` — a plain-JSON rendering of the typed snapshot
+  (for artifacts and the ``obs.jsonl`` ``metrics`` events).
+- :func:`timer_report_compat` — the legacy ``timer_report()`` shape
+  (``{name: {count, total, mean, max, unit, total_s, mean_s, max_s}}``)
+  so pre-obs consumers keep reading while they migrate; the ``*_s`` keys
+  are deprecated aliases that are only unit-correct for seconds series.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Mapping, Optional
+
+from socceraction_tpu.obs.metrics import RegistrySnapshot, SeriesSnapshot
+
+__all__ = ['prometheus_text', 'snapshot_dict', 'timer_report_compat']
+
+#: units already spelled out by the convention's trailing name segment —
+#: appending them again would produce ``_seconds_seconds``
+_UNIT_SUFFIXES = {
+    's': 'seconds',
+    'count': 'total',  # counters get _total via the kind rule instead
+    'value': '',  # dimensionless gauges carry no unit suffix
+}
+
+
+def _prom_name(name: str, unit: str, kind: str) -> str:
+    base = name.replace('/', '_')
+    suffix = _UNIT_SUFFIXES.get(unit, unit.replace('/', '_per_'))
+    if suffix and unit != 'count' and not base.endswith('_' + suffix):
+        base += '_' + suffix
+    if kind == 'counter' and not base.endswith('_total'):
+        base += '_total'
+    return base
+
+
+def _prom_escape(value: str) -> str:
+    """Label-value escaping per the text-format spec: ``\\``, ``"``, LF."""
+    return (
+        value.replace('\\', '\\\\').replace('"', '\\"').replace('\n', '\\n')
+    )
+
+
+def _prom_labels(labels: Mapping[str, str], extra: str = '') -> str:
+    parts = [
+        f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
+    ]
+    if extra:
+        parts.append(extra)
+    return '{' + ','.join(parts) + '}' if parts else ''
+
+
+def _prom_float(v: float) -> str:
+    if math.isinf(v):
+        return '+Inf' if v > 0 else '-Inf'
+    if math.isnan(v):
+        return 'NaN'
+    return repr(float(v))
+
+
+def prometheus_text(snapshot: RegistrySnapshot) -> str:
+    """Render the snapshot as Prometheus text exposition."""
+    lines: List[str] = []
+    for name, inst in snapshot.instruments.items():
+        pname = _prom_name(name, inst.unit, inst.kind)
+        help_text = inst.help or f'{name} ({inst.unit})'
+        lines.append(f'# HELP {pname} {help_text}')
+        lines.append(
+            f'# TYPE {pname} '
+            + ('histogram' if inst.kind == 'histogram' else inst.kind)
+        )
+        for s in inst.series:
+            labels = _prom_labels(s.labels)
+            if inst.kind == 'histogram':
+                for le, cum in s.buckets or ():
+                    lines.append(
+                        f'{pname}_bucket'
+                        + _prom_labels(s.labels, f'le="{_prom_float(le)}"')
+                        + f' {cum}'
+                    )
+                lines.append(f'{pname}_sum{labels} {_prom_float(s.total)}')
+                lines.append(f'{pname}_count{labels} {s.count}')
+            elif inst.kind == 'counter':
+                lines.append(f'{pname}{labels} {_prom_float(s.total)}')
+            else:  # gauge: the level is the last sample
+                value = s.last if s.count else 0.0
+                lines.append(f'{pname}{labels} {_prom_float(value)}')
+    return '\n'.join(lines) + '\n'
+
+
+def _series_dict(s: SeriesSnapshot, buckets: bool) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        'labels': dict(s.labels),
+        'count': s.count,
+        'total': s.total,
+        'mean': s.mean,
+        'min': None if math.isnan(s.min) else s.min,
+        'max': None if math.isnan(s.max) else s.max,
+        'last': None if math.isnan(s.last) else s.last,
+    }
+    if s.quantiles is not None:
+        out['quantiles'] = dict(s.quantiles)
+    if buckets and s.buckets is not None:
+        out['buckets'] = [
+            {'le': ('+Inf' if math.isinf(le) else le), 'count': cum}
+            for le, cum in s.buckets
+        ]
+    return out
+
+
+def snapshot_dict(
+    snapshot: RegistrySnapshot, *, buckets: bool = True
+) -> Dict[str, Any]:
+    """JSON-serializable rendering of the typed snapshot.
+
+    ``buckets=False`` drops the per-bucket rows (keeping count/sum/max
+    and the quantile estimates) for compact artifact embedding.
+    """
+    return {
+        name: {
+            'kind': inst.kind,
+            'unit': inst.unit,
+            'series': [_series_dict(s, buckets) for s in inst.series],
+        }
+        for name, inst in snapshot.instruments.items()
+    }
+
+
+def timer_report_compat(
+    snapshot: RegistrySnapshot,
+    names: Optional[Mapping[str, Any]] = None,
+) -> Dict[str, Dict[str, float]]:
+    """The legacy flat ``timer_report()`` shape from a typed snapshot.
+
+    ``names`` maps report keys to either an instrument name (unlabeled
+    series) or a ``(instrument, labels_dict)`` pair; omitted, every
+    unlabeled series reports under its instrument name. Entries carry the
+    unit-correct ``count/total/mean/max`` keys plus a ``unit`` field; the
+    old ``total_s``/``mean_s``/``max_s`` keys ride along as deprecated
+    aliases (only actually seconds when ``unit == 's'``).
+    """
+    out: Dict[str, Dict[str, float]] = {}
+
+    def add(key: str, unit: str, s: Optional[SeriesSnapshot]) -> None:
+        if s is None or s.count == 0:
+            return
+        mx = 0.0 if math.isnan(s.max) else s.max
+        out[key] = {
+            'count': s.count,
+            'total': s.total,
+            'mean': s.mean,
+            'max': mx,
+            'unit': unit,
+            # deprecated aliases (pre-obs key names)
+            'total_s': s.total,
+            'mean_s': s.mean,
+            'max_s': mx,
+        }
+
+    if names is None:
+        for name, inst in snapshot.instruments.items():
+            add(name, inst.unit, inst.series_for())
+        return dict(sorted(out.items()))
+
+    for key, spec in names.items():
+        if isinstance(spec, tuple):
+            inst_name, labels = spec
+        else:
+            inst_name, labels = spec, {}
+        inst = snapshot.get(inst_name)
+        if inst is None:
+            continue
+        add(key, inst.unit, inst.series_for(**labels))
+    return dict(sorted(out.items()))

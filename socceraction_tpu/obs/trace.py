@@ -1,0 +1,323 @@
+"""Span-structured run logs: nested spans, JSONL events, run manifests.
+
+Metrics (:mod:`socceraction_tpu.obs.metrics`) answer "how much / how
+fast"; this module answers "what happened, in what order, under which
+configuration":
+
+- :func:`span` — a nestable context manager that times a named region
+  (wall clock, plus an optional device-synced duration via
+  :meth:`Span.sync`), carries the name into jitted regions as a
+  ``jax.named_scope`` when jax is already loaded, and appends
+  ``span_open``/``span_close`` events to the active :class:`RunLog`.
+  Nesting is per-thread (the feed's prefetch worker gets its own stack),
+  so a run log's events always close in LIFO order within a thread.
+- :class:`RunLog` — the run-scoped sink: a rotating ``obs.jsonl`` writer
+  that opens with a run manifest (config, selected environment, device
+  topology), accepts arbitrary structured events, can embed metric
+  snapshots, and closes with a final snapshot + ``run_end`` event.
+- :func:`run_manifest` — the manifest dict alone, for artifacts (the
+  benchmark embeds it in its JSON line) as well as run logs.
+
+Everything here is importable — and usable — without jax: the named-scope
+bridge only activates when ``jax`` is already in ``sys.modules``, and
+device sync is requested explicitly per span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from socceraction_tpu.obs.metrics import NAME_RE, REGISTRY, MetricRegistry
+
+__all__ = ['RunLog', 'Span', 'current_runlog', 'run_manifest', 'span']
+
+_tls = threading.local()
+_span_ids = itertools.count(1)
+_active_lock = threading.Lock()
+_active_runlog: Optional['RunLog'] = None
+
+
+def current_runlog() -> Optional['RunLog']:
+    """The :class:`RunLog` currently collecting events, if any."""
+    return _active_runlog
+
+
+def _span_stack() -> List['Span']:
+    stack = getattr(_tls, 'stack', None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class Span:
+    """One open span: identity, attributes, and registered sync targets."""
+
+    __slots__ = ('name', 'attrs', 'span_id', 'parent_id', 't0', '_sync')
+
+    def __init__(
+        self, name: str, attrs: Dict[str, Any], parent_id: Optional[int]
+    ) -> None:
+        self.name = name
+        self.attrs = attrs
+        self.span_id = next(_span_ids)
+        self.parent_id = parent_id
+        self.t0 = time.perf_counter()
+        self._sync: List[Any] = []
+
+    def sync(self, value: Any) -> Any:
+        """Register arrays produced in this span for device sync at exit.
+
+        Returns ``value`` unchanged so it can wrap an expression inline::
+
+            with span('xt/fit') as sp:
+                grid = sp.sync(solve_xt(probs))
+
+        At span exit only these values are ``jax.block_until_ready``-ed,
+        so the recorded duration charges this span's device work — never
+        unrelated in-flight computations.
+        """
+        self._sync.append(value)
+        return value
+
+    def annotate(self, **attrs: Any) -> None:
+        """Attach additional attributes (shown on the close event)."""
+        self.attrs.update(attrs)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[Span]:
+    """Open a named, nestable span around a code region.
+
+    Records wall duration always; a device-synced duration when the body
+    registers outputs via :meth:`Span.sync`. When jax is already loaded,
+    the region also runs under ``jax.named_scope(name)`` so device work
+    traced/jitted inside it is identifiable in XLA profiles under the
+    same name. When a :class:`RunLog` is active, ``span_open`` and
+    ``span_close`` events (span id, parent id, duration, error status)
+    are appended to it; with no run log the span is just a cheap timer
+    scope.
+    """
+    if not NAME_RE.match(name):
+        raise ValueError(
+            f'span name {name!r} violates the area/stage convention '
+            "(lowercase segments joined by '/', e.g. 'xt/fit')"
+        )
+    stack = _span_stack()
+    parent = stack[-1] if stack else None
+    s = Span(name, dict(attrs), parent.span_id if parent else None)
+    log = _active_runlog
+    if log is not None:
+        log.event(
+            'span_open', name=name, span_id=s.span_id,
+            parent_id=s.parent_id, attrs=s.attrs,
+        )
+    stack.append(s)
+    jax = sys.modules.get('jax')
+    scope = jax.named_scope(name) if jax is not None else contextlib.nullcontext()
+    status = 'ok'
+    error: Optional[str] = None
+    try:
+        with scope:
+            yield s
+    except BaseException as e:
+        status = 'error'
+        error = f'{type(e).__name__}: {e}'
+        raise
+    finally:
+        synced = False
+        if s._sync:
+            jax = sys.modules.get('jax')
+            if jax is not None:
+                # never raise from span exit: a sync failure must not
+                # shadow the body's own exception
+                try:
+                    jax.block_until_ready(s._sync)
+                    synced = True
+                except Exception:
+                    pass
+        duration = time.perf_counter() - s.t0
+        stack.pop()
+        log = _active_runlog
+        if log is not None:
+            close: Dict[str, Any] = {
+                'name': name,
+                'span_id': s.span_id,
+                'parent_id': s.parent_id,
+                'duration_s': duration,
+                'synced': synced,
+                'status': status,
+                'attrs': s.attrs,
+            }
+            if error is not None:
+                close['error'] = error
+            log.event('span_close', **close)
+
+
+def run_manifest(
+    config: Optional[Dict[str, Any]] = None,
+    *,
+    env_prefixes: Any = ('SOCCERACTION_TPU_', 'JAX_', 'XLA_'),
+) -> Dict[str, Any]:
+    """Describe this run: time, process, selected env, device topology.
+
+    Device topology (platform, device kind, device count) is read from
+    jax only when jax is already imported — asking for a manifest never
+    initializes a backend or pulls jax into a jax-free process.
+    """
+    import platform as _platform
+    import socket
+
+    manifest: Dict[str, Any] = {
+        'time_unix': time.time(),
+        'pid': os.getpid(),
+        'host': socket.gethostname(),
+        'python': _platform.python_version(),
+        'argv': list(sys.argv),
+        'env': {
+            k: v
+            for k, v in sorted(os.environ.items())
+            if k.startswith(tuple(env_prefixes))
+        },
+    }
+    jax = sys.modules.get('jax')
+    if jax is not None:
+        try:
+            devices = jax.devices()
+            manifest['device'] = {
+                'platform': devices[0].platform,
+                'device_kind': devices[0].device_kind,
+                'device_count': len(devices),
+                'process_count': jax.process_count(),
+                'jax_version': jax.__version__,
+            }
+        except Exception as e:  # a wedged backend must not sink the manifest
+            manifest['device'] = {'error': f'{type(e).__name__}: {e}'}
+    if config:
+        manifest['config'] = dict(config)
+    return manifest
+
+
+class RunLog:
+    """Run-scoped JSONL sink tying spans, metrics and the manifest together.
+
+    Usage::
+
+        with RunLog(out_dir, config={'games': 512}) as log:
+            with span('train/epoch', epoch=0):
+                for batch, ids in iter_batches(store, 512, ...):
+                    ...
+            log.metric_snapshot()
+
+    The file opens with a ``run_start`` event carrying the manifest,
+    receives ``span_open``/``span_close`` events from every :func:`span`
+    in the process while active, and closes with a final metric snapshot
+    plus ``run_end``. Writes rotate at ``max_bytes`` (``obs.jsonl`` →
+    ``obs.jsonl.1`` → ... up to ``keep``), so a long-running feed cannot
+    fill the disk. Appends are locked — worker threads (the feed's
+    prefetch producer) interleave whole lines, never partial ones.
+
+    Only one RunLog collects spans at a time (process-global); nested
+    activation raises rather than silently splitting the event stream.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        config: Optional[Dict[str, Any]] = None,
+        registry: Optional[MetricRegistry] = None,
+        max_bytes: int = 64 << 20,
+        keep: int = 3,
+    ) -> None:
+        if os.path.isdir(path) or path.endswith(os.sep):
+            path = os.path.join(path, 'obs.jsonl')
+        self.path = path
+        self.config = config
+        self.registry = registry if registry is not None else REGISTRY
+        self.max_bytes = int(max_bytes)
+        self.keep = int(keep)
+        self._lock = threading.Lock()
+        self._fh: Optional[io.TextIOBase] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self) -> 'RunLog':
+        """Open the sink, write the manifest, start collecting spans."""
+        global _active_runlog
+        with _active_lock:
+            if _active_runlog is not None:
+                raise RuntimeError(
+                    'another RunLog is already active in this process'
+                )
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+            self._fh = open(self.path, 'a', encoding='utf-8')
+            _active_runlog = self
+        self.event('run_start', manifest=run_manifest(self.config))
+        return self
+
+    def close(self) -> None:
+        """Write the final snapshot + ``run_end`` and stop collecting."""
+        global _active_runlog
+        if self._fh is None:
+            return
+        self.metric_snapshot()
+        self.event('run_end')
+        with _active_lock:
+            if _active_runlog is self:
+                _active_runlog = None
+        with self._lock:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> 'RunLog':
+        return self.open()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- events ------------------------------------------------------------
+
+    def event(self, event_type: str, **fields: Any) -> None:
+        """Append one structured JSONL event (no-op once closed)."""
+        record = {
+            'ts': time.time(),
+            'event': event_type,
+            'thread': threading.current_thread().name,
+        }
+        record.update(fields)
+        line = json.dumps(record, default=str, sort_keys=True)
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line + '\n')
+            self._fh.flush()
+            if self._fh.tell() >= self.max_bytes:
+                self._rotate_locked()
+
+    def metric_snapshot(self) -> None:
+        """Embed the registry's current typed snapshot as one event."""
+        from socceraction_tpu.obs.export import snapshot_dict
+
+        self.event(
+            'metrics',
+            metrics=snapshot_dict(self.registry.snapshot(), buckets=False),
+        )
+
+    # -- rotation ----------------------------------------------------------
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        for i in range(self.keep - 1, 0, -1):
+            src = f'{self.path}.{i}'
+            if os.path.exists(src):
+                os.replace(src, f'{self.path}.{i + 1}')
+        os.replace(self.path, f'{self.path}.1')
+        self._fh = open(self.path, 'a', encoding='utf-8')

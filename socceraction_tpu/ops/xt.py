@@ -149,22 +149,29 @@ def _value_iteration(sweep, gs: jax.Array, eps: float, max_iter: int):
     """``xT <- sweep(xT)`` to convergence inside a ``lax.while_loop``.
 
     Convergence uses the reference's signed test ``any(new - old > eps)``
-    (``xthreat.py:303``; xT is monotonically non-decreasing so the signed
-    and absolute tests agree).
+    (``xthreat.py:303``, equivalently ``max(new - old) > eps``; xT is
+    monotonically non-decreasing so the signed and absolute tests agree).
+    The loop state carries that max — the exit residual — so the solver
+    can report how converged the returned surface actually is
+    (``resid <= eps`` on a normal exit, larger when ``max_iter`` cut the
+    loop) without an extra sweep.
+
+    Returns ``(xT, n_iter, resid)``.
     """
 
     def cond(state):
-        _, diff_any, it = state
-        return diff_any & (it < max_iter)
+        _, resid, it = state
+        return (resid > eps) & (it < max_iter)
 
     def body(state):
         xT, _, it = state
         new = sweep(xT)
-        return new, jnp.any(new - xT > eps), it + 1
+        return new, jnp.max(new - xT), it + 1
 
     xT0 = jnp.zeros_like(gs)
-    xT, _, it = jax.lax.while_loop(cond, body, (xT0, jnp.bool_(True), jnp.int32(0)))
-    return xT, it
+    state0 = (xT0, jnp.asarray(jnp.inf, gs.dtype), jnp.int32(0))
+    xT, resid, it = jax.lax.while_loop(cond, body, state0)
+    return xT, it, resid
 
 
 _ANDERSON_MEMORY = 3  # history depth m; m=2-4 is the sweet spot in practice
@@ -191,16 +198,18 @@ def _value_iteration_anderson(sweep, gs: jax.Array, eps: float, max_iter: int):
     are not monotone, so convergence here tests ``any(|f(x) - x| > eps)``
     (the absolute residual) rather than the reference's signed increment.
 
-    Returns ``(xT, n_sweeps)`` — ``n_sweeps`` counts ``sweep`` calls, the
-    apples-to-apples cost unit vs the plain loop.
+    Returns ``(xT, n_sweeps, resid)`` — ``n_sweeps`` counts ``sweep``
+    calls, the apples-to-apples cost unit vs the plain loop; ``resid`` is
+    the last tested residual ``max|f(x) - x|`` (the exit residual of the
+    returned iterate).
     """
     m = _ANDERSON_MEMORY
     n = gs.size
     shape = gs.shape
 
     def cond(state):
-        _, _, _, diff_any, it = state
-        return diff_any & (it < max_iter)
+        _, _, _, resid, it = state
+        return (resid > eps) & (it < max_iter)
 
     def body(state):
         x, Fb, Rb, _, it = state
@@ -224,18 +233,18 @@ def _value_iteration_anderson(sweep, gs: jax.Array, eps: float, max_iter: int):
         gamma = jnp.linalg.solve(A + ridge * jnp.eye(m), dR @ r) * row_valid
         x_new = f - gamma @ dF
 
-        return x_new, Fb, Rb, jnp.any(jnp.abs(r) > eps), it
+        return x_new, Fb, Rb, jnp.max(jnp.abs(r)), it
 
     zeros = jnp.zeros((m + 1, n), gs.dtype)
     x0 = jnp.zeros(n, gs.dtype)
-    state0 = (x0, zeros, zeros, jnp.bool_(True), jnp.int32(0))
-    _, Fb, _, _, it = jax.lax.while_loop(cond, body, state0)
+    state0 = (x0, zeros, zeros, jnp.asarray(jnp.inf, gs.dtype), jnp.int32(0))
+    _, Fb, _, resid, it = jax.lax.while_loop(cond, body, state0)
     # Return the last PLAIN sweep result Fb[-1] = f(x_prev): it is the
     # iterate whose residual the loop actually tested (|f - x_prev| <=
     # eps on normal exit), not the never-checked post-acceleration
     # extrapolation — an ill-conditioned final mixing solve could push
     # that one outside tolerance. Also keeps n_sweeps <= max_iter.
-    return Fb[-1].reshape(shape), it
+    return Fb[-1].reshape(shape), it, resid
 
 
 @functools.partial(jax.jit, static_argnames=('l', 'w'))
@@ -298,14 +307,17 @@ def xt_probabilities(counts: XTCounts, *, l: int, w: int) -> XTProbabilities:
     return XTProbabilities(p_score=p_score, p_shot=p_shot, p_move=p_move, transition=transition)
 
 
-@functools.partial(jax.jit, static_argnames=('max_iter', 'accelerate'))
+@functools.partial(
+    jax.jit, static_argnames=('max_iter', 'accelerate', 'return_residual')
+)
 def solve_xt(
     probs: XTProbabilities,
     eps: float = 1e-5,
     max_iter: int = 1000,
     *,
     accelerate: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
+    return_residual: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Run the xT value iteration to convergence on device.
 
     One sweep is a single mat-vec on the MXU:
@@ -316,8 +328,13 @@ def solve_xt(
 
     Returns
     -------
-    (xT, n_iter)
-        The converged ``(w, l)`` value surface and the iteration count.
+    (xT, n_iter) or (xT, n_iter, resid)
+        The converged ``(w, l)`` value surface and the iteration count;
+        with ``return_residual=True`` also the exit residual the loop
+        last tested (``max(new - old)``, or ``max|f(x) - x|`` on the
+        Anderson path) — ``<= eps`` on a normal exit, larger when
+        ``max_iter`` cut the loop. The telemetry layer records it per
+        fit (``xt/solve_residual``).
     """
     w, l = probs.p_shot.shape
     gs = probs.p_score * probs.p_shot
@@ -328,11 +345,16 @@ def solve_xt(
         return gs + probs.p_move * payoff
 
     solve = _value_iteration_anderson if accelerate else _value_iteration
-    return solve(sweep, gs, eps, max_iter)
+    with jax.named_scope('xt/solve'):
+        xT, it, resid = solve(sweep, gs, eps, max_iter)
+    return (xT, it, resid) if return_residual else (xT, it)
 
 
 @functools.partial(
-    jax.jit, static_argnames=('l', 'w', 'max_iter', 'axis_name', 'accelerate')
+    jax.jit,
+    static_argnames=(
+        'l', 'w', 'max_iter', 'axis_name', 'accelerate', 'return_residual'
+    ),
 )
 def solve_xt_matrix_free(
     type_id: jax.Array,
@@ -349,7 +371,8 @@ def solve_xt_matrix_free(
     max_iter: int = 1000,
     axis_name: Optional[str] = None,
     accelerate: bool = False,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    return_residual: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Value iteration without materializing the transition matrix.
 
     For fine grids the dense ``(w*l, w*l)`` transition matrix is intractable
@@ -372,10 +395,11 @@ def solve_xt_matrix_free(
 
     Returns
     -------
-    (xT, n_iter, p_score, p_shot, p_move)
+    (xT, n_iter, p_score, p_shot, p_move[, resid])
         The converged ``(w, l)`` surface, iteration count, and the three
         ``(w, l)`` probability matrices (the transition matrix is never
-        built).
+        built); with ``return_residual=True`` the exit residual the loop
+        last tested is appended (see :func:`solve_xt`).
     """
     s = _action_stream(type_id, result_id, start_x, start_y, end_x, end_y, mask, l, w)
     n_cells = w * l
@@ -408,7 +432,10 @@ def solve_xt_matrix_free(
         return gs + p_move * payoff.reshape(w, l)
 
     solve = _value_iteration_anderson if accelerate else _value_iteration
-    xT, it = solve(sweep, gs, eps, max_iter)
+    with jax.named_scope('xt/solve'):
+        xT, it, resid = solve(sweep, gs, eps, max_iter)
+    if return_residual:
+        return xT, it, p_score, p_shot, p_move, resid
     return xT, it, p_score, p_shot, p_move
 
 

@@ -24,7 +24,7 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, 
 import pandas as pd
 
 from socceraction_tpu.pipeline.store import SeasonStore
-from socceraction_tpu.utils import timed
+from socceraction_tpu.obs import timed_labels
 
 logger = logging.getLogger(__name__)
 
@@ -92,11 +92,11 @@ def build_spadl_store(
         for row in games.itertuples(index=False):
             game_id = row.game_id
             try:
-                with timed('pipeline/load_events'):
+                with timed_labels('pipeline/stage_seconds', stage='load_events'):
                     events = loader.events(game_id)
                     teams = loader.teams(game_id)
                     players = loader.players(game_id)
-                with timed('pipeline/convert'):
+                with timed_labels('pipeline/stage_seconds', stage='convert'):
                     actions = convert(events, row.home_team_id)
                 # inside the guarded region: a failure in the atomic
                 # conversion or the writes must also be skippable, and no
@@ -205,7 +205,7 @@ def iter_packed_build(
             store, fam, chunk, writer.home,
             max_actions=max_actions, float_dtype=float_dtype,
         )
-        with timed('pipeline/cache_write'):
+        with timed_labels('pipeline/stage_seconds', stage='cache_write'):
             writer.write_chunk(lo, host)
         return host, chunk
 

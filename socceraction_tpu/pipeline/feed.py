@@ -5,15 +5,16 @@ device feed:
 
 1. **read** — the next chunk's per-game files are fetched and decoded
    concurrently through :meth:`SeasonStore.get_many` (thread-pool fan-out
-   on the parquet engine; ``pipeline/read_actions`` wall +
-   ``pipeline/read_io``/``pipeline/decode`` per-file stage timers);
+   on the parquet engine; ``stage=read`` wall + ``stage=read_io``/
+   ``stage=decode`` per-file samples of the labeled
+   ``pipeline/stage_seconds`` histogram);
 2. **pack** — the frames are packed into a host *staging* batch
-   (``as_numpy=True`` — no implicit device copy; ``pipeline/pack``);
+   (``as_numpy=True`` — no implicit device copy; ``stage=pack``);
 3. **transfer** — the staging batch is shipped over the minimal wire
    format (stacked floats, int8-narrowed ids, flags, lengths) with
    ``jax.device_put`` and rebuilt by a jitted device-side unpack
    (:func:`~socceraction_tpu.pipeline.packed.ship_host_batch`;
-   ``pipeline/transfer``).
+   ``stage=transfer``).
 
 With ``prefetch=0`` the overlap comes from JAX's asynchronous dispatch
 alone (the consumer must return promptly); with ``prefetch > 0`` a
@@ -21,8 +22,9 @@ background worker thread runs all three stages ahead through a bounded
 queue, so the transfer of batch N+1 overlaps device compute on batch N
 even when the consumer blocks on device results — genuine double
 buffering at ``prefetch=2``. The queue depth observed at every consumer
-take is recorded under ``pipeline/feed_queue_depth``, and the time the
-consumer spends *blocked* on the queue under ``pipeline/feed_wait`` —
+take is recorded in the ``pipeline/feed_queue_depth`` gauge (a true
+dimensionless gauge, ``unit='chunks'``), and the time the consumer
+spends *blocked* on the queue under ``stage=feed_wait`` —
 the direct measure of a host-bound feed (a large wait fraction means the
 host could not keep the device fed; depth alone is ambiguous for
 consumers that dispatch asynchronously). The worker is cancelled (stop
@@ -33,8 +35,8 @@ from __future__ import annotations
 
 from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
+from socceraction_tpu.obs import gauge, span, timed_labels
 from socceraction_tpu.pipeline.store import SeasonStore
-from socceraction_tpu.utils import record_value, timed
 
 __all__ = ['load_batch', 'iter_batches']
 
@@ -108,14 +110,14 @@ def iter_batches(
     ``packed_cache`` (False | True | path) serves chunks from the
     season's packed memmap cache (:mod:`socceraction_tpu.pipeline.packed`)
     instead of re-parsing the store. A cache hit slices memmaps (timed
-    ``pipeline/read_cache``). On a miss, a full-season stream (the
+    under ``stage=read_cache``). On a miss, a full-season stream (the
     default ``game_ids``) builds the cache *overlapped* with this first
     pass (:func:`~socceraction_tpu.pipeline.build.iter_packed_build`):
     batches flow immediately and the cache publishes when the pass
     completes, so the serial build pass disappears into epoch one. A
     subset/reordered stream falls back to the serial
     :func:`~socceraction_tpu.pipeline.packed.ensure_packed` build
-    (timed ``pipeline/pack_cache_build``). Requires ``max_actions``;
+    (timed under ``stage=pack_cache_build``). Requires ``max_actions``;
     batches are bit-identical to the uncached path either way.
 
     ``family`` selects the SPADL family exactly as in :func:`load_batch`;
@@ -194,23 +196,28 @@ def iter_batches(
         if overlapped is not None:
             yield from overlapped
             return
+        path = 'cache' if season is not None else 'store'
         for lo in range(0, len(game_ids), games_per_batch):
             chunk = list(game_ids[lo : lo + games_per_batch])
             if drop_remainder and len(chunk) < games_per_batch:
                 return
-            if season is not None:
-                # take() times its own read_cache / transfer stages
-                yield season.take(chunk, device=device)
-                continue
-            host = _read_and_pack_chunk(
-                store, fam, chunk, home,
-                max_actions=max_actions, float_dtype=float_dtype,
-            )
-            item = (ship_host_batch(host, family=family, device=device), chunk)
-            # yield OUTSIDE the timers: with prefetch the generator
-            # suspends here on the queue put / consumer, which would
-            # otherwise be charged to a stage and invert bottleneck
-            # attribution
+            # yield OUTSIDE the span and the stage timers: with prefetch
+            # the generator suspends on the queue put / consumer, which
+            # would otherwise be charged to a stage and invert
+            # bottleneck attribution
+            with span('pipeline/chunk', games=len(chunk), path=path):
+                if season is not None:
+                    # take() times its own read_cache / transfer stages
+                    item = season.take(chunk, device=device)
+                else:
+                    host = _read_and_pack_chunk(
+                        store, fam, chunk, home,
+                        max_actions=max_actions, float_dtype=float_dtype,
+                    )
+                    item = (
+                        ship_host_batch(host, family=family, device=device),
+                        chunk,
+                    )
             yield item
 
     if prefetch <= 0:
@@ -266,13 +273,16 @@ def iter_batches(
     threading.Thread(target=worker, daemon=True, name='iter_batches').start()
     try:
         while True:
-            record_value('pipeline/feed_queue_depth', q.qsize())
+            # a TRUE gauge now (unit='chunks'): each sample is the
+            # prefetch depth observed at one consumer take, no longer a
+            # pseudo-timer with seconds-named keys
+            gauge('pipeline/feed_queue_depth', unit='chunks').set(q.qsize())
             # feed_wait accumulates the time the CONSUMER was blocked on
             # the queue — the direct measure of a host-bound feed, robust
             # where stage sums (which overlap device compute on the
             # worker) and the depth gauge (near zero for any consumer
             # that dispatches asynchronously) both mislead
-            with timed('pipeline/feed_wait'):
+            with timed_labels('pipeline/stage_seconds', stage='feed_wait'):
                 item = q.get()
             if item is _END:
                 if failure:

@@ -54,7 +54,7 @@ from socceraction_tpu.core import (
     pack_atomic_actions,
 )
 from socceraction_tpu.pipeline.store import SeasonStore
-from socceraction_tpu.utils import timed
+from socceraction_tpu.obs import timed_labels
 
 __all__ = [
     'FAMILIES',
@@ -144,13 +144,14 @@ def _read_and_pack_chunk(
     streamed feed bit-identical: every path reads the same projected
     columns, packs with the same arguments, and fails loudly on a
     missing/empty/mislabelled game. Stage costs land under the shared
-    ``pipeline/read_actions`` / ``pipeline/pack`` timers.
+    ``stage=read`` / ``stage=pack`` series of the labeled
+    ``pipeline/stage_seconds`` histogram.
     """
-    with timed('pipeline/read_actions'):
+    with timed_labels('pipeline/stage_seconds', stage='read'):
         actions = store.get_concat(
             fam.game_keys(chunk), columns=fam.read_columns
         )
-    with timed('pipeline/pack'):
+    with timed_labels('pipeline/stage_seconds', stage='pack'):
         host, ids = fam.packer(
             actions,
             {gid: home[gid] for gid in chunk},
@@ -314,12 +315,12 @@ class PackedSeason:
         id columns, flags and lengths cross the host→device link; the
         derived fields are rebuilt on device (see module docstring).
 
-        The memmap gather is timed under ``pipeline/read_cache`` and the
-        device dispatch under ``pipeline/transfer`` in the shared timer
-        registry.
+        The memmap gather is timed under ``stage=read_cache`` and the
+        device dispatch under ``stage=transfer`` of the shared
+        ``pipeline/stage_seconds`` histogram.
         """
         fam = self.family
-        with timed('pipeline/read_cache'):
+        with timed_labels('pipeline/stage_seconds', stage='read_cache'):
             idx = np.asarray([self._pos[g] for g in game_ids])
             A = self.max_actions
             n_act = self.n_actions[idx].astype(np.int32)
@@ -354,14 +355,14 @@ def _ship_wire(fam, floats, ints, is_home, n_act, device) -> Any:
     """Transfer the wire arrays and rebuild the batch on device.
 
     Dispatch time (``jax.device_put`` of the four wire arrays + the
-    jitted unpack launch) is recorded under ``pipeline/transfer``; the
+    jitted unpack launch) is recorded under ``stage=transfer``; the
     transfers themselves are asynchronous, so on an accelerator the wall
     time of the actual copy overlaps downstream host work.
     """
     import jax
     import jax.numpy as jnp
 
-    with timed('pipeline/transfer'):
+    with timed_labels('pipeline/stage_seconds', stage='transfer'):
         put = (
             (lambda a: jax.device_put(a, device))
             if device is not None
@@ -714,7 +715,7 @@ def ensure_packed(
     (:meth:`SeasonStore.get_many`) and packed host-side
     (``as_numpy=True``, no device round trip) — into preallocated
     ``.npy`` memmaps, then publishes the directory atomically. Timed
-    under ``pipeline/pack_cache_build`` in the shared timer registry.
+    under ``stage=pack_cache_build`` in the shared stage histogram.
 
     For the streaming first pass, prefer
     ``iter_batches(..., packed_cache=True)``: on a miss it builds this
@@ -731,7 +732,7 @@ def ensure_packed(
     if ps is not None:
         return ps
 
-    with timed('pipeline/pack_cache_build'):
+    with timed_labels('pipeline/stage_seconds', stage='pack_cache_build'):
         writer = PackedSeasonWriter(
             store,
             max_actions=max_actions,

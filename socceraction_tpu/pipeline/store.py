@@ -28,7 +28,7 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 import pandas as pd
 
-from socceraction_tpu.utils import timed
+from socceraction_tpu.obs import timed_labels
 
 __all__ = ['SeasonStore']
 
@@ -238,16 +238,17 @@ class SeasonStore:
         self, key: str, columns: Optional[Sequence[str]] = None
     ) -> pd.DataFrame:
         """One parquet read with the file fetch and the columnar decode
-        attributed separately (``pipeline/read_io`` / ``pipeline/decode``).
+        attributed separately (``stage=read_io`` / ``stage=decode`` of the
+        labeled ``pipeline/stage_seconds`` histogram).
 
         Only the multi-game reader goes through here: the per-stage totals
         are summed across worker threads, so with ``threads > 1`` they can
         legitimately exceed the wall time of the enclosing call (IO and
         decode overlap across files — that overlap is the point).
         """
-        with timed('pipeline/read_io'):
+        with timed_labels('pipeline/stage_seconds', stage='read_io'):
             table = self._read_parquet_table(key, columns)
-        with timed('pipeline/decode'):
+        with timed_labels('pipeline/stage_seconds', stage='decode'):
             return table.to_pandas(use_threads=False)
 
     def get_many(
@@ -294,8 +295,8 @@ class SeasonStore:
     def _read_arrow_staged(
         self, key: str, columns: Optional[Sequence[str]] = None
     ) -> Any:
-        """One per-key parquet file as an Arrow table (``pipeline/read_io``)."""
-        with timed('pipeline/read_io'):
+        """One per-key parquet file as an Arrow table (``stage=read_io``)."""
+        with timed_labels('pipeline/stage_seconds', stage='read_io'):
             return self._read_parquet_table(key, columns)
 
     def get_concat(
@@ -327,7 +328,7 @@ class SeasonStore:
         tables = self._fanout(
             keys, lambda k: self._read_arrow_staged(k, columns), threads
         )
-        with timed('pipeline/decode'):
+        with timed_labels('pipeline/stage_seconds', stage='decode'):
             return pa.concat_tables(tables).to_pandas(use_threads=False)
 
     def _fanout(

@@ -1,4 +1,4 @@
-"""Tracing and profiling hooks.
+"""Tracing and profiling hooks (façade over :mod:`socceraction_tpu.obs`).
 
 Three layers of observability, all optional and zero-cost when unused:
 
@@ -6,13 +6,30 @@ Three layers of observability, all optional and zero-cost when unused:
    captures a device trace (TensorBoard-viewable) for a code region.
 2. :func:`annotate` -- names a region inside a traced/jitted computation via
    ``jax.named_scope`` so it is identifiable in XLA/HLO dumps and profiles.
-3. :class:`Timer` / :func:`timed` -- host-side wall-clock timers for the
-   stages that stay off-device (JSON parsing, event surgery, Arrow packing),
-   aggregated in a process-wide registry readable via :func:`timer_report`.
+3. :class:`Timer` / :func:`timed` / :func:`record_value` /
+   :func:`timer_report` -- the legacy wall-clock timer API, now a thin
+   façade over the typed metric registry
+   (:mod:`socceraction_tpu.obs.metrics`): ``timed(name)`` records into a
+   seconds histogram, ``record_value`` into a true gauge, and
+   ``timer_report()`` renders the legacy flat report from the registry's
+   typed snapshot. Existing call sites keep working unchanged; new code
+   should use :mod:`socceraction_tpu.obs` directly (labels, units,
+   spans, exporters).
 
-The reference library has no equivalent (SURVEY §5: "Tracing / profiling:
-none"); this subsystem is new, designed for the TPU runtime where host-side
-ingest and device-side kernels need to be attributed separately.
+The report shim translates the labeled pipeline stage histogram
+(``pipeline/stage_seconds{stage=...}``) back to the pre-obs flat names
+(``pipeline/read_actions``, ``pipeline/pack``, ...) and includes the
+queue-depth gauge, so pre-obs consumers of ``timer_report()`` see the
+same keys they always did. Entries now carry unit-correct
+``count/total/mean/max`` keys plus a ``unit`` field; the old
+``total_s``/``mean_s``/``max_s`` keys remain as deprecated aliases (only
+actually seconds when ``unit == 's'``).
+
+jax is imported lazily, only by the paths that need it (device
+synchronization, named scopes, profiler traces): the registry façade
+must stay importable by jax-free processes — the SeasonStore read path
+times its stages from data-prep/bootstrap contexts that must not pay,
+or depend on, a jax import.
 """
 
 from __future__ import annotations
@@ -20,96 +37,188 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Any, ContextManager, Dict, Iterator
+from typing import Any, Callable, ContextManager, Dict, Iterator, Optional, Union
 
-# jax is imported lazily, only by the paths that need it (device
-# synchronization, named scopes, profiler traces): the wall-clock timer
-# registry itself must stay importable by jax-free processes — the
-# SeasonStore read path times its stages from data-prep/bootstrap
-# contexts that must not pay, or depend on, a jax import
+from socceraction_tpu.obs import metrics as _metrics
+from socceraction_tpu.obs.export import timer_report_compat
 
-_registry_lock = threading.Lock()
-_timers: Dict[str, 'Timer'] = {}
+__all__ = [
+    'Timer',
+    'annotate',
+    'profile_trace',
+    'record_value',
+    'timed',
+    'timer_report',
+]
+
+#: the labeled stage histogram the pipeline records into, and the legacy
+#: flat names ``timer_report()`` keeps publishing them under
+STAGE_SECONDS = 'pipeline/stage_seconds'
+LEGACY_STAGE_NAMES: Dict[str, str] = {
+    'read': 'pipeline/read_actions',
+    'read_io': 'pipeline/read_io',
+    'decode': 'pipeline/decode',
+    'pack': 'pipeline/pack',
+    'transfer': 'pipeline/transfer',
+    'read_cache': 'pipeline/read_cache',
+    'cache_write': 'pipeline/cache_write',
+    'pack_cache_build': 'pipeline/pack_cache_build',
+    'load_events': 'pipeline/load_events',
+    'convert': 'pipeline/convert',
+    'feed_wait': 'pipeline/feed_wait',
+}
+_FEED_QUEUE_DEPTH = 'pipeline/feed_queue_depth'
+
+# names created through this façade (timed / record_value): the report
+# publishes exactly these plus the pipeline mappings above, so metrics
+# recorded through the obs API proper don't leak into legacy consumers'
+# output (e.g. the walkthrough's printed timer table)
+_legacy_lock = threading.Lock()
+_legacy_names: set = set()
 
 
 class Timer:
-    """Accumulating wall-clock timer (count, total, max) for one stage."""
+    """Legacy accumulating timer view over one histogram series."""
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, _series: Optional[_metrics.Series] = None) -> None:
         self.name = name
-        self.count = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
-        self._lock = threading.Lock()
+        self._series = (
+            _series
+            if _series is not None
+            else _metrics.histogram(name, unit='s').labels()
+        )
+        self._sync_targets: list = []
 
     def add(self, elapsed_s: float) -> None:
         """Record one timed interval of ``elapsed_s`` seconds."""
-        with self._lock:
-            self.count += 1
-            self.total_s += elapsed_s
-            self.max_s = max(self.max_s, elapsed_s)
+        self._series.observe(elapsed_s)
+
+    def sync(self, value: Any) -> Any:
+        """Register device output(s) produced in the timed region.
+
+        At context exit only these values are synchronized
+        (``jax.block_until_ready``), so the stage is charged for its own
+        device work and nothing else. Returns ``value`` unchanged for
+        inline use: ``out = t.sync(kernel(x))``.
+        """
+        self._sync_targets.append(value)
+        return value
+
+    @property
+    def count(self) -> int:
+        """Recorded interval count."""
+        return self._series.count
+
+    @property
+    def total_s(self) -> float:
+        """Sum of recorded seconds."""
+        return self._series.total
+
+    @property
+    def max_s(self) -> float:
+        """Largest recorded interval (0.0 while empty)."""
+        m = self._series.max
+        return 0.0 if m != m else m  # NaN while empty
 
     def as_dict(self) -> Dict[str, float]:
         """Snapshot: count plus total/mean/max seconds."""
+        count = self.count
+        total = self.total_s
         return {
-            'count': self.count,
-            'total_s': self.total_s,
-            'mean_s': self.total_s / self.count if self.count else 0.0,
+            'count': count,
+            'total_s': total,
+            'mean_s': total / count if count else 0.0,
             'max_s': self.max_s,
         }
 
 
-def _get_timer(name: str) -> Timer:
-    with _registry_lock:
-        timer = _timers.get(name)
-        if timer is None:
-            timer = _timers[name] = Timer(name)
-        return timer
-
-
 @contextlib.contextmanager
-def timed(name: str, *, block_until_ready: bool = False) -> Iterator[Timer]:
-    """Time a host-side stage and record it under ``name``.
+def timed(
+    name: str,
+    *,
+    block_until_ready: bool = False,
+    sync: Union[None, Any, Callable[[], Any]] = None,
+) -> Iterator[Timer]:
+    """Time a host-side stage and record it under ``name`` (seconds).
 
-    With ``block_until_ready=True`` the context exit synchronizes all live
-    JAX arrays first, so asynchronously dispatched device work is charged to
-    the stage that launched it.
+    Device-synced timing charges only this stage's own work: pass the
+    arrays (or a zero-arg callable returning them) as ``sync=``, or
+    register outputs produced inside the region via
+    :meth:`Timer.sync` — the exit then waits on exactly those values.
+
+    ``block_until_ready=True`` *without* any registered sync target
+    falls back to the legacy behavior of synchronizing **all** live JAX
+    arrays, which charges unrelated in-flight work to this stage — kept
+    for backward compatibility, deprecated; prefer ``sync=`` /
+    ``Timer.sync``.
     """
-    timer = _get_timer(name)
+    with _legacy_lock:
+        _legacy_names.add(name)
+    timer = Timer(name)
     t0 = time.perf_counter()
     try:
         yield timer
     finally:
-        if block_until_ready:
+        targets = list(timer._sync_targets)
+        if sync is not None:
+            targets.append(sync() if callable(sync) else sync)
+        if targets:
             import jax
 
-            # jax.effects_barrier() only waits on *effectful* computations;
-            # pure async dispatches leave no runtime token, so block on the
-            # live arrays themselves to charge device time to this stage.
+            jax.block_until_ready(targets)
+        elif block_until_ready:
+            import jax
+
+            # Legacy coarse sync: jax.effects_barrier() only waits on
+            # *effectful* computations, so block on all live arrays —
+            # note this charges ANY in-flight device work to this stage.
             jax.block_until_ready(jax.live_arrays())
         timer.add(time.perf_counter() - t0)
 
 
 def record_value(name: str, value: float) -> None:
-    """Record a dimensionless sample (gauge) into the shared registry.
+    """Record a dimensionless sample into a gauge in the shared registry.
 
-    The registry's accumulators are unit-agnostic: ``count``/``total_s``/
-    ``mean_s``/``max_s`` read as count/total/mean/max of whatever was
-    recorded. Used for non-time series that want the same report plumbing
-    as the stage timers — e.g. ``pipeline/feed_queue_depth``, where each
-    sample is the prefetch queue depth observed at one consumer take, so
-    ``mean_s`` is the average buffered-chunk count (producer ahead) and a
-    mean near zero means the consumer is starved (host-bound feed).
+    The legacy spelling of ``obs.gauge(name).set(value)``: the series
+    reports under unit-correct ``count/total/mean/max`` keys with
+    ``unit='value'`` (the pre-obs ``*_s`` keys remain as deprecated
+    aliases). When the name is already registered as a gauge with a real
+    unit (e.g. the feed's ``pipeline/feed_queue_depth`` gauge,
+    ``unit='chunks'``), the sample lands on that gauge — the legacy
+    spelling and the obs spelling of one metric must interoperate, not
+    conflict. A name registered as a different *kind* (a ``timed``
+    histogram) still raises. Prefer the obs API directly for new code —
+    it can also carry labels and a real unit.
     """
-    _get_timer(name).add(float(value))
+    with _legacy_lock:
+        _legacy_names.add(name)
+    inst = _metrics.REGISTRY.get(name)
+    if isinstance(inst, _metrics.Gauge):
+        inst.set(float(value))
+        return
+    _metrics.gauge(name, unit='value').set(float(value))
 
 
 def timer_report(reset: bool = False) -> Dict[str, Dict[str, float]]:
-    """Snapshot of all timers as ``{name: {count, total_s, mean_s, max_s}}``."""
-    with _registry_lock:
-        report = {name: t.as_dict() for name, t in sorted(_timers.items())}
-        if reset:
-            _timers.clear()
+    """Legacy flat report ``{name: {count, total, mean, max, unit, ...}}``.
+
+    Rendered from the typed registry snapshot: façade-recorded series
+    under their own names, the labeled pipeline stage histogram under
+    the pre-obs flat names, and the feed queue-depth gauge. ``reset``
+    zeroes every registry series in place (instruments stay registered).
+    """
+    snapshot = _metrics.REGISTRY.snapshot()
+    with _legacy_lock:
+        spec: Dict[str, Any] = {
+            n: n for n in _legacy_names if n in snapshot.instruments
+        }
+    for stage, legacy in LEGACY_STAGE_NAMES.items():
+        spec[legacy] = (STAGE_SECONDS, {'stage': stage})
+    if _FEED_QUEUE_DEPTH in snapshot.instruments:
+        spec[_FEED_QUEUE_DEPTH] = _FEED_QUEUE_DEPTH
+    report = timer_report_compat(snapshot, spec)
+    if reset:
+        _metrics.REGISTRY.reset()
     return report
 
 

@@ -19,6 +19,7 @@ API parity: reference ``socceraction/vaep/base.py`` (``VAEP`` with
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -27,6 +28,7 @@ import pandas as pd
 from sklearn.metrics import brier_score_loss, roc_auc_score
 
 from .. import spadl as _spadl_pkg
+from ..obs import counter, gauge, histogram, span
 from ..config import DEFAULT_BACKEND, NB_PREV_ACTIONS
 from ..core.batch import ActionBatch, pack_actions, unpack_values
 from ..ml.learners import LEARNERS
@@ -340,6 +342,17 @@ class VAEP:
         feature tensor is never materialized. Both paths are numerically
         equivalent (``tests/test_fused.py``); ``SOCCERACTION_TPU_RATING_PATH``
         forces either one.
+
+        Every call reports to the telemetry registry
+        (:mod:`socceraction_tpu.obs`) under ``(path, platform)`` labels:
+        valid-action batch size (``vaep/rate_batch_actions``), dispatch
+        wall time (``vaep/rate_batch_seconds``), the running rated-action
+        counter (``vaep/rated_actions``) and a derived
+        ``vaep/rate_actions_per_sec`` gauge — all measured at *dispatch*,
+        so on an asynchronous backend they bound the host-side cost, not
+        device throughput (the rating itself is deliberately never
+        synced here; ``bench.py`` owns the synced throughput numbers).
+        The region runs inside a ``vaep/rate_batch`` span.
         """
         if not self._models:
             raise NotFittedError('fit the model before calling rate')
@@ -348,28 +361,51 @@ class VAEP:
         path = preferred_rating_path()
         from ..ops.profile import FUSED_PATH_HIDDEN_DTYPES, hidden_dtype_for
 
-        if self._can_fuse() and path in FUSED_PATH_HIDDEN_DTYPES:
-            from ..ops.fused import fused_pair_probs
+        fused = self._can_fuse() and path in FUSED_PATH_HIDDEN_DTYPES
+        selected = path if fused else 'materialized'
+        labels = {'path': selected, 'platform': jax.default_backend()}
+        t0 = time.perf_counter()
+        with span('vaep/rate_batch', games=batch.n_games, **labels):
+            if fused:
+                from ..ops.fused import fused_pair_probs
 
-            # one jitted trace for both heads so XLA shares the per-state
-            # views and dense feature blocks between them
-            cols = list(self._label_columns)
-            pair = fused_pair_probs(
-                self._models[cols[0]],
-                self._models[cols[1]],
+                # one jitted trace for both heads so XLA shares the
+                # per-state views and dense feature blocks between them
+                cols = list(self._label_columns)
+                pair = fused_pair_probs(
+                    self._models[cols[0]],
+                    self._models[cols[1]],
+                    batch,
+                    names=self._kernel_names(),
+                    k=self.nb_prev_actions,
+                    registry_name=self._fused_registry,
+                    hidden_dtype=hidden_dtype_for(path),
+                )
+                probs = dict(zip(cols, pair))
+            else:
+                feats = self.compute_features_batch(batch)
+                probs = self._estimate_probabilities_batch(feats)
+            values = self._formula_kernel(
                 batch,
-                names=self._kernel_names(),
-                k=self.nb_prev_actions,
-                registry_name=self._fused_registry,
-                hidden_dtype=hidden_dtype_for(path),
+                probs[self._label_columns[0]],
+                probs[self._label_columns[1]],
             )
-            probs = dict(zip(cols, pair))
-        else:
-            feats = self.compute_features_batch(batch)
-            probs = self._estimate_probabilities_batch(feats)
-        return self._formula_kernel(
-            batch, probs[self._label_columns[0]], probs[self._label_columns[1]]
+        # n_actions is a pack-time input, ready independently of the
+        # rating computation — fetching it does NOT sync the dispatch
+        dispatch_s = time.perf_counter() - t0
+        n_actions = batch.total_actions
+        histogram('vaep/rate_batch_actions', unit='actions').observe(
+            n_actions, **labels
         )
+        histogram('vaep/rate_batch_seconds', unit='s').observe(
+            dispatch_s, **labels
+        )
+        counter('vaep/rated_actions', unit='actions').inc(n_actions, **labels)
+        if dispatch_s > 0:
+            gauge('vaep/rate_actions_per_sec', unit='actions/s').set(
+                n_actions / dispatch_s, **labels
+            )
+        return values
 
     def score(self, X: pd.DataFrame, y: pd.DataFrame) -> Dict[str, Dict[str, float]]:
         """Brier score and ROC-AUC of both probability models."""

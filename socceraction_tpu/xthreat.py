@@ -26,12 +26,15 @@ to multi-chip by psum-reducing :class:`~socceraction_tpu.ops.xt.XTCounts`.
 from __future__ import annotations
 
 import json
+import math
 import os
+import time
 from typing import Callable, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
 
+from .obs import gauge, histogram, span
 from .spadl import config as spadlconfig
 
 try:  # pragma: no cover - import guard mirrors optional-dependency handling
@@ -263,6 +266,12 @@ class ExpectedThreat:
         # solver auto-resolution tracks w/l, which may change after
         # construction, so fit time is the only reliable point to check)
         self.n_iter: int = 0
+        #: residual the solver last tested before exiting (``max(new - old)``
+        #: Picard / ``max|f(x) - x|`` Anderson): ``<= eps`` after a normally
+        #: converged ``fit``, larger when ``max_iter`` cut the loop, ``None``
+        #: before fitting. Recorded per fit in the ``xt/solve_residual``
+        #: gauge of the telemetry registry.
+        self.solve_residual: Optional[float] = None
         self.heatmaps: List[np.ndarray] = []
         self.xT: np.ndarray = np.zeros((w, l))
         self.scoring_prob_matrix: Optional[np.ndarray] = None
@@ -290,17 +299,20 @@ class ExpectedThreat:
         if self.keep_heatmaps:
             self.heatmaps.append(xT.copy())
         it = 0
+        resid = None
         while it < self.max_iter:
             new = sweep(xT)
             diff = new - xT
             xT = new
             it += 1
+            resid = float(np.max(diff))
             if self.keep_heatmaps:
                 self.heatmaps.append(xT.copy())
             if not np.any(diff > self.eps):
                 break
         self.xT = xT
         self.n_iter = it
+        self.solve_residual = resid
 
     def _solve_numpy(self) -> None:
         gs = self.scoring_prob_matrix * self.shot_prob_matrix
@@ -351,7 +363,7 @@ class ExpectedThreat:
                     "keep_heatmaps on the JAX backend requires solver='dense' "
                     "(use backend='pandas' for matrix-free heatmaps)"
                 )
-            xT, it, p_score, p_shot, p_move = _xtops.solve_xt_matrix_free(
+            xT, it, p_score, p_shot, p_move, resid = _xtops.solve_xt_matrix_free(
                 batch.type_id,
                 batch.result_id,
                 batch.start_x,
@@ -364,6 +376,7 @@ class ExpectedThreat:
                 eps=self.eps,
                 max_iter=self.max_iter,
                 accelerate=self.accelerate,
+                return_residual=True,
             )
             self.scoring_prob_matrix = np.asarray(p_score, dtype=np.float64)
             self.shot_prob_matrix = np.asarray(p_shot, dtype=np.float64)
@@ -371,6 +384,8 @@ class ExpectedThreat:
             self.transition_matrix = None
             self.xT = np.asarray(xT, dtype=np.float64)
             self.n_iter = int(it)
+            r = float(resid)
+            self.solve_residual = r if math.isfinite(r) else None
             return
         counts = _xtops.xt_counts(
             batch.type_id,
@@ -392,12 +407,14 @@ class ExpectedThreat:
             # Host-stepped sweeps so every intermediate surface can be kept.
             self._solve_numpy()
         else:
-            xT, it = _xtops.solve_xt(
+            xT, it, resid = _xtops.solve_xt(
                 probs, eps=self.eps, max_iter=self.max_iter,
-                accelerate=self.accelerate,
+                accelerate=self.accelerate, return_residual=True,
             )
             self.xT = np.asarray(xT, dtype=np.float64)
             self.n_iter = int(it)
+            r = float(resid)
+            self.solve_residual = r if math.isfinite(r) else None
 
     def _as_batch(self, actions: Actions) -> 'ActionBatch':
         if isinstance(actions, pd.DataFrame):
@@ -423,16 +440,48 @@ class ExpectedThreat:
         return actions
 
     def fit(self, actions: Actions) -> 'ExpectedThreat':
-        """Fit the model on SPADL actions (DataFrame or packed batch)."""
+        """Fit the model on SPADL actions (DataFrame or packed batch).
+
+        Each fit reports to the telemetry registry
+        (:mod:`socceraction_tpu.obs`) under a ``(grid, solver, variant,
+        backend)`` label set: iterations-to-convergence
+        (``xt/solve_iterations``), solve wall time (``xt/solve_seconds``
+        — host-synced, since the iteration count fetch forces the device
+        solve to completion) and the exit residual
+        (``xt/solve_residual``); the whole fit runs inside an ``xt/fit``
+        span.
+        """
         # re-validated here, not only in __init__: backend/accelerate/
         # keep_heatmaps are plain public attributes that may have been
         # mutated since construction (same rationale as the matrix-free/
         # keep_heatmaps check living in _fit_jax)
         _validate_accelerate(self.accelerate, self.backend, self.keep_heatmaps)
-        if self.backend == 'jax':
-            self._fit_jax(self._as_batch(actions))
-        else:
-            self._fit_pandas(actions)
+        labels = {
+            'grid': f'{self.l}x{self.w}',
+            'solver': self.solver,
+            'variant': 'anderson' if self.accelerate else 'picard',
+            'backend': self.backend,
+        }
+        t0 = time.perf_counter()
+        with span('xt/fit', **labels):
+            if self.backend == 'jax':
+                self._fit_jax(self._as_batch(actions))
+            else:
+                self._fit_pandas(actions)
+        solve_s = time.perf_counter() - t0
+        # grid is user-controlled (any l×w), so these instruments collapse
+        # past-budget label sets into the reserved {overflow="true"} series
+        # instead of raising — telemetry degrades, fit() never crashes
+        histogram(
+            'xt/solve_iterations', unit='iterations', on_overflow='overflow'
+        ).observe(self.n_iter, **labels)
+        histogram(
+            'xt/solve_seconds', unit='s', on_overflow='overflow'
+        ).observe(solve_s, **labels)
+        if self.solve_residual is not None:
+            gauge(
+                'xt/solve_residual', unit='value', on_overflow='overflow'
+            ).set(self.solve_residual, **labels)
         return self
 
     # -- inference ---------------------------------------------------------

@@ -55,6 +55,36 @@ def test_per_call_marginal_and_degenerate():
     assert not reliable and dt == pytest.approx(0.04)
 
 
+def test_stage_breakdown_reads_the_typed_snapshot():
+    """The breakdown is built from the obs registry's typed snapshot
+    (labeled series addressed by (name, labels)) — no string-prefix
+    scraping of a flat timer report, which the source must not even
+    reference anymore."""
+    sys.path.insert(0, _ROOT)
+    from bench import _stage_breakdown
+    from socceraction_tpu.obs.metrics import MetricRegistry
+
+    reg = MetricRegistry()
+    h = reg.histogram('pipeline/stage_seconds', unit='s')
+    h.observe(1.25, stage='read')
+    h.observe(0.5, stage='pack')
+    h.observe(0.25, stage='feed_wait')
+    g = reg.gauge('pipeline/feed_queue_depth', unit='chunks')
+    g.set(1)
+    g.set(2)
+    out = _stage_breakdown(reg.snapshot())
+    assert out['read_s'] == 1.25 and out['pack_s'] == 0.5
+    assert out['feed_wait_s'] == 0.25
+    assert out['read_cache_s'] == 0.0  # absent stage degrades to zero
+    assert out['queue_depth_mean'] == 1.5 and out['queue_depth_max'] == 2
+    # empty snapshot: all-zero breakdown, never a KeyError
+    empty = _stage_breakdown(MetricRegistry().snapshot())
+    assert set(empty) == set(out) and empty['queue_depth_max'] == 0.0
+    with open(os.path.join(_ROOT, 'bench.py'), encoding='utf-8') as f:
+        src = f.read()
+    assert 'timer_report' not in src, 'bench.py regressed to the flat report'
+
+
 def test_triage_short_circuits_on_forced_cpu(monkeypatch):
     sys.path.insert(0, _ROOT)
     from bench import _triage_tunnel
@@ -106,6 +136,25 @@ def test_impl_headline_contract():
     assert {'fused_actions_per_sec', 'materialized_actions_per_sec'} <= set(d)
     # off-chip default: extras are skipped, not attempted
     assert 'extra_configs_skipped' in d
+    # the artifact embeds its run manifest: platform, device kind and the
+    # selected rating path must be recorded
+    manifest = d['run_manifest']
+    assert manifest['device']['platform'] == 'cpu'
+    assert 'device_kind' in manifest['device']
+    assert manifest['config']['rating_path'] == d['flagship']
+    assert manifest['config']['rating_path'] in ('fused', 'materialized')
+    # ... and a typed metric snapshot (compact: no per-bucket rows),
+    # carrying at least the headline rates as labeled gauge series
+    assert isinstance(d['metric_snapshot'], dict)
+    for inst in d['metric_snapshot'].values():
+        assert {'kind', 'unit', 'series'} <= set(inst)
+        for series in inst['series']:
+            assert 'buckets' not in series
+    bench_rates = d['metric_snapshot']['bench/rate_actions_per_sec']
+    assert bench_rates['unit'] == 'actions/s'
+    assert {s['labels']['path'] for s in bench_rates['series']} == {
+        'fused', 'materialized',
+    }
 
 
 def test_impl_forced_extras_contract():
@@ -138,6 +187,12 @@ def test_impl_forced_extras_contract():
     assert cold['games'] == 8 and cold['actions'] == 8 * 1600
     assert cold['actions_per_sec'] > 0
     assert cold['rating_path'] in ('fused', 'materialized')
-    # host attribution came from the pipeline timer registry
+    # host attribution came from the typed obs snapshot
     assert cold['host_read_s'] >= 0 and cold['host_pack_s'] >= 0
     assert cold['first_batch_s'] <= cold['wall_s'] + 1e-9
+    # the artifact's final snapshot carries the labeled stage histogram of
+    # the last streamed pass (the packed steady-state pass: cache reads)
+    stages = d['metric_snapshot']['pipeline/stage_seconds']
+    assert stages['kind'] == 'histogram' and stages['unit'] == 's'
+    stage_labels = {s['labels']['stage'] for s in stages['series']}
+    assert 'read_cache' in stage_labels

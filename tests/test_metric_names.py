@@ -1,0 +1,81 @@
+"""The static metric-name gate (tools/check_metric_names.py) stays honest.
+
+The tool is part of ``make lint``; these tests pin (1) that the repo
+itself passes it, (2) that it actually detects convention violations and
+unit conflicts, and (3) that its vendored name regex cannot drift from
+the runtime guard in ``obs/metrics.py``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool():
+    spec = importlib.util.spec_from_file_location(
+        'check_metric_names',
+        os.path.join(_ROOT, 'tools', 'check_metric_names.py'),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_passes_the_gate():
+    tool = _tool()
+    targets = [os.path.join(_ROOT, t) for t in tool.DEFAULT_TARGETS]
+    problems, n_sites = tool.check_files(targets)
+    assert problems == []
+    # the instrumented hot paths keep the gate non-vacuous
+    assert n_sites >= 20
+
+
+def test_convention_violation_detected(tmp_path):
+    tool = _tool()
+    bad = tmp_path / 'bad.py'
+    bad.write_text(
+        "from socceraction_tpu.obs import counter, histogram, span\n"
+        "counter('NoSlash').inc()\n"
+        "histogram('Bad/Name', unit='s').observe(1)\n"
+        "with span('fine/name'):\n"
+        "    pass\n"
+    )
+    problems, n_sites = tool.check_files([str(bad)])
+    assert n_sites == 3
+    assert len(problems) == 2
+    assert any("'NoSlash'" in p for p in problems)
+    assert any("'Bad/Name'" in p for p in problems)
+
+
+def test_unit_conflict_detected(tmp_path):
+    tool = _tool()
+    a = tmp_path / 'a.py'
+    a.write_text("histogram('area/latency', unit='s').observe(1)\n")
+    b = tmp_path / 'b.py'
+    b.write_text(
+        "histogram('area/latency', unit='ms').observe(1)\n"
+        "gauge('area/depth', unit='chunks').set(1)\n"
+        "gauge('area/depth', unit='chunks').set(2)\n"
+        # timed() implies unit='s'
+        "with timed('area/latency'):\n"
+        "    pass\n"
+    )
+    problems, _ = tool.check_files([str(a), str(b)])
+    assert len(problems) == 1
+    assert "unit='ms'" in problems[0] and "unit='s'" in problems[0]
+
+
+def test_vendored_regex_matches_runtime_guard():
+    from socceraction_tpu.obs.metrics import NAME_RE
+
+    assert _tool().NAME_RE.pattern == NAME_RE.pattern
+
+
+def test_make_lint_invokes_the_gate():
+    with open(os.path.join(_ROOT, 'Makefile'), encoding='utf-8') as f:
+        makefile = f.read()
+    lint_block = makefile.split('lint:')[1].split('\n\n')[0]
+    assert 'tools/check_metric_names.py' in lint_block

@@ -1,0 +1,519 @@
+"""Tests for the telemetry layer: obs.metrics / obs.trace / obs.export.
+
+Covers the ISSUE-2 acceptance surface: exact counts under concurrent
+multi-thread updates, the label-cardinality guard, span nesting and
+exception paths in the JSONL run log, Prometheus exposition golden text,
+run-log round-trips, the ``timer_report()`` compat shim, scoped device
+sync in ``timed``, jax-free importability, and the end-to-end criterion
+(xT fit + VAEP.rate_batch + one ``iter_batches`` epoch under a
+``RunLog``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.obs import export as obs_export
+from socceraction_tpu.obs import trace as obs_trace
+from socceraction_tpu.obs.metrics import (
+    REGISTRY,
+    CardinalityError,
+    MetricRegistry,
+    timed_labels,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- typed instruments -----------------------------------------------------
+
+
+def test_instrument_basics_and_units():
+    reg = MetricRegistry()
+    c = reg.counter('area/events', unit='count')
+    c.inc()
+    c.inc(2, kind='a')
+    g = reg.gauge('area/depth', unit='chunks')
+    g.set(3)
+    g.set(1)
+    h = reg.histogram('area/latency', unit='s')
+    h.observe(0.25)
+    h.observe(0.75)
+
+    snap = reg.snapshot()
+    assert snap.get('area/events').kind == 'counter'
+    assert snap.get('area/events').unit == 'count'
+    assert snap.value('area/events') == 1
+    assert snap.value('area/events', kind='a') == 2
+    depth = snap.series('area/depth')
+    assert (depth.count, depth.last, depth.max, depth.min) == (2, 1, 3, 1)
+    lat = snap.series('area/latency')
+    assert lat.count == 2 and lat.total == pytest.approx(1.0)
+    assert lat.mean == pytest.approx(0.5)
+    # cumulative bucket counts end at the total count
+    assert lat.buckets[-1][0] == math.inf and lat.buckets[-1][1] == 2
+
+
+def test_name_convention_enforced():
+    reg = MetricRegistry()
+    for bad in ('flat', 'Bad/Name', 'area/', '/stage', 'area/Sta ge'):
+        with pytest.raises(ValueError, match='area/stage'):
+            reg.counter(bad)
+    with pytest.raises(ValueError, match='area/stage'):
+        with obs_trace.span('NotASpanName'):
+            pass
+
+
+def test_kind_and_unit_conflicts_raise():
+    reg = MetricRegistry()
+    reg.histogram('area/x', unit='s')
+    with pytest.raises(ValueError, match='already registered'):
+        reg.gauge('area/x')
+    with pytest.raises(ValueError, match='already registered'):
+        reg.histogram('area/x', unit='ms')
+    # same kind + unit: get-or-create returns the same instrument
+    assert reg.histogram('area/x', unit='s') is reg.histogram('area/x', unit='s')
+
+
+def test_label_cardinality_guard():
+    reg = MetricRegistry()
+    c = reg.counter('area/wide')
+    for i in range(64):
+        c.inc(game=i)
+    with pytest.raises(CardinalityError, match='distinct label sets'):
+        c.inc(game=64)
+    # existing series keep recording after the guard trips
+    c.inc(game=0)
+    assert reg.snapshot().value('area/wide', game=0) == 2
+
+
+def test_overflow_policy_collapses_instead_of_raising():
+    reg = MetricRegistry()
+    h = reg.histogram('area/grid', unit='s', on_overflow='overflow')
+    for i in range(70):
+        h.observe(0.1, grid=f'{i}x{i}')
+    snap = reg.snapshot().get('area/grid')
+    # 64 real series + the one reserved overflow sink, never an exception
+    assert len(snap.series) == 65
+    sink = reg.snapshot().series('area/grid', overflow='true')
+    assert sink.count == 6
+    with pytest.raises(ValueError, match='on_overflow'):
+        reg.histogram('area/other', unit='s', on_overflow='drop')
+
+
+def test_xt_fit_survives_unbounded_grid_label(spadl_actions):
+    """fit() is a core library call: 64+ distinct grid sizes must degrade
+    telemetry into the overflow series, not crash the fit."""
+    from socceraction_tpu.xthreat import ExpectedThreat
+
+    try:
+        # saturate the instrument's label budget, then fit a fresh grid
+        h = REGISTRY.histogram(
+            'xt/solve_iterations', unit='iterations', on_overflow='overflow'
+        )
+        for i in range(h.max_series):
+            h.labels(grid=f'probe{i}', solver='dense',
+                     variant='picard', backend='pandas')
+        model = ExpectedThreat(backend='pandas', l=17, w=13).fit(spadl_actions)
+        assert model.n_iter > 0
+        sink = REGISTRY.snapshot().series(
+            'xt/solve_iterations', overflow='true'
+        )
+        assert sink is not None and sink.count > 0
+    finally:
+        # drop the saturated instruments so later tests' fresh label sets
+        # are not forced into the overflow sink
+        REGISTRY.reset(clear=True)
+
+
+def test_record_value_interoperates_with_typed_gauge():
+    from socceraction_tpu.utils.profiling import record_value, timed
+
+    reg_gauge = REGISTRY.gauge('compat/typed_depth', unit='chunks')
+    reg_gauge.set(1)
+    # the legacy spelling must land on the same gauge, not raise on unit
+    record_value('compat/typed_depth', 5)
+    assert REGISTRY.snapshot().series('compat/typed_depth').count == 2
+    # a genuine kind conflict (timed histogram vs gauge) still raises
+    with timed('compat/a_timer'):
+        pass
+    with pytest.raises(ValueError, match='already registered'):
+        record_value('compat/a_timer', 1.0)
+
+
+def test_prometheus_label_values_are_escaped():
+    reg = MetricRegistry()
+    reg.counter('area/esc').inc(1, detail='say "hi"\nback\\slash')
+    text = obs_export.prometheus_text(reg.snapshot())
+    assert 'detail="say \\"hi\\"\\nback\\\\slash"' in text
+
+
+def test_concurrent_updates_no_lost_samples():
+    reg = MetricRegistry()
+    c = reg.counter('area/hits')
+    h = reg.histogram('area/work', unit='s')
+    n_threads, n_each = 8, 5000
+
+    def worker(tid: int) -> None:
+        for _ in range(n_each):
+            c.inc()
+            h.observe(0.001, worker=tid % 2)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = reg.snapshot()
+    assert snap.series('area/hits').count == n_threads * n_each
+    assert snap.value('area/hits') == n_threads * n_each
+    per_label = [
+        snap.series('area/work', worker=w).count for w in (0, 1)
+    ]
+    assert sum(per_label) == n_threads * n_each
+    # bucket counts must add up too (no torn histogram updates)
+    s = snap.series('area/work', worker=0)
+    assert s.buckets[-1][1] == s.count
+
+
+def test_histogram_quantiles_monotone_and_bounded():
+    reg = MetricRegistry()
+    h = reg.histogram('area/dist', unit='s')
+    rng = np.random.default_rng(0)
+    samples = rng.lognormal(mean=-3.0, sigma=1.0, size=2000)
+    for v in samples:
+        h.observe(float(v))
+    q = reg.snapshot().series('area/dist').quantiles
+    assert q['p50'] <= q['p90'] <= q['p99']
+    assert samples.min() <= q['p50'] <= samples.max()
+    # log-spaced buckets give ~bucket-resolution accuracy near the median
+    assert q['p50'] == pytest.approx(float(np.median(samples)), rel=0.6)
+
+
+def test_reset_zeroes_in_place_and_bound_series_survive():
+    reg = MetricRegistry()
+    series = reg.histogram('area/stage', unit='s').labels(stage='read')
+    series.observe(1.0)
+    reg.reset()
+    assert reg.snapshot().series('area/stage', stage='read').count == 0
+    # a series bound before reset still records into the registry
+    series.observe(2.0)
+    after = reg.snapshot().series('area/stage', stage='read')
+    assert (after.count, after.total) == (1, 2.0)
+    reg.reset(clear=True)
+    assert reg.snapshot().get('area/stage') is None
+
+
+# -- export ----------------------------------------------------------------
+
+
+def test_prometheus_exposition_golden_text():
+    reg = MetricRegistry()
+    reg.counter('area/events', unit='count').inc(3, kind='shot')
+    reg.gauge('pipeline/feed_queue_depth', unit='chunks').set(2)
+    h = reg.histogram('pipeline/stage_seconds', unit='s', buckets=(0.1, 1.0, 10.0))
+    h.observe(0.5, stage='read')
+    h.observe(5.0, stage='read')
+    text = obs_export.prometheus_text(reg.snapshot())
+    assert text == (
+        '# HELP area_events_total area/events (count)\n'
+        '# TYPE area_events_total counter\n'
+        'area_events_total{kind="shot"} 3.0\n'
+        '# HELP pipeline_feed_queue_depth_chunks pipeline/feed_queue_depth (chunks)\n'
+        '# TYPE pipeline_feed_queue_depth_chunks gauge\n'
+        'pipeline_feed_queue_depth_chunks 2.0\n'
+        '# HELP pipeline_stage_seconds pipeline/stage_seconds (s)\n'
+        '# TYPE pipeline_stage_seconds histogram\n'
+        'pipeline_stage_seconds_bucket{stage="read",le="0.1"} 0\n'
+        'pipeline_stage_seconds_bucket{stage="read",le="1.0"} 1\n'
+        'pipeline_stage_seconds_bucket{stage="read",le="10.0"} 2\n'
+        'pipeline_stage_seconds_bucket{stage="read",le="+Inf"} 2\n'
+        'pipeline_stage_seconds_sum{stage="read"} 5.5\n'
+        'pipeline_stage_seconds_count{stage="read"} 2\n'
+    )
+
+
+def test_snapshot_dict_is_json_roundtrippable():
+    reg = MetricRegistry()
+    reg.histogram('area/latency', unit='s').observe(0.5, stage='read')
+    reg.gauge('area/depth', unit='chunks').set(1)
+    d = obs_export.snapshot_dict(reg.snapshot())
+    back = json.loads(json.dumps(d))
+    series = back['area/latency']['series'][0]
+    assert series['labels'] == {'stage': 'read'}
+    assert series['count'] == 1 and series['total'] == 0.5
+    assert any(b['le'] == '+Inf' for b in series['buckets'])
+    compact = obs_export.snapshot_dict(reg.snapshot(), buckets=False)
+    assert 'buckets' not in compact['area/latency']['series'][0]
+
+
+# -- spans + run log -------------------------------------------------------
+
+
+def _assert_spans_nest(events):
+    """Within each thread, span_close must pop the innermost open span."""
+    stacks = {}
+    pairs = 0
+    for e in events:
+        stack = stacks.setdefault(e['thread'], [])
+        if e['event'] == 'span_open':
+            stack.append(e['span_id'])
+        elif e['event'] == 'span_close':
+            assert stack and stack[-1] == e['span_id'], (
+                f'span_close {e["name"]} does not match the innermost '
+                f'open span on thread {e["thread"]}'
+            )
+            stack.pop()
+            pairs += 1
+    assert all(not s for s in stacks.values()), 'unclosed spans remain'
+    return pairs
+
+
+def test_span_nesting_exception_paths_and_jsonl_roundtrip(tmp_path):
+    with obs_trace.RunLog(str(tmp_path), config={'probe': 1}) as log:
+        with obs_trace.span('probe/outer', phase='demo') as outer:
+            with obs_trace.span('probe/inner'):
+                pass
+        with pytest.raises(RuntimeError, match='boom'):
+            with obs_trace.span('probe/fails'):
+                raise RuntimeError('boom')
+        log.event('custom', marker=True)
+
+    events = [
+        json.loads(line)
+        for line in open(tmp_path / 'obs.jsonl', encoding='utf-8')
+    ]
+    kinds = [e['event'] for e in events]
+    assert kinds[0] == 'run_start' and kinds[-1] == 'run_end'
+    assert kinds[-2] == 'metrics'  # the close-time snapshot
+    manifest = events[0]['manifest']
+    assert manifest['config'] == {'probe': 1}
+    assert manifest['pid'] == os.getpid()
+
+    assert _assert_spans_nest(events) == 3
+    by_name = {
+        e['name']: e for e in events if e['event'] == 'span_close'
+    }
+    assert by_name['probe/inner']['parent_id'] == outer.span_id
+    assert by_name['probe/outer']['parent_id'] is None
+    assert by_name['probe/outer']['attrs'] == {'phase': 'demo'}
+    assert by_name['probe/outer']['status'] == 'ok'
+    assert by_name['probe/fails']['status'] == 'error'
+    assert 'RuntimeError: boom' in by_name['probe/fails']['error']
+    assert all(e['duration_s'] >= 0 for e in by_name.values())
+    # after close, spans stop logging and the sink is inert
+    with obs_trace.span('probe/after'):
+        pass
+    log.event('late')
+    assert sum(1 for _ in open(tmp_path / 'obs.jsonl')) == len(events)
+
+
+def test_runlog_rotation_and_exclusive_activation(tmp_path):
+    log = obs_trace.RunLog(
+        str(tmp_path / 'obs.jsonl'), max_bytes=512, keep=2
+    )
+    with log:
+        with pytest.raises(RuntimeError, match='already active'):
+            obs_trace.RunLog(str(tmp_path / 'other.jsonl')).open()
+        for i in range(50):
+            log.event('filler', i=i, payload='x' * 64)
+    assert os.path.exists(tmp_path / 'obs.jsonl.1')
+    # every surviving line is intact JSON
+    for name in ('obs.jsonl', 'obs.jsonl.1'):
+        for line in open(tmp_path / name, encoding='utf-8'):
+            json.loads(line)
+    # the second run log can activate once the first closed
+    with obs_trace.RunLog(str(tmp_path / 'other.jsonl')):
+        pass
+    assert obs_trace.current_runlog() is None
+
+
+# -- the profiling façade --------------------------------------------------
+
+
+def test_timer_report_compat_shim():
+    from socceraction_tpu.utils.profiling import (
+        record_value,
+        timed,
+        timer_report,
+    )
+
+    timer_report(reset=True)
+    with timed('compat/stage'):
+        pass
+    record_value('compat/level', 4.0)
+    with timed_labels('pipeline/stage_seconds', stage='read'):
+        pass
+    REGISTRY.gauge('pipeline/feed_queue_depth', unit='chunks').set(2)
+
+    report = timer_report()
+    # façade timers: unit-correct keys + deprecated *_s aliases
+    stage = report['compat/stage']
+    assert stage['unit'] == 's' and stage['count'] == 1
+    assert stage['total_s'] == stage['total']
+    # dimensionless series carry their real unit; *_s keys are aliases
+    level = report['compat/level']
+    assert level['unit'] == 'value'
+    assert level['total'] == 4.0 and level['total_s'] == 4.0
+    # the labeled stage histogram surfaces under the legacy flat name
+    assert report['pipeline/read_actions']['count'] == 1
+    assert report['pipeline/feed_queue_depth']['unit'] == 'chunks'
+    assert report['pipeline/feed_queue_depth']['max'] == 2
+    # obs-native metrics do NOT leak into the legacy report
+    REGISTRY.histogram('vaep/rate_batch_seconds', unit='s').observe(0.1, path='fused')
+    assert 'vaep/rate_batch_seconds' not in timer_report()
+    # reset zeroes; zeroed series drop from the report
+    assert 'compat/stage' in timer_report(reset=True)
+    assert 'compat/stage' not in timer_report()
+
+
+def test_timed_sync_charges_only_registered_arrays(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from socceraction_tpu.utils.profiling import timed
+
+    synced = []
+    monkeypatch.setattr(
+        jax, 'block_until_ready', lambda x: synced.append(x) or x
+    )
+    unrelated = jnp.ones((4,))
+
+    with timed('compat/scoped') as t:
+        mine = t.sync(jnp.zeros((2,)))
+    assert len(synced) == 1
+    (targets,) = synced
+    assert any(x is mine for x in targets)
+    assert not any(x is unrelated for x in targets)
+
+    # explicit operand form: a zero-arg callable evaluated at exit
+    synced.clear()
+    out = jnp.ones((3,))
+    with timed('compat/scoped', sync=lambda: out):
+        pass
+    assert any(x is out for x in synced[0])
+
+    # legacy block_until_ready=True with no targets still syncs globally
+    synced.clear()
+    monkeypatch.setattr(jax, 'live_arrays', lambda: [unrelated])
+    with timed('compat/scoped', block_until_ready=True):
+        pass
+    assert any(x is unrelated for x in synced[0])
+
+
+def test_obs_and_facade_are_jax_free():
+    """The registry, spans, run log, exporters and the profiling façade
+    must import and run in a process where jax cannot be imported."""
+    code = (
+        'import builtins, sys\n'
+        'real = builtins.__import__\n'
+        'def blocker(name, *a, **k):\n'
+        "    if name == 'jax' or name.startswith('jax.'):\n"
+        "        raise ImportError('jax is blocked in this process')\n"
+        '    return real(name, *a, **k)\n'
+        'builtins.__import__ = blocker\n'
+        'from socceraction_tpu.obs import (\n'
+        '    REGISTRY, RunLog, counter, histogram, prometheus_text,\n'
+        '    snapshot_dict, span,\n'
+        ')\n'
+        'from socceraction_tpu.utils.profiling import timed, timer_report\n'
+        'import tempfile, os\n'
+        "with RunLog(tempfile.mkdtemp(), config={'jaxfree': True}):\n"
+        "    with span('probe/region'):\n"
+        "        with timed('probe/stage'):\n"
+        "            counter('probe/events').inc()\n"
+        "assert timer_report()['probe/stage']['count'] == 1\n"
+        'prometheus_text(REGISTRY.snapshot())\n'
+        'snapshot_dict(REGISTRY.snapshot())\n'
+        "assert 'jax' not in sys.modules\n"
+    )
+    env = dict(os.environ, PYTHONPATH=_ROOT)
+    subprocess.run([sys.executable, '-c', code], check=True, env=env)
+
+
+# -- acceptance: instrumented hot paths under one RunLog -------------------
+
+
+def test_runlog_over_xt_vaep_and_feed_epoch(
+    tmp_path, spadl_actions, home_team_id
+):
+    """The ISSUE-2 acceptance path: an xT fit, a VAEP.rate_batch and one
+    ``iter_batches`` epoch under a RunLog produce an ``obs.jsonl`` whose
+    spans nest correctly, and a Prometheus export listing labeled
+    histograms for the feed stages and the solver iterations."""
+    from socceraction_tpu.pipeline import SeasonStore, iter_batches
+    from socceraction_tpu.vaep.base import VAEP
+    from socceraction_tpu.xthreat import ExpectedThreat
+
+    store_path = str(tmp_path / 'store')
+    with SeasonStore(store_path, mode='w') as store:
+        games = []
+        for gid in range(1, 5):
+            df = spadl_actions.copy()
+            df['game_id'] = gid
+            store.put_actions(gid, df)
+            games.append({'game_id': gid, 'home_team_id': home_team_id})
+        store.put('games', pd.DataFrame(games))
+
+    game = pd.Series({'game_id': 1, 'home_team_id': home_team_id})
+    model = VAEP()
+    X = model.compute_features(game, spadl_actions)
+    y = model.compute_labels(game, spadl_actions)
+    model.fit(X, y, learner='mlp', random_state=0)
+
+    REGISTRY.reset()
+    with obs_trace.RunLog(str(tmp_path), config={'epoch': 0}):
+        xt = ExpectedThreat(backend='jax').fit(spadl_actions)
+        batch = model._pack(spadl_actions, home_team_id)
+        model.rate_batch(batch)
+        with SeasonStore(store_path, mode='r') as store:
+            n = 0
+            with obs_trace.span('train/epoch', epoch=0):
+                for chunk, _ids in iter_batches(
+                    store, 2, max_actions=256, prefetch=1
+                ):
+                    n += int(np.asarray(chunk.mask).sum())
+        assert n == 4 * len(spadl_actions)
+
+    assert 0 < xt.n_iter and xt.solve_residual is not None
+    assert xt.solve_residual <= xt.eps  # converged normally
+
+    events = [
+        json.loads(line)
+        for line in open(tmp_path / 'obs.jsonl', encoding='utf-8')
+    ]
+    _assert_spans_nest(events)
+    names = {e['name'] for e in events if e['event'] == 'span_close'}
+    assert {'xt/fit', 'vaep/rate_batch', 'train/epoch', 'pipeline/chunk'} <= names
+    # the epoch's chunks nest under the epoch span (same thread at
+    # prefetch=1? no — the worker produces them; chunks produced on the
+    # worker thread are roots THERE, which _assert_spans_nest validated)
+    snap = REGISTRY.snapshot()
+    assert snap.value('pipeline/stage_seconds', stage='read') > 0
+    assert snap.series('pipeline/feed_queue_depth').count > 0
+    assert (
+        snap.series(
+            'xt/solve_iterations',
+            grid='16x12', solver='dense', variant='picard', backend='jax',
+        ).count
+        == 1
+    )
+    text = obs_export.prometheus_text(snap)
+    assert 'pipeline_stage_seconds_bucket{stage="read",' in text
+    assert 'pipeline_stage_seconds_bucket{stage="pack",' in text
+    assert 'xt_solve_iterations_bucket{' in text and 'grid="16x12"' in text
+    assert 'vaep_rate_batch_seconds_bucket{' in text
+    assert 'pipeline_feed_queue_depth_chunks{' not in text  # unlabeled gauge
+    assert 'pipeline_feed_queue_depth_chunks ' in text

@@ -246,10 +246,25 @@ def test_prefetch_backpressure_and_order_under_slow_consumer(
         report = timer_report()
         depth = report['pipeline/feed_queue_depth']
         assert depth['count'] == len(sync) + 1  # one sample per take + END
-        assert depth['max_s'] <= 1  # bounded at prefetch=1
+        # a true gauge now: unit-correct keys, seconds-named keys only as
+        # deprecated aliases
+        assert depth['unit'] == 'chunks'
+        assert depth['max'] <= 1  # bounded at prefetch=1
+        assert depth['max_s'] == depth['max']  # deprecated alias
         # the consumer-block timer samples every take (it is the signal
-        # bench.py attributes host-boundedness from)
-        assert report['pipeline/feed_wait']['count'] == len(sync) + 1
+        # bench.py attributes host-boundedness from); it is a labeled
+        # series of the stage histogram surfaced under the legacy name
+        wait = report['pipeline/feed_wait']
+        assert wait['count'] == len(sync) + 1
+        assert wait['unit'] == 's'
+        from socceraction_tpu.obs import REGISTRY
+
+        assert (
+            REGISTRY.snapshot().series(
+                'pipeline/stage_seconds', stage='feed_wait'
+            ).count
+            == len(sync) + 1
+        )
 
 
 def test_iter_batches_static_shapes(tmp_path, spadl_actions):

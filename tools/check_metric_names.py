@@ -1,0 +1,162 @@
+"""Static metric/span name convention gate (AST-based, dependency-free).
+
+The obs registry enforces the ``area/stage`` naming convention and
+unit-conflict detection at *runtime* — but only on the code paths a test
+actually executes. This gate walks the source instead: every call to
+``counter(`` / ``gauge(`` / ``histogram(`` / ``timed(`` / ``timed_labels(``
+/ ``span(`` whose first argument is a string literal is checked against
+the convention (lowercase ``area/stage`` segments,
+``obs/metrics.py::NAME_RE``), and a name registered with two different
+literal ``unit=`` values anywhere in the tree fails as a unit conflict —
+the ``record_value``-gauge-under-seconds-keys bug, caught before runtime.
+
+Dynamic names (f-strings, variables) are out of scope by design: the
+convention applies to the literal registration sites, and the runtime
+guard still covers the rest.
+
+Usage: ``python tools/check_metric_names.py [paths...]`` (defaults to
+the package plus the repo-root scripts, benchmarks, examples and the
+walkthrough — tests are excluded: they intentionally construct invalid
+names to exercise the runtime guard). Exits non-zero on findings.
+Invoked from ``make lint`` and pinned by ``tests/test_metric_names.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: mirror of socceraction_tpu/obs/metrics.py::NAME_RE (kept dependency-free
+#: so the tool runs without importing the package; the test asserts the
+#: two stay identical)
+NAME_RE = re.compile(r'^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)+$')
+
+#: call sites whose first positional string literal is a metric/span name
+NAME_TAKING_CALLS = {
+    'counter', 'gauge', 'histogram', 'timed', 'timed_labels', 'span',
+}
+
+#: implicit units of name-taking calls that never pass ``unit=``
+DEFAULT_UNITS = {
+    'timed': 's',
+    'timed_labels': 's',
+    'histogram': 's',
+    'counter': 'count',
+    'gauge': 'value',
+}
+
+DEFAULT_TARGETS = [
+    'socceraction_tpu',
+    'tools',
+    'benchmarks',
+    'examples',
+    'docs/walkthrough',
+    'bench.py',
+    '__graft_entry__.py',
+]
+
+
+def iter_py_files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith('.py'):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [
+                    d for d in dirs if not d.startswith(('.', '__pycache__'))
+                ]
+                for f in sorted(files):
+                    if f.endswith('.py'):
+                        yield os.path.join(root, f)
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def collect_names(
+    tree: ast.Module, path: str
+) -> Iterator[Tuple[str, str, int, Optional[str]]]:
+    """Yield ``(call, name, lineno, unit_literal_or_None)`` per literal site.
+
+    Span names carry no unit (``None`` sentinel distinct from a metric's
+    implicit default) so a span and a metric may share an area prefix
+    without tripping the unit-conflict rule.
+    """
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        call = _call_name(node.func)
+        if call not in NAME_TAKING_CALLS or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            continue
+        unit: Optional[str] = DEFAULT_UNITS.get(call)
+        for kw in node.keywords:
+            if kw.arg == 'unit':
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, str
+                ):
+                    unit = kw.value.value
+                else:
+                    unit = None  # dynamic unit: skip the conflict check
+        yield call, first.value, node.lineno, unit
+
+
+def check_files(paths: List[str]) -> Tuple[List[str], int]:
+    """(problems, n_sites) over every literal registration site."""
+    problems: List[str] = []
+    units: Dict[str, Tuple[str, str]] = {}  # name -> (unit, first site)
+    n_sites = 0
+    for path in iter_py_files(paths):
+        with open(path, encoding='utf-8') as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:  # the lint gate owns syntax errors
+            problems.append(f'{path}:{e.lineno}: syntax error: {e.msg}')
+            continue
+        for call, name, lineno, unit in collect_names(tree, path):
+            n_sites += 1
+            site = f'{path}:{lineno}'
+            if not NAME_RE.match(name):
+                problems.append(
+                    f'{site}: {call}({name!r}) violates the area/stage '
+                    "naming convention (lowercase segments joined by '/')"
+                )
+                continue
+            if unit is None:
+                continue
+            seen = units.get(name)
+            if seen is None:
+                units[name] = (unit, site)
+            elif seen[0] != unit:
+                problems.append(
+                    f'{site}: {call}({name!r}) with unit={unit!r} conflicts '
+                    f'with unit={seen[0]!r} at {seen[1]}'
+                )
+    return sorted(problems), n_sites
+
+
+def main(argv: List[str]) -> int:
+    targets = argv or DEFAULT_TARGETS
+    problems, n_sites = check_files(targets)
+    for p in problems:
+        print(p)
+    print(
+        f'check_metric_names: {n_sites} literal name site(s), '
+        f'{len(problems)} problem(s)'
+    )
+    return 1 if problems else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
