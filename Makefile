@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -38,6 +38,11 @@ e2e-synthetic:
 
 bench:
 	$(PY) bench.py
+
+# fast CPU pass over the VAEP MLP training configs (fused + materialized,
+# 2 steps / 2 epochs) — catches a broken train kernel without a chip
+bench-smoke:
+	$(PY) bench.py --train-smoke
 
 # regenerate the committed executed-walkthrough outputs (the repo's
 # analog of the reference's executed notebook cells; drift-checked by

@@ -389,41 +389,47 @@ def _bench_extra_configs() -> dict:
         **({} if acc_reliable else {'measurement_unreliable': True}),
     }
 
-    # --- fused VAEP MLP train step (BASELINE config 5's kernel) -----------
-    from socceraction_tpu.parallel import make_mesh, make_train_step, shard_batch
+    # --- VAEP MLP training, both paths (BASELINE config 5 + the fused
+    # --- packed-train rework) ---------------------------------------------
+    out.update(_bench_train_configs(step_games))
 
-    mesh = make_mesh(n_devices=1)
-    batch = synthetic_batch(n_games=step_games, n_actions=1664, seed=3)
-    sharded = shard_batch(batch, mesh)
-    init_fn, step_fn, _ = make_train_step(mesh, _NAMES, k=_K, hidden=(128, 128))
-    n_features = int(
-        compute_features.eval_shape(sharded, names=_NAMES, k=_K).shape[-1]
-    )
-    params, opt_state = init_fn(jax.random.PRNGKey(0), n_features)
+    out['cold_path_stream'] = _bench_cold_path()
 
-    # step_fn donates (params, opt_state); time it by stepping in a chain
+    # the cold-path passes reset the registry between streams (same
+    # zeroed-husk hazard the headline gauges dodge by recording last —
+    # bench_impl); re-record the training gauges from the measured rates
+    # so the artifact's metric_snapshot carries them
+    import jax as _jax
+
+    from socceraction_tpu.obs import gauge as _gauge
+
+    _platform = _jax.devices()[0].platform
+    for metric, config in (
+        ('train/step_actions_per_sec', 'vaep_mlp_train_step'),
+        ('train/epoch_actions_per_sec', 'vaep_mlp_train_epoch'),
+    ):
+        for rate_path in ('fused', 'materialized'):
+            _gauge(metric, unit='actions/s').set(
+                out[config][rate_path]['actions_per_sec'],
+                path=rate_path,
+                platform=_platform,
+            )
+    return out
+
+
+def _chained_latency(n_steps: int) -> float:
+    """Per-call round trip of a serialized chain of trivial kernels.
+
+    Chained steps cannot pipeline (each consumes the previous params), so
+    through the remote tunnel every step pays the full per-execution
+    round trip (~100 ms class) that the throughput paths amortize away;
+    on local hardware this term vanishes. Used to annotate step/epoch
+    times as latency + compute.
+    """
     import time as _time
 
-    params, opt_state, loss = step_fn(params, opt_state, sharded)
-    float(loss)  # fetch barrier (block_until_ready is unreliable on axon)
-    n_steps = 10
+    import jax
 
-    def timed_steps():
-        nonlocal params, opt_state, loss
-        t0 = _time.perf_counter()
-        for _ in range(n_steps):
-            params, opt_state, loss = step_fn(params, opt_state, sharded)
-        float(loss)  # the params chain serializes; the fetch forces the last
-        return (_time.perf_counter() - t0) / n_steps
-
-    # min-of-two against transient tunnel stalls, like _measure
-    dt_step = min(timed_steps(), timed_steps())
-
-    # Chained steps cannot pipeline (each consumes the previous params),
-    # so through the remote tunnel every step pays the full per-execution
-    # round trip (~100 ms class) that the throughput paths amortize away.
-    # Calibrate that latency with a trivially small chained kernel so the
-    # reported step time can be read as latency + compute.
     bump = jax.jit(lambda x: x + 1.0)
     tiny = bump(jax.numpy.zeros((8,), jax.numpy.float32))
     float(tiny[0])
@@ -436,26 +442,179 @@ def _bench_extra_configs() -> dict:
         float(tiny[0])
         return (_time.perf_counter() - t0) / n_steps
 
-    chain_latency = min(timed_chain(), timed_chain())
+    return min(timed_chain(), timed_chain())
+
+
+def _bench_train_configs(step_games: int, *, n_steps: int = 10, n_epochs: int = 3) -> dict:
+    """Training-path benchmark: both configs, both paths, per (path, platform).
+
+    - ``vaep_mlp_train_step``: the full-batch two-head step (features +
+      labels + loss + adam as ONE XLA computation), measured on the
+      **fused** form (packed combined-table fold,
+      ``parallel.make_train_step``) AND a **materialized** twin that
+      builds the ``(G, A, F)`` feature tensor inside the step — the
+      baseline the acceptance gate compares against.
+    - ``vaep_mlp_train_epoch``: the minibatch trainer
+      (:mod:`socceraction_tpu.ml.mlp`): one jitted ``lax.scan`` dispatch
+      per epoch, shuffle drawn on device, ``(params, opt_state)``
+      donated. ``fused`` trains from the packed states
+      (``ops.fused.build_train_states``); ``materialized`` gathers
+      minibatches from the resident feature matrix. This is the config
+      the r5 artifact's 2.88M actions/s number motivated — the packed
+      representation moves ~10% of the bytes per epoch.
+
+    Every rate also lands in the obs registry as
+    ``train/step_actions_per_sec`` / ``train/epoch_actions_per_sec``
+    gauges labeled ``(path, platform)``.
+    """
+    import functools
+    import time as _time
+
+    import jax
+    import optax
+
+    from __graft_entry__ import _K, _NAMES
+    from socceraction_tpu.core.synthetic import synthetic_batch
+    from socceraction_tpu.ml.mlp import MLPClassifier, _EpochTrainer, _MLP
+    from socceraction_tpu.obs import gauge
+    from socceraction_tpu.ops.features import compute_features
+    from socceraction_tpu.ops.labels import scores_concedes
+    from socceraction_tpu.parallel import make_mesh, make_train_step, shard_batch
+    from socceraction_tpu.parallel.vaep import _masked_bce
+
+    platform = jax.devices()[0].platform
+    out: dict = {}
+
+    mesh = make_mesh(n_devices=1)
+    batch = synthetic_batch(n_games=step_games, n_actions=1664, seed=3)
+    sharded = shard_batch(batch, mesh)
+    init_fn, fused_step, _ = make_train_step(mesh, _NAMES, k=_K, hidden=(128, 128))
+    n_features = int(
+        compute_features.eval_shape(sharded, names=_NAMES, k=_K).shape[-1]
+    )
     total = int(batch.total_actions)
-    compute_s = max(dt_step - chain_latency, 0.0)
-    out['vaep_mlp_train_step'] = {
+
+    # the materialized twin of make_train_step's loss: identical protocol,
+    # but the (G, A, F) feature tensor is built in HBM inside the step
+    module = _MLP((128, 128))
+    tx = optax.adam(1e-3)
+
+    def materialized_loss(params, b):
+        feats = compute_features(b, names=_NAMES, k=_K)
+        ys, yc = scores_concedes(b)
+        logit_s = module.apply(params['scores'], feats)
+        logit_c = module.apply(params['concedes'], feats)
+        return _masked_bce(logit_s, ys, b.mask) + _masked_bce(
+            logit_c, yc, b.mask
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def materialized_step(params, opt_state, b):
+        loss, grads = jax.value_and_grad(materialized_loss)(params, b)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def time_steps(step_fn) -> tuple:
+        """(seconds/step, final loss) of a serialized step chain."""
+        params, opt_state = init_fn(jax.random.PRNGKey(0), n_features)
+        params, opt_state, loss = step_fn(params, opt_state, sharded)
+        float(loss)  # fetch barrier (block_until_ready unreliable on axon)
+
+        def timed():
+            nonlocal params, opt_state, loss
+            t0 = _time.perf_counter()
+            for _ in range(n_steps):
+                params, opt_state, loss = step_fn(params, opt_state, sharded)
+            float(loss)  # the params chain serializes; force the last
+            return (_time.perf_counter() - t0) / n_steps
+
+        # min-of-two against transient tunnel stalls, like _measure
+        return min(timed(), timed()), loss
+
+    step_rates = {}
+    step_out = {'games': step_games, 'actions': total, 'features': n_features}
+    for path, step_fn in (
+        ('fused', fused_step),
+        ('materialized', materialized_step),
+    ):
+        dt, loss = time_steps(step_fn)
+        aps = total / dt
+        step_rates[path] = aps
+        gauge('train/step_actions_per_sec', unit='actions/s').set(
+            aps, path=path, platform=platform
+        )
+        step_out[path] = {
+            'seconds_per_step': round(dt, 4),
+            'actions_per_sec': round(aps, 1),
+            'final_loss_finite': bool(jax.numpy.isfinite(loss)),
+        }
+    chain_latency = _chained_latency(n_steps)
+    # the serialized-chain round trip baked into every step; on local
+    # (non-tunnel) TPU hardware this term vanishes
+    step_out['chained_exec_latency_s'] = round(chain_latency, 4)
+    for path in step_rates:
+        compute_s = max(total / step_rates[path] - chain_latency, 0.0)
+        step_out[path]['est_compute_s_per_step'] = round(compute_s, 4)
+        step_out[path]['est_actions_per_sec_excl_latency'] = (
+            round(total / compute_s, 1) if compute_s > 1e-4 else None
+        )
+    step_out['fused_speedup'] = round(
+        step_rates['fused'] / step_rates['materialized'], 2
+    )
+    out['vaep_mlp_train_step'] = step_out
+
+    # --- minibatch epoch trainer: one scan dispatch per epoch -------------
+    ys, _yc = scores_concedes(batch)
+    y = jax.numpy.asarray(ys, dtype=jax.numpy.float32).reshape(-1)
+
+    def time_epochs(path: str) -> dict:
+        clf = MLPClassifier(hidden=(128, 128), batch_size=8192)
+        params, data, loss_fn, _mk, states, layout = clf._packed_problem(
+            batch, y, names=_NAMES, k=_K, path=path
+        )
+        opt_state = tx.init(params)
+        n_rows = int(states.weight.shape[0])
+        trainer = _EpochTrainer(loss_fn, tx, n_rows, clf.batch_size, clf.seed)
+        params, opt_state, loss = trainer.run(params, opt_state, 0, data)
+        float(loss)  # compile + warmup
+
+        def timed():
+            nonlocal params, opt_state, loss
+            t0 = _time.perf_counter()
+            for e in range(n_epochs):
+                params, opt_state, loss = trainer.run(
+                    params, opt_state, e + 1, data
+                )
+            float(loss)
+            return (_time.perf_counter() - t0) / n_epochs
+
+        dt = min(timed(), timed())
+        aps = total / dt
+        gauge('train/epoch_actions_per_sec', unit='actions/s').set(
+            aps, path=path, platform=platform
+        )
+        return {
+            'seconds_per_epoch': round(dt, 4),
+            'seconds_per_step': round(dt / trainer.steps, 5),
+            'actions_per_sec': round(aps, 1),
+            'steps_per_epoch': trainer.steps,
+            'final_loss_finite': bool(jax.numpy.isfinite(loss)),
+        }
+
+    epoch_out = {
         'games': step_games,
         'actions': total,
-        'features': n_features,
-        'seconds_per_step': round(dt_step, 4),
-        'actions_per_sec': round(total / dt_step, 1),
-        # the serialized-chain round trip baked into every step; on local
-        # (non-tunnel) TPU hardware this term vanishes
-        'chained_exec_latency_s': round(chain_latency, 4),
-        'est_compute_s_per_step': round(compute_s, 4),
-        'est_actions_per_sec_excl_latency': round(
-            total / compute_s, 1
-        ) if compute_s > 1e-4 else None,
-        'final_loss_finite': bool(jax.numpy.isfinite(loss)),
+        'batch_size': 8192,
+        'dispatches_per_epoch': 1,
     }
-
-    out['cold_path_stream'] = _bench_cold_path()
+    for path in ('fused', 'materialized'):
+        epoch_out[path] = time_epochs(path)
+    epoch_out['fused_speedup'] = round(
+        epoch_out['fused']['actions_per_sec']
+        / epoch_out['materialized']['actions_per_sec'],
+        2,
+    )
+    out['vaep_mlp_train_epoch'] = epoch_out
     return out
 
 
@@ -841,7 +1000,45 @@ def _run_child(env: dict, deadline_s: float = None) -> tuple:
     return (None if hung else proc.returncode), result, tail
 
 
+def _train_smoke() -> None:
+    """``make bench-smoke``: the train config, 2 steps/epochs, on CPU.
+
+    A sub-minute CI-sized pass over both training paths so a broken train
+    kernel fails fast and locally — not only in the full chip bench.
+    Re-execs itself into the clean-CPU environment when the process may
+    already be latched onto the accelerator plugin (same recipe as the
+    test suite's conftest).
+    """
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    axon_disabled = os.environ.get('PALLAS_AXON_POOL_IPS', 'unset') == ''
+    if not (platforms == 'cpu' and axon_disabled):
+        here = os.path.dirname(os.path.abspath(__file__))
+        rc = subprocess.call(
+            [sys.executable, os.path.join(here, 'bench.py'), '--train-smoke'],
+            env=_cpu_env(),
+            cwd=here,
+        )
+        sys.exit(rc)
+    games = int(os.environ.get('SOCCERACTION_TPU_BENCH_SMOKE_GAMES', 8))
+    out = _bench_train_configs(games, n_steps=2, n_epochs=2)
+    print(
+        json.dumps(
+            {
+                'metric': 'vaep_mlp_train_epoch_actions_per_sec',
+                'value': out['vaep_mlp_train_epoch']['fused']['actions_per_sec'],
+                'unit': 'actions/sec',
+                'platform': 'cpu',
+                'smoke': True,
+                **out,
+            }
+        )
+    )
+
+
 def main() -> None:
+    if '--train-smoke' in sys.argv:
+        _train_smoke()
+        return
     if '--impl' in sys.argv:
         print(json.dumps(bench_impl()))
         return

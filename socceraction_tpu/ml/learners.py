@@ -145,10 +145,45 @@ def fit_mlp(
     return model.fit(np.asarray(X), np.asarray(y), eval_set=es)
 
 
+def fit_mlp_packed(
+    batch: Any,
+    y: Any,
+    eval_set: EvalSet = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+    *,
+    names: Any,
+    k: int,
+    registry: str = 'standard',
+    mean: Any = None,
+    std: Any = None,
+) -> Any:
+    """The MLP trained directly on packed game states — no feature matrix.
+
+    ``batch`` is a packed ``ActionBatch`` or a precomputed
+    ``(TrainStates, TrainLayout)`` pair; ``y`` the flat/``(G, A)`` labels
+    (:meth:`socceraction_tpu.ml.mlp.MLPClassifier.fit_packed`). The tree
+    learners have no packed path — they need the materialized matrix —
+    which is why only ``'mlp'`` appears in :data:`PACKED_LEARNERS`.
+    """
+    model = MLPClassifier(**(tree_params or {}))
+    es = eval_set[0] if eval_set else None
+    return model.fit_packed(
+        batch, y, names=tuple(names), k=k, registry=registry,
+        eval_set=es, mean=mean, std=std, **(fit_params or {}),
+    )
+
+
 LEARNERS: Dict[str, Any] = {
     'xgboost': fit_xgboost,
     'catboost': fit_catboost,
     'lightgbm': fit_lightgbm,
     'sklearn': fit_sklearn,
     'mlp': fit_mlp,
+}
+
+#: Learners able to train from the packed game-state representation
+#: (``VAEP.fit_packed``). Trees require the materialized feature matrix.
+PACKED_LEARNERS: Dict[str, Any] = {
+    'mlp': fit_mlp_packed,
 }

@@ -10,17 +10,43 @@ actions/sec rating target reachable.
 
 Training follows the reference's protocol shape: random 75/25 split done
 by the caller, early stopping on a validation set with a patience window.
+
+Dispatch model (``docs/training.md``): one epoch is ONE jitted XLA
+computation — a ``jax.lax.scan`` over minibatches with the shuffle drawn
+on device (``jax.random.permutation`` keyed by ``fold_in(seed, epoch)``)
+and ``(params, opt_state)`` donated, so an epoch costs one dispatch
+instead of one per step (the pre-rework trainer paid ~6.5 ms of dispatch
+latency on each of ~100 steps per epoch). Two data paths feed the same
+loop:
+
+- **materialized** (:meth:`MLPClassifier.fit`): the caller's ``(n, F)``
+  feature matrix lives on device and minibatches are row gathers from it.
+- **fused** (:meth:`MLPClassifier.fit_packed`): the batch stays in the
+  packed game-state representation (dense sub-tensor + per-state combined
+  categorical ids, :mod:`socceraction_tpu.ops.fused`) and the first layer
+  is applied by folding the master ``Dense_0`` kernel into combined
+  tables every step — the one-hot feature columns (~90% of ``F``) are
+  never built, in training or inference.
+
+Minibatch tail: ``steps = ceil(n / batch_size)`` with every batch the
+same static shape, so the last batch *wraps around* the permutation.
+Wrapped slots carry zero loss weight — each sample contributes exactly
+once per epoch — and per-batch losses are normalized by the *real*
+(unwrapped, unpadded) sample count, not the slot count.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import linen as nn
+
+from ..obs import counter, histogram, span
 
 __all__ = ['MLPClassifier']
 
@@ -34,6 +60,71 @@ class _MLP(nn.Module):
             x = nn.Dense(h)(x)
             x = nn.relu(x)
         return nn.Dense(1)(x)[..., 0]  # logits
+
+
+def _weighted_bce(logits, y, w, pos_w):
+    """Σ bce·w·posw / Σw — wrapped/padded rows (w=0) contribute nothing."""
+    losses = optax.sigmoid_binary_cross_entropy(logits, y)
+    weights = w * jnp.where(y > 0.5, pos_w, 1.0)
+    return jnp.sum(losses * weights) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+class _EpochTrainer:
+    """One-dispatch-per-epoch minibatch trainer.
+
+    ``run(params, opt_state, epoch, data)`` executes a full epoch as a
+    single jitted ``lax.scan``: the permutation is drawn on device from
+    ``fold_in(PRNGKey(seed), epoch)``, minibatches are row gathers from
+    ``data`` (any pytree of ``(n, ...)`` arrays), and ``params``/
+    ``opt_state`` buffers are donated. ``n_traces`` counts retraces —
+    pinned to 1 across epochs by ``tests/test_fused_train.py`` (fixed
+    shapes: the tail batch wraps instead of shrinking).
+    """
+
+    def __init__(self, loss_fn, tx, n: int, batch_size: int, seed: int):
+        self.n = n
+        self.batch_size = min(batch_size, n)
+        # ceil so the tail is trained on; the last batch wraps around the
+        # permutation to keep a fixed shape (no per-epoch recompilation)
+        # and the wrapped duplicate slots get zero weight (module
+        # docstring) so they cannot double-count
+        self.steps = (n + self.batch_size - 1) // self.batch_size
+        self.n_traces = 0
+        base_rng = jax.random.PRNGKey(seed)
+        slots = self.steps * self.batch_size
+        slot_pos = jnp.arange(slots) % n
+        #: (steps, batch_size) loss weights: 0 on the wrapped tail slots,
+        #: so each of the n samples counts exactly once per epoch
+        self.slot_weight = (
+            (jnp.arange(slots) < n)
+            .astype(jnp.float32)
+            .reshape(self.steps, self.batch_size)
+        )
+        slot_valid = self.slot_weight
+
+        def epoch_fn(params, opt_state, epoch, data):
+            self.n_traces += 1  # trace-time counter: 1 == no recompilation
+            rng = jax.random.fold_in(base_rng, epoch)
+            perm = jax.random.permutation(rng, n)
+            sel = jnp.take(perm, slot_pos).reshape(self.steps, self.batch_size)
+
+            def body(carry, step):
+                p, o = carry
+                idx, valid = step
+                mb = jax.tree.map(lambda a: jnp.take(a, idx, axis=0), data)
+                loss, grads = jax.value_and_grad(loss_fn)(p, mb, valid)
+                updates, o = tx.update(grads, o)
+                return (optax.apply_updates(p, updates), o), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), (sel, slot_valid)
+            )
+            return params, opt_state, jnp.mean(losses)
+
+        self._epoch = jax.jit(epoch_fn, donate_argnums=(0, 1))
+
+    def run(self, params: Any, opt_state: Any, epoch: int, data: Any) -> Any:
+        return self._epoch(params, opt_state, np.int32(epoch), data)
 
 
 class MLPClassifier:
@@ -55,7 +146,14 @@ class MLPClassifier:
         Weight multiplier for positive examples in the loss; useful for the
         heavily imbalanced scoring/conceding labels. Default 1.0.
     seed : int
-        PRNG seed.
+        PRNG seed (parameter init and the on-device epoch shuffles).
+    train_dtype : str, optional
+        Narrow dtype (e.g. ``'bfloat16'``) for the training matmuls:
+        minibatch feature/hidden matmuls run in this dtype while the
+        master weights, the optimizer state and the loss stay f32 (the
+        logit head accumulates back in f32 —
+        :func:`socceraction_tpu.ops.fused._hidden_chain`). Opt-in;
+        ``None`` (default) trains fully in f32.
     """
 
     def __init__(
@@ -67,6 +165,7 @@ class MLPClassifier:
         patience: int = 5,
         pos_weight: float = 1.0,
         seed: int = 0,
+        train_dtype: Optional[str] = None,
     ) -> None:
         self.hidden = tuple(hidden)
         self.learning_rate = learning_rate
@@ -75,12 +174,160 @@ class MLPClassifier:
         self.patience = patience
         self.pos_weight = pos_weight
         self.seed = seed
+        self.train_dtype = train_dtype
         self.module = _MLP(self.hidden)
         self.params = None
-        self.mean_: Optional[np.ndarray] = None
-        self.std_: Optional[np.ndarray] = None
+        self._mean: Optional[np.ndarray] = None
+        self._std: Optional[np.ndarray] = None
+        self._mean_dev = None
+        self._std_dev = None
+        #: epoch-function retrace count of the last fit (1 == the epoch
+        #: compiled once and was reused across every epoch)
+        self.n_epoch_traces_: int = 0
+
+    # -- standardization statistics ----------------------------------------
+    # mean_/std_ are properties so the device copies predict_proba_device
+    # uses can be cached: re-uploading jnp.asarray(self.mean_) on every
+    # call cost a host->device transfer per prediction. Assigning either
+    # statistic invalidates its cached device constant.
+
+    @property
+    def mean_(self) -> Optional[np.ndarray]:
+        """Per-feature standardization mean (host f32 array, or None)."""
+        return self._mean
+
+    @mean_.setter
+    def mean_(self, value: Any) -> None:
+        """Set the mean and drop its cached device constant."""
+        self._mean = (
+            None if value is None else np.asarray(value, dtype=np.float32)
+        )
+        self._mean_dev = None
+
+    @property
+    def std_(self) -> Optional[np.ndarray]:
+        """Per-feature standardization scale (host f32 array, or None)."""
+        return self._std
+
+    @std_.setter
+    def std_(self, value: Any) -> None:
+        """Set the scale and drop its cached device constant."""
+        self._std = (
+            None if value is None else np.asarray(value, dtype=np.float32)
+        )
+        self._std_dev = None
+
+    def _device_stats(self) -> Tuple[jax.Array, jax.Array]:
+        """Cached device copies of ``(mean_, std_)``."""
+        if self._mean_dev is None:
+            self._mean_dev = jnp.asarray(self._mean)
+        if self._std_dev is None:
+            self._std_dev = jnp.asarray(self._std)
+        return self._mean_dev, self._std_dev
+
+    def _compute_dtype(self):
+        return jnp.dtype(self.train_dtype) if self.train_dtype else None
 
     # -- training ----------------------------------------------------------
+
+    def _init_params(self, n_features: int):
+        # distinct stream from the epoch shuffle keys (fold_in(seed, epoch)
+        # for epoch in 0..max_epochs): a shared key would correlate the
+        # init bits with epoch-1's minibatch permutation
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), 2**31 - 1)
+        return self.module.init(rng, jnp.zeros((1, n_features)))
+
+    def _dense_logits(self, params, x, mean, std):
+        """``module.apply`` on standardized rows, optionally narrowed.
+
+        The narrowed form follows the same policy as the fused path: the
+        first-layer matmul takes ``train_dtype`` inputs with f32
+        accumulation and the logit head stays f32
+        (:func:`socceraction_tpu.ops.fused._hidden_chain`), so bf16
+        deltas measure the dtype, never the path.
+        """
+        xn = (x - mean) / std
+        dt = self._compute_dtype()
+        if dt is None:
+            return self.module.apply(params, xn)
+        from ..ops.fused import _hidden_chain
+
+        leaves = params['params']
+        d0 = leaves['Dense_0']
+        h = (
+            jnp.dot(
+                xn.astype(dt),
+                jnp.asarray(d0['kernel']).astype(dt),
+                preferred_element_type=jnp.float32,
+            )
+            + jnp.asarray(d0['bias'])
+        )
+        return _hidden_chain(leaves, h, len(self.hidden), dt)
+
+    def _fit_loop(
+        self,
+        params,
+        data,
+        n: int,
+        loss_fn,
+        eval_data=None,
+        *,
+        path: str,
+        n_samples: Optional[int] = None,
+    ):
+        """Shared epoch loop: scan-train, eval, early-stop, telemetry.
+
+        ``loss_fn(params, minibatch, slot_weights)`` is the per-batch
+        objective; evaluation reuses it with all-ones slot weights.
+        Records ``train/*`` metrics per ``(path, platform)`` — one
+        ``train/epochs`` increment per epoch IS the XLA dispatch count of
+        the training work (the per-epoch eval is a second, tiny one).
+        """
+        tx = optax.adam(self.learning_rate)
+        opt_state = tx.init(params)
+        trainer = _EpochTrainer(loss_fn, tx, n, self.batch_size, self.seed)
+        eval_fn = None
+        if eval_data is not None:
+            n_eval = len(jax.tree.leaves(eval_data)[0])
+            ones = jnp.ones((n_eval,), jnp.float32)
+            eval_fn = jax.jit(lambda p, d: loss_fn(p, d, ones))
+
+        labels = {'path': path, 'platform': jax.default_backend()}
+        best_params = None
+        best_loss = np.inf
+        bad_epochs = 0
+        samples = n_samples if n_samples is not None else n
+        with span('train/fit', **labels):
+            for epoch in range(self.max_epochs):
+                t0 = time.perf_counter()
+                params, opt_state, _ = trainer.run(
+                    params, opt_state, epoch, data
+                )
+                # dispatch wall, not device wall: the epoch is async like
+                # every hot path; bench.py owns synced throughput numbers
+                histogram('train/epoch_seconds', unit='s').observe(
+                    time.perf_counter() - t0, **labels
+                )
+                counter('train/epochs', unit='count').inc(1, **labels)
+                counter('train/steps', unit='count').inc(
+                    trainer.steps, **labels
+                )
+                counter('train/samples', unit='count').inc(samples, **labels)
+                if eval_fn is not None:
+                    vloss = float(eval_fn(params, eval_data))
+                    if vloss < best_loss - 1e-6:
+                        best_loss = vloss
+                        # deep copy: the live params buffers are donated
+                        # to the next epoch's dispatch
+                        best_params = jax.tree.map(jnp.copy, params)
+                        bad_epochs = 0
+                    else:
+                        bad_epochs += 1
+                        if bad_epochs >= self.patience:
+                            break
+        self.n_epoch_traces_ = trainer.n_traces
+        self.params = best_params if best_params is not None else params
+        return self
 
     def fit(
         self,
@@ -93,85 +340,238 @@ class MLPClassifier:
         Standardizes features, minimizes sigmoid BCE with adam, and -- when
         ``eval_set`` is given -- early-stops on its loss exactly like the
         gradient-boosted learners (reference ``vaep/base.py:199-213``).
+        Each epoch is one jitted scan dispatch (module docstring); this
+        path keeps the materialized ``(n, F)`` matrix on device — use
+        :meth:`fit_packed` to train from packed game states without it.
         """
         X = np.asarray(X, dtype=np.float32)
         y = np.asarray(y, dtype=np.float32)
         self.mean_ = X.mean(axis=0)
         std = X.std(axis=0)
         self.std_ = np.where(std > 0, std, 1.0).astype(np.float32)
+        mean, std_dev = self._device_stats()
 
-        rng = jax.random.PRNGKey(self.seed)
-        rng, init_rng = jax.random.split(rng)
-        params = self.module.init(init_rng, jnp.zeros((1, X.shape[1])))
-        tx = optax.adam(self.learning_rate)
-        opt_state = tx.init(params)
-
-        mean = jnp.asarray(self.mean_)
-        std_dev = jnp.asarray(self.std_)
+        params = self._init_params(X.shape[1])
         pos_w = self.pos_weight
 
-        def loss_fn(params, xb, yb):
-            logits = self.module.apply(params, (xb - mean) / std_dev)
-            losses = optax.sigmoid_binary_cross_entropy(logits, yb)
-            weights = jnp.where(yb > 0.5, pos_w, 1.0)
-            return jnp.mean(losses * weights)
+        def loss_fn(params, mb, w):
+            logits = self._dense_logits(params, mb['x'], mean, std_dev)
+            return _weighted_bce(logits, mb['y'], w, pos_w)
 
-        @jax.jit
-        def train_step(params, opt_state, xb, yb):
-            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
-            updates, opt_state = tx.update(grads, opt_state)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        eval_loss = jax.jit(loss_fn)
-
-        n = len(X)
-        bs = min(self.batch_size, n)
-        # ceil so the tail is trained on; the last batch wraps around the
-        # permutation to keep a fixed shape (no per-epoch recompilation)
-        steps = (n + bs - 1) // bs
-        best_loss = np.inf
-        best_params = params
-        bad_epochs = 0
-        np_rng = np.random.default_rng(self.seed)
-
-        Xd = jnp.asarray(X)
-        yd = jnp.asarray(y)
+        data = {'x': jnp.asarray(X), 'y': jnp.asarray(y)}
+        eval_data = None
         if eval_set is not None:
-            Xv = jnp.asarray(np.asarray(eval_set[0], dtype=np.float32))
-            yv = jnp.asarray(np.asarray(eval_set[1], dtype=np.float32))
+            eval_data = {
+                'x': jnp.asarray(np.asarray(eval_set[0], dtype=np.float32)),
+                'y': jnp.asarray(np.asarray(eval_set[1], dtype=np.float32)),
+            }
+        return self._fit_loop(
+            params, data, len(X), loss_fn, eval_data, path='materialized'
+        )
 
-        for _ in range(self.max_epochs):
-            perm = np_rng.permutation(n)
-            for s in range(steps):
-                sel = jnp.asarray(perm[np.arange(s * bs, (s + 1) * bs) % n])
-                xb = jnp.take(Xd, sel, axis=0)
-                yb = jnp.take(yd, sel, axis=0)
-                params, opt_state, _ = train_step(params, opt_state, xb, yb)
-            if eval_set is not None:
-                vloss = float(eval_loss(params, Xv, yv))
-                if vloss < best_loss - 1e-6:
-                    best_loss = vloss
-                    best_params = params
-                    bad_epochs = 0
-                else:
-                    bad_epochs += 1
-                    if bad_epochs >= self.patience:
-                        break
-            else:
-                best_params = params
-        self.params = best_params
-        return self
+    def fit_packed(
+        self,
+        batch: Any,
+        y: Any,
+        *,
+        names: Tuple[str, ...],
+        k: int,
+        registry: str = 'standard',
+        eval_set: Optional[Tuple[Any, Any]] = None,
+        mean: Optional[Any] = None,
+        std: Optional[Any] = None,
+        path: str = 'fused',
+    ) -> 'MLPClassifier':
+        """Train directly on packed game states — no feature matrix in HBM.
+
+        Parameters
+        ----------
+        batch
+            A packed :class:`~socceraction_tpu.core.batch.ActionBatch` (or
+            the precomputed ``(TrainStates, TrainLayout)`` pair from
+            :func:`socceraction_tpu.ops.fused.build_train_states`, so
+            several heads can share one pack).
+        y
+            Labels, shape ``(G, A)`` or flat ``(G*A,)``; padding rows are
+            ignored via the states' zero weights.
+        names, k, registry
+            Feature layout, as in :meth:`predict_proba_device_batch`.
+        eval_set
+            Optional ``(batch_like, y)`` validation pair for the
+            reference early-stop protocol.
+        mean, std
+            Optional precomputed standardization statistics over the full
+            feature columns. Default: computed from the packed form
+            (:func:`socceraction_tpu.ops.fused.packed_feature_stats`) —
+            one-hot column moments are exact functions of activation
+            frequencies, so the matrix is not needed for them either.
+        path
+            ``'fused'`` (default) trains through the combined-table fold;
+            ``'materialized'`` builds the feature tensor and gathers rows
+            from it — the same minibatch stream and loss, kept as the
+            parity/bench baseline (requires ``batch`` to be an
+            ``ActionBatch``).
+        """
+        params, data, loss_fn, make_data, states, layout = self._packed_problem(
+            batch, y, names=tuple(names), k=k, registry=registry,
+            mean=mean, std=std, path=path,
+        )
+        eval_data = None
+        if eval_set is not None:
+            ev_states, ev_layout, ev_batch = self._resolve_states(
+                eval_set[0], names=tuple(names), k=k, registry=registry
+            )
+            if ev_layout.n_features != layout.n_features:
+                raise ValueError('eval_set feature layout differs from train')
+            ev_y = jnp.asarray(eval_set[1], dtype=jnp.float32).reshape(-1)
+            eval_data = make_data(ev_states, ev_y, ev_batch)
+
+        n = int(states.weight.shape[0])
+        n_valid = int(np.asarray(jnp.sum(states.weight)))
+        return self._fit_loop(
+            params, data, n, loss_fn, eval_data, path=path, n_samples=n_valid
+        )
+
+    def _packed_problem(
+        self,
+        batch: Any,
+        y: Any,
+        *,
+        names: Tuple[str, ...],
+        k: int,
+        registry: str = 'standard',
+        mean: Optional[Any] = None,
+        std: Optional[Any] = None,
+        path: str = 'fused',
+    ):
+        """Build the packed training problem (also used by ``bench.py``).
+
+        Returns ``(params, data, loss_fn, make_data, states, layout)``:
+        everything :class:`_EpochTrainer` needs, so the bench can time
+        epoch dispatches directly without going through the early-stop
+        loop.
+        """
+        from ..ops.fused import (
+            REGISTRIES,
+            fused_train_logits,
+            packed_feature_stats,
+        )
+
+        if path not in ('fused', 'materialized'):
+            raise ValueError(f'unknown training path {path!r}')
+        if registry not in REGISTRIES:
+            raise ValueError(f'unknown fused registry {registry!r}')
+
+        states, layout, raw_batch = self._resolve_states(
+            batch, names=tuple(names), k=k, registry=registry
+        )
+        yd = jnp.asarray(y, dtype=jnp.float32).reshape(-1)
+        if yd.shape[0] != states.weight.shape[0]:
+            raise ValueError(
+                f'labels have {yd.shape[0]} rows, packed states have '
+                f'{states.weight.shape[0]}'
+            )
+
+        if mean is None or std is None:
+            mean, raw_std = packed_feature_stats(states, layout)
+            std = jnp.where(raw_std > 0, raw_std, 1.0)
+        self.mean_ = np.asarray(mean)
+        self.std_ = np.asarray(std)
+        # the stats are (often) already device arrays: seed the caches
+        # directly instead of re-uploading the host copies the property
+        # setters just made
+        self._mean_dev = jnp.asarray(mean)
+        self._std_dev = jnp.asarray(std)
+        mean_dev, std_dev = self._device_stats()
+
+        params = self._init_params(layout.n_features)
+        pos_w = self.pos_weight
+        hidden_layers = len(self.hidden)
+        compute_dtype = self._compute_dtype()
+
+        if path == 'fused':
+
+            def loss_fn(params, mb, w):
+                logits = fused_train_logits(
+                    params,
+                    mb['x'],
+                    mb['ids'],
+                    layout=layout,
+                    hidden_layers=hidden_layers,
+                    mean=mean_dev,
+                    std=std_dev,
+                    compute_dtype=compute_dtype,
+                )
+                return _weighted_bce(logits, mb['y'], w * mb['w'], pos_w)
+
+            def make_data(states, yd, batch):
+                return {
+                    'x': states.x_dense,
+                    'ids': states.combo_ids,
+                    'w': states.weight,
+                    'y': yd,
+                }
+
+        else:
+
+            def loss_fn(params, mb, w):
+                logits = self._dense_logits(params, mb['x'], mean_dev, std_dev)
+                return _weighted_bce(logits, mb['y'], w * mb['w'], pos_w)
+
+            def make_data(states, yd, batch):
+                if batch is None:
+                    raise ValueError(
+                        "path='materialized' needs ActionBatch inputs "
+                        '(precomputed TrainStates cannot rebuild the '
+                        'feature tensor)'
+                    )
+                feats = self._materialize_features(batch, layout)
+                return {
+                    'x': feats.reshape(-1, layout.n_features),
+                    'w': states.weight,
+                    'y': yd,
+                }
+
+        data = make_data(states, yd, raw_batch)
+        return params, data, loss_fn, make_data, states, layout
+
+    @staticmethod
+    def _resolve_states(batch, *, names, k, registry):
+        """``batch`` -> (TrainStates, TrainLayout, ActionBatch | None)."""
+        from ..ops.fused import TrainStates, build_train_states
+
+        if (
+            isinstance(batch, tuple)
+            and len(batch) == 2
+            and isinstance(batch[0], TrainStates)
+        ):
+            return batch[0], batch[1], None
+        states, layout = build_train_states(
+            batch, names=names, k=k, registry_name=registry
+        )
+        return states, layout, batch
+
+    @staticmethod
+    def _materialize_features(batch, layout):
+        if layout.registry_name == 'atomic':
+            from ..ops.atomic import compute_features
+        else:
+            from ..ops.features import compute_features
+        return compute_features(batch, names=layout.names, k=layout.k)
 
     # -- inference ---------------------------------------------------------
 
     def predict_proba_device(self, X: jax.Array) -> jax.Array:
         """P(y=1) for a device array of any leading shape ``(..., F)``.
 
-        Stays on device; safe to call inside a jitted pipeline.
+        Stays on device; safe to call inside a jitted pipeline. The
+        standardization constants are cached device arrays (not
+        re-uploaded per call).
         """
         if self.params is None:
             raise ValueError('classifier is not fitted')
-        xn = (X - jnp.asarray(self.mean_)) / jnp.asarray(self.std_)
+        mean, std = self._device_stats()
+        xn = (X - mean) / std
         return jax.nn.sigmoid(self.module.apply(self.params, xn))
 
     def predict_proba(self, X: Any) -> np.ndarray:
@@ -196,7 +596,7 @@ class MLPClassifier:
 
         if self.params is None:
             raise ValueError('cannot save an unfitted classifier')
-        hyper = {
+        hyper: Dict[str, Any] = {
             'hidden': list(self.hidden),
             'learning_rate': self.learning_rate,
             'batch_size': self.batch_size,
@@ -205,6 +605,8 @@ class MLPClassifier:
             'pos_weight': self.pos_weight,
             'seed': self.seed,
         }
+        if self.train_dtype is not None:
+            hyper['train_dtype'] = self.train_dtype
         # write through a handle so np.savez honors the exact path instead
         # of appending '.npz'
         with open(path, 'wb') as f:

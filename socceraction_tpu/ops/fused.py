@@ -66,6 +66,14 @@ __all__ = [
     'fused_mlp_logits',
     'fused_pair_logits',
     'fused_pair_probs',
+    'TrainStates',
+    'TrainLayout',
+    'train_layout',
+    'build_train_states',
+    'concat_train_states',
+    'packed_feature_stats',
+    'table_lookup',
+    'fused_train_logits',
 ]
 
 # NOTE on the two-head path: rating always evaluates a scores head AND a
@@ -322,18 +330,16 @@ def _fused_first_layer(
         # single row gather per state — one (G, A, H) intermediate per
         # state instead of one per block per state (module docstring;
         # measured 3× on a v5e). Table build cost is combo_size × H.
-        combo = jnp.arange(registry.combo_size)
-        combo_rows = {
-            name: registry.combo_rows[name](combo) for name, _, _ in onehot_layout
-        }
+        blocks = [(name, per, off) for name, (per, _), off in onehot_layout]
         for i in range(k):
-            table = jnp.zeros((registry.combo_size, Wk.shape[1]), Wk.dtype)
-            for name, (per, _), off in onehot_layout:
-                rows = jax.lax.slice_in_dim(
-                    Wk, off + i * per, off + (i + 1) * per, axis=0
-                )
-                table = table + rows[combo_rows[name]]
-            h = h + table[registry.combo_ids(s, i)]
+            table = _combined_table(Wk, i, blocks, registry)
+            # table_lookup == table[ids] in the forward; routing through
+            # it gives every *differentiated* use of this fold (the
+            # full-batch train step, train_distributed) the segment-
+            # machinery backward instead of a conflict-serialized scatter
+            h = h + table_lookup(
+                table, registry.combo_ids(s, i), registry.combo_size
+            )
     if dense_blocks:
         x_dense = jnp.concatenate(dense_blocks, axis=-1)
         W_dense = jnp.concatenate(
@@ -342,6 +348,31 @@ def _fused_first_layer(
         )
         h = h + x_dense @ W_dense
     return h
+
+
+def _combined_table(
+    Wk: jax.Array,
+    i: int,
+    blocks: List[Tuple[str, int, int]],
+    registry: FusedRegistry,
+) -> jax.Array:
+    """State ``i``'s combined ``(combo_size, H)`` table from ``Dense_0`` rows.
+
+    ``blocks`` lists the one-hot spans as ``(name, per_state_width,
+    column_offset)``. The SINGLE source of the fold — both the inference
+    fold (:func:`_fused_first_layer`) and the differentiable training
+    fold (:func:`fused_train_logits`) build their tables here, so the
+    "same function of the same parameters" parity contract between the
+    two cannot drift apart block by block.
+    """
+    combo = jnp.arange(registry.combo_size)
+    table = jnp.zeros((registry.combo_size, Wk.shape[1]), Wk.dtype)
+    for name, per, off in blocks:
+        rows = jax.lax.slice_in_dim(
+            Wk, off + i * per, off + (i + 1) * per, axis=0
+        )
+        table = table + rows[registry.combo_rows[name](combo)]
+    return table
 
 
 def _hidden_chain(
@@ -502,3 +533,288 @@ def fused_pair_probs(
             jnp.dtype(hidden_dtype).name if hidden_dtype is not None else None
         ),
     )
+
+
+# --------------------------------------------------------------------------
+# differentiable fused-train path: the fold as a trainable first layer
+# --------------------------------------------------------------------------
+#
+# Inference proved the one-hot feature tensor unnecessary (module
+# docstring); training was still building it. The training representation
+# of a game state is the PACKED form the fold consumes: the small dense
+# sub-tensor plus one combined categorical id per state — ~10% of the
+# feature bytes of the 568-column matrix. The forward folds the master
+# ``Dense_0`` kernel into the per-state combined tables every step (a few
+# hundred rows of slicing and gathering — noise next to the minibatch
+# matmuls) and the backward of the table gather is a scatter-add
+# (:func:`table_lookup`, lowered through the segment machinery in
+# :mod:`socceraction_tpu.ops.segment`), which un-folds each table
+# cotangent back onto the per-block weight rows. The parameters therefore
+# never leave the standard per-block layout: export, checkpointing and the
+# inference paths see an ordinary ``_MLP`` pytree, and the fused-trained
+# weights are directly comparable to materialized-f32-trained ones
+# (``tests/test_fused_train.py`` pins ≤ 1e-4 parity after a fixed
+# schedule).
+
+
+class TrainStates(NamedTuple):
+    """Packed per-action training rows (flattened over ``(G, A)``).
+
+    ``x_dense`` holds the *raw* (unstandardized) dense feature columns —
+    standardization folds into the weights at apply time exactly like the
+    inference path, so both train paths are the same function of the same
+    parameters. Padding rows carry ``weight == 0`` and must be masked out
+    of every loss.
+    """
+
+    x_dense: jax.Array  # (N, D) raw dense feature columns
+    combo_ids: jax.Array  # (N, k) int32 combined categorical id per state
+    weight: jax.Array  # (N,) f32 validity weight (0 on padding rows)
+
+
+class TrainLayout(NamedTuple):
+    """Static column layout of the feature family a ``TrainStates`` packs.
+
+    Hashable (tuples only), so it can ride into jit closures as a static
+    value. ``spans`` lists ``(name, kind, offset, width)`` per transformer
+    in feature-column order, ``kind in ('onehot', 'dense')``.
+    """
+
+    names: Tuple[str, ...]
+    k: int
+    registry_name: str
+    n_features: int
+    spans: Tuple[Tuple[str, str, int, int], ...]
+
+
+def train_layout(
+    batch: Any, *, names: Tuple[str, ...], k: int, registry_name: str = 'standard'
+) -> TrainLayout:
+    """Resolve the static feature-column layout for a batch's family.
+
+    Dense block widths come from ``jax.eval_shape`` over the feature
+    kernels (no actual compute), so a kernel/layout mismatch raises here,
+    before any training step is traced.
+    """
+    registry = REGISTRIES[registry_name]
+    spans: List[Tuple[str, str, int, int]] = []
+    off = 0
+    for name in names:
+        spec = registry.onehot_specs.get(name)
+        if spec is not None:
+            spans.append((name, 'onehot', off, spec[0] * k))
+            off += spec[0] * k
+        else:
+            shape = jax.eval_shape(
+                lambda b, _name=name: registry.kernels[_name](
+                    registry.make_states(b, k)
+                ),
+                batch,
+            ).shape
+            spans.append((name, 'dense', off, shape[-1]))
+            off += shape[-1]
+    return TrainLayout(tuple(names), k, registry_name, off, tuple(spans))
+
+
+@functools.partial(jax.jit, static_argnames=('names', 'k', 'registry_name'))
+def _train_states_arrays(batch, *, names, k, registry_name):
+    registry = REGISTRIES[registry_name]
+    s = registry.make_states(batch, k)
+    dense_blocks = [
+        registry.kernels[name](s)
+        for name in names
+        if name not in registry.onehot_specs
+    ]
+    G, A = batch.type_id.shape
+    n = G * A
+    x_dense = (
+        jnp.concatenate(dense_blocks, axis=-1).reshape(n, -1).astype(jnp.float32)
+        if dense_blocks
+        else jnp.zeros((n, 0), jnp.float32)
+    )
+    ids = jnp.stack(
+        [registry.combo_ids(s, i).reshape(n) for i in range(k)], axis=1
+    ).astype(jnp.int32)
+    weight = batch.mask.reshape(n).astype(jnp.float32)
+    return x_dense, ids, weight
+
+
+def build_train_states(
+    batch: Any, *, names: Tuple[str, ...], k: int, registry_name: str = 'standard'
+) -> Tuple[TrainStates, TrainLayout]:
+    """Pack a batch into its fused-training representation.
+
+    One jitted dispatch building the dense sub-tensor (~10% of the feature
+    columns), the per-state combined categorical ids and the validity
+    weights — the 568-column feature matrix is never formed. The returned
+    layout is static/hashable and shared by every consumer of the states.
+    """
+    layout = train_layout(batch, names=tuple(names), k=k, registry_name=registry_name)
+    x_dense, ids, weight = _train_states_arrays(
+        batch, names=tuple(names), k=k, registry_name=registry_name
+    )
+    return TrainStates(x_dense, ids, weight), layout
+
+
+def concat_train_states(chunks: List[TrainStates]) -> TrainStates:
+    """Concatenate per-chunk training states along the row axis."""
+    if not chunks:
+        raise ValueError('cannot concatenate zero TrainStates chunks')
+    if len(chunks) == 1:
+        return chunks[0]
+    return TrainStates(
+        jnp.concatenate([c.x_dense for c in chunks], axis=0),
+        jnp.concatenate([c.combo_ids for c in chunks], axis=0),
+        jnp.concatenate([c.weight for c in chunks], axis=0),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=('layout',))
+def packed_feature_stats(
+    states: TrainStates, layout: TrainLayout
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-feature-column ``(mean, std)`` computed from the packed form.
+
+    Matches ``X.mean(axis=0)`` / ``X.std(axis=0)`` over the valid rows of
+    the materialized feature matrix without building it: dense columns use
+    weighted two-pass moments, and a one-hot column's moments are a pure
+    function of its activation frequency (``μ = p``, ``σ = √(p(1-p))``),
+    with ``p`` read off a segment-sum histogram of the combined ids.
+
+    ``std`` is raw (zeros where a column is constant) — callers apply
+    their own ``std > 0`` guard, mirroring the materialized fit.
+    """
+    from .segment import segment_sum_xla
+
+    registry = REGISTRIES[layout.registry_name]
+    w = states.weight
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    combo = jnp.arange(registry.combo_size)
+    # weight-histogram of combined ids per state: (k, combo_size)
+    counts = [
+        segment_sum_xla(w, states.combo_ids[:, i], registry.combo_size)
+        for i in range(layout.k)
+    ]
+    mean_parts: List[jax.Array] = []
+    var_parts: List[jax.Array] = []
+    dense_off = 0
+    for name, kind, _off, width in layout.spans:
+        if kind == 'onehot':
+            per = width // layout.k
+            rows = registry.combo_rows[name](combo)
+            for i in range(layout.k):
+                p = segment_sum_xla(counts[i], rows, per) / n
+                mean_parts.append(p)
+                var_parts.append(p * (1.0 - p))
+        else:
+            x = states.x_dense[:, dense_off : dense_off + width]
+            dense_off += width
+            mu = (w @ x) / n
+            var = (w @ jnp.square(x - mu)) / n  # two-pass, like np.std
+            mean_parts.append(mu)
+            var_parts.append(var)
+    return (
+        jnp.concatenate(mean_parts).astype(jnp.float32),
+        jnp.sqrt(jnp.concatenate(var_parts)).astype(jnp.float32),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def table_lookup(table: jax.Array, ids: jax.Array, num_rows: int) -> jax.Array:
+    """``table[ids]`` with an explicit scatter-add backward.
+
+    The forward is the combined-table row gather of the fused first layer;
+    the cotangent of ``table`` is the row-wise segment sum of the incoming
+    gradient (:func:`socceraction_tpu.ops.segment.segment_sum_rows`),
+    which on TPU lowers to a one-hot MXU contraction instead of the
+    conflict-serialized XLA scatter a plain autodiff gather would emit —
+    a minibatch scatters thousands of rows into a ≤ 552-row table, the
+    scatter's worst conflict density.
+    """
+    return table[ids]
+
+
+def _table_lookup_fwd(table, ids, num_rows):
+    return table[ids], ids
+
+
+def _table_lookup_bwd(num_rows, ids, g):
+    from .segment import segment_sum_rows
+
+    import numpy as _np
+
+    return (
+        segment_sum_rows(g, ids, num_rows),
+        _np.zeros(ids.shape, dtype=jax.dtypes.float0),  # int ids: no tangent
+    )
+
+
+table_lookup.defvjp(_table_lookup_fwd, _table_lookup_bwd)
+
+
+def fused_train_logits(
+    params: Any,
+    x_dense: jax.Array,
+    combo_ids: jax.Array,
+    *,
+    layout: TrainLayout,
+    hidden_layers: int,
+    mean: Optional[jax.Array] = None,
+    std: Optional[jax.Array] = None,
+    compute_dtype: Optional[Any] = None,
+) -> jax.Array:
+    """Differentiable MLP logits over packed training rows -> ``(N,)``.
+
+    The same function of ``params`` as
+    ``module.apply(params, (features - mean) / std)`` on the materialized
+    matrix — standardization folds into the first layer
+    (:func:`_standardized_first_layer`), the per-state combined tables are
+    folded from the master ``Dense_0`` rows every call, and the whole
+    one-hot contribution of a state is one :func:`table_lookup`. Because
+    the *parameterization* is unchanged (a standard ``_MLP`` pytree over
+    the full feature columns), gradients agree with the materialized
+    forward to f32-reorder error and the result trains/exports/infers
+    interchangeably with materialized-trained weights.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) narrows the dense matmul and
+    the post-relu hidden pipeline; the fold, the gathers and the logit
+    head stay f32 (master weights are always f32 — the optimizer never
+    sees the cast).
+    """
+    registry = REGISTRIES[layout.registry_name]
+    leaves = params['params']
+    Wk, bias = _standardized_first_layer(leaves, mean, std)
+    if Wk.shape[0] != layout.n_features:
+        raise ValueError(
+            f'first-layer kernel has {Wk.shape[0]} input rows but the '
+            f'feature layout ({layout.names!r}, k={layout.k}) emits '
+            f'{layout.n_features} columns'
+        )
+    H = Wk.shape[1]
+    h = jnp.zeros((x_dense.shape[0], H), Wk.dtype) + bias
+    blocks = [
+        (name, width // layout.k, off)
+        for name, kind, off, width in layout.spans
+        if kind == 'onehot'
+    ]
+    if blocks:
+        for i in range(layout.k):
+            table = _combined_table(Wk, i, blocks, registry)
+            h = h + table_lookup(table, combo_ids[:, i], registry.combo_size)
+    dense_spans = [
+        (off, width) for _, kind, off, width in layout.spans if kind == 'dense'
+    ]
+    if dense_spans and x_dense.shape[1]:
+        W_dense = jnp.concatenate(
+            [
+                jax.lax.slice_in_dim(Wk, off, off + width, axis=0)
+                for off, width in dense_spans
+            ],
+            axis=0,
+        )
+        x = x_dense
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+            W_dense = W_dense.astype(compute_dtype)
+        h = h + jnp.dot(x, W_dense, preferred_element_type=Wk.dtype)
+    return _hidden_chain(leaves, h, hidden_layers, compute_dtype)

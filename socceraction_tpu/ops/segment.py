@@ -63,12 +63,23 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ['segment_sum', 'segment_sum_pallas', 'segment_sum_xla']
+__all__ = [
+    'segment_sum',
+    'segment_sum_pallas',
+    'segment_sum_xla',
+    'segment_sum_rows',
+]
 
 CHUNK = 512  # actions per grid step
 SEG_BLOCK = 1024  # segment (grid-cell) lanes per grid step
 PALLAS_MAX_SEGMENTS = 2048  # crossover to XLA scatter, measured on v5e
 # (module docstring; re-derive with benchmarks/segment_crossover.py)
+
+#: Row-wise variant (:func:`segment_sum_rows`): past this many segments the
+#: (N, S) one-hot mask stops paying for itself and the XLA scatter takes
+#: over. The fused-train backward gathers into combined tables of at most
+#: T*R*B = 552 rows, far inside the bound.
+ROWS_ONEHOT_MAX_SEGMENTS = 2048
 
 
 def _kernel(ids_ref, vals_ref, out_ref):
@@ -132,12 +143,15 @@ def segment_sum_xla(
 ) -> jax.Array:
     """XLA scatter-add segment-sum (the portable fallback).
 
-    ``mode='drop'`` matches the Pallas path: ids outside
-    ``[0, num_segments)`` — including negatives — contribute nothing
-    (default scatter semantics would wrap negative ids).
+    Ids outside ``[0, num_segments)`` — including negatives — contribute
+    nothing, matching the Pallas path. ``mode='drop'`` alone is NOT
+    enough: scatter index semantics wrap negatives (``-1`` lands on the
+    last segment) *before* the out-of-bounds drop applies, so negatives
+    are first remapped to ``num_segments`` (genuinely out of range).
     """
     values = values.reshape(-1).astype(jnp.float32)
     segment_ids = segment_ids.reshape(-1)
+    segment_ids = jnp.where(segment_ids < 0, num_segments, segment_ids)
     return (
         jnp.zeros(num_segments, jnp.float32)
         .at[segment_ids]
@@ -183,3 +197,89 @@ def segment_sum(
             interpret=jax.default_backend() != 'tpu',
         )
     return segment_sum_xla(values, segment_ids, num_segments)
+
+
+# --------------------------------------------------------------------------
+# row-wise segment sum: out[s, :] += values[i, :] where ids[i] == s
+# --------------------------------------------------------------------------
+
+
+def segment_sum_rows_xla(
+    values: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> jax.Array:
+    """Row-wise scatter-add: ``out[ids[i]] += values[i]`` -> ``(S, H)``.
+
+    Same drop semantics as :func:`segment_sum_xla`: ids outside
+    ``[0, num_segments)`` (including negatives) contribute nothing — the
+    negative remap is required there too, scatter wraps before dropping.
+    """
+    ids = segment_ids.reshape(-1)
+    ids = jnp.where(ids < 0, num_segments, ids)
+    return (
+        jnp.zeros((num_segments, values.shape[-1]), values.dtype)
+        .at[ids]
+        .add(values, mode='drop')
+    )
+
+
+def segment_sum_rows_onehot(
+    values: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> jax.Array:
+    """Row-wise segment sum as a one-hot MXU contraction.
+
+    ``out = onehot(ids)ᵀ @ values`` — the scatter recast as a dense
+    ``(S, N) @ (N, H)`` matmul, the same trick as the Pallas scalar kernel
+    (module docstring) but expressed directly to XLA: the TPU scatter is
+    *conflict*-serialized, and the fused-train backward scatters a whole
+    minibatch (thousands of rows) into a few-hundred-row combined table —
+    maximal conflict density, the scatter's worst case and the MXU's best.
+    Runs at ``Precision.HIGHEST`` (f32 multi-pass) so the 0/1 mask times
+    f32 cotangents reproduces the scatter path to reorder-level error.
+    """
+    ids = segment_ids.reshape(-1)
+    onehot = (
+        ids[:, None] == jnp.arange(num_segments, dtype=ids.dtype)[None, :]
+    ).astype(values.dtype)
+    return jax.lax.dot_general(
+        onehot,
+        values,
+        (((0,), (0,)), ((), ())),  # contract the row axis: (S, H)
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(values.dtype)
+
+
+def segment_sum_rows(
+    values: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    *,
+    method: Optional[str] = None,
+) -> jax.Array:
+    """Sum ``(N, H)`` rows into ``(num_segments, H)`` buckets by id.
+
+    The backward pass of the fused-train table gather
+    (:func:`socceraction_tpu.ops.fused.table_lookup`): the cotangent of
+    ``table[ids]`` is exactly this scatter-add. Ids outside
+    ``[0, num_segments)`` are dropped on both paths.
+
+    ``method``: ``'xla'`` (scatter-add), ``'onehot'`` (MXU contraction) or
+    ``None``/``'auto'`` — one-hot on TPU while ``num_segments`` is within
+    :data:`ROWS_ONEHOT_MAX_SEGMENTS`, XLA scatter otherwise (CPU scatters
+    are not conflict-serialized, so the mask buys nothing there).
+    """
+    if method not in (None, 'auto', 'xla', 'onehot'):
+        raise ValueError(f'method={method!r} (want auto|xla|onehot)')
+    values = values.reshape(-1, values.shape[-1])
+    if method in (None, 'auto'):
+        method = (
+            'onehot'
+            if (
+                jax.default_backend() == 'tpu'
+                and num_segments <= ROWS_ONEHOT_MAX_SEGMENTS
+            )
+            else 'xla'
+        )
+    if method == 'onehot':
+        return segment_sum_rows_onehot(values, segment_ids, num_segments)
+    return segment_sum_rows_xla(values, segment_ids, num_segments)

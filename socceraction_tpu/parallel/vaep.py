@@ -111,9 +111,10 @@ def make_train_step(
         # the fused combined-table forward (ops/fused.py) avoids
         # materializing the (G, A, F) feature tensor in HBM, and the
         # stacked two-head fold computes ONE gather per state for both
-        # heads; autodiff turns the first-layer row gathers into
-        # scatter-adds over the small (T*R*B, 2H) tables, so the backward
-        # pass stays fused too
+        # heads; the gather's backward is the explicit segment-machinery
+        # scatter-add (ops/fused.py:table_lookup -> segment_sum_rows — a
+        # one-hot MXU contraction on TPU) over the small (T*R*B, 2H)
+        # tables, so the backward pass stays fused too
         ys, yc = scores_concedes(batch, nr_actions=nr_actions)
         mask = batch.mask
         logit_s, logit_c = fused_pair_logits(

@@ -1,0 +1,382 @@
+"""The fused training path: packed game states straight into the MLP.
+
+Pins the tentpole contracts of the fused-gather trainer:
+
+- the packed training representation (dense sub-tensor + per-state
+  combined ids) reproduces the materialized feature matrix's columns,
+  statistics and forward pass;
+- the table-gather backward is the explicit scatter-add
+  (``ops.segment.segment_sum_rows``), and autodiff through the fold
+  matches the materialized gradient;
+- **training parity**: fused-train parameters equal materialized-f32-train
+  parameters to ≤ 1e-4 after a fixed schedule (same seed, same minibatch
+  stream, different first-layer computation);
+- **dispatch model**: one ``train_epoch`` trace across all epochs (no
+  recompilation) and exactly one training dispatch per epoch, counted
+  through the ``train/*`` obs metrics;
+- the wrap-around tail batch cannot double-count samples.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from socceraction_tpu.core.synthetic import synthetic_batch
+from socceraction_tpu.ml.mlp import MLPClassifier, _MLP, _EpochTrainer
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.ops.features import compute_features
+from socceraction_tpu.ops.fused import (
+    build_train_states,
+    fused_train_logits,
+    packed_feature_stats,
+    table_lookup,
+)
+from socceraction_tpu.ops.labels import scores_concedes
+from socceraction_tpu.ops.segment import segment_sum_rows
+
+NAMES = (
+    'actiontype_onehot',
+    'result_onehot',
+    'actiontype_result_onehot',
+    'bodypart_onehot',
+    'time',
+    'startlocation',
+    'endlocation',
+    'startpolar',
+    'endpolar',
+    'movement',
+    'team',
+    'time_delta',
+    'space_delta',
+    'goalscore',
+)
+K = 3
+
+
+@pytest.fixture(scope='module')
+def batch():
+    return synthetic_batch(n_games=6, n_actions=256, seed=3)
+
+
+@pytest.fixture(scope='module')
+def packed(batch):
+    return build_train_states(batch, names=NAMES, k=K)
+
+
+@pytest.fixture(scope='module')
+def labels(batch):
+    ys, _ = scores_concedes(batch)
+    return np.asarray(ys).reshape(-1).astype(np.float32)
+
+
+# ---------------------------------------------------------------- segment --
+
+
+def test_segment_sum_rows_methods_agree():
+    rng = np.random.default_rng(0)
+    vals = jnp.asarray(rng.normal(size=(257, 8)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(-2, 12, size=257))  # includes drops
+    a = segment_sum_rows(vals, ids, 10, method='xla')
+    b = segment_sum_rows(vals, ids, 10, method='onehot')
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    # ids outside [0, S) contribute nothing on either path
+    kept = np.asarray(ids) >= 0
+    kept &= np.asarray(ids) < 10
+    np.testing.assert_allclose(
+        np.asarray(a).sum(), np.asarray(vals)[kept].sum(), rtol=1e-5
+    )
+
+
+def test_segment_sum_rows_rejects_bad_method():
+    with pytest.raises(ValueError, match='method'):
+        segment_sum_rows(jnp.ones((4, 2)), jnp.zeros(4, jnp.int32), 2, method='nope')
+
+
+def test_table_lookup_backward_is_scatter_add():
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.normal(size=(12, 5)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 12, size=300))
+
+    def f(t):
+        return jnp.sum(jnp.tanh(table_lookup(t, ids, 12)) * 0.5)
+
+    def ref(t):
+        return jnp.sum(jnp.tanh(t[ids]) * 0.5)
+
+    g = jax.grad(f)(table)
+    g_ref = jax.grad(ref)(table)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
+
+
+# ----------------------------------------------------------- packed form --
+
+
+def test_train_states_reproduce_feature_columns(batch, packed):
+    states, layout = packed
+    feats = np.asarray(compute_features(batch, names=NAMES, k=K))
+    F = feats.shape[-1]
+    assert layout.n_features == F
+    flat = feats.reshape(-1, F)
+    # the dense sub-tensor is the dense feature columns, in layout order
+    dense_cols = np.concatenate(
+        [
+            flat[:, off : off + width]
+            for _, kind, off, width in layout.spans
+            if kind == 'dense'
+        ],
+        axis=1,
+    )
+    np.testing.assert_allclose(np.asarray(states.x_dense), dense_cols, atol=1e-6)
+    # ~90% of the columns never reach the packed form
+    assert states.x_dense.shape[1] < 0.15 * F
+    np.testing.assert_array_equal(
+        np.asarray(states.weight), np.asarray(batch.mask).reshape(-1)
+    )
+    assert states.combo_ids.shape == (flat.shape[0], K)
+    assert int(jnp.min(states.combo_ids)) >= 0
+
+
+def test_packed_stats_match_materialized(batch, packed):
+    states, layout = packed
+    feats = np.asarray(compute_features(batch, names=NAMES, k=K))
+    mask = np.asarray(batch.mask).reshape(-1)
+    X = feats.reshape(-1, feats.shape[-1])[mask]
+    mean, std = packed_feature_stats(states, layout)
+    np.testing.assert_allclose(np.asarray(mean), X.mean(axis=0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(std), X.std(axis=0), atol=1e-5)
+
+
+def test_fused_train_logits_match_materialized_forward(batch, packed):
+    states, layout = packed
+    feats = np.asarray(compute_features(batch, names=NAMES, k=K))
+    F = feats.shape[-1]
+    module = _MLP((32, 16))
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, F)))
+    mean, raw_std = packed_feature_stats(states, layout)
+    std = jnp.where(raw_std > 0, raw_std, 1.0)
+    ref = module.apply(params, (feats.reshape(-1, F) - mean) / std)
+    out = fused_train_logits(
+        params, states.x_dense, states.combo_ids,
+        layout=layout, hidden_layers=2, mean=mean, std=std,
+    )
+    mask = np.asarray(batch.mask).reshape(-1)
+    np.testing.assert_allclose(
+        np.asarray(out)[mask], np.asarray(ref)[mask], atol=1e-4
+    )
+
+
+def test_fused_train_logits_rejects_wrong_layout(batch, packed):
+    states, layout = packed
+    module = _MLP((8,))
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 10)))
+    with pytest.raises(ValueError, match='feature layout'):
+        fused_train_logits(
+            params, states.x_dense, states.combo_ids,
+            layout=layout, hidden_layers=1,
+        )
+
+
+# ------------------------------------------------------- training parity --
+
+
+def test_fused_vs_materialized_train_parity(batch, labels):
+    """The acceptance gate: ≤ 1e-4 parameter parity after a fixed schedule.
+
+    Same seed → same on-device minibatch stream; the only difference is
+    the first-layer computation (combined-table fold + gathers vs the
+    materialized matrix). Gradients agree to ~5e-8 at init; over steps,
+    adam's ``1/√v̂`` amplifies f32-reorder noise on rare one-hot columns
+    (tiny second moments), so the schedule runs at lr 3e-4 where the
+    measured max |Δ| is ≤ 1e-5 across seeds — the 1e-4 bound leaves a
+    ≥10× band for platform-specific reassociation.
+    """
+
+    def train(path):
+        clf = MLPClassifier(
+            hidden=(32, 16), batch_size=512, max_epochs=5, seed=0,
+            learning_rate=3e-4,
+        )
+        clf.fit_packed(batch, labels, names=NAMES, k=K, path=path)
+        return clf
+
+    fused = train('fused')
+    mat = train('materialized')
+    np.testing.assert_allclose(fused.mean_, mat.mean_, atol=1e-5)
+    np.testing.assert_allclose(fused.std_, mat.std_, atol=1e-5)
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), fused.params, mat.params
+    )
+    assert max(jax.tree.leaves(diffs)) <= 1e-4, diffs
+    # and the two classifiers predict identically on fresh data
+    X = np.asarray(
+        compute_features(synthetic_batch(n_games=1, n_actions=128, seed=9),
+                         names=NAMES, k=K)
+    ).reshape(-1, fused.mean_.shape[0])
+    np.testing.assert_allclose(
+        fused.predict_proba(X)[:, 1], mat.predict_proba(X)[:, 1], atol=1e-4
+    )
+
+
+def test_one_trace_one_dispatch_per_epoch(batch, labels):
+    """The epoch scan compiles once and dispatches once per epoch."""
+    REGISTRY.reset()
+    clf = MLPClassifier(hidden=(16,), batch_size=512, max_epochs=4, seed=0)
+    clf.fit_packed(batch, labels, names=NAMES, k=K)
+    assert clf.n_epoch_traces_ == 1
+    snap = REGISTRY.snapshot()
+    assert snap.value(
+        'train/epochs', path='fused', platform=jax.default_backend()
+    ) == 4.0
+    # steps counter: ceil(n / bs) scan iterations inside each dispatch
+    n = batch.n_games * batch.max_actions
+    steps = -(-n // 512)
+    assert snap.value(
+        'train/steps', path='fused', platform=jax.default_backend()
+    ) == float(4 * steps)
+
+
+def test_materialized_fit_one_trace_across_epochs():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(700, 12)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    clf = MLPClassifier(hidden=(8,), batch_size=256, max_epochs=5)
+    clf.fit(X, y)
+    assert clf.n_epoch_traces_ == 1
+    # with an eval set (early-stop protocol) the pin must still hold
+    clf2 = MLPClassifier(hidden=(8,), batch_size=256, max_epochs=5, patience=2)
+    clf2.fit(X[:600], y[:600], eval_set=(X[600:], y[600:]))
+    assert clf2.n_epoch_traces_ == 1
+
+
+def test_wraparound_tail_slots_carry_zero_weight():
+    """ceil-batching wraps the tail; wrapped slots must not double-count."""
+    import optax
+
+    tx = optax.adam(1e-3)
+    trainer = _EpochTrainer(lambda p, mb, w: 0.0, tx, n=700, batch_size=256, seed=0)
+    assert trainer.steps == 3
+    w = np.asarray(trainer.slot_weight)
+    assert w.shape == (3, 256)
+    # exactly n slots carry weight; the 3*256 - 700 = 68 wrapped ones none
+    assert w.sum() == 700.0
+    assert (w[:2] == 1.0).all()
+    assert w[2].sum() == 700 - 2 * 256
+    # and n divisible by batch_size has no dead slots
+    full = _EpochTrainer(lambda p, mb, w: 0.0, tx, n=512, batch_size=256, seed=0)
+    assert np.asarray(full.slot_weight).sum() == 512.0
+
+
+def test_bf16_train_dtype_stays_near_f32(batch, labels):
+    f32 = MLPClassifier(hidden=(16,), batch_size=512, max_epochs=2, seed=0)
+    f32.fit_packed(batch, labels, names=NAMES, k=K)
+    bf16 = MLPClassifier(
+        hidden=(16,), batch_size=512, max_epochs=2, seed=0,
+        train_dtype='bfloat16',
+    )
+    bf16.fit_packed(batch, labels, names=NAMES, k=K)
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), f32.params, bf16.params
+    )
+    worst = max(jax.tree.leaves(diffs))
+    # master weights are f32 and the schedule is short: the narrowed
+    # matmuls may drift but must stay in a tight band — and must actually
+    # have run narrower (bit-identical params would mean the cast is dead)
+    assert 0.0 < worst < 0.05, diffs
+
+
+# ------------------------------------------------------------ VAEP level --
+
+
+def test_vaep_fit_packed_end_to_end(batch):
+    from socceraction_tpu.vaep.base import VAEP
+
+    other = synthetic_batch(n_games=4, n_actions=256, seed=11)
+    model = VAEP()
+    # an iterator of (batch, game_ids) pairs — the iter_batches shape
+    model.fit_packed(
+        iter([(batch, list(range(6))), (other, list(range(4)))]),
+        tree_params=dict(hidden=(32, 16), max_epochs=4, batch_size=1024),
+        random_state=0,
+    )
+    assert set(model._models) == {'scores', 'concedes'}
+    assert model._can_fuse()
+    vals = model.rate_batch(batch)
+    assert vals.shape == (6, 256, 3)
+    masked = np.asarray(vals)[np.asarray(batch.mask)]
+    assert np.isfinite(masked).all()
+    # the heads learned something about the labels they were fit on
+    ys, _ = scores_concedes(batch)
+    p = np.asarray(
+        model._models['scores'].predict_proba_device_batch(
+            batch, names=model._kernel_names(), k=model.nb_prev_actions
+        )
+    )
+    mask = np.asarray(batch.mask)
+    pos = p[mask & np.asarray(ys)]
+    neg = p[mask & ~np.asarray(ys)]
+    if len(pos) and len(neg):
+        assert pos.mean() > neg.mean()
+
+
+def test_vaep_fit_packed_rejects_tree_learner(batch):
+    from socceraction_tpu.vaep.base import VAEP
+
+    with pytest.raises(ValueError, match='packed fit path'):
+        VAEP().fit_packed(batch, learner='sklearn')
+
+
+def test_vaep_fit_packed_empty_raises():
+    from socceraction_tpu.vaep.base import VAEP
+
+    with pytest.raises(ValueError, match='no batches'):
+        VAEP().fit_packed(iter([]))
+
+
+def test_atomic_vaep_fit_packed(spadl_actions, home_team_id):
+    from socceraction_tpu.atomic.spadl import convert_to_atomic
+    from socceraction_tpu.atomic.vaep.base import AtomicVAEP
+
+    atomic = convert_to_atomic(spadl_actions)
+    model = AtomicVAEP()
+    batch = model._pack(atomic, home_team_id)
+    model.fit_packed(
+        batch, tree_params=dict(hidden=(16,), max_epochs=2), random_state=0
+    )
+    assert model._can_fuse()
+    vals = model.rate_batch(batch)
+    assert np.isfinite(np.asarray(vals)[np.asarray(batch.mask)]).all()
+
+
+def test_fit_packed_checkpoint_roundtrip(tmp_path, batch, labels):
+    clf = MLPClassifier(hidden=(16,), batch_size=512, max_epochs=2)
+    clf.fit_packed(batch, labels, names=NAMES, k=K)
+    path = str(tmp_path / 'clf.npz')
+    clf.save(path)
+    back = MLPClassifier.load(path)
+    X = np.asarray(
+        compute_features(batch, names=NAMES, k=K)
+    ).reshape(-1, clf.mean_.shape[0])[:64]
+    np.testing.assert_allclose(
+        clf.predict_proba(X), back.predict_proba(X), atol=1e-6
+    )
+
+
+# --------------------------------------------------------------- caching --
+
+
+def test_device_stats_are_cached_and_invalidated():
+    clf = MLPClassifier(hidden=(8,), batch_size=128, max_epochs=1)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    clf.fit(X, y)
+    m1, s1 = clf._device_stats()
+    m2, s2 = clf._device_stats()
+    assert m1 is m2 and s1 is s2  # no re-upload per call
+    p1 = np.asarray(clf.predict_proba_device(jnp.asarray(X[:8])))
+    # reassigning a statistic must invalidate its cached device constant
+    clf.mean_ = clf.mean_ + 1.0
+    assert clf._mean_dev is None
+    p2 = np.asarray(clf.predict_proba_device(jnp.asarray(X[:8])))
+    assert not np.allclose(p1, p2)

@@ -27,10 +27,33 @@ def _tool():
 def test_repo_passes_the_gate():
     tool = _tool()
     targets = [os.path.join(_ROOT, t) for t in tool.DEFAULT_TARGETS]
-    problems, n_sites = tool.check_files(targets)
+    problems, n_sites = tool.check_files(targets, areas=tool.KNOWN_AREAS)
     assert problems == []
     # the instrumented hot paths keep the gate non-vacuous
     assert n_sites >= 20
+
+
+def test_unregistered_area_detected(tmp_path):
+    """The area allow-list: well-formed names in unknown areas fail."""
+    tool = _tool()
+    bad = tmp_path / 'bad.py'
+    bad.write_text(
+        "counter('rogue/thing').inc()\n"
+        "histogram('train/epoch_seconds', unit='s').observe(1)\n"
+    )
+    problems, n_sites = tool.check_files([str(bad)], areas=tool.KNOWN_AREAS)
+    assert n_sites == 2
+    assert len(problems) == 1
+    assert 'unregistered area' in problems[0] and "'rogue'" in problems[0]
+    # without an allow-list (ad-hoc invocations) the same file passes
+    problems, _ = tool.check_files([str(bad)])
+    assert problems == []
+
+
+def test_train_area_is_registered():
+    """The fused-train metrics (``train/*``) are a governed area."""
+    tool = _tool()
+    assert 'train' in tool.KNOWN_AREAS
 
 
 def test_convention_violation_detected(tmp_path):

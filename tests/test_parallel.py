@@ -15,6 +15,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
+from conftest import requires_shard_map
 from socceraction_tpu.core.batch import pack_actions, unpack_values
 from socceraction_tpu.core.synthetic import synthetic_actions_frame
 from socceraction_tpu.ops.xt import solve_xt, xt_counts, xt_probabilities
@@ -94,6 +95,7 @@ def test_pad_games_is_inert(batch):
     assert padded.total_actions == batch.total_actions
 
 
+@requires_shard_map
 def test_sharded_xt_counts_match_single_device(season):
     mesh = make_mesh()
     sharded = shard_batch(season, mesh)
@@ -108,6 +110,7 @@ def test_sharded_xt_counts_match_single_device(season):
     np.testing.assert_allclose(np.asarray(counts.trans), np.asarray(local.trans))
 
 
+@requires_shard_map
 def test_sharded_xt_fit_matches_unsharded(season):
     mesh = make_mesh()
     sharded = shard_batch(season, mesh)
@@ -207,6 +210,7 @@ def test_train_distributed_and_sharded_rate(season, season_df):
     )
 
 
+@requires_shard_map
 def test_sharded_matrix_free_fit_matches_unsharded(season):
     from socceraction_tpu.ops.xt import solve_xt_matrix_free
     from socceraction_tpu.parallel import sharded_xt_fit_matrix_free

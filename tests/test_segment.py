@@ -37,6 +37,17 @@ def test_xla_matches_numpy():
     np.testing.assert_allclose(np.asarray(out), _ref(vals, ids, 100), rtol=1e-6)
 
 
+def test_xla_drops_negative_ids_like_pallas():
+    """Scatter wraps negatives before mode='drop' applies; the XLA path
+    must remap them out of range so both paths agree on padding ids."""
+    vals = jnp.asarray([10.0, 1.0, 2.0])
+    ids = jnp.asarray([-1, 0, 2])
+    out_x = segment_sum_xla(vals, ids, 4)
+    out_p = segment_sum_pallas(vals, ids, 4, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_x), [1.0, 0.0, 2.0, 0.0])
+    np.testing.assert_allclose(np.asarray(out_x), np.asarray(out_p))
+
+
 def test_2d_inputs_flattened():
     vals = jnp.ones((4, 8))
     ids = jnp.tile(jnp.arange(8), (4, 1))

@@ -12,6 +12,10 @@ import numpy as np
 import pandas as pd
 import pytest
 
+# requires_shard_map skips the compute tier where the env gap bites;
+# the two rejects-* error-path tests raise before any shard_map kernel
+# runs, so they stay unmarked and green everywhere
+from conftest import requires_shard_map
 from socceraction_tpu.core.batch import pack_actions
 from socceraction_tpu.core.synthetic import synthetic_actions_frame
 from socceraction_tpu.ops.features import compute_features
@@ -75,6 +79,7 @@ def sharded(batch, mesh):
 
 
 @pytest.mark.parametrize('k', [1, 2, 3])
+@requires_shard_map
 def test_sequence_features_match_unsharded(batch, sharded, mesh, k):
     ref = compute_features(batch, names=NAMES, k=k)
     out = sequence_features(sharded, mesh, names=NAMES, k=k)
@@ -85,6 +90,7 @@ def test_sequence_features_match_unsharded(batch, sharded, mesh, k):
 
 
 @pytest.mark.parametrize('nr_actions', [2, 10])
+@requires_shard_map
 def test_sequence_labels_match_unsharded(batch, sharded, mesh, nr_actions):
     ref_s, ref_c = scores_concedes(batch, nr_actions=nr_actions)
     out_s, out_c = sequence_labels(sharded, mesh, nr_actions=nr_actions)
@@ -93,6 +99,7 @@ def test_sequence_labels_match_unsharded(batch, sharded, mesh, nr_actions):
     np.testing.assert_array_equal(np.asarray(out_c)[mask], np.asarray(ref_c)[mask])
 
 
+@requires_shard_map
 def test_sequence_values_match_unsharded(batch, sharded, mesh):
     rng = np.random.default_rng(0)
     ps = rng.uniform(size=batch.type_id.shape).astype(np.float32)
@@ -113,6 +120,7 @@ def test_sequence_values_match_unsharded(batch, sharded, mesh):
 
 
 @pytest.mark.parametrize('k', [1, 3])
+@requires_shard_map
 def test_sequence_rate_matches_rate_batch(batch, sharded, mesh, k):
     """End-to-end sequence-sharded rating == the unsharded fused rating."""
     from socceraction_tpu.parallel.sequence import sequence_rate
@@ -192,6 +200,7 @@ def atomic_sharded(atomic_batch, mesh):
     return shard_batch_seq(atomic_batch, mesh)
 
 
+@requires_shard_map
 def test_atomic_sequence_features_match_unsharded(atomic_batch, atomic_sharded, mesh):
     from socceraction_tpu.ops import atomic as atomic_ops
 
@@ -203,6 +212,7 @@ def test_atomic_sequence_features_match_unsharded(atomic_batch, atomic_sharded, 
     )
 
 
+@requires_shard_map
 def test_atomic_sequence_labels_match_unsharded(atomic_batch, atomic_sharded, mesh):
     from socceraction_tpu.ops import atomic as atomic_ops
 
@@ -213,6 +223,7 @@ def test_atomic_sequence_labels_match_unsharded(atomic_batch, atomic_sharded, me
     np.testing.assert_array_equal(np.asarray(out_c)[mask], np.asarray(ref_c)[mask])
 
 
+@requires_shard_map
 def test_atomic_sequence_rate_matches_rate_batch(atomic_batch, atomic_sharded, mesh):
     from socceraction_tpu.atomic.spadl import convert_to_atomic
     from socceraction_tpu.atomic.vaep import AtomicVAEP
@@ -242,6 +253,7 @@ def test_atomic_sequence_rate_matches_rate_batch(atomic_batch, atomic_sharded, m
     )
 
 
+@requires_shard_map
 def test_atomic_sequence_values_match_unsharded(atomic_batch, atomic_sharded, mesh):
     """The atomic formula dispatch (sequence_values path), not just rate."""
     from socceraction_tpu.ops import atomic as atomic_ops
@@ -285,6 +297,7 @@ def test_sequence_rate_rejects_family_mismatch(atomic_sharded, mesh):
         sequence_rate(model, atomic_sharded, mesh)
 
 
+@requires_shard_map
 def test_halo_wider_than_shard_raises(mesh):
     """nr_actions-1 > A/seq must fail with the named constraint, not a
     broadcast error from inside ppermute."""
@@ -300,6 +313,7 @@ def test_halo_wider_than_shard_raises(mesh):
         sequence_labels(sb, mesh, nr_actions=10)
 
 
+@requires_shard_map
 def test_goalscore_prefix_crosses_shards(batch, sharded, mesh):
     """The running score must carry goals across shard boundaries."""
     out = sequence_features(sharded, mesh, names=('goalscore',), k=1)

@@ -10,6 +10,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
+from conftest import requires_shard_map
 from socceraction_tpu import xthreat as xt
 from socceraction_tpu.core.batch import pack_actions
 from socceraction_tpu.core.synthetic import synthetic_actions_frame
@@ -77,6 +78,7 @@ def test_model_level_accelerate(season):
     np.testing.assert_allclose(r_acc, r_plain, atol=5e-5, equal_nan=True)
 
 
+@requires_shard_map
 def test_sharded_anderson_matches_unsharded(season):
     """Accelerated + sharded: psum'd sweeps inside the Anderson loop must
     still land on the plain unsharded fixed point."""

@@ -39,6 +39,22 @@ NAME_TAKING_CALLS = {
     'counter', 'gauge', 'histogram', 'timed', 'timed_labels', 'span',
 }
 
+#: The repo's registered metric areas (the segment before the first '/').
+#: A new subsystem adds its area here — an unlisted area in a literal
+#: registration site fails the gate, so telemetry surfaces cannot appear
+#: ungoverned ('train' landed with the fused-train path, PR 3). ``main``
+#: enforces this list on every CLI invocation (default targets or
+#: explicit paths); ``check_files`` called without ``areas`` — the unit
+#: tests' scratch files — checks convention and units only.
+KNOWN_AREAS = {
+    'bench',  # bench.py headline gauges
+    'pipeline',  # store/feed/cache stage timings
+    'train',  # MLP fit loop + bench training configs
+    'vaep',  # rate_batch instrumentation
+    'walkthrough',  # narrative-doc demo spans
+    'xt',  # expected-threat fit metrics
+}
+
 #: implicit units of name-taking calls that never pass ``unit=``
 DEFAULT_UNITS = {
     'timed': 's',
@@ -111,8 +127,17 @@ def collect_names(
         yield call, first.value, node.lineno, unit
 
 
-def check_files(paths: List[str]) -> Tuple[List[str], int]:
-    """(problems, n_sites) over every literal registration site."""
+def check_files(
+    paths: List[str], areas: Optional[set] = None
+) -> Tuple[List[str], int]:
+    """(problems, n_sites) over every literal registration site.
+
+    ``areas``, when given, is the allow-list of registered metric areas
+    (:data:`KNOWN_AREAS`): a well-formed name whose leading segment is
+    not in it is flagged. ``None`` (the default, and what the unit tests
+    use on scratch files) checks the naming convention and unit
+    conflicts only.
+    """
     problems: List[str] = []
     units: Dict[str, Tuple[str, str]] = {}  # name -> (unit, first site)
     n_sites = 0
@@ -133,6 +158,13 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
                     "naming convention (lowercase segments joined by '/')"
                 )
                 continue
+            if areas is not None and name.split('/')[0] not in areas:
+                problems.append(
+                    f'{site}: {call}({name!r}) uses unregistered area '
+                    f'{name.split("/")[0]!r} (add it to KNOWN_AREAS to '
+                    'register a new telemetry area)'
+                )
+                continue
             if unit is None:
                 continue
             seen = units.get(name)
@@ -148,7 +180,7 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
 
 def main(argv: List[str]) -> int:
     targets = argv or DEFAULT_TARGETS
-    problems, n_sites = check_files(targets)
+    problems, n_sites = check_files(targets, areas=KNOWN_AREAS)
     for p in problems:
         print(p)
     print(
