@@ -40,9 +40,11 @@ bench:
 	$(PY) bench.py
 
 # fast CPU pass over the VAEP MLP training configs (fused + materialized,
-# 2 steps / 2 epochs) — catches a broken train kernel without a chip
+# 2 steps / 2 epochs) plus a 2-second serve_throughput sweep — catches a
+# broken train kernel or serving layer without a chip
 bench-smoke:
 	$(PY) bench.py --train-smoke
+	$(PY) bench.py --serve-smoke
 
 # regenerate the committed executed-walkthrough outputs (the repo's
 # analog of the reference's executed notebook cells; drift-checked by

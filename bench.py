@@ -395,6 +395,9 @@ def _bench_extra_configs() -> dict:
 
     out['cold_path_stream'] = _bench_cold_path()
 
+    serve_s = float(os.environ.get('SOCCERACTION_TPU_BENCH_SERVE_SECONDS', 8))
+    out['serve_throughput'] = _bench_serve_throughput(duration_s=serve_s)
+
     # the cold-path passes reset the registry between streams (same
     # zeroed-husk hazard the headline gauges dodge by recording last —
     # bench_impl); re-record the training gauges from the measured rates
@@ -414,6 +417,9 @@ def _bench_extra_configs() -> dict:
                 path=rate_path,
                 platform=_platform,
             )
+    _gauge('bench/serve_requests_per_sec', unit='requests/s').set(
+        out['serve_throughput']['peak_requests_per_sec'], platform=_platform
+    )
     return out
 
 
@@ -615,6 +621,156 @@ def _bench_train_configs(step_games: int, *, n_steps: int = 10, n_epochs: int = 
         2,
     )
     out['vaep_mlp_train_epoch'] = epoch_out
+    return out
+
+
+def _bench_serve_throughput(
+    *, duration_s: float = 8.0, clients=(1, 4, 16), max_actions: int = 512
+) -> dict:
+    """Closed-loop offered-load sweep over the online rating service.
+
+    Each level runs ``c`` closed-loop clients (submit one match, wait for
+    the rating, repeat) against one :class:`RatingService` for
+    ``duration_s`` seconds, after a warmup pass that compiles the bucket
+    ladder. Reported per level, all from the typed obs snapshot (no
+    string scraping):
+
+    - sustained ``requests_per_sec`` / ``actions_per_sec``;
+    - mean batch fill ratio (requests per flush / bucket size);
+    - ``request_p50_ms`` / ``request_p99_ms`` end-to-end latency
+      (``serve/request_seconds`` histogram quantile estimates);
+    - flush-reason split (``full`` vs ``deadline``) and rejections;
+    - ``compiled_shapes`` before/after — the acceptance gate: under
+      steady offered load the compiled-shape count must PLATEAU at the
+      bucket-ladder size (no per-request retraces).
+    """
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.obs import REGISTRY
+    from socceraction_tpu.serve import Overloaded, RatingService
+    from socceraction_tpu.vaep.base import VAEP
+
+    rng = np.random.default_rng(0)
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=300)
+        for i in range(2)
+    ]
+    model = VAEP()
+    X = []
+    y = []
+    for i, f in enumerate(frames):
+        game = pd.Series({'game_id': i, 'home_team_id': 100})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True),
+        pd.concat(y, ignore_index=True),
+        learner='mlp',
+        tree_params={'hidden': (64, 64), 'max_epochs': 2},
+    )
+
+    # randomized request sizes: the bucket ladder (not the request mix)
+    # must own the compiled-shape count
+    pool = [
+        synthetic_actions_frame(
+            game_id=100 + i, seed=100 + i,
+            n_actions=int(rng.integers(60, max_actions - 60)),
+        )
+        for i in range(8)
+    ]
+
+    out: dict = {'duration_s_per_level': duration_s, 'levels': []}
+    with RatingService(
+        model, max_actions=max_actions, max_batch_size=16, max_wait_ms=2.0,
+        max_queue=256,
+    ) as svc:
+        svc.warmup()
+        out['bucket_ladder'] = list(svc.ladder)
+        out['max_actions'] = max_actions
+
+        def run_level(n_clients: int) -> dict:
+            REGISTRY.reset()
+            shapes_before = svc.compiled_shapes
+            stop = _time.perf_counter() + duration_s
+            counts = [0] * n_clients
+            actions = [0] * n_clients
+            rejected = [0] * n_clients
+
+            def client(ci: int) -> None:
+                k = ci
+                while _time.perf_counter() < stop:
+                    frame = pool[k % len(pool)]
+                    k += 1
+                    try:
+                        svc.rate(frame, home_team_id=100).result(timeout=60)
+                    except Overloaded:
+                        rejected[ci] += 1
+                        continue
+                    counts[ci] += 1
+                    actions[ci] += len(frame)
+
+            t0 = _time.perf_counter()
+            threads = [
+                _threading.Thread(target=client, args=(ci,))
+                for ci in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = _time.perf_counter() - t0
+            snap = REGISTRY.snapshot()
+            lat = snap.series('serve/request_seconds', kind='rate')
+            fill = snap.series('serve/batch_fill_ratio')
+            q = lat.quantiles if lat is not None and lat.count else {}
+            level = {
+                'clients': n_clients,
+                'elapsed_s': round(elapsed, 2),
+                'requests': sum(counts),
+                'requests_per_sec': round(sum(counts) / elapsed, 1),
+                'actions_per_sec': round(sum(actions) / elapsed, 1),
+                'batch_fill_ratio_mean': (
+                    round(fill.mean, 3) if fill is not None and fill.count else None
+                ),
+                'request_p50_ms': (
+                    round(q['p50'] * 1e3, 2) if 'p50' in q else None
+                ),
+                'request_p99_ms': (
+                    round(q['p99'] * 1e3, 2) if 'p99' in q else None
+                ),
+                'flushes': {
+                    reason: int(
+                        snap.value('serve/flushes', reason=reason)
+                    )
+                    for reason in ('full', 'deadline')
+                },
+                # client-side tally only: serve/rejected_total counts the
+                # same submit-time Overloaded raises (adding them would
+                # double-count every shed request)
+                'rejected': sum(rejected),
+                'compiled_shapes_before': shapes_before,
+                'compiled_shapes_after': svc.compiled_shapes,
+            }
+            level['compiled_shapes_plateaued'] = bool(
+                svc.compiled_shapes == shapes_before
+            )
+            return level
+
+        for c in clients:
+            out['levels'].append(run_level(c))
+
+    best = max(out['levels'], key=lambda lv: lv['requests_per_sec'])
+    out['peak_requests_per_sec'] = best['requests_per_sec']
+    out['peak_actions_per_sec'] = best['actions_per_sec']
+    out['compiled_shapes_plateaued'] = all(
+        lv['compiled_shapes_plateaued'] for lv in out['levels']
+    )
     return out
 
 
@@ -908,6 +1064,7 @@ def _cpu_env() -> dict:
         'SOCCERACTION_TPU_BENCH_STEP_GAMES',
         'SOCCERACTION_TPU_BENCH_COLD_GAMES',
         'SOCCERACTION_TPU_BENCH_COLD_CHUNK',
+        'SOCCERACTION_TPU_BENCH_SERVE_SECONDS',
         'SOCCERACTION_TPU_RATING_PATH',
     ):
         env.pop(knob, None)
@@ -1035,9 +1192,46 @@ def _train_smoke() -> None:
     )
 
 
+def _serve_smoke() -> None:
+    """``make bench-smoke``: the serve_throughput sweep, 2s/level, on CPU.
+
+    Exercises the whole online path — packing, micro-batching, bucket
+    padding, deadline flushes, the typed-snapshot latency read — so a
+    broken serving layer fails fast and locally. Same clean-CPU re-exec
+    recipe as :func:`_train_smoke`.
+    """
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    axon_disabled = os.environ.get('PALLAS_AXON_POOL_IPS', 'unset') == ''
+    if not (platforms == 'cpu' and axon_disabled):
+        here = os.path.dirname(os.path.abspath(__file__))
+        rc = subprocess.call(
+            [sys.executable, os.path.join(here, 'bench.py'), '--serve-smoke'],
+            env=_cpu_env(),
+            cwd=here,
+        )
+        sys.exit(rc)
+    seconds = float(os.environ.get('SOCCERACTION_TPU_BENCH_SERVE_SECONDS', 2))
+    out = _bench_serve_throughput(duration_s=seconds, clients=(1, 4))
+    print(
+        json.dumps(
+            {
+                'metric': 'serve_requests_per_sec',
+                'value': out['peak_requests_per_sec'],
+                'unit': 'requests/sec',
+                'platform': 'cpu',
+                'smoke': True,
+                **out,
+            }
+        )
+    )
+
+
 def main() -> None:
     if '--train-smoke' in sys.argv:
         _train_smoke()
+        return
+    if '--serve-smoke' in sys.argv:
+        _serve_smoke()
         return
     if '--impl' in sys.argv:
         print(json.dumps(bench_impl()))

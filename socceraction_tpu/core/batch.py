@@ -39,6 +39,9 @@ __all__ = [
     'pack_atomic_actions',
     'unpack_values',
     'pad_length',
+    'bucket_games',
+    'bucket_ladder',
+    'pad_batch_games',
 ]
 
 from ..config import ACTION_AXIS_ALIGNMENT
@@ -314,6 +317,57 @@ def pack_atomic_actions(
         actions, home_team_ids, home_team_id, max_actions, float_dtype, device,
         _ATOMIC_FLOAT_COLS, _ATOMIC_INT_COLS, AtomicActionBatch, as_numpy,
     )
+
+
+def bucket_games(n: int) -> int:
+    """Round a game count up to its shape bucket (the next power of two).
+
+    Every distinct leading-axis length is a distinct XLA compilation; a
+    caller that rates arbitrary-length batches retraces once per unique
+    row count. Padding the game axis to a power-of-two ladder caps the
+    compiled-shape set at ``log2(max_games)`` entries — the bucket
+    discipline shared by :meth:`~socceraction_tpu.vaep.base.VAEP.rate_batch`
+    and the online batcher (:mod:`socceraction_tpu.serve.batcher`).
+    """
+    if n < 1:
+        raise ValueError(f'need at least one game, got {n}')
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_ladder(max_games: int) -> Tuple[int, ...]:
+    """The full bucket ladder up to ``max_games``: ``(1, 2, 4, ..., B)``.
+
+    ``max_games`` itself is rounded up to a bucket, so the top rung always
+    admits a full batch.
+    """
+    top = bucket_games(max_games)
+    return tuple(1 << i for i in range(top.bit_length()))
+
+
+def pad_batch_games(batch: Any, n_games: int) -> Any:
+    """Pad a batch's game axis to ``n_games`` with masked padding games.
+
+    Works on :class:`ActionBatch` and :class:`AtomicActionBatch` with
+    either host (numpy) or device fields. Padding games carry all-False
+    masks, ``n_actions == 0`` and ``row_index == -1``, so every masked
+    consumer (``unpack_values``, the label/formula kernels' valid rows)
+    ignores them; their computed values are garbage by contract and must
+    be sliced away by the caller.
+    """
+    G = batch.n_games
+    if n_games == G:
+        return batch
+    if n_games < G:
+        raise ValueError(f'cannot pad {G} games down to {n_games}')
+
+    def pad(a, fill=0):
+        width = [(0, n_games - G)] + [(0, 0)] * (a.ndim - 1)
+        if isinstance(a, np.ndarray):
+            return np.pad(a, width, constant_values=fill)
+        return jnp.pad(a, width, constant_values=fill)
+
+    padded = jax.tree.map(pad, batch)
+    return padded.replace(row_index=pad(batch.row_index, fill=-1))
 
 
 def unpack_values(values: Any, batch: ActionBatch) -> np.ndarray:

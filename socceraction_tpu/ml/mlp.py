@@ -48,7 +48,13 @@ from flax import linen as nn
 
 from ..obs import counter, histogram, span
 
-__all__ = ['MLPClassifier']
+__all__ = ['MLPClassifier', 'MLP_FORMAT_VERSION']
+
+#: Version stamped into :meth:`MLPClassifier.save` artifacts. Bump on any
+#: layout change; :meth:`MLPClassifier.load` rejects artifacts from a
+#: NEWER version with a clear error instead of failing deep inside
+#: ``np.load`` key access (the model registry depends on this contract).
+MLP_FORMAT_VERSION = 1
 
 
 class _MLP(nn.Module):
@@ -612,6 +618,7 @@ class MLPClassifier:
         with open(path, 'wb') as f:
             np.savez(
                 f,
+                format_version=np.array(MLP_FORMAT_VERSION),
                 params_msgpack=np.frombuffer(
                     serialization.to_bytes(self.params), dtype=np.uint8
                 ),
@@ -628,6 +635,19 @@ class MLPClassifier:
         from flax import serialization
 
         with np.load(path, allow_pickle=False) as data:
+            # pre-versioning artifacts (format 1 without the stamp) load;
+            # anything stamped NEWER than this library is rejected up
+            # front with an actionable error
+            version = (
+                int(data['format_version']) if 'format_version' in data else 1
+            )
+            if version > MLP_FORMAT_VERSION:
+                raise ValueError(
+                    f'checkpoint at {path!r} has format_version={version}, '
+                    'newer than this library understands '
+                    f'(<= {MLP_FORMAT_VERSION}); upgrade socceraction_tpu '
+                    'to load it'
+                )
             hyper = json.loads(str(data['hyper_json']))
             mean = data['mean']
             std = data['std']

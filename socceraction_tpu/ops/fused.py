@@ -475,6 +475,7 @@ def _pair_probs(
     mean_b,
     std_b,
     batch,
+    dense_overrides=None,
     *,
     names,
     k,
@@ -488,6 +489,7 @@ def _pair_probs(
         hidden_layers_a=hidden_layers_a, hidden_layers_b=hidden_layers_b,
         mean_a=mean_a, std_a=std_a, mean_b=mean_b, std_b=std_b,
         registry=REGISTRIES[registry_name],
+        dense_overrides=dense_overrides,
         hidden_dtype=(
             jnp.dtype(hidden_dtype_name) if hidden_dtype_name else None
         ),
@@ -503,6 +505,7 @@ def fused_pair_probs(
     names: Tuple[str, ...],
     k: int,
     registry_name: str = 'standard',
+    dense_overrides: Optional[Dict[str, jax.Array]] = None,
     hidden_dtype: Optional[Any] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Probabilities of two MLP heads in one jitted stacked-fold call.
@@ -510,20 +513,31 @@ def fused_pair_probs(
     ``VAEP.rate_batch`` rates with a scores head and a concedes head over
     the same batch; :func:`fused_pair_logits` stacks their first layers so
     the per-state gathers and the dense feature blocks are computed once
-    for both. Head widths and depths may differ. ``hidden_dtype`` opts
-    the hidden pipeline into a narrower dtype (:func:`_hidden_chain`).
+    for both. Head widths and depths may differ. ``dense_overrides``
+    substitutes precomputed ``(G, A, width)`` blocks for named dense
+    kernels (the serving layer injects the whole-match ``goalscore`` block
+    for suffix windows this way). ``hidden_dtype`` opts the hidden
+    pipeline into a narrower dtype (:func:`_hidden_chain`).
+
+    Standardization constants come from the classifiers' cached device
+    copies (:meth:`~socceraction_tpu.ml.mlp.MLPClassifier._device_stats`),
+    so a warm (registry-resident) model does not re-upload ``mean_``/
+    ``std_`` on every call.
     """
     for clf in (clf_a, clf_b):
         if clf.params is None or clf.mean_ is None or clf.std_ is None:
             raise ValueError('classifier is not fitted')
+    mean_a, std_a = clf_a._device_stats()
+    mean_b, std_b = clf_b._device_stats()
     return _pair_probs(
         clf_a.params,
         clf_b.params,
-        jnp.asarray(clf_a.mean_),
-        jnp.asarray(clf_a.std_),
-        jnp.asarray(clf_b.mean_),
-        jnp.asarray(clf_b.std_),
+        mean_a,
+        std_a,
+        mean_b,
+        std_b,
         batch,
+        dense_overrides,
         names=tuple(names),
         k=k,
         hidden_layers_a=len(clf_a.hidden),

@@ -1,0 +1,47 @@
+"""Online serving: micro-batched, shape-bucketed live rating.
+
+The subsystem that turns the batch-oriented valuation core into the
+thing the ROADMAP's north star describes — a server multiplexing many
+concurrent callers onto the fused one-dispatch rating path:
+
+- :mod:`socceraction_tpu.serve.batcher` — the thread-safe micro-batching
+  queue: deadline-bounded coalescing, power-of-two shape buckets,
+  bounded-queue admission control (:class:`Overloaded`).
+- :mod:`socceraction_tpu.serve.session` — :class:`MatchSession`, live
+  per-match streaming: O(new actions) incremental rating with the
+  whole-match ``goalscore`` carry injected as a dense override.
+- :mod:`socceraction_tpu.serve.registry` — :class:`ModelRegistry`,
+  named+versioned checkpoints with warm device residency and atomic
+  hot-swap.
+- :mod:`socceraction_tpu.serve.service` — :class:`RatingService`, the
+  front end (``rate() -> Future``, ``open_session``, ``swap_model``),
+  fully instrumented under the ``serve`` telemetry area.
+
+Quickstart::
+
+    from socceraction_tpu.serve import RatingService
+
+    service = RatingService(model, max_wait_ms=2.0)
+    service.warmup()                      # compile the bucket ladder
+    fut = service.rate(actions_df, home_team_id=782)
+    values = fut.result()                 # offensive/defensive/vaep cols
+
+    live = service.open_session('match-1', home_team_id=782)
+    live.add_actions(first_minutes_df)    # rates only the new suffix
+
+See ``docs/serving.md`` for the architecture and overload/swap
+semantics.
+"""
+
+from .batcher import MicroBatcher, Overloaded
+from .registry import ModelRegistry
+from .service import RatingService
+from .session import MatchSession
+
+__all__ = [
+    'MicroBatcher',
+    'Overloaded',
+    'ModelRegistry',
+    'RatingService',
+    'MatchSession',
+]

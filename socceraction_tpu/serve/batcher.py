@@ -1,0 +1,245 @@
+"""Micro-batching queue: coalesce concurrent rating requests into buckets.
+
+One request is one match's (or one session window's) actions — a single
+game-row of a device batch. Dispatching each request alone would pay a
+full XLA dispatch per request and compile one program per distinct batch
+shape; the batcher instead multiplexes every concurrent caller onto the
+fused one-dispatch rating path:
+
+- **coalescing** — requests accumulate in a bounded queue and flush as
+  ONE device batch when ``max_batch_size`` requests are waiting or the
+  oldest request has aged ``max_wait_ms`` (latency bound), whichever
+  comes first;
+- **shape buckets** — a flush of ``n`` requests is padded up to the
+  power-of-two bucket ladder
+  (:func:`socceraction_tpu.core.batch.bucket_ladder`), so steady-state
+  traffic executes a small, pinned set of compiled shapes instead of
+  retracing per unique batch size;
+- **admission control** — past ``max_queue`` waiting requests, ``submit``
+  raises :class:`Overloaded` immediately instead of growing the queue
+  (and its memory) without bound; callers shed load explicitly.
+
+The batcher is policy-only: it never touches jax. A ``runner`` callable
+(the service's flush, :meth:`socceraction_tpu.serve.service.RatingService._flush`)
+turns a list of payloads plus a bucket size into one result per payload;
+the batcher owns the queue, the deadline clock, the futures and the
+``serve/*`` telemetry. Everything is thread-safe; all device work happens
+on the single flusher thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ..core.batch import bucket_ladder
+from ..obs import counter, gauge, histogram, span
+
+__all__ = ['MicroBatcher', 'Overloaded']
+
+
+class Overloaded(RuntimeError):
+    """Raised by ``submit`` when the admission queue is full.
+
+    The explicit load-shedding signal: the caller sees it synchronously
+    (no future is created) and can retry, down-sample or propagate a 429 —
+    the alternative, unbounded queueing, turns overload into unbounded
+    memory growth and unbounded latency for every request behind it.
+    """
+
+
+class _Request:
+    __slots__ = ('payload', 'kind', 'future', 't0')
+
+    def __init__(self, payload: Any, kind: str) -> None:
+        self.payload = payload
+        self.kind = kind
+        self.future: Future = Future()
+        self.t0 = time.perf_counter()
+
+
+class MicroBatcher:
+    """Thread-safe micro-batching queue in front of a batch runner.
+
+    Parameters
+    ----------
+    runner : callable
+        ``runner(payloads, bucket) -> results`` — rates one coalesced
+        batch; ``bucket >= len(payloads)`` is the ladder size the device
+        batch must be padded to, and ``results`` must align with
+        ``payloads``. Runs on the flusher thread only.
+    max_batch_size : int
+        Flush immediately once this many requests are waiting. Also the
+        top of the bucket ladder (rounded up to a power of two).
+    max_wait_ms : float
+        Deadline flush: a request never waits longer than this for
+        co-batching before its flush is dispatched.
+    max_queue : int
+        Admission bound: ``submit`` past this many waiting requests
+        raises :class:`Overloaded`.
+    """
+
+    def __init__(
+        self,
+        runner: Callable[[List[Any], int], Sequence[Any]],
+        *,
+        max_batch_size: int = 64,
+        max_wait_ms: float = 2.0,
+        max_queue: int = 256,
+    ) -> None:
+        if max_batch_size < 1:
+            raise ValueError('max_batch_size must be >= 1')
+        if max_queue < max_batch_size:
+            raise ValueError('max_queue must be >= max_batch_size')
+        self._runner = runner
+        self.max_batch_size = max_batch_size
+        self.max_wait_s = max_wait_ms / 1000.0
+        self.max_queue = max_queue
+        self.ladder: Tuple[int, ...] = bucket_ladder(max_batch_size)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[_Request] = []
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, payload: Any, *, kind: str = 'rate') -> Future:
+        """Enqueue one request; returns its :class:`concurrent.futures.Future`.
+
+        Raises :class:`Overloaded` when the admission queue is full and
+        ``RuntimeError`` after :meth:`close`. ``kind`` is a low-cardinality
+        telemetry label (``rate`` | ``session`` | ``warmup``).
+        """
+        req = _Request(payload, kind)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError('batcher is closed')
+            if len(self._queue) >= self.max_queue:
+                counter('serve/rejected_total', unit='requests').inc(1)
+                raise Overloaded(
+                    f'{len(self._queue)} requests already queued '
+                    f'(max_queue={self.max_queue}); shed load or raise the bound'
+                )
+            self._queue.append(req)
+            depth = len(self._queue)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._flush_loop, name='serve-flusher', daemon=True
+                )
+                self._thread.start()
+            self._cond.notify()
+        gauge('serve/queue_depth', unit='requests').set(depth)
+        counter('serve/requests', unit='requests').inc(1, kind=kind)
+        return req.future
+
+    def bucket_for(self, n: int) -> int:
+        """The smallest ladder rung admitting ``n`` requests."""
+        for b in self.ladder:
+            if b >= n:
+                return b
+        return self.ladder[-1]
+
+    # -- the flusher thread ------------------------------------------------
+
+    def _take(self) -> Tuple[List[_Request], str]:
+        """Block until a flush is due; pop and return (requests, reason).
+
+        Called on the flusher thread. Returns ``([], 'closed')`` when the
+        batcher is closed and drained.
+        """
+        with self._cond:
+            while True:
+                if self._queue:
+                    if len(self._queue) >= self.max_batch_size:
+                        reason = 'full'
+                        break
+                    if self._closed:
+                        reason = 'close'
+                        break
+                    deadline = self._queue[0].t0 + self.max_wait_s
+                    now = time.perf_counter()
+                    if now >= deadline:
+                        reason = 'deadline'
+                        break
+                    self._cond.wait(timeout=deadline - now)
+                elif self._closed:
+                    return [], 'closed'
+                else:
+                    self._cond.wait()
+            take = self._queue[: self.max_batch_size]
+            del self._queue[: len(take)]
+            depth = len(self._queue)
+        gauge('serve/queue_depth', unit='requests').set(depth)
+        return take, reason
+
+    def _flush_loop(self) -> None:
+        while True:
+            take, reason = self._take()
+            if not take:
+                return
+            self._flush(take, reason)
+
+    def _flush(self, take: List[_Request], reason: str) -> None:
+        # Transition every future to RUNNING; a caller that cancel()ed
+        # while queued is dropped here. After this point cancel() can no
+        # longer succeed, so set_result below cannot raise
+        # InvalidStateError and kill the flusher thread.
+        take = [r for r in take if r.future.set_running_or_notify_cancel()]
+        if not take:
+            return
+        bucket = self.bucket_for(len(take))
+        fill = len(take) / bucket
+        counter('serve/flushes', unit='count').inc(1, reason=reason)
+        gauge('serve/batch_fill_ratio', unit='ratio').set(fill)
+        try:
+            with span('serve/flush', requests=len(take), bucket=bucket):
+                with histogram('serve/flush_seconds', unit='s').time(
+                    bucket=str(bucket)
+                ):
+                    results = self._runner([r.payload for r in take], bucket)
+            if len(results) != len(take):
+                raise RuntimeError(
+                    f'runner returned {len(results)} results for '
+                    f'{len(take)} requests'
+                )
+        except BaseException as e:  # noqa: BLE001 - failures go to the futures
+            for r in take:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        done = time.perf_counter()
+        lat = histogram('serve/request_seconds', unit='s')
+        for r, out in zip(take, results):
+            lat.observe(done - r.t0, kind=r.kind)
+            r.future.set_result(out)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, *, drain: bool = True) -> None:
+        """Stop the flusher. ``drain=True`` (default) rates what is queued
+        first; ``drain=False`` fails queued requests with RuntimeError."""
+        with self._cond:
+            if self._closed:
+                thread = self._thread
+            else:
+                self._closed = True
+                if not drain:
+                    dropped, self._queue = self._queue, []
+                    for r in dropped:
+                        if r.future.set_running_or_notify_cancel():
+                            r.future.set_exception(
+                                RuntimeError('batcher closed before flush')
+                            )
+                thread = self._thread
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join(timeout=30.0)
+
+    def __enter__(self) -> 'MicroBatcher':
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

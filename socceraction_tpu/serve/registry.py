@@ -1,0 +1,203 @@
+"""Versioned model registry with warm device residency and atomic hot-swap.
+
+A serving process outlives any single model: ratings traffic keeps
+flowing while a newly trained model is rolled out (or a bad one rolled
+back). The registry layers three things over the existing
+:meth:`~socceraction_tpu.vaep.base.VAEP.save_model` /
+:meth:`~socceraction_tpu.ml.mlp.MLPClassifier.save` artifacts:
+
+- **named + versioned storage** — ``root/<name>/<version>/`` directories,
+  each one a ``save_model`` checkpoint. Loaders go through
+  :func:`socceraction_tpu.vaep.base.load_model`, so the
+  ``format_version`` stamp rejects artifacts from a newer library with a
+  clear error instead of a deep ``KeyError``.
+- **warm device residency** — on load, every MLP head's parameter pytree
+  and standardization statistics are uploaded to the device once
+  (:meth:`MLPClassifier._device_stats` caches) so steady-state rating
+  dispatches re-upload nothing; the per-state combined-table fold and
+  XLA compilation are warmed per shape bucket by
+  :meth:`~socceraction_tpu.serve.service.RatingService.warmup`.
+- **atomic hot-swap** — :meth:`activate` replaces the active
+  ``(name, version, model)`` triple under a lock in one reference
+  assignment; the service's flusher reads the triple once per flush, so
+  every request in a batch is rated by exactly one model version, never
+  a half-swapped mixture.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs import counter, span
+
+__all__ = ['ModelRegistry']
+
+_NAME_RE = re.compile(r'^[A-Za-z0-9][A-Za-z0-9._-]*$')
+
+
+def _version_sort_key(version: str) -> Tuple[Any, ...]:
+    """Order versions numerically when they look numeric ('2' < '10')."""
+    parts = re.split(r'[._-]', version)
+    return tuple(
+        (0, int(p)) if p.isdigit() else (1, p) for p in parts
+    )
+
+
+class ModelRegistry:
+    """Named, versioned store of rating models over ``save_model`` artifacts.
+
+    Parameters
+    ----------
+    root : str
+        Directory holding ``<name>/<version>/`` checkpoints. Created on
+        first publish; a pre-existing tree is picked up as-is.
+    """
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self._lock = threading.Lock()
+        self._loaded: Dict[Tuple[str, str], Any] = {}
+        self._active: Optional[Tuple[str, str, Any]] = None
+
+    # -- storage -----------------------------------------------------------
+
+    def _dir(self, name: str, version: str) -> str:
+        for part in (name, version):
+            if not _NAME_RE.match(part):
+                raise ValueError(
+                    f'invalid registry name/version {part!r} '
+                    '(want [A-Za-z0-9][A-Za-z0-9._-]*)'
+                )
+        return os.path.join(self.root, name, version)
+
+    def publish(self, name: str, version: str, model: Any) -> str:
+        """Save a fitted model as ``name``/``version``; returns its path.
+
+        Refuses to overwrite an existing version — versions are immutable
+        (republish under a new version instead).
+        """
+        path = self._dir(name, version)
+        if os.path.exists(path):
+            raise ValueError(
+                f'model {name}/{version} already exists at {path!r}; '
+                'versions are immutable — publish a new version'
+            )
+        os.makedirs(path)
+        model.save_model(path)
+        return path
+
+    def names(self) -> List[str]:
+        """Published model names."""
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+        )
+
+    def versions(self, name: str) -> List[str]:
+        """Published versions of ``name``, oldest to newest."""
+        base = os.path.join(self.root, name)
+        if not os.path.isdir(base):
+            return []
+        found = [
+            v for v in os.listdir(base)
+            if os.path.isfile(os.path.join(base, v, 'meta.json'))
+        ]
+        return sorted(found, key=_version_sort_key)
+
+    # -- loading + residency ----------------------------------------------
+
+    def load(self, name: str, version: Optional[str] = None) -> Any:
+        """Load (and device-warm) ``name``/``version`` (default: newest).
+
+        Loaded models are cached per ``(name, version)`` — versions are
+        immutable, so a cache entry can never go stale.
+        """
+        version = self.resolve_version(name, version)
+        key = (name, version)
+        with self._lock:
+            model = self._loaded.get(key)
+        if model is not None:
+            return model
+        from ..vaep.base import load_model
+
+        path = self._dir(name, version)
+        if not os.path.isfile(os.path.join(path, 'meta.json')):
+            raise FileNotFoundError(f'no model at {path!r}')
+        with span('serve/model_load', model=name, version=version):
+            model = load_model(path)
+            self.warm(model)
+        with self._lock:
+            self._loaded.setdefault(key, model)
+            return self._loaded[key]
+
+    @staticmethod
+    def warm(model: Any) -> Any:
+        """Upload a model's constants to the device once.
+
+        MLP heads get device-resident parameter pytrees and cached
+        device standardization statistics, so per-dispatch host→device
+        transfers disappear. (Per-bucket XLA compilation is the
+        service's :meth:`~socceraction_tpu.serve.service.RatingService.warmup`,
+        which needs the batch shapes.)
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..ml.mlp import MLPClassifier
+
+        for clf in getattr(model, '_models', {}).values():
+            if isinstance(clf, MLPClassifier) and clf.params is not None:
+                clf.params = jax.tree.map(jnp.asarray, clf.params)
+                if clf.mean_ is not None and clf.std_ is not None:
+                    clf._device_stats()
+        return model
+
+    # -- the active model --------------------------------------------------
+
+    def resolve_version(self, name: str, version: Optional[str]) -> str:
+        """``version``, or the newest published version of ``name``.
+
+        Callers that validate/warm a model before activating it resolve
+        ONCE and pass the pinned version everywhere after — re-resolving
+        'newest' later would race a concurrent publish.
+        """
+        if version is not None:
+            return version
+        available = self.versions(name)
+        if not available:
+            raise FileNotFoundError(
+                f'no versions of model {name!r} under {self.root!r}'
+            )
+        return available[-1]
+
+    def activate(self, name: str, version: Optional[str] = None) -> Tuple[str, str]:
+        """Atomically make ``name``/``version`` the active serving model.
+
+        The version is resolved FIRST and that exact version is loaded,
+        device-warmed and activated — a publish racing this call can
+        never make the recorded version string mismatch the live model.
+        The swap itself is one locked reference assignment, so a
+        concurrent flush reads either the old triple or the new one —
+        never a mixture. Returns the ``(name, version)`` that went live.
+        """
+        version = self.resolve_version(name, version)
+        model = self.load(name, version)
+        with self._lock:
+            self._active = (name, version, model)
+        counter('serve/model_swaps', unit='count').inc(1)
+        return name, version
+
+    def active(self) -> Tuple[str, str, Any]:
+        """The active ``(name, version, model)`` triple (one atomic read)."""
+        with self._lock:
+            active = self._active
+        if active is None:
+            raise RuntimeError(
+                'no active model: call activate(name, version) first'
+            )
+        return active

@@ -1,0 +1,415 @@
+"""The online rating service: micro-batched, shape-bucketed, hot-swappable.
+
+:class:`RatingService` is the in-process front end that turns the
+batch-oriented valuation core (``VAEP.rate_batch`` and the fused
+one-dispatch path behind it) into a multiplexed, latency-bounded server:
+
+- ``rate(actions) -> Future`` — rate one match's SPADL actions; packing
+  happens on the calling thread, the dispatch is coalesced with every
+  other concurrent request by the micro-batcher
+  (:mod:`socceraction_tpu.serve.batcher`) into power-of-two shape
+  buckets, so steady traffic runs a pinned set of compiled programs;
+- ``open_session(match_id, ...)`` — a per-match streaming
+  :class:`~socceraction_tpu.serve.session.MatchSession` that rates a
+  live game in O(new actions) per tick through the same batcher;
+- ``swap_model(name, version)`` — atomic hot-swap via the
+  :class:`~socceraction_tpu.serve.registry.ModelRegistry`: each flush
+  reads the active model once, so no request is ever rated by a
+  half-swapped model;
+- overload raises :class:`~socceraction_tpu.serve.batcher.Overloaded` at
+  ``rate()`` time (bounded queue — load is shed, not buffered forever).
+
+Every stage reports to :mod:`socceraction_tpu.obs` under the ``serve``
+area (queue depth, batch fill ratio, request latency histogram with
+p50/p99 estimates, rejections, per-bucket trace counters) and runs
+inside spans, so a :class:`~socceraction_tpu.obs.trace.RunLog` captures
+the full serving timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..core.batch import ActionBatch, pack_actions, pad_batch_games, unpack_values
+from ..obs import counter, gauge, span
+from .batcher import MicroBatcher, Overloaded
+from .session import (
+    WINDOW_LOCAL_KERNELS,
+    MatchSession,
+    goalscore_block,
+    pack_window,
+    score_prefix,
+)
+
+__all__ = ['RatingService']
+
+RATING_COLUMNS = ['offensive_value', 'defensive_value', 'vaep_value']
+
+
+class _Payload:
+    """One packed request: a staging batch plus its result recipe."""
+
+    __slots__ = ('staging', 'gs', 'keep', 'index')
+
+    def __init__(self, staging, gs, keep=None, index=None) -> None:
+        self.staging = staging  # host ActionBatch, (1, A) numpy fields
+        self.gs = gs  # (1, A, 3) f32 goalscore block
+        self.keep = keep  # None (whole frame) | (context, m) window slice
+        self.index = index  # pandas index for frame requests
+
+
+class RatingService:
+    """In-process online rating server over a fitted VAEP model.
+
+    Parameters
+    ----------
+    model : VAEP, optional
+        A fitted standard-SPADL :class:`~socceraction_tpu.vaep.base.VAEP`.
+        Give either ``model`` or ``registry``.
+    registry : ModelRegistry, optional
+        A :class:`~socceraction_tpu.serve.registry.ModelRegistry` whose
+        active model serves traffic; enables :meth:`swap_model`.
+    max_actions : int
+        Fixed action-axis capacity of every device batch (one compiled
+        ladder serves all traffic). A request/window longer than this is
+        rejected at call time.
+    max_batch_size : int
+        Requests per flush cap == top of the bucket ladder.
+    max_wait_ms : float
+        Deadline bound: a lone request is dispatched at most this long
+        after arrival.
+    max_queue : int
+        Admission bound; past it ``rate()`` raises
+        :class:`~socceraction_tpu.serve.batcher.Overloaded`.
+    """
+
+    def __init__(
+        self,
+        model: Any = None,
+        registry: Any = None,
+        *,
+        max_actions: int = 1664,
+        max_batch_size: int = 64,
+        max_wait_ms: float = 2.0,
+        max_queue: int = 256,
+    ) -> None:
+        if (model is None) == (registry is None):
+            raise ValueError('give exactly one of model= or registry=')
+        self._registry = registry
+        self._model = None
+        if model is not None:
+            self._validate_model(model)
+            self._model = model
+            first = model
+        else:
+            first = registry.active()[2]
+            self._validate_model(first)
+        # whether requests must carry the host goalscore block: invariant
+        # across swaps (swap_model rejects feature-layout changes), so
+        # models without the kernel never pay the per-request prefix work
+        self._gs_enabled = 'goalscore' in first._kernel_names()
+        self.max_actions = int(max_actions)
+        self._batcher = MicroBatcher(
+            self._flush,
+            max_batch_size=max_batch_size,
+            max_wait_ms=max_wait_ms,
+            max_queue=max_queue,
+        )
+        self._shape_lock = threading.Lock()
+        self._seen_shapes: set = set()
+
+    # -- model plumbing ----------------------------------------------------
+
+    @staticmethod
+    def _validate_model(model: Any) -> None:
+        if not getattr(model, '_models', None):
+            raise ValueError('the serving model must be fitted')
+        if getattr(model, '_fused_registry', None) != 'standard':
+            raise ValueError(
+                'RatingService serves standard-SPADL VAEP models '
+                '(atomic serving is not wired up yet)'
+            )
+        model._kernel_names()  # raises for kernel-less custom transformers
+
+    def _active(self) -> Tuple[str, str, Any]:
+        """One consistent ``(name, version, model)`` read (swap atomicity)."""
+        if self._model is not None:
+            return ('default', '0', self._model)
+        return self._registry.active()
+
+    @property
+    def model(self) -> Any:
+        """The model currently serving traffic."""
+        return self._active()[2]
+
+    @property
+    def nb_prev_actions(self) -> int:
+        """Game-state depth ``k`` of the serving model."""
+        return int(self.model.nb_prev_actions)
+
+    def swap_model(self, name: str, version: Optional[str] = None) -> Tuple[str, str]:
+        """Atomically swap serving to ``name``/``version`` (default newest).
+
+        The new version must be serve-compatible (fitted, standard
+        SPADL) and keep the active model's feature layout — sessions in
+        flight pin their window shape to ``nb_prev_actions`` and the
+        bucket ladder pins compiled shapes, so a layout change requires
+        a new service, not a swap.
+        """
+        if self._registry is None:
+            raise RuntimeError('swap_model needs a registry-backed service')
+        old = self.model
+        # pin 'newest' NOW: the version validated and pre-warmed below must
+        # be the exact version activated (a publish racing this call could
+        # otherwise slip an unvalidated, cold model past the gates)
+        version = self._registry.resolve_version(name, version)
+        new = self._registry.load(name, version)
+        self._validate_model(new)
+        if new.nb_prev_actions != old.nb_prev_actions or (
+            new._kernel_names() != old._kernel_names()
+        ):
+            raise ValueError(
+                'swap target changes the feature layout '
+                '(nb_prev_actions/xfns); start a new RatingService for it'
+            )
+        # pre-warm the NEW model's ladder compiles before it goes live: a
+        # different head architecture is a different XLA program, and
+        # without this the first post-swap request would pay its compile
+        # inside its latency budget (observed ~1s on CPU). Same-arch swaps
+        # hit the jit cache and cost a few no-op dispatches.
+        A = self.max_actions
+        for b in self._batcher.ladder:
+            self._device_rate(_empty_host_batch(1, A), _empty_gs(1, A), new, b)
+        return self._registry.activate(name, version)
+
+    # -- request entry points ----------------------------------------------
+
+    def rate(self, actions: pd.DataFrame, *, home_team_id: Any = None) -> Future:
+        """Rate one match's SPADL actions; returns a Future of a DataFrame.
+
+        ``actions`` is a single game's frame (like ``VAEP.rate``'s input,
+        sans the metadata row); ``home_team_id`` defaults to the frame's
+        ``home_team_id`` column when present. Packing runs on the calling
+        thread; the device dispatch is coalesced with concurrent
+        requests. The future resolves to a DataFrame with
+        ``offensive_value`` / ``defensive_value`` / ``vaep_value``
+        aligned to ``actions``' index, exactly equal to
+        ``VAEP.rate``'s values for the same frame.
+
+        Raises :class:`~socceraction_tpu.serve.batcher.Overloaded`
+        synchronously when the admission queue is full.
+        """
+        if len(actions) == 0:
+            raise ValueError('cannot rate an empty actions frame')
+        if 'game_id' in actions.columns and actions['game_id'].nunique() > 1:
+            raise ValueError(
+                'one request rates one match; split multi-game frames '
+                '(or use VAEP.rate_batch for offline batches)'
+            )
+        if home_team_id is None:
+            if 'home_team_id' not in actions.columns:
+                raise ValueError('home_team_id is required')
+            home_team_id = actions['home_team_id'].iloc[0]
+        if len(actions) > self.max_actions:
+            raise ValueError(
+                f'{len(actions)} actions exceed the service window '
+                f'(max_actions={self.max_actions})'
+            )
+        frame = actions
+        if 'game_id' not in frame.columns:
+            frame = frame.assign(game_id=0)
+        staging, _ids = pack_actions(
+            frame, home_team_id=home_team_id, max_actions=self.max_actions,
+            as_numpy=True,
+        )
+        gs = (
+            self._frame_goalscore(frame, home_team_id)
+            if self._gs_enabled
+            else None
+        )
+        payload = _Payload(staging, gs, keep=None, index=actions.index)
+        return self._batcher.submit(payload, kind='rate')
+
+    def rate_sync(
+        self, actions: pd.DataFrame, *, home_team_id: Any = None,
+        timeout: Optional[float] = None,
+    ) -> pd.DataFrame:
+        """Blocking convenience wrapper around :meth:`rate`."""
+        return self.rate(actions, home_team_id=home_team_id).result(timeout)
+
+    def open_session(self, match_id: Any, *, home_team_id: Any) -> MatchSession:
+        """Start a live-match streaming session (see :class:`MatchSession`)."""
+        names = set(self.model._kernel_names())
+        nonlocal_names = names - WINDOW_LOCAL_KERNELS - {'goalscore'}
+        if nonlocal_names:
+            raise ValueError(
+                f'feature kernels {sorted(nonlocal_names)} are not '
+                'window-local; streaming sessions cannot rate suffixes '
+                'under this model'
+            )
+        counter('serve/sessions_opened', unit='count').inc(1)
+        return MatchSession(self, match_id, home_team_id)
+
+    def _submit_window(
+        self, window: pd.DataFrame, context: int, m: int,
+        *, match_id: Any, home_team_id: Any,
+    ) -> Future:
+        """Session entry: pack a context+suffix window and enqueue it."""
+        staging, gs = pack_window(
+            window, match_id, home_team_id, self.max_actions
+        )
+        payload = _Payload(staging, gs, keep=(context, m))
+        return self._batcher.submit(payload, kind='session')
+
+    # -- the flush (runs on the batcher's flusher thread) ------------------
+
+    def _frame_goalscore(self, frame: pd.DataFrame, home_team_id: Any) -> np.ndarray:
+        """Whole-frame goalscore block ``(1, A, 3)`` computed on host.
+
+        Every request carries this block (not just session windows) so
+        all flushes execute the SAME program per bucket — one compiled
+        shape, whether the batch mixes fresh matches and live suffixes
+        or not. Values come from the session module's ``score_prefix``
+        (the single host mirror of the device kernel): small integer
+        counts, bitwise what the kernel computes.
+        """
+        is_home = frame['team_id'].to_numpy() == home_team_id
+        team, opp, _a, _b = score_prefix(
+            frame['type_id'].to_numpy(dtype=np.int64),
+            frame['result_id'].to_numpy(dtype=np.int64),
+            is_home == bool(is_home[0]),
+        )
+        return goalscore_block(team, opp, self.max_actions)
+
+    def _device_rate(
+        self,
+        host_batch: ActionBatch,
+        gs: Optional[np.ndarray],
+        model: Any,
+        bucket: int,
+    ) -> np.ndarray:
+        """Pad to the bucket, dispatch ``rate_batch``, fetch to host."""
+        import jax
+        import jax.numpy as jnp
+
+        if host_batch.n_games != bucket:
+            host_batch = pad_batch_games(host_batch, bucket)
+            if gs is not None:
+                gs = np.pad(gs, [(0, bucket - gs.shape[0]), (0, 0), (0, 0)])
+        key = (bucket, host_batch.max_actions)
+        with self._shape_lock:
+            new_shape = key not in self._seen_shapes
+            if new_shape:
+                self._seen_shapes.add(key)
+                n_shapes = len(self._seen_shapes)
+        if new_shape:
+            counter('serve/shape_traces', unit='count').inc(
+                1, bucket=str(bucket)
+            )
+            gauge('serve/compiled_shapes', unit='shapes').set(n_shapes)
+        batch = jax.device_put(host_batch)
+        overrides = (
+            {'goalscore': jnp.asarray(gs)}
+            if self._gs_enabled and gs is not None
+            else None
+        )
+        values = model.rate_batch(batch, dense_overrides=overrides, bucket=False)
+        return np.asarray(jax.device_get(values))
+
+    def _flush(self, payloads: List[_Payload], bucket: int) -> List[Any]:
+        _name, _version, model = self._active()  # ONE read per flush
+        stagings = [p.staging for p in payloads]
+        if len(stagings) == 1:
+            host_batch = stagings[0]
+            gs = payloads[0].gs
+        else:
+            import jax
+
+            host_batch = jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0), *stagings
+            )
+            gs = (
+                np.concatenate([p.gs for p in payloads], axis=0)
+                if self._gs_enabled
+                else None
+            )
+        values = self._device_rate(host_batch, gs, model, bucket)
+
+        results: List[Any] = []
+        for i, p in enumerate(payloads):
+            if p.keep is None:
+                rows = unpack_values(values[i : i + 1], p.staging)
+                results.append(
+                    pd.DataFrame(rows, columns=RATING_COLUMNS, index=p.index)
+                )
+            else:
+                context, m = p.keep
+                results.append(values[i, context : context + m, :].copy())
+        return results
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> Tuple[int, ...]:
+        """Compile the bucket ladder up front with all-padding batches.
+
+        Serving the first real request on a cold shape pays XLA
+        compilation inside its latency budget; warmup moves that cost to
+        startup (and after it, the per-bucket trace counters must stay
+        flat — pinned by the tests and the ``serve_throughput`` bench).
+        Returns the buckets warmed.
+        """
+        buckets = tuple(buckets) if buckets is not None else self._batcher.ladder
+        _name, _version, model = self._active()
+        A = self.max_actions
+        with span('serve/warmup', buckets=list(buckets)):
+            for b in buckets:
+                self._device_rate(_empty_host_batch(1, A), _empty_gs(1, A), model, b)
+        return buckets
+
+    def close(self, *, drain: bool = True) -> None:
+        """Flush (or fail) queued requests and stop the flusher thread."""
+        self._batcher.close(drain=drain)
+
+    def __enter__(self) -> 'RatingService':
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def ladder(self) -> Tuple[int, ...]:
+        """The bucket ladder (compiled-shape budget) of this service."""
+        return self._batcher.ladder
+
+    @property
+    def compiled_shapes(self) -> int:
+        """Distinct ``(bucket, max_actions)`` shapes dispatched so far."""
+        with self._shape_lock:
+            return len(self._seen_shapes)
+
+
+def _empty_host_batch(n_games: int, max_actions: int) -> ActionBatch:
+    """An all-padding staging batch (used to warm compile caches)."""
+    G, A = n_games, max_actions
+    i32 = np.zeros((G, A), dtype=np.int32)
+    f32 = np.zeros((G, A), dtype=np.float32)
+    return ActionBatch(
+        type_id=i32, result_id=i32, bodypart_id=i32, period_id=i32,
+        is_home=np.zeros((G, A), dtype=bool),
+        time_seconds=f32, start_x=f32, start_y=f32, end_x=f32, end_y=f32,
+        mask=np.zeros((G, A), dtype=bool),
+        n_actions=np.zeros((G,), dtype=np.int32),
+        game_id=np.arange(G, dtype=np.int32),
+        row_index=np.full((G, A), -1, dtype=np.int32),
+    )
+
+
+def _empty_gs(n_games: int, max_actions: int) -> np.ndarray:
+    return np.zeros((n_games, max_actions, 3), dtype=np.float32)

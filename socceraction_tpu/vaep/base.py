@@ -31,7 +31,13 @@ from sklearn.metrics import brier_score_loss, roc_auc_score
 from .. import spadl as _spadl_pkg
 from ..obs import counter, gauge, histogram, span
 from ..config import DEFAULT_BACKEND, NB_PREV_ACTIONS
-from ..core.batch import ActionBatch, pack_actions, unpack_values
+from ..core.batch import (
+    ActionBatch,
+    bucket_games,
+    pack_actions,
+    pad_batch_games,
+    unpack_values,
+)
 from ..ml.learners import LEARNERS
 from ..ml.mlp import MLPClassifier
 from ..ops import features as _fops
@@ -44,6 +50,24 @@ from . import labels as lab
 
 class NotFittedError(ValueError):
     """Raised when ``rate``/``score`` is called before ``fit``."""
+
+
+#: Version stamped into ``save_model`` artifacts. Bump on any layout
+#: change; loaders reject artifacts from a NEWER version with a clear
+#: error instead of failing deep inside key access (the model registry,
+#: :mod:`socceraction_tpu.serve.registry`, depends on this contract).
+CHECKPOINT_FORMAT_VERSION = 1
+
+
+def _check_format_version(meta: Dict[str, Any], path: str) -> None:
+    """Reject checkpoints written by a newer library than this one."""
+    version = int(meta.get('format_version', 1))
+    if version > CHECKPOINT_FORMAT_VERSION:
+        raise ValueError(
+            f'checkpoint at {path!r} has format_version={version}, newer '
+            f'than this library understands (<= {CHECKPOINT_FORMAT_VERSION}); '
+            'upgrade socceraction_tpu to load it'
+        )
 
 
 xfns_default: List[fs.FeatureTransfomer] = [
@@ -472,8 +496,75 @@ class VAEP:
             and all(isinstance(m, MLPClassifier) for m in self._models.values())
         )
 
-    def rate_batch(self, batch: ActionBatch) -> jax.Array:
+    @staticmethod
+    def _bucketable(batch: ActionBatch) -> bool:
+        """True when the game axis may be padded: host arrays or a batch
+        resident on a single device. Sharded batches (``sharded_rate``)
+        are left alone — padding would gather them onto one device."""
+        sharding = getattr(batch.type_id, 'sharding', None)
+        if sharding is None:  # host numpy staging batch
+            return True
+        try:
+            return len(batch.type_id.devices()) <= 1
+        except Exception:
+            return False
+
+    def _apply_dense_overrides(
+        self, batch: ActionBatch, feats: jax.Array, dense_overrides
+    ) -> jax.Array:
+        """Substitute precomputed blocks into a materialized feature tensor.
+
+        The materialized twin of the fused path's ``dense_overrides``:
+        the override block replaces the kernel's columns at the layout
+        offset, so both rating paths are the same function of the same
+        overrides.
+        """
+        from ..ops.fused import train_layout
+
+        layout = train_layout(
+            batch, names=self._kernel_names(), k=self.nb_prev_actions,
+            registry_name=self._fused_registry,
+        )
+        for name, block in dense_overrides.items():
+            spec = next((sp for sp in layout.spans if sp[0] == name), None)
+            if spec is None or spec[1] != 'dense':
+                raise ValueError(
+                    f'{name!r} is not a dense feature block of this model '
+                    '(one-hot blocks cannot be overridden)'
+                )
+            _, _, off, width = spec
+            if block.shape[-1] != width:
+                raise ValueError(
+                    f'override {name!r} has width {block.shape[-1]}, '
+                    f'kernel emits {width}'
+                )
+            feats = feats.at[..., off : off + width].set(
+                jnp.asarray(block, feats.dtype)
+            )
+        return feats
+
+    def rate_batch(
+        self,
+        batch: ActionBatch,
+        *,
+        dense_overrides: Optional[Dict[str, Any]] = None,
+        bucket: bool = True,
+    ) -> jax.Array:
         """Device rating of a packed multi-game batch -> ``(G, A, 3)``.
+
+        ``bucket=True`` (default) pads the game axis up to its power-of-two
+        shape bucket (:func:`~socceraction_tpu.core.batch.bucket_games`)
+        before dispatch and slices the result back, so callers passing
+        arbitrary-length batches compile at most one program per bucket
+        instead of one per unique row count. Padding games carry all-False
+        masks and never touch valid games' values (every kernel is
+        game-local); sharded batches are never padded.
+
+        ``dense_overrides`` substitutes precomputed ``(G, A, width)``
+        blocks for named dense feature kernels on BOTH rating paths —
+        the serving layer's match sessions inject the whole-match
+        ``goalscore`` block this way, the one feature a suffix window
+        cannot compute locally.
 
         With 'mlp' models the entire pipeline (features, probabilities,
         formula) runs on device without host transfers — and, when the
@@ -506,8 +597,21 @@ class VAEP:
         fused = self._can_fuse() and path in FUSED_PATH_HIDDEN_DTYPES
         selected = path if fused else 'materialized'
         labels = {'path': selected, 'platform': jax.default_backend()}
+        n_games = batch.n_games
         t0 = time.perf_counter()
-        with span('vaep/rate_batch', games=batch.n_games, **labels):
+        with span('vaep/rate_batch', games=n_games, **labels):
+            target = bucket_games(n_games) if bucket else n_games
+            if target != n_games and self._bucketable(batch):
+                batch = pad_batch_games(batch, target)
+                if dense_overrides:
+                    dense_overrides = {
+                        name: jnp.pad(
+                            jnp.asarray(block),
+                            [(0, target - n_games)]
+                            + [(0, 0)] * (jnp.ndim(block) - 1),
+                        )
+                        for name, block in dense_overrides.items()
+                    }
             if fused:
                 from ..ops.fused import fused_pair_probs
 
@@ -521,17 +625,24 @@ class VAEP:
                     names=self._kernel_names(),
                     k=self.nb_prev_actions,
                     registry_name=self._fused_registry,
+                    dense_overrides=dense_overrides,
                     hidden_dtype=hidden_dtype_for(path),
                 )
                 probs = dict(zip(cols, pair))
             else:
                 feats = self.compute_features_batch(batch)
+                if dense_overrides:
+                    feats = self._apply_dense_overrides(
+                        batch, feats, dense_overrides
+                    )
                 probs = self._estimate_probabilities_batch(feats)
             values = self._formula_kernel(
                 batch,
                 probs[self._label_columns[0]],
                 probs[self._label_columns[1]],
             )
+            if values.shape[0] != n_games:
+                values = values[:n_games]
         # n_actions is a pack-time input, ready independently of the
         # rating computation — fetching it does NOT sync the dispatch
         dispatch_s = time.perf_counter() - t0
@@ -600,7 +711,7 @@ class VAEP:
                 with open(os.path.join(path, 'models', f'{col}.pkl'), 'wb') as f:
                     pickle.dump(model, f)
         meta = {
-            'format_version': 1,
+            'format_version': CHECKPOINT_FORMAT_VERSION,
             'class': type(self).__name__,
             'nb_prev_actions': self.nb_prev_actions,
             'backend': self.backend,
@@ -611,13 +722,15 @@ class VAEP:
             json.dump(meta, f, indent=2)
 
     @classmethod
-    def _load_into(cls, path: str) -> 'VAEP':
+    def _load_into(cls, path: str, meta: Optional[Dict[str, Any]] = None) -> 'VAEP':
         import json
         import os
         import pickle
 
-        with open(os.path.join(path, 'meta.json')) as f:
-            meta = json.load(f)
+        if meta is None:  # direct _load_into callers; load_model passes it
+            with open(os.path.join(path, 'meta.json')) as f:
+                meta = json.load(f)
+            _check_format_version(meta, path)
         model = cls(
             xfns=[getattr(cls._fs, name) for name in meta['xfns']],
             nb_prev_actions=meta['nb_prev_actions'],
@@ -645,13 +758,14 @@ def load_model(path: str) -> VAEP:
 
     with open(os.path.join(path, 'meta.json')) as f:
         meta = json.load(f)
+    _check_format_version(meta, path)
     if meta['class'] == 'AtomicVAEP':
         from ..atomic.vaep.base import AtomicVAEP
 
-        return AtomicVAEP._load_into(path)
+        return AtomicVAEP._load_into(path, meta)
     if meta['class'] != 'VAEP':
         raise ValueError(
             f'checkpoint was saved by unknown model class {meta["class"]!r}; '
             'load it with <YourClass>._load_into(path)'
         )
-    return VAEP._load_into(path)
+    return VAEP._load_into(path, meta)

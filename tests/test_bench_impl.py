@@ -165,6 +165,7 @@ def test_impl_forced_extras_contract():
             'SOCCERACTION_TPU_BENCH_STEP_GAMES': '4',
             'SOCCERACTION_TPU_BENCH_COLD_GAMES': '8',
             'SOCCERACTION_TPU_BENCH_COLD_CHUNK': '4',
+            'SOCCERACTION_TPU_BENCH_SERVE_SECONDS': '1',
         }
     )
     extras = d.get('extra_configs')
@@ -176,6 +177,7 @@ def test_impl_forced_extras_contract():
         'vaep_mlp_train_step',
         'vaep_mlp_train_epoch',
         'cold_path_stream',
+        'serve_throughput',
     }
     # both training configs report BOTH paths (the fused-vs-materialized
     # speedup is the artifact's acceptance measurement, never a max())
@@ -218,3 +220,46 @@ def test_impl_forced_extras_contract():
         assert {s['labels']['path'] for s in series} == {
             'fused', 'materialized',
         }, metric
+    _check_serve_throughput(extras['serve_throughput'])
+    # the serve headline gauge survives into the artifact snapshot too
+    assert 'bench/serve_requests_per_sec' in d['metric_snapshot']
+
+
+def _check_serve_throughput(serve):
+    """Shared contract for the serve_throughput section (extras + smoke)."""
+    assert serve['bucket_ladder'] == [1, 2, 4, 8, 16]
+    assert serve['peak_requests_per_sec'] > 0
+    # the acceptance gate: steady offered load compiles nothing past the
+    # warmed bucket ladder — no per-request retraces
+    assert serve['compiled_shapes_plateaued'] is True
+    for level in serve['levels']:
+        assert level['requests'] > 0
+        assert level['compiled_shapes_after'] == level['compiled_shapes_before']
+        assert level['rejected'] == 0  # closed loop never outruns the queue
+        # latency percentiles come from the typed snapshot's histogram
+        assert level['request_p50_ms'] > 0
+        assert level['request_p99_ms'] >= level['request_p50_ms']
+        assert 0 < level['batch_fill_ratio_mean'] <= 1.0
+
+
+def test_serve_smoke_end_to_end():
+    """``bench.py --serve-smoke`` (the make bench-smoke wiring) runs and
+    reports the serve_throughput contract on CPU."""
+    sys.path.insert(0, _ROOT)
+    from bench import _cpu_env
+
+    env = _cpu_env()
+    env['SOCCERACTION_TPU_BENCH_SERVE_SECONDS'] = '1'
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, 'bench.py'), '--serve-smoke'],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith('{')]
+    d = json.loads(lines[-1])
+    assert d['metric'] == 'serve_requests_per_sec'
+    assert d['unit'] == 'requests/sec'
+    assert d['smoke'] is True and d['platform'] == 'cpu'
+    assert d['value'] == d['peak_requests_per_sec'] > 0
+    assert [lv['clients'] for lv in d['levels']] == [1, 4]
+    _check_serve_throughput(d)

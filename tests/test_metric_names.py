@@ -56,6 +56,13 @@ def test_train_area_is_registered():
     assert 'train' in tool.KNOWN_AREAS
 
 
+def test_serve_area_is_registered():
+    """The online serving subsystem's metrics (``serve/*``) are governed
+    by the lint gate from day one (ISSUE 4 satellite)."""
+    tool = _tool()
+    assert 'serve' in tool.KNOWN_AREAS
+
+
 def test_convention_violation_detected(tmp_path):
     tool = _tool()
     bad = tmp_path / 'bad.py'

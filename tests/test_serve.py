@@ -1,0 +1,746 @@
+"""Tests for the online serving subsystem (socceraction_tpu.serve).
+
+Covers the ISSUE-4 contract: deadline-flush timing, bucket-ladder
+trace-count plateau, padded-row masking parity (coalesced ==
+per-request ``rate_batch``, bitwise), session incremental-vs-full-replay
+parity, overload rejection, concurrent hot-swap consistency, the model
+registry's versioning + format_version gate, and the pad-to-bucket
+helpers shared with ``rate_batch``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.batch import (
+    bucket_games,
+    bucket_ladder,
+    pack_actions,
+    pad_batch_games,
+    unpack_values,
+)
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.serve import (
+    MicroBatcher,
+    ModelRegistry,
+    Overloaded,
+    RatingService,
+)
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 512
+
+
+def _fit_model(hidden=(32, 16), seed_games=(0, 1)):
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=300)
+        for i in seed_games
+    ]
+    model = VAEP()
+    X, y = [], []
+    for i, f in zip(seed_games, frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True),
+        pd.concat(y, ignore_index=True),
+        learner='mlp',
+        tree_params={'hidden': hidden, 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+@pytest.fixture(scope='module')
+def model_b():
+    """Same feature layout, different head weights (hot-swap partner)."""
+    return _fit_model(hidden=(16,), seed_games=(2, 3))
+
+
+def _request_frames(n, rng_seed=0, lo=40, hi=400):
+    rng = np.random.default_rng(rng_seed)
+    return [
+        synthetic_actions_frame(
+            game_id=50 + i, seed=50 + i, n_actions=int(rng.integers(lo, hi))
+        )
+        for i in range(n)
+    ]
+
+
+def _reference(model, frame, max_actions=MAX_ACTIONS):
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=max_actions)
+    return unpack_values(model.rate_batch(batch, bucket=False), batch)
+
+
+# -------------------------------------------------------------- batcher ----
+
+
+def test_batcher_flushes_on_full():
+    seen = []
+
+    def runner(payloads, bucket):
+        seen.append((list(payloads), bucket))
+        return [p * 10 for p in payloads]
+
+    with MicroBatcher(runner, max_batch_size=4, max_wait_ms=10_000) as b:
+        futs = [b.submit(i) for i in range(4)]
+        assert [f.result(timeout=10) for f in futs] == [0, 10, 20, 30]
+    (payloads, bucket), = seen
+    assert payloads == [0, 1, 2, 3] and bucket == 4
+
+
+def test_batcher_deadline_flush_timing():
+    """A lone request flushes at ~max_wait_ms, not immediately, not never."""
+    done = []
+
+    def runner(payloads, bucket):
+        done.append(time.perf_counter())
+        return payloads
+
+    with MicroBatcher(runner, max_batch_size=64, max_wait_ms=150.0) as b:
+        t0 = time.perf_counter()
+        fut = b.submit('x')
+        assert not fut.done()  # deadline, not instant, dispatch
+        assert fut.result(timeout=10) == 'x'
+    waited = done[0] - t0
+    # lower bound is the contract (never early); upper bound is generous
+    # against CI scheduling noise
+    assert 0.14 <= waited < 5.0, waited
+    snap = REGISTRY.snapshot()
+    assert snap.value('serve/flushes', reason='deadline') >= 1
+
+
+def test_batcher_bucket_ladder_and_fill():
+    buckets = []
+
+    def runner(payloads, bucket):
+        buckets.append((len(payloads), bucket))
+        return payloads
+
+    with MicroBatcher(runner, max_batch_size=8, max_wait_ms=30.0) as b:
+        assert b.ladder == (1, 2, 4, 8)
+        futs = [b.submit(i) for i in range(3)]
+        for f in futs:
+            f.result(timeout=10)
+    n, bucket = buckets[0]
+    assert n == 3 and bucket == 4  # 3 requests pad to the 4-bucket
+
+
+def test_batcher_overload_rejects():
+    release = threading.Event()
+
+    def runner(payloads, bucket):
+        release.wait(timeout=30)
+        return payloads
+
+    b = MicroBatcher(runner, max_batch_size=1, max_wait_ms=0.0, max_queue=2)
+    try:
+        before = REGISTRY.snapshot().value('serve/rejected_total')
+        first = b.submit('a')  # taken by the flusher, blocks in runner
+        time.sleep(0.05)
+        held = [b.submit(x) for x in 'bc']  # fills the queue
+        with pytest.raises(Overloaded):
+            b.submit('d')
+        after = REGISTRY.snapshot().value('serve/rejected_total')
+        assert after == before + 1
+        release.set()
+        assert first.result(timeout=10) == 'a'
+        assert [f.result(timeout=10) for f in held] == ['b', 'c']
+    finally:
+        release.set()
+        b.close()
+
+
+def test_batcher_runner_error_fails_futures():
+    def runner(payloads, bucket):
+        raise RuntimeError('boom')
+
+    with MicroBatcher(runner, max_batch_size=2, max_wait_ms=1.0) as b:
+        futs = [b.submit(i) for i in range(2)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match='boom'):
+                f.result(timeout=10)
+
+
+def test_batcher_survives_cancelled_futures():
+    """A caller-side cancel() must not kill the flusher thread."""
+    def runner(payloads, bucket):
+        return payloads
+
+    with MicroBatcher(runner, max_batch_size=8, max_wait_ms=60_000) as b:
+        doomed = b.submit('x')
+        assert doomed.cancel()  # cancelled while queued
+        b.close()  # close-flush sees the cancelled future and drops it
+    # a fresh batcher: a cancelled future mixed into a live full flush
+    with MicroBatcher(runner, max_batch_size=3, max_wait_ms=60_000) as b:
+        dead = b.submit('a')
+        assert dead.cancel()
+        live1 = b.submit('b')
+        live2 = b.submit('c')  # 3 queued -> immediate 'full' flush
+        assert live1.result(timeout=10) == 'b'
+        assert live2.result(timeout=10) == 'c'
+        # the flusher survived the cancelled future: still serving
+        d = b.submit('d')
+    assert d.result(timeout=10) == 'd'  # drained by close
+
+
+def test_session_tick_failure_does_not_corrupt_carry(model):
+    """A rejected/failed tick commits nothing; the retry stays exact."""
+    frame = synthetic_actions_frame(game_id=10, seed=10, n_actions=300)
+    import socceraction_tpu.spadl.config as c
+
+    shots = frame['type_id'].isin([c.SHOT, c.SHOT_PENALTY, c.SHOT_FREEKICK])
+    goal_rows = np.flatnonzero(
+        (shots & (frame['result_id'] == c.SUCCESS)).to_numpy()
+    )
+    assert len(goal_rows), 'fixture game must contain a goal'
+    cut = int(goal_rows[0]) + 1  # first failing tick CONTAINS a goal
+
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        sess = svc.open_session('m10', home_team_id=HOME)
+        sess.add_actions(frame.iloc[: cut - 5])
+        orig = svc._submit_window
+        calls = {'n': 0}
+
+        def flaky(*args, **kw):
+            if calls['n'] == 0:
+                calls['n'] += 1
+                raise Overloaded('queue full')
+            return orig(*args, **kw)
+
+        svc._submit_window = flaky
+        with pytest.raises(Overloaded):
+            sess.add_actions(frame.iloc[cut - 5 : cut + 5])  # goal inside
+        # retry the SAME tick: the carry must not have double-counted
+        sess.add_actions(frame.iloc[cut - 5 : cut + 5])
+        sess.add_actions(frame.iloc[cut + 5 :])
+    np.testing.assert_array_equal(
+        sess.ratings().to_numpy(), _reference(model, frame)
+    )
+
+
+def test_batcher_close_drains():
+    def runner(payloads, bucket):
+        return payloads
+
+    b = MicroBatcher(runner, max_batch_size=64, max_wait_ms=60_000)
+    futs = [b.submit(i) for i in range(3)]
+    b.close()  # deadline far away: close itself must flush the queue
+    assert [f.result(timeout=10) for f in futs] == [0, 1, 2]
+    with pytest.raises(RuntimeError):
+        b.submit('late')
+
+
+# ------------------------------------------------- coalescing parity -------
+
+
+def test_coalesced_batch_matches_per_request_rate_batch(model):
+    """Multi-request flushes return bitwise the per-request ratings.
+
+    Requests of different lengths coalesce into one padded bucket batch;
+    padding games and padded rows must not perturb valid rows at all.
+    """
+    frames = _request_frames(5)
+
+    def flush_total(snap):
+        inst = snap.get('serve/flushes')
+        return sum(s.total for s in inst.series) if inst else 0.0
+
+    flushes_before = flush_total(REGISTRY.snapshot())
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=8, max_wait_ms=50.0
+    ) as svc:
+        futs = [svc.rate(f, home_team_id=HOME) for f in frames]
+        outs = [f.result(timeout=60) for f in futs]
+    snap = REGISTRY.snapshot()
+    for frame, out in zip(frames, outs):
+        assert list(out.columns) == [
+            'offensive_value', 'defensive_value', 'vaep_value',
+        ]
+        assert out.index.equals(frame.index)
+        ref = _reference(model, frame)
+        np.testing.assert_array_equal(out.to_numpy(), ref)
+    # they actually coalesced: fewer flushes than requests
+    assert flush_total(snap) - flushes_before < len(frames)
+    lat = snap.series('serve/request_seconds', kind='rate')
+    assert lat is not None and lat.count >= len(frames)
+    assert lat.quantiles is not None and 'p99' in lat.quantiles
+
+
+def test_trace_count_plateaus_under_randomized_sizes(model):
+    """After warmup, randomized request sizes compile NOTHING new.
+
+    The compiled-shape budget is the bucket ladder; the pin is both on
+    the service's own shape accounting and on the jitted pair-path's
+    actual compilation-cache size.
+    """
+    from socceraction_tpu.ops.fused import _pair_probs
+
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        assert svc.compiled_shapes == len(svc.ladder)
+        cache_after_warmup = _pair_probs._cache_size()
+        rng = np.random.default_rng(7)
+        frames = _request_frames(12, rng_seed=3)
+        for group in range(4):
+            futs = [
+                svc.rate(frames[int(i)], home_team_id=HOME)
+                for i in rng.integers(0, len(frames), size=3)
+            ]
+            for f in futs:
+                f.result(timeout=60)
+        assert svc.compiled_shapes == len(svc.ladder)
+        assert _pair_probs._cache_size() == cache_after_warmup
+    snap = REGISTRY.snapshot()
+    traces = snap.get('serve/shape_traces')
+    assert traces is not None
+    # per-bucket trace counters: bucket labels are ladder rungs (powers
+    # of two — the registry is process-global, so other services'
+    # ladders may appear too)
+    for s in traces.series:
+        b = int(s.labels['bucket'])
+        assert b == bucket_games(b)
+
+
+def test_service_overload_rejection(model):
+    release = threading.Event()
+    orig = RatingService._device_rate
+
+    def slow(self, host_batch, gs, m, bucket):
+        release.wait(timeout=30)
+        return orig(self, host_batch, gs, m, bucket)
+
+    frames = _request_frames(3, lo=40, hi=80)
+    svc = RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=1, max_wait_ms=0.0,
+        max_queue=2,
+    )
+    try:
+        svc._device_rate = slow.__get__(svc)
+        futs = [svc.rate(frames[i % 3], home_team_id=HOME) for i in range(3)]
+        with pytest.raises(Overloaded):
+            svc.rate(frames[0], home_team_id=HOME)
+        release.set()
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        release.set()
+        svc.close()
+
+
+# ------------------------------------------------------------- sessions ----
+
+
+def test_session_incremental_matches_full_replay(model):
+    """Random-chunk streaming equals the one-shot rate_batch bit-for-bit
+    (acceptance gate: <= 1e-5; measured 0)."""
+    frame = synthetic_actions_frame(game_id=9, seed=9, n_actions=420)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        sess = svc.open_session('m9', home_team_id=HOME)
+        rng = np.random.default_rng(1)
+        i = 0
+        while i < len(frame):
+            m = int(rng.integers(1, 48))
+            chunk = frame.iloc[i : i + m]
+            out = sess.add_actions(chunk)
+            assert out.index.equals(chunk.index)
+            i += m
+        inc = sess.ratings()
+    ref = _reference(model, frame)
+    assert np.abs(inc.to_numpy() - ref).max() <= 1e-5
+    np.testing.assert_array_equal(inc.to_numpy(), ref)
+    # the game had goals, so the whole-match goalscore carry was live
+    import socceraction_tpu.spadl.config as c
+
+    shots = frame['type_id'].isin([c.SHOT, c.SHOT_PENALTY, c.SHOT_FREEKICK])
+    assert (shots & (frame['result_id'] == c.SUCCESS)).sum() > 0
+
+
+def test_session_single_action_ticks(model):
+    """The live-match extreme: one action per tick, still exact."""
+    frame = synthetic_actions_frame(game_id=11, seed=11, n_actions=60)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        sess = svc.open_session('m11', home_team_id=HOME)
+        for i in range(len(frame)):
+            sess.add_actions(frame.iloc[i : i + 1])
+        assert sess.n_actions == len(frame)
+        inc = sess.ratings()
+    np.testing.assert_array_equal(inc.to_numpy(), _reference(model, frame))
+
+
+def test_oversized_tick_is_atomic(model):
+    """A tick larger than the service window splits into sub-windows but
+    commits once: a failure mid-split leaves the session untouched and
+    the retried tick stays exact."""
+    frame = synthetic_actions_frame(game_id=10, seed=10, n_actions=300)
+    with RatingService(
+        model, max_actions=128, max_batch_size=8, max_wait_ms=5.0
+    ) as svc:
+        sess = svc.open_session('m10big', home_team_id=HOME)
+        orig = svc._submit_window
+        calls = {'n': 0}
+
+        def fail_second(*args, **kw):
+            calls['n'] += 1
+            if calls['n'] == 2:
+                raise Overloaded('queue full')
+            return orig(*args, **kw)
+
+        svc._submit_window = fail_second
+        with pytest.raises(Overloaded):
+            sess.add_actions(frame)  # 300 rows -> 3 sub-windows, #2 fails
+        assert sess.n_actions == 0 and sess.ratings().empty
+        svc._submit_window = orig
+        out = sess.add_actions(frame)  # clean retry of the whole tick
+        assert sess.n_actions == len(frame)
+    # reference packs the whole game at once (needs a bigger action axis
+    # than the service window; values are trailing-pad invariant)
+    np.testing.assert_array_equal(out.to_numpy(), _reference(model, frame))
+
+
+def test_service_without_goalscore_kernel():
+    """A model whose xfns exclude goalscore serves without the host
+    goalscore prefix work, and sessions stay exact (all kernels local)."""
+    from socceraction_tpu.vaep import features as fs
+
+    xfns = [fs.actiontype_onehot, fs.bodypart_onehot, fs.startlocation, fs.movement]
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=200)
+        for i in (0, 1)
+    ]
+    m = VAEP(xfns=xfns)
+    X, y = [], []
+    for i, f in enumerate(frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(m.compute_features(game, f))
+        y.append(m.compute_labels(game, f))
+    np.random.seed(0)
+    m.fit(
+        pd.concat(X, ignore_index=True), pd.concat(y, ignore_index=True),
+        learner='mlp', tree_params={'hidden': (16,), 'max_epochs': 2},
+    )
+    with RatingService(
+        m, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        assert svc._gs_enabled is False
+        out = svc.rate_sync(frames[0], home_team_id=HOME, timeout=60)
+        np.testing.assert_array_equal(
+            out.to_numpy(), _reference(m, frames[0])
+        )
+        sess = svc.open_session('nogs', home_team_id=HOME)
+        for i in range(0, len(frames[1]), 40):
+            sess.add_actions(frames[1].iloc[i : i + 40])
+        np.testing.assert_array_equal(
+            sess.ratings().to_numpy(), _reference(m, frames[1])
+        )
+
+
+def test_concurrent_sessions_coalesce(model):
+    """Several live matches tick concurrently through shared flushes."""
+    frames = {
+        mid: synthetic_actions_frame(game_id=mid, seed=mid, n_actions=120)
+        for mid in (21, 22, 23)
+    }
+    results = {}
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=8, max_wait_ms=20.0
+    ) as svc:
+        def play(mid):
+            sess = svc.open_session(mid, home_team_id=HOME)
+            f = frames[mid]
+            for i in range(0, len(f), 30):
+                sess.add_actions(f.iloc[i : i + 30])
+            results[mid] = sess.ratings()
+
+        threads = [
+            threading.Thread(target=play, args=(mid,)) for mid in frames
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for mid, f in frames.items():
+        np.testing.assert_array_equal(
+            results[mid].to_numpy(), _reference(model, f)
+        )
+
+
+# ------------------------------------------------------ registry + swap ----
+
+
+@pytest.fixture()
+def registry(tmp_path, model, model_b):
+    reg = ModelRegistry(str(tmp_path / 'models'))
+    reg.publish('vaep', '1', model)
+    reg.publish('vaep', '2', model_b)
+    return reg
+
+
+def test_registry_versions_and_load(registry):
+    assert registry.names() == ['vaep']
+    assert registry.versions('vaep') == ['1', '2']
+    m1 = registry.load('vaep', '1')
+    latest = registry.load('vaep')  # default: newest
+    assert m1 is registry.load('vaep', '1')  # cached (versions immutable)
+    assert latest is registry.load('vaep', '2')
+    # warm residency: every MLP head's params are device arrays and the
+    # standardization stats have cached device copies
+    import jax
+
+    for clf in m1._models.values():
+        for leaf in jax.tree.leaves(clf.params):
+            assert isinstance(leaf, jax.Array)
+        assert clf._mean_dev is not None and clf._std_dev is not None
+
+
+def test_registry_rejects_duplicate_publish(registry, model):
+    with pytest.raises(ValueError, match='immutable'):
+        registry.publish('vaep', '1', model)
+
+
+def test_registry_numeric_version_order(tmp_path, model):
+    reg = ModelRegistry(str(tmp_path / 'm'))
+    for v in ('2', '10', '9'):
+        reg.publish('vaep', v, model)
+    assert reg.versions('vaep') == ['2', '9', '10']
+
+
+def test_registry_activate_and_service_swap(registry, model, model_b):
+    registry.activate('vaep', '1')
+    frame = synthetic_actions_frame(game_id=31, seed=31, n_actions=150)
+    with RatingService(
+        registry=registry, max_actions=MAX_ACTIONS, max_batch_size=4,
+        max_wait_ms=1.0,
+    ) as svc:
+        out1 = svc.rate_sync(frame, home_team_id=HOME, timeout=60)
+        assert svc.swap_model('vaep', '2') == ('vaep', '2')
+        out2 = svc.rate_sync(frame, home_team_id=HOME, timeout=60)
+    np.testing.assert_array_equal(out1.to_numpy(), _reference(model, frame))
+    np.testing.assert_array_equal(out2.to_numpy(), _reference(model_b, frame))
+    snap = REGISTRY.snapshot()
+    assert snap.value('serve/model_swaps') >= 1
+
+
+def test_concurrent_hot_swap_consistency(registry, model, model_b):
+    """No request is ever rated by a half-swapped model: every result is
+    EXACTLY one version's output, under rapid concurrent swapping."""
+    registry.activate('vaep', '1')
+    frame = synthetic_actions_frame(game_id=33, seed=33, n_actions=100)
+    ref1 = _reference(model, frame)
+    ref2 = _reference(model_b, frame)
+    assert not np.array_equal(ref1, ref2)  # the two versions do differ
+
+    stop = threading.Event()
+    with RatingService(
+        registry=registry, max_actions=MAX_ACTIONS, max_batch_size=4,
+        max_wait_ms=1.0,
+    ) as svc:
+        def swapper():
+            v = 2
+            while not stop.is_set():
+                svc.swap_model('vaep', str(v))
+                v = 3 - v
+        t = threading.Thread(target=swapper)
+        t.start()
+        try:
+            for _ in range(25):
+                out = svc.rate_sync(frame, home_team_id=HOME, timeout=60)
+                got = out.to_numpy()
+                assert np.array_equal(got, ref1) or np.array_equal(got, ref2)
+        finally:
+            stop.set()
+            t.join()
+
+
+def test_swap_rejects_layout_change(registry, model):
+    registry.activate('vaep', '1')
+    other = VAEP(nb_prev_actions=2)
+    other._models = dict(model._models)  # fitted, but k differs
+    registry._loaded[('vaep', '99')] = other
+    import os
+
+    os.makedirs(registry._dir('vaep', '99'))
+    with open(
+        os.path.join(registry._dir('vaep', '99'), 'meta.json'), 'w'
+    ) as f:
+        f.write('{}')
+    with RatingService(
+        registry=registry, max_actions=MAX_ACTIONS, max_batch_size=2,
+        max_wait_ms=1.0,
+    ) as svc:
+        with pytest.raises(ValueError, match='feature layout'):
+            svc.swap_model('vaep', '99')
+
+
+# ------------------------------------------------------- format version ----
+
+
+def test_mlp_checkpoint_format_version_stamp(tmp_path, model):
+    from socceraction_tpu.ml.mlp import MLP_FORMAT_VERSION, MLPClassifier
+
+    clf = next(iter(model._models.values()))
+    path = str(tmp_path / 'head.npz')
+    clf.save(path)
+    with np.load(path) as data:
+        assert int(data['format_version']) == MLP_FORMAT_VERSION
+    MLPClassifier.load(path)  # current version round-trips
+
+    # forge a FUTURE artifact: the loader must reject it up front
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files}
+    arrays['format_version'] = np.array(MLP_FORMAT_VERSION + 1)
+    future_path = str(tmp_path / 'future.npz')
+    with open(future_path, 'wb') as f:
+        np.savez(f, **arrays)
+    with pytest.raises(ValueError, match='format_version'):
+        MLPClassifier.load(future_path)
+
+
+def test_vaep_checkpoint_format_version_gate(tmp_path, model):
+    import json
+    import os
+
+    from socceraction_tpu.vaep.base import (
+        CHECKPOINT_FORMAT_VERSION,
+        load_model,
+    )
+
+    path = str(tmp_path / 'ckpt')
+    model.save_model(path)
+    meta_path = os.path.join(path, 'meta.json')
+    with open(meta_path) as f:
+        meta = json.load(f)
+    assert meta['format_version'] == CHECKPOINT_FORMAT_VERSION
+    load_model(path)  # current version round-trips
+
+    meta['format_version'] = CHECKPOINT_FORMAT_VERSION + 1
+    with open(meta_path, 'w') as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match='format_version'):
+        load_model(path)
+    # the registry surfaces the same clear error
+    reg_root = tmp_path / 'reg' / 'vaep'
+    reg_root.mkdir(parents=True)
+    os.rename(path, str(reg_root / '1'))
+    reg = ModelRegistry(str(tmp_path / 'reg'))
+    with pytest.raises(ValueError, match='format_version'):
+        reg.load('vaep', '1')
+
+
+# ------------------------------------------------- bucket helpers ----------
+
+
+def test_bucket_games_and_ladder():
+    assert [bucket_games(n) for n in (1, 2, 3, 4, 5, 9, 64, 65)] == [
+        1, 2, 4, 4, 8, 16, 64, 128,
+    ]
+    assert bucket_ladder(8) == (1, 2, 4, 8)
+    assert bucket_ladder(6) == (1, 2, 4, 8)  # top rounds up
+    with pytest.raises(ValueError):
+        bucket_games(0)
+
+
+def test_pad_batch_games_masks_padding():
+    frame = pd.concat(
+        [
+            synthetic_actions_frame(game_id=i, seed=i, n_actions=50)
+            for i in range(3)
+        ],
+        ignore_index=True,
+    )
+    batch, _ = pack_actions(frame, home_team_id=HOME)
+    padded = pad_batch_games(batch, 4)
+    assert padded.n_games == 4
+    assert int(np.asarray(padded.n_actions)[3]) == 0
+    assert not np.asarray(padded.mask)[3].any()
+    assert (np.asarray(padded.row_index)[3] == -1).all()
+    with pytest.raises(ValueError):
+        pad_batch_games(batch, 2)
+
+
+def test_rate_batch_buckets_arbitrary_game_counts(model):
+    """Default bucketing: odd game counts neither retrace nor change values."""
+    from socceraction_tpu.ops.fused import _pair_probs
+
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=60)
+        for i in range(6)
+    ]
+
+    def batch_of(n):
+        return pack_actions(
+            pd.concat(frames[:n], ignore_index=True),
+            home_team_id=HOME, max_actions=128,
+        )[0]
+
+    # warm the 4-bucket, then 3 games must reuse its compiled program
+    ref4 = np.asarray(model.rate_batch(batch_of(4)))
+    cache = _pair_probs._cache_size()
+    b3 = batch_of(3)
+    v3 = np.asarray(model.rate_batch(b3))
+    assert _pair_probs._cache_size() == cache  # no retrace: 3 -> 4 bucket
+    assert v3.shape[0] == 3  # result sliced back to the caller's games
+    np.testing.assert_array_equal(v3, ref4[:3])
+    # bucket=False keeps the exact shape (and compiles it)
+    v3_exact = np.asarray(model.rate_batch(b3, bucket=False))
+    np.testing.assert_array_equal(v3_exact, v3)
+
+
+def test_rate_batch_unpack_roundtrip_with_bucketing(model):
+    """rate() -> unpack on the ORIGINAL batch stays aligned after padding."""
+    frame = synthetic_actions_frame(game_id=1, seed=5, n_actions=70)
+    game = pd.Series({'game_id': 1, 'home_team_id': HOME})
+    rated = model.rate(game, frame)
+    assert rated.index.equals(frame.index)
+    assert list(rated.columns) == [
+        'offensive_value', 'defensive_value', 'vaep_value',
+    ]
+
+
+# ---------------------------------------------------------- validation -----
+
+
+def test_service_requires_fitted_standard_model():
+    with pytest.raises(ValueError, match='fitted'):
+        RatingService(VAEP())
+    with pytest.raises(ValueError, match='exactly one'):
+        RatingService()
+
+
+def test_service_rejects_oversized_and_multigame(model):
+    frame = synthetic_actions_frame(game_id=1, seed=1, n_actions=50)
+    with RatingService(
+        model, max_actions=128, max_batch_size=2, max_wait_ms=1.0
+    ) as svc:
+        big = synthetic_actions_frame(game_id=2, seed=2, n_actions=200)
+        with pytest.raises(ValueError, match='exceed'):
+            svc.rate(big, home_team_id=HOME)
+        multi = pd.concat(
+            [frame, synthetic_actions_frame(game_id=3, seed=3, n_actions=40)],
+            ignore_index=True,
+        )
+        with pytest.raises(ValueError, match='one match'):
+            svc.rate(multi, home_team_id=HOME)
+        with pytest.raises(ValueError, match='empty'):
+            svc.rate(frame.iloc[:0], home_team_id=HOME)

@@ -49,6 +49,7 @@ NAME_TAKING_CALLS = {
 KNOWN_AREAS = {
     'bench',  # bench.py headline gauges
     'pipeline',  # store/feed/cache stage timings
+    'serve',  # online rating service (batcher/session/registry/service)
     'train',  # MLP fit loop + bench training configs
     'vaep',  # rate_batch instrumentation
     'walkthrough',  # narrative-doc demo spans
