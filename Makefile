@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke obs-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -18,6 +18,13 @@ compile:
 lint:
 	$(PY) tools/lint.py
 	$(PY) tools/check_metric_names.py
+	$(PY) tools/obsctl.py snapshot >/dev/null
+
+# the operator CLI, driven end to end in a jax-free process: a live
+# registry snapshot plus the Prometheus exposition must both exit 0
+obs-smoke:
+	$(PY) tools/obsctl.py snapshot
+	$(PY) tools/obsctl.py prom
 
 types:
 	@$(PY) -c "import mypy" 2>/dev/null \

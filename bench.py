@@ -116,14 +116,16 @@ _PEAKS = {
 
 
 def _cost_analysis(jitted, args):
-    """XLA's own (flops, bytes accessed) for a compiled fn, or Nones."""
-    try:
-        cost = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(cost, list):  # older jax returns one dict per device
-            cost = cost[0]
-        return float(cost.get('flops', 0.0)), float(cost.get('bytes accessed', 0.0))
-    except Exception:
-        return None, None
+    """XLA's own (flops, bytes accessed) for a compiled fn, or Nones.
+
+    Promoted to ``obs.xla.cost_analysis`` (the compile observatory) so
+    the bench roofline and the runtime ``xla/cost_*`` gauges report
+    identical numbers; this wrapper only keeps the import lazy — the
+    parent process must stay importable without the package.
+    """
+    from socceraction_tpu.obs.xla import cost_analysis
+
+    return cost_analysis(jitted, args)
 
 
 def _roofline(device_kind, dt, flops, bytes_accessed):
@@ -188,8 +190,15 @@ def bench_impl() -> dict:
     batch = synthetic_batch(n_games=n_games, n_actions=1664, seed=1)
     total_actions = int(batch.total_actions)
 
-    fused_jit = jax.jit(fused_forward)
-    mat_jit = jax.jit(materialized_forward)
+    # instrumented jits: the headline forwards report into the compile
+    # observatory like every runtime hot path (cost=False — the roofline
+    # below runs the one shared cost_analysis explicitly)
+    from socceraction_tpu.obs.xla import instrument_jit
+
+    fused_jit = instrument_jit(fused_forward, 'bench_forward_fused', cost=False)
+    mat_jit = instrument_jit(
+        materialized_forward, 'bench_forward_materialized', cost=False
+    )
     dt_fused, fused_reliable = _measure(fused_jit, (params, batch))
     dt_mat, mat_reliable = _measure(mat_jit, (params, batch))
 
@@ -207,6 +216,19 @@ def bench_impl() -> dict:
     flagship = preferred_rating_path(platform, respect_env=False)
     rates = {'fused': fused_aps, 'materialized': mat_aps}
     flagship_aps = rates[flagship]
+    # the cold-path extras reset the registry between streamed passes; the
+    # preserve() guard (obs/metrics.py) shields the summary gauges and the
+    # compile observatory's xla/* accounting from those resets, so the
+    # headline rates land at MEASURE time (the pre-PR-5 workaround —
+    # recording them last and re-recording the train/serve gauges by
+    # hand — is retired)
+    from socceraction_tpu.obs import REGISTRY, gauge
+
+    REGISTRY.preserve('bench/', 'xla/')
+    for rate_path, aps in rates.items():
+        gauge('bench/rate_actions_per_sec', unit='actions/s').set(
+            aps, path=rate_path, platform=platform
+        )
     # run provenance for the artifact: device topology + selected config
     # (obs/trace.py run_manifest — the same manifest a RunLog opens with)
     from socceraction_tpu.obs import run_manifest
@@ -275,20 +297,17 @@ def bench_impl() -> dict:
             '(set SOCCERACTION_TPU_BENCH_FORCE_EXTRAS=1 plus the '
             '*_XT_GAMES/*_STEP_GAMES knobs to drive them elsewhere)'
         )
-    # the headline rates land in the registry LAST — after the extras,
-    # whose cold-path passes reset the registry between streamed passes
-    # (recording them earlier would leave zeroed husks in the snapshot on
-    # exactly the runs where the extras execute)
-    from socceraction_tpu.obs import REGISTRY, gauge, snapshot_dict
-
-    for rate_path, aps in rates.items():
-        gauge('bench/rate_actions_per_sec', unit='actions/s').set(
-            aps, path=rate_path, platform=platform
-        )
-    # typed snapshot of everything still live in the registry: the
-    # headline rates plus, when the extras ran, the last streamed pass's
+    # typed snapshot of everything live in the registry: the preserved
+    # summary gauges plus, when the extras ran, the last streamed pass's
     # stage histogram — compact form, no per-bucket rows
+    from socceraction_tpu.obs import snapshot_dict
+    from socceraction_tpu.obs.xla import observatory_snapshot
+
     result['metric_snapshot'] = snapshot_dict(REGISTRY.snapshot(), buckets=False)
+    # the compile observatory rides in every artifact: per-function
+    # compile counts, compile wall, signatures, XLA cost analysis —
+    # the same numbers the runtime's xla/* gauges report
+    result['xla_observatory'] = observatory_snapshot()
     return result
 
 
@@ -315,6 +334,15 @@ def _bench_extra_configs() -> dict:
     )
 
     out = {}
+
+    # the cold-path passes below reset the registry between streams: the
+    # training summary gauges recorded at measure time survive them via
+    # the preserve() guard (the pre-PR-5 re-record workaround is retired)
+    from socceraction_tpu.obs import REGISTRY as _registry
+
+    _registry.preserve(
+        'train/step_actions_per_sec', 'train/epoch_actions_per_sec'
+    )
 
     # scale knobs: chip-scale defaults, env-overridable so the whole extras
     # path can be driven end-to-end on CPU (tests, degraded environments)
@@ -397,29 +425,6 @@ def _bench_extra_configs() -> dict:
 
     serve_s = float(os.environ.get('SOCCERACTION_TPU_BENCH_SERVE_SECONDS', 8))
     out['serve_throughput'] = _bench_serve_throughput(duration_s=serve_s)
-
-    # the cold-path passes reset the registry between streams (same
-    # zeroed-husk hazard the headline gauges dodge by recording last —
-    # bench_impl); re-record the training gauges from the measured rates
-    # so the artifact's metric_snapshot carries them
-    import jax as _jax
-
-    from socceraction_tpu.obs import gauge as _gauge
-
-    _platform = _jax.devices()[0].platform
-    for metric, config in (
-        ('train/step_actions_per_sec', 'vaep_mlp_train_step'),
-        ('train/epoch_actions_per_sec', 'vaep_mlp_train_epoch'),
-    ):
-        for rate_path in ('fused', 'materialized'):
-            _gauge(metric, unit='actions/s').set(
-                out[config][rate_path]['actions_per_sec'],
-                path=rate_path,
-                platform=_platform,
-            )
-    _gauge('bench/serve_requests_per_sec', unit='requests/s').set(
-        out['serve_throughput']['peak_requests_per_sec'], platform=_platform
-    )
     return out
 
 
@@ -605,6 +610,9 @@ def _bench_train_configs(step_games: int, *, n_steps: int = 10, n_epochs: int = 
             'actions_per_sec': round(aps, 1),
             'steps_per_epoch': trainer.steps,
             'final_loss_finite': bool(jax.numpy.isfinite(loss)),
+            # 1 == the epoch compiled once and every timed epoch reused
+            # it (the steady-state zero-retrace gate bench-smoke asserts)
+            'epoch_traces': trainer.n_traces,
         }
 
     epoch_out = {
@@ -686,6 +694,9 @@ def _bench_serve_throughput(
     ]
 
     out: dict = {'duration_s_per_level': duration_s, 'levels': []}
+    # run_level resets the registry per level; the summary gauge and the
+    # compile observatory's accounting must survive those resets
+    REGISTRY.preserve('bench/', 'xla/')
     with RatingService(
         model, max_actions=max_actions, max_batch_size=16, max_wait_ms=2.0,
         max_queue=256,
@@ -693,6 +704,11 @@ def _bench_serve_throughput(
         svc.warmup()
         out['bucket_ladder'] = list(svc.ladder)
         out['max_actions'] = max_actions
+        # steady-state gate: after warmup, the offered-load levels must
+        # compile NOTHING new and trip no retrace storm (xla/* observatory)
+        snap0 = REGISTRY.snapshot()
+        compiles_before = snap0.value('xla/compiles', fn='pair_probs')
+        storms_before = snap0.value('xla/retrace_storm', fn='pair_probs')
 
         def run_level(n_clients: int) -> dict:
             REGISTRY.reset()
@@ -764,12 +780,26 @@ def _bench_serve_throughput(
 
         for c in clients:
             out['levels'].append(run_level(c))
+        snap1 = REGISTRY.snapshot()
+        out['steady_state_compiles'] = int(
+            snap1.value('xla/compiles', fn='pair_probs') - compiles_before
+        )
+        out['retrace_storms'] = int(
+            snap1.value('xla/retrace_storm', fn='pair_probs') - storms_before
+        )
 
     best = max(out['levels'], key=lambda lv: lv['requests_per_sec'])
     out['peak_requests_per_sec'] = best['requests_per_sec']
     out['peak_actions_per_sec'] = best['actions_per_sec']
     out['compiled_shapes_plateaued'] = all(
         lv['compiled_shapes_plateaued'] for lv in out['levels']
+    )
+    import jax as _jax
+
+    from socceraction_tpu.obs import gauge as _gauge
+
+    _gauge('bench/serve_requests_per_sec', unit='requests/s').set(
+        out['peak_requests_per_sec'], platform=_jax.devices()[0].platform
     )
     return out
 
@@ -1178,6 +1208,14 @@ def _train_smoke() -> None:
         sys.exit(rc)
     games = int(os.environ.get('SOCCERACTION_TPU_BENCH_SMOKE_GAMES', 8))
     out = _bench_train_configs(games, n_steps=2, n_epochs=2)
+    # zero-retrace gate: every timed epoch (warmup + 2×2 measured) must
+    # reuse the single compiled epoch program on both data paths
+    for path in ('fused', 'materialized'):
+        traces = out['vaep_mlp_train_epoch'][path]['epoch_traces']
+        assert traces == 1, (
+            f'{path} epoch trainer retraced ({traces} traces for one '
+            'shape) — the one-dispatch-per-epoch contract is broken'
+        )
     print(
         json.dumps(
             {
@@ -1212,6 +1250,14 @@ def _serve_smoke() -> None:
         sys.exit(rc)
     seconds = float(os.environ.get('SOCCERACTION_TPU_BENCH_SERVE_SECONDS', 2))
     out = _bench_serve_throughput(duration_s=seconds, clients=(1, 4))
+    # zero-retrace gate: steady offered load after warmup must compile
+    # nothing new and trip no retrace storm (compile observatory)
+    assert out['compiled_shapes_plateaued'] is True, out['levels']
+    assert out['steady_state_compiles'] == 0, (
+        f'{out["steady_state_compiles"]} pair_probs compiles during '
+        'steady-state serve traffic — the bucket ladder leaked a shape'
+    )
+    assert out['retrace_storms'] == 0, 'retrace storm during steady serve'
     print(
         json.dumps(
             {

@@ -47,6 +47,7 @@ import optax
 from flax import linen as nn
 
 from ..obs import counter, histogram, span
+from ..obs.xla import instrument_jit
 
 __all__ = ['MLPClassifier', 'MLP_FORMAT_VERSION']
 
@@ -127,7 +128,12 @@ class _EpochTrainer:
             )
             return params, opt_state, jnp.mean(losses)
 
-        self._epoch = jax.jit(epoch_fn, donate_argnums=(0, 1))
+        # cost=False: epoch_fn has a trace-time side effect (the
+        # n_traces counter above) — the observatory's AOT cost lowering
+        # would run the trace a second time and inflate it
+        self._epoch = instrument_jit(
+            epoch_fn, 'train_epoch', cost=False, donate_argnums=(0, 1)
+        )
 
     def run(self, params: Any, opt_state: Any, epoch: int, data: Any) -> Any:
         return self._epoch(params, opt_state, np.int32(epoch), data)

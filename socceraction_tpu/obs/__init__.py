@@ -15,6 +15,14 @@ where a caller asks for device sync or named scopes):
   rotation).
 - :mod:`socceraction_tpu.obs.export` — Prometheus-text and JSON
   exposition, plus the legacy ``timer_report`` compatibility shape.
+- :mod:`socceraction_tpu.obs.xla` — the compile observatory:
+  :func:`instrument_jit` wrappers that account per-function compiles,
+  signatures and ``cost_analysis()`` FLOPs/bytes, with a retrace-storm
+  detector.
+- :mod:`socceraction_tpu.obs.memory` — device-memory accounting: HBM
+  in-use/peak gauges, per-span watermarks, a live-buffer census.
+- :mod:`socceraction_tpu.obs.recorder` — the crash-dump flight
+  recorder: a bounded event ring plus :func:`dump_debug_bundle`.
 
 ``socceraction_tpu.utils.profiling`` is a thin façade over this package:
 its ``timed``/``record_value``/``timer_report`` keep working and now
@@ -27,19 +35,30 @@ from typing import Any
 __all__ = [
     'CardinalityError',
     'Counter',
+    'FlightRecorder',
     'Gauge',
     'Histogram',
+    'InstrumentedJit',
+    'MemorySampler',
     'MetricRegistry',
+    'RECORDER',
     'REGISTRY',
     'RegistrySnapshot',
     'RunLog',
     'Span',
+    'cost_analysis',
     'counter',
     'current_runlog',
+    'device_memory_stats',
+    'dump_debug_bundle',
     'gauge',
     'histogram',
+    'instrument_jit',
+    'live_array_census',
+    'observatory_snapshot',
     'prometheus_text',
     'run_manifest',
+    'sample_device_memory',
     'snapshot_dict',
     'span',
     'timed_labels',
@@ -54,6 +73,15 @@ _HOMES = {
     ),
     'trace': ('RunLog', 'Span', 'current_runlog', 'run_manifest', 'span'),
     'export': ('prometheus_text', 'snapshot_dict', 'timer_report_compat'),
+    'xla': (
+        'InstrumentedJit', 'cost_analysis', 'instrument_jit',
+        'observatory_snapshot',
+    ),
+    'memory': (
+        'MemorySampler', 'device_memory_stats', 'live_array_census',
+        'sample_device_memory',
+    ),
+    'recorder': ('FlightRecorder', 'RECORDER', 'dump_debug_bundle'),
 }
 _HOME_BY_SYMBOL = {
     name: module for module, names in _HOMES.items() for name in names

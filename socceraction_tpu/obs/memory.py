@@ -1,0 +1,208 @@
+"""Device-memory accounting: HBM gauges, span watermarks, buffer census.
+
+An HBM creep (a leaked cache, an accidentally resident feature matrix)
+is invisible to wall-clock telemetry until an allocation fails. This
+module makes device memory a first-class observable, built on two jax
+surfaces that exist everywhere but only *report* where the runtime
+supports them:
+
+- ``device.memory_stats()`` — allocator statistics (bytes in use, peak,
+  limit). TPU/GPU backends report; the CPU backend returns ``None``, so
+  every entry point here degrades to a silent no-op off-chip (the same
+  code path runs in tests and on the chip, recording only where there
+  is something to record).
+- ``jax.live_arrays()`` — every live buffer the client tracks, for the
+  on-demand census (:func:`live_array_census`).
+
+Recorded metrics (``mem`` area, all labeled ``device=<index>``):
+
+| metric | kind (unit) | meaning |
+|---|---|---|
+| ``mem/bytes_in_use`` | gauge (bytes) | allocator bytes currently held |
+| ``mem/peak_bytes`` | gauge (bytes) | allocator high-water mark |
+| ``mem/bytes_limit`` | gauge (bytes) | device capacity (when reported) |
+| ``mem/span_peak_bytes`` | histogram (bytes) | per-span high-water (``Span.memory``), labeled ``span`` |
+
+Like the rest of the obs package, this module imports without jax and
+never *initializes* a backend on its own: stats are read only when jax
+is already in ``sys.modules``, so a jax-free data-prep process can
+import (and call) everything here for free.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from socceraction_tpu.obs.metrics import REGISTRY, MetricRegistry
+
+__all__ = [
+    'MemorySampler',
+    'device_memory_stats',
+    'live_array_census',
+    'sample_device_memory',
+]
+
+#: allocator-stat keys worth exporting, mapped to governed metric names
+_STAT_GAUGES = (
+    ('bytes_in_use', 'mem/bytes_in_use'),
+    ('peak_bytes_in_use', 'mem/peak_bytes'),
+    ('bytes_limit', 'mem/bytes_limit'),
+)
+
+
+def device_memory_stats(device: Any = None) -> Optional[Dict[str, float]]:
+    """``device.memory_stats()`` of one device, or None where unsupported.
+
+    ``device`` defaults to the first jax device. Returns None when jax is
+    not loaded, the backend is wedged, or the platform reports no
+    allocator stats (CPU) — callers treat None as "nothing to record".
+    """
+    jax = sys.modules.get('jax')
+    if jax is None:
+        return None
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: float(v) for k, v in stats.items()}
+
+
+def sample_device_memory(
+    registry: Optional[MetricRegistry] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Record every device's allocator stats as ``mem/*`` gauges.
+
+    Returns ``{device_index: stats}`` for the devices that reported;
+    ``{}`` (recording nothing) where memory stats are unsupported — the
+    graceful CPU/jax-free no-op.
+    """
+    jax = sys.modules.get('jax')
+    if jax is None:
+        return {}
+    try:
+        devices = jax.devices()
+    except Exception:
+        return {}
+    reg = registry if registry is not None else REGISTRY
+    out: Dict[str, Dict[str, float]] = {}
+    for i, device in enumerate(devices):
+        stats = device_memory_stats(device)
+        if stats is None:
+            continue
+        out[str(i)] = stats
+        for key, metric in _STAT_GAUGES:
+            if key in stats:
+                reg.gauge(metric, unit='bytes').set(stats[key], device=str(i))
+    return out
+
+
+def live_array_census(top: int = 10) -> Dict[str, Any]:
+    """Aggregate ``jax.live_arrays()`` by ``(dtype, shape)`` on demand.
+
+    The "what is actually resident" answer behind an HBM creep: returns
+    ``{'supported', 'n_arrays', 'total_bytes', 'top': [...]}`` with the
+    ``top`` largest buffer groups (count, per-buffer nbytes, total).
+    ``supported=False`` (and nothing else) when jax is not loaded.
+    """
+    jax = sys.modules.get('jax')
+    if jax is None:
+        return {'supported': False}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return {'supported': False}
+    groups: Dict[Any, List[int]] = {}
+    total = 0
+    for a in arrays:
+        try:
+            nbytes = int(a.nbytes)
+            key = (str(a.dtype), tuple(a.shape))
+        except Exception:  # deleted/donated buffers may refuse attribute reads
+            continue
+        total += nbytes
+        entry = groups.setdefault(key, [0, 0])
+        entry[0] += 1
+        entry[1] += nbytes
+    ranked = sorted(groups.items(), key=lambda kv: kv[1][1], reverse=True)
+    return {
+        'supported': True,
+        'n_arrays': len(arrays),
+        'total_bytes': total,
+        'top': [
+            {
+                'dtype': dtype,
+                'shape': list(shape),
+                'count': count,
+                'total_bytes': nbytes,
+            }
+            for (dtype, shape), (count, nbytes) in ranked[: max(top, 0)]
+        ],
+    }
+
+
+class MemorySampler:
+    """Background thread sampling device memory into the registry.
+
+    Usage::
+
+        with MemorySampler(interval_s=1.0):
+            train(...)
+
+    Each tick runs :func:`sample_device_memory`; where stats are
+    unsupported (CPU) the first tick discovers it and the thread exits
+    immediately, so the sampler is safe to leave in place on every
+    platform. ``sampler.supported`` is None before the first tick, then
+    True/False.
+    """
+
+    def __init__(
+        self,
+        interval_s: float = 1.0,
+        *,
+        registry: Optional[MetricRegistry] = None,
+    ) -> None:
+        self.interval_s = float(interval_s)
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.supported: Optional[bool] = None
+        self.samples = 0
+
+    def start(self) -> 'MemorySampler':
+        """Start the daemon sampling thread (idempotent)."""
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name='mem-sampler', daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            out = sample_device_memory(self._registry)
+            if self.supported is None:
+                self.supported = bool(out)
+            if not out:
+                return  # unsupported platform: nothing will ever change
+            self.samples += 1
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        """Stop and join the sampling thread."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> 'MemorySampler':
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

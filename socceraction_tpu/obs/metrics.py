@@ -463,6 +463,7 @@ class MetricRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._instruments: Dict[str, Instrument] = {}
+        self._preserved: Tuple[str, ...] = ()
 
     def _instrument(
         self,
@@ -543,19 +544,48 @@ class MetricRegistry:
             }
         )
 
+    def preserve(self, *prefixes: str) -> None:
+        """Shield name prefixes from :meth:`reset`'s in-place zeroing.
+
+        The zeroed-husk hazard, fixed once instead of per-call-site: a
+        cold-path pass that resets the registry between streams used to
+        wipe previously recorded summary gauges (the bench headline /
+        train / serve rates), leaving zeroed husks in the final
+        snapshot — each consumer re-recorded them by hand. Declaring
+        ``REGISTRY.preserve('bench/')`` makes every later ``reset()``
+        skip instruments whose name starts with a preserved prefix
+        (exact names work too: a full name is its own prefix).
+        ``reset(clear=True)`` remains the full wipe: it drops the
+        instruments AND the preserve list.
+        """
+        with self._lock:
+            self._preserved = tuple(dict.fromkeys(self._preserved + prefixes))
+
+    @property
+    def preserved(self) -> Tuple[str, ...]:
+        """The reset-shielded name prefixes, in declaration order."""
+        return self._preserved
+
     def reset(self, *, clear: bool = False) -> None:
-        """Zero every series in place; ``clear=True`` also forgets the
-        instruments (new registrations may then change kind/unit).
+        """Zero every non-preserved series in place; ``clear=True`` wipes.
 
         The in-place default keeps series objects held by hot loops
         (e.g. a bound stage series inside a running feed) recording into
-        the registry across benchmark passes.
+        the registry across benchmark passes, and skips instruments
+        shielded by :meth:`preserve`. ``clear=True`` forgets the
+        instruments (new registrations may then change kind/unit) and
+        the preserve list with them.
         """
         with self._lock:
             if clear:
                 self._instruments.clear()
+                self._preserved = ()
                 return
-            instruments = list(self._instruments.values())
+            instruments = [
+                inst
+                for name, inst in self._instruments.items()
+                if not name.startswith(self._preserved)
+            ]
         for inst in instruments:
             inst.reset()
 

@@ -1,0 +1,171 @@
+"""Crash-dump flight recorder: a bounded event ring + debug bundles.
+
+When the serving loop dies — a flusher-thread crash, an overload burst,
+a failed hot-swap — the metrics registry says *that* something went
+wrong, but not *what led up to it*. The flight recorder keeps a small,
+always-on, bounded in-memory ring of recent runtime events (span closes,
+jit compiles, serve queue states, retrace storms) so the last seconds
+before a failure can be written out as one post-mortem artifact:
+
+- :data:`RECORDER` — the process-wide :class:`FlightRecorder`. The span
+  machinery (:mod:`socceraction_tpu.obs.trace`), the compile observatory
+  (:mod:`socceraction_tpu.obs.xla`) and the serve micro-batcher feed it
+  automatically; appends are a lock + deque push, cheap enough to stay
+  on in production.
+- :func:`dump_debug_bundle` — write ring + typed metric snapshot + run
+  manifest (env, device topology) + memory census as one ``.tar.gz``.
+  :class:`~socceraction_tpu.serve.service.RatingService` calls it
+  automatically on flusher-thread death, ``Overloaded`` bursts and
+  hot-swap failure; ``tools/obsctl.py bundle <path>`` reads the result
+  without writing Python.
+
+Bundle layout (all JSON)::
+
+    manifest.json   run manifest + {'reason', 'trigger': {...}}
+    ring.jsonl      the recorder ring, one event per line, oldest first
+    metrics.json    compact typed registry snapshot (snapshot_dict)
+    memory.json     device memory stats + live-array census (when jax
+                    is loaded; {'supported': false} otherwise)
+
+Importable and fully functional without jax (``memory.json`` then just
+reports unsupported) — a crashing jax-free feed process can still dump.
+"""
+
+from __future__ import annotations
+
+import io
+import itertools
+import json
+import os
+import tarfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from socceraction_tpu.obs.metrics import REGISTRY, MetricRegistry
+
+__all__ = ['RECORDER', 'FlightRecorder', 'dump_debug_bundle']
+
+_bundle_seq = itertools.count(1)
+
+
+class FlightRecorder:
+    """Bounded ring of recent runtime events (thread-safe).
+
+    ``capacity`` bounds memory: the ring holds the *most recent* events
+    and silently drops the oldest — a flight recorder, not a log.
+    """
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self._ring: 'deque[Dict[str, Any]]' = deque(maxlen=int(capacity))
+        self.dropped = 0
+
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one event (``ts`` and ``kind`` are added here)."""
+        event = {'ts': time.time(), 'kind': kind}
+        event.update(fields)
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(event)
+
+    def events(self) -> List[Dict[str, Any]]:
+        """The ring's events, oldest first (a copy)."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        """Drop every buffered event (test isolation)."""
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+#: The process-wide flight recorder the runtime feeds by default.
+RECORDER = FlightRecorder()
+
+
+def _json_bytes(obj: Any) -> bytes:
+    return json.dumps(obj, default=str, sort_keys=True, indent=1).encode('utf-8')
+
+
+def dump_debug_bundle(
+    out_dir: str,
+    *,
+    reason: str = 'manual',
+    trigger: Optional[Dict[str, Any]] = None,
+    registry: Optional[MetricRegistry] = None,
+    recorder: Optional[FlightRecorder] = None,
+) -> str:
+    """Write one post-mortem tarball into ``out_dir``; returns its path.
+
+    ``reason`` is a short machine-readable cause (``flusher_crash``,
+    ``overload``, ``swap_failure``, ``manual``); ``trigger`` is the
+    structured event that fired the dump (error string, queue state, …)
+    and lands verbatim in ``manifest.json``. The active
+    :class:`~socceraction_tpu.obs.trace.RunLog` (if any) gets a
+    ``debug_bundle`` event pointing at the artifact.
+    """
+    from socceraction_tpu.obs.export import snapshot_dict
+    from socceraction_tpu.obs.memory import (
+        device_memory_stats,
+        live_array_census,
+    )
+    from socceraction_tpu.obs.trace import current_runlog, run_manifest
+
+    reg = registry if registry is not None else REGISTRY
+    rec = recorder if recorder is not None else RECORDER
+
+    manifest = run_manifest()
+    manifest['reason'] = reason
+    manifest['trigger'] = dict(trigger) if trigger else None
+
+    ring = rec.events()
+    ring_lines = b''.join(
+        json.dumps(e, default=str, sort_keys=True).encode('utf-8') + b'\n'
+        for e in ring
+    )
+
+    census = live_array_census()
+    memory = {
+        'device_memory_stats': device_memory_stats(),
+        'live_arrays': census,
+        'supported': census.get('supported', False),
+    }
+
+    os.makedirs(out_dir, exist_ok=True)
+    stamp = time.strftime('%Y%m%dT%H%M%S')
+    path = os.path.join(
+        out_dir,
+        f'debug-{os.getpid()}-{stamp}-{next(_bundle_seq)}.tar.gz',
+    )
+    members = (
+        ('manifest.json', _json_bytes(manifest)),
+        ('ring.jsonl', ring_lines),
+        ('metrics.json', _json_bytes(snapshot_dict(reg.snapshot(), buckets=False))),
+        ('memory.json', _json_bytes(memory)),
+    )
+    tmp = f'{path}.tmp-{os.getpid()}'
+    try:
+        with tarfile.open(tmp, 'w:gz') as tar:
+            for name, payload in members:
+                info = tarfile.TarInfo(name)
+                info.size = len(payload)
+                info.mtime = int(time.time())
+                tar.addfile(info, io.BytesIO(payload))
+        os.replace(tmp, path)  # a killed dump never leaves a partial bundle
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+    rec.record('debug_bundle', path=path, reason=reason)
+    log = current_runlog()
+    if log is not None:
+        log.event('debug_bundle', path=path, reason=reason)
+    return path

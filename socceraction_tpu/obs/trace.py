@@ -60,7 +60,7 @@ def _span_stack() -> List['Span']:
 class Span:
     """One open span: identity, attributes, and registered sync targets."""
 
-    __slots__ = ('name', 'attrs', 'span_id', 'parent_id', 't0', '_sync')
+    __slots__ = ('name', 'attrs', 'span_id', 'parent_id', 't0', '_sync', '_memory')
 
     def __init__(
         self, name: str, attrs: Dict[str, Any], parent_id: Optional[int]
@@ -71,6 +71,7 @@ class Span:
         self.parent_id = parent_id
         self.t0 = time.perf_counter()
         self._sync: List[Any] = []
+        self._memory: Optional[Dict[str, float]] = None
 
     def sync(self, value: Any) -> Any:
         """Register arrays produced in this span for device sync at exit.
@@ -90,6 +91,22 @@ class Span:
     def annotate(self, **attrs: Any) -> None:
         """Attach additional attributes (shown on the close event)."""
         self.attrs.update(attrs)
+
+    def memory(self) -> 'Span':
+        """Request device-memory watermarks for this span; returns self.
+
+        Captures allocator stats now (``obs.memory.device_memory_stats``)
+        and, at span exit, annotates the close event with
+        ``mem_bytes_in_use`` / ``mem_peak_bytes`` / ``mem_delta_bytes``
+        and records the peak into the ``mem/span_peak_bytes`` histogram
+        (labeled by span name). A graceful no-op where the platform
+        reports no stats (CPU, jax-free processes): the span just closes
+        without memory attributes.
+        """
+        from socceraction_tpu.obs.memory import device_memory_stats
+
+        self._memory = device_memory_stats() or {}
+        return self
 
 
 @contextlib.contextmanager
@@ -145,6 +162,8 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
                     pass
         duration = time.perf_counter() - s.t0
         stack.pop()
+        if s._memory is not None:
+            _annotate_span_memory(s)
         log = _active_runlog
         if log is not None:
             close: Dict[str, Any] = {
@@ -159,6 +178,37 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
             if error is not None:
                 close['error'] = error
             log.event('span_close', **close)
+        # feed the always-on flight recorder (bounded ring — cheap)
+        from socceraction_tpu.obs.recorder import RECORDER
+
+        RECORDER.record(
+            'span_close', name=name, duration_s=duration, status=status,
+            attrs=dict(s.attrs), **({'error': error} if error else {}),
+        )
+
+
+def _annotate_span_memory(s: 'Span') -> None:
+    """Close-time half of :meth:`Span.memory` (no-op without stats)."""
+    from socceraction_tpu.obs.memory import device_memory_stats
+
+    end = device_memory_stats() or {}
+    if not end:
+        return
+    in_use = end.get('bytes_in_use')
+    peak = end.get('peak_bytes_in_use')
+    if in_use is not None:
+        s.attrs['mem_bytes_in_use'] = in_use
+        start = s._memory.get('bytes_in_use')
+        if start is not None:
+            s.attrs['mem_delta_bytes'] = in_use - start
+    if peak is not None:
+        s.attrs['mem_peak_bytes'] = peak
+        # span names may be dynamic (sanctioned for spans): past the
+        # label budget the samples collapse into the reserved overflow
+        # series instead of raising out of the span's exit path
+        REGISTRY.histogram(
+            'mem/span_peak_bytes', unit='bytes', on_overflow='overflow'
+        ).observe(peak, span=s.name)
 
 
 def run_manifest(

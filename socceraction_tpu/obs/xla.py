@@ -1,0 +1,549 @@
+"""Compile observatory: per-jit compile accounting + retrace-storm detection.
+
+The stack's hot paths are all "compile once, dispatch forever" designs —
+the serve bucket ladder, the one-dispatch-per-epoch trainer, the xT
+solvers. A *retrace* (a new abstract input signature reaching a jitted
+function) silently turns a microsecond dispatch into a multi-second XLA
+compile, and until now nothing counted them outside ad-hoc per-subsystem
+pins (``serve/shape_traces``, ``_EpochTrainer.n_traces``). This module is
+the shared instrument:
+
+- :func:`instrument_jit` wraps ``jax.jit`` with signature accounting.
+  Every *new* abstract signature (leaf shapes/dtypes + static values +
+  tree structure) records into governed ``xla/*`` metrics, all labeled
+  by ``fn`` (the function name is a **label**, never a metric-name
+  suffix — Prometheus cardinality stays one series per function):
+
+  | metric | kind (unit) | meaning |
+  |---|---|---|
+  | ``xla/compiles`` | counter (count) | new signatures seen (≈ XLA compiles) |
+  | ``xla/compile_seconds`` | histogram (s) | trace + compile + first-dispatch wall |
+  | ``xla/signatures`` | gauge (shapes) | live signature count per function |
+  | ``xla/cost_flops`` | gauge (flops) | XLA ``cost_analysis()`` of the last compile |
+  | ``xla/cost_bytes`` | gauge (bytes) | XLA ``cost_analysis()`` bytes accessed |
+  | ``xla/retrace_storm`` | counter (count) | storm-detector trips |
+
+- a **retrace-storm detector**: ``storm_threshold`` new signatures
+  within ``storm_window_s`` raises the ``xla/retrace_storm`` counter and
+  emits a ``retrace_storm`` event (RunLog + flight recorder) naming the
+  *signature diff* — exactly which argument's shape/dtype churned. The
+  default threshold (8) sits above the default serve bucket ladder's
+  7-rung warmup; sites with a larger legitimate compile budget set it
+  explicitly (``pair_probs`` uses 16: a full ladder warmup plus a
+  different-architecture hot-swap prewarm must stay silent, a
+  per-request shape leak must not).
+
+- :func:`cost_analysis` — XLA's own (flops, bytes accessed) for a
+  compiled function, promoted here from ``bench.py`` so the benchmark
+  artifact and the runtime observatory report identical numbers. The
+  observatory computes it from a *separate* AOT lowering built on
+  ``ShapeDtypeStruct`` specs (never the caller's possibly-donated
+  buffers). Default mode is ``'first'`` — one extra compile per
+  function, not per signature, so a 7-rung ladder warmup pays one AOT
+  compile rather than doubling; ``cost=True`` analyzes every signature,
+  ``cost=False`` (or ``SOCCERACTION_TPU_XLA_COST=0``) none.
+
+Everything here is importable without jax (the obs package contract);
+jax is touched only when a function is actually instrumented or called.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from socceraction_tpu.obs.metrics import (
+    REGISTRY,
+    MetricRegistry,
+)
+
+__all__ = [
+    'InstrumentedJit',
+    'call_key',
+    'cost_analysis',
+    'instrument_jit',
+    'observatory_snapshot',
+    'signature_of',
+]
+
+#: every live :class:`InstrumentedJit`, for per-instance introspection
+#: (weak: per-fit trainer instances must not accumulate forever)
+_INSTANCES: 'weakref.WeakSet[InstrumentedJit]' = weakref.WeakSet()
+
+#: process-lifetime per-``fn`` totals behind :func:`observatory_snapshot`
+#: — short-lived instances (per-fit epoch trainers) contribute here at
+#: compile time, so their accounting survives their garbage collection
+_TOTALS: Dict[str, Dict[str, Any]] = {}
+_TOTALS_LOCK = threading.Lock()
+_MAX_SIGNATURES_KEPT = 64
+
+
+def _bump_totals(
+    name: str,
+    *,
+    compiles: int = 0,
+    seconds: float = 0.0,
+    storms: int = 0,
+    cost: Optional[Tuple[float, float]] = None,
+    signature: Optional[str] = None,
+) -> None:
+    with _TOTALS_LOCK:
+        t = _TOTALS.setdefault(
+            name,
+            {
+                'fn': name,
+                'compiles': 0,
+                'compile_seconds_total': 0.0,
+                'retrace_storms': 0,
+                'signatures': [],
+            },
+        )
+        t['compiles'] += compiles
+        t['compile_seconds_total'] = round(
+            t['compile_seconds_total'] + seconds, 4
+        )
+        t['retrace_storms'] += storms
+        if cost is not None:
+            t['cost_flops'], t['cost_bytes'] = cost
+        if signature is not None and len(t['signatures']) < _MAX_SIGNATURES_KEPT:
+            t['signatures'].append(signature)
+
+
+_FN_LABEL_OK = re.compile(r'^[a-z][a-z0-9_]*$')
+
+
+def _cost_enabled() -> bool:
+    return os.environ.get('SOCCERACTION_TPU_XLA_COST', '1') != '0'
+
+
+def _leaf_desc(x: Any) -> str:
+    """One leaf of an abstract signature: ``float32[64,1664]``, a scalar
+    *type* (dynamic Python scalars are cached by aval, not value), or
+    repr for anything else."""
+    shape = getattr(x, 'shape', None)
+    dtype = getattr(x, 'dtype', None)
+    if shape is not None and dtype is not None:
+        return f'{dtype}[{",".join(str(d) for d in shape)}]'
+    if isinstance(x, (bool, int, float, complex)):
+        # a dynamic Python scalar traces as a weak-typed 0-d array: its
+        # VALUE does not key the jit cache, so it must not key ours
+        # (eps=1e-5 vs eps=1e-4 is the same compiled program)
+        return f'py_{type(x).__name__}'
+    return repr(x)
+
+
+def _leaf_key(x: Any) -> Any:
+    """Hashable fast-path cache key for one leaf (no string building)."""
+    shape = getattr(x, 'shape', None)
+    dtype = getattr(x, 'dtype', None)
+    if shape is not None and dtype is not None:
+        return (dtype, tuple(shape))
+    if isinstance(x, (bool, int, float, complex)):
+        return type(x)  # dynamic scalar: keyed by aval, not value
+    return repr(x)
+
+
+def _flatten_call(
+    args: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+    static_names: Any,
+) -> Tuple[Any, Any, Any]:
+    """Split/flatten one call: ``(dynamic_leaves, treedef, static_kv)``."""
+    from jax.tree_util import tree_flatten
+
+    if static_names:
+        static = tuple(
+            sorted((k, kwargs[k]) for k in kwargs if k in static_names)
+        )
+        dynamic = {k: v for k, v in kwargs.items() if k not in static_names}
+    else:
+        static = ()
+        dynamic = kwargs
+    leaves, treedef = tree_flatten((args, dynamic))
+    return leaves, treedef, static
+
+
+def call_key(
+    args: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+    static_names: Any = frozenset(),
+) -> Any:
+    """The hashable abstract cache key of a call (the hot-path form).
+
+    Array leaves key by ``(dtype, shape)``; dynamic Python scalars by
+    type (value changes do not recompile); keyword arguments named in
+    ``static_names`` (the wrapper's ``static_argnames``) by value —
+    their values DO key the compile cache. Two calls with the same key
+    hit the same compiled program under ``jax.jit``'s cache keying (up
+    to weak-type promotion corners), so a key *miss* here is the
+    observatory's compile event. Costs a ``tree_flatten`` plus one
+    tuple per call — no per-call string formatting; the human-readable
+    form (:func:`signature_of`) is built only on a miss.
+    """
+    leaves, treedef, static = _flatten_call(args, kwargs, static_names)
+    return (treedef, tuple(_leaf_key(x) for x in leaves), static)
+
+
+def signature_of(
+    args: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+    static_names: Any = frozenset(),
+) -> Tuple[Tuple[str, str], ...]:
+    """The human-readable signature of a call: ``((arg_path, desc), ...)``.
+
+    The pretty form of :func:`call_key` — argument paths via
+    ``jax.tree_util.keystr`` plus ``dtype[shape]``/type/repr leaf
+    descriptions — used for compile events, storm diffs and snapshots.
+    Built only when a call misses the signature cache.
+    """
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    static = {k: kwargs[k] for k in kwargs if k in static_names}
+    dynamic = {k: v for k, v in kwargs.items() if k not in static_names}
+    leaves, _treedef = tree_flatten_with_path((args, dynamic))
+    sig = [(keystr(path), _leaf_desc(x)) for path, x in leaves]
+    sig += [(f'static:{k}', repr(v)) for k, v in sorted(static.items())]
+    return tuple(sig)
+
+
+def signature_diff(
+    old: Optional[Tuple[Tuple[str, str], ...]],
+    new: Tuple[Tuple[str, str], ...],
+) -> Dict[str, Any]:
+    """Name what changed between two signatures (the storm event payload).
+
+    Returns ``{'changed': [{'arg', 'was', 'now'}], 'added': [...],
+    'removed': [...]}`` — empty lists when ``old`` is None (first
+    signature ever: everything is new, nothing "churned").
+    """
+    if old is None:
+        return {'changed': [], 'added': [f'{p} = {d}' for p, d in new], 'removed': []}
+    old_map = dict(old)
+    new_map = dict(new)
+    changed = [
+        {'arg': p, 'was': old_map[p], 'now': d}
+        for p, d in new
+        if p in old_map and old_map[p] != d
+    ]
+    added = [f'{p} = {d}' for p, d in new if p not in old_map]
+    removed = [f'{p} = {d}' for p, d in old if p not in new_map]
+    return {'changed': changed, 'added': added, 'removed': removed}
+
+
+def _spec_leaf(x: Any) -> Any:
+    """Replace array leaves by ShapeDtypeStructs (AOT lowering input)."""
+    import jax
+
+    shape = getattr(x, 'shape', None)
+    dtype = getattr(x, 'dtype', None)
+    if shape is not None and dtype is not None:
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+    return x
+
+
+def cost_analysis(
+    jitted: Any,
+    args: Tuple[Any, ...] = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+) -> Tuple[Optional[float], Optional[float]]:
+    """XLA's own ``(flops, bytes accessed)`` for ``jitted(*args)``, or Nones.
+
+    ``jitted`` may be a plain ``jax.jit`` product or an
+    :class:`InstrumentedJit`. The lowering runs on ``ShapeDtypeStruct``
+    specs derived from ``args``, so donated or deleted buffers are never
+    touched, and the AOT compile does not populate (or disturb) the
+    function's dispatch cache. This is the one implementation both
+    ``bench.py``'s roofline and the runtime observatory report from.
+    """
+    import jax
+
+    kwargs = kwargs or {}
+    try:
+        spec_args, spec_kwargs = jax.tree_util.tree_map(
+            _spec_leaf, (tuple(args), dict(kwargs))
+        )
+        cost = jitted.lower(*spec_args, **spec_kwargs).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns one dict per device
+            cost = cost[0]
+        return (
+            float(cost.get('flops', 0.0)),
+            float(cost.get('bytes accessed', 0.0)),
+        )
+    except Exception:
+        return None, None
+
+
+class InstrumentedJit:
+    """A ``jax.jit`` wrapper that accounts every compile it causes.
+
+    Calls delegate to the underlying jitted function; unknown attributes
+    (``lower``, ``eval_shape``, ``_cache_size``, ...) delegate too, so an
+    instrumented function is a drop-in replacement at existing call
+    sites. Calls made *inside an outer trace* (tracer arguments — the
+    function is being inlined, not dispatched) bypass the accounting
+    entirely.
+
+    Thread-safe: concurrent first calls on the same new signature record
+    it once.
+
+    Static arguments must be declared via ``static_argnames`` and passed
+    by keyword at call sites (the repo convention): ``static_argnums``
+    is rejected, and a static value smuggled in positionally would be
+    keyed value-insensitively by the observatory (jit itself would still
+    recompile correctly — only the accounting would undercount).
+    """
+
+    def __init__(
+        self,
+        fn: Callable[..., Any],
+        name: str,
+        *,
+        storm_threshold: int = 8,
+        storm_window_s: float = 60.0,
+        cost: Any = None,
+        registry: Optional[MetricRegistry] = None,
+        **jit_kwargs: Any,
+    ) -> None:
+        import jax
+
+        if not _FN_LABEL_OK.match(name):
+            raise ValueError(
+                f'instrument_jit name {name!r} must be a label-safe '
+                'function name ([a-z][a-z0-9_]*) — it becomes the fn= '
+                'label of the xla/* metrics'
+            )
+        if 'static_argnums' in jit_kwargs:
+            raise ValueError(
+                'instrument_jit supports static_argnames only — '
+                'positional statics would be keyed value-insensitively '
+                'by the signature accounting'
+            )
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self.name = name
+        static = jit_kwargs.get('static_argnames') or ()
+        self._static_names = frozenset(
+            (static,) if isinstance(static, str) else static
+        )
+        self.storm_threshold = int(storm_threshold)
+        self.storm_window_s = float(storm_window_s)
+        self._cost = cost
+        self._registry = registry if registry is not None else REGISTRY
+        self._lock = threading.Lock()
+        #: fast call key -> human-readable signature
+        self._signatures: Dict[Any, Tuple[Tuple[str, str], ...]] = {}
+        self._last_sig: Optional[Tuple[Tuple[str, str], ...]] = None
+        self._recent: 'deque[float]' = deque()
+        self.n_storms = 0
+        self.compile_seconds_total = 0.0
+        self.last_cost: Optional[Tuple[float, float]] = None
+        self._cost_attempted = False
+        _INSTANCES.add(self)
+
+    # -- call path ---------------------------------------------------------
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        import jax
+
+        leaves, treedef, static = _flatten_call(
+            args, kwargs, self._static_names
+        )
+        if any(isinstance(x, jax.core.Tracer) for x in leaves):
+            # inlined into an outer trace: no dispatch, no compile here
+            return self._jit(*args, **kwargs)
+        key = (treedef, tuple(_leaf_key(x) for x in leaves), static)
+        if key in self._signatures:
+            return self._jit(*args, **kwargs)
+        return self._first_call(key, args, kwargs)
+
+    def _first_call(self, key, args, kwargs):
+        sig = signature_of(args, kwargs, self._static_names)
+        with self._lock:
+            fresh = key not in self._signatures
+            if fresh:
+                self._signatures[key] = sig
+                prev = self._last_sig
+                self._last_sig = sig
+                n_sigs = len(self._signatures)
+        if not fresh:  # another thread registered it while we waited
+            return self._jit(*args, **kwargs)
+
+        mode = self._cost
+        if mode is None:
+            mode = 'first' if _cost_enabled() else False
+        # 'first' caps the extra AOT compile at one ATTEMPT per function:
+        # gating on success would re-pay the lowering on every signature
+        # when the backend's cost_analysis() is unimplemented
+        do_cost = mode in (True, 'all') or (
+            mode == 'first' and not self._cost_attempted
+        )
+        flops = bytes_acc = None
+        if do_cost:
+            self._cost_attempted = True
+            # AOT, from specs: never touches caller buffers, never
+            # pollutes the dispatch cache; runs BEFORE the call so
+            # donated arguments are still alive for spec derivation
+            flops, bytes_acc = cost_analysis(self._jit, args, kwargs)
+
+        t0 = time.perf_counter()
+        out = self._jit(*args, **kwargs)
+        dt = time.perf_counter() - t0
+
+        reg = self._registry
+        labels = {'fn': self.name}
+        with self._lock:
+            self.compile_seconds_total += dt
+            if flops is not None:
+                self.last_cost = (flops, bytes_acc)
+        reg.counter('xla/compiles', unit='count').inc(1, **labels)
+        reg.histogram('xla/compile_seconds', unit='s').observe(dt, **labels)
+        reg.gauge('xla/signatures', unit='shapes').set(n_sigs, **labels)
+        if flops is not None:
+            reg.gauge('xla/cost_flops', unit='flops').set(flops, **labels)
+            reg.gauge('xla/cost_bytes', unit='bytes').set(bytes_acc, **labels)
+        _bump_totals(
+            self.name,
+            compiles=1,
+            seconds=dt,
+            cost=(flops, bytes_acc) if flops is not None else None,
+            signature=' '.join(d for _p, d in sig),
+        )
+
+        self._note_compile_event(sig, prev, dt, flops, bytes_acc)
+        return out
+
+    def _note_compile_event(self, sig, prev, dt, flops, bytes_acc):
+        """RunLog/recorder events + the rate-over-window storm detector."""
+        from socceraction_tpu.obs.recorder import RECORDER
+        from socceraction_tpu.obs.trace import current_runlog
+
+        event = {
+            'fn': self.name,
+            'signature': [f'{p} = {d}' for p, d in sig],
+            'compile_s': dt,
+        }
+        if flops is not None:
+            event['cost_flops'] = flops
+            event['cost_bytes'] = bytes_acc
+        log = current_runlog()
+        if log is not None:
+            log.event('jit_compile', **event)
+        RECORDER.record('jit_compile', **event)
+
+        now = time.monotonic()
+        with self._lock:
+            self._recent.append(now)
+            while self._recent and now - self._recent[0] > self.storm_window_s:
+                self._recent.popleft()
+            n_recent = len(self._recent)
+            storm = n_recent >= self.storm_threshold
+            if storm:
+                self.n_storms += 1
+        if storm:
+            diff = signature_diff(prev, sig)
+            self._registry.counter('xla/retrace_storm', unit='count').inc(
+                1, fn=self.name
+            )
+            _bump_totals(self.name, storms=1)
+            storm_event = {
+                'fn': self.name,
+                'new_signatures_in_window': n_recent,
+                'window_s': self.storm_window_s,
+                'signature_diff': diff,
+            }
+            if log is not None:
+                log.event('retrace_storm', **storm_event)
+            RECORDER.record('retrace_storm', **storm_event)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def n_compiles(self) -> int:
+        """Distinct abstract signatures dispatched so far."""
+        with self._lock:
+            return len(self._signatures)
+
+    def signatures(self) -> Tuple[Tuple[Tuple[str, str], ...], ...]:
+        """The human-readable signatures seen, in registration order."""
+        with self._lock:
+            return tuple(self._signatures.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One function's observatory entry (compiles, wall, last cost)."""
+        with self._lock:
+            sigs = [
+                ' '.join(d for _p, d in s) for s in self._signatures.values()
+            ]
+            storms = self.n_storms
+            seconds = self.compile_seconds_total
+            last_cost = self.last_cost
+        out: Dict[str, Any] = {
+            'fn': self.name,
+            'compiles': len(sigs),
+            'compile_seconds_total': round(seconds, 4),
+            'retrace_storms': storms,
+            'signatures': sigs,
+        }
+        if last_cost is not None:
+            out['cost_flops'], out['cost_bytes'] = last_cost
+        return out
+
+    def __getattr__(self, item: str) -> Any:
+        # lower / eval_shape / _cache_size / clear_cache / __wrapped__ ...
+        if item == '_jit':  # guard recursion on a half-initialized object
+            raise AttributeError(item)
+        return getattr(self._jit, item)
+
+    def __repr__(self) -> str:
+        return f'InstrumentedJit({self.name!r}, compiles={self.n_compiles})'
+
+
+def instrument_jit(
+    fn: Optional[Callable[..., Any]] = None,
+    name: Optional[str] = None,
+    **kwargs: Any,
+) -> Any:
+    """Wrap ``fn`` in ``jax.jit`` with compile accounting (see module doc).
+
+    Usable directly (``solve = instrument_jit(solve_fn, 'solve_xt',
+    static_argnames=('l', 'w'))``) or as a configured decorator::
+
+        @functools.partial(instrument_jit, name='pair_probs',
+                           static_argnames=('names', 'k'))
+        def _pair_probs(...): ...
+
+    Keyword arguments beyond the observatory's own (``storm_threshold``,
+    ``storm_window_s``, ``cost``, ``registry``) pass through to
+    ``jax.jit`` (``static_argnames``, ``donate_argnums``, ...). ``cost``
+    selects the AOT cost-analysis mode: ``'first'`` (the default —
+    analyze the first signature only, one extra compile per function),
+    ``True`` (every signature), ``False`` (never — required for jitted
+    functions with trace-time side effects, where a second lowering
+    would run them again).
+    """
+    if fn is None:
+        return lambda f: instrument_jit(f, name, **kwargs)
+    if name is None:
+        name = getattr(fn, '__name__', 'fn').strip('_')
+    return InstrumentedJit(fn, name, **kwargs)
+
+
+def observatory_snapshot() -> Dict[str, Any]:
+    """Every instrumented function's process-lifetime entry, by ``fn``.
+
+    Aggregated at compile time into module totals, so short-lived
+    instances (per-fit epoch trainers) keep counting after they are
+    garbage-collected; instances sharing one name merge (compile counts
+    and wall sum, the latest cost wins, signatures capped at
+    ``_MAX_SIGNATURES_KEPT`` per function). This is the block
+    ``bench.py`` embeds in its artifact.
+    """
+    with _TOTALS_LOCK:
+        return {
+            name: dict(t, signatures=list(t['signatures']))
+            for name, t in sorted(_TOTALS.items())
+        }

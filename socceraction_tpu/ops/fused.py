@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from ..atomic.spadl import config as atomicconfig
+from ..obs.xla import instrument_jit
 from ..spadl import config as spadlconfig
 from . import atomic as _atomicops
 from .atomic import ATOMIC_KERNELS, _AtomicStates
@@ -461,7 +462,11 @@ def fused_pair_logits(
 
 
 @functools.partial(
-    jax.jit,
+    instrument_jit, name='pair_probs',
+    # threshold 16: a full serve bucket-ladder warmup (up to 8 rungs at
+    # max_batch_size=128) PLUS a different-architecture hot-swap prewarm
+    # in the same window are controlled compiles, not a storm
+    storm_threshold=16,
     static_argnames=(
         'names', 'k', 'hidden_layers_a', 'hidden_layers_b', 'registry_name',
         'hidden_dtype_name',
@@ -630,7 +635,10 @@ def train_layout(
     return TrainLayout(tuple(names), k, registry_name, off, tuple(spans))
 
 
-@functools.partial(jax.jit, static_argnames=('names', 'k', 'registry_name'))
+@functools.partial(
+    instrument_jit, name='train_states',
+    static_argnames=('names', 'k', 'registry_name'),
+)
 def _train_states_arrays(batch, *, names, k, registry_name):
     registry = REGISTRIES[registry_name]
     s = registry.make_states(batch, k)

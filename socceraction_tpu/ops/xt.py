@@ -28,6 +28,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.xla import instrument_jit
 from ..spadl import config as spadlconfig
 from .segment import segment_sum
 
@@ -308,7 +309,8 @@ def xt_probabilities(counts: XTCounts, *, l: int, w: int) -> XTProbabilities:
 
 
 @functools.partial(
-    jax.jit, static_argnames=('max_iter', 'accelerate', 'return_residual')
+    instrument_jit, name='solve_xt',
+    static_argnames=('max_iter', 'accelerate', 'return_residual'),
 )
 def solve_xt(
     probs: XTProbabilities,
@@ -351,7 +353,7 @@ def solve_xt(
 
 
 @functools.partial(
-    jax.jit,
+    instrument_jit, name='solve_xt_matrix_free',
     static_argnames=(
         'l', 'w', 'max_iter', 'axis_name', 'accelerate', 'return_residual'
     ),

@@ -36,6 +36,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ..core.batch import bucket_ladder
 from ..obs import counter, gauge, histogram, span
+from ..obs.recorder import RECORDER
 
 __all__ = ['MicroBatcher', 'Overloaded']
 
@@ -79,6 +80,12 @@ class MicroBatcher:
     max_queue : int
         Admission bound: ``submit`` past this many waiting requests
         raises :class:`Overloaded`.
+    on_crash : callable, optional
+        ``on_crash(exc)`` invoked (once, on the dying thread) if the
+        flusher thread itself dies — i.e. an exception escapes the take
+        loop rather than a flush (flush failures land on the affected
+        futures and the thread lives on). The service hooks its
+        flight-recorder dump here.
     """
 
     def __init__(
@@ -88,6 +95,7 @@ class MicroBatcher:
         max_batch_size: int = 64,
         max_wait_ms: float = 2.0,
         max_queue: int = 256,
+        on_crash: Optional[Callable[[BaseException], None]] = None,
     ) -> None:
         if max_batch_size < 1:
             raise ValueError('max_batch_size must be >= 1')
@@ -103,6 +111,9 @@ class MicroBatcher:
         self._queue: List[_Request] = []
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        self._on_crash = on_crash
+        self._crashed: Optional[BaseException] = None
+        self._last_flush_t: Optional[float] = None
 
     # -- submission --------------------------------------------------------
 
@@ -117,6 +128,11 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 raise RuntimeError('batcher is closed')
+            if self._crashed is not None:
+                raise RuntimeError(
+                    f'flusher thread died: {self._crashed!r} '
+                    '(see the debug bundle; start a new service)'
+                )
             if len(self._queue) >= self.max_queue:
                 counter('serve/rejected_total', unit='requests').inc(1)
                 raise Overloaded(
@@ -176,11 +192,36 @@ class MicroBatcher:
         return take, reason
 
     def _flush_loop(self) -> None:
-        while True:
-            take, reason = self._take()
-            if not take:
-                return
-            self._flush(take, reason)
+        try:
+            while True:
+                take, reason = self._take()
+                if not take:
+                    return
+                self._flush(take, reason)
+                self._last_flush_t = time.monotonic()
+        except BaseException as e:  # noqa: BLE001 - the thread is dying
+            # A dead flusher would otherwise strand every queued (and
+            # future) request forever: record the crash, fail what is
+            # queued, reject new submits, and hand the exception to the
+            # crash hook (the service's debug-bundle dump).
+            self._crashed = e
+            counter('serve/flusher_crashes', unit='count').inc(1)
+            RECORDER.record(
+                'flusher_crash', error=f'{type(e).__name__}: {e}',
+                queue_depth=self.queue_depth,
+            )
+            with self._cond:
+                dropped, self._queue = self._queue, []
+            for r in dropped:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(
+                        RuntimeError(f'flusher thread died: {e!r}')
+                    )
+            if self._on_crash is not None:
+                try:
+                    self._on_crash(e)
+                except Exception:  # the hook must not mask the crash
+                    pass
 
     def _flush(self, take: List[_Request], reason: str) -> None:
         # Transition every future to RUNNING; a caller that cancel()ed
@@ -194,6 +235,10 @@ class MicroBatcher:
         fill = len(take) / bucket
         counter('serve/flushes', unit='count').inc(1, reason=reason)
         gauge('serve/batch_fill_ratio', unit='ratio').set(fill)
+        RECORDER.record(
+            'serve_queue', taken=len(take), bucket=bucket, reason=reason,
+            queue_depth=self.queue_depth, fill_ratio=fill,
+        )
         try:
             with span('serve/flush', requests=len(take), bucket=bucket):
                 with histogram('serve/flush_seconds', unit='s').time(
@@ -215,6 +260,34 @@ class MicroBatcher:
         for r, out in zip(take, results):
             lat.observe(done - r.t0, kind=r.kind)
             r.future.set_result(out)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests currently waiting for a flush."""
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def crashed(self) -> Optional[BaseException]:
+        """The exception that killed the flusher thread, or None."""
+        return self._crashed
+
+    @property
+    def flusher_alive(self) -> bool:
+        """False once the flusher thread has died (crash or exit); True
+        while it runs or before it has lazily started."""
+        if self._crashed is not None:
+            return False
+        thread = self._thread
+        return thread is None or thread.is_alive()
+
+    @property
+    def last_flush_age_s(self) -> Optional[float]:
+        """Seconds since the last completed flush (None before any)."""
+        t = self._last_flush_t
+        return None if t is None else time.monotonic() - t
 
     # -- lifecycle ---------------------------------------------------------
 

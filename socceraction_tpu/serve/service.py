@@ -28,15 +28,20 @@ the full serving timeline.
 
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
+import time
+from collections import deque
 from concurrent.futures import Future
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
 
 from ..core.batch import ActionBatch, pack_actions, pad_batch_games, unpack_values
-from ..obs import counter, gauge, span
+from ..obs import REGISTRY, counter, gauge, span
+from ..obs.recorder import dump_debug_bundle
 from .batcher import MicroBatcher, Overloaded
 from .session import (
     WINDOW_LOCAL_KERNELS,
@@ -86,6 +91,19 @@ class RatingService:
     max_queue : int
         Admission bound; past it ``rate()`` raises
         :class:`~socceraction_tpu.serve.batcher.Overloaded`.
+    slo_p99_ms : float
+        The p99 end-to-end latency budget :meth:`health` compares the
+        measured ``serve/request_seconds`` p99 against. Observability
+        only — nothing is throttled by it.
+    debug_dir : str, optional
+        Where automatic flight-recorder bundles land
+        (:func:`~socceraction_tpu.obs.recorder.dump_debug_bundle` on
+        flusher-thread death, ``Overloaded`` bursts past
+        ``overload_dump_threshold`` within ``overload_dump_window_s``,
+        and hot-swap failure). Default:
+        ``$SOCCERACTION_TPU_DEBUG_DIR`` or
+        ``<tmpdir>/socceraction-tpu-debug``. Dumps are rate-limited to
+        one per reason per ``dump_interval_s``.
     """
 
     def __init__(
@@ -97,6 +115,11 @@ class RatingService:
         max_batch_size: int = 64,
         max_wait_ms: float = 2.0,
         max_queue: int = 256,
+        slo_p99_ms: float = 250.0,
+        debug_dir: Optional[str] = None,
+        overload_dump_threshold: int = 64,
+        overload_dump_window_s: float = 10.0,
+        dump_interval_s: float = 60.0,
     ) -> None:
         if (model is None) == (registry is None):
             raise ValueError('give exactly one of model= or registry=')
@@ -114,11 +137,26 @@ class RatingService:
         # models without the kernel never pay the per-request prefix work
         self._gs_enabled = 'goalscore' in first._kernel_names()
         self.max_actions = int(max_actions)
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.debug_dir = (
+            debug_dir
+            or os.environ.get('SOCCERACTION_TPU_DEBUG_DIR')
+            or os.path.join(tempfile.gettempdir(), 'socceraction-tpu-debug')
+        )
+        self.overload_dump_threshold = int(overload_dump_threshold)
+        self.overload_dump_window_s = float(overload_dump_window_s)
+        self.dump_interval_s = float(dump_interval_s)
+        self.last_dump_path: Optional[str] = None
+        self._dump_lock = threading.Lock()
+        self._last_dump_t: Dict[str, float] = {}
+        self._overloads: 'deque[float]' = deque()
+        self._started_t = time.monotonic()
         self._batcher = MicroBatcher(
             self._flush,
             max_batch_size=max_batch_size,
             max_wait_ms=max_wait_ms,
             max_queue=max_queue,
+            on_crash=self._on_flusher_crash,
         )
         self._shape_lock = threading.Lock()
         self._seen_shapes: set = set()
@@ -163,29 +201,46 @@ class RatingService:
         """
         if self._registry is None:
             raise RuntimeError('swap_model needs a registry-backed service')
-        old = self.model
-        # pin 'newest' NOW: the version validated and pre-warmed below must
-        # be the exact version activated (a publish racing this call could
-        # otherwise slip an unvalidated, cold model past the gates)
-        version = self._registry.resolve_version(name, version)
-        new = self._registry.load(name, version)
-        self._validate_model(new)
-        if new.nb_prev_actions != old.nb_prev_actions or (
-            new._kernel_names() != old._kernel_names()
-        ):
-            raise ValueError(
-                'swap target changes the feature layout '
-                '(nb_prev_actions/xfns); start a new RatingService for it'
+        try:
+            old = self.model
+            # pin 'newest' NOW: the version validated and pre-warmed below
+            # must be the exact version activated (a publish racing this
+            # call could otherwise slip an unvalidated, cold model past the
+            # gates)
+            version = self._registry.resolve_version(name, version)
+            new = self._registry.load(name, version)
+            self._validate_model(new)
+            if new.nb_prev_actions != old.nb_prev_actions or (
+                new._kernel_names() != old._kernel_names()
+            ):
+                raise ValueError(
+                    'swap target changes the feature layout '
+                    '(nb_prev_actions/xfns); start a new RatingService for it'
+                )
+            # pre-warm the NEW model's ladder compiles before it goes live:
+            # a different head architecture is a different XLA program, and
+            # without this the first post-swap request would pay its compile
+            # inside its latency budget (observed ~1s on CPU). Same-arch
+            # swaps hit the jit cache and cost a few no-op dispatches.
+            A = self.max_actions
+            for b in self._batcher.ladder:
+                self._device_rate(
+                    _empty_host_batch(1, A), _empty_gs(1, A), new, b
+                )
+            return self._registry.activate(name, version)
+        except Exception as e:
+            # a failed rollout is exactly when an operator wants the
+            # flight recorder: what was serving, what was queued, which
+            # gate the new version failed
+            self._maybe_dump(
+                'swap_failure',
+                {
+                    'type': 'swap_failure',
+                    'target': f'{name}/{version or "newest"}',
+                    'error': f'{type(e).__name__}: {e}',
+                },
             )
-        # pre-warm the NEW model's ladder compiles before it goes live: a
-        # different head architecture is a different XLA program, and
-        # without this the first post-swap request would pay its compile
-        # inside its latency budget (observed ~1s on CPU). Same-arch swaps
-        # hit the jit cache and cost a few no-op dispatches.
-        A = self.max_actions
-        for b in self._batcher.ladder:
-            self._device_rate(_empty_host_batch(1, A), _empty_gs(1, A), new, b)
-        return self._registry.activate(name, version)
+            raise
 
     # -- request entry points ----------------------------------------------
 
@@ -233,7 +288,7 @@ class RatingService:
             else None
         )
         payload = _Payload(staging, gs, keep=None, index=actions.index)
-        return self._batcher.submit(payload, kind='rate')
+        return self._submit(payload, 'rate')
 
     def rate_sync(
         self, actions: pd.DataFrame, *, home_team_id: Any = None,
@@ -264,7 +319,15 @@ class RatingService:
             window, match_id, home_team_id, self.max_actions
         )
         payload = _Payload(staging, gs, keep=(context, m))
-        return self._batcher.submit(payload, kind='session')
+        return self._submit(payload, 'session')
+
+    def _submit(self, payload: '_Payload', kind: str) -> Future:
+        """Enqueue via the batcher, counting ``Overloaded`` bursts."""
+        try:
+            return self._batcher.submit(payload, kind=kind)
+        except Overloaded:
+            self._note_overload()
+            raise
 
     # -- the flush (runs on the batcher's flusher thread) ------------------
 
@@ -351,6 +414,117 @@ class RatingService:
                 context, m = p.keep
                 results.append(values[i, context : context + m, :].copy())
         return results
+
+    # -- flight recorder + health ------------------------------------------
+
+    def _queue_state(self) -> Dict[str, Any]:
+        """The batcher's current state, for triggers and ``health()``."""
+        b = self._batcher
+        crashed = b.crashed
+        return {
+            'queue_depth': b.queue_depth,
+            'max_queue': b.max_queue,
+            'flusher_alive': b.flusher_alive,
+            'flusher_error': (
+                f'{type(crashed).__name__}: {crashed}' if crashed else None
+            ),
+            'last_flush_age_s': b.last_flush_age_s,
+        }
+
+    def _maybe_dump(self, reason: str, trigger: Dict[str, Any]) -> Optional[str]:
+        """Write a debug bundle, rate-limited per reason; never raises.
+
+        Every trigger increments ``serve/debug_dumps{reason=...}`` even
+        when the bundle itself is rate-limited away (the counter counts
+        trigger events, the files stay bounded).
+        """
+        counter('serve/debug_dumps', unit='count').inc(1, reason=reason)
+        now = time.monotonic()
+        with self._dump_lock:
+            last = self._last_dump_t.get(reason)
+            if last is not None and now - last < self.dump_interval_s:
+                return None
+            self._last_dump_t[reason] = now
+        try:
+            path = dump_debug_bundle(
+                self.debug_dir,
+                reason=reason,
+                trigger={**trigger, 'queue_state': self._queue_state()},
+            )
+        except Exception:  # a failing dump must never mask the trigger
+            return None
+        self.last_dump_path = path
+        return path
+
+    def _on_flusher_crash(self, exc: BaseException) -> None:
+        """Batcher crash hook: the service is dead — dump the recorder."""
+        self._maybe_dump(
+            'flusher_crash',
+            {'type': 'flusher_crash', 'error': f'{type(exc).__name__}: {exc}'},
+        )
+
+    def _note_overload(self) -> None:
+        """Track ``Overloaded`` raises; a burst past the threshold dumps."""
+        now = time.monotonic()
+        with self._dump_lock:
+            self._overloads.append(now)
+            cutoff = now - self.overload_dump_window_s
+            while self._overloads and self._overloads[0] < cutoff:
+                self._overloads.popleft()
+            burst = len(self._overloads)
+        if burst >= self.overload_dump_threshold:
+            self._maybe_dump(
+                'overload',
+                {
+                    'type': 'overload_burst',
+                    'rejections_in_window': burst,
+                    'window_s': self.overload_dump_window_s,
+                },
+            )
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness/pressure dict for external pollers (one cheap call).
+
+        Reads only host state and the typed metric snapshot — no device
+        work, safe on any thread at any rate. Keys: ``status``
+        (``'ok'`` | ``'flusher-dead'``), the queue state
+        (depth/bounds/last-flush age), the active model
+        ``{'name', 'version'}``, compiled-shape budget vs. ladder, the
+        measured request p99 vs. the ``slo_p99_ms`` budget, rejection
+        and debug-dump totals, and ``last_dump`` (path or None).
+        """
+        snap = REGISTRY.snapshot()
+        # worst p99 across traffic kinds (rate AND session) — a
+        # session-only deployment must not report a permanently blind SLO
+        lat = snap.get('serve/request_seconds')
+        p99s = [
+            s.quantiles['p99']
+            for s in (lat.series if lat is not None else ())
+            if s.count and s.quantiles and s.labels.get('kind') != 'warmup'
+        ]
+        p99_ms = max(p99s) * 1e3 if p99s else None
+        name, version, _model = self._active()
+        state = self._queue_state()
+        return {
+            'status': 'ok' if state['flusher_alive'] else 'flusher-dead',
+            **state,
+            'model': {'name': name, 'version': version},
+            'ladder': list(self.ladder),
+            'compiled_shapes': self.compiled_shapes,
+            'slo': {
+                'request_p99_ms': p99_ms,
+                'budget_p99_ms': self.slo_p99_ms,
+                'ok': None if p99_ms is None else bool(p99_ms <= self.slo_p99_ms),
+            },
+            'rejected_total': int(snap.value('serve/rejected_total')),
+            'debug_dumps': int(
+                sum(s.total for s in dumps.series)
+                if (dumps := snap.get('serve/debug_dumps')) is not None
+                else 0
+            ),
+            'last_dump': self.last_dump_path,
+            'uptime_s': time.monotonic() - self._started_t,
+        }
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -247,14 +247,26 @@ def profile_trace(
     Writes a TensorBoard-loadable trace to ``log_dir``. ``enabled=False``
     turns the context into a no-op so call sites can keep the hook in place
     unconditionally.
+
+    The capture itself is registered with the telemetry layer: the
+    region runs inside an ``xla/profile_trace`` span carrying ``log_dir``
+    in its attributes, so a :class:`~socceraction_tpu.obs.trace.RunLog`
+    (and the flight recorder) records when a device trace was taken and
+    where the artifact went — profiler captures are no longer invisible
+    to the run's own timeline.
     """
     if not enabled:
         yield
         return
     import jax
 
-    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    from socceraction_tpu.obs.trace import span as _span
+
+    with _span('xla/profile_trace', log_dir=log_dir):
+        jax.profiler.start_trace(
+            log_dir, create_perfetto_link=create_perfetto_link
+        )
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()

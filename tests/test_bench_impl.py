@@ -155,6 +155,13 @@ def test_impl_headline_contract():
     assert {s['labels']['path'] for s in bench_rates['series']} == {
         'fused', 'materialized',
     }
+    # every artifact embeds the compile observatory: the headline
+    # forwards compiled exactly once per path (ISSUE 5 bench satellite)
+    obs = d['xla_observatory']
+    for fn in ('bench_forward_fused', 'bench_forward_materialized'):
+        assert obs[fn]['compiles'] == 1, obs[fn]
+        assert obs[fn]['retrace_storms'] == 0
+        assert obs[fn]['compile_seconds_total'] > 0
 
 
 def test_impl_forced_extras_contract():
@@ -198,6 +205,8 @@ def test_impl_forced_extras_contract():
         assert epoch[path]['final_loss_finite'] is True
         assert epoch[path]['steps_per_epoch'] >= 1
         assert epoch[path]['seconds_per_epoch'] > 0
+        # zero retraces across the timed epochs (ISSUE 5 bench satellite)
+        assert epoch[path]['epoch_traces'] == 1
     assert epoch['fused_speedup'] > 0
     cold = extras['cold_path_stream']
     # 8 games x chunk 4, drop_remainder: both chunks complete, all actions
@@ -223,6 +232,16 @@ def test_impl_forced_extras_contract():
     _check_serve_throughput(extras['serve_throughput'])
     # the serve headline gauge survives into the artifact snapshot too
     assert 'bench/serve_requests_per_sec' in d['metric_snapshot']
+    # with the extras run, the observatory covers the eagerly-dispatched
+    # hot paths (the xT configs jit *around* the solvers, so those are
+    # inlined — correctly not counted as their own dispatches)
+    obs = d['xla_observatory']
+    assert {
+        'bench_forward_fused', 'pair_probs', 'train_epoch', 'train_states',
+    } <= set(obs)
+    assert obs['pair_probs']['compiles'] >= 1
+    assert obs['pair_probs'].get('cost_flops', 0) > 0
+    assert obs['train_epoch']['compiles'] >= 2  # one per timed path
 
 
 def _check_serve_throughput(serve):
@@ -230,8 +249,11 @@ def _check_serve_throughput(serve):
     assert serve['bucket_ladder'] == [1, 2, 4, 8, 16]
     assert serve['peak_requests_per_sec'] > 0
     # the acceptance gate: steady offered load compiles nothing past the
-    # warmed bucket ladder — no per-request retraces
+    # warmed bucket ladder — no per-request retraces, confirmed both by
+    # the service's own shape accounting and the compile observatory
     assert serve['compiled_shapes_plateaued'] is True
+    assert serve['steady_state_compiles'] == 0
+    assert serve['retrace_storms'] == 0
     for level in serve['levels']:
         assert level['requests'] > 0
         assert level['compiled_shapes_after'] == level['compiled_shapes_before']

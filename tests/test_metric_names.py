@@ -63,6 +63,44 @@ def test_serve_area_is_registered():
     assert 'serve' in tool.KNOWN_AREAS
 
 
+def test_xla_and_mem_areas_are_registered():
+    """The runtime introspection areas (``xla/*`` compile observatory,
+    ``mem/*`` device-memory accounting) are governed (ISSUE 5 satellite)."""
+    tool = _tool()
+    assert {'xla', 'mem'} <= tool.KNOWN_AREAS
+
+
+def test_per_function_name_nesting_detected(tmp_path):
+    """Function names must be labels, never metric-name suffixes: a
+    third ``/`` segment fails the gate (Prometheus cardinality)."""
+    tool = _tool()
+    bad = tmp_path / 'bad.py'
+    bad.write_text(
+        "counter('xla/compiles/pair_probs').inc()\n"
+        "counter('xla/compiles').inc(fn='pair_probs')\n"
+    )
+    problems, n_sites = tool.check_files([str(bad)])
+    assert n_sites == 2
+    assert len(problems) == 1
+    assert 'label' in problems[0] and "'xla/compiles/pair_probs'" in problems[0]
+
+
+def test_fstring_metric_names_detected(tmp_path):
+    """``counter(f'...')`` mints a metric per value — flagged; span
+    names may stay dynamic (run-log events, not exposition series)."""
+    tool = _tool()
+    bad = tmp_path / 'bad.py'
+    bad.write_text(
+        "counter(f'xla/compiles_{fn}').inc()\n"
+        "with span(f'serve/{phase}'):\n"
+        '    pass\n'
+    )
+    problems, n_sites = tool.check_files([str(bad)])
+    assert n_sites == 2
+    assert len(problems) == 1
+    assert 'label' in problems[0] and 'counter' in problems[0]
+
+
 def test_convention_violation_detected(tmp_path):
     tool = _tool()
     bad = tmp_path / 'bad.py'

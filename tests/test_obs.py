@@ -216,6 +216,33 @@ def test_reset_zeroes_in_place_and_bound_series_survive():
     assert reg.snapshot().get('area/stage') is None
 
 
+def test_preserve_shields_prefixes_from_reset():
+    """The zeroed-husk fix (ISSUE 5 satellite): instruments under a
+    preserved prefix survive in-place resets — the bench headline/train/
+    serve summary gauges no longer need per-call-site re-recording."""
+    reg = MetricRegistry()
+    reg.gauge('bench/rate_actions_per_sec', unit='actions/s').set(7.0, path='fused')
+    reg.counter('xla/compiles', unit='count').inc(3, fn='pair_probs')
+    reg.histogram('pipeline/stage_seconds', unit='s').observe(1.0, stage='read')
+    reg.preserve('bench/', 'xla/compiles')  # a prefix and an exact name
+    assert reg.preserved == ('bench/', 'xla/compiles')
+    reg.reset()
+    snap = reg.snapshot()
+    # preserved: values intact
+    assert snap.value('bench/rate_actions_per_sec', 'last', path='fused') == 7.0
+    assert snap.value('xla/compiles', fn='pair_probs') == 3
+    # everything else: zeroed in place
+    assert snap.value('pipeline/stage_seconds', stage='read') == 0.0
+    assert snap.series('pipeline/stage_seconds', stage='read').count == 0
+    # declaring a prefix twice does not duplicate it
+    reg.preserve('bench/')
+    assert reg.preserved == ('bench/', 'xla/compiles')
+    # clear=True is the full wipe: instruments AND the preserve list go
+    reg.reset(clear=True)
+    assert reg.snapshot().get('bench/rate_actions_per_sec') is None
+    assert reg.preserved == ()
+
+
 # -- export ----------------------------------------------------------------
 
 

@@ -1,0 +1,593 @@
+"""Tests for the runtime introspection layer (ISSUE 5).
+
+Covers the compile observatory (``obs/xla.py``: per-signature compile
+accounting, scalar/static cache-key fidelity, the retrace-storm detector
+firing on forced shape churn and staying silent across the serve bucket
+ladder), device-memory accounting (``obs/memory.py``: graceful CPU
+no-op, live-buffer census, the ``Span.memory`` hook), the flight
+recorder (``obs/recorder.py``: bounded ring, debug bundles, the
+service's automatic dump triggers), ``RatingService.health()``, and the
+``tools/obsctl.py`` operator CLI round-trips.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.obs import REGISTRY, RunLog, instrument_jit
+from socceraction_tpu.obs.memory import (
+    MemorySampler,
+    device_memory_stats,
+    live_array_census,
+    sample_device_memory,
+)
+from socceraction_tpu.obs.recorder import (
+    RECORDER,
+    FlightRecorder,
+    dump_debug_bundle,
+)
+from socceraction_tpu.obs.trace import span
+from socceraction_tpu.obs.xla import (
+    cost_analysis,
+    observatory_snapshot,
+    signature_diff,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOME = 100
+
+
+def _xla_value(name, stat='total', **labels):
+    return REGISTRY.snapshot().value(name, stat, **labels)
+
+
+# -- the compile observatory -----------------------------------------------
+
+
+def test_instrument_jit_counts_compiles_per_signature():
+    import jax.numpy as jnp
+
+    calls = []
+    f = instrument_jit(lambda x: calls.append(1) or x * 2, 'obsrt_basic')
+    before = _xla_value('xla/compiles', fn='obsrt_basic')
+    f(jnp.ones((3,)))
+    f(jnp.ones((3,)))  # same signature: no new compile
+    assert _xla_value('xla/compiles', fn='obsrt_basic') == before + 1
+    assert f.n_compiles == 1
+    f(jnp.ones((4,)))  # new shape: one more
+    assert _xla_value('xla/compiles', fn='obsrt_basic') == before + 2
+    assert f.n_compiles == 2
+    # the underlying jit agrees (the wrapper mirrors its cache keying)
+    assert f._cache_size() == 2
+    # cost analysis ran per signature and landed in the gauges
+    assert _xla_value('xla/cost_flops', 'last', fn='obsrt_basic') > 0
+    obs = observatory_snapshot()['obsrt_basic']
+    assert obs['compiles'] == 2 and len(obs['signatures']) == 2
+    assert obs['cost_flops'] > 0
+
+
+def test_instrument_jit_scalar_values_share_a_signature():
+    """Dynamic Python scalars are cached by aval, not value — eps=0.0 and
+    eps=1e-5 are ONE compiled program and must count as one; a *static*
+    kwarg's value change is a real recompile and must count as two."""
+    import jax.numpy as jnp
+
+    f = instrument_jit(
+        lambda x, eps=1e-5, *, n=2: x * eps * n, 'obsrt_scalars',
+        static_argnames=('n',),
+    )
+    x = jnp.ones((2,))
+    f(x, eps=1e-5, n=2)
+    f(x, eps=0.25, n=2)  # dynamic scalar value change: cache hit
+    assert f.n_compiles == 1
+    assert f._cache_size() == 1
+    f(x, eps=1e-5, n=3)  # static value change: real recompile
+    assert f.n_compiles == 2
+    assert f._cache_size() == 2
+
+
+def test_instrument_jit_nested_trace_is_not_a_compile():
+    import jax
+    import jax.numpy as jnp
+
+    inner = instrument_jit(lambda x: x + 1, 'obsrt_inner')
+
+    @jax.jit
+    def outer(x):
+        return inner(x) * 2  # inlined: tracer args, no dispatch
+
+    out = outer(jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+    assert inner.n_compiles == 0
+
+
+def test_instrument_jit_rejects_unlabeled_names():
+    with pytest.raises(ValueError, match='label-safe'):
+        instrument_jit(lambda x: x, 'Bad/Name')
+
+
+def test_retrace_storm_fires_on_shape_churn_with_diff(tmp_path):
+    """The acceptance path: forced shape churn raises the
+    ``xla/retrace_storm`` counter and the RunLog names the signature
+    diff of the offending retrace."""
+    import jax.numpy as jnp
+
+    f = instrument_jit(
+        lambda x: x.sum(), 'obsrt_churn',
+        storm_threshold=4, storm_window_s=60.0,
+    )
+    storms_before = _xla_value('xla/retrace_storm', fn='obsrt_churn')
+    with RunLog(str(tmp_path)):
+        for n in range(6):  # six distinct shapes in one window
+            f(jnp.ones((n + 1,)))
+    assert _xla_value('xla/retrace_storm', fn='obsrt_churn') > storms_before
+    events = [
+        json.loads(line)
+        for line in open(tmp_path / 'obs.jsonl', encoding='utf-8')
+    ]
+    storms = [e for e in events if e['event'] == 'retrace_storm']
+    assert storms and storms[0]['fn'] == 'obsrt_churn'
+    diff = storms[0]['signature_diff']
+    # the diff names the churning argument and both shapes
+    assert diff['changed'] and 'float32[' in diff['changed'][0]['was']
+    assert diff['changed'][0]['was'] != diff['changed'][0]['now']
+    compiles = [e for e in events if e['event'] == 'jit_compile']
+    assert len(compiles) == 6
+
+
+def test_retrace_storm_silent_below_threshold():
+    import jax.numpy as jnp
+
+    f = instrument_jit(
+        lambda x: x.sum(), 'obsrt_quiet',
+        storm_threshold=8, storm_window_s=60.0,
+    )
+    before = _xla_value('xla/retrace_storm', fn='obsrt_quiet')
+    for n in range(7):  # one below the threshold
+        f(jnp.ones((n + 1,)))
+    assert _xla_value('xla/retrace_storm', fn='obsrt_quiet') == before
+
+
+def test_signature_diff_shapes():
+    old = (('[0]', 'float32[3]'), ('[1]', 'int32[]'))
+    new = (('[0]', 'float32[4]'), ('[2]', 'bool[2]'))
+    d = signature_diff(old, new)
+    assert d['changed'] == [
+        {'arg': '[0]', 'was': 'float32[3]', 'now': 'float32[4]'}
+    ]
+    assert d['added'] == ['[2] = bool[2]']
+    assert d['removed'] == ['[1] = int32[]']
+    first = signature_diff(None, new)
+    assert first['changed'] == [] and len(first['added']) == 2
+
+
+def test_cost_analysis_matches_bench_promotion():
+    """``bench._cost_analysis`` is a thin alias of the observatory's —
+    one implementation, identical numbers in artifact and runtime."""
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, _ROOT)
+    from bench import _cost_analysis as bench_cost
+
+    f = jax.jit(lambda x: (x * 2.0).sum())
+    args = (jnp.ones((16,)),)
+    assert bench_cost(f, args) == cost_analysis(f, args)
+    flops, _bytes = cost_analysis(f, args)
+    assert flops and flops > 0
+
+
+# -- device-memory accounting ----------------------------------------------
+
+
+def test_memory_sampler_noops_cleanly_on_cpu():
+    """CPU reports no allocator stats: every entry point must degrade to
+    a silent no-op, and the background sampler must discover it and
+    exit on its first tick."""
+    assert device_memory_stats() is None  # jax loaded, CPU backend
+    assert sample_device_memory() == {}
+    assert REGISTRY.snapshot().get('mem/bytes_in_use') is None
+    with MemorySampler(interval_s=0.01) as sampler:
+        deadline = time.monotonic() + 10.0
+        while sampler.supported is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert sampler.supported is False and sampler.samples == 0
+
+
+def test_live_array_census_groups_buffers():
+    import jax.numpy as jnp
+
+    marker = jnp.full((17, 23), 1.5)
+    census = live_array_census(top=1000)
+    assert census['supported'] is True
+    assert census['n_arrays'] >= 1
+    assert census['total_bytes'] > 0
+    match = [g for g in census['top'] if g['shape'] == [17, 23]]
+    assert match and match[0]['total_bytes'] >= marker.nbytes
+
+
+def test_span_memory_hook_graceful_on_cpu(tmp_path):
+    with RunLog(str(tmp_path)):
+        with span('obsrt/memspan') as sp:
+            assert sp.memory() is sp
+    events = [
+        json.loads(line)
+        for line in open(tmp_path / 'obs.jsonl', encoding='utf-8')
+    ]
+    close = next(
+        e for e in events
+        if e['event'] == 'span_close' and e['name'] == 'obsrt/memspan'
+    )
+    # no stats on CPU: the span closes clean, without memory attributes
+    assert 'mem_bytes_in_use' not in close['attrs']
+    assert REGISTRY.snapshot().get('mem/span_peak_bytes') is None
+
+
+# -- registry preserve (the zeroed-husk fix, pinned in test_obs too) -------
+
+
+def test_bench_summary_gauges_survive_cold_path_resets():
+    """The bench usage shape: preserved summary gauges survive the cold
+    path's in-place resets while everything else zeroes."""
+    from socceraction_tpu.obs.metrics import MetricRegistry
+
+    reg = MetricRegistry()
+    reg.gauge('bench/rate_actions_per_sec', unit='actions/s').set(5.0, path='fused')
+    reg.histogram('pipeline/stage_seconds', unit='s').observe(1.0, stage='read')
+    reg.preserve('bench/')
+    reg.reset()  # a rated_pass boundary
+    snap = reg.snapshot()
+    assert snap.value('bench/rate_actions_per_sec', 'last', path='fused') == 5.0
+    assert snap.value('pipeline/stage_seconds', stage='read') == 0.0
+
+
+# -- the flight recorder ---------------------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record('probe', i=i)
+    events = rec.events()
+    assert len(events) == 4 and rec.dropped == 6
+    assert [e['i'] for e in events] == [6, 7, 8, 9]  # most recent survive
+    rec.clear()
+    assert len(rec) == 0 and rec.dropped == 0
+
+
+def test_spans_feed_the_process_recorder():
+    before = len(RECORDER)
+    with span('obsrt/ringfeed'):
+        pass
+    events = RECORDER.events()
+    assert len(events) > before or RECORDER.dropped
+    assert any(
+        e['kind'] == 'span_close' and e.get('name') == 'obsrt/ringfeed'
+        for e in events
+    )
+
+
+def test_dump_debug_bundle_roundtrips_through_obsctl(tmp_path, capsys):
+    with span('obsrt/predump'):
+        pass
+    path = dump_debug_bundle(
+        str(tmp_path),
+        reason='manual',
+        trigger={'type': 'unit_test', 'queue_state': {'queue_depth': 3}},
+    )
+    assert os.path.isfile(path)
+    with tarfile.open(path) as tar:
+        names = sorted(tar.getnames())
+        assert names == [
+            'manifest.json', 'memory.json', 'metrics.json', 'ring.jsonl'
+        ]
+        manifest = json.load(tar.extractfile('manifest.json'))
+        assert manifest['reason'] == 'manual'
+        assert manifest['trigger']['queue_state']['queue_depth'] == 3
+        memory = json.load(tar.extractfile('memory.json'))
+        assert memory['supported'] is True  # jax loaded (census works)
+
+    obsctl = _obsctl()
+    assert obsctl.main(['bundle', str(tmp_path), '--json']) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out['reason'] == 'manual'
+    assert out['trigger']['type'] == 'unit_test'
+    assert 'span_close' in out['ring_kinds']
+
+
+def test_obs_runtime_layer_is_jax_free():
+    """The observatory/memory/recorder modules import, run and DUMP in a
+    process where jax cannot be imported (a crashing jax-free feed
+    worker must still produce a bundle)."""
+    code = (
+        'import builtins, sys\n'
+        'real = builtins.__import__\n'
+        'def blocker(name, *a, **k):\n'
+        "    if name == 'jax' or name.startswith('jax.'):\n"
+        "        raise ImportError('jax is blocked in this process')\n"
+        '    return real(name, *a, **k)\n'
+        'builtins.__import__ = blocker\n'
+        'import tempfile, tarfile, json\n'
+        'from socceraction_tpu.obs.memory import (\n'
+        '    device_memory_stats, live_array_census, sample_device_memory)\n'
+        'from socceraction_tpu.obs.recorder import RECORDER, dump_debug_bundle\n'
+        'from socceraction_tpu.obs import span\n'
+        'assert device_memory_stats() is None\n'
+        'assert sample_device_memory() == {}\n'
+        "assert live_array_census() == {'supported': False}\n"
+        "with span('probe/region'):\n"
+        '    pass\n'
+        "p = dump_debug_bundle(tempfile.mkdtemp(), reason='manual')\n"
+        'with tarfile.open(p) as t:\n'
+        "    mem = json.load(t.extractfile('memory.json'))\n"
+        "assert mem['supported'] is False\n"
+        "assert 'jax' not in sys.modules\n"
+    )
+    env = dict(os.environ, PYTHONPATH=_ROOT)
+    subprocess.run([sys.executable, '-c', code], check=True, env=env)
+
+
+# -- the serving integration: ladder silence, health, auto-dumps -----------
+
+
+def _fit_model():
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.vaep.base import VAEP
+
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=240)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': HOME})
+    X = model.compute_features(game, frame)
+    y = model.compute_labels(game, frame)
+    np.random.seed(0)
+    model.fit(X, y, learner='mlp', tree_params={'hidden': (16,), 'max_epochs': 2})
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+@pytest.fixture()
+def frame():
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+
+    return synthetic_actions_frame(game_id=7, seed=7, n_actions=90)
+
+
+def test_serve_ladder_warmup_compiles_once_and_stays_silent(model, frame):
+    """The acceptance pin: the full ladder warmup records exactly one
+    pair-path compile per rung, trips NO retrace storm, and steady
+    traffic afterwards compiles nothing."""
+    from socceraction_tpu.serve import RatingService
+
+    compiles0 = _xla_value('xla/compiles', fn='pair_probs')
+    storms0 = _xla_value('xla/retrace_storm', fn='pair_probs')
+    with RatingService(
+        model, max_actions=160, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        warmed = svc.warmup()
+        assert len(warmed) == len(svc.ladder)
+        after_warmup = _xla_value('xla/compiles', fn='pair_probs')
+        assert after_warmup - compiles0 == len(svc.ladder)
+        for _ in range(3):
+            svc.rate(frame, home_team_id=HOME).result(timeout=60)
+        assert _xla_value('xla/compiles', fn='pair_probs') == after_warmup
+    assert _xla_value('xla/retrace_storm', fn='pair_probs') == storms0
+
+
+def test_health_reports_queue_model_and_slo(model, frame):
+    from socceraction_tpu.serve import RatingService
+
+    with RatingService(
+        model, max_actions=160, max_batch_size=4, max_wait_ms=1.0,
+        slo_p99_ms=60_000.0,
+    ) as svc:
+        svc.warmup()
+        svc.rate(frame, home_team_id=HOME).result(timeout=60)
+        h = svc.health()
+    assert h['status'] == 'ok' and h['flusher_alive'] is True
+    assert h['queue_depth'] == 0 and h['max_queue'] >= 4
+    assert h['last_flush_age_s'] is not None and h['last_flush_age_s'] >= 0
+    assert h['model'] == {'name': 'default', 'version': '0'}
+    assert h['compiled_shapes'] == len(h['ladder'])
+    assert h['slo']['budget_p99_ms'] == 60_000.0
+    assert h['slo']['request_p99_ms'] > 0 and h['slo']['ok'] is True
+    assert h['uptime_s'] > 0 and h['last_dump'] is None
+
+
+def test_flusher_death_fails_fast_dumps_and_degrades_health(
+    model, frame, tmp_path, monkeypatch, capsys
+):
+    """The injected-crash acceptance path: the flusher dies, queued
+    futures fail instead of hanging, new submits are rejected, health
+    flips to flusher-dead, and the auto-dumped bundle replays through
+    obsctl showing the trigger and the queue state."""
+    from socceraction_tpu.serve import RatingService
+
+    with RatingService(
+        model, max_actions=160, max_batch_size=4, max_wait_ms=50.0,
+        debug_dir=str(tmp_path), dump_interval_s=0.0,
+    ) as svc:
+        monkeypatch.setattr(
+            svc._batcher, '_take',
+            lambda: (_ for _ in ()).throw(RuntimeError('injected death')),
+        )
+        fut = svc.rate(frame, home_team_id=HOME)
+        with pytest.raises(RuntimeError, match='flusher thread died'):
+            fut.result(timeout=30)
+        deadline = time.monotonic() + 10.0
+        while svc.last_dump_path is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.last_dump_path is not None
+
+        h = svc.health()
+        assert h['status'] == 'flusher-dead'
+        assert 'injected death' in h['flusher_error']
+        assert h['last_dump'] == svc.last_dump_path
+        with pytest.raises(RuntimeError, match='flusher thread died'):
+            svc.rate(frame, home_team_id=HOME)
+
+        obsctl = _obsctl()
+        assert obsctl.main(['bundle', svc.last_dump_path, '--json']) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out['reason'] == 'flusher_crash'
+        assert out['trigger']['type'] == 'flusher_crash'
+        assert 'injected death' in out['trigger']['error']
+        assert out['trigger']['queue_state']['flusher_alive'] is False
+        assert out['trigger']['queue_state']['queue_depth'] == 0  # drained
+
+
+def test_overload_burst_triggers_one_dump(model, frame, tmp_path):
+    from socceraction_tpu.serve import Overloaded, RatingService
+
+    release = threading.Event()
+    with RatingService(
+        model, max_actions=160, max_batch_size=1, max_wait_ms=0.1,
+        max_queue=1, debug_dir=str(tmp_path), dump_interval_s=0.0,
+        overload_dump_threshold=3, overload_dump_window_s=30.0,
+    ) as svc:
+        real_runner = svc._batcher._runner
+        svc._batcher._runner = lambda payloads, bucket: (
+            release.wait(timeout=30) and None or real_runner(payloads, bucket)
+        )
+        futs = [svc.rate(frame, home_team_id=HOME)]  # occupies the flusher
+        rejections = 0
+        deadline = time.monotonic() + 20.0
+        while rejections < 3 and time.monotonic() < deadline:
+            try:
+                futs.append(svc.rate(frame, home_team_id=HOME))
+            except Overloaded:
+                rejections += 1
+        assert rejections >= 3
+        assert svc.last_dump_path is not None
+        with tarfile.open(svc.last_dump_path) as tar:
+            manifest = json.load(tar.extractfile('manifest.json'))
+        assert manifest['reason'] == 'overload'
+        assert manifest['trigger']['rejections_in_window'] >= 3
+        release.set()
+        for f in futs:
+            f.result(timeout=60)
+
+
+def test_swap_failure_dumps_a_bundle(model, tmp_path):
+    from socceraction_tpu.serve import ModelRegistry, RatingService
+
+    registry = ModelRegistry(str(tmp_path / 'models'))
+    registry.publish('vaep', '1', model)
+    registry.activate('vaep', '1')
+    with RatingService(
+        registry=registry, max_actions=160, max_batch_size=2,
+        debug_dir=str(tmp_path / 'dumps'), dump_interval_s=0.0,
+    ) as svc:
+        with pytest.raises(FileNotFoundError):
+            svc.swap_model('vaep', '99')
+        assert svc.last_dump_path is not None
+        with tarfile.open(svc.last_dump_path) as tar:
+            manifest = json.load(tar.extractfile('manifest.json'))
+        assert manifest['reason'] == 'swap_failure'
+        assert manifest['trigger']['target'] == 'vaep/99'
+        assert svc.health()['status'] == 'ok'  # serving is unaffected
+
+
+def test_two_epoch_fused_train_compiles_once_and_no_storm():
+    """The acceptance pin's training half: a two-epoch fused train run
+    records exactly ONE epoch-function compile in the observatory (one
+    signature, reused every epoch) and trips no retrace storm."""
+    import jax.numpy as jnp
+
+    from socceraction_tpu.core.synthetic import synthetic_batch
+    from socceraction_tpu.ml.mlp import MLPClassifier
+    from socceraction_tpu.ops.labels import scores_concedes
+
+    names = ('actiontype_onehot', 'result_onehot', 'startlocation', 'movement')
+    batch = synthetic_batch(n_games=2, n_actions=128, seed=5)
+    ys, _yc = scores_concedes(batch)
+    compiles0 = _xla_value('xla/compiles', fn='train_epoch')
+    storms0 = _xla_value('xla/retrace_storm', fn='train_epoch')
+    clf = MLPClassifier(hidden=(8,), batch_size=64, max_epochs=2, seed=0)
+    clf.fit_packed(batch, jnp.asarray(ys).reshape(-1), names=names, k=2)
+    assert clf.n_epoch_traces_ == 1  # the trace-time ground truth
+    # ... and the observatory agrees: one compile, reused by epoch 2
+    assert _xla_value('xla/compiles', fn='train_epoch') == compiles0 + 1
+    assert _xla_value('xla/retrace_storm', fn='train_epoch') == storms0
+
+
+# -- profile_trace registers with the run log ------------------------------
+
+
+def test_profile_trace_records_a_span(tmp_path, monkeypatch):
+    import jax
+
+    from socceraction_tpu.utils.profiling import profile_trace
+
+    monkeypatch.setattr(jax.profiler, 'start_trace', lambda *a, **k: None)
+    monkeypatch.setattr(jax.profiler, 'stop_trace', lambda: None)
+    with RunLog(str(tmp_path)):
+        with profile_trace('/tmp/trace-out'):
+            pass
+        with profile_trace('/tmp/other', enabled=False):
+            pass  # disabled: no span either
+    events = [
+        json.loads(line)
+        for line in open(tmp_path / 'obs.jsonl', encoding='utf-8')
+    ]
+    traces = [
+        e for e in events
+        if e['event'] == 'span_close' and e['name'] == 'xla/profile_trace'
+    ]
+    assert len(traces) == 1
+    assert traces[0]['attrs']['log_dir'] == '/tmp/trace-out'
+
+
+# -- the obsctl CLI over run logs ------------------------------------------
+
+
+def _obsctl():
+    spec = importlib.util.spec_from_file_location(
+        'obsctl', os.path.join(_ROOT, 'tools', 'obsctl.py')
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obsctl_snapshot_tail_and_prom_over_a_runlog(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    f = instrument_jit(lambda x: x + 1, 'obsrt_ctl')
+    with RunLog(str(tmp_path)):
+        f(jnp.ones((2,)))
+        with span('obsrt/ctlspan'):
+            pass
+    log = str(tmp_path / 'obs.jsonl')
+    obsctl = _obsctl()
+
+    assert obsctl.main(['snapshot', log, '--json']) == 0
+    snapshot = json.loads(capsys.readouterr().out)
+    assert snapshot['xla/compiles']['kind'] == 'counter'
+
+    assert obsctl.main(['tail', log, '-n', '100']) == 0
+    out = capsys.readouterr().out
+    assert 'obsrt/ctlspan' in out and 'jit_compile' in out
+
+    assert obsctl.main(['prom', log]) == 0
+    prom = capsys.readouterr().out
+    assert 'xla_compiles_total{fn="obsrt_ctl"}' in prom
+
+    # a log without a metrics event is a clean, nonzero failure
+    empty = tmp_path / 'empty.jsonl'
+    empty.write_text('')
+    assert obsctl.main(['snapshot', str(empty)]) == 1

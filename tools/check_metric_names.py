@@ -10,9 +10,19 @@ the convention (lowercase ``area/stage`` segments,
 literal ``unit=`` values anywhere in the tree fails as a unit conflict —
 the ``record_value``-gauge-under-seconds-keys bug, caught before runtime.
 
-Dynamic names (f-strings, variables) are out of scope by design: the
-convention applies to the literal registration sites, and the runtime
-guard still covers the rest.
+Two cardinality rules ride along (the Prometheus-sanity gate):
+
+- **metric names are exactly ``area/stage``** — a third segment is
+  almost always a dimension smuggled into the name (a function name, a
+  bucket size) that belongs in a *label*; per-function metrics like the
+  compile observatory's must be ``xla/compiles{fn=...}``, never
+  ``xla/compiles/my_fn``.
+- **no f-string metric names** — ``counter(f'xla/{fn}')`` mints one
+  metric per value and defeats both this gate and Prometheus grouping;
+  the varying part must be a label. (Span names may stay dynamic:
+  they are run-log events, not exposition series.) Other dynamic names
+  (plain variables) remain out of scope: the convention applies to the
+  literal registration sites, and the runtime guard covers the rest.
 
 Usage: ``python tools/check_metric_names.py [paths...]`` (defaults to
 the package plus the repo-root scripts, benchmarks, examples and the
@@ -48,11 +58,13 @@ NAME_TAKING_CALLS = {
 #: tests' scratch files — checks convention and units only.
 KNOWN_AREAS = {
     'bench',  # bench.py headline gauges
+    'mem',  # device-memory accounting (obs/memory.py)
     'pipeline',  # store/feed/cache stage timings
     'serve',  # online rating service (batcher/session/registry/service)
     'train',  # MLP fit loop + bench training configs
     'vaep',  # rate_batch instrumentation
     'walkthrough',  # narrative-doc demo spans
+    'xla',  # compile observatory + profiler traces (obs/xla.py)
     'xt',  # expected-threat fit metrics
 }
 
@@ -100,12 +112,14 @@ def _call_name(func: ast.AST) -> Optional[str]:
 
 def collect_names(
     tree: ast.Module, path: str
-) -> Iterator[Tuple[str, str, int, Optional[str]]]:
-    """Yield ``(call, name, lineno, unit_literal_or_None)`` per literal site.
+) -> Iterator[Tuple[str, Optional[str], int, Optional[str]]]:
+    """Yield ``(call, name, lineno, unit_literal_or_None)`` per name site.
 
-    Span names carry no unit (``None`` sentinel distinct from a metric's
-    implicit default) so a span and a metric may share an area prefix
-    without tripping the unit-conflict rule.
+    ``name`` is None for an f-string first argument (a dynamic-name
+    site the cardinality rule rejects for metric calls). Span names
+    carry no unit (``None`` sentinel distinct from a metric's implicit
+    default) so a span and a metric may share an area prefix without
+    tripping the unit-conflict rule.
     """
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -114,6 +128,9 @@ def collect_names(
         if call not in NAME_TAKING_CALLS or not node.args:
             continue
         first = node.args[0]
+        if isinstance(first, ast.JoinedStr):
+            yield call, None, node.lineno, None
+            continue
         if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
             continue
         unit: Optional[str] = DEFAULT_UNITS.get(call)
@@ -153,10 +170,25 @@ def check_files(
         for call, name, lineno, unit in collect_names(tree, path):
             n_sites += 1
             site = f'{path}:{lineno}'
+            if name is None:  # f-string first argument
+                if call != 'span':
+                    problems.append(
+                        f"{site}: {call}(f'...') mints a metric name per "
+                        'value — make the varying part a label on a fixed '
+                        'area/stage name (Prometheus cardinality)'
+                    )
+                continue
             if not NAME_RE.match(name):
                 problems.append(
                     f'{site}: {call}({name!r}) violates the area/stage '
                     "naming convention (lowercase segments joined by '/')"
+                )
+                continue
+            if name.count('/') > 1:
+                problems.append(
+                    f'{site}: {call}({name!r}) nests deeper than '
+                    'area/stage — a per-function (or per-anything) '
+                    'dimension must be a label, not a name suffix'
                 )
                 continue
             if areas is not None and name.split('/')[0] not in areas:

@@ -29,9 +29,24 @@ from typing import Dict, Iterator, List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = 'socceraction_tpu'
 
+#: operator-facing tool modules documented alongside the package (the
+#: rest of tools/ is build machinery, not API surface)
+EXTRA_MODULES = (('tools.obsctl', os.path.join('tools', 'obsctl.py')),)
+
 
 def iter_modules(root: str) -> Iterator[Tuple[str, str]]:
-    """Yield ``(dotted_name, path)`` for every public module, sorted."""
+    """Yield ``(dotted_name, path)`` for every public module, sorted.
+
+    Package modules first, then the :data:`EXTRA_MODULES` tool pages.
+    """
+    yield from _iter_package_modules(root)
+    for dotted, rel in EXTRA_MODULES:
+        path = os.path.join(root, rel)
+        if os.path.isfile(path):  # absent in stub trees (the gate tests)
+            yield dotted, path
+
+
+def _iter_package_modules(root: str) -> Iterator[Tuple[str, str]]:
     out = []
     for dirpath, dirnames, filenames in os.walk(os.path.join(root, PACKAGE)):
         dirnames[:] = sorted(d for d in dirnames if not d.startswith('_') and d != '__pycache__')

@@ -1,0 +1,302 @@
+"""Operator CLI over the observability layer: RunLogs, bundles, Prometheus.
+
+The obs subsystem writes three artifact kinds an operator needs to read
+under pressure — ``obs.jsonl`` run logs, flight-recorder debug bundles
+(``debug-*.tar.gz``) and registry snapshots — and until now all of them
+required writing Python. ``obsctl`` is the no-Python surface::
+
+    python tools/obsctl.py snapshot              # this process's registry
+    python tools/obsctl.py snapshot obs.jsonl    # last embedded snapshot
+    python tools/obsctl.py tail obs.jsonl -n 30  # recent events, readable
+    python tools/obsctl.py prom obs.jsonl        # Prometheus text
+    python tools/obsctl.py bundle /tmp/socceraction-tpu-debug  # post-mortem
+
+``snapshot``/``tail``/``bundle`` accept ``--json`` for machine-readable
+output (``prom`` *is* a machine format already); the default rendering
+is a compact human table. ``bundle`` accepts either a bundle file or a
+directory (the newest ``debug-*.tar.gz`` by mtime wins) and
+prints the manifest's trigger (what fired the dump), the queue state at
+dump time and the tail of the event ring.
+
+``prom`` over a run log re-renders the log's last *compact* snapshot
+(no per-bucket rows survive embedding), so histograms are exposed in
+summary form: ``_sum``/``_count`` plus ``{quantile=...}`` estimate rows.
+A live registry (no argument) uses the full text exposition.
+
+The ``snapshot`` form with no argument doubles as the obs smoke test in
+``make lint``: it imports the whole obs surface in a jax-free process
+and must exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tarfile
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+__all__ = ['main']
+
+
+def _read_events(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path, encoding='utf-8') as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # a torn tail line in a live log is expected
+    return events
+
+
+def _last_snapshot(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    for event in reversed(events):
+        if event.get('event') == 'metrics':
+            return event.get('metrics')
+    return None
+
+
+def _fmt_ts(ts: Any) -> str:
+    try:
+        return time.strftime('%H:%M:%S', time.localtime(float(ts)))
+    except (TypeError, ValueError):
+        return '?'
+
+
+def _print_snapshot(snapshot: Dict[str, Any], as_json: bool) -> None:
+    if as_json:
+        print(json.dumps(snapshot, sort_keys=True))
+        return
+    rows = []
+    for name, inst in sorted(snapshot.items()):
+        series = inst.get('series', [])
+        total = sum(s.get('total') or 0.0 for s in series)
+        rows.append(
+            (name, inst.get('kind', '?'), inst.get('unit', '?'),
+             str(len(series)), f'{total:g}')
+        )
+    if rows:
+        widths = [max(len(r[i]) for r in rows) for i in range(5)]
+        header = ('name', 'kind', 'unit', 'series', 'total')
+        widths = [max(w, len(h)) for w, h in zip(widths, header)]
+        for r in (header,) + tuple(rows):
+            print('  '.join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    print(f'obsctl snapshot: {len(rows)} instrument(s)')
+
+
+def _cmd_snapshot(args: argparse.Namespace) -> int:
+    """``snapshot [runlog]``: print a typed registry snapshot."""
+    if args.runlog:
+        snapshot = _last_snapshot(_read_events(args.runlog))
+        if snapshot is None:
+            print(f'obsctl: no metrics event in {args.runlog}', file=sys.stderr)
+            return 1
+    else:
+        from socceraction_tpu.obs import REGISTRY, snapshot_dict
+
+        snapshot = snapshot_dict(REGISTRY.snapshot(), buckets=False)
+    _print_snapshot(snapshot, args.json)
+    return 0
+
+
+def _prom_from_dict(snapshot: Dict[str, Any]) -> str:
+    """Prometheus text from a *compact* snapshot dict (no bucket rows)."""
+    from socceraction_tpu.obs.export import _prom_labels, _prom_name
+
+    lines: List[str] = []
+    for name, inst in sorted(snapshot.items()):
+        kind, unit = inst.get('kind', 'gauge'), inst.get('unit', '')
+        pname = _prom_name(name, unit, kind)
+        lines.append(f'# HELP {pname} {name} ({unit})')
+        lines.append(
+            f'# TYPE {pname} '
+            + ('summary' if kind == 'histogram' else kind)
+        )
+        for s in inst.get('series', []):
+            labels = s.get('labels', {})
+            rendered = _prom_labels(labels)
+            if kind == 'histogram':
+                for q, value in sorted((s.get('quantiles') or {}).items()):
+                    qv = q.lstrip('p')
+                    lines.append(
+                        pname
+                        + _prom_labels(labels, f'quantile="0.{qv}"')
+                        + f' {value!r}'
+                    )
+                lines.append(f'{pname}_sum{rendered} {s.get("total", 0.0)!r}')
+                lines.append(f'{pname}_count{rendered} {s.get("count", 0)}')
+            elif kind == 'counter':
+                lines.append(f'{pname}{rendered} {float(s.get("total", 0.0))!r}')
+            else:
+                value = s.get('last')
+                lines.append(f'{pname}{rendered} {float(value or 0.0)!r}')
+    return '\n'.join(lines) + '\n'
+
+
+def _cmd_prom(args: argparse.Namespace) -> int:
+    """``prom [runlog]``: Prometheus text exposition."""
+    if args.runlog:
+        snapshot = _last_snapshot(_read_events(args.runlog))
+        if snapshot is None:
+            print(f'obsctl: no metrics event in {args.runlog}', file=sys.stderr)
+            return 1
+        sys.stdout.write(_prom_from_dict(snapshot))
+        return 0
+    from socceraction_tpu.obs import REGISTRY, prometheus_text
+
+    sys.stdout.write(prometheus_text(REGISTRY.snapshot()))
+    return 0
+
+
+def _fmt_event(event: Dict[str, Any]) -> str:
+    kind = event.get('event') or event.get('kind') or '?'
+    parts = [_fmt_ts(event.get('ts')), kind.ljust(14)]
+    name = event.get('name') or event.get('fn')
+    if name:
+        parts.append(str(name))
+    if 'duration_s' in event:
+        parts.append(f'{event["duration_s"] * 1e3:.2f}ms')
+    if 'compile_s' in event:
+        parts.append(f'compile {event["compile_s"] * 1e3:.1f}ms')
+    status = event.get('status')
+    if status and status != 'ok':
+        parts.append(f'status={status} error={event.get("error")}')
+    if kind == 'retrace_storm':
+        parts.append(json.dumps(event.get('signature_diff')))
+    if kind in ('serve_queue', 'flusher_crash'):
+        parts.append(f'queue_depth={event.get("queue_depth")}')
+    if kind == 'debug_bundle':
+        parts.append(f'{event.get("reason")} -> {event.get("path")}')
+    return '  '.join(parts)
+
+
+def _cmd_tail(args: argparse.Namespace) -> int:
+    """``tail <runlog> [-n N]``: the run log's most recent events."""
+    events = _read_events(args.runlog)[-args.n :]
+    if args.json:
+        for event in events:
+            print(json.dumps(event, sort_keys=True))
+        return 0
+    for event in events:
+        print(_fmt_event(event))
+    print(f'obsctl tail: {len(events)} event(s) from {args.runlog}')
+    return 0
+
+
+def _resolve_bundle(path: str) -> Optional[str]:
+    if os.path.isdir(path):
+        # newest by mtime: filenames start with the writing PID, so a
+        # lexicographic sort would order by process id, not by time
+        found = sorted(
+            glob.glob(os.path.join(path, 'debug-*.tar.gz')),
+            key=os.path.getmtime,
+        )
+        return found[-1] if found else None
+    return path if os.path.isfile(path) else None
+
+
+def _cmd_bundle(args: argparse.Namespace) -> int:
+    """``bundle <path>``: unpack and summarize a debug bundle."""
+    bundle = _resolve_bundle(args.path)
+    if bundle is None:
+        print(f'obsctl: no debug bundle at {args.path}', file=sys.stderr)
+        return 1
+    with tarfile.open(bundle) as tar:
+
+        def load(name: str, jsonl: bool = False) -> Any:
+            try:
+                raw = tar.extractfile(name).read().decode('utf-8')
+            except (KeyError, AttributeError):
+                return [] if jsonl else {}
+            if jsonl:
+                return [json.loads(l) for l in raw.splitlines() if l.strip()]
+            return json.loads(raw)
+
+        manifest = load('manifest.json')
+        ring = load('ring.jsonl', jsonl=True)
+        metrics = load('metrics.json')
+        memory = load('memory.json')
+    trigger = manifest.get('trigger') or {}
+    summary = {
+        'bundle': bundle,
+        'reason': manifest.get('reason'),
+        'trigger': trigger,
+        'host': manifest.get('host'),
+        'pid': manifest.get('pid'),
+        'device': manifest.get('device'),
+        'ring_events': len(ring),
+        'ring_kinds': sorted({e.get('kind', '?') for e in ring}),
+        'metrics': len(metrics),
+        'memory_supported': memory.get('supported'),
+    }
+    if args.json:
+        summary['ring_tail'] = ring[-args.n :]
+        print(json.dumps(summary, sort_keys=True, default=str))
+        return 0
+    print(f'bundle : {bundle}')
+    print(f'reason : {summary["reason"]}')
+    print(f'trigger: {json.dumps(trigger, sort_keys=True, default=str)}')
+    print(f'host   : {summary["host"]} (pid {summary["pid"]})')
+    if summary['device']:
+        print(f'device : {json.dumps(summary["device"], default=str)}')
+    print(
+        f'ring   : {len(ring)} event(s), kinds: '
+        + ', '.join(summary['ring_kinds'])
+    )
+    print(f'metrics: {len(metrics)} instrument(s); memory supported: '
+          f'{summary["memory_supported"]}')
+    for event in ring[-args.n :]:
+        print('  ' + _fmt_event(event))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Parse ``obsctl`` arguments and dispatch to a subcommand.
+
+    Returns a process exit code (0 success, 1 missing/invalid input);
+    argparse handles usage errors with its own exit(2).
+    """
+    parser = argparse.ArgumentParser(
+        prog='obsctl', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    p = sub.add_parser('snapshot', help='print a typed registry snapshot')
+    p.add_argument('runlog', nargs='?', help='obs.jsonl to read (default: this process)')
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_snapshot)
+
+    p = sub.add_parser('prom', help='Prometheus text exposition')
+    p.add_argument('runlog', nargs='?', help='obs.jsonl to read (default: this process)')
+    p.set_defaults(fn=_cmd_prom)
+
+    p = sub.add_parser('tail', help='recent run-log events, human-readable')
+    p.add_argument('runlog')
+    p.add_argument('-n', type=int, default=20)
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_tail)
+
+    p = sub.add_parser('bundle', help='summarize a flight-recorder bundle')
+    p.add_argument('path', help='bundle file or directory of bundles')
+    p.add_argument('-n', type=int, default=10, help='ring-tail events shown')
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_bundle)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
