@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke obs-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke learn-smoke obs-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -52,6 +52,13 @@ bench:
 bench-smoke:
 	$(PY) bench.py --train-smoke
 	$(PY) bench.py --serve-smoke
+
+# one abbreviated continuous-learning loop iteration on CPU: land new
+# matches -> incremental ingest -> warm-started fit_packed -> shadow
+# replay -> calibration gate -> registry publish, with the per-stage
+# wall breakdown asserted from the typed learn/* snapshot
+learn-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --learn-smoke
 
 # regenerate the committed executed-walkthrough outputs (the repo's
 # analog of the reference's executed notebook cells; drift-checked by

@@ -425,7 +425,141 @@ def _bench_extra_configs() -> dict:
 
     serve_s = float(os.environ.get('SOCCERACTION_TPU_BENCH_SERVE_SECONDS', 8))
     out['serve_throughput'] = _bench_serve_throughput(duration_s=serve_s)
+
+    learn_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_LEARN_GAMES', 24))
+    out['continuous_learning'] = _bench_continuous_learning(games=learn_games)
     return out
+
+
+def _bench_continuous_learning(
+    *,
+    games: int = 24,
+    new_games: int = 4,
+    n_actions: int = 512,
+    max_epochs: int = 2,
+) -> dict:
+    """One full continuous-learning iteration, timed per stage.
+
+    Builds a synthetic season store + registry in a temp dir, bootstraps
+    the first model version, lands ``new_games`` fresh matches and runs
+    one complete loop iteration (incremental ingest → warm-started
+    ``fit_packed`` → shadow replay → calibration gate → publish/swap).
+    Stage walls (ingest/train/shadow/gate/publish) come from the typed
+    ``learn/stage_seconds`` snapshot — the same numbers the runtime
+    reports — plus the loop's verdict and replay size, so a regression
+    in any stage of the loop shows up in the artifact, not just in CI.
+    """
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from socceraction_tpu.core.synthetic import (
+        append_synthetic_games,
+        write_synthetic_season,
+    )
+    from socceraction_tpu.learn import ContinuousLearner, GateConfig, LearnConfig
+    from socceraction_tpu.obs import REGISTRY
+    from socceraction_tpu.pipeline.store import SeasonStore
+    from socceraction_tpu.serve import ModelRegistry
+
+    tmp = _tempfile.mkdtemp(prefix='socceraction-tpu-learn-bench-')
+    try:
+        store_path = os.path.join(tmp, 'season')
+        write_synthetic_season(store_path, n_games=games, n_actions=n_actions)
+        registry = ModelRegistry(os.path.join(tmp, 'registry'))
+        config = LearnConfig(
+            max_actions=n_actions,
+            games_per_batch=min(8, games),
+            train_params={
+                'hidden': (64, 64),
+                'max_epochs': max_epochs,
+                'batch_size': 4096,
+            },
+            gate=GateConfig(
+                n_boot=64,
+                # bench bands are wide: this config measures stage cost,
+                # not model quality (2-epoch fits on synthetic data jitter)
+                max_ece_regression=0.05,
+                max_brier_regression=0.02,
+            ),
+            fallback_replay_games=min(8, games),
+            random_state=0,
+            debug_dir=os.path.join(tmp, 'debug'),
+        )
+        with SeasonStore(store_path, mode='a') as store:
+            learner = ContinuousLearner(store, registry, config=config)
+            bootstrap = learner.run_once()
+            landed = append_synthetic_games(
+                store_path, new_games, n_actions=n_actions, seed=games + 1
+            )
+            t0 = time.perf_counter()
+            report = learner.run_once()
+            loop_wall = time.perf_counter() - t0
+
+        snap = REGISTRY.snapshot()
+        stages = {}
+        inst = snap.get('learn/stage_seconds')
+        for s in inst.series if inst is not None else ():
+            stage = s.labels.get('stage')
+            # only stages the TIMED iteration actually ran: the bootstrap
+            # recorded the same series, and e.g. its 'publish' wall must
+            # not be attributed to a gate-rejected second iteration
+            if stage and stage in report.stage_seconds:
+                stages[stage] = round(s.last, 4)
+        return {
+            'games': games,
+            'new_games': len(landed),
+            'n_actions': n_actions,
+            'max_epochs': max_epochs,
+            'bootstrap_verdict': bootstrap.verdict,
+            'verdict': report.verdict,
+            'published_version': report.candidate_version,
+            'replay': dict(report.replay),
+            'loop_seconds': round(loop_wall, 4),
+            'stage_seconds': stages,
+        }
+    finally:
+        _shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _learn_smoke() -> None:
+    """``make learn-smoke``: one abbreviated loop iteration on CPU.
+
+    Drives the whole continuous-learning control loop — incremental
+    ingest, warm-started packed training, shadow replay, calibration
+    gate, registry publish — at smoke scale, so a broken stage fails
+    fast and locally. Same clean-CPU re-exec recipe as
+    :func:`_train_smoke`.
+    """
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    axon_disabled = os.environ.get('PALLAS_AXON_POOL_IPS', 'unset') == ''
+    if not (platforms == 'cpu' and axon_disabled):
+        here = os.path.dirname(os.path.abspath(__file__))
+        rc = subprocess.call(
+            [sys.executable, os.path.join(here, 'bench.py'), '--learn-smoke'],
+            env=_cpu_env(),
+            cwd=here,
+        )
+        sys.exit(rc)
+    games = int(os.environ.get('SOCCERACTION_TPU_BENCH_LEARN_GAMES', 8))
+    out = _bench_continuous_learning(games=games, n_actions=256, max_epochs=1)
+    # the loop must complete with a real verdict and a per-stage
+    # breakdown covering every stage it ran
+    assert out['bootstrap_verdict'] == 'promoted', out
+    assert out['verdict'] in ('promoted', 'rejected'), out
+    missing = {'ingest', 'train', 'shadow', 'gate'} - set(out['stage_seconds'])
+    assert not missing, f'stages missing from the typed snapshot: {missing}'
+    print(
+        json.dumps(
+            {
+                'metric': 'continuous_learning_loop_seconds',
+                'value': out['loop_seconds'],
+                'unit': 'seconds',
+                'platform': 'cpu',
+                'smoke': True,
+                **out,
+            }
+        )
+    )
 
 
 def _chained_latency(n_steps: int) -> float:
@@ -1278,6 +1412,9 @@ def main() -> None:
         return
     if '--serve-smoke' in sys.argv:
         _serve_smoke()
+        return
+    if '--learn-smoke' in sys.argv:
+        _learn_smoke()
         return
     if '--impl' in sys.argv:
         print(json.dumps(bench_impl()))

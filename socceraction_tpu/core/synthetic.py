@@ -7,7 +7,7 @@ benchmarks measure kernel throughput, not host packing.
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict
+from typing import TYPE_CHECKING, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -19,7 +19,11 @@ from ..config import CORNER_PRIOR, PENALTY_PRIOR
 from ..spadl import config as spadlconfig
 from .batch import ActionBatch
 
-__all__ = ['synthetic_batch', 'write_synthetic_season']
+__all__ = [
+    'append_synthetic_games',
+    'synthetic_batch',
+    'write_synthetic_season',
+]
 
 
 def _draw_spadl_columns(
@@ -676,3 +680,54 @@ def write_synthetic_season(
         store.put('players', pd.DataFrame(players))
         store.put('meta', pd.DataFrame({'synthetic': [True]}))
     return path
+
+
+def append_synthetic_games(
+    path: str,
+    n_games: int = 4,
+    *,
+    n_actions: int = 300,
+    seed: int = 0,
+    start_id: Optional[int] = None,
+) -> List[int]:
+    """Land ``n_games`` new synthetic matches in an *existing* store.
+
+    The test/bench stand-in for a live data pipeline delivering played
+    matches: per-game frames come from the learnable chain generator
+    (:func:`synthetic_actions_frame`) and the ``games`` table is extended
+    in place — exactly the append-only mutation the continuous-learning
+    loop (:mod:`socceraction_tpu.learn`) watches for. Returns the new
+    game ids (``start_id`` defaults past the largest stored id).
+    """
+    import pandas as pd
+
+    from ..pipeline.store import SeasonStore
+
+    with SeasonStore(path, mode='a') as store:
+        games = store.games()
+        existing = set(store.game_ids())
+        if start_id is None:
+            numeric = [int(g) for g in existing if str(g).lstrip('-').isdigit()]
+            start_id = max(numeric) + 1 if numeric else 1
+        new_rows = []
+        gid = int(start_id)
+        for j in range(int(n_games)):
+            while gid in existing:
+                gid += 1
+            home = 100 + 2 * (j % 16)
+            away = home + 1
+            frame = synthetic_actions_frame(
+                gid, home_team_id=home, away_team_id=away,
+                n_actions=n_actions, seed=seed + j,
+            )
+            store.put_actions(gid, frame)
+            new_rows.append(
+                {'game_id': gid, 'home_team_id': home, 'away_team_id': away}
+            )
+            existing.add(gid)
+            gid += 1
+        store.put(
+            'games',
+            pd.concat([games, pd.DataFrame(new_rows)], ignore_index=True),
+        )
+    return [r['game_id'] for r in new_rows]

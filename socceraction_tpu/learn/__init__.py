@@ -1,0 +1,60 @@
+"""Continuous learning: stream → incremental train → shadow-eval → hot-swap.
+
+The control loop that keeps a production rating service from going stale
+or regressing silently (ROADMAP item 4) — the first subsystem that
+exercises every prior layer at once:
+
+- :mod:`socceraction_tpu.learn.ingest` — :class:`SeasonWatcher` (which
+  matches are new) and :func:`extend_packed` (O(new matches) incremental
+  packed-cache extension over the existing build machinery).
+- :mod:`socceraction_tpu.learn.calibration` — device calibration
+  metrics: reliability curves, ECE, the Brier decomposition and
+  bootstrap CIs via one ``vmap``'d resample-ensemble dispatch (per
+  arXiv 2409.04889).
+- :mod:`socceraction_tpu.learn.shadow` — bitwise-reproducible replay of
+  captured traffic (:class:`~socceraction_tpu.serve.capture.TrafficCapture`)
+  through candidate vs active model.
+- :mod:`socceraction_tpu.learn.gate` — :class:`GateConfig` calibration
+  bands and the typed :class:`PromotionReport` every decision becomes.
+- :mod:`socceraction_tpu.learn.loop` — :class:`ContinuousLearner`, the
+  orchestrator: warm-started :meth:`VAEP.fit_packed` continuation,
+  staged registry candidates, gated atomic hot-swap, explicit rollback.
+
+Quickstart::
+
+    from socceraction_tpu.learn import ContinuousLearner, LearnConfig
+
+    learner = ContinuousLearner(store, registry, service=service,
+                                config=LearnConfig(max_actions=512))
+    report = learner.run_once()       # ingest -> train -> shadow -> gate
+    if not report.promoted:
+        print(report.reasons)         # and obsctl promotions <runlog>
+    # bad promotion in production? one warm, atomic step back:
+    learner.rollback()
+
+See ``docs/continuous_learning.md`` for the architecture, gate
+configuration and the operational runbook.
+"""
+
+from .calibration import CalibrationSummary, calibration_summary, reliability_curve
+from .gate import GateConfig, PromotionReport, evaluate_gate, record_report
+from .ingest import SeasonWatcher, extend_packed, newest_game_ids
+from .loop import ContinuousLearner, LearnConfig
+from .shadow import ShadowResult, shadow_replay
+
+__all__ = [
+    'CalibrationSummary',
+    'ContinuousLearner',
+    'GateConfig',
+    'LearnConfig',
+    'PromotionReport',
+    'SeasonWatcher',
+    'ShadowResult',
+    'calibration_summary',
+    'evaluate_gate',
+    'extend_packed',
+    'newest_game_ids',
+    'record_report',
+    'reliability_curve',
+    'shadow_replay',
+]

@@ -1,0 +1,223 @@
+"""Device calibration metrics: reliability curves, ECE, Brier, bootstrap CIs.
+
+The promotion criterion of the continuous-learning loop follows
+PAPERS.md's *Moving from Machine Learning to Statistics: Expected Points*
+(arXiv 2409.04889): a probability model earns deployment by being
+*calibrated* — its predicted probabilities match observed frequencies —
+not by a marginally lower loss, and every point estimate carries a
+bootstrap uncertainty interval so a gate never acts on noise.
+
+Everything here runs on device as a handful of XLA dispatches over the
+replayed traffic:
+
+- :func:`reliability_curve` — equal-width probability bins with weighted
+  per-bin confidence (mean predicted probability) and accuracy (observed
+  positive rate); the raw curve behind every other metric.
+- :func:`calibration_summary` — one jitted kernel computing the expected
+  calibration error (ECE), the Brier score and its Murphy decomposition
+  (reliability − resolution + uncertainty, binned form), plus bootstrap
+  confidence intervals for ECE and Brier via **one** ``vmap``'d
+  resample-ensemble dispatch: ``n_boot`` row-resamples evaluated as a
+  single batched computation, the way 2409.04889 computes uncertainty
+  bands over expected-points curves.
+
+Weights make padding free: packed batches carry ``(G, A)`` masks, and a
+zero-weight row contributes to no bin, no score and no resample. All
+reductions are deterministic for a fixed input on CPU — the shadow
+evaluation's bitwise-replay contract extends through these metrics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    'CalibrationSummary',
+    'calibration_summary',
+    'reliability_curve',
+]
+
+_EPS = 1e-12
+
+
+def _flatten(probs: Any, labels: Any, weights: Any):
+    p = jnp.asarray(probs, jnp.float32).reshape(-1)
+    y = jnp.asarray(labels, jnp.float32).reshape(-1)
+    if weights is None:
+        w = jnp.ones_like(p)
+    else:
+        w = jnp.asarray(weights, jnp.float32).reshape(-1)
+    if p.shape != y.shape or p.shape != w.shape:
+        raise ValueError(
+            f'probs/labels/weights disagree on shape: {p.shape} vs '
+            f'{y.shape} vs {w.shape}'
+        )
+    return p, y, w
+
+
+def _binned_sums(p, y, w, n_bins: int):
+    """Weighted per-bin (mass, Σw·p, Σw·y) over equal-width bins."""
+    bins = jnp.clip((p * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    seg = partial(jax.ops.segment_sum, segment_ids=bins, num_segments=n_bins)
+    return seg(w), seg(w * p), seg(w * y)
+
+
+def _point_metrics(p, y, w, n_bins: int):
+    """(n, ece, brier, reliability, resolution, uncertainty) — one trace."""
+    wsum, psum, ysum = _binned_sums(p, y, w, n_bins)
+    n = jnp.maximum(jnp.sum(w), _EPS)
+    conf = psum / jnp.maximum(wsum, _EPS)
+    acc = ysum / jnp.maximum(wsum, _EPS)
+    ece = jnp.sum(wsum / n * jnp.abs(conf - acc))
+    brier = jnp.sum(w * jnp.square(p - y)) / n
+    base = jnp.sum(w * y) / n
+    reliability = jnp.sum(wsum * jnp.square(conf - acc)) / n
+    resolution = jnp.sum(wsum * jnp.square(acc - base)) / n
+    uncertainty = base * (1.0 - base)
+    return n, ece, brier, reliability, resolution, uncertainty
+
+
+@partial(jax.jit, static_argnames=('n_bins',))
+def _curve_kernel(p, y, w, n_bins: int):
+    wsum, psum, ysum = _binned_sums(p, y, w, n_bins)
+    conf = psum / jnp.maximum(wsum, _EPS)
+    acc = ysum / jnp.maximum(wsum, _EPS)
+    return conf, acc, wsum
+
+
+@partial(jax.jit, static_argnames=('n_bins', 'n_boot'))
+def _summary_kernel(p, y, w, seed, n_bins: int, n_boot: int, ci: float):
+    n, ece, brier, rel, res, unc = _point_metrics(p, y, w, n_bins)
+
+    def one_resample(key):
+        idx = jax.random.randint(key, (p.shape[0],), 0, p.shape[0])
+        _, e, b, _, _, _ = _point_metrics(p[idx], y[idx], w[idx], n_bins)
+        return e, b
+
+    # ONE dispatch for the whole resample ensemble: n_boot row-resamples
+    # of (probs, labels, weights) evaluated as a batched computation
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_boot)
+    eces, briers = jax.vmap(one_resample)(keys)
+    lo = (1.0 - ci) / 2.0
+    q = jnp.asarray([lo, 1.0 - lo], jnp.float32)
+    ece_ci = jnp.quantile(eces, q)
+    brier_ci = jnp.quantile(briers, q)
+    return n, ece, brier, rel, res, unc, ece_ci, brier_ci
+
+
+def reliability_curve(
+    probs: Any,
+    labels: Any,
+    weights: Any = None,
+    *,
+    n_bins: int = 10,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Weighted reliability curve over ``n_bins`` equal-width bins.
+
+    Returns ``(confidence, accuracy, bin_weight)`` host arrays of length
+    ``n_bins``: per-bin mean predicted probability, observed positive
+    rate and total sample weight. Empty bins report zero confidence and
+    accuracy with zero weight (callers mask on ``bin_weight > 0``).
+    """
+    p, y, w = _flatten(probs, labels, weights)
+    conf, acc, wsum = _curve_kernel(p, y, w, int(n_bins))
+    return np.asarray(conf), np.asarray(acc), np.asarray(wsum)
+
+
+@dataclass(frozen=True)
+class CalibrationSummary:
+    """Point calibration metrics plus bootstrap uncertainty for one head.
+
+    ``ece`` is the expected calibration error (bin-weighted |confidence −
+    accuracy|); ``brier`` the weighted Brier score with its binned Murphy
+    decomposition (``brier ≈ reliability − resolution + uncertainty``, up
+    to within-bin variance); ``ece_ci``/``brier_ci`` are bootstrap
+    ``ci_level`` intervals from the resample ensemble.
+    """
+
+    n: float
+    ece: float
+    brier: float
+    brier_reliability: float
+    brier_resolution: float
+    brier_uncertainty: float
+    ece_ci: Tuple[float, float]
+    brier_ci: Tuple[float, float]
+    n_bins: int = 10
+    n_boot: int = 200
+    ci_level: float = 0.95
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """A flat, JSON-ready rendering (promotion reports embed this)."""
+        return {
+            'n': self.n,
+            'ece': self.ece,
+            'brier': self.brier,
+            'brier_reliability': self.brier_reliability,
+            'brier_resolution': self.brier_resolution,
+            'brier_uncertainty': self.brier_uncertainty,
+            'ece_ci': list(self.ece_ci),
+            'brier_ci': list(self.brier_ci),
+            'n_bins': self.n_bins,
+            'n_boot': self.n_boot,
+            'ci_level': self.ci_level,
+            **self.extra,
+        }
+
+
+def calibration_summary(
+    probs: Any,
+    labels: Any,
+    weights: Any = None,
+    *,
+    n_bins: int = 10,
+    n_boot: int = 200,
+    seed: int = 0,
+    ci_level: float = 0.95,
+) -> CalibrationSummary:
+    """Full calibration summary of one probability head on device.
+
+    Parameters
+    ----------
+    probs, labels, weights
+        Any matching leading shape (``(G, A)`` packed tensors or flat
+        rows); ``weights`` (e.g. the packed batch mask) zero out padding.
+    n_bins : int
+        Equal-width reliability bins (2409.04889 uses 10).
+    n_boot : int
+        Bootstrap resamples, evaluated in one ``vmap`` dispatch.
+    seed : int
+        PRNG seed of the resample ensemble — fixed seed, fixed input ⇒
+        fixed intervals (the shadow replay's reproducibility contract).
+    ci_level : float
+        Central interval mass (default 0.95).
+    """
+    if n_bins < 2:
+        raise ValueError(f'need at least 2 bins, got {n_bins}')
+    if n_boot < 1:
+        raise ValueError(f'need at least 1 bootstrap resample, got {n_boot}')
+    p, y, w = _flatten(probs, labels, weights)
+    out = _summary_kernel(
+        p, y, w, int(seed), int(n_bins), int(n_boot), float(ci_level)
+    )
+    n, ece, brier, rel, res, unc, ece_ci, brier_ci = jax.device_get(out)
+    return CalibrationSummary(
+        n=float(n),
+        ece=float(ece),
+        brier=float(brier),
+        brier_reliability=float(rel),
+        brier_resolution=float(res),
+        brier_uncertainty=float(unc),
+        ece_ci=(float(ece_ci[0]), float(ece_ci[1])),
+        brier_ci=(float(brier_ci[0]), float(brier_ci[1])),
+        n_bins=int(n_bins),
+        n_boot=int(n_boot),
+        ci_level=float(ci_level),
+    )

@@ -1,0 +1,197 @@
+"""The promotion gate: calibration bands, typed reports, recording.
+
+A candidate model is promoted only when its calibration on the shadow
+replay does not regress beyond configured bands — per 2409.04889, the
+deployment criterion is statistical (reliability, uncertainty), not a
+marginally better loss. The gate compares candidate vs active per
+probability head (scores/concedes) and produces a typed
+:class:`PromotionReport` that is recorded *everywhere an operator might
+look*: the active :class:`~socceraction_tpu.obs.trace.RunLog` (a
+``promotion_report`` event — what ``obsctl promotions`` tails), the
+always-on flight recorder ring (post-mortem bundles), and the ``learn``
+metric area (``learn/promotions{verdict}``, per-head
+``learn/ece``/``learn/brier`` gauges).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs import RECORDER, counter, gauge
+from ..obs.trace import current_runlog
+from .calibration import CalibrationSummary
+
+__all__ = ['GateConfig', 'PromotionReport', 'evaluate_gate', 'record_report']
+
+
+@dataclass(frozen=True)
+class GateConfig:
+    """Calibration bands and replay parameters of the promotion gate.
+
+    A candidate is **blocked** when, on any head, its expected
+    calibration error exceeds the active model's by more than
+    ``max_ece_regression`` or its Brier score by more than
+    ``max_brier_regression``. Bands are absolute deltas on [0, 1]
+    metrics; negative deltas (improvements) always pass. Bootstrap CIs
+    ride along in the report as evidence — the verdict itself stays a
+    deterministic function of the point estimates and bands, so the same
+    replay always gates the same way.
+
+    ``min_replay_actions`` refuses to promote on a traffic window too
+    small to measure calibration at all (the gate fails *closed*: no
+    evidence, no promotion).
+    """
+
+    max_ece_regression: float = 0.01
+    max_brier_regression: float = 0.005
+    min_replay_actions: int = 64
+    n_bins: int = 10
+    n_boot: int = 200
+    seed: int = 0
+    ci_level: float = 0.95
+
+
+@dataclass
+class PromotionReport:
+    """One loop iteration's full decision record (JSON-ready via
+    :meth:`to_dict`). ``verdict`` is one of ``'promoted'``,
+    ``'rejected'``, ``'no_new_data'``, ``'publish_failed'`` (the gate
+    passed but the registry publish / service swap raised), or
+    ``'error'`` (the shadow/gate stages themselves raised). The two
+    failure verdicts are recorded *before* the error surfaces to the
+    caller — every iteration that consumed data leaves a decision
+    trail."""
+
+    name: str
+    verdict: str
+    reasons: List[str] = field(default_factory=list)
+    active_version: Optional[str] = None
+    candidate_tag: Optional[str] = None
+    #: set only when the candidate was actually published
+    candidate_version: Optional[str] = None
+    new_games: List[Any] = field(default_factory=list)
+    #: per-head metric comparison:
+    #: ``{head: {'candidate': {...}, 'active': {...}, 'delta_ece': .,
+    #: 'delta_brier': .}}`` (summaries are CalibrationSummary.to_dict())
+    heads: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    replay: Dict[str, Any] = field(default_factory=dict)
+    stage_seconds: Dict[str, float] = field(default_factory=dict)
+    time_unix: float = field(default_factory=time.time)
+
+    @property
+    def promoted(self) -> bool:
+        """True iff this iteration published (and activated) the candidate."""
+        return self.verdict == 'promoted'
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-ready rendering — the run-log/recorder event payload."""
+        return {
+            'name': self.name,
+            'verdict': self.verdict,
+            'reasons': list(self.reasons),
+            'active_version': self.active_version,
+            'candidate_tag': self.candidate_tag,
+            'candidate_version': self.candidate_version,
+            'new_games': [
+                g.item() if hasattr(g, 'item') else g for g in self.new_games
+            ],
+            'heads': self.heads,
+            'replay': dict(self.replay),
+            'stage_seconds': {
+                k: round(v, 6) for k, v in self.stage_seconds.items()
+            },
+            'time_unix': self.time_unix,
+        }
+
+
+def compare_heads(
+    active: Dict[str, CalibrationSummary],
+    candidate: Dict[str, CalibrationSummary],
+) -> Dict[str, Dict[str, Any]]:
+    """The report's per-head block: both summaries plus the deltas."""
+    heads: Dict[str, Dict[str, Any]] = {}
+    for col, cand in candidate.items():
+        entry: Dict[str, Any] = {'candidate': cand.to_dict()}
+        act = active.get(col) if active else None
+        if act is not None:
+            entry['active'] = act.to_dict()
+            entry['delta_ece'] = cand.ece - act.ece
+            entry['delta_brier'] = cand.brier - act.brier
+        heads[col] = entry
+    return heads
+
+
+def evaluate_gate(
+    active: Optional[Dict[str, CalibrationSummary]],
+    candidate: Dict[str, CalibrationSummary],
+    config: GateConfig,
+) -> Tuple[bool, List[str]]:
+    """Apply the calibration bands; returns ``(passed, reasons)``.
+
+    ``active=None`` is the bootstrap case (no serving baseline yet): the
+    candidate passes by default, with the reason recorded. Otherwise
+    every head must stay within both bands; all violations are listed,
+    not just the first.
+    """
+    if active is None:
+        return True, ['bootstrap: no active model to compare against']
+    reasons: List[str] = []
+    for col, cand in candidate.items():
+        act = active.get(col)
+        if act is None:
+            reasons.append(f'{col}: active model has no such head')
+            continue
+        if cand.n < config.min_replay_actions:
+            reasons.append(
+                f'{col}: replay window too small '
+                f'({cand.n:.0f} < {config.min_replay_actions} actions)'
+            )
+            continue
+        ci_pct = f'{cand.ci_level:.0%}'
+        d_ece = cand.ece - act.ece
+        if d_ece > config.max_ece_regression:
+            reasons.append(
+                f'{col}: ECE regressed {act.ece:.4f} -> {cand.ece:.4f} '
+                f'(+{d_ece:.4f} > band {config.max_ece_regression:.4f}; '
+                f'candidate {ci_pct} CI '
+                f'[{cand.ece_ci[0]:.4f}, {cand.ece_ci[1]:.4f}])'
+            )
+        d_brier = cand.brier - act.brier
+        if d_brier > config.max_brier_regression:
+            reasons.append(
+                f'{col}: Brier regressed {act.brier:.4f} -> {cand.brier:.4f} '
+                f'(+{d_brier:.4f} > band {config.max_brier_regression:.4f}; '
+                f'candidate {ci_pct} CI '
+                f'[{cand.brier_ci[0]:.4f}, {cand.brier_ci[1]:.4f}])'
+            )
+    return not reasons, reasons
+
+
+def record_report(report: PromotionReport) -> None:
+    """Land one report in the run log, the flight recorder and metrics.
+
+    Call once per loop iteration, after the verdict is final (including
+    the published version on promotion). Never raises — the decision has
+    already been acted on; losing telemetry must not unwind it.
+    """
+    payload = report.to_dict()
+    counter('learn/promotions', unit='count').inc(1, verdict=report.verdict)
+    for col, entry in report.heads.items():
+        for which in ('candidate', 'active'):
+            metrics = entry.get(which)
+            if metrics:
+                gauge('learn/ece', unit='value').set(
+                    metrics['ece'], head=col, model=which
+                )
+                gauge('learn/brier', unit='value').set(
+                    metrics['brier'], head=col, model=which
+                )
+    try:
+        RECORDER.record('promotion_report', **payload)
+        log = current_runlog()
+        if log is not None:
+            log.event('promotion_report', **payload)
+    except Exception:
+        pass

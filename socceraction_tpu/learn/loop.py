@@ -1,0 +1,499 @@
+"""The continuous-learning orchestrator: stream → train → shadow → swap.
+
+:class:`ContinuousLearner` closes the loop between every subsystem built
+so far. One :meth:`~ContinuousLearner.run_once` iteration:
+
+1. **ingest** — poll the :class:`~socceraction_tpu.learn.ingest.SeasonWatcher`
+   for newly landed matches; nothing new short-circuits to a
+   ``no_new_data`` report (and a bitwise no-op on the serving model).
+   Otherwise the packed cache is extended incrementally
+   (:func:`~socceraction_tpu.learn.ingest.extend_packed` — O(new
+   matches) store IO).
+2. **train** — stream the season through the packed feed
+   (:func:`~socceraction_tpu.pipeline.feed.iter_batches`, cache-hit) into
+   :meth:`VAEP.fit_packed`, **warm-started** from the active registry
+   model's parameters (and in-process adam state) so the candidate is an
+   incremental continuation, not a from-scratch retrain.
+3. **shadow** — replay recent traffic (the service's
+   :class:`~socceraction_tpu.serve.capture.TrafficCapture`, falling back
+   to the newest stored matches when no capture exists) through the
+   candidate AND the active model over one byte-identical packed batch;
+   compute per-head calibration with bootstrap CIs on device
+   (:mod:`socceraction_tpu.learn.calibration`).
+4. **gate** — apply the calibration bands
+   (:class:`~socceraction_tpu.learn.gate.GateConfig`); every decision
+   becomes a typed :class:`~socceraction_tpu.learn.gate.PromotionReport`
+   recorded to the run log, the flight recorder and ``learn/*`` metrics.
+5. **publish** — on pass, the staged candidate is atomically promoted to
+   the next registry version and hot-swapped into the service
+   (pre-warmed ladder, zero steady-state retraces); on rejection the
+   candidate stays staged for post-mortems, the retention policy
+   (:meth:`ModelRegistry.gc_candidates`) bounds the backlog, and a
+   flight-recorder debug bundle is dumped automatically.
+
+:meth:`~ContinuousLearner.rollback` is the explicit escape hatch back to
+the previously active version (service ladder pre-warmed, counted under
+``serve/model_swaps{reason="rollback"}``).
+
+Every stage runs inside a ``learn/*`` span and lands its wall time in
+the ``learn/stage_seconds{stage=...}`` histogram — the source of the
+bench's ``continuous_learning`` per-stage breakdown. The whole loop is
+CPU-runnable end to end (``make learn-smoke``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from ..obs import counter, histogram, span
+from ..obs.recorder import RECORDER, default_debug_dir, dump_debug_bundle
+from .gate import (
+    GateConfig,
+    PromotionReport,
+    compare_heads,
+    evaluate_gate,
+    record_report,
+)
+from .ingest import SeasonWatcher, extend_packed, newest_game_ids
+from .shadow import ShadowResult, pack_replay_batch, shadow_replay
+
+__all__ = ['ContinuousLearner', 'LearnConfig']
+
+
+@dataclass
+class LearnConfig:
+    """Knobs of one :class:`ContinuousLearner`.
+
+    ``train_params`` are the MLP head hyperparameters (``tree_params`` of
+    :meth:`VAEP.fit_packed`); under a warm start they override the
+    inherited schedule knobs while the architecture stays the warm
+    model's. ``model_factory`` builds the bootstrap model (default: a
+    fresh default :class:`~socceraction_tpu.vaep.base.VAEP`).
+    """
+
+    model_name: str = 'vaep'
+    max_actions: int = 1664
+    games_per_batch: int = 64
+    learner: str = 'mlp'
+    train_params: Optional[Dict[str, Any]] = None
+    fit_params: Optional[Dict[str, Any]] = None
+    val_size: float = 0.25
+    random_state: Optional[int] = 0
+    warm_start: bool = True
+    gate: GateConfig = field(default_factory=GateConfig)
+    #: traffic source of last resort: replay the newest N stored matches
+    #: when no capture ring is attached (or it is empty)
+    fallback_replay_games: int = 8
+    #: staged candidates kept by the retention policy after a rejection
+    retention_keep: int = 2
+    cache_dir: Optional[str] = None
+    float_dtype: Any = 'float32'
+    family: str = 'standard'
+    model_factory: Optional[Callable[[], Any]] = None
+    debug_dir: Optional[str] = None
+
+
+class ContinuousLearner:
+    """Drives the stream → train → shadow-eval → gated hot-swap loop.
+
+    Parameters
+    ----------
+    store : SeasonStore
+        Where new matches land.
+    registry : ModelRegistry
+        Versioned model store; the loop publishes candidates here and
+        reads the active model as its warm-start / comparison baseline.
+    service : RatingService, optional
+        A live serving front end. When given, promotions go through its
+        pre-warmed atomic :meth:`swap_model` and the shadow replay reads
+        its traffic capture ring by default.
+    capture : TrafficCapture, optional
+        Explicit traffic source for the shadow replay; defaults to
+        ``service.capture``.
+    config : LearnConfig, optional
+    prime_watcher : bool
+        ``True`` (default when the registry already has an active model)
+        marks the store's current games as consumed, so the first
+        iteration only trains when *new* matches land.
+    """
+
+    def __init__(
+        self,
+        store: Any,
+        registry: Any,
+        *,
+        service: Any = None,
+        capture: Any = None,
+        config: Optional[LearnConfig] = None,
+        prime_watcher: Optional[bool] = None,
+    ) -> None:
+        self.store = store
+        self.registry = registry
+        self.service = service
+        self.capture = capture if capture is not None else (
+            getattr(service, 'capture', None) if service is not None else None
+        )
+        self.config = config if config is not None else LearnConfig()
+        if prime_watcher is None:
+            prime_watcher = self._active() is not None
+        self.watcher = SeasonWatcher(store, prime=prime_watcher)
+        self.last_report: Optional[PromotionReport] = None
+
+    # -- pieces ------------------------------------------------------------
+
+    def _active(self) -> Optional[Tuple[str, str, Any]]:
+        try:
+            return self.registry.active()
+        except RuntimeError:
+            return None
+
+    def _debug_dir(self) -> str:
+        return self.config.debug_dir or default_debug_dir()
+
+    def _new_model(self, active_model: Any) -> Any:
+        """An unfitted candidate shell matching the active feature layout."""
+        if active_model is not None:
+            return type(active_model)(
+                xfns=list(active_model.xfns),
+                nb_prev_actions=active_model.nb_prev_actions,
+                backend=active_model.backend,
+            )
+        if self.config.model_factory is not None:
+            return self.config.model_factory()
+        from ..vaep.base import VAEP
+
+        return VAEP()
+
+    def _train_candidate(self, active_model: Any) -> Any:
+        """Incremental fit: packed feed (cache hit) + warm start."""
+        from ..pipeline.feed import iter_batches
+
+        cfg = self.config
+        candidate = self._new_model(active_model)
+        batches = iter_batches(
+            self.store,
+            cfg.games_per_batch,
+            max_actions=cfg.max_actions,
+            float_dtype=cfg.float_dtype,
+            packed_cache=cfg.cache_dir if cfg.cache_dir else True,
+            family=cfg.family,
+        )
+        warm = active_model if (cfg.warm_start and active_model is not None) else None
+        candidate.fit_packed(
+            batches,
+            learner=cfg.learner,
+            val_size=cfg.val_size,
+            tree_params=cfg.train_params,
+            fit_params=cfg.fit_params,
+            random_state=cfg.random_state,
+            warm_start=warm,
+        )
+        return candidate
+
+    def _replay_frames(
+        self, exclude: Any = ()
+    ) -> Tuple[List[Tuple[pd.DataFrame, Any]], str]:
+        """The traffic window plus its actual source.
+
+        Capture ring first (genuinely served traffic — kept even when it
+        overlaps the new games), stored games as the fallback. The
+        source travels with the frames so the report can never claim
+        ``'capture'`` for a window that was actually the fallback (the
+        ring may fill concurrently with this call).
+
+        ``exclude`` (the games this iteration just trained on) is
+        dropped from the *fallback* window: scoring the candidate on its
+        own fresh training data while the active model is out-of-sample
+        would bias the gate toward promotion. When nothing else exists
+        (the bootstrap store is only new games), the in-sample window is
+        used anyway but labeled ``'store_fallback_in_sample'`` so the
+        report carries the caveat.
+        """
+        if self.capture is not None:
+            frames = self.capture.frames()
+            if frames:
+                return frames, 'capture'
+        n = int(self.config.fallback_replay_games)
+        if n <= 0:
+            return [], 'store_fallback'
+        exclude = set(exclude)
+        # numeric-aware recency: the raw listing is key-string ordered,
+        # whose tail is NOT the newest games once ids grow a digit
+        all_ids = self.store.game_ids()
+        game_ids = newest_game_ids(
+            [g for g in all_ids if g not in exclude], n
+        )
+        source = 'store_fallback'
+        if not game_ids and exclude:
+            game_ids = newest_game_ids(all_ids, n)
+            source = 'store_fallback_in_sample'
+        home = self.store.home_team_ids()
+        return [
+            (self.store.get_actions(gid), home.get(gid))
+            for gid in game_ids
+        ], source
+
+    # -- the loop ----------------------------------------------------------
+
+    def run_once(self) -> PromotionReport:
+        """One full loop iteration; returns (and records) the report."""
+        cfg = self.config
+        gate_cfg = cfg.gate
+        stage_s: Dict[str, float] = {}
+
+        def timed_stage(stage: str):
+            return _StageTimer(stage, stage_s)
+
+        with span('learn/loop', model=cfg.model_name):
+            active = self._active()
+            active_version = active[1] if active else None
+            active_model = active[2] if active else None
+
+            with timed_stage('ingest'), span('learn/ingest'):
+                new_ids = self.watcher.poll()
+                if new_ids:
+                    extend_packed(
+                        self.store,
+                        max_actions=cfg.max_actions,
+                        float_dtype=cfg.float_dtype,
+                        cache_dir=cfg.cache_dir,
+                        family=cfg.family,
+                    )
+            if not new_ids:
+                report = PromotionReport(
+                    name=cfg.model_name,
+                    verdict='no_new_data',
+                    reasons=['no new matches since the last iteration'],
+                    active_version=active_version,
+                    stage_seconds=dict(stage_s),
+                )
+                self._finish(report)
+                return report
+            counter('learn/new_games', unit='count').inc(len(new_ids))
+
+            with timed_stage('train'), span('learn/train', games=len(new_ids)):
+                candidate = self._train_candidate(active_model)
+                tag, _path = self.registry.stage_candidate(
+                    cfg.model_name, candidate
+                )
+            # the games are consumed once a candidate was trained over
+            # them — a rejected candidate must not retrain the same data
+            # forever, and a crash before this line retries it
+            self.watcher.commit(new_ids)
+
+            # everything past the commit must end in a recorded report —
+            # an exception here would otherwise consume the games with no
+            # decision trail anywhere (same contract as the publish guard)
+            try:
+                act_res: Optional[ShadowResult] = None
+                cand_res: Optional[ShadowResult] = None
+                with timed_stage('shadow'), span('learn/shadow'):
+                    frames, replay_source = self._replay_frames(
+                        exclude=new_ids
+                    )
+                    if frames:
+                        batch = pack_replay_batch(
+                            frames, max_actions=cfg.max_actions
+                        )
+                        # ONE packed batch replayed through both models:
+                        # candidate and active see byte-identical inputs
+                        # and labels
+                        cand_res = shadow_replay(
+                            candidate, batch=batch,
+                            n_bins=gate_cfg.n_bins, n_boot=gate_cfg.n_boot,
+                            seed=gate_cfg.seed, ci_level=gate_cfg.ci_level,
+                        )
+                        if active_model is not None:
+                            act_res = shadow_replay(
+                                active_model, batch=batch,
+                                n_bins=gate_cfg.n_bins,
+                                n_boot=gate_cfg.n_boot,
+                                seed=gate_cfg.seed,
+                                ci_level=gate_cfg.ci_level,
+                            )
+                if cand_res is None:
+                    # fail CLOSED, but on the record: the candidate stays
+                    # staged unevaluated and the decision is a typed
+                    # report (built OUTSIDE the stage timer, so the
+                    # shadow wall it just measured is included)
+                    report = PromotionReport(
+                        name=cfg.model_name,
+                        verdict='rejected',
+                        reasons=[
+                            'no replay traffic available (capture empty '
+                            'and the store fallback is disabled)'
+                        ],
+                        active_version=active_version,
+                        candidate_tag=tag,
+                        new_games=list(new_ids),
+                        stage_seconds=dict(stage_s),
+                    )
+                    self.registry.gc_candidates(
+                        cfg.model_name, keep=cfg.retention_keep
+                    )
+                    self._finish(report)
+                    return report
+
+                with timed_stage('gate'), span('learn/gate'):
+                    passed, reasons = evaluate_gate(
+                        act_res.summaries if act_res else None,
+                        cand_res.summaries,
+                        gate_cfg,
+                    )
+            except Exception as e:
+                report = PromotionReport(
+                    name=cfg.model_name,
+                    verdict='error',
+                    reasons=[
+                        f'shadow/gate failed: {type(e).__name__}: {e}'
+                    ],
+                    active_version=active_version,
+                    candidate_tag=tag,
+                    new_games=list(new_ids),
+                    stage_seconds=dict(stage_s),
+                )
+                self.registry.gc_candidates(
+                    cfg.model_name, keep=cfg.retention_keep
+                )
+                self._finish(report)
+                raise
+
+            report = PromotionReport(
+                name=cfg.model_name,
+                verdict='promoted' if passed else 'rejected',
+                reasons=reasons,
+                active_version=active_version,
+                candidate_tag=tag,
+                new_games=list(new_ids),
+                heads=compare_heads(
+                    act_res.summaries if act_res else {}, cand_res.summaries
+                ),
+                replay={
+                    'frames': cand_res.n_frames,
+                    'actions': cand_res.n_actions,
+                    'source': replay_source,
+                },
+            )
+
+            if passed:
+                try:
+                    with timed_stage('publish'), span('learn/publish'):
+                        version = self.registry.next_version(cfg.model_name)
+                        self.registry.promote_candidate(
+                            cfg.model_name, version, tag
+                        )
+                        if self.service is not None:
+                            self.service.swap_model(cfg.model_name, version)
+                        else:
+                            self.registry.activate(cfg.model_name, version)
+                        report.candidate_version = version
+                        self._transplant_opt_state(candidate)
+                except Exception as e:
+                    # an operational publish failure (version race, disk,
+                    # swap validation) still gets a typed decision record
+                    # before it surfaces — the report contract holds for
+                    # every iteration that got past the commit
+                    report.verdict = 'publish_failed'
+                    report.reasons = [
+                        f'publish failed: {type(e).__name__}: {e}'
+                    ]
+                    report.candidate_version = None
+                    report.stage_seconds = dict(stage_s)
+                    self._finish(report)
+                    raise
+            else:
+                # the rejected candidate stays staged for post-mortems;
+                # retention bounds the backlog, and the flight recorder
+                # is dumped with the full decision attached
+                self.registry.gc_candidates(
+                    cfg.model_name, keep=cfg.retention_keep
+                )
+                try:
+                    dump_debug_bundle(
+                        self._debug_dir(),
+                        reason='promotion_rejected',
+                        trigger={
+                            'type': 'promotion_rejected',
+                            **report.to_dict(),
+                        },
+                    )
+                except Exception:
+                    pass  # a failing dump must never unwind the verdict
+
+            report.stage_seconds = dict(stage_s)
+            self._finish(report)
+            return report
+
+    def _transplant_opt_state(self, candidate: Any) -> None:
+        """Carry the candidate's adam state onto the freshly *loaded* active.
+
+        Promotion activates the checkpoint read back from disk —
+        parameter-identical to the candidate (the msgpack round trip is
+        exact) but with ``opt_state_ = None``, because checkpoints
+        deliberately exclude optimizer state. Transplanting the
+        in-process state keeps the next iteration's warm start a true
+        optimizer continuation; across process restarts it degrades
+        gracefully to a params-only warm start.
+        """
+        from ..ml.mlp import MLPClassifier
+
+        try:
+            active = self.registry.active()[2]
+        except RuntimeError:
+            return
+        for col, head in getattr(active, '_models', {}).items():
+            cand_head = candidate._models.get(col)
+            if (
+                isinstance(head, MLPClassifier)
+                and isinstance(cand_head, MLPClassifier)
+                and cand_head.opt_state_ is not None
+            ):
+                head.opt_state_ = cand_head.opt_state_
+
+    def _finish(self, report: PromotionReport) -> None:
+        for stage, seconds in report.stage_seconds.items():
+            histogram('learn/stage_seconds', unit='s').observe(
+                seconds, stage=stage
+            )
+        record_report(report)
+        self.last_report = report
+
+    # -- rollback ----------------------------------------------------------
+
+    def rollback(self) -> Tuple[str, str]:
+        """Restore the previously active version (explicit escape hatch).
+
+        Through the service when one is attached (ladder pre-warmed
+        before the swap goes live), directly on the registry otherwise.
+        Either way the swap is atomic and counted under
+        ``serve/model_swaps{reason="rollback"}``.
+        """
+        if self.service is not None:
+            name, version = self.service.rollback_model()
+        else:
+            name, version = self.registry.rollback()
+        counter('learn/rollbacks', unit='count').inc(1)
+        RECORDER.record('rollback', name=name, version=version)
+        return name, version
+
+
+class _StageTimer:
+    """Record one stage's wall clock into a shared dict on exit."""
+
+    def __init__(self, stage: str, sink: Dict[str, float]) -> None:
+        self.stage = stage
+        self.sink = sink
+
+    def __enter__(self) -> '_StageTimer':
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.sink[self.stage] = (
+            self.sink.get(self.stage, 0.0) + time.perf_counter() - self.t0
+        )

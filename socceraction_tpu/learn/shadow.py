@@ -1,0 +1,156 @@
+"""Shadow evaluation: replay captured traffic through candidate vs active.
+
+The promotion gate never judges a candidate on a held-out split — it
+replays *recent real traffic* (the serving layer's
+:class:`~socceraction_tpu.serve.capture.TrafficCapture`: one-shot rating
+requests and per-match session streams) through both the candidate and
+the currently active model, and compares their calibration on the
+outcomes those action sequences actually produced (labels from the
+device label kernel). This is the replay-based evaluation PAPERS.md's
+*What Happened Next?* (2106.01786) motivates: event sequences as they
+occurred, not rows in isolation.
+
+Reproducibility is a hard contract here: for a fixed model and a fixed
+traffic window, :func:`shadow_replay` is **bitwise-stable on CPU** —
+same packed batch, same feature/probability dispatches, same reductions,
+no RNG outside the seeded bootstrap ensemble. The promotion report's
+numbers can therefore be regenerated exactly from a capture dump, and
+``tests/test_learn.py`` pins candidate replay stability across runs.
+
+Both models are evaluated by the *same* function of the same packed
+batch (features → probability heads), so the comparison is symmetric:
+any truncation a captured window imposes on the label lookahead affects
+candidate and active identically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..core.batch import ActionBatch, pack_actions
+from ..obs import counter, span
+from .calibration import CalibrationSummary, calibration_summary
+
+__all__ = ['ShadowResult', 'pack_replay_batch', 'replay_probs', 'shadow_replay']
+
+
+def pack_replay_batch(
+    frames: Sequence[Tuple[pd.DataFrame, Any]],
+    *,
+    max_actions: int,
+) -> ActionBatch:
+    """Pack captured ``(frame, home_team_id)`` traffic into one host batch.
+
+    Each traffic unit becomes its own game row (game ids are
+    renumbered positionally — captures from different sources may reuse
+    ids), packed to the service's fixed ``max_actions`` exactly like a
+    live request; a frame longer than the window keeps its most recent
+    ``max_actions`` rows (still a contiguous action sequence). The
+    per-unit staging batches are concatenated on host, the same idiom
+    the service's flusher uses to coalesce a flush.
+    """
+    if not frames:
+        raise ValueError('no captured traffic to replay')
+    stagings: List[ActionBatch] = []
+    for i, (frame, home_team_id) in enumerate(frames):
+        if len(frame) == 0:
+            continue
+        if len(frame) > max_actions:
+            frame = frame.iloc[-max_actions:]
+        work = frame.assign(game_id=i)
+        staging, _ids = pack_actions(
+            work, home_team_id=home_team_id, max_actions=max_actions,
+            as_numpy=True,
+        )
+        stagings.append(staging)
+    if not stagings:
+        raise ValueError('captured traffic is empty')
+    if len(stagings) == 1:
+        return stagings[0]
+    import jax
+
+    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *stagings)
+
+
+def replay_probs(model: Any, batch: ActionBatch) -> Dict[str, np.ndarray]:
+    """Per-head probability tensors ``(G, A)`` of one model on one batch.
+
+    Deliberately the *same* path for every model under comparison:
+    materialized features from the device feature kernels, probabilities
+    from each head (device MLPs stay on device; tree heads go through
+    their host predictors). Values on padding rows are garbage by
+    contract — callers mask with ``batch.mask``.
+    """
+    feats = model.compute_features_batch(batch)
+    probs = model._estimate_probabilities_batch(feats)
+    return {col: np.asarray(p) for col, p in probs.items()}
+
+
+@dataclass(frozen=True)
+class ShadowResult:
+    """One model's replay over one traffic window."""
+
+    #: per-head calibration (key: label column, e.g. 'scores'/'concedes')
+    summaries: Dict[str, CalibrationSummary]
+    #: per-head raw probability tensors (masked rows included) — kept so
+    #: reproducibility can be asserted bitwise, not just on summaries
+    probs: Dict[str, np.ndarray] = field(repr=False, default_factory=dict)
+    n_frames: int = 0
+    n_actions: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-ready per-head summary block (reports embed this)."""
+        return {
+            'n_frames': self.n_frames,
+            'n_actions': self.n_actions,
+            'heads': {c: s.to_dict() for c, s in self.summaries.items()},
+        }
+
+
+def shadow_replay(
+    model: Any,
+    frames: Optional[Sequence[Tuple[pd.DataFrame, Any]]] = None,
+    *,
+    batch: Optional[ActionBatch] = None,
+    max_actions: int = 1664,
+    n_bins: int = 10,
+    n_boot: int = 200,
+    seed: int = 0,
+    ci_level: float = 0.95,
+) -> ShadowResult:
+    """Replay a traffic window through ``model``; calibration per head.
+
+    Give either ``frames`` (captured ``(frame, home_team_id)`` pairs,
+    packed here) or a pre-packed ``batch`` — the loop packs once and
+    replays the same batch through candidate and active so both models
+    see byte-identical inputs. Labels come from the model family's
+    device label kernel over the same batch; padding rows carry zero
+    weight.
+    """
+    if (frames is None) == (batch is None):
+        raise ValueError('give exactly one of frames= or batch=')
+    if batch is None:
+        batch = pack_replay_batch(frames, max_actions=max_actions)
+    n_frames = int(batch.n_games)
+    n_actions = int(batch.total_actions)
+    with span('learn/shadow_replay', frames=n_frames, actions=n_actions):
+        probs = replay_probs(model, batch)
+        tensors = model._labels_kernel(batch)
+        labels = dict(zip(model._label_columns, tensors))
+        weights = np.asarray(batch.mask, dtype=np.float32)
+        summaries = {
+            col: calibration_summary(
+                probs[col], labels[col], weights,
+                n_bins=n_bins, n_boot=n_boot, seed=seed, ci_level=ci_level,
+            )
+            for col in probs
+        }
+    counter('learn/replayed_actions', unit='actions').inc(n_actions)
+    return ShadowResult(
+        summaries=summaries, probs=probs,
+        n_frames=n_frames, n_actions=n_actions,
+    )

@@ -196,6 +196,15 @@ class MLPClassifier:
         #: epoch-function retrace count of the last fit (1 == the epoch
         #: compiled once and was reused across every epoch)
         self.n_epoch_traces_: int = 0
+        #: adam state matching :attr:`params` — the best-eval epoch's
+        #: snapshot under early stopping, else the last trained epoch's.
+        #: Hand it to the next incremental
+        #: ``fit_packed(init_opt_state=...)`` so a warm-started
+        #: continuation keeps its second-moment scale instead of
+        #: re-estimating it from zero. In-process only: the
+        #: ``save``/``load`` checkpoint deliberately stores parameters,
+        #: not optimizer state.
+        self.opt_state_: Any = None
 
     # -- standardization statistics ----------------------------------------
     # mean_/std_ are properties so the device copies predict_proba_device
@@ -249,6 +258,37 @@ class MLPClassifier:
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), 2**31 - 1)
         return self.module.init(rng, jnp.zeros((1, n_features)))
 
+    def _check_init_params(self, init_params: Any, n_features: int):
+        """Validate + deep-copy a warm-start parameter pytree.
+
+        The structure and every leaf shape must match a fresh init of
+        this classifier's architecture at ``n_features`` — a silent
+        shape broadcast here would train a corrupted head. The template
+        is abstract (``jax.eval_shape``): validation allocates nothing
+        and runs no PRNG dispatch, which matters on the incremental
+        path that warm-starts every head every loop iteration. The copy
+        is mandatory: the epoch dispatch donates its parameter buffers,
+        and donating the caller's live (possibly actively serving)
+        pytree would invalidate it.
+        """
+        template = jax.eval_shape(lambda: self._init_params(n_features))
+        t_struct = jax.tree.structure(template)
+        i_struct = jax.tree.structure(init_params)
+        if t_struct != i_struct:
+            raise ValueError(
+                f'init_params tree structure {i_struct} does not match '
+                f'this classifier (hidden={self.hidden}): {t_struct}'
+            )
+        t_shapes = [jnp.shape(l) for l in jax.tree.leaves(template)]
+        i_shapes = [jnp.shape(l) for l in jax.tree.leaves(init_params)]
+        if t_shapes != i_shapes:
+            raise ValueError(
+                f'init_params leaf shapes {i_shapes} do not match the '
+                f'feature layout / architecture ({t_shapes}); warm starts '
+                'require an unchanged layout'
+            )
+        return jax.tree.map(lambda a: jnp.array(a, jnp.float32), init_params)
+
     def _dense_logits(self, params, x, mean, std):
         """``module.apply`` on standardized rows, optionally narrowed.
 
@@ -286,6 +326,7 @@ class MLPClassifier:
         *,
         path: str,
         n_samples: Optional[int] = None,
+        init_opt_state: Any = None,
     ):
         """Shared epoch loop: scan-train, eval, early-stop, telemetry.
 
@@ -294,9 +335,14 @@ class MLPClassifier:
         Records ``train/*`` metrics per ``(path, platform)`` — one
         ``train/epochs`` increment per epoch IS the XLA dispatch count of
         the training work (the per-epoch eval is a second, tiny one).
+        ``init_opt_state`` warm-starts adam (incremental fits); it is
+        deep-copied because the epoch dispatch donates its buffers.
         """
         tx = optax.adam(self.learning_rate)
-        opt_state = tx.init(params)
+        if init_opt_state is None:
+            opt_state = tx.init(params)
+        else:
+            opt_state = jax.tree.map(jnp.array, init_opt_state)
         trainer = _EpochTrainer(loss_fn, tx, n, self.batch_size, self.seed)
         eval_fn = None
         if eval_data is not None:
@@ -306,6 +352,7 @@ class MLPClassifier:
 
         labels = {'path': path, 'platform': jax.default_backend()}
         best_params = None
+        best_opt_state = None
         best_loss = np.inf
         bad_epochs = 0
         samples = n_samples if n_samples is not None else n
@@ -330,8 +377,13 @@ class MLPClassifier:
                     if vloss < best_loss - 1e-6:
                         best_loss = vloss
                         # deep copy: the live params buffers are donated
-                        # to the next epoch's dispatch
+                        # to the next epoch's dispatch. The optimizer
+                        # state is snapshotted WITH the parameters — a
+                        # warm start must continue adam from the epoch
+                        # the restored parameters came from, not from
+                        # wherever patience ran out
                         best_params = jax.tree.map(jnp.copy, params)
+                        best_opt_state = jax.tree.map(jnp.copy, opt_state)
                         bad_epochs = 0
                     else:
                         bad_epochs += 1
@@ -339,6 +391,9 @@ class MLPClassifier:
                             break
         self.n_epoch_traces_ = trainer.n_traces
         self.params = best_params if best_params is not None else params
+        self.opt_state_ = (
+            best_opt_state if best_params is not None else opt_state
+        )
         return self
 
     def fit(
@@ -393,6 +448,8 @@ class MLPClassifier:
         mean: Optional[Any] = None,
         std: Optional[Any] = None,
         path: str = 'fused',
+        init_params: Any = None,
+        init_opt_state: Any = None,
     ) -> 'MLPClassifier':
         """Train directly on packed game states — no feature matrix in HBM.
 
@@ -423,10 +480,21 @@ class MLPClassifier:
             from it — the same minibatch stream and loss, kept as the
             parity/bench baseline (requires ``batch`` to be an
             ``ActionBatch``).
+        init_params, init_opt_state
+            Warm start: initialize from an already-trained parameter
+            pytree (and optionally its adam state, e.g. a previous fit's
+            :attr:`opt_state_`) instead of a fresh random init — the
+            incremental-training entry the continuous-learning loop
+            (:mod:`socceraction_tpu.learn`) drives. Both are deep-copied
+            before the first epoch (dispatches donate their buffers), so
+            the caller's live model is never invalidated; with
+            ``max_epochs=0`` the fit is a bitwise no-op on the provided
+            parameters. ``init_params`` must match the feature layout and
+            ``hidden`` architecture of this classifier.
         """
         params, data, loss_fn, make_data, states, layout = self._packed_problem(
             batch, y, names=tuple(names), k=k, registry=registry,
-            mean=mean, std=std, path=path,
+            mean=mean, std=std, path=path, init_params=init_params,
         )
         eval_data = None
         if eval_set is not None:
@@ -441,7 +509,8 @@ class MLPClassifier:
         n = int(states.weight.shape[0])
         n_valid = int(np.asarray(jnp.sum(states.weight)))
         return self._fit_loop(
-            params, data, n, loss_fn, eval_data, path=path, n_samples=n_valid
+            params, data, n, loss_fn, eval_data, path=path,
+            n_samples=n_valid, init_opt_state=init_opt_state,
         )
 
     def _packed_problem(
@@ -455,6 +524,7 @@ class MLPClassifier:
         mean: Optional[Any] = None,
         std: Optional[Any] = None,
         path: str = 'fused',
+        init_params: Any = None,
     ):
         """Build the packed training problem (also used by ``bench.py``).
 
@@ -496,7 +566,10 @@ class MLPClassifier:
         self._std_dev = jnp.asarray(std)
         mean_dev, std_dev = self._device_stats()
 
-        params = self._init_params(layout.n_features)
+        if init_params is None:
+            params = self._init_params(layout.n_features)
+        else:
+            params = self._check_init_params(init_params, layout.n_features)
         pos_w = self.pos_weight
         hidden_layers = len(self.hidden)
         compute_dtype = self._compute_dtype()

@@ -49,6 +49,7 @@ __all__ = [
     'cost_analysis',
     'counter',
     'current_runlog',
+    'default_debug_dir',
     'device_memory_stats',
     'dump_debug_bundle',
     'gauge',
@@ -81,7 +82,10 @@ _HOMES = {
         'MemorySampler', 'device_memory_stats', 'live_array_census',
         'sample_device_memory',
     ),
-    'recorder': ('FlightRecorder', 'RECORDER', 'dump_debug_bundle'),
+    'recorder': (
+        'FlightRecorder', 'RECORDER', 'default_debug_dir',
+        'dump_debug_bundle',
+    ),
 }
 _HOME_BY_SYMBOL = {
     name: module for module, names in _HOMES.items() for name in names

@@ -45,7 +45,28 @@ from typing import Any, Dict, List, Optional
 
 from socceraction_tpu.obs.metrics import REGISTRY, MetricRegistry
 
-__all__ = ['RECORDER', 'FlightRecorder', 'dump_debug_bundle']
+__all__ = [
+    'RECORDER',
+    'FlightRecorder',
+    'default_debug_dir',
+    'dump_debug_bundle',
+]
+
+
+def default_debug_dir() -> str:
+    """Where automatic debug bundles land unless a caller overrides it.
+
+    One resolution chain (``SOCCERACTION_TPU_DEBUG_DIR`` env var, else a
+    fixed tempdir subdirectory) shared by every auto-dumping subsystem —
+    the serving layer's crash/overload/swap dumps and the learning
+    loop's rejected-promotion dumps must land in the same place for
+    ``obsctl bundle <dir>`` to find them all.
+    """
+    import tempfile
+
+    return os.environ.get('SOCCERACTION_TPU_DEBUG_DIR') or os.path.join(
+        tempfile.gettempdir(), 'socceraction-tpu-debug'
+    )
 
 _bundle_seq = itertools.count(1)
 

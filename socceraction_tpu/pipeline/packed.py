@@ -605,6 +605,48 @@ class PackedSeasonWriter:
                 )
                 self.write_chunk(run[0], batch)
 
+    def seed_from(self, old: 'PackedSeason', *, copy_chunk: int = 256) -> int:
+        """Copy rows for games an existing cache already packed.
+
+        The incremental half of the continuous-learning ingest
+        (:func:`socceraction_tpu.learn.ingest.extend_packed`): when new
+        matches land, the store fingerprint changes and the whole cache
+        reads as a miss — but the *rows* of every previously packed game
+        are still exactly right for an append-only store. This seeds the
+        new build's memmaps straight from the old cache's (positional →
+        positional, matched by game id), so the rebuild only reads and
+        packs the games that actually landed.
+
+        Returns the number of rows copied. A shape/family/dtype mismatch
+        copies nothing (the caller falls back to a full
+        :meth:`write_missing` pass). Contract: rows are matched **by
+        game id** — a store that *rewrites* an existing game's actions
+        must drop the cache instead (``shutil.rmtree``) to avoid reviving
+        the pre-rewrite rows.
+        """
+        if (
+            old.family.name != self.family.name
+            or old.max_actions != self.max_actions
+            or old.float_dtype != self.float_dtype
+        ):
+            return 0
+        pairs = [
+            (i, old._pos[gid])
+            for i, gid in enumerate(self.game_ids)
+            if not self._written[i] and gid in old._pos
+        ]
+        for lo in range(0, len(pairs), copy_chunk):
+            chunk = pairs[lo : lo + copy_chunk]
+            new_idx = np.asarray([p[0] for p in chunk])
+            old_idx = np.asarray([p[1] for p in chunk])
+            for c in self.family.all_cols:
+                self._maps[c][new_idx] = np.asarray(
+                    old._cols[c][old_idx], dtype=self._maps[c].dtype
+                )
+            self._n_actions[new_idx] = old.n_actions[old_idx]
+            self._written[new_idx] = True
+        return len(pairs)
+
     def finalize(self) -> PackedSeason:
         """Flush, write ``meta.json`` and publish atomically.
 

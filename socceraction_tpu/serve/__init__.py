@@ -14,8 +14,12 @@ concurrent callers onto the fused one-dispatch rating path:
   named+versioned checkpoints with warm device residency and atomic
   hot-swap.
 - :mod:`socceraction_tpu.serve.service` — :class:`RatingService`, the
-  front end (``rate() -> Future``, ``open_session``, ``swap_model``),
-  fully instrumented under the ``serve`` telemetry area.
+  front end (``rate() -> Future``, ``open_session``, ``swap_model``,
+  ``rollback_model``), fully instrumented under the ``serve`` telemetry
+  area.
+- :mod:`socceraction_tpu.serve.capture` — :class:`TrafficCapture`, the
+  bounded ring of recently served traffic the continuous-learning
+  loop's shadow evaluation (:mod:`socceraction_tpu.learn`) replays.
 
 Quickstart::
 
@@ -34,6 +38,7 @@ semantics.
 """
 
 from .batcher import MicroBatcher, Overloaded
+from .capture import TrafficCapture
 from .registry import ModelRegistry
 from .service import RatingService
 from .session import MatchSession
@@ -44,4 +49,5 @@ __all__ = [
     'ModelRegistry',
     'RatingService',
     'MatchSession',
+    'TrafficCapture',
 ]

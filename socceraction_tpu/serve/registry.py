@@ -22,13 +22,32 @@ back). The registry layers three things over the existing
   assignment; the service's flusher reads the triple once per flush, so
   every request in a batch is rated by exactly one model version, never
   a half-swapped mixture.
+
+The continuous-learning loop (:mod:`socceraction_tpu.learn`) adds two
+lifecycle stages on top:
+
+- **candidates** — :meth:`stage_candidate` saves a freshly trained model
+  under ``root/<name>/.candidates/<tag>`` (invisible to
+  :meth:`versions`; the leading dot is outside the version grammar, so a
+  candidate can never be activated by accident). A candidate that passes
+  the promotion gate is :meth:`promote_candidate`-d — one atomic rename
+  into a real version directory, no re-serialization — and one that
+  fails stays on disk for post-mortems until the retention policy
+  (:meth:`gc_candidates`) reclaims it.
+- **rollback** — :meth:`rollback` re-activates the version that was
+  serving *before* the last activation. The previous model is still
+  resident in the load cache (versions are immutable, entries are never
+  evicted), so a rollback is one warm, atomic reference swap — counted
+  under ``serve/model_swaps{reason="rollback"}``.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import shutil
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..obs import counter, span
@@ -36,6 +55,11 @@ from ..obs import counter, span
 __all__ = ['ModelRegistry']
 
 _NAME_RE = re.compile(r'^[A-Za-z0-9][A-Za-z0-9._-]*$')
+
+#: Subdirectory of ``root/<name>/`` holding staged (gate-pending or
+#: gate-rejected) candidate checkpoints. The leading dot keeps it out of
+#: the version grammar (``_NAME_RE``) and out of ``versions()`` listings.
+_CANDIDATES = '.candidates'
 
 
 def _version_sort_key(version: str) -> Tuple[Any, ...]:
@@ -61,6 +85,8 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._loaded: Dict[Tuple[str, str], Any] = {}
         self._active: Optional[Tuple[str, str, Any]] = None
+        self._previous: Optional[Tuple[str, str, Any]] = None
+        self._candidate_seq = 0
 
     # -- storage -----------------------------------------------------------
 
@@ -115,7 +141,11 @@ class ModelRegistry:
         """Load (and device-warm) ``name``/``version`` (default: newest).
 
         Loaded models are cached per ``(name, version)`` — versions are
-        immutable, so a cache entry can never go stale.
+        immutable, so a cache entry can never go *stale*. The cache is
+        pruned to the active + previous versions at every activation
+        (:meth:`activate` / :meth:`rollback`), so a loop that promotes a
+        new version per iteration holds at most two models resident
+        instead of growing without bound.
         """
         version = self.resolve_version(name, version)
         key = (name, version)
@@ -188,9 +218,28 @@ class ModelRegistry:
         version = self.resolve_version(name, version)
         model = self.load(name, version)
         with self._lock:
+            if self._active is not None and self._active[:2] != (name, version):
+                self._previous = self._active
             self._active = (name, version, model)
+            self._prune_loaded_locked()
         counter('serve/model_swaps', unit='count').inc(1)
         return name, version
+
+    def _prune_loaded_locked(self) -> None:
+        """Drop cached models other than the active/previous versions.
+
+        Called (under the lock) at every activation: rollback needs
+        exactly those two warm, and anything older would otherwise
+        accumulate one full parameter set per promotion for the life of
+        the process. A caller still holding a reference to an evicted
+        model keeps using it unaffected — only the cache lets go.
+        """
+        keep = {
+            triple[:2]
+            for triple in (self._active, self._previous)
+            if triple is not None
+        }
+        self._loaded = {k: v for k, v in self._loaded.items() if k in keep}
 
     def active(self) -> Tuple[str, str, Any]:
         """The active ``(name, version, model)`` triple (one atomic read)."""
@@ -201,3 +250,154 @@ class ModelRegistry:
                 'no active model: call activate(name, version) first'
             )
         return active
+
+    def previous(self) -> Optional[Tuple[str, str]]:
+        """The ``(name, version)`` that was serving before the last swap.
+
+        ``None`` until a second distinct version has been activated.
+        This is what :meth:`rollback` will restore — callers that need
+        to pre-warm compile caches (the serving ladder) before the swap
+        read it first.
+        """
+        with self._lock:
+            prev = self._previous
+        return prev[:2] if prev is not None else None
+
+    def rollback(
+        self, expected: Optional[Tuple[str, str]] = None
+    ) -> Tuple[str, str]:
+        """Atomically re-activate the previously active version.
+
+        The previous *model object* is still warm (it was serving until
+        the last swap, and the load cache retains active + previous), so
+        the whole exchange happens under one lock hold — read previous,
+        swap the triples — the same atomicity as :meth:`activate`, with
+        no window for a concurrent activation to slip between a read
+        and the swap. Callers that validated/pre-warmed a specific
+        target first (``RatingService.rollback_model``) pass it as
+        ``expected``; a concurrent activation that changed "previous"
+        in the meantime then raises instead of silently activating a
+        version nobody validated. After a rollback the
+        *rolled-back-from* version becomes the new "previous", so a
+        mistaken rollback can itself be rolled back. Counted under
+        ``serve/model_swaps{reason="rollback"}``.
+        """
+        with self._lock:
+            prev = self._previous
+            if prev is None:
+                raise RuntimeError(
+                    'no previous version to roll back to (rollback needs '
+                    'a completed swap first)'
+                )
+            if expected is not None and prev[:2] != tuple(expected):
+                raise RuntimeError(
+                    f'previous version changed concurrently (expected '
+                    f'{tuple(expected)}, found {prev[:2]}); re-read '
+                    'previous() and retry'
+                )
+            name, version, _model = prev
+            self._previous = self._active
+            self._active = prev
+            self._prune_loaded_locked()
+        counter('serve/model_swaps', unit='count').inc(1, reason='rollback')
+        return name, version
+
+    # -- candidate lifecycle (the continuous-learning loop) ----------------
+
+    def _candidate_dir(self, name: str, tag: str) -> str:
+        if not _NAME_RE.match(name) or not _NAME_RE.match(tag):
+            raise ValueError(
+                f'invalid candidate name/tag {name!r}/{tag!r} '
+                '(want [A-Za-z0-9][A-Za-z0-9._-]*)'
+            )
+        return os.path.join(self.root, name, _CANDIDATES, tag)
+
+    def stage_candidate(
+        self, name: str, model: Any, tag: Optional[str] = None
+    ) -> Tuple[str, str]:
+        """Save ``model`` as a staged candidate of ``name``; returns
+        ``(tag, path)``.
+
+        Candidates live under ``root/<name>/.candidates/<tag>`` — real
+        ``save_model`` checkpoints, but invisible to :meth:`versions` /
+        :meth:`resolve_version`, so nothing can activate one before the
+        promotion gate passes. The default tag is a timestamp plus a
+        process-local sequence number (collision-free within a process;
+        across processes the timestamp + refusal-to-overwrite guard
+        surfaces the race instead of corrupting a checkpoint).
+        """
+        if tag is None:
+            with self._lock:
+                self._candidate_seq += 1
+                seq = self._candidate_seq
+            tag = f'{time.strftime("%Y%m%dT%H%M%S")}-{os.getpid()}-{seq}'
+        path = self._candidate_dir(name, tag)
+        if os.path.exists(path):
+            raise ValueError(f'candidate {name}/{tag} already staged at {path!r}')
+        os.makedirs(path)
+        model.save_model(path)
+        return tag, path
+
+    def candidates(self, name: str) -> List[str]:
+        """Staged candidate tags of ``name``, oldest first (by mtime)."""
+        base = os.path.join(self.root, name, _CANDIDATES)
+        if not os.path.isdir(base):
+            return []
+        found = [
+            t for t in os.listdir(base)
+            if os.path.isfile(os.path.join(base, t, 'meta.json'))
+        ]
+        return sorted(found, key=lambda t: os.path.getmtime(os.path.join(base, t)))
+
+    def promote_candidate(self, name: str, version: str, tag: str) -> str:
+        """Publish a staged candidate as ``name``/``version`` (atomic).
+
+        One ``os.replace`` of the candidate directory into the version
+        slot — the checkpoint bytes the gate evaluated ARE the bytes
+        that serve; nothing is re-serialized between evaluation and
+        publication. The usual immutability rule applies: an existing
+        version refuses to be overwritten.
+        """
+        src = self._candidate_dir(name, tag)
+        if not os.path.isfile(os.path.join(src, 'meta.json')):
+            raise FileNotFoundError(f'no staged candidate {name}/{tag}')
+        dst = self._dir(name, version)
+        if os.path.exists(dst):
+            raise ValueError(
+                f'model {name}/{version} already exists at {dst!r}; '
+                'versions are immutable — promote under a new version'
+            )
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)
+        return dst
+
+    def next_version(self, name: str) -> str:
+        """The next free numeric version string of ``name`` ('1', '2', …).
+
+        Non-numeric published versions are ignored for the increment but
+        can never collide (the result is purely numeric).
+        """
+        numeric = [
+            int(v) for v in self.versions(name)
+            if v.isdigit()
+        ]
+        return str(max(numeric) + 1 if numeric else 1)
+
+    def gc_candidates(self, name: Optional[str] = None, *, keep: int = 2) -> List[str]:
+        """Retention policy: delete all but the newest ``keep`` candidates.
+
+        Gate-rejected candidates are kept on disk for post-mortems, but
+        a loop that keeps training (and keeps getting rejected) must not
+        grow the registry without bound. Returns the removed candidate
+        directories. ``name=None`` sweeps every published name.
+        """
+        removed: List[str] = []
+        names = [name] if name is not None else self.names()
+        for n in names:
+            tags = self.candidates(n)
+            for tag in tags[: max(0, len(tags) - max(0, int(keep)))]:
+                path = self._candidate_dir(n, tag)
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+                counter('serve/candidates_expired', unit='count').inc(1)
+        return removed

@@ -29,7 +29,6 @@ the full serving timeline.
 from __future__ import annotations
 
 import os
-import tempfile
 import threading
 import time
 from collections import deque
@@ -95,6 +94,11 @@ class RatingService:
         The p99 end-to-end latency budget :meth:`health` compares the
         measured ``serve/request_seconds`` p99 against. Observability
         only — nothing is throttled by it.
+    capture : TrafficCapture, optional
+        A :class:`~socceraction_tpu.serve.capture.TrafficCapture` ring
+        that records served traffic (successful ``rate`` submissions and
+        committed session ticks) for the continuous-learning loop's
+        shadow replay. ``None`` (default) captures nothing.
     debug_dir : str, optional
         Where automatic flight-recorder bundles land
         (:func:`~socceraction_tpu.obs.recorder.dump_debug_bundle` on
@@ -116,6 +120,7 @@ class RatingService:
         max_wait_ms: float = 2.0,
         max_queue: int = 256,
         slo_p99_ms: float = 250.0,
+        capture: Any = None,
         debug_dir: Optional[str] = None,
         overload_dump_threshold: int = 64,
         overload_dump_window_s: float = 10.0,
@@ -138,11 +143,10 @@ class RatingService:
         self._gs_enabled = 'goalscore' in first._kernel_names()
         self.max_actions = int(max_actions)
         self.slo_p99_ms = float(slo_p99_ms)
-        self.debug_dir = (
-            debug_dir
-            or os.environ.get('SOCCERACTION_TPU_DEBUG_DIR')
-            or os.path.join(tempfile.gettempdir(), 'socceraction-tpu-debug')
-        )
+        self.capture = capture
+        from ..obs.recorder import default_debug_dir
+
+        self.debug_dir = debug_dir or default_debug_dir()
         self.overload_dump_threshold = int(overload_dump_threshold)
         self.overload_dump_window_s = float(overload_dump_window_s)
         self.dump_interval_s = float(dump_interval_s)
@@ -190,43 +194,51 @@ class RatingService:
         """Game-state depth ``k`` of the serving model."""
         return int(self.model.nb_prev_actions)
 
+    def _prepare_swap_target(self, name: str, version: str) -> Any:
+        """Load, validate, layout-guard and ladder-warm a swap target.
+
+        The shared half of :meth:`swap_model` and :meth:`rollback_model`:
+        the target must be serve-compatible (fitted, standard SPADL) and
+        keep the active model's feature layout — sessions in flight pin
+        their window shape to ``nb_prev_actions`` and the bucket ladder
+        pins compiled shapes, so a layout change requires a new service,
+        not a swap. The ladder is pre-warmed *before* the target goes
+        live: a different head architecture is a different XLA program,
+        and without this the first post-swap request would pay its
+        compile inside its latency budget (observed ~1s on CPU);
+        same-arch targets hit the jit cache and cost a few no-op
+        dispatches.
+        """
+        old = self.model
+        new = self._registry.load(name, version)
+        self._validate_model(new)
+        if new.nb_prev_actions != old.nb_prev_actions or (
+            new._kernel_names() != old._kernel_names()
+        ):
+            raise ValueError(
+                'swap target changes the feature layout '
+                '(nb_prev_actions/xfns); start a new RatingService for it'
+            )
+        A = self.max_actions
+        for b in self._batcher.ladder:
+            self._device_rate(_empty_host_batch(1, A), _empty_gs(1, A), new, b)
+        return new
+
     def swap_model(self, name: str, version: Optional[str] = None) -> Tuple[str, str]:
         """Atomically swap serving to ``name``/``version`` (default newest).
 
-        The new version must be serve-compatible (fitted, standard
-        SPADL) and keep the active model's feature layout — sessions in
-        flight pin their window shape to ``nb_prev_actions`` and the
-        bucket ladder pins compiled shapes, so a layout change requires
-        a new service, not a swap.
+        The new version is validated, layout-guarded and ladder-warmed
+        before activation (:meth:`_prepare_swap_target`).
         """
         if self._registry is None:
             raise RuntimeError('swap_model needs a registry-backed service')
         try:
-            old = self.model
             # pin 'newest' NOW: the version validated and pre-warmed below
             # must be the exact version activated (a publish racing this
             # call could otherwise slip an unvalidated, cold model past the
             # gates)
             version = self._registry.resolve_version(name, version)
-            new = self._registry.load(name, version)
-            self._validate_model(new)
-            if new.nb_prev_actions != old.nb_prev_actions or (
-                new._kernel_names() != old._kernel_names()
-            ):
-                raise ValueError(
-                    'swap target changes the feature layout '
-                    '(nb_prev_actions/xfns); start a new RatingService for it'
-                )
-            # pre-warm the NEW model's ladder compiles before it goes live:
-            # a different head architecture is a different XLA program, and
-            # without this the first post-swap request would pay its compile
-            # inside its latency budget (observed ~1s on CPU). Same-arch
-            # swaps hit the jit cache and cost a few no-op dispatches.
-            A = self.max_actions
-            for b in self._batcher.ladder:
-                self._device_rate(
-                    _empty_host_batch(1, A), _empty_gs(1, A), new, b
-                )
+            self._prepare_swap_target(name, version)
             return self._registry.activate(name, version)
         except Exception as e:
             # a failed rollout is exactly when an operator wants the
@@ -237,6 +249,42 @@ class RatingService:
                 {
                     'type': 'swap_failure',
                     'target': f'{name}/{version or "newest"}',
+                    'error': f'{type(e).__name__}: {e}',
+                },
+            )
+            raise
+
+    def rollback_model(self) -> Tuple[str, str]:
+        """Atomically roll serving back to the previously active version.
+
+        The operator escape hatch after a bad promotion: the registry's
+        :meth:`~socceraction_tpu.serve.registry.ModelRegistry.rollback`
+        restores the version that was serving before the last swap —
+        still resident in the load cache, so the swap itself is one
+        reference assignment — after this service re-warms the bucket
+        ladder for it (a rolled-back-to model with the same architecture
+        hits the jit cache; the warmup is then a few no-op dispatches).
+        Counted under ``serve/model_swaps{reason="rollback"}``; a
+        failure dumps the flight recorder like a failed forward swap.
+        """
+        if self._registry is None:
+            raise RuntimeError('rollback_model needs a registry-backed service')
+        prev = self._registry.previous()
+        if prev is None:
+            raise RuntimeError('no previous version to roll back to')
+        name, version = prev
+        try:
+            self._prepare_swap_target(name, version)
+            # pin the exact version just validated/warmed: a promotion
+            # racing this call changes "previous", and rolling back to a
+            # version nobody validated must fail, not slip through
+            return self._registry.rollback(expected=(name, version))
+        except Exception as e:
+            self._maybe_dump(
+                'swap_failure',
+                {
+                    'type': 'rollback_failure',
+                    'target': f'{name}/{version}',
                     'error': f'{type(e).__name__}: {e}',
                 },
             )
@@ -288,7 +336,12 @@ class RatingService:
             else None
         )
         payload = _Payload(staging, gs, keep=None, index=actions.index)
-        return self._submit(payload, 'rate')
+        future = self._submit(payload, 'rate')
+        # capture AFTER admission: shed (Overloaded) traffic never ran,
+        # and replaying it would skew shadow calibration toward bursts
+        if self.capture is not None:
+            self.capture.record_frame(actions, home_team_id)
+        return future
 
     def rate_sync(
         self, actions: pd.DataFrame, *, home_team_id: Any = None,

@@ -208,6 +208,11 @@ class MatchSession:
         self._score_a, self._score_b = score_a, score_b
         self._tail = tail
         self.n_actions += len(actions)
+        # commit-time capture: a retried tick records its rows exactly
+        # once, and the captured stream is the match as actually rated
+        capture = getattr(self._service, 'capture', None)
+        if capture is not None:
+            capture.record_session(self.match_id, actions, self.home_team_id)
         out = parts[0] if len(parts) == 1 else pd.concat(parts)
         self._chunks.append(out)
         return out

@@ -88,6 +88,26 @@ xfns_default: List[fs.FeatureTransfomer] = [
 ]
 
 
+def _mlp_hyperparams(clf: MLPClassifier) -> Dict[str, Any]:
+    """The constructor kwargs reproducing ``clf``'s architecture/schedule.
+
+    Used by warm-started :meth:`VAEP.fit_packed` so an incremental head
+    defaults to the exact shape its seed parameters were trained with.
+    """
+    hyper: Dict[str, Any] = {
+        'hidden': clf.hidden,
+        'learning_rate': clf.learning_rate,
+        'batch_size': clf.batch_size,
+        'max_epochs': clf.max_epochs,
+        'patience': clf.patience,
+        'pos_weight': clf.pos_weight,
+        'seed': clf.seed,
+    }
+    if clf.train_dtype is not None:
+        hyper['train_dtype'] = clf.train_dtype
+    return hyper
+
+
 def _default_learner() -> str:
     try:
         import xgboost  # noqa: F401
@@ -291,6 +311,7 @@ class VAEP:
         tree_params: Optional[Dict[str, Any]] = None,
         fit_params: Optional[Dict[str, Any]] = None,
         random_state: Optional[int] = None,
+        warm_start: Any = None,
     ) -> 'VAEP':
         """Fit the probability models directly from packed game states.
 
@@ -326,6 +347,20 @@ class VAEP:
         random_state : int, optional
             Seed for the train/validation row split; defaults to the
             global numpy RNG like :meth:`fit`.
+        warm_start : VAEP, optional
+            A fitted model (same feature layout) whose MLP heads seed
+            this fit: each head trains from the existing parameters (and
+            in-process adam state, when available) instead of a fresh
+            random init — the incremental-retrain entry of the
+            continuous-learning loop (:mod:`socceraction_tpu.learn`).
+            Unless ``tree_params`` overrides them, each head also
+            inherits the warm model's hyperparameters so the
+            architecture cannot silently diverge, and the warm model's
+            standardization statistics are reused — the copied weights
+            are a function of that scaling; recomputing stats over the
+            grown season would perturb the continuation. The warm model
+            itself is never mutated (parameters are copied before
+            training).
         """
         from ..ml.learners import PACKED_LEARNERS
         from ..ops.fused import (
@@ -392,10 +427,42 @@ class VAEP:
 
         states_tr = take(train_idx)
         states_val = take(val_idx) if val_size > 0 else None
-        # one stats pass over the training rows, shared by both heads
-        # (fit() computes them per head from the same X_train — identical)
-        mean, raw_std = packed_feature_stats(states_tr, layout)
-        std = jnp.where(raw_std > 0, raw_std, 1.0)
+
+        warm_models: Optional[Dict[str, Any]] = None
+        if warm_start is not None:
+            warm_models = getattr(warm_start, '_models', None)
+            if not warm_models:
+                raise ValueError('warm_start must be a fitted model')
+
+        # standardization statistics: a warm start REUSES the seed model's
+        # stats — the copied first-layer weights (and transplanted adam
+        # moments) are a function of that scaling, and recomputing stats
+        # over the grown season would apply them to perturbed inputs,
+        # breaking the continuation. A cold fit computes one stats pass
+        # over the training rows, shared by both heads (fit() computes
+        # them per head from the same X_train — identical).
+        mean = std = None
+        if warm_models:
+            warm_head = next(
+                (
+                    m for m in warm_models.values()
+                    if isinstance(m, MLPClassifier) and m.mean_ is not None
+                ),
+                None,
+            )
+            if warm_head is not None:
+                if warm_head.mean_.shape[0] != layout.n_features:
+                    raise ValueError(
+                        'warm_start model has a different feature layout '
+                        f'({warm_head.mean_.shape[0]} features vs '
+                        f'{layout.n_features}); warm starts require an '
+                        'unchanged layout'
+                    )
+                mean = jnp.asarray(warm_head.mean_)
+                std = jnp.asarray(warm_head.std_)
+        if mean is None:
+            mean, raw_std = packed_feature_stats(states_tr, layout)
+            std = jnp.where(raw_std > 0, raw_std, 1.0)
 
         fit_fn = PACKED_LEARNERS[learner]
         with span('train/fit_packed', games=n_games, rows=nb_rows):
@@ -406,9 +473,20 @@ class VAEP:
                     eval_set = [
                         ((states_val, layout), jnp.take(y, val_idx))
                     ]
+                head_tree, head_fit = tree_params, fit_params
+                warm = warm_models.get(col) if warm_models else None
+                if isinstance(warm, MLPClassifier) and warm.params is not None:
+                    # inherit the warm head's architecture (overridable
+                    # schedule knobs) so the copied parameters are
+                    # guaranteed to fit the head they seed
+                    head_tree = {**_mlp_hyperparams(warm), **(tree_params or {})}
+                    head_fit = dict(head_fit or {})
+                    head_fit.setdefault('init_params', warm.params)
+                    if warm.opt_state_ is not None:
+                        head_fit.setdefault('init_opt_state', warm.opt_state_)
                 self._models[col] = fit_fn(
                     (states_tr, layout), y_tr, eval_set,
-                    tree_params, fit_params,
+                    head_tree, head_fit,
                     names=names, k=k, registry=registry, mean=mean, std=std,
                 )
         return self

@@ -1,0 +1,720 @@
+"""Tests for the continuous-learning loop (socceraction_tpu.learn).
+
+Covers the ISSUE-6 contract: device calibration metrics (reliability
+curves, ECE, Brier decomposition, deterministic bootstrap CIs),
+warm-started ``fit_packed`` (zero-epoch warm start is a bitwise no-op),
+the registry's candidate lifecycle + rollback, the serve-side traffic
+capture ring, bitwise-stable shadow replay, the promotion gate in both
+directions, and the full CPU end-to-end loop: new matches land →
+incremental ingest → warm-start fit → shadow replay of captured traffic
+→ gate blocks a degraded candidate AND promotes a retrained one →
+pre-warmed atomic swap with zero steady-state retraces → rollback — with
+the promotion report visible in the flight recorder, the run log and
+``obsctl promotions``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import (
+    append_synthetic_games,
+    synthetic_actions_frame,
+    write_synthetic_season,
+)
+from socceraction_tpu.learn import (
+    CalibrationSummary,
+    ContinuousLearner,
+    GateConfig,
+    LearnConfig,
+    SeasonWatcher,
+    calibration_summary,
+    evaluate_gate,
+    extend_packed,
+    reliability_curve,
+    shadow_replay,
+)
+from socceraction_tpu.learn.shadow import pack_replay_batch
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs.recorder import RECORDER
+from socceraction_tpu.pipeline.store import SeasonStore
+from socceraction_tpu.serve import ModelRegistry, RatingService, TrafficCapture
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+A_MAX = 192  # max_actions of the e2e loop (== valid actions per store game)
+
+
+@pytest.fixture(scope='module', autouse=True)
+def _drain_pair_probs_storm_window():
+    """Retire this module's pair-path compiles from the storm window.
+
+    The retrace-storm detector keeps a process-global rolling deque of
+    recent ``pair_probs`` compiles; this module legitimately compiles
+    many serving ladders (several services, architectures and shapes in
+    quick succession). Left in the 60 s window, those compiles could
+    push a LATER module's own controlled warmup over the storm
+    threshold purely by test adjacency — a timing-dependent flake, not
+    a signal. Clearing the window (not the counters) at module teardown
+    keeps the storm pins deterministic.
+    """
+    yield
+    from socceraction_tpu.ops.fused import _pair_probs
+
+    with _pair_probs._lock:
+        _pair_probs._recent.clear()
+
+
+# ---------------------------------------------------------- calibration ----
+
+
+def _calibrated_draws(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    p = rng.uniform(0, 1, n).astype(np.float32)
+    y = (rng.uniform(0, 1, n) < p).astype(np.float32)
+    return p, y
+
+
+def test_reliability_curve_masses_and_empty_bins():
+    p = np.asarray([0.05, 0.05, 0.95, 0.95], np.float32)
+    y = np.asarray([0.0, 1.0, 1.0, 1.0], np.float32)
+    conf, acc, w = reliability_curve(p, y, n_bins=10)
+    assert conf.shape == (10,)
+    assert w.sum() == pytest.approx(4.0)
+    assert w[0] == pytest.approx(2.0) and w[9] == pytest.approx(2.0)
+    assert acc[0] == pytest.approx(0.5) and acc[9] == pytest.approx(1.0)
+    assert conf[0] == pytest.approx(0.05) and conf[9] == pytest.approx(0.95)
+    # empty bins report zero mass (callers mask on it)
+    assert np.all(w[1:9] == 0)
+
+
+def test_ece_separates_calibrated_from_anticalibrated():
+    p, y = _calibrated_draws()
+    good = calibration_summary(p, y, n_bins=10, n_boot=32)
+    bad = calibration_summary(p, 1.0 - y, n_bins=10, n_boot=32)
+    assert good.ece < 0.05
+    assert bad.ece > 0.25
+    assert bad.brier > good.brier
+
+
+def test_brier_decomposition_identity():
+    """Murphy: brier ≈ reliability − resolution + uncertainty (binned)."""
+    p, y = _calibrated_draws(seed=3)
+    s = calibration_summary(p, y, n_bins=10, n_boot=8)
+    recomposed = s.brier_reliability - s.brier_resolution + s.brier_uncertainty
+    # equality up to within-bin variance of the continuous forecasts
+    assert recomposed == pytest.approx(s.brier, abs=0.01)
+    assert 0.0 <= s.brier_uncertainty <= 0.25 + 1e-6
+
+
+def test_bootstrap_cis_deterministic_and_ordered():
+    p, y = _calibrated_draws(seed=5)
+    a = calibration_summary(p, y, n_bins=10, n_boot=64, seed=7)
+    b = calibration_summary(p, y, n_bins=10, n_boot=64, seed=7)
+    assert a.ece_ci == b.ece_ci and a.brier_ci == b.brier_ci
+    assert a.ece_ci[0] <= a.ece_ci[1]
+    assert a.brier_ci[0] <= a.brier_ci[1]
+    # a different ensemble seed draws different resamples
+    c = calibration_summary(p, y, n_bins=10, n_boot=64, seed=8)
+    assert c.ece_ci != a.ece_ci
+
+
+def test_zero_weight_rows_contribute_nothing():
+    p, y = _calibrated_draws(seed=9)
+    w = np.ones_like(p)
+    garbage_p = np.concatenate([p, np.full(100, 0.99, np.float32)])
+    garbage_y = np.concatenate([y, np.zeros(100, np.float32)])
+    garbage_w = np.concatenate([w, np.zeros(100, np.float32)])
+    s0 = calibration_summary(p, y, w, n_bins=10, n_boot=4)
+    s1 = calibration_summary(garbage_p, garbage_y, garbage_w, n_bins=10, n_boot=4)
+    assert s1.ece == pytest.approx(s0.ece, abs=1e-6)
+    assert s1.brier == pytest.approx(s0.brier, abs=1e-6)
+    assert s1.n == pytest.approx(s0.n)
+
+
+def test_calibration_validation_errors():
+    p, y = _calibrated_draws(n=16)
+    with pytest.raises(ValueError, match='bins'):
+        calibration_summary(p, y, n_bins=1)
+    with pytest.raises(ValueError, match='resample'):
+        calibration_summary(p, y, n_boot=0)
+    with pytest.raises(ValueError, match='shape'):
+        calibration_summary(p, y[:-1])
+
+
+# ----------------------------------------------------------- warm start ----
+
+
+@pytest.fixture(scope='module')
+def packed_problem():
+    """A small packed batch + labels + a trained reference head."""
+    from socceraction_tpu.core.synthetic import synthetic_batch
+    from socceraction_tpu.ml.mlp import MLPClassifier
+    from socceraction_tpu.ops.labels import scores_concedes
+
+    model = VAEP()
+    names, k = model._kernel_names(), model.nb_prev_actions
+    batch = synthetic_batch(n_games=4, n_actions=256, seed=11)
+    y = np.asarray(scores_concedes(batch)[0], np.float32).reshape(-1)
+    clf = MLPClassifier(hidden=(16,), max_epochs=2, batch_size=512, seed=3)
+    clf.fit_packed(batch, y, names=names, k=k)
+    return batch, y, names, k, clf
+
+
+def _leaves(params):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree.leaves(params)]
+
+
+def test_zero_epoch_warm_start_is_bitwise_noop(packed_problem):
+    """The satellite pin: a zero-new-data incremental fit (warm start +
+    max_epochs=0) returns the provided parameters bit for bit."""
+    from socceraction_tpu.ml.mlp import MLPClassifier
+
+    batch, y, names, k, clf = packed_problem
+    cont = MLPClassifier(hidden=(16,), max_epochs=0, batch_size=512)
+    cont.fit_packed(batch, y, names=names, k=k, init_params=clf.params)
+    for got, want in zip(_leaves(cont.params), _leaves(clf.params)):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_warm_start_never_mutates_the_seed_params(packed_problem):
+    """Dispatch donation must never invalidate the caller's live pytree."""
+    from socceraction_tpu.ml.mlp import MLPClassifier
+
+    batch, y, names, k, clf = packed_problem
+    before = _leaves(clf.params)
+    cont = MLPClassifier(hidden=(16,), max_epochs=2, batch_size=512)
+    cont.fit_packed(
+        batch, y, names=names, k=k,
+        init_params=clf.params, init_opt_state=clf.opt_state_,
+    )
+    after = _leaves(clf.params)
+    for got, want in zip(after, before):
+        np.testing.assert_array_equal(got, want)
+    # and the continuation actually trained
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(_leaves(cont.params), before)
+    )
+
+
+def test_warm_start_architecture_mismatch_raises(packed_problem):
+    from socceraction_tpu.ml.mlp import MLPClassifier
+
+    batch, y, names, k, clf = packed_problem
+    wrong = MLPClassifier(hidden=(8, 8), max_epochs=1, batch_size=512)
+    with pytest.raises(ValueError, match='structure|shapes'):
+        wrong.fit_packed(batch, y, names=names, k=k, init_params=clf.params)
+
+
+def test_vaep_fit_packed_warm_start_inherits_architecture(packed_problem):
+    batch, _y, _names, _k, _clf = packed_problem
+    seed_model = VAEP()
+    seed_model.fit_packed(
+        batch, tree_params={'hidden': (16,), 'max_epochs': 2, 'batch_size': 512},
+        random_state=0,
+    )
+    cont = VAEP()
+    cont.fit_packed(
+        batch, warm_start=seed_model,
+        tree_params={'max_epochs': 0}, random_state=0,
+    )
+    for col, head in cont._models.items():
+        assert head.hidden == (16,)  # architecture inherited, not default
+        for got, want in zip(
+            _leaves(head.params), _leaves(seed_model._models[col].params)
+        ):
+            np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------- registry + candidates ----
+
+
+def _tiny_model(seed_games=(0, 1), hidden=(16,)):
+    frames = [
+        synthetic_actions_frame(
+            game_id=i, home_team_id=HOME, away_team_id=HOME + 1,
+            seed=i, n_actions=200,
+        )
+        for i in seed_games
+    ]
+    model = VAEP()
+    X, y = [], []
+    for i, f in zip(seed_games, frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True), pd.concat(y, ignore_index=True),
+        learner='mlp', tree_params={'hidden': hidden, 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def tiny_model():
+    return _tiny_model()
+
+
+def test_registry_candidate_lifecycle(tmp_path, tiny_model):
+    reg = ModelRegistry(str(tmp_path / 'reg'))
+    reg.publish('vaep', '1', tiny_model)
+    tags = []
+    for i in range(3):
+        tag, path = reg.stage_candidate('vaep', tiny_model, tag=f'cand-{i}')
+        assert os.path.isfile(os.path.join(path, 'meta.json'))
+        tags.append(tag)
+    # candidates are invisible to the version listing
+    assert reg.versions('vaep') == ['1']
+    assert reg.candidates('vaep') == tags
+    assert reg.next_version('vaep') == '2'
+
+    reg.promote_candidate('vaep', '2', 'cand-1')
+    assert reg.versions('vaep') == ['1', '2']
+    assert 'cand-1' not in reg.candidates('vaep')
+    # the promoted bytes load and serve
+    assert reg.load('vaep', '2')._models
+
+    removed = reg.gc_candidates('vaep', keep=1)
+    assert len(removed) == 1
+    assert reg.candidates('vaep') == ['cand-2']
+    # duplicate tags and bad names are refused
+    with pytest.raises(ValueError, match='already staged'):
+        reg.stage_candidate('vaep', tiny_model, tag='cand-2')
+    with pytest.raises(ValueError, match='invalid'):
+        reg.stage_candidate('vaep', tiny_model, tag='.sneaky')
+    with pytest.raises(ValueError, match='immutable'):
+        reg.promote_candidate('vaep', '1', 'cand-2')
+
+
+def test_registry_rollback(tmp_path, tiny_model):
+    reg = ModelRegistry(str(tmp_path / 'reg'))
+    reg.publish('vaep', '1', tiny_model)
+    reg.publish('vaep', '2', tiny_model)
+    with pytest.raises(RuntimeError, match='previous'):
+        reg.rollback()
+    reg.activate('vaep', '1')
+    assert reg.previous() is None
+    reg.activate('vaep', '2')
+    assert reg.previous() == ('vaep', '1')
+
+    # a pinned rollback target that no longer matches "previous" refuses
+    with pytest.raises(RuntimeError, match='changed concurrently'):
+        reg.rollback(expected=('vaep', '9'))
+
+    before = REGISTRY.snapshot().value('serve/model_swaps', reason='rollback')
+    assert reg.rollback(expected=('vaep', '1')) == ('vaep', '1')
+    assert reg.active()[:2] == ('vaep', '1')
+    # a rollback is itself rollback-able
+    assert reg.previous() == ('vaep', '2')
+    after = REGISTRY.snapshot().value('serve/model_swaps', reason='rollback')
+    assert after == before + 1
+
+
+# ------------------------------------------------------- traffic capture ----
+
+
+def _frame(i, n=40):
+    return synthetic_actions_frame(
+        game_id=i, home_team_id=HOME, away_team_id=HOME + 1,
+        seed=i, n_actions=n,
+    )
+
+
+def test_capture_ring_bounds_and_streams():
+    cap = TrafficCapture(max_frames=2, max_sessions=2, max_session_actions=35)
+    for i in range(4):
+        cap.record_frame(_frame(i, n=20), HOME)
+    assert len(cap.frames()) == 2  # oldest two evicted
+    assert all(len(f) == 20 for f, _h in cap.frames())
+
+    # session streams concatenate in arrival order
+    cap.record_session('m1', _frame(10, n=12), HOME)
+    cap.record_session('m1', _frame(11, n=12), HOME)
+    streams = [f for f, _h in cap.frames() if len(f) == 24]
+    assert len(streams) == 1
+
+    # whole leading parts drop first when the stream overflows
+    cap.record_session('m1', _frame(12, n=20), HOME)  # 44 > 35 -> drop 12
+    assert sorted(len(f) for f, _h in cap.frames()) == [20, 20, 32]
+
+    # a single oversized part keeps its newest rows
+    cap.record_session('m2', _frame(13, n=50), HOME)
+    assert 35 in [len(f) for f, _h in cap.frames()]
+
+    # the session bound evicts the least-recently-updated stream (m1)
+    cap.record_session('m3', _frame(14, n=5), HOME)
+    assert len(cap) == 2 + 2  # 2 frames + 2 sessions
+    assert cap.total_actions == 20 + 20 + 35 + 5
+    cap.clear()
+    assert len(cap) == 0 and cap.total_actions == 0
+
+
+# ----------------------------------------------------------- ingest -----
+
+
+def test_watcher_poll_commit_prime(tmp_path):
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=3, n_actions=64)
+    with SeasonStore(store_path, mode='a') as store:
+        fresh = SeasonWatcher(store)
+        assert len(fresh.poll()) == 3
+        fresh.commit(fresh.poll())
+        assert fresh.poll() == []
+        primed = SeasonWatcher(store, prime=True)
+        assert primed.poll() == []
+        new_ids = append_synthetic_games(store_path, 2, n_actions=64, seed=50)
+        assert set(primed.poll()) == set(new_ids)
+        # poll is read-only: nothing is consumed until commit
+        assert set(primed.poll()) == set(new_ids)
+
+
+def test_extend_packed_is_incremental_and_bitwise(tmp_path):
+    store_path = str(tmp_path / 'season')
+    cache = str(tmp_path / 'cache')
+    cold_cache = str(tmp_path / 'cache-cold')
+    write_synthetic_season(store_path, n_games=5, n_actions=64)
+    with SeasonStore(store_path, mode='a') as store:
+        season, reused, packed = extend_packed(
+            store, max_actions=64, cache_dir=cache
+        )
+        assert (reused, packed) == (0, 5)
+        # a valid cache short-circuits
+        season, reused, packed = extend_packed(
+            store, max_actions=64, cache_dir=cache
+        )
+        assert (reused, packed) == (5, 0)
+
+        new_ids = append_synthetic_games(store_path, 2, n_actions=64, seed=9)
+    with SeasonStore(store_path, mode='a') as store:
+        season, reused, packed = extend_packed(
+            store, max_actions=64, cache_dir=cache
+        )
+        assert (reused, packed) == (5, 2)
+        assert set(new_ids) <= set(season.game_ids)
+
+        # incremental extension is bit-identical to a cold full build
+        from socceraction_tpu.pipeline.packed import ensure_packed
+
+        cold = ensure_packed(store, max_actions=64, cache_dir=cold_cache)
+        ids = season.game_ids
+        import jax
+
+        a, _ = season.take(ids)
+        b, _ = cold.take(ids)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ----------------------------------------------------------- shadow -----
+
+
+def test_shadow_replay_bitwise_stable(tiny_model):
+    frames = [(_frame(20, n=60), HOME), (_frame(21, n=80), HOME)]
+    one = shadow_replay(tiny_model, frames, max_actions=128, n_boot=16)
+    two = shadow_replay(tiny_model, frames, max_actions=128, n_boot=16)
+    assert one.n_frames == 2 and one.n_actions == 140
+    for col in one.probs:
+        np.testing.assert_array_equal(one.probs[col], two.probs[col])
+    assert one.summaries.keys() == {'scores', 'concedes'}
+    for col, s in one.summaries.items():
+        assert s.to_dict() == two.summaries[col].to_dict()
+        assert s.n == pytest.approx(140.0)
+
+
+def test_pack_replay_batch_truncates_and_validates():
+    long = _frame(30, n=100)
+    batch = pack_replay_batch([(long, HOME)], max_actions=64)
+    assert batch.n_games == 1
+    assert int(batch.n_actions[0]) == 64
+    with pytest.raises(ValueError, match='traffic'):
+        pack_replay_batch([], max_actions=64)
+    with pytest.raises(ValueError, match='exactly one'):
+        shadow_replay(None, None)
+
+
+# ------------------------------------------------------------- gate -----
+
+
+def _summary(ece, brier, n=1000.0):
+    return CalibrationSummary(
+        n=n, ece=ece, brier=brier,
+        brier_reliability=ece, brier_resolution=0.0, brier_uncertainty=brier,
+        ece_ci=(ece * 0.8, ece * 1.2), brier_ci=(brier * 0.9, brier * 1.1),
+    )
+
+
+def test_gate_blocks_regressions_and_passes_improvements():
+    cfg = GateConfig(max_ece_regression=0.01, max_brier_regression=0.005)
+    active = {'scores': _summary(0.05, 0.10), 'concedes': _summary(0.04, 0.08)}
+
+    better = {'scores': _summary(0.03, 0.09), 'concedes': _summary(0.04, 0.08)}
+    passed, reasons = evaluate_gate(active, better, cfg)
+    assert passed and reasons == []
+
+    worse_ece = {'scores': _summary(0.09, 0.10), 'concedes': _summary(0.04, 0.08)}
+    passed, reasons = evaluate_gate(active, worse_ece, cfg)
+    assert not passed and 'ECE regressed' in reasons[0]
+
+    worse_brier = {'scores': _summary(0.05, 0.12), 'concedes': _summary(0.04, 0.08)}
+    passed, reasons = evaluate_gate(active, worse_brier, cfg)
+    assert not passed and 'Brier regressed' in reasons[0]
+
+    # within-band drift passes
+    drift = {'scores': _summary(0.055, 0.102), 'concedes': _summary(0.045, 0.083)}
+    passed, _ = evaluate_gate(active, drift, cfg)
+    assert passed
+
+    # bootstrap (no baseline) passes with the reason recorded
+    passed, reasons = evaluate_gate(None, better, cfg)
+    assert passed and 'bootstrap' in reasons[0]
+
+    # too little replay evidence fails CLOSED
+    small = {'scores': _summary(0.03, 0.09, n=8.0), 'concedes': _summary(0.04, 0.08)}
+    passed, reasons = evaluate_gate(active, small, cfg)
+    assert not passed and 'too small' in reasons[0]
+
+
+# ------------------------------------------------------- the full loop ----
+
+
+def test_full_continuous_learning_loop(tmp_path):
+    """The acceptance run: one CPU process drives the entire loop."""
+    from socceraction_tpu.obs.trace import RunLog
+
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=6, n_actions=A_MAX, seed=0)
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    debug_dir = str(tmp_path / 'debug')
+    base = dict(
+        model_name='vaep', max_actions=A_MAX, games_per_batch=4,
+        random_state=0, debug_dir=debug_dir,
+        gate=GateConfig(n_boot=32, max_ece_regression=0.05,
+                        max_brier_regression=0.02),
+    )
+    # enough epochs that the baseline is genuinely trained — the gate can
+    # only separate candidates around a real model (early stop bounds it)
+    good_cfg = LearnConfig(
+        **base,
+        train_params={
+            'hidden': (16,), 'max_epochs': 40, 'batch_size': 512,
+            'patience': 8,
+        },
+    )
+    # a deliberately degraded candidate: fresh random init, zero epochs
+    bad_cfg = LearnConfig(
+        **{**base, 'warm_start': False},
+        train_params={'hidden': (16,), 'max_epochs': 0, 'batch_size': 1024},
+    )
+
+    with SeasonStore(store_path, mode='a') as store:
+        # ---- bootstrap: first model version, promoted without baseline
+        boot = ContinuousLearner(store, registry, config=good_cfg)
+        r1 = boot.run_once()
+        assert r1.verdict == 'promoted' and r1.candidate_version == '1'
+        assert registry.active()[:2] == ('vaep', '1')
+
+        # ---- serve live traffic with capture on
+        capture = TrafficCapture(max_frames=32)
+        with RatingService(
+            registry=registry, max_actions=A_MAX, max_batch_size=4,
+            max_wait_ms=1.0, capture=capture, debug_dir=debug_dir,
+        ) as svc:
+            svc.warmup()
+            req = _frame(70, n=120)
+            out_v1 = svc.rate_sync(req, home_team_id=HOME, timeout=60)
+            sess = svc.open_session('live-1', home_team_id=HOME)
+            live = _frame(71, n=90)
+            sess.add_actions(live.iloc[:50], timeout=60)
+            sess.add_actions(live.iloc[50:], timeout=60)
+            assert len(capture) == 2
+            assert capture.total_actions == 210
+
+            learner_bad = ContinuousLearner(
+                store, registry, service=svc, config=bad_cfg
+            )
+            learner_good = ContinuousLearner(
+                store, registry, service=svc, config=good_cfg
+            )
+            # both watchers primed: nothing new yet
+            assert learner_bad.run_once().verdict == 'no_new_data'
+
+            new_ids = append_synthetic_games(
+                store_path, 3, n_actions=A_MAX, seed=77
+            )
+
+            RECORDER.clear()
+            with RunLog(str(tmp_path / 'obs.jsonl')) as _log:
+                # ---- the gate BLOCKS the degraded candidate
+                r_bad = learner_bad.run_once()
+                assert r_bad.verdict == 'rejected'
+                assert r_bad.reasons  # names the regressed metric(s)
+                assert r_bad.candidate_version is None
+                assert registry.active()[:2] == ('vaep', '1')
+                assert r_bad.replay['source'] == 'capture'
+                # rejected candidates stay staged (bounded by retention)
+                assert registry.candidates('vaep')
+                # a failed promotion auto-dumps the flight recorder
+                assert glob.glob(os.path.join(debug_dir, 'debug-*.tar.gz'))
+
+                # ---- a genuine warm-started retrain is PROMOTED
+                shapes_before = svc.compiled_shapes
+                r_good = learner_good.run_once()
+                assert r_good.verdict == 'promoted'
+                assert r_good.candidate_version == '2'
+                assert set(r_good.new_games) == set(new_ids)
+                assert registry.active()[:2] == ('vaep', '2')
+                assert r_good.stage_seconds.keys() >= {
+                    'ingest', 'train', 'shadow', 'gate', 'publish'
+                }
+                # incremental ingest reused every previously packed game
+                snap = REGISTRY.snapshot()
+                assert snap.value('learn/cache_games', source='reused') >= 6
+
+            # ---- the swap is live and steady state compiles nothing new
+            out_v2 = svc.rate_sync(req, home_team_id=HOME, timeout=60)
+            assert svc.compiled_shapes == shapes_before
+            assert not np.array_equal(out_v2.to_numpy(), out_v1.to_numpy())
+
+            # ---- rollback restores version 1 bitwise
+            name, version = learner_good.rollback()
+            assert (name, version) == ('vaep', '1')
+            assert registry.active()[:2] == ('vaep', '1')
+            out_back = svc.rate_sync(req, home_team_id=HOME, timeout=60)
+            np.testing.assert_array_equal(
+                out_back.to_numpy(), out_v1.to_numpy()
+            )
+            assert svc.compiled_shapes == shapes_before
+            snap = REGISTRY.snapshot()
+            assert snap.value('serve/model_swaps', reason='rollback') >= 1
+
+    # ---- every decision is on the record
+    kinds = [e['kind'] for e in RECORDER.events()]
+    assert kinds.count('promotion_report') >= 2
+    assert 'rollback' in kinds
+    snap = REGISTRY.snapshot()
+    assert snap.value('learn/promotions', verdict='rejected') >= 1
+    assert snap.value('learn/promotions', verdict='promoted') >= 1
+
+    # ---- and obsctl tails it from the run log
+    import tools.obsctl as obsctl
+
+    runlog = str(tmp_path / 'obs.jsonl')
+    assert obsctl.main(['promotions', runlog]) == 0
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert obsctl.main(['promotions', runlog, '--json']) == 0
+    reports = [json.loads(l) for l in buf.getvalue().splitlines() if l.strip()]
+    verdicts = [r['verdict'] for r in reports]
+    assert 'rejected' in verdicts and 'promoted' in verdicts
+    rejected = next(r for r in reports if r['verdict'] == 'rejected')
+    heads = rejected['heads']
+    for col in ('scores', 'concedes'):
+        assert 'ece_ci' in heads[col]['candidate']
+        assert 'delta_ece' in heads[col]
+
+
+def test_loop_fails_closed_without_replay_traffic(tmp_path, tiny_model):
+    """No replay window ⇒ a recorded rejection, never an exception (and
+    the consumed games are not retrained forever)."""
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=2, n_actions=64)
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    registry.publish('vaep', '1', tiny_model)
+    registry.activate('vaep', '1')
+    with SeasonStore(store_path, mode='a') as store:
+        learner = ContinuousLearner(
+            store, registry,
+            config=LearnConfig(
+                max_actions=64, games_per_batch=2, warm_start=False,
+                fallback_replay_games=0,  # and no capture attached
+                train_params={
+                    'hidden': (16,), 'max_epochs': 0, 'batch_size': 256,
+                },
+            ),
+            prime_watcher=False,  # the stored games count as new
+        )
+        report = learner.run_once()
+        assert report.verdict == 'rejected'
+        assert 'no replay traffic' in report.reasons[0]
+        assert registry.active()[:2] == ('vaep', '1')
+        # the data was consumed: the next iteration is a no-op
+        assert learner.run_once().verdict == 'no_new_data'
+
+
+def test_newest_game_ids_is_numeric_aware():
+    """The store fallback must replay the games that actually landed
+    last, not the tail of the lexicographic key listing."""
+    from socceraction_tpu.learn import newest_game_ids
+
+    ids = [9000, 9999, 10000, 12072, 'friendly-b', 'friendly-a']
+    assert newest_game_ids(ids, 2) == ['friendly-a', 'friendly-b']
+    assert newest_game_ids([9000, 9999, 10000, 12072], 2) == [10000, 12072]
+    assert newest_game_ids(ids, 0) == []
+
+
+def test_publish_failure_recorded_then_raised(tmp_path, tiny_model, monkeypatch):
+    """A gate-passing candidate whose publish raises still leaves a typed
+    report (verdict='publish_failed') before the error surfaces."""
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=2, n_actions=64)
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    registry.publish('vaep', '1', tiny_model)
+    registry.activate('vaep', '1')
+    with SeasonStore(store_path, mode='a') as store:
+        learner = ContinuousLearner(
+            store, registry,
+            config=LearnConfig(
+                max_actions=64, games_per_batch=2,
+                fallback_replay_games=2,
+                # warm start + zero epochs: candidate == active bitwise,
+                # so the gate passes deterministically
+                train_params={'max_epochs': 0},
+                gate=GateConfig(n_boot=8),
+            ),
+            prime_watcher=False,
+        )
+
+        def boom(*_a, **_k):
+            raise RuntimeError('registry volume is full')
+
+        monkeypatch.setattr(registry, 'promote_candidate', boom)
+        with pytest.raises(RuntimeError, match='volume is full'):
+            learner.run_once()
+    assert learner.last_report is not None
+    assert learner.last_report.verdict == 'publish_failed'
+    assert 'volume is full' in learner.last_report.reasons[0]
+    assert registry.active()[:2] == ('vaep', '1')
+    snap = REGISTRY.snapshot()
+    assert snap.value('learn/promotions', verdict='publish_failed') >= 1
+
+
+def test_loop_noop_keeps_active_model_untouched(tmp_path, tiny_model):
+    """Zero new data ⇒ the loop is a bitwise no-op on the serving model."""
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=2, n_actions=64)
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    registry.publish('vaep', '1', tiny_model)
+    registry.activate('vaep', '1')
+    active_before = registry.active()[2]
+    with SeasonStore(store_path, mode='a') as store:
+        learner = ContinuousLearner(
+            store, registry,
+            config=LearnConfig(max_actions=64, games_per_batch=2),
+        )
+        report = learner.run_once()
+    assert report.verdict == 'no_new_data'
+    assert registry.active()[2] is active_before  # same object, no retrain
+    assert registry.versions('vaep') == ['1']

@@ -63,6 +63,13 @@ def test_serve_area_is_registered():
     assert 'serve' in tool.KNOWN_AREAS
 
 
+def test_learn_area_is_registered():
+    """The continuous-learning loop's metrics (``learn/*``) are governed
+    by the lint gate from day one (ISSUE 6 satellite)."""
+    tool = _tool()
+    assert 'learn' in tool.KNOWN_AREAS
+
+
 def test_xla_and_mem_areas_are_registered():
     """The runtime introspection areas (``xla/*`` compile observatory,
     ``mem/*`` device-memory accounting) are governed (ISSUE 5 satellite)."""

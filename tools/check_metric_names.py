@@ -58,6 +58,7 @@ NAME_TAKING_CALLS = {
 #: tests' scratch files — checks convention and units only.
 KNOWN_AREAS = {
     'bench',  # bench.py headline gauges
+    'learn',  # continuous-learning loop (learn/: ingest/train/shadow/gate)
     'mem',  # device-memory accounting (obs/memory.py)
     'pipeline',  # store/feed/cache stage timings
     'serve',  # online rating service (batcher/session/registry/service)

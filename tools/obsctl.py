@@ -10,10 +10,15 @@ required writing Python. ``obsctl`` is the no-Python surface::
     python tools/obsctl.py tail obs.jsonl -n 30  # recent events, readable
     python tools/obsctl.py prom obs.jsonl        # Prometheus text
     python tools/obsctl.py bundle /tmp/socceraction-tpu-debug  # post-mortem
+    python tools/obsctl.py promotions obs.jsonl  # gate decisions, readable
 
-``snapshot``/``tail``/``bundle`` accept ``--json`` for machine-readable
-output (``prom`` *is* a machine format already); the default rendering
-is a compact human table. ``bundle`` accepts either a bundle file or a
+``snapshot``/``tail``/``bundle``/``promotions`` accept ``--json`` for
+machine-readable output (``prom`` *is* a machine format already); the
+default rendering is a compact human table. ``promotions`` tails the
+continuous-learning loop's typed promotion reports (verdict, per-head
+ECE/Brier deltas, bootstrap CI bounds, published version) from a run
+log — the operator's answer to "why did the last rollout (not) go
+out?". ``bundle`` accepts either a bundle file or a
 directory (the newest ``debug-*.tar.gz`` by mtime wins) and
 prints the manifest's trigger (what fired the dump), the queue state at
 dump time and the tail of the event ring.
@@ -194,6 +199,66 @@ def _cmd_tail(args: argparse.Namespace) -> int:
     return 0
 
 
+def _fmt_promotion(event: Dict[str, Any]) -> str:
+    """One human-readable line block per promotion report."""
+    lines = []
+    verdict = event.get('verdict', '?')
+    version = event.get('candidate_version')
+    target = (
+        f'{event.get("name", "?")}/{version}'
+        if version
+        else f'{event.get("name", "?")} (tag {event.get("candidate_tag")})'
+    )
+    head_line = (
+        f'{_fmt_ts(event.get("ts") or event.get("time_unix"))}  '
+        f'{verdict.upper().ljust(11)} {target}'
+    )
+    active = event.get('active_version')
+    if active:
+        head_line += f'  (active was {active})'
+    lines.append(head_line)
+    replay = event.get('replay') or {}
+    if replay:
+        lines.append(
+            f'  replay : {replay.get("frames", "?")} frame(s), '
+            f'{replay.get("actions", "?")} action(s) '
+            f'from {replay.get("source", "?")}'
+        )
+    for head, entry in sorted((event.get('heads') or {}).items()):
+        cand = entry.get('candidate') or {}
+        parts = [f'  {head.ljust(9)}: ece {cand.get("ece", float("nan")):.4f}']
+        ci = cand.get('ece_ci')
+        if ci:
+            parts.append(f'ci [{ci[0]:.4f}, {ci[1]:.4f}]')
+        if 'delta_ece' in entry:
+            parts.append(f'Δece {entry["delta_ece"]:+.4f}')
+        parts.append(f'brier {cand.get("brier", float("nan")):.4f}')
+        if 'delta_brier' in entry:
+            parts.append(f'Δbrier {entry["delta_brier"]:+.4f}')
+        lines.append('  '.join(parts))
+    for reason in event.get('reasons') or []:
+        lines.append(f'  reason : {reason}')
+    return '\n'.join(lines)
+
+
+def _cmd_promotions(args: argparse.Namespace) -> int:
+    """``promotions <runlog> [-n N]``: tail the loop's promotion reports."""
+    reports = [
+        e
+        for e in _read_events(args.runlog)
+        if e.get('event') == 'promotion_report'
+        or e.get('kind') == 'promotion_report'
+    ][-args.n :]
+    if args.json:
+        for event in reports:
+            print(json.dumps(event, sort_keys=True, default=str))
+        return 0
+    for event in reports:
+        print(_fmt_promotion(event))
+    print(f'obsctl promotions: {len(reports)} report(s) from {args.runlog}')
+    return 0
+
+
 def _resolve_bundle(path: str) -> Optional[str]:
     if os.path.isdir(path):
         # newest by mtime: filenames start with the writing PID, so a
@@ -287,6 +352,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument('-n', type=int, default=20)
     p.add_argument('--json', action='store_true')
     p.set_defaults(fn=_cmd_tail)
+
+    p = sub.add_parser(
+        'promotions', help="tail the continuous-learning loop's gate decisions"
+    )
+    p.add_argument('runlog')
+    p.add_argument('-n', type=int, default=10)
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_promotions)
 
     p = sub.add_parser('bundle', help='summarize a flight-recorder bundle')
     p.add_argument('path', help='bundle file or directory of bundles')
