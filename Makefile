@@ -52,6 +52,7 @@ bench:
 bench-smoke:
 	$(PY) bench.py --train-smoke
 	$(PY) bench.py --serve-smoke
+	$(PY) bench.py --xt-smoke
 
 # one abbreviated continuous-learning loop iteration on CPU: land new
 # matches -> incremental ingest -> warm-started fit_packed -> shadow

@@ -365,7 +365,7 @@ def _bench_extra_configs() -> dict:
         return solve_xt(probs)
 
     dt, reliable = _measure(fit_16x12, xt_args, n_iters=5)
-    _, it = fit_16x12(*xt_args)
+    it = fit_16x12(*xt_args).iterations
     out['xt_fit_16x12_dense'] = {
         'games': xt_games,
         'actions': n_actions,
@@ -385,7 +385,7 @@ def _bench_extra_configs() -> dict:
         )
     )
     dt_mf, mf_reliable = _measure(mf, xt_args, n_iters=3)
-    n_iters_mf = int(mf(*xt_args)[1])
+    n_iters_mf = int(mf(*xt_args)[0].iterations)
     out['xt_fit_192x125_matrix_free_100iter'] = {
         'games': xt_games,
         'actions': n_actions,
@@ -405,7 +405,7 @@ def _bench_extra_configs() -> dict:
         )
     )
     dt_acc, acc_reliable = _measure(mf_acc, xt_args, n_iters=3)
-    sweeps_acc = int(mf_acc(*xt_args)[1])
+    sweeps_acc = int(mf_acc(*xt_args)[0].iterations)
     out['xt_fit_192x125_anderson_converged'] = {
         'games': xt_games,
         'eps': 1e-5,
@@ -416,6 +416,19 @@ def _bench_extra_configs() -> dict:
         'converged': sweeps_acc < 100,
         **({} if acc_reliable else {'measurement_unreliable': True}),
     }
+
+    # --- batched xT: a fleet of grids per dispatch (ISSUE 7) --------------
+    xt_batch_sizes = tuple(
+        int(x) for x in os.environ.get(
+            'SOCCERACTION_TPU_BENCH_XT_BATCH', '1,64,1024'
+        ).split(',')
+    )
+    xt_batch_games = int(
+        os.environ.get('SOCCERACTION_TPU_BENCH_XT_BATCH_GAMES', 1024)
+    )
+    out['xt_batched_grids'] = _bench_xt_batched(
+        batch_sizes=xt_batch_sizes, n_games=xt_batch_games
+    )
 
     # --- VAEP MLP training, both paths (BASELINE config 5 + the fused
     # --- packed-train rework) ---------------------------------------------
@@ -428,6 +441,182 @@ def _bench_extra_configs() -> dict:
 
     learn_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_LEARN_GAMES', 24))
     out['continuous_learning'] = _bench_continuous_learning(games=learn_games)
+    return out
+
+
+def _bench_xt_batched(
+    *,
+    batch_sizes: tuple = (1, 64, 1024),
+    n_games: int = 1024,
+    n_actions: int = 512,
+    l: int = 16,
+    w: int = 12,
+    sequential_at: int = 64,
+) -> dict:
+    """Batched xT: grids/s per (solver variant, fleet size), one dispatch each.
+
+    Groups a synthetic season's actions by game index into 1/64/1024
+    groups and solves the whole ``(G, w, l)`` fleet with every solver
+    variant (:data:`socceraction_tpu.ops.xt.SOLVERS`), dense AND
+    matrix-free — recording seconds per solve, grids/s, and the
+    sweeps-to-converge A/B (the anchored/momentum variants additionally
+    pay an uncounted 8-sweep modulus prologue, so their sweep numbers
+    carry ``+ prologue`` context in ``docs/xt.md``).
+
+    Two structural gates ride along for ``--xt-smoke``:
+
+    - ``signatures_per_fn`` vs ``expected_signatures_per_fn``: the batch
+      axis must be ONE compiled signature per (solver, fleet size) —
+      1024 grids are one program, not 1024.
+    - ``steady_state_compiles``: re-solving every already-warm config
+      must compile nothing.
+
+    Plus the throughput acceptance record: ``sequential_at`` grids
+    solved one-by-one (a warm Python loop of single-grid fits) vs the
+    batched solve at the same size → ``speedup_vs_sequential``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from socceraction_tpu.core.synthetic import synthetic_batch
+    from socceraction_tpu.obs.xla import observatory_snapshot
+    from socceraction_tpu.ops.xt import (
+        SOLVERS,
+        XTProbabilities,
+        solve_xt,
+        solve_xt_matrix_free,
+        xt_counts,
+        xt_probabilities,
+    )
+
+    season = synthetic_batch(n_games=n_games, n_actions=n_actions, seed=11)
+    args = (
+        season.type_id, season.result_id,
+        season.start_x, season.start_y, season.end_x, season.end_y,
+        season.mask,
+    )
+    game_idx = jnp.arange(n_games, dtype=jnp.int32)[:, None]
+
+    def obs_counts() -> dict:
+        snap = observatory_snapshot()
+        return {
+            fn: (
+                snap.get(fn, {}).get('compiles', 0),
+                len(snap.get(fn, {}).get('signatures', ())),
+            )
+            for fn in ('solve_xt', 'solve_xt_matrix_free')
+        }
+
+    out = {
+        'grid': f'{l}x{w}',
+        'games': n_games,
+        'actions': int(season.total_actions),
+        'batch_sizes': list(batch_sizes),
+        'levels': [],
+    }
+    before = obs_counts()
+    probs_by_size = {}
+    gid_by_size = {}
+    for G in batch_sizes:
+        gid = jnp.broadcast_to(game_idx % G, season.type_id.shape)
+        gid_by_size[G] = gid
+        counts = xt_counts(*args, l=l, w=w, group_id=gid, n_groups=G)
+        probs = xt_probabilities(counts, l=l, w=w)
+        probs_by_size[G] = probs
+        level = {'n_grids': G, 'solvers': {}}
+        for solver in SOLVERS:
+            dt, reliable = _measure(
+                lambda p, _s=solver: solve_xt(p, solver=_s), (probs,), n_iters=3
+            )
+            sol = solve_xt(probs, solver=solver)
+            entry = {
+                'seconds_per_solve': round(dt, 5),
+                'grids_per_sec': round(G / dt, 1),
+                'sweeps_to_converge_max': int(jnp.max(sol.iterations)),
+                'converged_grids': int(jnp.sum(sol.converged)),
+                **({} if reliable else {'measurement_unreliable': True}),
+            }
+            dt_mf, rel_mf = _measure(
+                lambda *a, _s=solver, _g=gid, _n=G: solve_xt_matrix_free(
+                    *a, l=l, w=w, solver=_s, group_id=_g, n_groups=_n
+                ),
+                args,
+                n_iters=3,
+            )
+            msol, _ = solve_xt_matrix_free(
+                *args, l=l, w=w, solver=solver, group_id=gid, n_groups=G
+            )
+            entry['matrix_free'] = {
+                'seconds_per_solve': round(dt_mf, 5),
+                'grids_per_sec': round(G / dt_mf, 1),
+                'sweeps_to_converge_max': int(jnp.max(msol.iterations)),
+                **({} if rel_mf else {'measurement_unreliable': True}),
+            }
+            level['solvers'][solver] = entry
+        out['levels'].append(level)
+    after_warm = obs_counts()
+
+    # steady state: every warm config again — nothing may compile, and the
+    # signature count must be one per (solver, fleet size), not per grid
+    for G in batch_sizes:
+        for solver in SOLVERS:
+            solve_xt(probs_by_size[G], solver=solver)
+            solve_xt_matrix_free(
+                *args, l=l, w=w, solver=solver,
+                group_id=gid_by_size[G], n_groups=G,
+            )
+    after_steady = obs_counts()
+    out['signatures_per_fn'] = {
+        fn: after_warm[fn][1] - before[fn][1] for fn in after_warm
+    }
+    out['expected_signatures_per_fn'] = len(batch_sizes) * len(SOLVERS)
+    out['steady_state_compiles'] = sum(
+        after_steady[fn][0] - after_warm[fn][0] for fn in after_steady
+    )
+
+    if sequential_at in batch_sizes:
+        # the acceptance A/B: what the batched path replaces is a Python
+        # loop of per-scenario FITS — each one re-scanning the whole
+        # action stream for its group's counts, building probabilities
+        # and solving a single grid — vs ONE grouped scatter + ONE fleet
+        # solve. Both sides measured end-to-end (counts + probs + solve).
+        G = sequential_at
+        gid = gid_by_size[G]
+        stream, mask = args[:6], args[6]
+
+        def fit_batched() -> float:
+            counts = xt_counts(*args, l=l, w=w, group_id=gid, n_groups=G)
+            probs = xt_probabilities(counts, l=l, w=w)
+            return float(jnp.sum(solve_xt(probs).grid))
+
+        def sequential_pass() -> float:
+            acc = 0.0
+            for g in range(G):
+                counts = xt_counts(*stream, mask & (gid == g), l=l, w=w)
+                probs = xt_probabilities(counts, l=l, w=w)
+                acc += float(jnp.sum(solve_xt(probs).grid))
+            return acc
+
+        fit_batched()  # both sides warm before timing
+        sequential_pass()
+        t0 = time.perf_counter()
+        fit_batched()
+        batched_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sequential_pass()
+        seq_wall = time.perf_counter() - t0
+        solve_s = next(
+            lv for lv in out['levels'] if lv['n_grids'] == G
+        )['solvers']['picard']['seconds_per_solve']
+        out['sequential_baseline'] = {
+            'n_grids': G,
+            'seconds_total': round(seq_wall, 4),
+            'grids_per_sec': round(G / seq_wall, 1),
+            'batched_fit_seconds': round(batched_wall, 4),
+            'batched_solve_seconds': solve_s,
+            'speedup_vs_sequential': round(seq_wall / batched_wall, 1)
+            if batched_wall else None,
+        }
     return out
 
 
@@ -1225,6 +1414,8 @@ def _cpu_env() -> dict:
         'SOCCERACTION_TPU_BENCH_FORCE_EXTRAS',
         'SOCCERACTION_TPU_BENCH_GAMES',
         'SOCCERACTION_TPU_BENCH_XT_GAMES',
+        'SOCCERACTION_TPU_BENCH_XT_BATCH',
+        'SOCCERACTION_TPU_BENCH_XT_BATCH_GAMES',
         'SOCCERACTION_TPU_BENCH_STEP_GAMES',
         'SOCCERACTION_TPU_BENCH_COLD_GAMES',
         'SOCCERACTION_TPU_BENCH_COLD_CHUNK',
@@ -1406,12 +1597,63 @@ def _serve_smoke() -> None:
     )
 
 
+def _xt_smoke() -> None:
+    """``make bench-smoke``: the batched-xT sweep at CPU scale.
+
+    Drives the whole batch-native xT layer — grouped one-scatter counts,
+    the four solver variants, the one-``while_loop`` fleet solve — at
+    1/8/64 grids and asserts the structural acceptance gates: one
+    compiled signature per (solver, fleet size) and zero steady-state
+    retraces across batch sizes (the batch axis must be one signature,
+    not 64). Same clean-CPU re-exec recipe as :func:`_train_smoke`.
+    """
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    axon_disabled = os.environ.get('PALLAS_AXON_POOL_IPS', 'unset') == ''
+    if not (platforms == 'cpu' and axon_disabled):
+        here = os.path.dirname(os.path.abspath(__file__))
+        rc = subprocess.call(
+            [sys.executable, os.path.join(here, 'bench.py'), '--xt-smoke'],
+            env=_cpu_env(),
+            cwd=here,
+        )
+        sys.exit(rc)
+    out = _bench_xt_batched(
+        batch_sizes=(1, 8, 64), n_games=64, n_actions=512
+    )
+    expected = out['expected_signatures_per_fn']
+    for fn, n_sigs in out['signatures_per_fn'].items():
+        assert n_sigs == expected, (
+            f'{fn} compiled {n_sigs} signatures for {expected} '
+            '(solver, fleet size) configs — the batch axis leaked shapes'
+        )
+    assert out['steady_state_compiles'] == 0, (
+        f'{out["steady_state_compiles"]} compiles while re-solving warm '
+        'batched configs — the fleet solve retraced'
+    )
+    top = out['levels'][-1]
+    print(
+        json.dumps(
+            {
+                'metric': 'xt_batched_grids_per_sec',
+                'value': top['solvers']['picard']['grids_per_sec'],
+                'unit': 'grids/sec',
+                'platform': 'cpu',
+                'smoke': True,
+                **out,
+            }
+        )
+    )
+
+
 def main() -> None:
     if '--train-smoke' in sys.argv:
         _train_smoke()
         return
     if '--serve-smoke' in sys.argv:
         _serve_smoke()
+        return
+    if '--xt-smoke' in sys.argv:
+        _xt_smoke()
         return
     if '--learn-smoke' in sys.argv:
         _learn_smoke()

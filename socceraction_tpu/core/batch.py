@@ -37,6 +37,7 @@ __all__ = [
     'AtomicActionBatch',
     'pack_actions',
     'pack_atomic_actions',
+    'pack_row_values',
     'unpack_values',
     'pad_length',
     'bucket_games',
@@ -368,6 +369,38 @@ def pad_batch_games(batch: Any, n_games: int) -> Any:
 
     padded = jax.tree.map(pad, batch)
     return padded.replace(row_index=pad(batch.row_index, fill=-1))
+
+
+def pack_row_values(values: Any, batch: ActionBatch, *, fill: Any = 0) -> np.ndarray:
+    """Scatter per-row values into a batch's ``(G, A)`` layout.
+
+    The inverse of :func:`unpack_values`: ``values`` is aligned with the
+    positional row order of the DataFrame that was packed (one entry per
+    valid action), and comes back as a ``(G, A)`` host array with
+    ``fill`` in every padding slot — ready to ride along the batch into
+    a kernel (e.g. the per-action ``group_id`` of a batched xT fit).
+
+    Parameters
+    ----------
+    values : array-like
+        Shape ``(total_actions,)``, one value per source-frame row.
+    batch : ActionBatch
+        The batch whose layout to scatter into.
+    fill
+        Value for padding slots (default 0; grouped xT uses ``-1``,
+        the "in no group" id every kernel drops).
+    """
+    vals = np.asarray(values)
+    ri = np.asarray(jax.device_get(batch.row_index))
+    valid = ri >= 0
+    if vals.shape[:1] != (int(valid.sum()),):
+        raise ValueError(
+            f'got {vals.shape[0]} values for a batch of {int(valid.sum())} '
+            'valid actions'
+        )
+    out = np.full(ri.shape, fill, dtype=vals.dtype)
+    out[valid] = vals[ri[valid]]
+    return out
 
 
 def unpack_values(values: Any, batch: ActionBatch) -> np.ndarray:

@@ -79,7 +79,7 @@ class Span:
         Returns ``value`` unchanged so it can wrap an expression inline::
 
             with span('xt/fit') as sp:
-                grid = sp.sync(solve_xt(probs))
+                solution = sp.sync(solve_xt(probs))
 
         At span exit only these values are ``jax.block_until_ready``-ed,
         so the recorded duration charges this span's device work — never

@@ -68,6 +68,7 @@ __all__ = [
     'segment_sum_pallas',
     'segment_sum_xla',
     'segment_sum_rows',
+    'segment_sum_2d',
 ]
 
 CHUNK = 512  # actions per grid step
@@ -197,6 +198,53 @@ def segment_sum(
             interpret=jax.default_backend() != 'tpu',
         )
     return segment_sum_xla(values, segment_ids, num_segments)
+
+
+def segment_sum_2d(
+    values: jax.Array,
+    row_ids: jax.Array,
+    col_ids: jax.Array,
+    n_rows: int,
+    n_cols: int,
+    *,
+    method: Optional[str] = None,
+) -> jax.Array:
+    """Sum ``values`` into an ``(n_rows, n_cols)`` grid by ``(row, col)`` id.
+
+    The two-index form of :func:`segment_sum`: one scatter-add over the
+    flattened id ``row * n_cols + col``, so a whole *stack* of segment
+    sums (e.g. the batched xT count matrices, one per group) costs a
+    single dispatch instead of one scatter per row. Dispatches through
+    :func:`segment_sum`, so the Pallas-vs-XLA selection (and the
+    ``SOCCERACTION_TPU_SEGMENT`` override) applies to the flattened
+    ``n_rows * n_cols`` segment count.
+
+    Drop semantics match the 1-D kernels, checked **per axis**: a pair
+    with either id outside its own range contributes nothing. (Flattening
+    alone would NOT give this: ``row=2, col=-1`` flattens to the last
+    valid cell of row 1 — in range, silently misattributed — so
+    out-of-range pairs are remapped to ``-1`` first.)
+
+    ``n_rows * n_cols`` must fit int32: the flat id is computed in the
+    ids' (int32) dtype, and under JAX's default x32 a larger grid could
+    neither be indexed nor materialized — overflow would silently wrap
+    ids into the wrong bucket, so it is rejected loudly instead (e.g. a
+    grouped dense xT transition stack with thousands of groups belongs
+    on the matrix-free path).
+    """
+    if n_rows * n_cols > jnp.iinfo(jnp.int32).max:
+        raise ValueError(
+            f'segment_sum_2d grid {n_rows} x {n_cols} overflows int32 flat '
+            'indices; shrink the grid (for grouped xT transition counts: '
+            'fewer groups, or the matrix-free solver which never builds '
+            'the dense stack)'
+        )
+    row = row_ids.reshape(-1)
+    col = col_ids.reshape(-1)
+    bad = (row < 0) | (row >= n_rows) | (col < 0) | (col >= n_cols)
+    flat = jnp.where(bad, -1, row * n_cols + col)
+    out = segment_sum(values, flat, n_rows * n_cols, method=method)
+    return out.reshape(n_rows, n_cols)
 
 
 # --------------------------------------------------------------------------

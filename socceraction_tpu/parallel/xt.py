@@ -11,7 +11,7 @@ This is the only cross-game collective in the whole framework.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -69,8 +69,13 @@ def sharded_xt_fit(
     eps: float = 1e-5,
     max_iter: int = 1000,
     accelerate: bool = False,
+    solver: Optional[str] = None,
 ) -> Tuple[jax.Array, XTProbabilities, jax.Array]:
     """Fit xT on a game-sharded batch: psum'd counts, replicated solve.
+
+    ``solver`` selects the value-iteration variant
+    (:data:`~socceraction_tpu.ops.xt.SOLVERS`; ``accelerate`` is the
+    deprecated Anderson alias).
 
     Returns ``(grid, probabilities, n_iterations)`` — identical values to
     the single-device :func:`~socceraction_tpu.ops.xt.xt_counts` path
@@ -78,10 +83,12 @@ def sharded_xt_fit(
     """
     counts = sharded_xt_counts(batch, mesh, l=l, w=w)
     probs = xt_probabilities(counts, l=l, w=w)
-    grid, it = solve_xt(probs, eps=eps, max_iter=max_iter, accelerate=accelerate)
+    sol = solve_xt(
+        probs, eps=eps, max_iter=max_iter, solver=solver, accelerate=accelerate
+    )
     rep = NamedSharding(mesh, P())
-    grid = jax.device_put(grid, rep)
-    return grid, probs, it
+    grid = jax.device_put(sol.grid, rep)
+    return grid, probs, sol.iterations
 
 
 def sharded_xt_fit_matrix_free(
@@ -93,6 +100,9 @@ def sharded_xt_fit_matrix_free(
     eps: float = 1e-5,
     max_iter: int = 1000,
     accelerate: bool = False,
+    solver: Optional[str] = None,
+    group_id: Optional[jax.Array] = None,
+    n_groups: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fine-grid sharded xT fit: per-shard segment-sums, psum'd sweeps.
 
@@ -104,11 +114,19 @@ def sharded_xt_fit_matrix_free(
     (:func:`~socceraction_tpu.ops.xt.solve_xt_matrix_free` with
     ``axis_name='games'``).
 
-    Returns ``(grid, n_iterations)``; the grid is replicated.
+    The batch axis composes with the shard axis: pass a per-action
+    ``group_id`` shaped like the batch fields (``(G_games, A)``, sharded
+    the same way) plus ``n_groups`` and every device solves the SAME
+    replicated ``(n_groups, w, l)`` fleet from its local action shard —
+    grouped counts and every batched sweep payoff are psum'd like the
+    single-grid case. ``solver`` selects the value-iteration variant.
+
+    Returns ``(grid, n_iterations)``; the grid is replicated (stacked
+    with per-grid iteration counts for grouped fits).
     """
 
-    def local_fit(b: ActionBatch):
-        xT, it, _, _, _ = solve_xt_matrix_free(
+    def local_fit(b: ActionBatch, gid: Optional[jax.Array] = None):
+        sol, _ = solve_xt_matrix_free(
             b.type_id,
             b.result_id,
             b.start_x,
@@ -122,8 +140,18 @@ def sharded_xt_fit_matrix_free(
             max_iter=max_iter,
             axis_name='games',
             accelerate=accelerate,
+            solver=solver,
+            group_id=gid,
+            n_groups=n_groups,
         )
-        return xT, it
+        return sol.grid, sol.iterations
 
-    fn = jax.shard_map(local_fit, mesh=mesh, in_specs=P('games'), out_specs=P())
-    return fn(batch)
+    if group_id is None:
+        fn = jax.shard_map(
+            local_fit, mesh=mesh, in_specs=P('games'), out_specs=P()
+        )
+        return fn(batch)
+    fn = jax.shard_map(
+        local_fit, mesh=mesh, in_specs=(P('games'), P('games')), out_specs=P()
+    )
+    return fn(batch, group_id)

@@ -228,7 +228,7 @@ def annotate(name: str) -> ContextManager[Any]:
     Example::
 
         with annotate('xt/solve'):
-            grid = solve_xt(probs, eps=eps)
+            solution = solve_xt(probs, eps=eps)
     """
     import jax
 

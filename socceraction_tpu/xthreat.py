@@ -29,7 +29,7 @@ import json
 import math
 import os
 import time
-from typing import Callable, List, Optional, Tuple, Union
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -41,7 +41,7 @@ try:  # pragma: no cover - import guard mirrors optional-dependency handling
     import jax
     import jax.numpy as jnp
 
-    from .core.batch import ActionBatch, pack_actions
+    from .core.batch import ActionBatch, pack_actions, pack_row_values
     from .ops import xt as _xtops
 
     _HAS_JAX = True
@@ -57,6 +57,9 @@ M: int = 12
 N: int = 16
 
 Actions = Union[pd.DataFrame, 'ActionBatch']
+
+#: ``group_by`` spec: a frame column name or a per-action key array.
+GroupBy = Union[str, Sequence[Any], np.ndarray]
 
 
 # ---------------------------------------------------------------------------
@@ -171,21 +174,48 @@ def move_transition_matrix(actions: pd.DataFrame, l: int = N, w: int = M) -> np.
     return _safe_divide(counts.astype(np.float64), start_counts[:, None])
 
 
-def _validate_accelerate(accelerate: bool, backend: str, keep_heatmaps: bool) -> None:
-    """Shared by ``__init__`` and ``fit`` (public attributes are mutable)."""
-    if not accelerate:
-        return
+#: Solver-variant names accepted by ``ExpectedThreat(variant=)`` —
+#: mirrors :data:`socceraction_tpu.ops.xt.SOLVERS` (kept as a literal so
+#: the pandas-only install can still validate without importing jax).
+VARIANTS = ('picard', 'anderson', 'anchored', 'momentum')
+
+
+def _resolve_variant(
+    variant: Optional[str], accelerate: bool, backend: str, keep_heatmaps: bool
+) -> str:
+    """Validate + normalize the solver variant (shared by ``__init__`` and
+    ``fit`` — the public attributes are mutable)."""
+    if variant == 'plain':
+        variant = 'picard'
+    if variant is None:
+        variant = 'anderson' if accelerate else 'picard'
+    elif variant not in VARIANTS:
+        raise ValueError(f'unknown variant {variant!r} (want one of {VARIANTS})')
+    elif accelerate and variant != 'anderson':
+        raise ValueError(
+            "accelerate=True is a deprecated alias of variant='anderson' "
+            f'and conflicts with variant={variant!r}'
+        )
+    if variant == 'picard':
+        return variant
     if backend != 'jax':
         raise ValueError(
-            'accelerate=True (Anderson-accelerated value iteration) is a '
+            f'variant={variant!r} (accelerated value iteration) is a '
             "JAX-backend feature; the pandas backend keeps the reference's "
             'plain iteration'
         )
     if keep_heatmaps:
         raise ValueError(
             'keep_heatmaps records the plain Picard iterate sequence; '
-            'Anderson iterates are a different (non-monotone) sequence'
+            f'{variant} iterates are a different (non-monotone) sequence'
         )
+    return variant
+
+
+def _pow2_bucket(n: int) -> int:
+    """Round a grid count up to a power of two (the ``n_grids`` metric
+    label stays cardinality-bounded at ``log2(max fleet size)`` values)."""
+    return 1 << max(n - 1, 0).bit_length()
 
 
 # ---------------------------------------------------------------------------
@@ -222,13 +252,18 @@ class ExpectedThreat:
         4096 cells, matrix-free beyond. ``transition_matrix`` stays ``None``
         on the matrix-free path.
     accelerate : bool
-        JAX backend only: solve with Anderson-accelerated fixed-point
-        iteration (``ops/xt.py:_value_iteration_anderson``) — same fixed
-        point, measured 1.1-2.5x fewer sweeps (growing with how slowly
-        the plain iteration mixes). Off by default because
-        the reference's *iterate sequence* (and its monotone convergence
-        test) is the plain Picard one; ``n_iter`` then counts sweeps, not
-        Picard iterations.
+        Deprecated alias of ``variant='anderson'``.
+    variant : {'picard', 'anderson', 'anchored', 'momentum'}, optional
+        Value-iteration variant (``'plain'`` is accepted as an alias of
+        ``'picard'``, the default). All variants share the fixed point
+        and return the same convergence certificate
+        (:class:`~socceraction_tpu.ops.xt.XTSolution` semantics:
+        ``solve_residual`` / ``converged`` / ``n_iter``); the
+        accelerated three are JAX-backend features. See ``docs/xt.md``
+        for the selection guide. Orthogonal to ``solver`` — ``solver``
+        picks the sweep *structure* (dense mat-vec vs matrix-free
+        gather/scatter), ``variant`` picks the iteration *schedule*
+        around it.
     """
 
     #: Cell count above which the auto solver goes matrix-free.
@@ -244,6 +279,7 @@ class ExpectedThreat:
         keep_heatmaps: bool = False,
         solver: Optional[str] = None,
         accelerate: bool = False,
+        variant: Optional[str] = None,
     ) -> None:
         if backend is None:
             backend = 'jax' if _HAS_JAX else 'pandas'
@@ -253,7 +289,7 @@ class ExpectedThreat:
             raise ImportError('JAX backend requested but jax is not importable')
         if solver is not None and solver not in ('dense', 'matrix-free'):
             raise ValueError(f'unknown solver {solver!r}')
-        _validate_accelerate(accelerate, backend, keep_heatmaps)
+        _resolve_variant(variant, accelerate, backend, keep_heatmaps)
         self.l = l
         self.w = w
         self.eps = eps
@@ -262,22 +298,49 @@ class ExpectedThreat:
         self.keep_heatmaps = keep_heatmaps
         self._solver = solver
         self.accelerate = accelerate
+        self.variant = variant
         # (keep_heatmaps + jax + matrix-free is rejected in _fit_jax: the
         # solver auto-resolution tracks w/l, which may change after
         # construction, so fit time is the only reliable point to check)
         self.n_iter: int = 0
         #: residual the solver last tested before exiting (``max(new - old)``
-        #: Picard / ``max|f(x) - x|`` Anderson): ``<= eps`` after a normally
-        #: converged ``fit``, larger when ``max_iter`` cut the loop, ``None``
-        #: before fitting. Recorded per fit in the ``xt/solve_residual``
-        #: gauge of the telemetry registry.
+        #: Picard / ``max|f(x) - x|`` on the accelerated variants): ``<= eps``
+        #: after a normally converged ``fit``, larger when ``max_iter`` cut
+        #: the loop, ``None`` before fitting. Recorded per fit in the
+        #: ``xt/solve_residual`` gauge of the telemetry registry. For a
+        #: grouped fit this is the WORST grid's residual
+        #: (``solve_residual_per_grid_`` has the full vector).
         self.solve_residual: Optional[float] = None
+        #: ``True`` when the last fit's residual met ``eps`` (every grid,
+        #: for grouped fits), ``False`` when ``max_iter`` cut the loop,
+        #: ``None`` before fitting — the model-level convergence
+        #: certificate flag.
+        self.converged: Optional[bool] = None
         self.heatmaps: List[np.ndarray] = []
         self.xT: np.ndarray = np.zeros((w, l))
         self.scoring_prob_matrix: Optional[np.ndarray] = None
         self.shot_prob_matrix: Optional[np.ndarray] = None
         self.move_prob_matrix: Optional[np.ndarray] = None
         self.transition_matrix: Optional[np.ndarray] = None
+        #: Grouped-fit state (``fit(..., group_by=)``): the ``(G, w, l)``
+        #: surface stack, the sorted group keys aligned with its leading
+        #: axis, the grouping column name (when a column was used), the
+        #: per-grid certificate vectors, and the stacked probability
+        #: matrices (``(G, w, l)``; transition ``(G, w·l, w·l)`` on the
+        #: dense path, ``None`` matrix-free). The documented single-grid
+        #: ``*_matrix`` slots stay ``None`` on grouped fits so 2-D
+        #: consumers fail loudly rather than read a stack. All ``None``
+        #: / scalar defaults for ungrouped models.
+        self.grids_: Optional[np.ndarray] = None
+        self.group_keys_: Optional[np.ndarray] = None
+        self.group_by_: Optional[str] = None
+        self.n_iter_per_grid_: Optional[np.ndarray] = None
+        self.solve_residual_per_grid_: Optional[np.ndarray] = None
+        self.converged_per_grid_: Optional[np.ndarray] = None
+        self.scoring_prob_matrices_: Optional[np.ndarray] = None
+        self.shot_prob_matrices_: Optional[np.ndarray] = None
+        self.move_prob_matrices_: Optional[np.ndarray] = None
+        self.transition_matrices_: Optional[np.ndarray] = None
 
     @property
     def solver(self) -> str:
@@ -285,11 +348,27 @@ class ExpectedThreat:
 
         Auto selection tracks ``self.w``/``self.l`` so models whose grid is
         set after construction (e.g. :func:`load_model`) still pick the
-        tractable solver on a later ``fit``.
+        tractable solver on a later ``fit``. Grouped fits use
+        :meth:`_effective_solver` instead, which folds the fleet size in.
+        """
+        return self._effective_solver(1)
+
+    def _effective_solver(self, n_grids: int) -> str:
+        """Auto solver with the group axis folded in.
+
+        Dense builds an ``(G, w·l, w·l)`` transition stack, so the gate is
+        memory-equivalent to the single-grid rule (``T`` entries ≤
+        ``DENSE_CELL_LIMIT²``): ``G · (w·l)² ≤ DENSE_CELL_LIMIT²``. A
+        ``group_by='player_id'`` fit with thousands of groups therefore
+        lands on the matrix-free path automatically (which never builds
+        the stack) instead of allocating gigabytes — or tripping
+        ``segment_sum_2d``'s int32 flat-index guard.
         """
         if self._solver is not None:
             return self._solver
-        return 'dense' if self.w * self.l <= self.DENSE_CELL_LIMIT else 'matrix-free'
+        n_cells = self.w * self.l
+        dense_ok = n_grids * n_cells * n_cells <= self.DENSE_CELL_LIMIT ** 2
+        return 'dense' if dense_ok else 'matrix-free'
 
     # -- fitting -----------------------------------------------------------
 
@@ -313,6 +392,7 @@ class ExpectedThreat:
         self.xT = xT
         self.n_iter = it
         self.solve_residual = resid
+        self.converged = resid is not None and resid <= self.eps
 
     def _solve_numpy(self) -> None:
         gs = self.scoring_prob_matrix * self.shot_prob_matrix
@@ -356,14 +436,22 @@ class ExpectedThreat:
             self.transition_matrix = move_transition_matrix(actions, self.l, self.w)
             self._solve_numpy()
 
-    def _fit_jax(self, batch: 'ActionBatch') -> None:
+    def _take_solution(self, sol: '_xtops.XTSolution') -> None:
+        """Adopt a single-grid :class:`~socceraction_tpu.ops.xt.XTSolution`."""
+        self.xT = np.asarray(sol.grid, dtype=np.float64)
+        self.n_iter = int(sol.iterations)
+        r = float(sol.residual)
+        self.solve_residual = r if math.isfinite(r) else None
+        self.converged = bool(sol.converged)
+
+    def _fit_jax(self, batch: 'ActionBatch', variant: str) -> None:
         if self.solver == 'matrix-free':
             if self.keep_heatmaps:
                 raise ValueError(
                     "keep_heatmaps on the JAX backend requires solver='dense' "
                     "(use backend='pandas' for matrix-free heatmaps)"
                 )
-            xT, it, p_score, p_shot, p_move, resid = _xtops.solve_xt_matrix_free(
+            sol, probs = _xtops.solve_xt_matrix_free(
                 batch.type_id,
                 batch.result_id,
                 batch.start_x,
@@ -375,17 +463,13 @@ class ExpectedThreat:
                 w=self.w,
                 eps=self.eps,
                 max_iter=self.max_iter,
-                accelerate=self.accelerate,
-                return_residual=True,
+                solver=variant,
             )
-            self.scoring_prob_matrix = np.asarray(p_score, dtype=np.float64)
-            self.shot_prob_matrix = np.asarray(p_shot, dtype=np.float64)
-            self.move_prob_matrix = np.asarray(p_move, dtype=np.float64)
+            self.scoring_prob_matrix = np.asarray(probs.p_score, dtype=np.float64)
+            self.shot_prob_matrix = np.asarray(probs.p_shot, dtype=np.float64)
+            self.move_prob_matrix = np.asarray(probs.p_move, dtype=np.float64)
             self.transition_matrix = None
-            self.xT = np.asarray(xT, dtype=np.float64)
-            self.n_iter = int(it)
-            r = float(resid)
-            self.solve_residual = r if math.isfinite(r) else None
+            self._take_solution(sol)
             return
         counts = _xtops.xt_counts(
             batch.type_id,
@@ -407,14 +491,91 @@ class ExpectedThreat:
             # Host-stepped sweeps so every intermediate surface can be kept.
             self._solve_numpy()
         else:
-            xT, it, resid = _xtops.solve_xt(
-                probs, eps=self.eps, max_iter=self.max_iter,
-                accelerate=self.accelerate, return_residual=True,
+            sol = _xtops.solve_xt(
+                probs, eps=self.eps, max_iter=self.max_iter, solver=variant,
             )
-            self.xT = np.asarray(xT, dtype=np.float64)
-            self.n_iter = int(it)
-            r = float(resid)
-            self.solve_residual = r if math.isfinite(r) else None
+            self._take_solution(sol)
+
+    def _group_codes(self, actions: pd.DataFrame, group_by) -> tuple:
+        """``(codes, keys)`` for a grouped fit/rate: per-row int codes into
+        the sorted unique key array (``-1`` for null keys)."""
+        if isinstance(group_by, str):
+            if group_by not in actions.columns:
+                raise ValueError(f'group_by column {group_by!r} not in actions')
+            values = actions[group_by]
+        else:
+            values = np.asarray(group_by)
+            if len(values) != len(actions):
+                raise ValueError(
+                    f'group_by array has {len(values)} entries for '
+                    f'{len(actions)} actions'
+                )
+        codes, keys = pd.factorize(values, sort=True)
+        return codes.astype(np.int32), np.asarray(keys)
+
+    def _fit_jax_grouped(
+        self,
+        actions: pd.DataFrame,
+        codes: np.ndarray,
+        keys: np.ndarray,
+        group_by,
+        variant: str,
+    ) -> None:
+        """One dispatch for the whole keyed surface fleet (see ``fit``)."""
+        if self.keep_heatmaps:
+            raise ValueError(
+                'keep_heatmaps records one plain Picard iterate sequence; '
+                'a grouped fit solves a whole fleet of grids at once'
+            )
+        batch = self._as_batch(actions)
+        group_id = jnp.asarray(pack_row_values(codes, batch, fill=-1))
+        G = len(keys)
+        fields = (
+            batch.type_id, batch.result_id,
+            batch.start_x, batch.start_y, batch.end_x, batch.end_y,
+            batch.mask,
+        )
+        if self._effective_solver(G) == 'matrix-free':
+            sol, probs = _xtops.solve_xt_matrix_free(
+                *fields, l=self.l, w=self.w, eps=self.eps,
+                max_iter=self.max_iter, solver=variant,
+                group_id=group_id, n_groups=G,
+            )
+            self.transition_matrices_ = None
+        else:
+            counts = _xtops.xt_counts(
+                *fields, l=self.l, w=self.w, group_id=group_id, n_groups=G
+            )
+            probs = _xtops.xt_probabilities(counts, l=self.l, w=self.w)
+            sol = _xtops.solve_xt(
+                probs, eps=self.eps, max_iter=self.max_iter, solver=variant
+            )
+            self.transition_matrices_ = np.asarray(probs.transition, np.float64)
+        # the documented single-grid probability slots keep their 2-D
+        # contract: grouped stacks live in the *_matrices_ attributes and
+        # the single-grid slots stay None (same decision as the zeroed
+        # ``xT`` slot — existing (w, l)-shaped consumers fail loudly
+        # instead of silently reading a (G, ...) stack)
+        self.scoring_prob_matrix = None
+        self.shot_prob_matrix = None
+        self.move_prob_matrix = None
+        self.transition_matrix = None
+        self.scoring_prob_matrices_ = np.asarray(probs.p_score, dtype=np.float64)
+        self.shot_prob_matrices_ = np.asarray(probs.p_shot, dtype=np.float64)
+        self.move_prob_matrices_ = np.asarray(probs.p_move, dtype=np.float64)
+        self.grids_ = np.asarray(sol.grid, dtype=np.float64)
+        self.group_keys_ = keys
+        self.group_by_ = group_by if isinstance(group_by, str) else None
+        self.n_iter_per_grid_ = np.asarray(sol.iterations)
+        self.solve_residual_per_grid_ = np.asarray(sol.residual, np.float64)
+        self.converged_per_grid_ = np.asarray(sol.converged)
+        self.n_iter = int(self.n_iter_per_grid_.max())
+        worst = float(self.solve_residual_per_grid_.max())
+        self.solve_residual = worst if math.isfinite(worst) else None
+        self.converged = bool(self.converged_per_grid_.all())
+        # the single-surface slot stays zeroed: grouped models rate
+        # through the stack (``rate``/``surface``)
+        self.xT = np.zeros((self.w, self.l))
 
     def _as_batch(self, actions: Actions) -> 'ActionBatch':
         if isinstance(actions, pd.DataFrame):
@@ -439,35 +600,91 @@ class ExpectedThreat:
             return batch
         return actions
 
-    def fit(self, actions: Actions) -> 'ExpectedThreat':
+    def fit(
+        self, actions: Actions, *, group_by: Optional[GroupBy] = None
+    ) -> 'ExpectedThreat':
         """Fit the model on SPADL actions (DataFrame or packed batch).
+
+        Parameters
+        ----------
+        actions : DataFrame or ActionBatch
+            SPADL actions.
+        group_by : str or array-like, optional
+            JAX backend only: fit one surface **per group** — a column
+            name (``'team_id'``, ``'competition_id'``, a phase bucket
+            you derived…) or a per-action array of group keys aligned
+            with the frame's rows. The whole fleet of grids is counted
+            by one scatter-add and solved in ONE XLA dispatch
+            (:mod:`socceraction_tpu.ops.xt` batched path), populating
+            ``grids_`` / ``group_keys_`` and the per-grid certificate
+            vectors; ``rate`` then gathers each action from its own
+            group's surface. Requires a DataFrame (the keys live in
+            frame columns).
 
         Each fit reports to the telemetry registry
         (:mod:`socceraction_tpu.obs`) under a ``(grid, solver, variant,
-        backend)`` label set: iterations-to-convergence
-        (``xt/solve_iterations``), solve wall time (``xt/solve_seconds``
-        — host-synced, since the iteration count fetch forces the device
-        solve to completion) and the exit residual
-        (``xt/solve_residual``); the whole fit runs inside an ``xt/fit``
-        span.
+        backend, n_grids)`` label set — ``variant`` is the
+        value-iteration schedule (picard/anderson/anchored/momentum) and
+        ``n_grids`` the fleet size bucketed to powers of two
+        (cardinality-bounded): iterations-to-convergence
+        (``xt/solve_iterations``; the worst grid for grouped fits),
+        solve wall time (``xt/solve_seconds`` — host-synced, since the
+        iteration count fetch forces the device solve to completion) and
+        the exit residual (``xt/solve_residual``); the whole fit runs
+        inside an ``xt/fit`` span.
         """
-        # re-validated here, not only in __init__: backend/accelerate/
+        # re-validated here, not only in __init__: backend/variant/
         # keep_heatmaps are plain public attributes that may have been
         # mutated since construction (same rationale as the matrix-free/
         # keep_heatmaps check living in _fit_jax)
-        _validate_accelerate(self.accelerate, self.backend, self.keep_heatmaps)
+        variant = _resolve_variant(
+            self.variant, self.accelerate, self.backend, self.keep_heatmaps
+        )
+        if group_by is not None:
+            if self.backend != 'jax':
+                raise ValueError(
+                    'group_by (batched surface fleets) is a JAX-backend '
+                    'feature'
+                )
+            if not isinstance(actions, pd.DataFrame):
+                raise ValueError(
+                    'group_by requires a DataFrame (group keys live in '
+                    'frame columns)'
+                )
+            codes, keys = self._group_codes(actions, group_by)
+            n_grids = len(keys)
+            if n_grids == 0:
+                raise ValueError('group_by produced no groups (all keys null?)')
+        else:
+            codes = keys = None
+            n_grids = 1
         labels = {
             'grid': f'{self.l}x{self.w}',
-            'solver': self.solver,
-            'variant': 'anderson' if self.accelerate else 'picard',
+            'solver': self._effective_solver(n_grids),
+            'variant': variant,
             'backend': self.backend,
+            'n_grids': str(_pow2_bucket(n_grids)),
         }
         t0 = time.perf_counter()
         with span('xt/fit', **labels):
-            if self.backend == 'jax':
-                self._fit_jax(self._as_batch(actions))
+            if group_by is not None:
+                self._fit_jax_grouped(actions, codes, keys, group_by, variant)
             else:
-                self._fit_pandas(actions)
+                # a refit without group_by drops any previous fleet state
+                self.grids_ = None
+                self.group_keys_ = None
+                self.group_by_ = None
+                self.n_iter_per_grid_ = None
+                self.solve_residual_per_grid_ = None
+                self.converged_per_grid_ = None
+                self.scoring_prob_matrices_ = None
+                self.shot_prob_matrices_ = None
+                self.move_prob_matrices_ = None
+                self.transition_matrices_ = None
+                if self.backend == 'jax':
+                    self._fit_jax(self._as_batch(actions), variant)
+                else:
+                    self._fit_pandas(actions)
         solve_s = time.perf_counter() - t0
         # grid is user-controlled (any l×w), so these instruments collapse
         # past-budget label sets into the reserved {overflow="true"} series
@@ -526,14 +743,113 @@ class ExpectedThreat:
         fine = top * (1 - ty[:, None]) + bot * ty[:, None]
         return fine[::-1]
 
+    def _rate_grouped(
+        self, actions: pd.DataFrame, use_interpolation: bool, group_by
+    ) -> np.ndarray:
+        """Batched rating against the fitted surface fleet.
+
+        Every action gathers from its own group's grid in one dispatch
+        (:func:`~socceraction_tpu.ops.xt.rate_actions` with a surface
+        stack); actions whose key the fit never saw rate NaN, like any
+        other unrated action.
+        """
+        if group_by is None:
+            group_by = self.group_by_
+        if group_by is None:
+            raise ValueError(
+                'this model was grouped by a per-action array; pass '
+                'group_by= to rate'
+            )
+        if not isinstance(actions, pd.DataFrame):
+            raise ValueError('rating a grouped model requires a DataFrame')
+        if isinstance(group_by, str):
+            if group_by not in actions.columns:
+                raise ValueError(f'group_by column {group_by!r} not in actions')
+            values = actions[group_by].to_numpy()
+        else:
+            values = np.asarray(group_by)
+            if len(values) != len(actions):
+                raise ValueError(
+                    f'group_by array has {len(values)} entries for '
+                    f'{len(actions)} actions'
+                )
+        # unseen keys -> -1 -> NaN in the kernel
+        codes = pd.Index(self.group_keys_).get_indexer(values).astype(np.int32)
+
+        grids = self.grids_
+        l, w = self.l, self.w
+        if use_interpolation:
+            # interpolate ONLY the groups this frame references: the fine
+            # fleet is (G, 680, 1050) — ~2.9 MB per grid — so upsampling
+            # all G surfaces to rate a frame touching a handful of teams
+            # would burn gigabytes at four-digit fleet sizes
+            used = np.unique(codes[codes >= 0])
+            if used.size == 0:
+                return np.full(len(actions), np.nan)
+            remap = np.full(len(self.group_keys_), -1, dtype=np.int32)
+            remap[used] = np.arange(used.size, dtype=np.int32)
+            codes = np.where(codes >= 0, remap[np.clip(codes, 0, None)], -1)
+            codes = codes.astype(np.int32)
+            l = int(spadlconfig.field_length * 10)
+            w = int(spadlconfig.field_width * 10)
+            grids = np.asarray(
+                _xtops.interpolate_grid(jnp.asarray(grids[used]), l, w)
+            )
+        batch = self._as_batch(actions)
+        group_id = jnp.asarray(pack_row_values(codes, batch, fill=-1))
+        vals = _xtops.rate_actions(
+            jnp.asarray(grids, dtype=jnp.float32),
+            batch.type_id,
+            batch.result_id,
+            batch.start_x,
+            batch.start_y,
+            batch.end_x,
+            batch.end_y,
+            batch.mask,
+            l=l,
+            w=w,
+            group_id=group_id,
+        )
+        from .core.batch import unpack_values
+
+        return unpack_values(vals, batch)
+
+    def surface(self, key: Any) -> np.ndarray:
+        """The fitted ``(w, l)`` surface of one group (grouped fits)."""
+        if self.grids_ is None:
+            raise NotFittedError('fit the model with group_by= first')
+        idx = pd.Index(self.group_keys_).get_indexer([key])[0]
+        if idx < 0:
+            raise KeyError(key)
+        return self.grids_[idx]
+
+    def surfaces(self) -> dict:
+        """``{group key -> (w, l) surface}`` of a grouped fit."""
+        if self.grids_ is None:
+            raise NotFittedError('fit the model with group_by= first')
+        return {k: self.grids_[i] for i, k in enumerate(self.group_keys_)}
+
     def rate(
-        self, actions: Actions, use_interpolation: bool = False
+        self,
+        actions: Actions,
+        use_interpolation: bool = False,
+        *,
+        group_by: Optional[GroupBy] = None,
     ) -> np.ndarray:
         """Compute per-action xT ratings.
 
         Only successful pass/dribble/cross actions are rated; all other rows
-        receive NaN (reference ``xthreat.py:453-464``).
+        receive NaN (reference ``xthreat.py:453-464``). A grouped model
+        (``fit(..., group_by=)``) rates every action against its own
+        group's surface in one batched gather; ``group_by`` here
+        overrides the fit-time column (required when the fit grouped by
+        a per-action array). Actions with keys the fit never saw rate
+        NaN.
         """
+        if self.grids_ is not None:
+            return self._rate_grouped(actions, use_interpolation, group_by)
+        if group_by is not None:
+            raise ValueError('group_by rating requires a group_by fit')
         if not np.any(self.xT):
             raise NotFittedError('fit the model before calling rate')
 
@@ -606,6 +922,15 @@ class ExpectedThreat:
         methods = {'linear': 'linear', 'cubic': 'cubic', 'quintic': 'quintic'}
         if kind not in methods:
             raise ValueError(f'kind must be one of {sorted(methods)}, got {kind!r}')
+        if self.grids_ is not None:
+            # the single-surface slot is deliberately zeroed on grouped
+            # fits — interpolating it would silently return a flat zero
+            # function instead of any group's surface
+            raise ValueError(
+                'a grouped fit holds a surface collection, not one grid; '
+                'interpolate a single surface via surface(key), or rate '
+                'with rate(..., use_interpolation=True)'
+            )
 
         cell_l = spadlconfig.field_length / self.l
         cell_w = spadlconfig.field_width / self.w
@@ -638,6 +963,11 @@ class ExpectedThreat:
 
     def save_model(self, filepath: str, overwrite: bool = True) -> None:
         """Save the xT value surface as a JSON 2-D matrix."""
+        if self.grids_ is not None:
+            raise ValueError(
+                'a grouped fit holds a surface collection, not one grid; '
+                'save per-group surfaces via surfaces() / surface(key)'
+            )
         if not np.any(self.xT):
             raise NotFittedError('fit the model before saving')
         if not overwrite and os.path.isfile(filepath):

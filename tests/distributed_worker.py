@@ -92,7 +92,7 @@ def main() -> None:
         season.start_x, season.start_y, season.end_x, season.end_y,
         season.mask, l=16, w=12,
     )
-    ref_grid, _ = solve_xt(xt_probabilities(local, l=16, w=12))
+    ref_grid = solve_xt(xt_probabilities(local, l=16, w=12)).grid
     np.testing.assert_allclose(grid, np.asarray(ref_grid), atol=1e-6)
 
     # --- distributed VAEP train step across the process boundary ----------

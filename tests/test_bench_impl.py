@@ -169,6 +169,8 @@ def test_impl_forced_extras_contract():
         {
             'SOCCERACTION_TPU_BENCH_FORCE_EXTRAS': '1',
             'SOCCERACTION_TPU_BENCH_XT_GAMES': '8',
+            'SOCCERACTION_TPU_BENCH_XT_BATCH': '1,8',
+            'SOCCERACTION_TPU_BENCH_XT_BATCH_GAMES': '16',
             'SOCCERACTION_TPU_BENCH_STEP_GAMES': '4',
             'SOCCERACTION_TPU_BENCH_COLD_GAMES': '8',
             'SOCCERACTION_TPU_BENCH_COLD_CHUNK': '4',
@@ -181,11 +183,16 @@ def test_impl_forced_extras_contract():
         'xt_fit_16x12_dense',
         'xt_fit_192x125_matrix_free_100iter',
         'xt_fit_192x125_anderson_converged',
+        'xt_batched_grids',
         'vaep_mlp_train_step',
         'vaep_mlp_train_epoch',
         'cold_path_stream',
         'serve_throughput',
+        # added by the continuous-learning PR; its pin here was missed
+        # then — repaired with the xt_batched_grids addition
+        'continuous_learning',
     }
+    _check_xt_batched(extras['xt_batched_grids'], sizes=[1, 8])
     # both training configs report BOTH paths (the fused-vs-materialized
     # speedup is the artifact's acceptance measurement, never a max())
     step = extras['vaep_mlp_train_step']
@@ -242,6 +249,54 @@ def test_impl_forced_extras_contract():
     assert obs['pair_probs']['compiles'] >= 1
     assert obs['pair_probs'].get('cost_flops', 0) > 0
     assert obs['train_epoch']['compiles'] >= 2  # one per timed path
+
+
+def _check_xt_batched(xtb, *, sizes):
+    """Shared contract for the xt_batched_grids section (extras + smoke)."""
+    assert [lv['n_grids'] for lv in xtb['levels']] == sizes
+    solvers = {'picard', 'anderson', 'anchored', 'momentum'}
+    for level in xtb['levels']:
+        assert set(level['solvers']) == solvers
+        for entry in level['solvers'].values():
+            # the A/B is honest: both structures report grids/s AND the
+            # sweeps-to-converge count, per solver
+            assert entry['grids_per_sec'] > 0
+            assert entry['sweeps_to_converge_max'] >= 1
+            assert entry['matrix_free']['grids_per_sec'] > 0
+            assert entry['matrix_free']['sweeps_to_converge_max'] >= 1
+    # acceptance gates: one signature per (solver, fleet size), zero
+    # steady-state retraces across batch sizes
+    expected = xtb['expected_signatures_per_fn']
+    assert expected == len(sizes) * len(solvers)
+    assert xtb['signatures_per_fn'] == {
+        'solve_xt': expected, 'solve_xt_matrix_free': expected,
+    }
+    assert xtb['steady_state_compiles'] == 0
+
+
+def test_xt_smoke_end_to_end():
+    """``bench.py --xt-smoke`` (the make bench-smoke wiring) runs the
+    batched-grid sweep on CPU and reports the structural contract plus
+    the sequential-fits A/B the acceptance records."""
+    sys.path.insert(0, _ROOT)
+    from bench import _cpu_env
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, 'bench.py'), '--xt-smoke'],
+        env=_cpu_env(), cwd=_ROOT, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith('{')]
+    d = json.loads(lines[-1])
+    assert d['metric'] == 'xt_batched_grids_per_sec'
+    assert d['unit'] == 'grids/sec'
+    assert d['smoke'] is True and d['platform'] == 'cpu'
+    assert d['value'] > 0
+    _check_xt_batched(d, sizes=[1, 8, 64])
+    seq = d['sequential_baseline']
+    assert seq['n_grids'] == 64
+    assert seq['speedup_vs_sequential'] > 1  # recorded honestly, not clamped
+    assert seq['batched_fit_seconds'] > 0 and seq['seconds_total'] > 0
 
 
 def _check_serve_throughput(serve):

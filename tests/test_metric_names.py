@@ -77,6 +77,36 @@ def test_xla_and_mem_areas_are_registered():
     assert {'xla', 'mem'} <= tool.KNOWN_AREAS
 
 
+def test_xt_solver_and_n_grids_labels_are_registered():
+    """The batched-xT exposition dimensions are governed (ISSUE 7
+    satellite): ``solver``/``variant`` and the power-of-two-bucketed
+    ``n_grids`` label must be part of the ``xt`` area's label contract,
+    and the bucketing helper must actually emit powers of two."""
+    tool = _tool()
+    assert {'solver', 'variant', 'n_grids'} <= tool.KNOWN_LABELS['xt']
+    from socceraction_tpu.xthreat import _pow2_bucket
+
+    assert [_pow2_bucket(n) for n in (1, 2, 3, 64, 65, 1000, 1024)] == [
+        1, 2, 4, 64, 128, 1024, 1024,
+    ]
+
+
+def test_unregistered_label_key_detected(tmp_path):
+    """A literal label key outside its area's contract fails the gate;
+    registered keys (and areas without a contract) pass."""
+    tool = _tool()
+    bad = tmp_path / 'bad.py'
+    bad.write_text(
+        "counter('xt/fits').inc(1, rogue_dim='x')\n"
+        "counter('xt/fits').inc(1, solver='dense')\n"
+        "counter('uncontracted/thing').inc(1, whatever='x')\n"
+    )
+    problems, n_sites = tool.check_files([str(bad)])
+    assert n_sites == 3
+    assert len(problems) == 1
+    assert "'rogue_dim'" in problems[0] and 'KNOWN_LABELS' in problems[0]
+
+
 def test_per_function_name_nesting_detected(tmp_path):
     """Function names must be labels, never metric-name suffixes: a
     third ``/`` segment fails the gate (Prometheus cardinality)."""

@@ -534,6 +534,7 @@ def test_runlog_over_xt_vaep_and_feed_epoch(
         snap.series(
             'xt/solve_iterations',
             grid='16x12', solver='dense', variant='picard', backend='jax',
+            n_grids='1',
         ).count
         == 1
     )

@@ -122,7 +122,7 @@ def test_sharded_xt_fit_matches_unsharded(season):
         season.mask, l=16, w=12,
     )
     probs1 = xt_probabilities(local, l=16, w=12)
-    grid1, _ = solve_xt(probs1)
+    grid1 = solve_xt(probs1).grid
     np.testing.assert_allclose(np.asarray(grid), np.asarray(grid1), atol=1e-6)
     assert int(it) > 0
 
@@ -219,12 +219,12 @@ def test_sharded_matrix_free_fit_matches_unsharded(season):
     sharded = shard_batch(season, mesh)
     grid, it = sharded_xt_fit_matrix_free(sharded, mesh, l=24, w=16)
 
-    ref_grid, ref_it, _, _, _ = solve_xt_matrix_free(
+    ref, _ = solve_xt_matrix_free(
         season.type_id, season.result_id, season.start_x, season.start_y,
         season.end_x, season.end_y, season.mask, l=24, w=16,
     )
-    assert int(it) == int(ref_it)
-    np.testing.assert_allclose(np.asarray(grid), np.asarray(ref_grid), atol=1e-6)
+    assert int(it) == int(ref.iterations)
+    np.testing.assert_allclose(np.asarray(grid), np.asarray(ref.grid), atol=1e-6)
 
 
 def test_mesh_guard_rails():

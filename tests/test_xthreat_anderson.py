@@ -43,12 +43,13 @@ def test_anderson_dense_matches_plain(season):
         batch.mask, l=16, w=12,
     )
     probs = xt_probabilities(counts, l=16, w=12)
-    grid_plain, it_plain = solve_xt(probs)
-    grid_acc, it_acc = solve_xt(probs, accelerate=True)
+    plain = solve_xt(probs)
+    acc = solve_xt(probs, accelerate=True)
     np.testing.assert_allclose(
-        np.asarray(grid_acc), np.asarray(grid_plain), atol=5e-5
+        np.asarray(acc.grid), np.asarray(plain.grid), atol=5e-5
     )
-    assert int(it_acc) < int(it_plain), (int(it_acc), int(it_plain))
+    assert int(acc.iterations) < int(plain.iterations)
+    assert bool(plain.converged) and bool(acc.converged)
 
 
 def test_anderson_matrix_free_matches_plain(season):
@@ -57,12 +58,12 @@ def test_anderson_matrix_free_matches_plain(season):
         batch.type_id, batch.result_id,
         batch.start_x, batch.start_y, batch.end_x, batch.end_y, batch.mask,
     )
-    grid_plain, it_plain, *_ = solve_xt_matrix_free(*args, l=24, w=16)
-    grid_acc, it_acc, *_ = solve_xt_matrix_free(*args, l=24, w=16, accelerate=True)
+    plain, _ = solve_xt_matrix_free(*args, l=24, w=16)
+    acc, _ = solve_xt_matrix_free(*args, l=24, w=16, accelerate=True)
     np.testing.assert_allclose(
-        np.asarray(grid_acc), np.asarray(grid_plain), atol=5e-5
+        np.asarray(acc.grid), np.asarray(plain.grid), atol=5e-5
     )
-    assert int(it_acc) < int(it_plain), (int(it_acc), int(it_plain))
+    assert int(acc.iterations) < int(plain.iterations)
 
 
 def test_model_level_accelerate(season):
@@ -97,15 +98,15 @@ def test_sharded_anderson_matches_unsharded(season):
     grid_acc, it_acc = sharded_xt_fit_matrix_free(
         sharded, mesh, l=24, w=16, accelerate=True
     )
-    ref_grid, ref_it, *_ = solve_xt_matrix_free(
+    ref, _ = solve_xt_matrix_free(
         batch.type_id, batch.result_id,
         batch.start_x, batch.start_y, batch.end_x, batch.end_y, batch.mask,
         l=24, w=16,
     )
     np.testing.assert_allclose(
-        np.asarray(grid_acc), np.asarray(ref_grid), atol=5e-5
+        np.asarray(grid_acc), np.asarray(ref.grid), atol=5e-5
     )
-    assert int(it_acc) < int(ref_it)
+    assert int(it_acc) < int(ref.iterations)
 
 
 def test_accelerate_guards(season):
@@ -135,5 +136,6 @@ def test_anderson_respects_max_iter(season):
         batch.mask, l=16, w=12,
     )
     probs = xt_probabilities(counts, l=16, w=12)
-    _, it = solve_xt(probs, eps=0.0, max_iter=7, accelerate=True)
-    assert int(it) == 7
+    sol = solve_xt(probs, eps=0.0, max_iter=7, accelerate=True)
+    assert int(sol.iterations) == 7
+    assert not bool(sol.converged)

@@ -1,0 +1,502 @@
+"""The xT solver family and the batch-native (fleet) paths.
+
+CPU tier-1 coverage for what ``test_xthreat_anderson``'s shard_map-gated
+test cannot give: every value-iteration variant (picard, anderson,
+anchored, momentum) agrees on the fixed point on single grids AND on a
+stacked 64-grid batch, the :class:`XTSolution` convergence certificate
+is honest (the reported residual upper-bounds a recomputed one; the
+converged flag matches it), grouped counts/solves match the per-group
+loop bit-for-tolerance, and the frontend's ``group_by`` fit/rate round
+trip equals per-group single fits.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from socceraction_tpu import xthreat as xt
+from socceraction_tpu.core.batch import pack_actions, pack_row_values, unpack_values
+from socceraction_tpu.core.synthetic import synthetic_actions_frame, synthetic_batch
+from socceraction_tpu.ops.xt import (
+    SOLVERS,
+    XTProbabilities,
+    XTSolution,
+    interpolate_grid,
+    rate_actions,
+    solve_xt,
+    solve_xt_matrix_free,
+    xt_counts,
+    xt_probabilities,
+)
+
+N_GAMES = 64
+
+
+@pytest.fixture(scope='module')
+def season():
+    return synthetic_batch(n_games=N_GAMES, n_actions=192, seed=13)
+
+
+@pytest.fixture(scope='module')
+def stream(season):
+    return (
+        season.type_id, season.result_id,
+        season.start_x, season.start_y, season.end_x, season.end_y,
+        season.mask,
+    )
+
+
+@pytest.fixture(scope='module')
+def probs(stream):
+    counts = xt_counts(*stream, l=16, w=12)
+    return xt_probabilities(counts, l=16, w=12)
+
+
+def _group_ids(season, n_groups):
+    idx = jnp.arange(season.n_games, dtype=jnp.int32)[:, None]
+    return jnp.broadcast_to(idx % n_groups, season.type_id.shape)
+
+
+@pytest.fixture(scope='module')
+def batched64(stream, season):
+    gid = _group_ids(season, 64)
+    counts = xt_counts(*stream, l=16, w=12, group_id=gid, n_groups=64)
+    return gid, xt_probabilities(counts, l=16, w=12)
+
+
+def _sweep_once(probs, grid):
+    """One plain numpy sweep — the independent certificate recomputation."""
+    p_shot = np.asarray(probs.p_shot, np.float64)
+    p_move = np.asarray(probs.p_move, np.float64)
+    gs = np.asarray(probs.p_score, np.float64) * p_shot
+    T = np.asarray(probs.transition, np.float64)
+    payoff = (T @ np.asarray(grid, np.float64).reshape(-1)).reshape(gs.shape)
+    return gs + p_move * payoff
+
+
+# -- fixed-point agreement across the whole family --------------------------
+
+
+@pytest.mark.parametrize('solver', SOLVERS)
+def test_solver_family_fixed_point_16x12(probs, solver):
+    """Tight-eps solves of every variant land on the same surface <=1e-5."""
+    ref = solve_xt(probs, eps=1e-7, max_iter=5000)
+    sol = solve_xt(probs, eps=1e-7, max_iter=5000, solver=solver)
+    assert isinstance(sol, XTSolution)
+    assert bool(sol.converged) and bool(ref.converged)
+    np.testing.assert_allclose(
+        np.asarray(sol.grid), np.asarray(ref.grid), atol=1e-5
+    )
+
+
+@pytest.mark.parametrize('solver', SOLVERS)
+def test_solver_family_fixed_point_matrix_free(stream, solver):
+    ref, ref_probs = solve_xt_matrix_free(*stream, l=24, w=16, eps=1e-7)
+    sol, sol_probs = solve_xt_matrix_free(
+        *stream, l=24, w=16, eps=1e-7, solver=solver
+    )
+    assert bool(sol.converged)
+    assert sol_probs.transition is None
+    np.testing.assert_allclose(
+        np.asarray(sol.grid), np.asarray(ref.grid), atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sol_probs.p_move), np.asarray(ref_probs.p_move)
+    )
+
+
+def test_plain_alias_and_accelerate_alias(probs):
+    plain = solve_xt(probs, solver='plain')
+    picard = solve_xt(probs, solver='picard')
+    np.testing.assert_array_equal(np.asarray(plain.grid), np.asarray(picard.grid))
+    acc = solve_xt(probs, accelerate=True)
+    anderson = solve_xt(probs, solver='anderson')
+    np.testing.assert_array_equal(np.asarray(acc.grid), np.asarray(anderson.grid))
+    with pytest.raises(ValueError, match='conflicts'):
+        solve_xt(probs, solver='momentum', accelerate=True)
+    with pytest.raises(ValueError, match='unknown solver'):
+        solve_xt(probs, solver='sor')
+
+
+# -- certificate honesty -----------------------------------------------------
+
+
+@pytest.mark.parametrize('solver', SOLVERS)
+def test_certificate_honesty(probs, solver):
+    """The reported residual is a real bound: one more (independently
+    recomputed) sweep of the returned grid moves it by no more than the
+    certificate says, and the converged flag is exactly ``resid <= eps``."""
+    sol = solve_xt(probs, solver=solver)
+    resid = float(sol.residual)
+    assert bool(sol.converged) == (resid <= 1e-5)
+    recomputed = float(
+        np.max(np.abs(_sweep_once(probs, sol.grid) - np.asarray(sol.grid)))
+    )
+    # the sweep is a contraction: |f(f(p)) - f(p)| <= gamma * |f(p) - p|
+    # <= reported residual (small slack for f32 vs f64 recomputation)
+    assert recomputed <= resid * (1 + 1e-3) + 1e-7, (solver, recomputed, resid)
+
+
+@pytest.mark.parametrize('solver', SOLVERS)
+def test_certificate_max_iter_cut(probs, solver):
+    """An iteration-capped solve says so: converged False, resid > eps."""
+    sol = solve_xt(probs, eps=0.0, max_iter=5, solver=solver)
+    assert int(sol.iterations) == 5
+    assert not bool(sol.converged)
+
+
+def test_picard_residual_matches_exact_recomputation(probs):
+    """For picard the certificate is exactly reproducible: re-running one
+    iteration short and sweeping once recovers the reported residual."""
+    sol = solve_xt(probs)
+    prev = solve_xt(probs, max_iter=int(sol.iterations) - 1)
+    stepped = _sweep_once(probs, prev.grid)
+    recomputed = float(np.max(stepped - np.asarray(prev.grid, np.float64)))
+    # rel tolerance covers the f64 recomputation of the f32 solver sweep
+    assert recomputed == pytest.approx(float(sol.residual), rel=5e-3)
+
+
+# -- batched counts + solves -------------------------------------------------
+
+
+def test_grouped_counts_match_per_group_masked_counts(stream, season):
+    gid = _group_ids(season, 8)
+    stacked = xt_counts(*stream, l=16, w=12, group_id=gid, n_groups=8)
+    assert stacked.shots.shape == (8, 192)
+    assert stacked.trans.shape == (8, 192, 192)
+    head, mask = stream[:6], stream[6]
+    for g in range(8):
+        single = xt_counts(*head, mask & (gid == g), l=16, w=12)
+        np.testing.assert_array_equal(
+            np.asarray(stacked.shots[g]), np.asarray(single.shots)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stacked.trans[g]), np.asarray(single.trans)
+        )
+
+
+def test_grouped_counts_validation(stream, season):
+    with pytest.raises(ValueError, match='together'):
+        xt_counts(*stream, l=16, w=12, group_id=_group_ids(season, 4))
+    with pytest.raises(ValueError, match='together'):
+        solve_xt_matrix_free(*stream, l=16, w=12, n_groups=4)
+    # a dense transition stack whose flat ids would overflow int32 is
+    # rejected loudly, never silently wrapped into the wrong group
+    with pytest.raises(ValueError, match='int32'):
+        xt_counts(
+            *stream, l=32, w=24,
+            group_id=_group_ids(season, 4000), n_groups=4000,
+        )
+
+
+@pytest.mark.parametrize('solver', SOLVERS)
+def test_batched_64_matches_looped_single_solves(batched64, solver):
+    """The acceptance parity: a 64-grid fleet solved in one dispatch
+    equals 64 single-grid solves of the same variant <=1e-5, with honest
+    per-grid certificates."""
+    _, bp = batched64
+    sol = solve_xt(bp, solver=solver)
+    assert sol.grid.shape == (64, 12, 16)
+    assert sol.iterations.shape == (64,)
+    assert np.asarray(sol.converged).all()
+    for g in range(0, 64, 7):
+        pg = XTProbabilities(
+            bp.p_score[g], bp.p_shot[g], bp.p_move[g], bp.transition[g]
+        )
+        sg = solve_xt(pg, solver=solver)
+        np.testing.assert_allclose(
+            np.asarray(sol.grid[g]), np.asarray(sg.grid), atol=1e-5
+        )
+        # per-grid certificate: residual recomputation bound, per grid
+        recomputed = float(
+            np.max(np.abs(_sweep_once(pg, sol.grid[g]) - np.asarray(sol.grid[g])))
+        )
+        assert recomputed <= float(sol.residual[g]) * (1 + 1e-3) + 1e-7
+
+
+def test_batched_matrix_free_matches_batched_dense(stream, season, batched64):
+    gid, bp = batched64
+    dense = solve_xt(bp)
+    mf, mf_probs = solve_xt_matrix_free(
+        *stream, l=16, w=12, group_id=gid, n_groups=64
+    )
+    np.testing.assert_allclose(
+        np.asarray(mf.grid), np.asarray(dense.grid), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(mf_probs.p_move), np.asarray(bp.p_move), atol=1e-6
+    )
+    assert mf_probs.transition is None
+
+
+def test_batched_max_iter_masking(batched64):
+    """eps=0: every grid either runs the full max_iter or stopped at an
+    EXACT f32 fixed point (residual 0) — the per-grid masking never
+    freezes a still-moving grid early (same exit rule as the single-grid
+    loop's ``resid > eps`` test)."""
+    _, bp = batched64
+    sol = solve_xt(bp, eps=0.0, max_iter=4)
+    its = np.asarray(sol.iterations)
+    resid = np.asarray(sol.residual)
+    assert ((its == 4) | (resid <= 0.0)).all()
+    assert (its == 4).any()  # the big groups really are cut by the cap
+    # the converged flag is exactly the residual test, per grid
+    np.testing.assert_array_equal(np.asarray(sol.converged), resid <= 0.0)
+
+
+def test_batched_legacy_tuple_rejected(batched64):
+    _, bp = batched64
+    with pytest.raises(ValueError, match='single-grid'):
+        solve_xt(bp, return_residual=True)
+
+
+def test_legacy_return_residual_tuples(probs, stream):
+    """The deprecated single-grid aliases keep their exact old shapes."""
+    xT, it, resid = solve_xt(probs, return_residual=True)
+    sol = solve_xt(probs)
+    np.testing.assert_array_equal(np.asarray(xT), np.asarray(sol.grid))
+    assert int(it) == int(sol.iterations)
+    assert float(resid) == float(sol.residual)
+    xT, it, p_score, p_shot, p_move, resid = solve_xt_matrix_free(
+        *stream, l=16, w=12, return_residual=True
+    )
+    msol, mprobs = solve_xt_matrix_free(*stream, l=16, w=12)
+    np.testing.assert_array_equal(np.asarray(xT), np.asarray(msol.grid))
+    np.testing.assert_array_equal(np.asarray(p_shot), np.asarray(mprobs.p_shot))
+
+
+# -- batch-aware rating + interpolation --------------------------------------
+
+
+def test_batched_rate_actions_equals_looped(stream, season, batched64):
+    gid, bp = batched64
+    sol = solve_xt(bp)
+    grids = jnp.asarray(np.asarray(sol.grid), dtype=jnp.float32)
+    vals = rate_actions(grids, *stream, l=16, w=12, group_id=gid)
+    assert np.isfinite(np.asarray(vals)).any()
+    for g in range(0, 64, 9):
+        single = rate_actions(grids[g], *stream, l=16, w=12)
+        sel = np.asarray(gid == g) & np.isfinite(np.asarray(vals))
+        np.testing.assert_array_equal(
+            np.asarray(vals)[sel], np.asarray(single)[sel]
+        )
+    # out-of-range group ids rate NaN
+    bad = rate_actions(grids, *stream, l=16, w=12, group_id=gid * 0 - 1)
+    assert np.isnan(np.asarray(bad)).all()
+    with pytest.raises(ValueError, match='group_id'):
+        rate_actions(grids, *stream, l=16, w=12)
+
+
+def test_batched_interpolate_equals_looped(batched64):
+    _, bp = batched64
+    grids = solve_xt(bp).grid
+    fine = interpolate_grid(grids, 64, 48)
+    assert fine.shape == (64, 48, 64)
+    for g in range(0, 64, 11):
+        np.testing.assert_array_equal(
+            np.asarray(fine[g]), np.asarray(interpolate_grid(grids[g], 64, 48))
+        )
+
+
+# -- frontend: grouped fit / rate -------------------------------------------
+
+
+@pytest.fixture(scope='module')
+def frame():
+    frames = [
+        synthetic_actions_frame(game_id=2000 + g, n_actions=700, seed=100 + g)
+        for g in range(6)
+    ]
+    return pd.concat(frames, ignore_index=True)
+
+
+def test_model_group_by_matches_per_group_fits(frame):
+    model = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        frame, group_by='team_id'
+    )
+    assert model.grids_.shape[0] == len(model.group_keys_)
+    assert model.converged is True
+    assert model.converged_per_grid_.all()
+    assert sorted(model.surfaces()) == sorted(model.group_keys_.tolist())
+    # the documented single-grid probability slots keep their 2-D
+    # contract: stacks live in *_matrices_, the slots stay None
+    G = len(model.group_keys_)
+    assert model.scoring_prob_matrix is None
+    assert model.transition_matrix is None
+    assert model.scoring_prob_matrices_.shape == (G, 12, 16)
+    assert model.transition_matrices_.shape == (G, 192, 192)
+    ratings = model.rate(frame)
+    for key in model.group_keys_:
+        sub = frame[frame['team_id'] == key]
+        single = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(sub)
+        np.testing.assert_allclose(
+            model.surface(key), single.xT, atol=1e-5
+        )
+        sel = (frame['team_id'] == key).to_numpy()
+        np.testing.assert_allclose(
+            ratings[sel], single.rate(sub), atol=1e-6, equal_nan=True
+        )
+
+
+def test_model_group_by_variants_and_certificates(frame):
+    ref = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        frame, group_by='team_id'
+    )
+    for variant in ('anderson', 'anchored', 'momentum'):
+        m = xt.ExpectedThreat(l=16, w=12, backend='jax', variant=variant).fit(
+            frame, group_by='team_id'
+        )
+        assert m.converged is True
+        np.testing.assert_allclose(m.grids_, ref.grids_, atol=5e-5)
+        assert m.n_iter == int(m.n_iter_per_grid_.max())
+        assert m.solve_residual == pytest.approx(
+            float(m.solve_residual_per_grid_.max())
+        )
+
+
+def test_model_group_by_unseen_key_and_interpolation(frame):
+    model = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        frame, group_by='team_id'
+    )
+    mutated = frame.copy()
+    mutated.loc[mutated.index[:40], 'team_id'] = -777
+    vals = model.rate(mutated)
+    assert np.isnan(vals[:40]).all()
+    coarse = model.rate(frame)
+    fine = model.rate(frame, use_interpolation=True)
+    m = np.isfinite(coarse)
+    assert np.isfinite(fine[m]).all()
+    assert np.isnan(fine[~m]).all()
+    # interpolation only upsamples the REFERENCED groups: rating a
+    # one-team slice must agree with rating it inside the full frame
+    # (the compact remap cannot scramble which surface an action reads)
+    key = model.group_keys_[-1]
+    sub = frame[frame['team_id'] == key]
+    fine_sub = model.rate(sub, use_interpolation=True)
+    sel = (frame['team_id'] == key).to_numpy()
+    np.testing.assert_array_equal(fine[sel], fine_sub)
+    # a frame of only unseen keys rates all-NaN without touching a grid
+    ghost = frame.head(20).copy()
+    ghost['team_id'] = -1234
+    assert np.isnan(model.rate(ghost, use_interpolation=True)).all()
+
+
+def test_grouped_auto_solver_folds_fleet_size_in(frame):
+    """The dense/matrix-free auto gate is memory-equivalent at the fleet
+    scale: G·(w·l)² past DENSE_CELL_LIMIT² goes matrix-free, so a
+    many-group fit never builds (nor stores) a giant transition stack."""
+    m = xt.ExpectedThreat(l=16, w=12, backend='jax')
+    assert m.solver == 'dense'
+    assert m._effective_solver(2) == 'dense'
+    # 456 * 192^2 > 4096^2: past the memory-equivalent dense budget
+    assert m._effective_solver(456) == 'matrix-free'
+    # an explicit request still wins
+    forced = xt.ExpectedThreat(l=16, w=12, backend='jax', solver='dense')
+    assert forced._effective_solver(10_000) == 'dense'
+    # end-to-end: a fine-ish grid with groups auto-routes matrix-free
+    # (transition stack never materialized) and still rates
+    fleet = xt.ExpectedThreat(l=64, w=48, backend='jax')
+    assert fleet._effective_solver(3) == 'matrix-free'
+    fleet.fit(frame, group_by='team_id')
+    assert fleet.transition_matrix is None
+    assert fleet.transition_matrices_ is None  # never built matrix-free
+    assert fleet.scoring_prob_matrices_ is not None
+    assert np.isfinite(fleet.rate(frame)).any()
+    # an ungrouped refit clears the stacked state too
+    fleet.fit(frame)
+    assert fleet.scoring_prob_matrices_ is None
+    assert fleet.scoring_prob_matrix is not None
+
+
+def test_model_group_by_array_spec(frame):
+    """Grouping by an explicit per-action array (a scenario axis derived
+    outside the frame, e.g. a game-phase bucket)."""
+    phase = (np.arange(len(frame)) * 3 // len(frame)).astype(np.int64)
+    model = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        frame, group_by=phase
+    )
+    assert list(model.group_keys_) == [0, 1, 2]
+    # the fit-time grouping came from an array: rate needs it again
+    with pytest.raises(ValueError, match='group_by'):
+        model.rate(frame)
+    vals = model.rate(frame, group_by=phase)
+    single = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        frame[phase == 1]
+    )
+    sel = phase == 1
+    np.testing.assert_allclose(
+        vals[sel], single.rate(frame[sel]), atol=1e-6, equal_nan=True
+    )
+
+
+def test_model_group_by_guards(frame):
+    with pytest.raises(ValueError, match='JAX-backend'):
+        xt.ExpectedThreat(backend='pandas').fit(frame, group_by='team_id')
+    with pytest.raises(ValueError, match='not in actions'):
+        xt.ExpectedThreat(backend='jax').fit(frame, group_by='no_such_col')
+    with pytest.raises(ValueError, match='fleet'):
+        xt.ExpectedThreat(backend='jax', keep_heatmaps=True).fit(
+            frame, group_by='team_id'
+        )
+    grouped = xt.ExpectedThreat(backend='jax').fit(frame, group_by='team_id')
+    with pytest.raises(ValueError, match='collection'):
+        grouped.save_model('/tmp/never-written.json')
+    # interpolator() reads the (deliberately zeroed) single-surface slot:
+    # it must refuse rather than silently return a flat zero function
+    with pytest.raises(ValueError, match='collection'):
+        grouped.interpolator()
+    # refitting WITHOUT group_by clears the fleet state
+    grouped.fit(frame)
+    assert grouped.grids_ is None
+    assert np.any(grouped.xT)
+    with pytest.raises(ValueError, match='variant'):
+        xt.ExpectedThreat(backend='jax', variant='gauss-seidel')
+    with pytest.raises(ValueError, match='JAX-backend'):
+        xt.ExpectedThreat(backend='pandas', variant='momentum')
+
+
+def test_model_variant_attribute_mutation_guard(frame):
+    """variant is a public attribute: the fit-time re-validation catches a
+    post-construction mutation (codebase convention)."""
+    model = xt.ExpectedThreat(backend='jax')
+    model.variant = 'momentum'
+    model.backend = 'pandas'
+    with pytest.raises(ValueError, match='JAX-backend'):
+        model.fit(frame)
+
+
+@pytest.mark.slow
+def test_docs_xt_quickstart_runs():
+    """The docs/xt.md batched-fit quickstart must run as written (same
+    policy as the README quickstart guard)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(root, 'docs', 'xt.md')).read()
+    blocks = re.findall(r'```python\n(.*?)```', doc, flags=re.DOTALL)
+    assert blocks, 'docs/xt.md has no python quickstart block'
+    code = blocks[0]
+    assert 'group_by' in code
+    proc = subprocess.run(
+        [sys.executable, '-c', code],
+        capture_output=True, text=True, timeout=300, cwd=root,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+
+
+def test_pack_row_values_roundtrip(frame):
+    batch, _ = pack_actions(
+        frame, home_team_ids={g: None for g in frame['game_id'].unique()}
+    )
+    values = np.arange(len(frame), dtype=np.int32)
+    packed = pack_row_values(values, batch, fill=-1)
+    assert packed.shape == batch.mask.shape
+    assert (packed[~np.asarray(batch.mask)] == -1).all()
+    np.testing.assert_array_equal(unpack_values(packed, batch), values)
+    with pytest.raises(ValueError, match='valid actions'):
+        pack_row_values(values[:-1], batch)

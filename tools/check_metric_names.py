@@ -23,6 +23,12 @@ Two cardinality rules ride along (the Prometheus-sanity gate):
   they are run-log events, not exposition series.) Other dynamic names
   (plain variables) remain out of scope: the convention applies to the
   literal registration sites, and the runtime guard covers the rest.
+- **label keys are registered per area** (``KNOWN_LABELS``): a literal
+  ``key=`` kwarg on a registered instrument's ``inc``/``observe``/
+  ``set``/``labels`` call must appear in its area's entry, so new
+  exposition dimensions (like the batched-xT ``solver``/``n_grids``
+  labels) land governed — with their value-cardinality contract noted —
+  instead of ad hoc.
 
 Usage: ``python tools/check_metric_names.py [paths...]`` (defaults to
 the package plus the repo-root scripts, benchmarks, examples and the
@@ -68,6 +74,36 @@ KNOWN_AREAS = {
     'xla',  # compile observatory + profiler traces (obs/xla.py)
     'xt',  # expected-threat fit metrics
 }
+
+#: Registered label KEYS per metric area — the cardinality contract's
+#: other half. A label key minted at a literal call site
+#: (``counter('a/b').inc(1, key=...)``) must appear in its area's entry
+#: here, so a new dimension cannot leak into the exposition ungoverned.
+#: Values are the label's *keys* only; value cardinality is the caller's
+#: contract, noted where it is load-bearing:
+#:
+#: - ``xt``: ``n_grids`` is the batched-fit fleet size and MUST be
+#:   bucketed to powers of two (``xthreat._pow2_bucket``) — an arbitrary
+#:   fleet size would mint a series per distinct group count. ``solver``
+#:   is dense|matrix-free (sweep structure), ``variant`` the
+#:   picard|anderson|anchored|momentum iteration schedule.
+#: - sites passing labels via ``**labels`` dicts are out of static
+#:   reach; their keys are still registered here as documentation and
+#:   the runtime series-budget guard covers the rest.
+KNOWN_LABELS = {
+    'bench': {'path', 'platform'},
+    'learn': {'source', 'stage', 'verdict', 'head', 'model'},
+    'mem': {'span', 'device'},
+    'pipeline': {'stage'},
+    'serve': {'reason', 'kind', 'bucket'},
+    'train': {'path', 'platform'},
+    'vaep': {'path', 'platform'},
+    'xla': {'fn'},
+    'xt': {'grid', 'solver', 'variant', 'backend', 'n_grids', 'overflow'},
+}
+
+#: methods through which a registered instrument takes label kwargs
+LABEL_TAKING_METHODS = {'inc', 'observe', 'set', 'labels'}
 
 #: implicit units of name-taking calls that never pass ``unit=``
 DEFAULT_UNITS = {
@@ -146,6 +182,38 @@ def collect_names(
         yield call, first.value, node.lineno, unit
 
 
+def collect_label_sites(tree: ast.Module) -> Iterator[Tuple[str, str, int]]:
+    """Yield ``(metric_name, label_key, lineno)`` for every literal label.
+
+    A literal label site is a ``.inc(...)`` / ``.observe(...)`` /
+    ``.set(...)`` / ``.labels(...)`` call whose receiver is a
+    ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` call with a
+    literal name, carrying explicit ``key=`` kwargs (``**labels`` dicts
+    and instruments held in variables are out of static reach — the
+    runtime cardinality guard covers those).
+    """
+    metric_calls = NAME_TAKING_CALLS - {'timed', 'timed_labels', 'span'}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr in LABEL_TAKING_METHODS
+            and isinstance(func.value, ast.Call)
+        ):
+            continue
+        recv = func.value
+        if _call_name(recv.func) not in metric_calls or not recv.args:
+            continue
+        first = recv.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            continue
+        for kw in node.keywords:
+            if kw.arg is not None:
+                yield first.value, kw.arg, node.lineno
+
+
 def check_files(
     paths: List[str], areas: Optional[set] = None
 ) -> Tuple[List[str], int]:
@@ -208,6 +276,17 @@ def check_files(
                 problems.append(
                     f'{site}: {call}({name!r}) with unit={unit!r} conflicts '
                     f'with unit={seen[0]!r} at {seen[1]}'
+                )
+        for name, key, lineno in collect_label_sites(tree):
+            area = name.split('/')[0]
+            allowed = KNOWN_LABELS.get(area)
+            if allowed is None:
+                continue  # area without a label contract (yet)
+            if key not in allowed:
+                problems.append(
+                    f'{path}:{lineno}: label {key!r} on {name!r} is not '
+                    f'registered for area {area!r} (add it to KNOWN_LABELS '
+                    'to govern the new dimension)'
                 )
     return sorted(problems), n_sites
 
