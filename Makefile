@@ -20,11 +20,15 @@ lint:
 	$(PY) tools/check_metric_names.py
 	$(PY) tools/obsctl.py snapshot >/dev/null
 
-# the operator CLI, driven end to end in a jax-free process: a live
-# registry snapshot plus the Prometheus exposition must both exit 0
+# the operator CLI, driven end to end in a jax-free process (a live
+# registry snapshot plus the Prometheus exposition must both exit 0),
+# then one traced request end to end: tools/obs_smoke.py serves a real
+# request under a RunLog and asserts `obsctl trace <request_id>`
+# reconstructs its queue -> flush -> dispatch -> slice path
 obs-smoke:
 	$(PY) tools/obsctl.py snapshot
 	$(PY) tools/obsctl.py prom
+	env JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 types:
 	@$(PY) -c "import mypy" 2>/dev/null \

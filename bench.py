@@ -971,9 +971,15 @@ def _bench_serve_throughput(
     - ``request_p50_ms`` / ``request_p99_ms`` end-to-end latency
       (``serve/request_seconds`` histogram quantile estimates);
     - flush-reason split (``full`` vs ``deadline``) and rejections;
+    - per-segment latency decomposition (``queue_wait`` / ``pad`` /
+      ``dispatch`` / ``slice`` mean + p99 from the request-tracing
+      histograms) — where each offered-load level spends its wall;
     - ``compiled_shapes`` before/after — the acceptance gate: under
       steady offered load the compiled-shape count must PLATEAU at the
-      bucket-ladder size (no per-request retraces).
+      bucket-ladder size (no per-request retraces);
+    - sweep-wide SLO verdicts (per-objective burn rates and budget
+      remaining from the service's SLO engine; steady CPU load under
+      generous objectives must end with every budget intact).
     """
     import threading as _threading
     import time as _time
@@ -982,7 +988,7 @@ def _bench_serve_throughput(
     import pandas as pd
 
     from socceraction_tpu.core.synthetic import synthetic_actions_frame
-    from socceraction_tpu.obs import REGISTRY
+    from socceraction_tpu.obs import REGISTRY, SLOConfig
     from socceraction_tpu.serve import Overloaded, RatingService
     from socceraction_tpu.vaep.base import VAEP
 
@@ -1017,12 +1023,16 @@ def _bench_serve_throughput(
     ]
 
     out: dict = {'duration_s_per_level': duration_s, 'levels': []}
-    # run_level resets the registry per level; the summary gauge and the
-    # compile observatory's accounting must survive those resets
-    REGISTRY.preserve('bench/', 'xla/')
+    # run_level resets the registry per level; the summary gauge, the
+    # compile observatory's accounting and the SLO event counters (the
+    # burn-rate windows span levels) must survive those resets
+    REGISTRY.preserve('bench/', 'xla/', 'slo/')
     with RatingService(
         model, max_actions=max_actions, max_batch_size=16, max_wait_ms=2.0,
         max_queue=256,
+        # generous objectives: the artifact reports the verdicts, and a
+        # CPU smoke run must never shed its own offered load
+        slo=SLOConfig.simple(latency_ms=60_000.0, latency_target=0.99),
     ) as svc:
         svc.warmup()
         out['bucket_ladder'] = list(svc.ladder)
@@ -1068,6 +1078,19 @@ def _bench_serve_throughput(
             lat = snap.series('serve/request_seconds', kind='rate')
             fill = snap.series('serve/batch_fill_ratio')
             q = lat.quantiles if lat is not None and lat.count else {}
+            # per-segment latency decomposition (queue-wait vs pad vs
+            # dispatch vs slice) from the request-tracing histograms —
+            # where an offered-load level actually spends its wall
+            segments = {}
+            for seg in ('queue_wait', 'pad', 'dispatch', 'slice'):
+                s = snap.series('serve/segment_seconds', segment=seg)
+                if s is not None and s.count:
+                    segments[seg] = {
+                        'mean_ms': round(s.mean * 1e3, 3),
+                        'p99_ms': round(
+                            (s.quantiles or {}).get('p99', s.max) * 1e3, 3
+                        ),
+                    }
             level = {
                 'clients': n_clients,
                 'elapsed_s': round(elapsed, 2),
@@ -1083,6 +1106,7 @@ def _bench_serve_throughput(
                 'request_p99_ms': (
                     round(q['p99'] * 1e3, 2) if 'p99' in q else None
                 ),
+                'segments': segments,
                 'flushes': {
                     reason: int(
                         snap.value('serve/flushes', reason=reason)
@@ -1110,6 +1134,24 @@ def _bench_serve_throughput(
         out['retrace_storms'] = int(
             snap1.value('xla/retrace_storm', fn='pair_probs') - storms_before
         )
+        # SLO verdicts over the whole sweep: per-objective burn rates and
+        # budget remaining from the service's engine (the sweep must end
+        # with every budget intact and nothing shedding)
+        health_slo = svc.health()['slo']
+        out['slo'] = {
+            'objectives': {
+                name: {
+                    'kind': e.get('kind'),
+                    'target': e.get('target'),
+                    'burn_rate_fast': e.get('burn_rate_fast'),
+                    'burn_rate_slow': e.get('burn_rate_slow'),
+                    'budget_remaining': e.get('budget_remaining'),
+                    'ok': e.get('ok'),
+                }
+                for name, e in health_slo.get('objectives', {}).items()
+            },
+            'shedding': health_slo.get('shedding'),
+        }
 
     best = max(out['levels'], key=lambda lv: lv['requests_per_sec'])
     out['peak_requests_per_sec'] = best['requests_per_sec']

@@ -14,6 +14,11 @@ exercises every prior layer at once:
 - :mod:`socceraction_tpu.learn.shadow` — bitwise-reproducible replay of
   captured traffic (:class:`~socceraction_tpu.serve.capture.TrafficCapture`)
   through candidate vs active model.
+- :mod:`socceraction_tpu.learn.drift` — the drift watch: device-side
+  PSI/KS of the capture ring's feature and prediction distributions vs
+  the active model's training reference (one vmap'd dispatch), the
+  learner's optional early retrain trigger and an extra fail-closed
+  gate input (``GateConfig.max_drift_psi``).
 - :mod:`socceraction_tpu.learn.gate` — :class:`GateConfig` calibration
   bands and the typed :class:`PromotionReport` every decision becomes.
 - :mod:`socceraction_tpu.learn.loop` — :class:`ContinuousLearner`, the
@@ -37,6 +42,14 @@ configuration and the operational runbook.
 """
 
 from .calibration import CalibrationSummary, calibration_summary, reliability_curve
+from .drift import (
+    DriftConfig,
+    DriftReference,
+    DriftResult,
+    DriftWatch,
+    build_drift_reference,
+    drift_statistics,
+)
 from .gate import GateConfig, PromotionReport, evaluate_gate, record_report
 from .ingest import SeasonWatcher, extend_packed, newest_game_ids
 from .loop import ContinuousLearner, LearnConfig
@@ -45,12 +58,18 @@ from .shadow import ShadowResult, shadow_replay
 __all__ = [
     'CalibrationSummary',
     'ContinuousLearner',
+    'DriftConfig',
+    'DriftReference',
+    'DriftResult',
+    'DriftWatch',
     'GateConfig',
     'LearnConfig',
     'PromotionReport',
     'SeasonWatcher',
     'ShadowResult',
+    'build_drift_reference',
     'calibration_summary',
+    'drift_statistics',
     'evaluate_gate',
     'extend_packed',
     'newest_game_ids',

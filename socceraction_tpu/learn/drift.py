@@ -1,0 +1,420 @@
+"""Drift watch: device PSI/KS of live traffic vs the training reference.
+
+Between the promotion gate's calibration checks (PR 6) the serving model
+runs blind: if the traffic distribution moves — a new league's pitch
+geometry, a rule change shifting shot mix, a provider re-mapping action
+types — nothing notices until enough new matches land to trigger a
+retrain *and* the gate happens to catch the damage. Per 2409.04889's
+argument that statistical honesty must be monitored *continuously*, not
+only at promotion time, this module watches the serving distribution
+itself:
+
+- :func:`build_drift_reference` — fix per-feature bin edges and
+  reference proportions from the active model's training data (a packed
+  batch of stored matches): raw packed action fields (locations, clock,
+  action/result/bodypart ids) plus each probability head's prediction
+  distribution.
+- :class:`DriftWatch` / :func:`drift_statistics` — score a current
+  traffic window (the serve layer's capture ring, packed exactly like a
+  replay) against the reference with the **population stability index**
+  (PSI, the classic ``(p-q)·ln(p/q)`` score-drift statistic) and a
+  binned **Kolmogorov–Smirnov** statistic per feature, computed on
+  device in **one** ``vmap``'d dispatch over the stacked feature/head
+  rows — the same packed-mask semantics as
+  :mod:`socceraction_tpu.learn.calibration`: zero-weight (padding) rows
+  contribute to no bin, and the row axis is padded to a power of two so
+  varying window sizes reuse one compiled program.
+
+Results surface three ways: ``drift/*`` gauges (per-feature PSI/KS,
+the max, check/trigger counters), a ``drift_check`` event in the run
+log + flight recorder (``obsctl drift`` tails them), and the typed
+:class:`DriftResult` the continuous learner threads into its promotion
+report, its optional early retrain trigger, and the gate's fail-closed
+``max_drift_psi`` band.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache, partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import counter, gauge, span
+from ..obs.recorder import RECORDER
+from ..obs.trace import current_runlog
+
+__all__ = [
+    'DriftConfig',
+    'DriftReference',
+    'DriftResult',
+    'DriftWatch',
+    'build_drift_reference',
+    'drift_statistics',
+]
+
+#: Packed action fields monitored by default: the continuous geometry /
+#: clock signals plus the categorical ids (binned by value — adjacent ids
+#: may share a bin past ``n_bins`` categories, which is fine for drift:
+#: reference and current windows are binned identically).
+DEFAULT_FIELDS: Tuple[str, ...] = (
+    'start_x', 'start_y', 'end_x', 'end_y', 'time_seconds',
+    'type_id', 'result_id', 'bodypart_id',
+)
+
+_EPS = 1e-6
+
+
+@dataclass(frozen=True)
+class DriftConfig:
+    """Knobs of one drift watch.
+
+    ``psi_trigger`` uses the classic banding: PSI < 0.1 stable,
+    0.1–0.25 drifting, > 0.25 shifted — the default trigger fires on a
+    genuine shift, not sampling noise. ``min_actions`` refuses to score
+    a window too small to estimate proportions (the result then reports
+    ``evaluated=False``, which the gate's ``max_drift_psi`` band treats
+    as *no evidence* and fails closed on).
+    """
+
+    n_bins: int = 16
+    psi_trigger: float = 0.25
+    ks_trigger: Optional[float] = None
+    min_actions: int = 256
+    fields: Tuple[str, ...] = DEFAULT_FIELDS
+    include_predictions: bool = True
+    #: stored matches used to build the training reference (newest-first)
+    reference_games: int = 16
+
+
+@dataclass(frozen=True)
+class DriftReference:
+    """Frozen training-side distribution: bin edges + proportions.
+
+    ``lo``/``hi`` fix the equal-width bin edges per monitored row —
+    stored so every later window is binned *identically* to the
+    reference (prediction rows are pinned to [0, 1]); ``props`` is the
+    ``(F, n_bins)`` reference proportion stack.
+    """
+
+    names: Tuple[str, ...]
+    lo: np.ndarray
+    hi: np.ndarray
+    props: np.ndarray
+    n_bins: int
+    n_actions: int
+    model_version: Optional[str] = None
+
+
+@dataclass
+class DriftResult:
+    """One window's drift statistics vs the reference (JSON-ready)."""
+
+    psi: Dict[str, float] = field(default_factory=dict)
+    ks: Dict[str, float] = field(default_factory=dict)
+    max_psi: float = 0.0
+    max_psi_feature: Optional[str] = None
+    max_ks: float = 0.0
+    max_ks_feature: Optional[str] = None
+    n_actions: int = 0
+    reference_actions: int = 0
+    #: False when the window was too small to score (no statistics)
+    evaluated: bool = True
+    triggered: bool = False
+    reasons: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Flat rendering for reports, run-log events and ``obsctl``."""
+        return {
+            'psi': {k: round(v, 6) for k, v in self.psi.items()},
+            'ks': {k: round(v, 6) for k, v in self.ks.items()},
+            'max_psi': round(self.max_psi, 6),
+            'max_psi_feature': self.max_psi_feature,
+            'max_ks': round(self.max_ks, 6),
+            'max_ks_feature': self.max_ks_feature,
+            'n_actions': self.n_actions,
+            'reference_actions': self.reference_actions,
+            'evaluated': self.evaluated,
+            'triggered': self.triggered,
+            'reasons': list(self.reasons),
+        }
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _stack_rows(
+    batch: Any,
+    fields: Sequence[str],
+    probs: Optional[Dict[str, np.ndarray]],
+) -> Tuple[Tuple[str, ...], np.ndarray, np.ndarray]:
+    """``(names, x (F, N), w (N,))`` from a packed batch (+ predictions).
+
+    ``N`` is padded up to a power of two with zero-weight rows, so a
+    drift check compiles one program per power-of-two window size
+    instead of one per distinct capture-window length — the same
+    padding-is-free mask semantics as the calibration kernels.
+    """
+    rows: List[np.ndarray] = []
+    names: List[str] = []
+    for f in fields:
+        rows.append(np.asarray(getattr(batch, f), np.float32).reshape(-1))
+        names.append(f)
+    for head in sorted(probs or {}):
+        rows.append(np.asarray(probs[head], np.float32).reshape(-1))
+        names.append(f'pred_{head}')
+    w = np.asarray(batch.mask, np.float32).reshape(-1)
+    x = np.stack(rows, axis=0)
+    n = x.shape[1]
+    padded = _pow2(max(n, 1))
+    if padded != n:
+        x = np.pad(x, [(0, 0), (0, padded - n)])
+        w = np.pad(w, [(0, padded - n)])
+    return tuple(names), x, w
+
+
+def _weighted_props(xi, w, lo_i, hi_i, n_bins: int):
+    """Masked equal-width bin proportions of one stacked row (traced)."""
+    import jax
+    import jax.numpy as jnp
+
+    width = jnp.maximum(hi_i - lo_i, _EPS)
+    t = (xi - lo_i) / width
+    bins = jnp.clip((t * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    cnt = jax.ops.segment_sum(w, bins, num_segments=n_bins)
+    return cnt / jnp.maximum(jnp.sum(cnt), _EPS)
+
+
+def _props_kernel(x, w, lo, hi, n_bins: int):
+    import jax
+
+    return jax.vmap(
+        lambda xi, lo_i, hi_i: _weighted_props(xi, w, lo_i, hi_i, n_bins)
+    )(x, lo, hi)
+
+
+@lru_cache(maxsize=None)
+def _jitted(n_bins: int):
+    """Jitted (props, drift) kernels for one static bin count."""
+    import jax
+    import jax.numpy as jnp
+
+    props = jax.jit(partial(_props_kernel, n_bins=n_bins))
+
+    def drift(x, w, lo, hi, ref):
+        p = _props_kernel(x, w, lo, hi, n_bins)
+        # clamp-and-renormalize both sides identically: PSI's log blows
+        # up on empty bins, and the clamp must not bias p against q
+        p = jnp.clip(p, _EPS, None)
+        p = p / jnp.sum(p, axis=1, keepdims=True)
+        q = jnp.clip(ref, _EPS, None)
+        q = q / jnp.sum(q, axis=1, keepdims=True)
+        psi = jnp.sum((p - q) * jnp.log(p / q), axis=1)
+        ks = jnp.max(
+            jnp.abs(jnp.cumsum(p, axis=1) - jnp.cumsum(q, axis=1)), axis=1
+        )
+        return psi, ks
+
+    return props, jax.jit(drift)
+
+
+def build_drift_reference(
+    model: Any,
+    batch: Any,
+    config: Optional[DriftConfig] = None,
+    *,
+    model_version: Optional[str] = None,
+) -> DriftReference:
+    """Freeze the training-side distribution of ``model`` over ``batch``.
+
+    ``batch`` is a packed :class:`~socceraction_tpu.core.batch.ActionBatch`
+    of the matches the active model trained on (the learner packs the
+    newest ``reference_games`` stored matches). Bin edges come from the
+    reference's own masked min/max per field — predictions are pinned to
+    [0, 1] so the head distributions bin identically forever.
+    """
+    from .shadow import replay_probs
+
+    cfg = config if config is not None else DriftConfig()
+    probs = replay_probs(model, batch) if cfg.include_predictions else None
+    names, x, w = _stack_rows(batch, cfg.fields, probs)
+    mask = w > 0
+    n_actions = int(mask.sum())
+    if n_actions == 0:
+        raise ValueError('cannot build a drift reference from an empty batch')
+    lo = np.empty(len(names), np.float32)
+    hi = np.empty(len(names), np.float32)
+    for i, name in enumerate(names):
+        if name.startswith('pred_'):
+            lo[i], hi[i] = 0.0, 1.0
+        else:
+            vals = x[i][mask]
+            lo[i], hi[i] = float(vals.min()), float(vals.max())
+            if hi[i] <= lo[i]:
+                hi[i] = lo[i] + 1.0  # a constant field still bins sanely
+    props_fn, _ = _jitted(int(cfg.n_bins))
+    props = np.asarray(props_fn(x, w, lo, hi))
+    return DriftReference(
+        names=names, lo=lo, hi=hi, props=props,
+        n_bins=int(cfg.n_bins), n_actions=n_actions,
+        model_version=model_version,
+    )
+
+
+def drift_statistics(
+    reference: DriftReference,
+    batch: Any,
+    probs: Optional[Dict[str, np.ndarray]] = None,
+    *,
+    fields: Optional[Sequence[str]] = None,
+) -> Tuple[Dict[str, float], Dict[str, float], int]:
+    """``(psi, ks, n_actions)`` of one window vs the reference.
+
+    One vmap'd device dispatch over the stacked rows; the row set must
+    match the reference's (same fields, same prediction heads).
+    """
+    use_fields = tuple(fields) if fields is not None else tuple(
+        n for n in reference.names if not n.startswith('pred_')
+    )
+    names, x, w = _stack_rows(batch, use_fields, probs)
+    if names != reference.names:
+        raise ValueError(
+            f'window rows {names} do not match the reference '
+            f'{reference.names}; rebuild the reference for this model'
+        )
+    _, drift_fn = _jitted(int(reference.n_bins))
+    psi, ks = drift_fn(x, w, reference.lo, reference.hi, reference.props)
+    psi = np.asarray(psi)
+    ks = np.asarray(ks)
+    n_actions = int((w > 0).sum())
+    return (
+        {n: float(v) for n, v in zip(names, psi)},
+        {n: float(v) for n, v in zip(names, ks)},
+        n_actions,
+    )
+
+
+class DriftWatch:
+    """A frozen reference plus the check that scores windows against it.
+
+    Build once per active model (:meth:`from_batch`); each ``check`` is
+    one device dispatch that lands the statistics in the ``drift/*``
+    gauges, the run log and the flight recorder, and returns the typed
+    :class:`DriftResult` the learner acts on.
+    """
+
+    def __init__(
+        self, reference: DriftReference, config: Optional[DriftConfig] = None
+    ) -> None:
+        self.reference = reference
+        self.config = config if config is not None else DriftConfig()
+
+    @classmethod
+    def from_batch(
+        cls,
+        model: Any,
+        batch: Any,
+        config: Optional[DriftConfig] = None,
+        *,
+        model_version: Optional[str] = None,
+    ) -> 'DriftWatch':
+        """Build the reference from ``model``'s training batch and wrap it."""
+        cfg = config if config is not None else DriftConfig()
+        return cls(
+            build_drift_reference(
+                model, batch, cfg, model_version=model_version
+            ),
+            cfg,
+        )
+
+    def check(self, model: Any, batch: Any) -> DriftResult:
+        """Score one traffic window; record gauges + events; never raises
+        past telemetry (statistic errors do propagate — a broken check
+        must not read as "no drift")."""
+        from .shadow import replay_probs
+
+        cfg = self.config
+        with span('learn/drift_check'):
+            probs = (
+                replay_probs(model, batch)
+                if cfg.include_predictions
+                else None
+            )
+            # the window size gate reads the MASKED row count (padding is
+            # not evidence)
+            n_actions = int(np.asarray(batch.mask).sum())
+            if n_actions < cfg.min_actions:
+                result = DriftResult(
+                    n_actions=n_actions,
+                    reference_actions=self.reference.n_actions,
+                    evaluated=False,
+                    triggered=False,
+                    reasons=[
+                        f'window too small to score drift ({n_actions} < '
+                        f'{cfg.min_actions} actions)'
+                    ],
+                )
+                self._record(result)
+                return result
+            psi, ks, n_actions = drift_statistics(
+                self.reference, batch, probs
+            )
+        max_psi_feature = max(psi, key=psi.get)
+        max_ks_feature = max(ks, key=ks.get)
+        reasons: List[str] = []
+        if psi[max_psi_feature] > cfg.psi_trigger:
+            reasons.append(
+                f'{max_psi_feature}: PSI {psi[max_psi_feature]:.4f} > '
+                f'trigger {cfg.psi_trigger:.4f}'
+            )
+        if (
+            cfg.ks_trigger is not None
+            and ks[max_ks_feature] > cfg.ks_trigger
+        ):
+            reasons.append(
+                f'{max_ks_feature}: KS {ks[max_ks_feature]:.4f} > '
+                f'trigger {cfg.ks_trigger:.4f}'
+            )
+        result = DriftResult(
+            psi=psi,
+            ks=ks,
+            max_psi=psi[max_psi_feature],
+            max_psi_feature=max_psi_feature,
+            max_ks=ks[max_ks_feature],
+            max_ks_feature=max_ks_feature,
+            n_actions=n_actions,
+            reference_actions=self.reference.n_actions,
+            evaluated=True,
+            triggered=bool(reasons),
+            reasons=reasons,
+        )
+        self._record(result)
+        return result
+
+    def _record(self, result: DriftResult) -> None:
+        """Gauges + run-log/recorder events; telemetry never raises."""
+        counter('drift/checks', unit='count').inc(1)
+        if result.evaluated:
+            psi_g = gauge('drift/psi', unit='value')
+            ks_g = gauge('drift/ks', unit='value')
+            for name, v in result.psi.items():
+                psi_g.set(v, feature=name)
+            for name, v in result.ks.items():
+                ks_g.set(v, feature=name)
+            gauge('drift/max_psi', unit='value').set(result.max_psi)
+        if result.triggered:
+            counter('drift/triggers', unit='count').inc(1)
+        try:
+            payload = result.to_dict()
+            payload['model_version'] = self.reference.model_version
+            RECORDER.record('drift_check', **payload)
+            log = current_runlog()
+            if log is not None:
+                log.event('drift_check', **payload)
+        except Exception:
+            pass

@@ -42,11 +42,21 @@ class GateConfig:
     ``min_replay_actions`` refuses to promote on a traffic window too
     small to measure calibration at all (the gate fails *closed*: no
     evidence, no promotion).
+
+    ``max_drift_psi``, when set, adds the drift watch as a second
+    fail-closed input: a candidate is blocked when the serving traffic
+    has drifted past the band from the active model's training reference
+    (the calibration comparison is then answering the wrong question —
+    both models are being scored on a distribution neither trained on),
+    **and** when the drift statistics are unavailable (window too small,
+    no watch configured): no evidence, no promotion, same direction as
+    ``min_replay_actions``.
     """
 
     max_ece_regression: float = 0.01
     max_brier_regression: float = 0.005
     min_replay_actions: int = 64
+    max_drift_psi: Optional[float] = None
     n_bins: int = 10
     n_boot: int = 200
     seed: int = 0
@@ -77,6 +87,9 @@ class PromotionReport:
     #: 'delta_brier': .}}`` (summaries are CalibrationSummary.to_dict())
     heads: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     replay: Dict[str, Any] = field(default_factory=dict)
+    #: the drift watch's statistics for this iteration's traffic window
+    #: (``DriftResult.to_dict()``; empty when no watch is configured)
+    drift: Dict[str, Any] = field(default_factory=dict)
     stage_seconds: Dict[str, float] = field(default_factory=dict)
     time_unix: float = field(default_factory=time.time)
 
@@ -99,6 +112,7 @@ class PromotionReport:
             ],
             'heads': self.heads,
             'replay': dict(self.replay),
+            'drift': dict(self.drift),
             'stage_seconds': {
                 k: round(v, 6) for k, v in self.stage_seconds.items()
             },
@@ -127,6 +141,8 @@ def evaluate_gate(
     active: Optional[Dict[str, CalibrationSummary]],
     candidate: Dict[str, CalibrationSummary],
     config: GateConfig,
+    *,
+    drift: Any = None,
 ) -> Tuple[bool, List[str]]:
     """Apply the calibration bands; returns ``(passed, reasons)``.
 
@@ -134,10 +150,32 @@ def evaluate_gate(
     candidate passes by default, with the reason recorded. Otherwise
     every head must stay within both bands; all violations are listed,
     not just the first.
+
+    ``drift`` is the iteration's
+    :class:`~socceraction_tpu.learn.drift.DriftResult` (or None). With
+    ``config.max_drift_psi`` set the drift check is fail-closed: absent
+    or unevaluated statistics block exactly like a breach — the gate
+    must not certify calibration measured on a distribution it cannot
+    vouch for. Drift reasons apply even in the bootstrap case.
     """
-    if active is None:
-        return True, ['bootstrap: no active model to compare against']
     reasons: List[str] = []
+    if config.max_drift_psi is not None:
+        if drift is None or not getattr(drift, 'evaluated', False):
+            reasons.append(
+                'drift: statistics unavailable for this replay window '
+                '(fail closed; configure a drift watch or widen the '
+                'capture window)'
+            )
+        elif drift.max_psi > config.max_drift_psi:
+            reasons.append(
+                f'drift: {drift.max_psi_feature} PSI {drift.max_psi:.4f} '
+                f'> band {config.max_drift_psi:.4f} — the replay window '
+                'no longer resembles the training reference'
+            )
+    if active is None:
+        if reasons:
+            return False, reasons
+        return True, ['bootstrap: no active model to compare against']
     for col, cand in candidate.items():
         act = active.get(col)
         if act is None:

@@ -52,6 +52,7 @@ import pandas as pd
 
 from ..obs import counter, histogram, span
 from ..obs.recorder import RECORDER, default_debug_dir, dump_debug_bundle
+from .drift import DriftConfig, DriftResult, DriftWatch
 from .gate import (
     GateConfig,
     PromotionReport,
@@ -86,6 +87,13 @@ class LearnConfig:
     random_state: Optional[int] = 0
     warm_start: bool = True
     gate: GateConfig = field(default_factory=GateConfig)
+    #: drift watch over the capture ring vs the active model's training
+    #: reference; None (default) disables the watch entirely
+    drift: Optional[DriftConfig] = None
+    #: wait for at least this many new games before retraining — the
+    #: drift watch is the early trigger: a triggered check overrides the
+    #: floor and retrains on whatever has landed
+    min_new_games: int = 1
     #: traffic source of last resort: replay the newest N stored matches
     #: when no capture ring is attached (or it is empty)
     fallback_replay_games: int = 8
@@ -143,6 +151,8 @@ class ContinuousLearner:
             prime_watcher = self._active() is not None
         self.watcher = SeasonWatcher(store, prime=prime_watcher)
         self.last_report: Optional[PromotionReport] = None
+        self._drift_watch: Optional[DriftWatch] = None
+        self._drift_version: Optional[str] = None
 
     # -- pieces ------------------------------------------------------------
 
@@ -238,6 +248,63 @@ class ContinuousLearner:
             for gid in game_ids
         ], source
 
+    def _drift_check(
+        self,
+        active_model: Any,
+        active_version: Optional[str],
+        pending_ids: Any = (),
+    ) -> Optional[DriftResult]:
+        """Score the capture ring against the active model's reference.
+
+        Returns None when the watch cannot run (no ``drift`` config, no
+        active model, no captured traffic) — with the gate's
+        ``max_drift_psi`` band set, that absence itself fails closed.
+        The reference is (re)built from the newest stored matches
+        whenever the active version changes, EXCLUDING ``pending_ids``
+        (games landed but not yet consumed by a retrain): the active
+        model never trained on those, and folding a drifted fresh batch
+        into its own reference would make the watch compare drift
+        against drift and read PSI ~0. Known limitation: across a
+        process restart with a primed watcher, games promoted-past
+        before the restart are indistinguishable from training data
+        (the registry keeps no training manifest yet), so a shift that
+        fully landed pre-restart is under-detected until the next
+        promotion rebuilds the world.
+        """
+        cfg = self.config
+        if cfg.drift is None or active_model is None:
+            return None
+        if self.capture is None:
+            return None
+        frames = self.capture.frames()
+        if not frames:
+            return None
+        if (
+            self._drift_watch is None
+            or self._drift_version != active_version
+        ):
+            pending = set(pending_ids)
+            ids = newest_game_ids(
+                [g for g in self.store.game_ids() if g not in pending],
+                cfg.drift.reference_games,
+            )
+            if not ids:
+                return None
+            home = self.store.home_team_ids()
+            ref_frames = [
+                (self.store.get_actions(gid), home.get(gid)) for gid in ids
+            ]
+            ref_batch = pack_replay_batch(
+                ref_frames, max_actions=cfg.max_actions
+            )
+            self._drift_watch = DriftWatch.from_batch(
+                active_model, ref_batch, cfg.drift,
+                model_version=active_version,
+            )
+            self._drift_version = active_version
+        batch = pack_replay_batch(frames, max_actions=cfg.max_actions)
+        return self._drift_watch.check(active_model, batch)
+
     # -- the loop ----------------------------------------------------------
 
     def run_once(self) -> PromotionReport:
@@ -264,16 +331,52 @@ class ContinuousLearner:
                         cache_dir=cfg.cache_dir,
                         family=cfg.family,
                     )
-            if not new_ids:
+            # the drift watch runs every iteration — continuous
+            # monitoring, not promotion-time-only — and doubles as the
+            # early retrain trigger below
+            drift_res: Optional[DriftResult] = None
+            if cfg.drift is not None:
+                with timed_stage('drift'):
+                    drift_res = self._drift_check(
+                        active_model, active_version, pending_ids=new_ids
+                    )
+            drift_triggered = bool(drift_res is not None and drift_res.triggered)
+            if not new_ids or (
+                len(new_ids) < cfg.min_new_games and not drift_triggered
+            ):
+                # nothing to train on — or not enough yet and the serving
+                # distribution is stable, so waiting is free (the
+                # uncommitted games stay pending for the next poll)
+                reasons = (
+                    ['no new matches since the last iteration']
+                    if not new_ids
+                    else [
+                        f'waiting: {len(new_ids)} new game(s) < '
+                        f'min_new_games={cfg.min_new_games} and drift is '
+                        'below trigger'
+                    ]
+                )
                 report = PromotionReport(
                     name=cfg.model_name,
                     verdict='no_new_data',
-                    reasons=['no new matches since the last iteration'],
+                    reasons=reasons,
                     active_version=active_version,
+                    drift=drift_res.to_dict() if drift_res else {},
                     stage_seconds=dict(stage_s),
                 )
                 self._finish(report)
                 return report
+            if drift_triggered and len(new_ids) < cfg.min_new_games:
+                # the early trigger: the distribution moved, so retrain
+                # on whatever has landed instead of waiting out the floor
+                counter('learn/early_trains', unit='count').inc(1)
+                RECORDER.record(
+                    'drift_early_train',
+                    new_games=len(new_ids),
+                    min_new_games=cfg.min_new_games,
+                    max_psi=drift_res.max_psi,
+                    feature=drift_res.max_psi_feature,
+                )
             counter('learn/new_games', unit='count').inc(len(new_ids))
 
             with timed_stage('train'), span('learn/train', games=len(new_ids)):
@@ -331,6 +434,7 @@ class ContinuousLearner:
                         active_version=active_version,
                         candidate_tag=tag,
                         new_games=list(new_ids),
+                        drift=drift_res.to_dict() if drift_res else {},
                         stage_seconds=dict(stage_s),
                     )
                     self.registry.gc_candidates(
@@ -344,6 +448,7 @@ class ContinuousLearner:
                         act_res.summaries if act_res else None,
                         cand_res.summaries,
                         gate_cfg,
+                        drift=drift_res,
                     )
             except Exception as e:
                 report = PromotionReport(
@@ -378,6 +483,7 @@ class ContinuousLearner:
                     'actions': cand_res.n_actions,
                     'source': replay_source,
                 },
+                drift=drift_res.to_dict() if drift_res else {},
             )
 
             if passed:

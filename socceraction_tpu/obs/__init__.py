@@ -13,6 +13,13 @@ where a caller asks for device sync or named scopes):
   contexts that bridge into ``jax.named_scope``, and the run-scoped
   :class:`RunLog` JSONL sink (manifest, span events, metric snapshots,
   rotation).
+- :mod:`socceraction_tpu.obs.context` — request-scoped trace contexts:
+  the :class:`RequestContext` identity that rides a serving request's
+  future across the micro-batcher's thread boundary (id, deadline,
+  per-segment wall decomposition, run-log linkage for ``obsctl trace``).
+- :mod:`socceraction_tpu.obs.slo` — the SLO engine: declarative
+  objectives, multi-window error-budget burn rates over the typed
+  snapshot, and the ``should_shed`` admission-control verdict.
 - :mod:`socceraction_tpu.obs.export` — Prometheus-text and JSON
   exposition, plus the legacy ``timer_report`` compatibility shape.
 - :mod:`socceraction_tpu.obs.xla` — the compile observatory:
@@ -35,6 +42,7 @@ from typing import Any
 __all__ = [
     'CardinalityError',
     'Counter',
+    'DeadlineExceeded',
     'FlightRecorder',
     'Gauge',
     'Histogram',
@@ -44,11 +52,16 @@ __all__ = [
     'RECORDER',
     'REGISTRY',
     'RegistrySnapshot',
+    'RequestContext',
     'RunLog',
+    'SLOConfig',
+    'SLOEngine',
+    'SLOObjective',
     'Span',
     'cost_analysis',
     'counter',
     'current_runlog',
+    'current_span',
     'default_debug_dir',
     'device_memory_stats',
     'dump_debug_bundle',
@@ -56,6 +69,7 @@ __all__ = [
     'histogram',
     'instrument_jit',
     'live_array_census',
+    'new_request_context',
     'observatory_snapshot',
     'prometheus_text',
     'run_manifest',
@@ -72,7 +86,12 @@ _HOMES = {
         'REGISTRY', 'RegistrySnapshot', 'counter', 'gauge', 'histogram',
         'timed_labels',
     ),
-    'trace': ('RunLog', 'Span', 'current_runlog', 'run_manifest', 'span'),
+    'trace': (
+        'RunLog', 'Span', 'current_runlog', 'current_span', 'run_manifest',
+        'span',
+    ),
+    'context': ('DeadlineExceeded', 'RequestContext', 'new_request_context'),
+    'slo': ('SLOConfig', 'SLOEngine', 'SLOObjective'),
     'export': ('prometheus_text', 'snapshot_dict', 'timer_report_compat'),
     'xla': (
         'InstrumentedJit', 'cost_analysis', 'instrument_jit',

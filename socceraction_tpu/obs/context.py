@@ -1,0 +1,208 @@
+"""Request-scoped trace context: one identity per ``rate()`` call.
+
+Spans (:mod:`socceraction_tpu.obs.trace`) nest per *thread*, which is
+the wrong axis for a micro-batched server: a caller's request enters the
+queue on its own thread, is coalesced with strangers on the flusher
+thread, and resolves back on a future — by then the caller's span stack
+knows nothing about what happened. A :class:`RequestContext` is the
+identity that rides the request's future across that boundary:
+
+- minted at ``RatingService.rate()`` / session-tick time
+  (:func:`new_request_context`): a process-unique ``request_id``, the
+  enqueue timestamp, an optional absolute deadline, and the id of the
+  caller's innermost open span (so a request can be linked back into
+  the submitting thread's trace);
+- carried through the micro-batcher on the request object; the flush
+  span lists the coalesced ``request_ids`` as children, and the
+  batcher/service decompose each request's wall into **queue-wait /
+  pad-overhead / dispatch / slice-back** segments, recorded both on the
+  context (``ctx.segments``) and as the
+  ``serve/segment_seconds{segment=...}`` histogram with the request id
+  attached as an exemplar;
+- lifecycle events (:func:`record_request_enqueue`,
+  :func:`record_request_done`) land in the active
+  :class:`~socceraction_tpu.obs.trace.RunLog` and the flight-recorder
+  ring, so ``obsctl trace <request_id>`` can reconstruct one request's
+  full queue→flush→dispatch→slice path through a shared dispatch.
+
+Everything here is stdlib-only and jax-free, like the rest of the obs
+substrate.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from socceraction_tpu.obs.metrics import histogram
+
+__all__ = [
+    'DeadlineExceeded',
+    'RequestContext',
+    'SEGMENTS',
+    'new_request_context',
+    'record_request_done',
+    'record_request_enqueue',
+    'record_segment',
+]
+
+#: The per-request wall decomposition, in path order: time waiting in the
+#: admission queue, host-side concat/pad of the coalesced batch, the
+#: device dispatch (transfer + compute + fetch), and slicing each
+#: request's rows back out of the shared result.
+SEGMENTS = ('queue_wait', 'pad', 'dispatch', 'slice')
+
+_req_seq = itertools.count(1)
+#: short per-process prefix so ids from two services on one host never
+#: collide (the RunLog may be shared)
+_PROC_TAG = uuid.uuid4().hex[:6]
+
+
+class DeadlineExceeded(RuntimeError):
+    """A queued request's deadline passed before its flush dispatched.
+
+    The request was **never** rated: it is failed here instead of being
+    dispatched late (a caller that stopped waiting must not burn device
+    time), its queue-wait is attributed to the ``queue_wait`` segment,
+    and it is never recorded by the traffic capture (it never happened,
+    as far as replay is concerned).
+    """
+
+
+@dataclass
+class RequestContext:
+    """One request's identity and timing as it crosses thread boundaries.
+
+    ``deadline_t`` is an absolute ``time.perf_counter()`` instant (None:
+    no deadline); ``segments`` is filled in by the batcher (queue_wait)
+    and the service's flush (pad / dispatch / slice) as the request
+    moves through the pipeline.
+    """
+
+    request_id: str
+    kind: str = 'rate'
+    enqueue_t: float = field(default_factory=time.perf_counter)
+    deadline_t: Optional[float] = None
+    #: innermost open span id on the submitting thread (trace linkage)
+    parent_span_id: Optional[int] = None
+    segments: Dict[str, float] = field(default_factory=dict)
+
+    def remaining_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the deadline (negative: expired); None without one."""
+        if self.deadline_t is None:
+            return None
+        return self.deadline_t - (time.perf_counter() if now is None else now)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True once the deadline has passed (always False without one)."""
+        remaining = self.remaining_s(now)
+        return remaining is not None and remaining <= 0.0
+
+
+def new_request_context(
+    kind: str = 'rate',
+    *,
+    deadline_ms: Optional[float] = None,
+    parent_span_id: Optional[int] = None,
+) -> RequestContext:
+    """Mint a fresh :class:`RequestContext` for one service request.
+
+    ``deadline_ms`` is relative to now; the parent span defaults to the
+    submitting thread's innermost open span (if any), so the request
+    links back into the caller's trace.
+    """
+    now = time.perf_counter()
+    if parent_span_id is None:
+        from socceraction_tpu.obs.trace import current_span
+
+        open_span = current_span()
+        parent_span_id = open_span.span_id if open_span is not None else None
+    return RequestContext(
+        request_id=f'{_PROC_TAG}-{os.getpid():x}-{next(_req_seq):x}',
+        kind=kind,
+        enqueue_t=now,
+        deadline_t=(now + deadline_ms / 1e3) if deadline_ms is not None else None,
+        parent_span_id=parent_span_id,
+    )
+
+
+def record_segment(
+    segment: str, seconds: float, request_id: Optional[str] = None
+) -> None:
+    """One sample of the per-request wall decomposition.
+
+    Lands in ``serve/segment_seconds{segment=...}`` with ``request_id``
+    attached as the series' exemplar — the operator's jump from "p99 of
+    queue_wait spiked" to one concrete request to ``obsctl trace``.
+    """
+    histogram('serve/segment_seconds', unit='s').observe(
+        seconds,
+        exemplar={'request_id': request_id} if request_id else None,
+        segment=segment,
+    )
+
+
+def record_request_enqueue(ctx: RequestContext, queue_depth: int) -> None:
+    """Request admitted to the queue: the trace's opening event."""
+    from socceraction_tpu.obs.trace import current_runlog
+
+    log = current_runlog()
+    if log is not None:
+        log.event(
+            'request_enqueue',
+            request_id=ctx.request_id,
+            request_kind=ctx.kind,
+            queue_depth=queue_depth,
+            parent_span_id=ctx.parent_span_id,
+            deadline_in_s=ctx.remaining_s(),
+        )
+
+
+def record_request_done(
+    ctx: RequestContext,
+    status: str,
+    wall_s: float,
+    *,
+    bucket: Optional[int] = None,
+    coalesced: Optional[int] = None,
+    flush_span_id: Optional[int] = None,
+    error: Optional[str] = None,
+) -> None:
+    """Request resolved (``ok`` | ``error`` | ``expired``): closing event.
+
+    Carries the full segment decomposition accumulated on the context,
+    plus the flush it rode (bucket size, how many requests coalesced,
+    the flush span id) — everything ``obsctl trace`` needs to rebuild
+    the path from one line.
+    """
+    from socceraction_tpu.obs.recorder import RECORDER
+    from socceraction_tpu.obs.trace import current_runlog
+
+    # 'request_kind', not 'kind': the flight recorder's ring keys every
+    # event by its own 'kind' (= event type), which must stay distinct
+    # from the request's traffic kind
+    fields: Dict[str, Any] = {
+        'request_id': ctx.request_id,
+        'request_kind': ctx.kind,
+        'status': status,
+        'wall_s': wall_s,
+        'segments': dict(ctx.segments),
+    }
+    if bucket is not None:
+        fields['bucket'] = bucket
+    if coalesced is not None:
+        fields['coalesced'] = coalesced
+    if flush_span_id is not None:
+        fields['flush_span_id'] = flush_span_id
+    if ctx.parent_span_id is not None:
+        fields['parent_span_id'] = ctx.parent_span_id
+    if error is not None:
+        fields['error'] = error
+    RECORDER.record('request_done', **fields)
+    log = current_runlog()
+    if log is not None:
+        log.event('request_done', **fields)

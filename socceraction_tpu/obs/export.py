@@ -46,6 +46,41 @@ def _prom_name(name: str, unit: str, kind: str) -> str:
     return base
 
 
+def _prom_unit(unit: str) -> str:
+    """The exposition unit token of a registry unit ('' when unitless)."""
+    if unit in ('count', 'value', ''):
+        return ''  # event counts and dimensionless gauges carry no unit
+    return _UNIT_SUFFIXES.get(unit, unit.replace('/', '_per_'))
+
+
+def _prom_header(
+    pname: str,
+    name: str,
+    unit: str,
+    kind: str,
+    help_text: str = '',
+    type_token: Optional[str] = None,
+) -> List[str]:
+    """``# HELP`` / ``# TYPE`` / ``# UNIT`` comment lines for one metric.
+
+    The ``# UNIT`` line (OpenMetrics) is derived from the instrument's
+    unit metadata, so scrapers see the declared unit even when a name
+    predates the unit-suffix convention; unitless instruments emit none.
+    Shared by the full live exposition and ``obsctl prom``'s compact
+    re-rendering (which passes ``type_token='summary'`` for histograms:
+    no bucket rows survive snapshot embedding) so the two cannot drift.
+    """
+    lines = [
+        f'# HELP {pname} {help_text or f"{name} ({unit})"}',
+        f'# TYPE {pname} '
+        + (type_token or ('histogram' if kind == 'histogram' else kind)),
+    ]
+    unit_token = _prom_unit(unit)
+    if unit_token:
+        lines.append(f'# UNIT {pname} {unit_token}')
+    return lines
+
+
 def _prom_escape(value: str) -> str:
     """Label-value escaping per the text-format spec: ``\\``, ``"``, LF."""
     return (
@@ -75,11 +110,8 @@ def prometheus_text(snapshot: RegistrySnapshot) -> str:
     lines: List[str] = []
     for name, inst in snapshot.instruments.items():
         pname = _prom_name(name, inst.unit, inst.kind)
-        help_text = inst.help or f'{name} ({inst.unit})'
-        lines.append(f'# HELP {pname} {help_text}')
-        lines.append(
-            f'# TYPE {pname} '
-            + ('histogram' if inst.kind == 'histogram' else inst.kind)
+        lines.extend(
+            _prom_header(pname, name, inst.unit, inst.kind, inst.help)
         )
         for s in inst.series:
             labels = _prom_labels(s.labels)
@@ -112,6 +144,8 @@ def _series_dict(s: SeriesSnapshot, buckets: bool) -> Dict[str, Any]:
     }
     if s.quantiles is not None:
         out['quantiles'] = dict(s.quantiles)
+    if s.exemplar is not None:
+        out['exemplar'] = dict(s.exemplar)
     if buckets and s.buckets is not None:
         out['buckets'] = [
             {'le': ('+Inf' if math.isinf(le) else le), 'count': cum}

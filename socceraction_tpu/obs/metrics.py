@@ -102,6 +102,9 @@ class SeriesSnapshot(NamedTuple):
     buckets: Optional[Tuple[Tuple[float, int], ...]]
     #: histogram only: ``{'p50': ..., 'p90': ..., 'p99': ...}`` estimates
     quantiles: Optional[Mapping[str, float]]
+    #: last exemplar attached to an observation (``{'value', 'ts', ...}``,
+    #: e.g. a request id) — the trace-linkage hook; None when never set
+    exemplar: Optional[Mapping[str, Any]] = None
 
     @property
     def mean(self) -> float:
@@ -187,7 +190,7 @@ class Series:
 
     __slots__ = (
         '_lock', 'labels', 'count', 'total', 'min', 'max', 'last', '_buckets',
-        '_bucket_counts',
+        '_bucket_counts', '_exemplar',
     )
 
     def __init__(
@@ -209,6 +212,7 @@ class Series:
         self.min = math.nan
         self.max = math.nan
         self.last = math.nan
+        self._exemplar: Optional[Dict[str, Any]] = None
         if self._bucket_counts is not None:
             self._bucket_counts = [0] * len(self._bucket_counts)
 
@@ -238,7 +242,22 @@ class Series:
         """Gauge sample: the level observed now."""
         self.record(value)
 
-    observe = record  # histogram verb
+    def observe(
+        self, value: float, exemplar: Optional[Mapping[str, Any]] = None
+    ) -> None:
+        """Histogram verb: record one sample, optionally with an exemplar.
+
+        The exemplar (e.g. ``{'request_id': ...}``) is kept per series,
+        last-writer-wins, and surfaces in the typed snapshot — enough to
+        jump from an aggregate ("queue_wait p99 spiked") to one concrete
+        request id for ``obsctl trace``.
+        """
+        self.record(value)
+        if exemplar:
+            with self._lock:
+                self._exemplar = {
+                    'value': float(value), 'ts': time.time(), **exemplar
+                }
 
     # snapshot -------------------------------------------------------------
 
@@ -293,6 +312,9 @@ class Series:
                 last=self.last,
                 buckets=buckets,
                 quantiles=quantiles,
+                exemplar=(
+                    dict(self._exemplar) if self._exemplar is not None else None
+                ),
             )
 
     def reset(self) -> None:
@@ -433,9 +455,15 @@ class Histogram(Instrument):
             buckets=tuple(buckets) if buckets is not None else DEFAULT_BUCKETS,
         )
 
-    def observe(self, value: float, **labels: Any) -> None:
-        """Record one sample on the labeled series."""
-        self.labels(**labels).observe(value)
+    def observe(
+        self,
+        value: float,
+        *,
+        exemplar: Optional[Mapping[str, Any]] = None,
+        **labels: Any,
+    ) -> None:
+        """Record one sample on the labeled series (optional exemplar)."""
+        self.labels(**labels).observe(value, exemplar=exemplar)
 
     @contextlib.contextmanager
     def time(self, **labels: Any) -> Iterator[Series]:

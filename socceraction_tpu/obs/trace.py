@@ -37,7 +37,9 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from socceraction_tpu.obs.metrics import NAME_RE, REGISTRY, MetricRegistry
 
-__all__ = ['RunLog', 'Span', 'current_runlog', 'run_manifest', 'span']
+__all__ = [
+    'RunLog', 'Span', 'current_runlog', 'current_span', 'run_manifest', 'span',
+]
 
 _tls = threading.local()
 _span_ids = itertools.count(1)
@@ -48,6 +50,18 @@ _active_runlog: Optional['RunLog'] = None
 def current_runlog() -> Optional['RunLog']:
     """The :class:`RunLog` currently collecting events, if any."""
     return _active_runlog
+
+
+def current_span() -> Optional['Span']:
+    """This thread's innermost open span, if any.
+
+    The hook request contexts (:mod:`socceraction_tpu.obs.context`) use
+    to link a request minted inside a caller's span back into that
+    trace — span stacks are per-thread, so this is only meaningful on
+    the thread doing the submitting.
+    """
+    stack = getattr(_tls, 'stack', None)
+    return stack[-1] if stack else None
 
 
 def _span_stack() -> List['Span']:

@@ -37,17 +37,20 @@ See ``docs/serving.md`` for the architecture and overload/swap
 semantics.
 """
 
+from ..obs.context import DeadlineExceeded
 from .batcher import MicroBatcher, Overloaded
 from .capture import TrafficCapture
 from .registry import ModelRegistry
-from .service import RatingService
+from .service import RatingService, SLOShed
 from .session import MatchSession
 
 __all__ = [
+    'DeadlineExceeded',
     'MicroBatcher',
     'Overloaded',
     'ModelRegistry',
     'RatingService',
+    'SLOShed',
     'MatchSession',
     'TrafficCapture',
 ]

@@ -36,9 +36,16 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ..core.batch import bucket_ladder
 from ..obs import counter, gauge, histogram, span
+from ..obs.context import (
+    DeadlineExceeded,
+    RequestContext,
+    record_request_done,
+    record_request_enqueue,
+    record_segment,
+)
 from ..obs.recorder import RECORDER
 
-__all__ = ['MicroBatcher', 'Overloaded']
+__all__ = ['DeadlineExceeded', 'MicroBatcher', 'Overloaded']
 
 
 class Overloaded(RuntimeError):
@@ -52,13 +59,16 @@ class Overloaded(RuntimeError):
 
 
 class _Request:
-    __slots__ = ('payload', 'kind', 'future', 't0')
+    __slots__ = ('payload', 'kind', 'future', 't0', 'ctx')
 
-    def __init__(self, payload: Any, kind: str) -> None:
+    def __init__(
+        self, payload: Any, kind: str, ctx: Optional[RequestContext] = None
+    ) -> None:
         self.payload = payload
         self.kind = kind
         self.future: Future = Future()
-        self.t0 = time.perf_counter()
+        self.ctx = ctx
+        self.t0 = ctx.enqueue_t if ctx is not None else time.perf_counter()
 
 
 class MicroBatcher:
@@ -86,6 +96,12 @@ class MicroBatcher:
         loop rather than a flush (flush failures land on the affected
         futures and the thread lives on). The service hooks its
         flight-recorder dump here.
+    on_request_done : callable, optional
+        ``on_request_done(ctx, kind, wall_s, status)`` invoked on the
+        flusher thread for every request that reaches a terminal state
+        (``status`` in ``'ok'`` | ``'error'`` | ``'expired'``). The
+        service hooks its SLO engine here; the hook must not raise (a
+        raising hook is swallowed, never the flush).
     """
 
     def __init__(
@@ -96,6 +112,9 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         max_queue: int = 256,
         on_crash: Optional[Callable[[BaseException], None]] = None,
+        on_request_done: Optional[
+            Callable[[Optional[RequestContext], str, float, str], None]
+        ] = None,
     ) -> None:
         if max_batch_size < 1:
             raise ValueError('max_batch_size must be >= 1')
@@ -112,19 +131,31 @@ class MicroBatcher:
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._on_crash = on_crash
+        self._on_request_done = on_request_done
         self._crashed: Optional[BaseException] = None
         self._last_flush_t: Optional[float] = None
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, payload: Any, *, kind: str = 'rate') -> Future:
+    def submit(
+        self,
+        payload: Any,
+        *,
+        kind: str = 'rate',
+        ctx: Optional[RequestContext] = None,
+    ) -> Future:
         """Enqueue one request; returns its :class:`concurrent.futures.Future`.
 
         Raises :class:`Overloaded` when the admission queue is full and
         ``RuntimeError`` after :meth:`close`. ``kind`` is a low-cardinality
-        telemetry label (``rate`` | ``session`` | ``warmup``).
+        telemetry label (``rate`` | ``session`` | ``warmup``). ``ctx``, when
+        given, is the request's trace identity: its id links the request
+        into the flush span and run-log events, and its deadline is
+        enforced at flush time — an expired request is failed with
+        :class:`~socceraction_tpu.obs.context.DeadlineExceeded` instead
+        of being dispatched late.
         """
-        req = _Request(payload, kind)
+        req = _Request(payload, kind, ctx)
         with self._cond:
             if self._closed:
                 raise RuntimeError('batcher is closed')
@@ -149,6 +180,10 @@ class MicroBatcher:
             self._cond.notify()
         gauge('serve/queue_depth', unit='requests').set(depth)
         counter('serve/requests', unit='requests').inc(1, kind=kind)
+        if ctx is not None:
+            req.future.request_id = ctx.request_id  # type: ignore[attr-defined]
+            req.future.context = ctx  # type: ignore[attr-defined]
+            record_request_enqueue(ctx, depth)
         return req.future
 
     def bucket_for(self, n: int) -> int:
@@ -223,42 +258,153 @@ class MicroBatcher:
                 except Exception:  # the hook must not mask the crash
                     pass
 
+    def _notify_done(self, req: _Request, wall_s: float, status: str) -> None:
+        """Invoke the terminal-state hook; a raising hook never escapes."""
+        if self._on_request_done is not None:
+            try:
+                self._on_request_done(req.ctx, req.kind, wall_s, status)
+            except Exception:
+                pass
+
+    def _expire(self, req: _Request, now: float) -> None:
+        """Fail one deadline-expired request without dispatching it.
+
+        The whole wait was queue time, so it is attributed to the
+        ``queue_wait`` segment; the request never reaches the runner
+        (a caller that stopped waiting must not burn device time) and —
+        because the future resolves with an error — is never recorded
+        by the service's traffic capture.
+        """
+        ctx = req.ctx
+        assert ctx is not None  # only ctx-carrying requests have deadlines
+        wait = now - req.t0
+        ctx.segments['queue_wait'] = wait
+        record_segment('queue_wait', wait, ctx.request_id)
+        counter('serve/deadline_expired', unit='requests').inc(1, kind=req.kind)
+        err = DeadlineExceeded(
+            f'request {ctx.request_id} spent {wait * 1e3:.1f}ms queued, past '
+            f'its deadline (never dispatched); slow down or raise the deadline'
+        )
+        record_request_done(ctx, 'expired', wait, error=str(err))
+        self._notify_done(req, wait, 'expired')
+        req.future.set_exception(err)
+
     def _flush(self, take: List[_Request], reason: str) -> None:
         # Transition every future to RUNNING; a caller that cancel()ed
         # while queued is dropped here. After this point cancel() can no
         # longer succeed, so set_result below cannot raise
         # InvalidStateError and kill the flusher thread.
         take = [r for r in take if r.future.set_running_or_notify_cancel()]
-        if not take:
+        try:
+            self._flush_running(take, reason)
+        except BaseException as e:  # noqa: BLE001 - never strand a future
+            # a RUNNING future whose flush died any other way than the
+            # runner path below would hang its caller forever (and the
+            # escaping exception would kill the flusher thread for
+            # everyone else) — fail what this flush owns, with the same
+            # per-request error accounting as a runner failure (the SLO
+            # engine and the trace must see these failures too), and
+            # live on
+            self._fail_requests(take, e)
+
+    def _fail_requests(
+        self,
+        requests: List[_Request],
+        exc: BaseException,
+        *,
+        bucket: Optional[int] = None,
+        coalesced: Optional[int] = None,
+    ) -> None:
+        """Resolve every unresolved request as failed, fully accounted.
+
+        Each request's accounting (request_done event, SLO hook) is
+        individually guarded: if telemetry itself is what raised (a full
+        disk under the run log), the remaining futures must still fail
+        rather than strand.
+        """
+        done = time.perf_counter()
+        for r in requests:
+            if r.future.done():
+                continue
+            wall = done - r.t0
+            if r.ctx is not None:
+                try:
+                    record_request_done(
+                        r.ctx, 'error', wall, bucket=bucket,
+                        coalesced=coalesced,
+                        error=f'{type(exc).__name__}: {exc}',
+                    )
+                except Exception:
+                    pass
+            self._notify_done(r, wall, 'error')
+            r.future.set_exception(exc)
+
+    def _flush_running(self, take: List[_Request], reason: str) -> None:
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for r in take:
+            if r.ctx is not None and r.ctx.expired(now):
+                self._expire(r, now)
+            else:
+                live.append(r)
+        if not live:
             return
-        bucket = self.bucket_for(len(take))
-        fill = len(take) / bucket
+        bucket = self.bucket_for(len(live))
+        fill = len(live) / bucket
         counter('serve/flushes', unit='count').inc(1, reason=reason)
         gauge('serve/batch_fill_ratio', unit='ratio').set(fill)
+        request_ids = [r.ctx.request_id for r in live if r.ctx is not None]
         RECORDER.record(
-            'serve_queue', taken=len(take), bucket=bucket, reason=reason,
+            'serve_queue', taken=len(live), bucket=bucket, reason=reason,
             queue_depth=self.queue_depth, fill_ratio=fill,
+            request_ids=request_ids,
         )
+        # every coalesced request's queue wait ends here: the flush owns
+        # the rest of the wall (pad/dispatch/slice, recorded by the runner)
+        flush_t0 = time.perf_counter()
+        for r in live:
+            wait = flush_t0 - r.t0
+            if r.ctx is not None:
+                r.ctx.segments['queue_wait'] = wait
+            record_segment(
+                'queue_wait', wait, r.ctx.request_id if r.ctx else None
+            )
         try:
-            with span('serve/flush', requests=len(take), bucket=bucket):
+            # the flush span lists the coalesced request ids: the link
+            # from one shared dispatch back to every request it served
+            with span(
+                'serve/flush', requests=len(live), bucket=bucket,
+                request_ids=request_ids,
+            ) as flush_span:
                 with histogram('serve/flush_seconds', unit='s').time(
                     bucket=str(bucket)
                 ):
-                    results = self._runner([r.payload for r in take], bucket)
-            if len(results) != len(take):
+                    results = self._runner([r.payload for r in live], bucket)
+            if len(results) != len(live):
                 raise RuntimeError(
                     f'runner returned {len(results)} results for '
-                    f'{len(take)} requests'
+                    f'{len(live)} requests'
                 )
         except BaseException as e:  # noqa: BLE001 - failures go to the futures
-            for r in take:
-                if not r.future.done():
-                    r.future.set_exception(e)
+            self._fail_requests(live, e, bucket=bucket, coalesced=len(live))
             return
         done = time.perf_counter()
         lat = histogram('serve/request_seconds', unit='s')
-        for r, out in zip(take, results):
-            lat.observe(done - r.t0, kind=r.kind)
+        for r, out in zip(live, results):
+            wall = done - r.t0
+            lat.observe(
+                wall,
+                exemplar=(
+                    {'request_id': r.ctx.request_id} if r.ctx else None
+                ),
+                kind=r.kind,
+            )
+            if r.ctx is not None:
+                record_request_done(
+                    r.ctx, 'ok', wall, bucket=bucket, coalesced=len(live),
+                    flush_span_id=flush_span.span_id,
+                )
+            self._notify_done(r, wall, 'ok')
             r.future.set_result(out)
 
     # -- introspection -----------------------------------------------------

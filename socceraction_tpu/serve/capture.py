@@ -75,11 +75,20 @@ class TrafficCapture:
 
     # -- recording (called by the serving layer) ---------------------------
 
-    def record_frame(self, actions: pd.DataFrame, home_team_id: Any) -> None:
-        """Record one successfully submitted one-shot request."""
+    def record_frame(
+        self, actions: pd.DataFrame, home_team_id: Any, *, copy: bool = True
+    ) -> None:
+        """Record one successfully submitted one-shot request.
+
+        ``copy=False`` hands ownership of ``actions`` to the ring (the
+        caller must never mutate it afterwards) — the serving layer
+        copies on the *caller* thread at submit time so the flusher
+        thread's success callback never pays a DataFrame copy inside the
+        flush loop.
+        """
         if self._frames.maxlen == 0:
             return  # one-shot capture disabled: no phantom metrics either
-        frame = actions.copy()
+        frame = actions.copy() if copy else actions
         with self._lock:
             if len(self._frames) == self._frames.maxlen:
                 counter('serve/capture_evictions', unit='count').inc(

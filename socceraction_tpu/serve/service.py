@@ -40,7 +40,9 @@ import pandas as pd
 
 from ..core.batch import ActionBatch, pack_actions, pad_batch_games, unpack_values
 from ..obs import REGISTRY, counter, gauge, span
+from ..obs.context import RequestContext, new_request_context, record_segment
 from ..obs.recorder import dump_debug_bundle
+from ..obs.slo import SLOConfig, SLOEngine
 from .batcher import MicroBatcher, Overloaded
 from .session import (
     WINDOW_LOCAL_KERNELS,
@@ -50,7 +52,32 @@ from .session import (
     score_prefix,
 )
 
-__all__ = ['RatingService']
+__all__ = ['RatingService', 'SLOShed']
+
+
+class SLOShed(Overloaded):
+    """Raised by ``rate()`` when SLO burn-rate admission control sheds.
+
+    A subclass of :class:`~socceraction_tpu.serve.batcher.Overloaded`,
+    so callers with queue-overload handling (retry, down-sample, 429)
+    keep working unchanged — but the cause is different: the service is
+    *burning its error budget* (latency or error-rate objective past the
+    burn threshold over both windows), and taking more load would make
+    it worse. ``reason`` is the machine-readable payload: objective
+    name, per-window burn rates, threshold, windows and remaining
+    budget.
+    """
+
+    def __init__(self, reason: Dict[str, Any]) -> None:
+        self.reason = dict(reason)
+        super().__init__(
+            'shedding by SLO burn rate: objective '
+            f'{reason.get("objective")!r} burning at '
+            f'{reason.get("burn_rate_fast")}x (fast) / '
+            f'{reason.get("burn_rate_slow")}x (slow) of budget, '
+            f'threshold {reason.get("threshold")}x '
+            f'(budget remaining: {reason.get("budget_remaining")})'
+        )
 
 RATING_COLUMNS = ['offensive_value', 'defensive_value', 'vaep_value']
 
@@ -58,13 +85,14 @@ RATING_COLUMNS = ['offensive_value', 'defensive_value', 'vaep_value']
 class _Payload:
     """One packed request: a staging batch plus its result recipe."""
 
-    __slots__ = ('staging', 'gs', 'keep', 'index')
+    __slots__ = ('staging', 'gs', 'keep', 'index', 'ctx')
 
-    def __init__(self, staging, gs, keep=None, index=None) -> None:
+    def __init__(self, staging, gs, keep=None, index=None, ctx=None) -> None:
         self.staging = staging  # host ActionBatch, (1, A) numpy fields
         self.gs = gs  # (1, A, 3) f32 goalscore block
         self.keep = keep  # None (whole frame) | (context, m) window slice
         self.index = index  # pandas index for frame requests
+        self.ctx = ctx  # RequestContext (trace identity + segments)
 
 
 class RatingService:
@@ -93,7 +121,24 @@ class RatingService:
     slo_p99_ms : float
         The p99 end-to-end latency budget :meth:`health` compares the
         measured ``serve/request_seconds`` p99 against. Observability
-        only — nothing is throttled by it.
+        only — nothing is throttled by it (``slo=`` is the throttling
+        form).
+    slo : SLOConfig, optional
+        Declarative service-level objectives
+        (:class:`~socceraction_tpu.obs.slo.SLOConfig`). When given, an
+        :class:`~socceraction_tpu.obs.slo.SLOEngine` scores every
+        terminal request, ``health()`` reports per-objective budget
+        remaining, a burn-rate breach dumps a rate-limited debug bundle,
+        and ``rate()`` / session ticks **shed by burn rate**: past the
+        config's threshold over both windows, submissions raise
+        :class:`SLOShed` with the machine-readable reason. ``None``
+        (default) keeps the PR-4 behavior: shedding by queue depth only.
+    request_deadline_ms : float, optional
+        Default per-request deadline. A request still queued when its
+        deadline passes is failed with
+        :class:`~socceraction_tpu.obs.context.DeadlineExceeded` — never
+        dispatched, never captured. ``rate(deadline_ms=...)`` overrides
+        per call; ``None`` (default) means no deadline.
     capture : TrafficCapture, optional
         A :class:`~socceraction_tpu.serve.capture.TrafficCapture` ring
         that records served traffic (successful ``rate`` submissions and
@@ -120,6 +165,8 @@ class RatingService:
         max_wait_ms: float = 2.0,
         max_queue: int = 256,
         slo_p99_ms: float = 250.0,
+        slo: Optional[SLOConfig] = None,
+        request_deadline_ms: Optional[float] = None,
         capture: Any = None,
         debug_dir: Optional[str] = None,
         overload_dump_threshold: int = 64,
@@ -155,12 +202,24 @@ class RatingService:
         self._last_dump_t: Dict[str, float] = {}
         self._overloads: 'deque[float]' = deque()
         self._started_t = time.monotonic()
+        self.request_deadline_ms = request_deadline_ms
+        self._model_activated_t = time.monotonic()
+        self._slo: Optional[SLOEngine] = (
+            SLOEngine(
+                slo,
+                model_age_s=lambda: time.monotonic() - self._model_activated_t,
+                on_breach=self._on_slo_breach,
+            )
+            if slo is not None
+            else None
+        )
         self._batcher = MicroBatcher(
             self._flush,
             max_batch_size=max_batch_size,
             max_wait_ms=max_wait_ms,
             max_queue=max_queue,
             on_crash=self._on_flusher_crash,
+            on_request_done=self._on_request_done,
         )
         self._shape_lock = threading.Lock()
         self._seen_shapes: set = set()
@@ -239,7 +298,9 @@ class RatingService:
             # gates)
             version = self._registry.resolve_version(name, version)
             self._prepare_swap_target(name, version)
-            return self._registry.activate(name, version)
+            out = self._registry.activate(name, version)
+            self._model_activated_t = time.monotonic()  # freshness SLO clock
+            return out
         except Exception as e:
             # a failed rollout is exactly when an operator wants the
             # flight recorder: what was serving, what was queued, which
@@ -278,7 +339,9 @@ class RatingService:
             # pin the exact version just validated/warmed: a promotion
             # racing this call changes "previous", and rolling back to a
             # version nobody validated must fail, not slip through
-            return self._registry.rollback(expected=(name, version))
+            out = self._registry.rollback(expected=(name, version))
+            self._model_activated_t = time.monotonic()  # freshness SLO clock
+            return out
         except Exception as e:
             self._maybe_dump(
                 'swap_failure',
@@ -292,7 +355,13 @@ class RatingService:
 
     # -- request entry points ----------------------------------------------
 
-    def rate(self, actions: pd.DataFrame, *, home_team_id: Any = None) -> Future:
+    def rate(
+        self,
+        actions: pd.DataFrame,
+        *,
+        home_team_id: Any = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Future:
         """Rate one match's SPADL actions; returns a Future of a DataFrame.
 
         ``actions`` is a single game's frame (like ``VAEP.rate``'s input,
@@ -304,11 +373,24 @@ class RatingService:
         aligned to ``actions``' index, exactly equal to
         ``VAEP.rate``'s values for the same frame.
 
+        Every call mints a :class:`~socceraction_tpu.obs.context.RequestContext`
+        exposed on the future as ``future.context`` (and its id as
+        ``future.request_id``) — the handle ``obsctl trace
+        <request_id>`` reconstructs the request's path from.
+        ``deadline_ms`` (default: the service's ``request_deadline_ms``)
+        bounds the total wait: a request still queued past it fails with
+        :class:`~socceraction_tpu.obs.context.DeadlineExceeded` instead
+        of dispatching late.
+
         Raises :class:`~socceraction_tpu.serve.batcher.Overloaded`
-        synchronously when the admission queue is full.
+        synchronously when the admission queue is full, and its subclass
+        :class:`SLOShed` when burn-rate admission control is shedding.
         """
         if len(actions) == 0:
             raise ValueError('cannot rate an empty actions frame')
+        # shed BEFORE the packing work: a rejected request must cost the
+        # burning service as close to nothing as possible
+        self._check_admission('rate')
         if 'game_id' in actions.columns and actions['game_id'].nunique() > 1:
             raise ValueError(
                 'one request rates one match; split multi-game frames '
@@ -335,20 +417,46 @@ class RatingService:
             if self._gs_enabled
             else None
         )
-        payload = _Payload(staging, gs, keep=None, index=actions.index)
-        future = self._submit(payload, 'rate')
-        # capture AFTER admission: shed (Overloaded) traffic never ran,
-        # and replaying it would skew shadow calibration toward bursts
+        ctx = new_request_context(
+            'rate',
+            deadline_ms=(
+                deadline_ms if deadline_ms is not None
+                else self.request_deadline_ms
+            ),
+        )
+        payload = _Payload(staging, gs, keep=None, index=actions.index, ctx=ctx)
+        future = self._submit(payload, 'rate', ctx)
+        # capture ONLY on success, via the future: shed (Overloaded)
+        # traffic never ran, deadline-expired requests were never
+        # dispatched, and a failed flush never produced ratings —
+        # replaying any of them would put traffic the service never
+        # served into the shadow-calibration window. The copy happens
+        # HERE, on the caller's thread: done-callbacks run on the
+        # flusher thread, which must never pay a DataFrame copy per
+        # request inside the flush loop.
         if self.capture is not None:
-            self.capture.record_frame(actions, home_team_id)
+            capture = self.capture
+            captured = actions.copy()
+
+            def _record(fut: Future, _a=captured, _h=home_team_id) -> None:
+                try:
+                    if not fut.cancelled() and fut.exception() is None:
+                        capture.record_frame(_a, _h, copy=False)
+                except Exception:  # capture must never hurt the caller
+                    pass
+
+            future.add_done_callback(_record)
         return future
 
     def rate_sync(
         self, actions: pd.DataFrame, *, home_team_id: Any = None,
         timeout: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
     ) -> pd.DataFrame:
         """Blocking convenience wrapper around :meth:`rate`."""
-        return self.rate(actions, home_team_id=home_team_id).result(timeout)
+        return self.rate(
+            actions, home_team_id=home_team_id, deadline_ms=deadline_ms
+        ).result(timeout)
 
     def open_session(self, match_id: Any, *, home_team_id: Any) -> MatchSession:
         """Start a live-match streaming session (see :class:`MatchSession`)."""
@@ -368,16 +476,57 @@ class RatingService:
         *, match_id: Any, home_team_id: Any,
     ) -> Future:
         """Session entry: pack a context+suffix window and enqueue it."""
+        self._check_admission('session')
         staging, gs = pack_window(
             window, match_id, home_team_id, self.max_actions
         )
-        payload = _Payload(staging, gs, keep=(context, m))
-        return self._submit(payload, 'session')
+        ctx = new_request_context(
+            'session', deadline_ms=self.request_deadline_ms
+        )
+        payload = _Payload(staging, gs, keep=(context, m), ctx=ctx)
+        return self._submit(payload, 'session', ctx)
 
-    def _submit(self, payload: '_Payload', kind: str) -> Future:
+    def _check_admission(self, kind: str) -> None:
+        """SLO burn-rate admission control; raises :class:`SLOShed`.
+
+        A no-op without an ``slo=`` config. The verdict comes from the
+        engine's cached multi-window evaluation, so the per-request cost
+        is a dict lookup; sheds are counted per objective under
+        ``slo/shed_total`` and, like queue overloads, feed the
+        overload-burst debug-bundle trigger.
+        """
+        if self._slo is None:
+            return
+        shed, reason = self._slo.should_shed(kind)
+        if shed:
+            counter('slo/shed_total', unit='requests').inc(
+                1, objective=reason['objective']
+            )
+            self._note_overload()
+            raise SLOShed(reason)
+
+    def _on_request_done(
+        self, ctx: Optional[RequestContext], kind: str, wall_s: float,
+        status: str,
+    ) -> None:
+        """Batcher terminal-state hook: score the request against the SLOs."""
+        if self._slo is not None and kind != 'warmup':
+            self._slo.observe_request(kind, wall_s, status)
+
+    def _on_slo_breach(self, objective: str, entry: Dict[str, Any]) -> None:
+        """SLO engine breach hook: dump the flight recorder (rate-limited)."""
+        self._maybe_dump(
+            'slo_breach',
+            {'type': 'slo_breach', 'objective': objective, 'evaluation': entry},
+        )
+
+    def _submit(
+        self, payload: '_Payload', kind: str,
+        ctx: Optional[RequestContext] = None,
+    ) -> Future:
         """Enqueue via the batcher, counting ``Overloaded`` bursts."""
         try:
-            return self._batcher.submit(payload, kind=kind)
+            return self._batcher.submit(payload, kind=kind, ctx=ctx)
         except Overloaded:
             self._note_overload()
             raise
@@ -413,10 +562,7 @@ class RatingService:
         import jax
         import jax.numpy as jnp
 
-        if host_batch.n_games != bucket:
-            host_batch = pad_batch_games(host_batch, bucket)
-            if gs is not None:
-                gs = np.pad(gs, [(0, bucket - gs.shape[0]), (0, 0), (0, 0)])
+        host_batch, gs = _pad_to_bucket(host_batch, gs, bucket)
         key = (bucket, host_batch.max_actions)
         with self._shape_lock:
             new_shape = key not in self._seen_shapes
@@ -439,6 +585,7 @@ class RatingService:
 
     def _flush(self, payloads: List[_Payload], bucket: int) -> List[Any]:
         _name, _version, model = self._active()  # ONE read per flush
+        t0 = time.perf_counter()
         stagings = [p.staging for p in payloads]
         if len(stagings) == 1:
             host_batch = stagings[0]
@@ -454,7 +601,13 @@ class RatingService:
                 if self._gs_enabled
                 else None
             )
+        # pad here (not inside the dispatch) so the host-side concat+pad
+        # overhead is charged to the 'pad' segment, never to 'dispatch'
+        # (_device_rate's own pad then no-ops; warmup still relies on it)
+        host_batch, gs = _pad_to_bucket(host_batch, gs, bucket)
+        t_pad = time.perf_counter()
         values = self._device_rate(host_batch, gs, model, bucket)
+        t_dispatch = time.perf_counter()
 
         results: List[Any] = []
         for i, p in enumerate(payloads):
@@ -466,6 +619,27 @@ class RatingService:
             else:
                 context, m = p.keep
                 results.append(values[i, context : context + m, :].copy())
+        t_slice = time.perf_counter()
+
+        # the flush-shared half of the per-request wall decomposition
+        # (queue_wait is the batcher's): pad/dispatch are one shared cost
+        # per flush, slicing is attributed evenly — recorded once per
+        # flush with the first coalesced request id as the exemplar, and
+        # onto every request's context for its request_done event
+        exemplar = next(
+            (p.ctx.request_id for p in payloads if p.ctx is not None), None
+        )
+        pad_s = t_pad - t0
+        dispatch_s = t_dispatch - t_pad
+        slice_s = t_slice - t_dispatch
+        record_segment('pad', pad_s, exemplar)
+        record_segment('dispatch', dispatch_s, exemplar)
+        record_segment('slice', slice_s, exemplar)
+        for p in payloads:
+            if p.ctx is not None:
+                p.ctx.segments.update(
+                    pad=pad_s, dispatch=dispatch_s, slice=slice_s
+                )
         return results
 
     # -- flight recorder + health ------------------------------------------
@@ -558,17 +732,29 @@ class RatingService:
         p99_ms = max(p99s) * 1e3 if p99s else None
         name, version, _model = self._active()
         state = self._queue_state()
+        slo_block: Dict[str, Any] = {
+            'request_p99_ms': p99_ms,
+            'budget_p99_ms': self.slo_p99_ms,
+            'ok': None if p99_ms is None else bool(p99_ms <= self.slo_p99_ms),
+        }
+        if self._slo is not None:
+            # per-objective burn rates + budget remaining, freshly
+            # evaluated (health is the poll that keeps the windows moving
+            # even when no admission decision forced an evaluation)
+            evaluation = self._slo.evaluate()
+            slo_block['objectives'] = evaluation['objectives']
+            slo_block['shed_burn_rate'] = evaluation['shed_burn_rate']
+            slo_block['shedding'] = bool(
+                self._slo.should_shed('rate')[0]
+                or self._slo.should_shed('session')[0]
+            )
         return {
             'status': 'ok' if state['flusher_alive'] else 'flusher-dead',
             **state,
             'model': {'name': name, 'version': version},
             'ladder': list(self.ladder),
             'compiled_shapes': self.compiled_shapes,
-            'slo': {
-                'request_p99_ms': p99_ms,
-                'budget_p99_ms': self.slo_p99_ms,
-                'ok': None if p99_ms is None else bool(p99_ms <= self.slo_p99_ms),
-            },
+            'slo': slo_block,
             'rejected_total': int(snap.value('serve/rejected_total')),
             'debug_dumps': int(
                 sum(s.total for s in dumps.series)
@@ -620,6 +806,23 @@ class RatingService:
         """Distinct ``(bucket, max_actions)`` shapes dispatched so far."""
         with self._shape_lock:
             return len(self._seen_shapes)
+
+
+def _pad_to_bucket(
+    host_batch: ActionBatch, gs: Optional[np.ndarray], bucket: int
+) -> Tuple[ActionBatch, Optional[np.ndarray]]:
+    """Pad a staging batch (and its goalscore block) up to the bucket.
+
+    The ONE home of the shape-critical padding rule, shared by the flush
+    (which pads early so the cost lands in the 'pad' segment) and
+    ``_device_rate`` (whose call no-ops on pre-padded batches but still
+    covers warmup's direct 1-game dispatches).
+    """
+    if host_batch.n_games != bucket:
+        host_batch = pad_batch_games(host_batch, bucket)
+        if gs is not None:
+            gs = np.pad(gs, [(0, bucket - gs.shape[0]), (0, 0), (0, 0)])
+    return host_batch, gs
 
 
 def _empty_host_batch(n_games: int, max_actions: int) -> ActionBatch:

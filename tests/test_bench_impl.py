@@ -317,6 +317,19 @@ def _check_serve_throughput(serve):
         assert level['request_p50_ms'] > 0
         assert level['request_p99_ms'] >= level['request_p50_ms']
         assert 0 < level['batch_fill_ratio_mean'] <= 1.0
+        # per-segment decomposition from the request-tracing histograms
+        assert set(level['segments']) == {
+            'queue_wait', 'pad', 'dispatch', 'slice'
+        }
+        for seg in level['segments'].values():
+            assert seg['mean_ms'] >= 0 and seg['p99_ms'] >= 0
+    # sweep-wide SLO verdicts: generous objectives end with budget intact
+    slo = serve['slo']
+    assert slo['shedding'] is False
+    assert set(slo['objectives']) == {'latency', 'errors'}
+    for entry in slo['objectives'].values():
+        assert entry['ok'] is True
+        assert entry['budget_remaining'] == 1.0
 
 
 def test_serve_smoke_end_to_end():

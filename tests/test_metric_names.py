@@ -91,6 +91,32 @@ def test_xt_solver_and_n_grids_labels_are_registered():
     ]
 
 
+def test_slo_and_drift_areas_are_registered():
+    """The SLO engine's (``slo/*``) and drift watch's (``drift/*``)
+    metric areas and their label contracts are governed by the lint gate
+    from day one (ISSUE 8 satellite)."""
+    tool = _tool()
+    assert {'slo', 'drift'} <= tool.KNOWN_AREAS
+    assert {'objective', 'outcome', 'window'} <= tool.KNOWN_LABELS['slo']
+    assert {'feature'} <= tool.KNOWN_LABELS['drift']
+    # the request-tracing segment dimension rides the serve contract
+    assert 'segment' in tool.KNOWN_LABELS['serve']
+
+
+def test_gate_reports_all_violations_per_site(tmp_path):
+    """One site breaking several rules surfaces every violation in one
+    run — not one per fix-and-rerun cycle (ISSUE 8 satellite)."""
+    tool = _tool()
+    bad = tmp_path / 'bad.py'
+    # one site: nested deeper than area/stage AND an unregistered area
+    bad.write_text("counter('rogue/compiles/per_fn').inc()\n")
+    problems, n_sites = tool.check_files([str(bad)], areas=tool.KNOWN_AREAS)
+    assert n_sites == 1
+    assert len(problems) == 2
+    assert any('nests deeper' in p for p in problems)
+    assert any('unregistered area' in p for p in problems)
+
+
 def test_unregistered_label_key_detected(tmp_path):
     """A literal label key outside its area's contract fails the gate;
     registered keys (and areas without a contract) pass."""

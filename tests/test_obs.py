@@ -260,9 +260,11 @@ def test_prometheus_exposition_golden_text():
         'area_events_total{kind="shot"} 3.0\n'
         '# HELP pipeline_feed_queue_depth_chunks pipeline/feed_queue_depth (chunks)\n'
         '# TYPE pipeline_feed_queue_depth_chunks gauge\n'
+        '# UNIT pipeline_feed_queue_depth_chunks chunks\n'
         'pipeline_feed_queue_depth_chunks 2.0\n'
         '# HELP pipeline_stage_seconds pipeline/stage_seconds (s)\n'
         '# TYPE pipeline_stage_seconds histogram\n'
+        '# UNIT pipeline_stage_seconds seconds\n'
         'pipeline_stage_seconds_bucket{stage="read",le="0.1"} 0\n'
         'pipeline_stage_seconds_bucket{stage="read",le="1.0"} 1\n'
         'pipeline_stage_seconds_bucket{stage="read",le="10.0"} 2\n'

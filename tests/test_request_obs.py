@@ -1,0 +1,319 @@
+"""Request-scoped tracing: contexts, deadlines, segments, obsctl trace.
+
+Covers the ISSUE-8 tentpole's first piece: every ``rate()`` call mints a
+:class:`RequestContext` that rides its future across the flusher-thread
+boundary; flush spans link the coalesced request ids; the per-request
+wall decomposes into queue-wait / pad / dispatch / slice segments (with
+exemplar request ids); deadline-expired requests are failed without a
+dispatch and never captured; and ``obsctl trace <request_id>``
+reconstructs the full path from the run log — plus the ``obsctl tail``
+``--area`` / ``--span`` / ``--since`` filters and their ``--json``
+round trip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.obs import REGISTRY, RunLog
+from socceraction_tpu.obs.context import (
+    SEGMENTS,
+    DeadlineExceeded,
+    new_request_context,
+)
+from socceraction_tpu.serve import MicroBatcher, RatingService, TrafficCapture
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 256
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def obsctl_main(argv):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        'obsctl', os.path.join(_ROOT, 'tools', 'obsctl.py')
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+def _fit_model():
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=220)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': HOME})
+    np.random.seed(0)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': (16,), 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+def _frame(seed=7, n_actions=120):
+    return synthetic_actions_frame(game_id=seed, seed=seed, n_actions=n_actions)
+
+
+def _obsctl(argv):
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = obsctl_main(argv)
+    return rc, out.getvalue()
+
+
+# ---------------------------------------------------------- batcher ctx ----
+
+
+def test_context_rides_the_future():
+    def runner(payloads, bucket):
+        return [p * 2 for p in payloads]
+
+    with MicroBatcher(runner, max_batch_size=4, max_wait_ms=5.0) as b:
+        ctx = new_request_context('rate')
+        fut = b.submit(21, ctx=ctx)
+        assert fut.result(timeout=30) == 42
+    assert fut.request_id == ctx.request_id
+    assert fut.context is ctx
+    # the batcher attributed the wait before the flush took over
+    assert ctx.segments['queue_wait'] >= 0.0
+
+
+def test_deadline_expired_request_never_dispatched():
+    """A queued request whose deadline passes is failed, its wait lands
+    in the queue_wait segment, and the runner never sees it."""
+    dispatched = []
+
+    def runner(payloads, bucket):
+        dispatched.extend(payloads)
+        return payloads
+
+    seg_before = REGISTRY.snapshot().value(
+        'serve/segment_seconds', stat='count', segment='queue_wait'
+    )
+    with MicroBatcher(runner, max_batch_size=8, max_wait_ms=120.0) as b:
+        ctx = new_request_context('rate', deadline_ms=15)
+        fut = b.submit('doomed', ctx=ctx)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+    assert dispatched == []
+    assert 'queue_wait' in ctx.segments and len(ctx.segments) == 1
+    snap = REGISTRY.snapshot()
+    assert snap.value('serve/deadline_expired', kind='rate') >= 1
+    qw = snap.series('serve/segment_seconds', segment='queue_wait')
+    assert qw.count >= seg_before + 1
+    assert qw.exemplar is not None and 'request_id' in qw.exemplar
+
+
+def test_expired_and_live_requests_split_one_flush():
+    """Expiry is per request: the live co-batched request still rates."""
+    dispatched = []
+
+    def runner(payloads, bucket):
+        dispatched.append(list(payloads))
+        return [p.upper() for p in payloads]
+
+    with MicroBatcher(runner, max_batch_size=8, max_wait_ms=100.0) as b:
+        doomed = b.submit('a', ctx=new_request_context('rate', deadline_ms=10))
+        alive = b.submit('b', ctx=new_request_context('rate'))
+        assert alive.result(timeout=30) == 'B'
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+    assert dispatched == [['b']]
+
+
+def test_flush_failure_reaches_ctx_futures_with_error_event():
+    """A raising runner fails ctx-carrying futures (no stranding) and
+    the request_done event records status=error."""
+    def runner(payloads, bucket):
+        raise RuntimeError('boom')
+
+    with MicroBatcher(runner, max_batch_size=2, max_wait_ms=5.0) as b:
+        fut = b.submit('x', ctx=new_request_context('rate'))
+        with pytest.raises(RuntimeError, match='boom'):
+            fut.result(timeout=30)
+        # the flusher thread survived a failed flush
+        assert b.flusher_alive
+
+
+# ----------------------------------------------- service-level tracing ----
+
+
+def test_rate_future_carries_request_context(model):
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        fut = svc.rate(_frame(), home_team_id=HOME)
+        fut.result(timeout=120)
+    ctx = fut.context
+    assert fut.request_id == ctx.request_id
+    # the full wall decomposition arrived on the context
+    assert set(SEGMENTS) <= set(ctx.segments)
+    assert all(v >= 0.0 for v in ctx.segments.values())
+
+
+def test_service_deadline_expiry_is_never_captured(model):
+    """Service-level satellite pin: deadline-expired requests fail with
+    the queue-wait attributed, are never dispatched (no new flush work)
+    and never reach the TrafficCapture ring."""
+    capture = TrafficCapture(max_frames=16)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=8, max_wait_ms=200.0,
+        capture=capture,
+    ) as svc:
+        svc.warmup()
+        flushes_before = REGISTRY.snapshot().value('serve/flush_seconds',
+                                                   stat='count',
+                                                   bucket='1')
+        fut = svc.rate(_frame(), home_team_id=HOME, deadline_ms=5)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=60)
+        time.sleep(0.05)  # let any (wrong) capture callback land
+    assert len(capture) == 0 and capture.total_actions == 0
+    snap = REGISTRY.snapshot()
+    assert snap.value('serve/flush_seconds', stat='count', bucket='1') == (
+        flushes_before
+    )
+    assert 'queue_wait' in fut.context.segments
+    assert 'dispatch' not in fut.context.segments
+
+
+def test_successful_rate_is_captured_after_resolution(model):
+    capture = TrafficCapture(max_frames=16)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        capture=capture,
+    ) as svc:
+        svc.warmup()
+        frame = _frame()
+        svc.rate(frame, home_team_id=HOME).result(timeout=120)
+        time.sleep(0.05)  # done-callbacks run on the flusher thread
+        assert len(capture) == 1
+        (got, home), = capture.frames()
+        assert home == HOME and len(got) == len(frame)
+
+
+# -------------------------------------------------- run log + obsctl ------
+
+
+@pytest.fixture(scope='module')
+def traced_runlog(model, tmp_path_factory):
+    """One coalesced flush of two traced requests under a RunLog."""
+    path = str(tmp_path_factory.mktemp('runlog') / 'obs.jsonl')
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=40.0
+    ) as svc:
+        svc.warmup()
+        with RunLog(path, config={'test': 'request_obs'}):
+            futs = [
+                svc.rate(_frame(seed=11), home_team_id=HOME),
+                svc.rate(_frame(seed=12), home_team_id=HOME),
+            ]
+            for f in futs:
+                f.result(timeout=120)
+    return path, [f.request_id for f in futs]
+
+
+def test_runlog_links_requests_through_the_flush(traced_runlog):
+    path, rids = traced_runlog
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    enq = [e for e in events if e.get('event') == 'request_enqueue']
+    done = [e for e in events if e.get('event') == 'request_done']
+    assert {e['request_id'] for e in enq} == set(rids)
+    assert {e['request_id'] for e in done} == set(rids)
+    for e in done:
+        assert e['status'] == 'ok'
+        assert set(SEGMENTS) <= set(e['segments'])
+        # coalesced: both requests rode one flush
+        assert e['coalesced'] == 2
+    flushes = [
+        e for e in events
+        if e.get('event') == 'span_close' and e.get('name') == 'serve/flush'
+    ]
+    (flush,) = flushes
+    assert set(flush['attrs']['request_ids']) == set(rids)
+    # the done events point at the span that served them
+    assert {e['flush_span_id'] for e in done} == {flush['span_id']}
+
+
+def test_obsctl_trace_reconstructs_one_request(traced_runlog):
+    path, rids = traced_runlog
+    rc, out = _obsctl(['trace', rids[0], path, '--json'])
+    assert rc == 0
+    trace = json.loads(out)
+    assert trace['request_id'] == rids[0]
+    assert trace['status'] == 'ok' and trace['kind'] == 'rate'
+    assert trace['coalesced'] == 2
+    assert set(SEGMENTS) <= set(trace['segments'])
+    assert trace['enqueue'] is not None and trace['flush'] is not None
+    assert rids[0] in trace['flush']['attrs']['request_ids']
+    # human rendering shows the queue->flush->path->done timeline
+    rc, human = _obsctl(['trace', rids[0], path])
+    assert rc == 0
+    assert 'enqueued' in human and 'flush' in human
+    assert 'queue_wait' in human and 'dispatch' in human
+    # an unknown id is a clean failure, not a stack trace
+    rc, _ = _obsctl(['trace', 'no-such-id', path, '--json'])
+    assert rc == 1
+
+
+def test_obsctl_tail_filters_and_json_roundtrip(traced_runlog):
+    path, rids = traced_runlog
+    # --area request: only request lifecycle events
+    rc, out = _obsctl(['tail', path, '--area', 'request', '--json', '-n', '50'])
+    assert rc == 0
+    events = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert events
+    assert all(e['event'].startswith('request_') for e in events)
+    # --json round trip: the filtered events are the log's own lines
+    raw = [json.loads(l) for l in open(path) if l.strip()]
+    raw_requests = [e for e in raw if e['event'].startswith('request_')]
+    assert events == raw_requests[-50:]
+    # --span: exactly the serve/flush span events
+    rc, out = _obsctl(['tail', path, '--span', 'serve/flush', '--json'])
+    assert rc == 0
+    spans = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert spans and all(e['name'] == 'serve/flush' for e in spans)
+    # --since: a zero-width window keeps only the newest instant
+    rc, out = _obsctl(['tail', path, '--since', '0s', '--json'])
+    assert rc == 0
+    newest = [json.loads(l) for l in out.splitlines() if l.strip()]
+    latest_ts = max(e['ts'] for e in raw)
+    assert newest and all(e['ts'] >= latest_ts for e in newest)
+    # --since with an absolute timestamp far in the future keeps nothing
+    rc, out = _obsctl(['tail', path, '--since', str(latest_ts + 1e6), '--json'])
+    assert rc == 0 and out.strip() == ''
+
+
+def test_sessions_mint_contexts_too(model):
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        session = svc.open_session('live-1', home_team_id=HOME)
+        frame = _frame(seed=21, n_actions=40)
+        session.add_actions(frame)
+    snap = REGISTRY.snapshot()
+    # session traffic flows through the same segment decomposition
+    assert snap.value(
+        'serve/segment_seconds', stat='count', segment='queue_wait'
+    ) > 0

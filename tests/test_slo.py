@@ -1,0 +1,319 @@
+"""SLO engine + burn-rate admission control (obs/slo.py, RatingService).
+
+Covers the ISSUE-8 tentpole's second piece: declarative objectives,
+multi-window burn-rate arithmetic over the typed snapshot, the engine's
+registry-reset resilience, and the service integration — a forced
+latency-SLO burn sheds with a machine-readable burn-rate reason while
+steady traffic under the objective is never shed (both acceptance
+pins), ``health()`` reports per-objective budget remaining, and a
+breach fires the rate-limited debug bundle.
+"""
+
+from __future__ import annotations
+
+import glob
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs.metrics import MetricRegistry
+from socceraction_tpu.obs.slo import SLOConfig, SLOEngine, SLOObjective
+from socceraction_tpu.serve import Overloaded, RatingService, SLOShed
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 256
+
+
+def _fit_model():
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=220)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': HOME})
+    np.random.seed(0)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': (16,), 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+def _engine(*, latency_ms=100.0, **cfg_kw):
+    """An engine on its own registry with an injectable clock."""
+    clock = [0.0]
+    cfg_kw.setdefault('fast_window_s', 1.0)
+    cfg_kw.setdefault('slow_window_s', 2.0)
+    cfg_kw.setdefault('min_events', 5)
+    cfg_kw.setdefault('shed_burn_rate', 1.0)
+    cfg_kw.setdefault('eval_interval_s', 0.0)
+    config = SLOConfig.simple(latency_ms=latency_ms, **cfg_kw)
+    engine = SLOEngine(
+        config, registry=MetricRegistry(), time_fn=lambda: clock[0]
+    )
+    return engine, clock
+
+
+# ------------------------------------------------------------- config -----
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match='latency_ms'):
+        SLOObjective(name='l', kind='latency')
+    with pytest.raises(ValueError, match='max_age_s'):
+        SLOObjective(name='f', kind='freshness')
+    with pytest.raises(ValueError, match='target'):
+        SLOObjective(name='l', kind='latency', latency_ms=1.0, target=1.0)
+    with pytest.raises(ValueError, match='at least one'):
+        SLOConfig(objectives=())
+    with pytest.raises(ValueError, match='duplicate'):
+        SLOConfig(
+            objectives=(
+                SLOObjective(name='x', kind='error'),
+                SLOObjective(name='x', kind='error'),
+            )
+        )
+
+
+def test_simple_config_per_kind_latency_objectives():
+    cfg = SLOConfig.simple(
+        latency_ms={'rate': 250.0, 'session': 50.0},
+        model_freshness_s=3600.0,
+    )
+    names = {o.name: o for o in cfg.objectives}
+    assert set(names) == {
+        'latency_rate', 'latency_session', 'errors', 'model_freshness'
+    }
+    assert names['latency_session'].latency_ms == 50.0
+    assert names['latency_session'].request_kind == 'session'
+    assert names['model_freshness'].max_age_s == 3600.0
+
+
+# ------------------------------------------------------------- engine -----
+
+
+def test_burn_rate_math_over_windows():
+    """bad_fraction / budget: half the requests over a 0.99 target burn
+    at 50x; the gauges and budget remaining agree."""
+    engine, clock = _engine(latency_ms=100.0, latency_target=0.99)
+    for i in range(20):
+        engine.observe_request('rate', 0.5 if i % 2 else 0.001, 'ok')
+        clock[0] += 0.05
+    ev = engine.evaluate()
+    entry = ev['objectives']['latency']
+    assert entry['burn_rate_fast'] == pytest.approx(50.0, rel=0.01)
+    assert entry['budget_remaining'] == 0.0
+    assert entry['breaching'] is True
+    snap = engine._registry.snapshot()
+    assert snap.value(
+        'slo/burn_rate', stat='last', objective='latency', window='fast'
+    ) == pytest.approx(50.0, rel=0.01)
+    assert snap.value(
+        'slo/events', objective='latency', outcome='bad'
+    ) == 10
+
+
+def test_min_events_gate_refuses_to_act_on_noise():
+    engine, clock = _engine(min_events=50)
+    for _ in range(10):  # all terrible, but only 10 events
+        engine.observe_request('rate', 9.9, 'ok')
+        clock[0] += 0.01
+    ev = engine.evaluate()
+    entry = ev['objectives']['latency']
+    assert entry['burn_rate_fast'] is None and entry['breaching'] is False
+    assert engine.should_shed('rate') == (False, None)
+
+
+def test_errors_and_expiries_burn_the_error_budget():
+    engine, clock = _engine(latency_ms=10_000.0, error_target=0.9)
+    for status in ('ok', 'ok', 'error', 'expired', 'ok', 'ok', 'ok', 'ok'):
+        engine.observe_request('rate', 0.001, status)
+        clock[0] += 0.01
+    entry = engine.evaluate()['objectives']['errors']
+    # 2 bad of 8 over a 0.1 budget: burning at 2.5x
+    assert entry['burn_rate_fast'] == pytest.approx(2.5, rel=0.01)
+    # the latency objective only saw the 6 completed requests
+    lat = engine.evaluate()['objectives']['latency']
+    assert lat['window_events_fast'] == 6
+
+
+def test_burn_recovers_as_the_window_slides():
+    engine, clock = _engine()
+    for _ in range(10):
+        engine.observe_request('rate', 9.9, 'ok')  # burn hard
+        clock[0] += 0.05
+        engine.evaluate()
+    assert engine.should_shed('rate')[0] is True
+    # a quiet burn-free stretch longer than the slow window
+    for _ in range(30):
+        engine.observe_request('rate', 0.001, 'ok')
+        clock[0] += 0.1
+        engine.evaluate()
+    shed, reason = engine.should_shed('rate')
+    assert shed is False and reason is None
+
+
+def test_registry_reset_clears_history_instead_of_negative_deltas():
+    engine, clock = _engine()
+    for _ in range(10):
+        engine.observe_request('rate', 9.9, 'ok')
+        clock[0] += 0.05
+    engine.evaluate()
+    engine._registry.reset()  # the bench does this between levels
+    clock[0] += 0.1
+    entry = engine.evaluate()['objectives']['latency']
+    assert entry['window_events_fast'] == 0
+    assert entry['breaching'] is False
+
+
+def test_freshness_objective_reports_but_never_sheds():
+    clock = [0.0]
+    age = [10.0]
+    cfg = SLOConfig.simple(
+        latency_ms=10_000.0, model_freshness_s=60.0,
+        fast_window_s=1.0, slow_window_s=2.0, min_events=5,
+        shed_burn_rate=1.0, eval_interval_s=0.0,
+    )
+    engine = SLOEngine(
+        cfg, registry=MetricRegistry(), time_fn=lambda: clock[0],
+        model_age_s=lambda: age[0],
+    )
+    entry = engine.evaluate()['objectives']['model_freshness']
+    assert entry['ok'] is True and entry['budget_remaining'] > 0.8
+    age[0] = 120.0  # stale: breaching, but shedding cannot help
+    entry = engine.evaluate()['objectives']['model_freshness']
+    assert entry['breaching'] is True
+    assert engine.should_shed('rate') == (False, None)
+
+
+def test_breach_hook_fires_once_per_episode():
+    fired = []
+    clock = [0.0]
+    cfg = SLOConfig.simple(
+        latency_ms=100.0, fast_window_s=1.0, slow_window_s=2.0,
+        min_events=5, shed_burn_rate=1.0, eval_interval_s=0.0,
+    )
+    engine = SLOEngine(
+        cfg, registry=MetricRegistry(), time_fn=lambda: clock[0],
+        on_breach=lambda name, entry: fired.append(name),
+    )
+    for _ in range(10):
+        engine.observe_request('rate', 9.9, 'ok')
+        clock[0] += 0.05
+        engine.evaluate()
+    assert fired == ['latency']  # errors objective saw only 'ok' statuses
+    n_after_burn = len(fired)
+    for _ in range(5):  # still burning: no re-fire
+        engine.observe_request('rate', 9.9, 'ok')
+        clock[0] += 0.05
+        engine.evaluate()
+    assert len(fired) == n_after_burn
+    assert engine._registry.snapshot().value(
+        'slo/breaches', objective='latency'
+    ) == 1
+
+
+# ------------------------------------------------- service integration ----
+
+
+def test_forced_latency_burn_sheds_with_burn_rate_reason(model, tmp_path):
+    """Acceptance pin: a forced latency-SLO burn causes RatingService to
+    shed with a machine-readable burn-rate reason, the shed is counted,
+    and the breach dumped a debug bundle."""
+    slo = SLOConfig.simple(
+        latency_ms=1e-6,  # impossible objective: every request burns
+        latency_target=0.9,
+        fast_window_s=0.5, slow_window_s=1.0,
+        min_events=4, shed_burn_rate=1.0, eval_interval_s=0.0,
+    )
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        slo=slo, debug_dir=str(tmp_path),
+    ) as svc:
+        svc.warmup()
+        frame = synthetic_actions_frame(game_id=5, seed=5, n_actions=80)
+        shed_reason = None
+        for _ in range(40):
+            try:
+                svc.rate(frame, home_team_id=HOME).result(timeout=120)
+            except SLOShed as e:
+                shed_reason = e.reason
+                break
+            time.sleep(0.02)
+        assert shed_reason is not None, 'burning service never shed'
+        assert shed_reason['objective'] == 'latency'
+        assert shed_reason['burn_rate_fast'] > 1.0
+        assert shed_reason['burn_rate_slow'] > 1.0
+        assert shed_reason['threshold'] == 1.0
+        assert shed_reason['budget_remaining'] == 0.0
+        # SLOShed is an Overloaded: existing shed-handling callers work
+        assert isinstance(SLOShed(shed_reason), Overloaded)
+        health = svc.health()
+        assert health['slo']['objectives']['latency']['breaching'] is True
+        assert health['slo']['shedding'] is True
+    snap = REGISTRY.snapshot()
+    assert snap.value('slo/shed_total', objective='latency') >= 1
+    # the breach fired the (rate-limited) debug bundle
+    assert snap.value('serve/debug_dumps', reason='slo_breach') >= 1
+    assert glob.glob(str(tmp_path / 'debug-*.tar.gz'))
+
+
+def test_steady_traffic_under_objective_is_never_shed(model):
+    """Acceptance pin: traffic comfortably inside the objective is never
+    shed and the budget stays intact."""
+    slo = SLOConfig.simple(
+        latency_ms=60_000.0,
+        fast_window_s=0.5, slow_window_s=1.0,
+        min_events=4, shed_burn_rate=1.0, eval_interval_s=0.0,
+    )
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        slo=slo,
+    ) as svc:
+        svc.warmup()
+        frame = synthetic_actions_frame(game_id=6, seed=6, n_actions=80)
+        for _ in range(25):
+            svc.rate(frame, home_team_id=HOME).result(timeout=120)
+        health = svc.health()
+    for name, entry in health['slo']['objectives'].items():
+        assert entry['breaching'] is False, (name, entry)
+        assert entry['budget_remaining'] == 1.0, (name, entry)
+    assert health['slo']['shedding'] is False
+
+
+def test_health_reports_per_objective_budget_remaining(model):
+    slo = SLOConfig.simple(
+        latency_ms={'rate': 60_000.0, 'session': 60_000.0},
+        model_freshness_s=3600.0,
+    )
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        slo=slo,
+    ) as svc:
+        health = svc.health()
+    objectives = health['slo']['objectives']
+    assert set(objectives) == {
+        'latency_rate', 'latency_session', 'errors', 'model_freshness'
+    }
+    for entry in objectives.values():
+        assert 'budget_remaining' in entry
+    fresh = objectives['model_freshness']
+    assert fresh['age_s'] is not None and fresh['ok'] is True
+
+
+def test_service_without_slo_keeps_legacy_health_shape(model):
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        health = svc.health()
+    assert 'objectives' not in health['slo']
+    assert set(health['slo']) == {'request_p99_ms', 'budget_p99_ms', 'ok'}

@@ -64,10 +64,12 @@ NAME_TAKING_CALLS = {
 #: tests' scratch files — checks convention and units only.
 KNOWN_AREAS = {
     'bench',  # bench.py headline gauges
+    'drift',  # traffic-drift watch (learn/drift.py: PSI/KS vs reference)
     'learn',  # continuous-learning loop (learn/: ingest/train/shadow/gate)
     'mem',  # device-memory accounting (obs/memory.py)
     'pipeline',  # store/feed/cache stage timings
     'serve',  # online rating service (batcher/session/registry/service)
+    'slo',  # SLO engine: burn rates, budgets, sheds (obs/slo.py)
     'train',  # MLP fit loop + bench training configs
     'vaep',  # rate_batch instrumentation
     'walkthrough',  # narrative-doc demo spans
@@ -90,12 +92,21 @@ KNOWN_AREAS = {
 #: - sites passing labels via ``**labels`` dicts are out of static
 #:   reach; their keys are still registered here as documentation and
 #:   the runtime series-budget guard covers the rest.
+#: - ``serve``: ``segment`` is the fixed per-request wall decomposition
+#:   (queue_wait|pad|dispatch|slice, ``obs/context.py::SEGMENTS``).
+#: - ``slo``: ``objective`` values are the configured objective names
+#:   (bounded by the SLOConfig, a handful), ``outcome`` good|bad,
+#:   ``window`` fast|slow.
+#: - ``drift``: ``feature`` values are the monitored packed fields plus
+#:   one ``pred_<head>`` per probability head — bounded by DriftConfig.
 KNOWN_LABELS = {
     'bench': {'path', 'platform'},
+    'drift': {'feature'},
     'learn': {'source', 'stage', 'verdict', 'head', 'model'},
     'mem': {'span', 'device'},
     'pipeline': {'stage'},
-    'serve': {'reason', 'kind', 'bucket'},
+    'serve': {'reason', 'kind', 'bucket', 'segment'},
+    'slo': {'objective', 'outcome', 'window'},
     'train': {'path', 'platform'},
     'vaep': {'path', 'platform'},
     'xla': {'fn'},
@@ -210,7 +221,9 @@ def collect_label_sites(tree: ast.Module) -> Iterator[Tuple[str, str, int]]:
         if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
             continue
         for kw in node.keywords:
-            if kw.arg is not None:
+            # 'exemplar' is the observe() verb's reserved kwarg (trace
+            # linkage), never a label dimension
+            if kw.arg is not None and kw.arg != 'exemplar':
                 yield first.value, kw.arg, node.lineno
 
 
@@ -252,21 +265,21 @@ def check_files(
                     f'{site}: {call}({name!r}) violates the area/stage '
                     "naming convention (lowercase segments joined by '/')"
                 )
-                continue
+                continue  # the remaining rules presume a parseable name
+            # every independent rule reports — a site violating several
+            # surfaces ALL of them in one run, not one per fix-and-rerun
             if name.count('/') > 1:
                 problems.append(
                     f'{site}: {call}({name!r}) nests deeper than '
                     'area/stage — a per-function (or per-anything) '
                     'dimension must be a label, not a name suffix'
                 )
-                continue
             if areas is not None and name.split('/')[0] not in areas:
                 problems.append(
                     f'{site}: {call}({name!r}) uses unregistered area '
                     f'{name.split("/")[0]!r} (add it to KNOWN_AREAS to '
                     'register a new telemetry area)'
                 )
-                continue
             if unit is None:
                 continue
             seen = units.get(name)

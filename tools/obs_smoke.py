@@ -1,0 +1,135 @@
+"""End-to-end request-tracing smoke: one traced request, reconstructed.
+
+The ``make obs-smoke`` gate for the request-observability layer: fit a
+tiny VAEP model on synthetic actions, serve ONE rating request through
+a :class:`RatingService` under a :class:`RunLog`, then reconstruct that
+request's queue → flush → dispatch → slice path from the run log with
+``obsctl trace`` and assert every piece is there:
+
+- the future carries its ``request_id`` / ``RequestContext``;
+- ``request_enqueue`` and ``request_done`` events landed in the log;
+- the ``serve/flush`` span lists the id among its coalesced children;
+- the segment decomposition covers queue_wait / pad / dispatch / slice
+  and sums to (at most) the request's wall;
+- the SLO engine scored the request and reports full budget remaining.
+
+Exit 0 on success; any assertion failure is a non-zero exit with the
+reconstructed trace printed for debugging. CPU-sized (a few seconds).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+__all__ = ['main']
+
+
+def main() -> int:
+    """Drive one traced request end to end; returns a process exit code."""
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.obs import RunLog, SLOConfig
+    from socceraction_tpu.serve import RatingService
+    from socceraction_tpu.vaep.base import VAEP
+    from tools.obsctl import main as obsctl_main
+
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=120)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': 100})
+    np.random.seed(0)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': (8,), 'max_epochs': 2},
+    )
+
+    with tempfile.TemporaryDirectory(prefix='obs-smoke-') as tmp:
+        runlog_path = os.path.join(tmp, 'obs.jsonl')
+        with RunLog(runlog_path, config={'smoke': 'obs'}):
+            with RatingService(
+                model,
+                max_actions=256,
+                max_batch_size=4,
+                max_wait_ms=1.0,
+                slo=SLOConfig.simple(latency_ms=60_000.0),
+            ) as service:
+                future = service.rate(frame, home_team_id=100)
+                ratings = future.result(timeout=120)
+                request_id = future.request_id
+                health = service.health()
+        assert len(ratings) == len(frame), 'ratings misaligned with request'
+        assert request_id, 'future carries no request id'
+        assert future.context.segments, 'context carries no segments'
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = obsctl_main(['trace', request_id, runlog_path, '--json'])
+        if rc != 0:
+            print(out.getvalue())
+            print('obs-smoke: FAIL - obsctl trace could not reconstruct')
+            return 1
+        trace = json.loads(out.getvalue())
+
+        problems = []
+        if trace.get('status') != 'ok':
+            problems.append(f'status {trace.get("status")!r} != ok')
+        if trace.get('enqueue') is None:
+            problems.append('no request_enqueue event')
+        if trace.get('done') is None:
+            problems.append('no request_done event')
+        flush = trace.get('flush')
+        if flush is None:
+            problems.append('no serve/flush span lists this request')
+        elif request_id not in (flush.get('attrs') or {}).get(
+            'request_ids', ()
+        ):
+            problems.append('flush span does not link the request id')
+        segments = trace.get('segments') or {}
+        missing = {'queue_wait', 'pad', 'dispatch', 'slice'} - set(segments)
+        if missing:
+            problems.append(f'segments missing {sorted(missing)}')
+        wall = trace.get('wall_s') or 0.0
+        if segments and sum(segments.values()) > wall * 1.05 + 1e-3:
+            problems.append(
+                f'segments sum {sum(segments.values()):.4f}s exceeds '
+                f'wall {wall:.4f}s'
+            )
+        slo = health.get('slo', {}).get('objectives', {})
+        if not slo:
+            problems.append('health() reports no SLO objectives')
+        elif any(
+            o.get('budget_remaining') not in (None, 1.0)
+            for o in slo.values()
+        ):
+            problems.append(f'unexpected budget burn in {slo}')
+
+        if problems:
+            print(json.dumps(trace, indent=1, sort_keys=True, default=str))
+            for p in problems:
+                print(f'obs-smoke: FAIL - {p}')
+            return 1
+
+        seg_ms = {k: round(v * 1e3, 3) for k, v in segments.items()}
+        print(
+            f'obs-smoke: OK - request {request_id} reconstructed '
+            f'(wall {wall * 1e3:.2f}ms, segments {seg_ms}, '
+            f'{len(slo)} SLO objective(s) at full budget)'
+        )
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

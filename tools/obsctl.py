@@ -8,11 +8,22 @@ required writing Python. ``obsctl`` is the no-Python surface::
     python tools/obsctl.py snapshot              # this process's registry
     python tools/obsctl.py snapshot obs.jsonl    # last embedded snapshot
     python tools/obsctl.py tail obs.jsonl -n 30  # recent events, readable
+    python tools/obsctl.py tail obs.jsonl --area serve --since 5m
+    python tools/obsctl.py trace <request_id> obs.jsonl  # one request's path
     python tools/obsctl.py prom obs.jsonl        # Prometheus text
     python tools/obsctl.py bundle /tmp/socceraction-tpu-debug  # post-mortem
     python tools/obsctl.py promotions obs.jsonl  # gate decisions, readable
+    python tools/obsctl.py drift obs.jsonl       # drift-watch checks
 
-``snapshot``/``tail``/``bundle``/``promotions`` accept ``--json`` for
+``trace`` reconstructs one request's queue → flush → dispatch → slice
+path from its ``request_enqueue``/``request_done`` events plus the
+``serve/flush`` span that coalesced it; ``tail`` filters with
+``--area`` (span-name area or event-type prefix), ``--span`` (exact
+name) and ``--since`` (``5m``-style relative to the log's newest event,
+or an absolute unix timestamp).
+
+``snapshot``/``tail``/``trace``/``bundle``/``promotions``/``drift``
+accept ``--json`` for
 machine-readable output (``prom`` *is* a machine format already); the
 default rendering is a compact human table. ``promotions`` tails the
 continuous-learning loop's typed promotion reports (verdict, per-head
@@ -117,16 +128,17 @@ def _cmd_snapshot(args: argparse.Namespace) -> int:
 
 def _prom_from_dict(snapshot: Dict[str, Any]) -> str:
     """Prometheus text from a *compact* snapshot dict (no bucket rows)."""
-    from socceraction_tpu.obs.export import _prom_labels, _prom_name
+    from socceraction_tpu.obs.export import _prom_header, _prom_labels, _prom_name
 
     lines: List[str] = []
     for name, inst in sorted(snapshot.items()):
         kind, unit = inst.get('kind', 'gauge'), inst.get('unit', '')
         pname = _prom_name(name, unit, kind)
-        lines.append(f'# HELP {pname} {name} ({unit})')
-        lines.append(
-            f'# TYPE {pname} '
-            + ('summary' if kind == 'histogram' else kind)
+        lines.extend(
+            _prom_header(
+                pname, name, unit, kind,
+                type_token='summary' if kind == 'histogram' else None,
+            )
         )
         for s in inst.get('series', []):
             labels = s.get('labels', {})
@@ -170,8 +182,12 @@ def _fmt_event(event: Dict[str, Any]) -> str:
     name = event.get('name') or event.get('fn')
     if name:
         parts.append(str(name))
+    if 'request_id' in event:
+        parts.append(f'request={event["request_id"]}')
     if 'duration_s' in event:
         parts.append(f'{event["duration_s"] * 1e3:.2f}ms')
+    if 'wall_s' in event:
+        parts.append(f'{event["wall_s"] * 1e3:.2f}ms')
     if 'compile_s' in event:
         parts.append(f'compile {event["compile_s"] * 1e3:.1f}ms')
     status = event.get('status')
@@ -183,12 +199,60 @@ def _fmt_event(event: Dict[str, Any]) -> str:
         parts.append(f'queue_depth={event.get("queue_depth")}')
     if kind == 'debug_bundle':
         parts.append(f'{event.get("reason")} -> {event.get("path")}')
+    if kind == 'drift_check':
+        parts.append(
+            f'max_psi={event.get("max_psi")} ({event.get("max_psi_feature")}) '
+            f'triggered={event.get("triggered")}'
+        )
     return '  '.join(parts)
 
 
+def _event_area(event: Dict[str, Any]) -> str:
+    """The event's effective telemetry area for ``tail --area``.
+
+    Named events (spans, jit accounting) use their name's leading
+    segment (``serve/flush`` → ``serve``); unnamed lifecycle events fall
+    back to the event type's leading token (``request_done`` →
+    ``request``, ``drift_check`` → ``drift``, ``serve_queue`` →
+    ``serve``).
+    """
+    name = event.get('name') or event.get('fn') or ''
+    if '/' in str(name):
+        return str(name).split('/')[0]
+    kind = str(event.get('event') or event.get('kind') or '')
+    return kind.split('_')[0]
+
+
+def _since_cutoff(spec: str, latest_ts: float) -> float:
+    """``--since`` cutoff: relative (``30s``/``5m``/``2h``/``1d``, from
+    the log's newest event) or an absolute unix timestamp."""
+    spec = spec.strip()
+    scale = {'s': 1.0, 'm': 60.0, 'h': 3600.0, 'd': 86400.0}.get(spec[-1:])
+    if scale is not None and spec[:-1].replace('.', '', 1).isdigit():
+        return latest_ts - float(spec[:-1]) * scale
+    return float(spec)
+
+
+def _filter_events(
+    events: List[Dict[str, Any]], args: argparse.Namespace
+) -> List[Dict[str, Any]]:
+    """Apply ``tail``'s ``--area`` / ``--span`` / ``--since`` filters."""
+    if getattr(args, 'area', None):
+        events = [e for e in events if _event_area(e) == args.area]
+    if getattr(args, 'span', None):
+        events = [
+            e for e in events if str(e.get('name') or '') == args.span
+        ]
+    if getattr(args, 'since', None) and events:
+        latest = max(float(e.get('ts') or 0.0) for e in events)
+        cutoff = _since_cutoff(args.since, latest)
+        events = [e for e in events if float(e.get('ts') or 0.0) >= cutoff]
+    return events
+
+
 def _cmd_tail(args: argparse.Namespace) -> int:
-    """``tail <runlog> [-n N]``: the run log's most recent events."""
-    events = _read_events(args.runlog)[-args.n :]
+    """``tail <runlog> [-n N] [--area A] [--span S] [--since T]``."""
+    events = _filter_events(_read_events(args.runlog), args)[-args.n :]
     if args.json:
         for event in events:
             print(json.dumps(event, sort_keys=True))
@@ -196,6 +260,120 @@ def _cmd_tail(args: argparse.Namespace) -> int:
     for event in events:
         print(_fmt_event(event))
     print(f'obsctl tail: {len(events)} event(s) from {args.runlog}')
+    return 0
+
+
+def _cmd_trace(args: argparse.Namespace) -> int:
+    """``trace <request_id> <runlog>``: one request's full path.
+
+    Reconstructs queue → flush → dispatch → slice from the request's
+    ``request_enqueue`` / ``request_done`` events plus the
+    ``serve/flush`` span that lists the id among its coalesced children.
+    """
+    rid = args.request_id
+    enqueue = done = flush = None
+    for event in _read_events(args.runlog):
+        et = event.get('event') or event.get('kind')
+        if event.get('request_id') == rid:
+            if et == 'request_enqueue':
+                enqueue = event
+            elif et == 'request_done':
+                done = event
+        elif et == 'span_close' and event.get('name') == 'serve/flush':
+            attrs = event.get('attrs') or {}
+            if rid in (attrs.get('request_ids') or ()):
+                flush = event
+    if enqueue is None and done is None and flush is None:
+        print(
+            f'obsctl: no events for request {rid} in {args.runlog}',
+            file=sys.stderr,
+        )
+        return 1
+    segments = (done or {}).get('segments') or {}
+    trace = {
+        'request_id': rid,
+        'kind': (done or enqueue or {}).get('request_kind'),
+        'status': (done or {}).get('status'),
+        'wall_s': (done or {}).get('wall_s'),
+        'segments': segments,
+        'bucket': (done or {}).get('bucket'),
+        'coalesced': (done or {}).get('coalesced'),
+        'enqueue': enqueue,
+        'flush': flush,
+        'done': done,
+    }
+    if args.json:
+        print(json.dumps(trace, sort_keys=True, default=str))
+        return 0
+    print(f'request: {rid}  kind={trace["kind"]}  status={trace["status"]}')
+    if enqueue is not None:
+        depth = enqueue.get('queue_depth')
+        print(
+            f'  {_fmt_ts(enqueue.get("ts"))}  enqueued  '
+            f'queue_depth={depth}'
+            + (
+                f'  deadline_in={enqueue["deadline_in_s"] * 1e3:.1f}ms'
+                if enqueue.get('deadline_in_s') is not None
+                else ''
+            )
+        )
+    if flush is not None:
+        attrs = flush.get('attrs') or {}
+        print(
+            f'  {_fmt_ts(flush.get("ts"))}  flush     '
+            f'span={flush.get("span_id")}  bucket={attrs.get("bucket")}  '
+            f'coalesced={len(attrs.get("request_ids") or ())}  '
+            f'{(flush.get("duration_s") or 0.0) * 1e3:.2f}ms'
+        )
+    if segments:
+        path = '  ->  '.join(
+            f'{seg} {segments[seg] * 1e3:.2f}ms'
+            for seg in ('queue_wait', 'pad', 'dispatch', 'slice')
+            if seg in segments
+        )
+        print(f'  path:     {path}')
+    if done is not None:
+        line = (
+            f'  {_fmt_ts(done.get("ts"))}  done      '
+            f'status={done.get("status")}  '
+            f'wall={(done.get("wall_s") or 0.0) * 1e3:.2f}ms'
+        )
+        if done.get('error'):
+            line += f'  error={done["error"]}'
+        print(line)
+    return 0
+
+
+def _cmd_drift(args: argparse.Namespace) -> int:
+    """``drift <runlog> [-n N]``: tail the drift watch's check events."""
+    checks = [
+        e
+        for e in _read_events(args.runlog)
+        if (e.get('event') or e.get('kind')) == 'drift_check'
+    ][-args.n :]
+    if args.json:
+        for event in checks:
+            print(json.dumps(event, sort_keys=True, default=str))
+        return 0
+    for event in checks:
+        if not event.get('evaluated', True):
+            print(
+                f'{_fmt_ts(event.get("ts"))}  not-scored  '
+                + '; '.join(event.get('reasons') or ())
+            )
+            continue
+        line = (
+            f'{_fmt_ts(event.get("ts"))}  '
+            f'max_psi={event.get("max_psi"):.4f} '
+            f'({event.get("max_psi_feature")})  '
+            f'max_ks={event.get("max_ks"):.4f}  '
+            f'actions={event.get("n_actions")}  '
+            f'triggered={event.get("triggered")}'
+        )
+        print(line)
+        for reason in event.get('reasons') or ():
+            print(f'  reason : {reason}')
+    print(f'obsctl drift: {len(checks)} check(s) from {args.runlog}')
     return 0
 
 
@@ -350,8 +528,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser('tail', help='recent run-log events, human-readable')
     p.add_argument('runlog')
     p.add_argument('-n', type=int, default=20)
+    p.add_argument(
+        '--area',
+        help="telemetry area filter (e.g. 'serve', 'request', 'drift')",
+    )
+    p.add_argument('--span', help="exact span/event name (e.g. 'serve/flush')")
+    p.add_argument(
+        '--since',
+        help="cutoff: '30s'/'5m'/'2h'/'1d' before the log's newest event, "
+        'or an absolute unix timestamp',
+    )
     p.add_argument('--json', action='store_true')
     p.set_defaults(fn=_cmd_tail)
+
+    p = sub.add_parser(
+        'trace', help="reconstruct one request's queue->flush->dispatch path"
+    )
+    p.add_argument('request_id')
+    p.add_argument('runlog')
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser('drift', help="tail the drift watch's check events")
+    p.add_argument('runlog')
+    p.add_argument('-n', type=int, default=10)
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_drift)
 
     p = sub.add_parser(
         'promotions', help="tail the continuous-learning loop's gate decisions"
