@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke learn-smoke obs-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -23,8 +23,10 @@ lint:
 # the operator CLI, driven end to end in a jax-free process (a live
 # registry snapshot plus the Prometheus exposition must both exit 0),
 # then one traced request end to end: tools/obs_smoke.py serves a real
-# request under a RunLog and asserts `obsctl trace <request_id>`
-# reconstructs its queue -> flush -> dispatch -> slice path
+# request under a RunLog — through the in-dispatch finite guards and a
+# sample-everything parity probe — and asserts `obsctl trace
+# <request_id>` reconstructs its path AND `obsctl numerics` round-trips
+# the guard/parity surface (zero nonfinite, probe within 1e-5)
 obs-smoke:
 	$(PY) tools/obsctl.py snapshot
 	$(PY) tools/obsctl.py prom
@@ -57,6 +59,12 @@ bench-smoke:
 	$(PY) bench.py --train-smoke
 	$(PY) bench.py --serve-smoke
 	$(PY) bench.py --xt-smoke
+
+# regression verdicts between the two newest bench_history/ ledger
+# entries (every bench/smoke artifact is appended there); exits 1 on a
+# >10% headline-rate drop
+bench-diff:
+	$(PY) tools/benchdiff.py
 
 # one abbreviated continuous-learning loop iteration on CPU: land new
 # matches -> incremental ingest -> warm-started fit_packed -> shadow

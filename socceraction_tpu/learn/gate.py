@@ -51,12 +51,25 @@ class GateConfig:
     **and** when the drift statistics are unavailable (window too small,
     no watch configured): no evidence, no promotion, same direction as
     ``min_replay_actions``.
+
+    ``max_parity_err``, when set, adds the serving layer's shadow-parity
+    probe (:class:`socceraction_tpu.obs.parity.ParityProbe`) as a third
+    fail-closed input: a candidate is blocked when the probe's worst
+    observed fused-vs-reference error exceeds the band — a numerically
+    broken serving path makes every calibration number measured through
+    it untrustworthy — when the serving service's in-dispatch guards
+    detected non-finite values (``serve_nonfinite_events`` in the
+    stats: the captured traffic window itself is suspect), and, in the
+    same fail-closed direction, when no parity statistics exist at all
+    (no probe attached, nothing sampled yet): no evidence, no
+    promotion.
     """
 
     max_ece_regression: float = 0.01
     max_brier_regression: float = 0.005
     min_replay_actions: int = 64
     max_drift_psi: Optional[float] = None
+    max_parity_err: Optional[float] = None
     n_bins: int = 10
     n_boot: int = 200
     seed: int = 0
@@ -90,6 +103,9 @@ class PromotionReport:
     #: the drift watch's statistics for this iteration's traffic window
     #: (``DriftResult.to_dict()``; empty when no watch is configured)
     drift: Dict[str, Any] = field(default_factory=dict)
+    #: the serving parity probe's lifetime stats at gate time
+    #: (``ParityProbe.stats()``; empty when no probe is attached)
+    parity: Dict[str, Any] = field(default_factory=dict)
     stage_seconds: Dict[str, float] = field(default_factory=dict)
     time_unix: float = field(default_factory=time.time)
 
@@ -113,6 +129,7 @@ class PromotionReport:
             'heads': self.heads,
             'replay': dict(self.replay),
             'drift': dict(self.drift),
+            'parity': dict(self.parity),
             'stage_seconds': {
                 k: round(v, 6) for k, v in self.stage_seconds.items()
             },
@@ -143,6 +160,7 @@ def evaluate_gate(
     config: GateConfig,
     *,
     drift: Any = None,
+    parity: Optional[Dict[str, Any]] = None,
 ) -> Tuple[bool, List[str]]:
     """Apply the calibration bands; returns ``(passed, reasons)``.
 
@@ -157,6 +175,14 @@ def evaluate_gate(
     or unevaluated statistics block exactly like a breach — the gate
     must not certify calibration measured on a distribution it cannot
     vouch for. Drift reasons apply even in the bootstrap case.
+
+    ``parity`` is the serving parity probe's
+    :meth:`~socceraction_tpu.obs.parity.ParityProbe.stats` dict (or
+    None). With ``config.max_parity_err`` set the check is fail-closed
+    in the same way: no probe statistics, or a worst observed error past
+    the band, both block — calibration measured through a numerically
+    diverged serving path proves nothing. Parity reasons apply even in
+    the bootstrap case.
     """
     reasons: List[str] = []
     if config.max_drift_psi is not None:
@@ -171,6 +197,28 @@ def evaluate_gate(
                 f'drift: {drift.max_psi_feature} PSI {drift.max_psi:.4f} '
                 f'> band {config.max_drift_psi:.4f} — the replay window '
                 'no longer resembles the training reference'
+            )
+    if config.max_parity_err is not None:
+        if parity and parity.get('serve_nonfinite_events'):
+            reasons.append(
+                'numerics: the serving service detected '
+                f'{parity["serve_nonfinite_events"]} non-finite dispatch '
+                'value(s) — traffic served (and captured) through a '
+                'non-finite path is not promotion evidence (fail closed)'
+            )
+        if not parity or not parity.get('evaluated'):
+            reasons.append(
+                'parity: no shadow-parity probes observed (fail closed; '
+                'attach a ParityProbe to the serving service so the '
+                'fused path is measured against the reference)'
+            )
+        elif parity['max_abs_err'] > config.max_parity_err:
+            reasons.append(
+                'parity: fused-vs-reference max abs error '
+                f'{parity["max_abs_err"]:.3e} > band '
+                f'{config.max_parity_err:.3e} over {parity["probes"]} '
+                'probe(s) — the serving path numerically diverged from '
+                'the reference implementation'
             )
     if active is None:
         if reasons:

@@ -205,6 +205,50 @@ class ContinuousLearner:
         )
         return candidate
 
+    def _parity_stats(self) -> Optional[Dict[str, Any]]:
+        """The serving layer's numeric-health stats for the gate.
+
+        The fail-closed ``GateConfig(max_parity_err=)`` input: the
+        parity probe's stats plus the service's drained nonfinite-event
+        count (``serve_nonfinite_events`` — a NaN that reached served
+        values makes the captured window untrustworthy regardless of
+        path parity). None when no service (or no probe and no
+        detections) is attached — with the band set, that absence
+        itself blocks promotion.
+        """
+        probe = getattr(self.service, 'parity', None)
+        stats = probe.stats() if probe is not None else None
+        nonfinite = int(getattr(self.service, 'nonfinite_events', 0) or 0)
+        if stats is None and nonfinite:
+            stats = {'evaluated': False, 'probes': 0}
+        if stats is not None:
+            stats['serve_nonfinite_events'] = nonfinite
+        return stats
+
+    @staticmethod
+    def _train_health_reasons(candidate: Any) -> List[str]:
+        """Divergence verdicts from the candidate's training-health telemetry.
+
+        Each MLP head records a :attr:`train_health_` dict inside its
+        epoch dispatches (:mod:`socceraction_tpu.ml.mlp`); any head that
+        saw a non-finite loss/gradient step — or ended on non-finite
+        norms — makes the candidate unpromotable regardless of what the
+        shadow calibration would say about it.
+        """
+        reasons: List[str] = []
+        for col, head in getattr(candidate, '_models', {}).items():
+            health = getattr(head, 'train_health_', None)
+            if health is None or health.get('finite', True):
+                continue
+            reasons.append(
+                f'{col}: training diverged — '
+                f'{health.get("nonfinite_steps", 0)} non-finite '
+                f'loss/grad step(s) over {health.get("epochs", 0)} '
+                f'epoch(s); grad_norm {health.get("grad_norm_last")}, '
+                f'weight_norm {health.get("weight_norm_last")}'
+            )
+        return reasons
+
     def _replay_frames(
         self, exclude: Any = ()
     ) -> Tuple[List[Tuple[pd.DataFrame, Any]], str]:
@@ -393,6 +437,43 @@ class ContinuousLearner:
             # an exception here would otherwise consume the games with no
             # decision trail anywhere (same contract as the publish guard)
             try:
+                # training-health gate first: a diverging incremental
+                # retrain is a poisoned candidate — reject it with a
+                # typed report before the shadow replay can score NaN
+                # probabilities (the games stay committed: retraining
+                # the same data would diverge again). Inside this try on
+                # purpose: a raise out of the rejection bookkeeping
+                # still records the 'error' report below.
+                health_reasons = self._train_health_reasons(candidate)
+                if health_reasons:
+                    counter('learn/training_diverged', unit='count').inc(1)
+                    report = PromotionReport(
+                        name=cfg.model_name,
+                        verdict='rejected',
+                        reasons=health_reasons,
+                        active_version=active_version,
+                        candidate_tag=tag,
+                        new_games=list(new_ids),
+                        drift=drift_res.to_dict() if drift_res else {},
+                        stage_seconds=dict(stage_s),
+                    )
+                    self.registry.gc_candidates(
+                        cfg.model_name, keep=cfg.retention_keep
+                    )
+                    try:
+                        dump_debug_bundle(
+                            self._debug_dir(),
+                            reason='training_diverged',
+                            trigger={
+                                'type': 'training_diverged',
+                                **report.to_dict(),
+                            },
+                        )
+                    except Exception:
+                        pass  # a failing dump must never unwind the verdict
+                    self._finish(report)
+                    return report
+
                 act_res: Optional[ShadowResult] = None
                 cand_res: Optional[ShadowResult] = None
                 with timed_stage('shadow'), span('learn/shadow'):
@@ -444,11 +525,13 @@ class ContinuousLearner:
                     return report
 
                 with timed_stage('gate'), span('learn/gate'):
+                    parity_stats = self._parity_stats()
                     passed, reasons = evaluate_gate(
                         act_res.summaries if act_res else None,
                         cand_res.summaries,
                         gate_cfg,
                         drift=drift_res,
+                        parity=parity_stats,
                     )
             except Exception as e:
                 report = PromotionReport(
@@ -484,6 +567,7 @@ class ContinuousLearner:
                     'source': replay_source,
                 },
                 drift=drift_res.to_dict() if drift_res else {},
+                parity=parity_stats or {},
             )
 
             if passed:

@@ -86,6 +86,15 @@ class _EpochTrainer:
     ``opt_state`` buffers are donated. ``n_traces`` counts retraces —
     pinned to 1 across epochs by ``tests/test_fused_train.py`` (fixed
     shapes: the tail batch wraps instead of shrinking).
+
+    Each epoch additionally returns a **health dict** of device scalars
+    computed inside the same scan — per-step global grad/update norms
+    (finite-masked means over the epoch), the post-epoch weight norm and
+    a count of steps whose loss or gradient went non-finite. The scalars
+    ride back as device arrays (no sync added to the epoch loop); the
+    fit loop materializes them ONCE at the end of training
+    (``train/grad_norm`` etc. + the divergence verdict in
+    ``train_health_``).
     """
 
     def __init__(self, loss_fn, tx, n: int, batch_size: int, seed: int):
@@ -120,13 +129,36 @@ class _EpochTrainer:
                 idx, valid = step
                 mb = jax.tree.map(lambda a: jnp.take(a, idx, axis=0), data)
                 loss, grads = jax.value_and_grad(loss_fn)(p, mb, valid)
+                gnorm = optax.global_norm(grads)
                 updates, o = tx.update(grads, o)
-                return (optax.apply_updates(p, updates), o), loss
+                unorm = optax.global_norm(updates)
+                return (
+                    (optax.apply_updates(p, updates), o),
+                    (loss, gnorm, unorm),
+                )
 
-            (params, opt_state), losses = jax.lax.scan(
+            (params, opt_state), (losses, gnorms, unorms) = jax.lax.scan(
                 body, (params, opt_state), (sel, slot_valid)
             )
-            return params, opt_state, jnp.mean(losses)
+            # training-health scalars, computed in the SAME dispatch: a
+            # step is unhealthy when its loss or its gradient norm went
+            # non-finite; the norm telemetry averages the finite steps so
+            # one blown-up step cannot make the whole epoch's norms NaN
+            step_ok = jnp.isfinite(losses) & jnp.isfinite(gnorms)
+
+            def finite_mean(x):
+                ok = jnp.isfinite(x)
+                return jnp.sum(jnp.where(ok, x, 0.0)) / jnp.maximum(
+                    jnp.sum(ok), 1
+                )
+
+            health = {
+                'nonfinite_steps': jnp.sum(~step_ok).astype(jnp.int32),
+                'grad_norm': finite_mean(gnorms),
+                'update_norm': finite_mean(unorms),
+                'weight_norm': optax.global_norm(params),
+            }
+            return params, opt_state, jnp.mean(losses), health
 
         # cost=False: epoch_fn has a trace-time side effect (the
         # n_traces counter above) — the observatory's AOT cost lowering
@@ -205,6 +237,15 @@ class MLPClassifier:
         #: ``save``/``load`` checkpoint deliberately stores parameters,
         #: not optimizer state.
         self.opt_state_: Any = None
+        #: training-health verdict of the last fit (None before any):
+        #: ``{'finite': bool, 'epochs': int, 'nonfinite_steps': int,
+        #: 'grad_norm_last': float, 'update_norm_last': float,
+        #: 'weight_norm_last': float}`` — computed inside the epoch
+        #: dispatches and materialized once at the end of training. The
+        #: continuous-learning loop rejects a candidate whose heads
+        #: report ``finite=False`` (a diverging incremental retrain must
+        #: never reach the shadow gate as a healthy candidate).
+        self.train_health_: Optional[Dict[str, Any]] = None
 
     # -- standardization statistics ----------------------------------------
     # mean_/std_ are properties so the device copies predict_proba_device
@@ -356,12 +397,16 @@ class MLPClassifier:
         best_loss = np.inf
         bad_epochs = 0
         samples = n_samples if n_samples is not None else n
+        epoch_health: list = []
         with span('train/fit', **labels):
             for epoch in range(self.max_epochs):
                 t0 = time.perf_counter()
-                params, opt_state, _ = trainer.run(
+                params, opt_state, _, health = trainer.run(
                     params, opt_state, epoch, data
                 )
+                # device scalars only — materialized AFTER the loop, so
+                # the health telemetry adds no per-epoch sync
+                epoch_health.append(health)
                 # dispatch wall, not device wall: the epoch is async like
                 # every hot path; bench.py owns synced throughput numbers
                 histogram('train/epoch_seconds', unit='s').observe(
@@ -394,7 +439,50 @@ class MLPClassifier:
         self.opt_state_ = (
             best_opt_state if best_params is not None else opt_state
         )
+        self._record_train_health(epoch_health, labels, path)
         return self
+
+    def _record_train_health(self, epoch_health, labels, path) -> None:
+        """Materialize the per-epoch health scalars; record + verdict.
+
+        One host conversion at the END of the fit (the epochs were
+        dispatched asynchronously; anything consuming the trained
+        parameters waits for the same stream anyway). Lands per-epoch
+        ``train/grad_norm`` / ``train/update_norm`` / ``train/weight_norm``
+        histograms, counts nonfinite steps into ``train/nonfinite_loss``
+        AND the cross-cutting ``num/nonfinite_total{fn=train_epoch}``
+        guard counter, and stores the :attr:`train_health_` verdict.
+        """
+        from ..obs.numerics import record_nonfinite
+
+        nonfinite_steps = 0
+        last = {'grad_norm': None, 'update_norm': None, 'weight_norm': None}
+        for h in epoch_health:
+            gn = float(h['grad_norm'])
+            un = float(h['update_norm'])
+            wn = float(h['weight_norm'])
+            histogram('train/grad_norm', unit='value').observe(gn, **labels)
+            histogram('train/update_norm', unit='value').observe(un, **labels)
+            histogram('train/weight_norm', unit='value').observe(wn, **labels)
+            nonfinite_steps += int(h['nonfinite_steps'])
+            last = {'grad_norm': gn, 'update_norm': un, 'weight_norm': wn}
+        if nonfinite_steps:
+            counter('train/nonfinite_loss', unit='count').inc(
+                nonfinite_steps, **labels
+            )
+            record_nonfinite('train_epoch', 'loss', nonfinite_steps)
+        finite = nonfinite_steps == 0 and all(
+            v is None or np.isfinite(v) for v in last.values()
+        )
+        self.train_health_ = {
+            'finite': bool(finite),
+            'path': path,
+            'epochs': len(epoch_health),
+            'nonfinite_steps': nonfinite_steps,
+            'grad_norm_last': last['grad_norm'],
+            'update_norm_last': last['update_norm'],
+            'weight_norm_last': last['weight_norm'],
+        }
 
     def fit(
         self,

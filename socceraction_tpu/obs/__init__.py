@@ -30,6 +30,12 @@ where a caller asks for device sync or named scopes):
   in-use/peak gauges, per-span watermarks, a live-buffer census.
 - :mod:`socceraction_tpu.obs.recorder` — the crash-dump flight
   recorder: a bounded event ring plus :func:`dump_debug_bundle`.
+- :mod:`socceraction_tpu.obs.numerics` — in-dispatch numeric health
+  guards: finite/overflow reductions folded into the jitted hot paths,
+  drained into governed ``num/*`` metrics without syncing a dispatch.
+- :mod:`socceraction_tpu.obs.parity` — :class:`ParityProbe`, the
+  sampled off-thread shadow re-execution of serve flushes through the
+  materialized reference path (abs/ulp error histograms per path pair).
 
 ``socceraction_tpu.utils.profiling`` is a thin façade over this package:
 its ``timed``/``record_value``/``timer_report`` keep working and now
@@ -47,8 +53,10 @@ __all__ = [
     'Gauge',
     'Histogram',
     'InstrumentedJit',
+    'GuardEvent',
     'MemorySampler',
     'MetricRegistry',
+    'ParityProbe',
     'RECORDER',
     'REGISTRY',
     'RegistrySnapshot',
@@ -64,14 +72,21 @@ __all__ = [
     'current_span',
     'default_debug_dir',
     'device_memory_stats',
+    'drain_guards',
     'dump_debug_bundle',
     'gauge',
+    'guards_enabled',
     'histogram',
     'instrument_jit',
     'live_array_census',
     'new_request_context',
+    'nonfinite_count',
+    'note_guard',
     'observatory_snapshot',
+    'overflow_count',
     'prometheus_text',
+    'record_nonfinite',
+    'record_overflow',
     'run_manifest',
     'sample_device_memory',
     'snapshot_dict',
@@ -105,6 +120,12 @@ _HOMES = {
         'FlightRecorder', 'RECORDER', 'default_debug_dir',
         'dump_debug_bundle',
     ),
+    'numerics': (
+        'GuardEvent', 'drain_guards', 'guards_enabled', 'nonfinite_count',
+        'note_guard', 'overflow_count', 'record_nonfinite',
+        'record_overflow',
+    ),
+    'parity': ('ParityProbe',),
 }
 _HOME_BY_SYMBOL = {
     name: module for module, names in _HOMES.items() for name in names

@@ -1,0 +1,304 @@
+"""In-dispatch numeric health guards: finite checks as runtime signals.
+
+Every correctness invariant of the hot paths — fused/materialized parity
+at 1e-5, int-overflow-free segment scatters, converging solvers — lived
+only in tests until now: a NaN in a serve flush or a diverging
+incremental retrain produced *wrong answers with healthy telemetry*
+(PR 7's int32 wrap in ``segment_sum_2d`` produced wrong grids with
+``converged=True`` certificates before a reviewer caught it). This
+module makes numeric health a measured runtime signal:
+
+- **in-jit guard reductions** — :func:`nonfinite_count` /
+  :func:`overflow_count` fold a cheap ``jnp.isfinite`` reduction into a
+  jitted hot path's own dispatch (a few fused element-wise ops over
+  tensors the kernel already touches; no extra HBM round trip). The
+  guarded function returns the count as a side-band scalar next to its
+  real outputs.
+- **deferred, sync-free recording** — the hot paths must never block on
+  a guard: :func:`note_guard` stashes the *device* scalar in a bounded
+  pending ring and returns immediately (tracer values — a guarded
+  function inlined under an outer trace — are skipped). A later
+  :func:`drain_guards` call, placed where the caller has already
+  fetched the dispatch's results to host (the serve flush, after its
+  ``device_get``), converts the ready scalars and records any nonzero
+  counts into the governed ``num/*`` metrics plus a
+  ``nonfinite_detected`` event (RunLog + flight recorder). Zero counts
+  cost one ``int()`` of a ready buffer and record nothing.
+- **host-side recording** — :func:`record_nonfinite` /
+  :func:`record_overflow` for paths whose outputs are already on host
+  (the xT fit materializes its certificate arrays for its own metrics;
+  counting ``np.isfinite`` over them costs no device work).
+
+Metrics (area ``num``, labels governed by
+``tools/check_metric_names.py``):
+
+| metric | kind | labels | meaning |
+|---|---|---|---|
+| ``num/nonfinite_total`` | counter | ``fn``, ``output`` | nonfinite values detected per guarded output |
+| ``num/overflow_guard_total`` | counter | ``fn`` | finite values past the magnitude guard (e.g. logits beyond f32 ``exp`` saturation) |
+| ``num/guard_drops`` | counter | — | pending guards evicted before a drain |
+
+``SOCCERACTION_TPU_NUM_GUARDS=0`` disables the in-jit guards (the
+guarded functions compile without the side-band output; flipping the
+flag mid-process retraces once per signature — it is static).
+
+Importable without jax (the obs package contract): jax is touched only
+inside the in-jit helpers and when a noted value needs tracer
+detection.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from socceraction_tpu.obs.metrics import REGISTRY
+
+__all__ = [
+    'GuardEvent',
+    'LOGIT_OVERFLOW_LIMIT',
+    'clear_pending',
+    'drain_guards',
+    'guards_enabled',
+    'nonfinite_count',
+    'nonfinite_total',
+    'note_guard',
+    'overflow_count',
+    'pending_guards',
+    'record_health_event',
+    'record_nonfinite',
+    'record_overflow',
+]
+
+#: Environment flag: ``0`` disables the in-jit guard outputs.
+NUM_GUARDS_ENV = 'SOCCERACTION_TPU_NUM_GUARDS'
+
+#: Magnitude guard for pre-sigmoid logits: past ``exp(±88)`` an f32
+#: sigmoid saturates to exactly 0/1 — still finite, but a red flag for
+#: blown-up weights that :func:`overflow_count` makes visible before the
+#: probabilities go NaN.
+LOGIT_OVERFLOW_LIMIT = 88.0
+
+
+def guards_enabled() -> bool:
+    """Whether the in-dispatch guards are compiled into the hot paths."""
+    return os.environ.get(NUM_GUARDS_ENV, '1') != '0'
+
+
+# -- in-jit reductions -------------------------------------------------------
+
+
+def nonfinite_count(*arrays: Any) -> Any:
+    """Total count of non-finite elements across ``arrays`` (int32).
+
+    Safe inside jit: a fused elementwise ``isfinite`` + sum over tensors
+    the kernel already produced — no extra HBM traffic beyond the
+    reduction itself.
+    """
+    import jax.numpy as jnp
+
+    total = jnp.int32(0)
+    for x in arrays:
+        total = total + jnp.sum(~jnp.isfinite(x)).astype(jnp.int32)
+    return total
+
+
+def overflow_count(
+    *arrays: Any, limit: float = LOGIT_OVERFLOW_LIMIT
+) -> Any:
+    """Count of elements with ``|x| > limit`` (int32, in-jit).
+
+    The magnitude half of the guard: values that have left the
+    numerically meaningful range (saturating logits, blown-up
+    accumulators). ``±Inf`` counts — it is the saturation signal's
+    terminal case — while NaN does not (``|NaN| > limit`` is False by
+    IEEE comparison; NaN is the *nonfinite* guard's signal).
+    """
+    import jax.numpy as jnp
+
+    total = jnp.int32(0)
+    for x in arrays:
+        total = total + jnp.sum(jnp.abs(x) > limit).astype(jnp.int32)
+    return total
+
+
+# -- pending ring + recording ------------------------------------------------
+
+
+class GuardEvent(NamedTuple):
+    """One drained nonzero guard observation."""
+
+    fn: str
+    output: str
+    kind: str  # 'nonfinite' | 'overflow'
+    count: int
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-ready payload (the ``nonfinite_detected`` event body).
+
+        ``guard_kind``, not ``kind``: the payload rides into
+        ``FlightRecorder.record(kind=...)``, whose event-type key a
+        field named ``kind`` would collide with.
+        """
+        return {
+            'fn': self.fn,
+            'output': self.output,
+            'guard_kind': self.kind,
+            'count': self.count,
+        }
+
+
+class _PendingGuards:
+    """Bounded ring of ``(fn, output, kind, device scalar)`` entries.
+
+    The hot path appends (no host sync); a drain converts and records.
+    The bound keeps unharvested guards (standalone ``rate_batch`` users
+    who never drain) from accumulating device buffers without limit.
+    """
+
+    def __init__(self, capacity: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._ring: 'deque' = deque(maxlen=int(capacity))
+        self.dropped = 0
+
+    def note(self, fn: str, output: str, kind: str, value: Any) -> None:
+        if not isinstance(value, int):
+            try:
+                import jax
+
+                if isinstance(value, jax.core.Tracer):
+                    # the guarded function is being inlined under an
+                    # outer trace: there is no concrete count to record
+                    return
+            except Exception:
+                return
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+                REGISTRY.counter('num/guard_drops', unit='count').inc(1)
+            self._ring.append((fn, output, kind, value))
+
+    def drain(self) -> List[GuardEvent]:
+        with self._lock:
+            taken, self._ring = list(self._ring), deque(
+                maxlen=self._ring.maxlen
+            )
+        events: List[GuardEvent] = []
+        for fn, output, kind, value in taken:
+            try:
+                n = int(value)
+            except Exception:
+                continue  # a deleted/donated buffer cannot sink the drain
+            if n <= 0:
+                continue
+            if kind == 'overflow':
+                events.append(record_overflow(fn, n, output=output))
+            else:
+                events.append(record_nonfinite(fn, output, n))
+        return [e for e in events if e is not None]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+_PENDING = _PendingGuards()
+
+
+def note_guard(fn: str, output: str, value: Any, kind: str = 'nonfinite') -> None:
+    """Stash one dispatch's guard scalar for a later :func:`drain_guards`.
+
+    ``value`` is the (device or host) integer count a guarded hot path
+    produced as its side-band output. Never blocks on the device; tracer
+    values are skipped.
+    """
+    _PENDING.note(fn, output, kind, value)
+
+
+def drain_guards() -> List[GuardEvent]:
+    """Convert pending guard scalars; record and return nonzero events.
+
+    Call where the dispatch's real outputs have already been fetched to
+    host (the device stream is in-order, so the side-band scalars are
+    ready and conversion is a copy, not a sync).
+    """
+    return _PENDING.drain()
+
+
+def pending_guards() -> int:
+    """Guard scalars noted but not yet drained (introspection/tests)."""
+    return len(_PENDING)
+
+
+def clear_pending() -> None:
+    """Discard pending guards without recording (test isolation)."""
+    _PENDING.clear()
+
+
+def record_health_event(event_type: str, payload: Dict[str, Any]) -> None:
+    """Land one numeric-health event everywhere an operator might look.
+
+    The single RECORDER + RunLog fan-out both numeric-health producers
+    share (guard drains record ``nonfinite_detected``, the parity probe
+    ``parity_exceeded``) — one place for sinks and exception policy.
+    Never raises into a hot path.
+    """
+    from socceraction_tpu.obs.recorder import RECORDER
+    from socceraction_tpu.obs.trace import current_runlog
+
+    try:
+        RECORDER.record(event_type, **payload)
+        log = current_runlog()
+        if log is not None:
+            log.event(event_type, **payload)
+    except Exception:
+        pass  # telemetry of telemetry must never raise into a hot path
+
+
+def _record_event(event: GuardEvent) -> None:
+    record_health_event('nonfinite_detected', event.to_dict())
+
+
+def record_nonfinite(fn: str, output: str, n: int) -> Optional[GuardEvent]:
+    """Record ``n`` nonfinite values observed in ``fn``'s ``output``.
+
+    ``n <= 0`` is a no-op (healthy dispatches cost nothing). Returns the
+    recorded event, or None.
+    """
+    n = int(n)
+    if n <= 0:
+        return None
+    REGISTRY.counter('num/nonfinite_total', unit='count').inc(
+        n, fn=fn, output=output
+    )
+    event = GuardEvent(fn=fn, output=output, kind='nonfinite', count=n)
+    _record_event(event)
+    return event
+
+
+def record_overflow(
+    fn: str, n: int, output: str = 'logits'
+) -> Optional[GuardEvent]:
+    """Record ``n`` finite-but-overflowing values observed in ``fn``."""
+    n = int(n)
+    if n <= 0:
+        return None
+    REGISTRY.counter('num/overflow_guard_total', unit='count').inc(n, fn=fn)
+    event = GuardEvent(fn=fn, output=output, kind='overflow', count=n)
+    _record_event(event)
+    return event
+
+
+def nonfinite_total() -> float:
+    """Process-lifetime total of detected nonfinite values (all guards)."""
+    snap = REGISTRY.snapshot().get('num/nonfinite_total')
+    if snap is None:
+        return 0.0
+    return float(sum(s.total for s in snap.series))

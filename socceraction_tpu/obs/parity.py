@@ -1,0 +1,338 @@
+"""Sampled shadow parity: re-check serve flushes against the reference path.
+
+The fused rating path is pinned bit-close to the materialized reference
+by tests — at test time, on test shapes. In production nothing measured
+that the two paths still agree: a quantized table, a fused kernel
+regression or a backend numeric change would shift served values with
+no signal anywhere. :class:`ParityProbe` turns the parity contract into
+a live meter:
+
+- the serving layer samples a configurable fraction of its flushes
+  (:meth:`ParityProbe.should_sample`, deterministic 1-in-N — no RNG in
+  the flush path) and hands the probe the *already computed* flush:
+  the padded host batch, its goalscore overrides, the values the
+  service returned, and the first coalesced request id as the exemplar;
+- a dedicated daemon worker re-rates the batch through the
+  **materialized reference path**
+  (:meth:`~socceraction_tpu.vaep.base.VAEP.rate_batch_reference`) **off
+  the flusher thread** — a probe never adds latency to live traffic,
+  and a full probe queue drops the sample rather than blocking;
+- per path-pair error histograms land in the governed ``num`` area with
+  the request id attached as the exemplar:
+
+  | metric | kind | labels | meaning |
+  |---|---|---|---|
+  | ``num/parity_abs_err`` | histogram (value) | ``pair`` | max abs error of one probed flush |
+  | ``num/parity_ulp_err`` | histogram (ulps) | ``pair`` | the same error in units-in-last-place |
+  | ``num/parity_probes`` | counter | ``pair`` | flushes probed |
+  | ``num/parity_exceedances`` | counter | ``pair`` | probes past the configured band |
+  | ``num/parity_dropped`` | counter | — | samples dropped (full queue / errors) |
+
+- a probe past ``max_abs_err`` records a ``parity_exceeded`` event
+  (RunLog + flight recorder) and fires the ``on_exceed`` hook — the
+  service wires its rate-limited debug-bundle dump there — and the
+  probe's :meth:`stats` feed the continuous-learning gate's fail-closed
+  ``GateConfig(max_parity_err=)`` input, so a parity breach blocks
+  promotions instead of certifying calibration measured on a broken
+  path.
+
+``pair`` names the two sides being compared. The serving integration
+records ``fused_vs_materialized`` (the live path vs the materialized
+reference — identical computations when the platform profile already
+serves materialized, which still exercises the meter);
+:meth:`compare` is public so other invariants can feed the same
+machinery — ``incremental_vs_replay`` (a session's O(new actions)
+window vs a full-match replay) is the second governed pair.
+
+Sampling guidance: each probe costs roughly one extra flush-sized
+dispatch on the probe thread. ``sample_rate=0.01``–``0.05`` keeps the
+meter live in production for noise-level cost; smokes and tests run at
+``1.0``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from socceraction_tpu.obs.metrics import REGISTRY
+
+__all__ = ['ParityProbe']
+
+
+class ParityProbe:
+    """Off-thread sampled parity checks between two rating paths.
+
+    Parameters
+    ----------
+    sample_rate : float
+        Fraction of submitted flushes actually probed, implemented as a
+        deterministic 1-in-``round(1/rate)`` counter (0 disables, 1.0
+        probes everything).
+    max_abs_err : float
+        The parity band: a probe whose max abs error exceeds it counts
+        an exceedance, records a ``parity_exceeded`` event and fires
+        ``on_exceed``.
+    queue_size : int
+        Bound on flushes waiting for the probe worker; a full queue
+        drops the sample (``num/parity_dropped``) instead of blocking
+        the flusher.
+    on_exceed : callable, optional
+        ``on_exceed(report_dict)`` invoked (on the probe thread) per
+        exceedance; must not raise (it is guarded). The serving layer
+        hooks its rate-limited debug-bundle dump here.
+    """
+
+    def __init__(
+        self,
+        sample_rate: float = 0.05,
+        max_abs_err: float = 1e-4,
+        *,
+        queue_size: int = 4,
+        on_exceed: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ) -> None:
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError('sample_rate must be in [0, 1]')
+        self.sample_rate = float(sample_rate)
+        self.max_abs_err = float(max_abs_err)
+        self.on_exceed = on_exceed
+        self._queue: 'queue.Queue' = queue.Queue(maxsize=int(queue_size))
+        self._lock = threading.Lock()
+        self._tick = 0
+        self._outstanding = 0
+        self._probes = 0
+        self._exceedances = 0
+        self._errors = 0
+        self._worst: Optional[float] = None
+        self._worst_ulp: Optional[float] = None
+        self._last: Optional[Dict[str, Any]] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- sampling + submission (flusher thread) ----------------------------
+
+    def should_sample(self) -> bool:
+        """Deterministic 1-in-N sampling decision (cheap, no RNG)."""
+        if self.sample_rate <= 0.0 or self._closed:
+            return False
+        period = max(1, round(1.0 / self.sample_rate))
+        with self._lock:
+            self._tick += 1
+            return (self._tick - 1) % period == 0
+
+    def submit_flush(
+        self,
+        model: Any,
+        host_batch: Any,
+        gs: Optional[np.ndarray],
+        values: np.ndarray,
+        exemplar: Optional[str] = None,
+    ) -> bool:
+        """Enqueue one served flush for off-thread reference comparison.
+
+        ``host_batch`` is the padded staging :class:`ActionBatch` the
+        flush dispatched (numpy fields; never mutated after the flush),
+        ``gs`` its goalscore override block (or None), ``values`` the
+        ``(B, A, 3)`` host ratings the service returned. Returns False
+        (and counts a drop) when the probe queue is full.
+        """
+        item = (model, host_batch, gs, values, exemplar)
+        with self._lock:
+            if self._closed:
+                return False
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name='parity-probe', daemon=True
+                )
+                self._thread.start()
+            self._outstanding += 1
+        try:
+            self._queue.put_nowait(item)
+            return True
+        except queue.Full:
+            with self._lock:
+                self._outstanding -= 1
+            REGISTRY.counter('num/parity_dropped', unit='count').inc(1)
+            return False
+
+    # -- the probe worker ---------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            try:
+                self._probe_one(*item)
+            except Exception:
+                with self._lock:
+                    self._errors += 1
+                REGISTRY.counter('num/parity_dropped', unit='count').inc(1)
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+
+    def _probe_one(self, model, host_batch, gs, values, exemplar) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        batch = jax.device_put(host_batch)
+        overrides = {'goalscore': jnp.asarray(gs)} if gs is not None else None
+        want = np.asarray(
+            model.rate_batch_reference(batch, dense_overrides=overrides)
+        )
+        mask = np.asarray(host_batch.mask, dtype=bool)
+        self.compare(
+            'fused_vs_materialized',
+            np.asarray(values),
+            want,
+            mask=mask,
+            exemplar=exemplar,
+        )
+
+    # -- the comparison core (public: other invariants feed it too) --------
+
+    def compare(
+        self,
+        pair: str,
+        got: np.ndarray,
+        want: np.ndarray,
+        *,
+        mask: Optional[np.ndarray] = None,
+        exemplar: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Record one parity observation between two value tensors.
+
+        ``mask`` (broadcast against the leading axes) restricts the
+        comparison to valid rows — padded slots carry garbage by
+        contract. Returns the observation dict (also kept as
+        :attr:`stats`'s ``last``).
+        """
+        got = np.asarray(got, dtype=np.float64)
+        want = np.asarray(want, dtype=np.float64)
+        if got.shape != want.shape:
+            raise ValueError(
+                f'parity shapes disagree: {got.shape} vs {want.shape}'
+            )
+        if mask is not None:
+            valid = np.broadcast_to(
+                np.asarray(mask, bool).reshape(
+                    mask.shape + (1,) * (got.ndim - np.ndim(mask))
+                ),
+                got.shape,
+            )
+        else:
+            valid = np.ones(got.shape, bool)
+        err = np.where(valid, np.abs(got - want), 0.0)
+        # NaN-vs-NaN agrees; NaN on one side only is maximal disagreement
+        both_nan = np.isnan(got) & np.isnan(want)
+        one_nan = np.isnan(got) ^ np.isnan(want)
+        err = np.where(valid & both_nan, 0.0, err)
+        err = np.where(valid & one_nan, np.inf, err)
+        max_abs = float(np.max(err)) if err.size else 0.0
+        # units-in-last-place of the reference value (f32 spacing: the
+        # values being compared are f32 computations). A one-sided-NaN
+        # reference has no spacing — force the same inf-disagreement
+        # verdict as the abs error, never a NaN that would corrupt the
+        # histogram and latch the lifetime max
+        spacing = np.spacing(
+            np.maximum(np.abs(np.nan_to_num(want)), np.float32(1.0)).astype(
+                np.float32
+            )
+        ).astype(np.float64)
+        ulp = np.where(valid & ~both_nan, err / spacing, 0.0)
+        ulp = np.where(valid & one_nan, np.inf, ulp)
+        max_ulp = float(np.max(ulp)) if ulp.size else 0.0
+
+        exceeded = bool(max_abs > self.max_abs_err)
+        observation = {
+            'pair': pair,
+            'max_abs_err': max_abs,
+            'max_ulp_err': max_ulp,
+            'band': self.max_abs_err,
+            'exceeded': exceeded,
+            'request_id': exemplar,
+            'n_compared': int(valid.sum()),
+        }
+        labels = {'pair': pair}
+        REGISTRY.histogram('num/parity_abs_err', unit='value').observe(
+            max_abs,
+            exemplar={'request_id': exemplar} if exemplar else None,
+            **labels,
+        )
+        REGISTRY.histogram('num/parity_ulp_err', unit='ulps').observe(
+            max_ulp, **labels
+        )
+        REGISTRY.counter('num/parity_probes', unit='count').inc(1, **labels)
+        with self._lock:
+            self._probes += 1
+            if self._worst is None or max_abs > self._worst:
+                self._worst = max_abs
+            if self._worst_ulp is None or max_ulp > self._worst_ulp:
+                self._worst_ulp = max_ulp
+            if exceeded:
+                self._exceedances += 1
+            self._last = observation
+        if exceeded:
+            REGISTRY.counter('num/parity_exceedances', unit='count').inc(
+                1, **labels
+            )
+            self._note_exceedance(observation)
+        return observation
+
+    def _note_exceedance(self, observation: Dict[str, Any]) -> None:
+        from socceraction_tpu.obs.numerics import record_health_event
+
+        record_health_event('parity_exceeded', observation)
+        if self.on_exceed is not None:
+            try:
+                self.on_exceed(observation)
+            except Exception:
+                pass  # the hook must never kill the probe worker
+
+    # -- introspection / gate input -----------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """The probe's lifetime summary — the learn gate's parity input.
+
+        ``evaluated`` is True once at least one probe completed;
+        ``max_abs_err`` is the worst observed error (None before any
+        probe).
+        """
+        with self._lock:
+            return {
+                'evaluated': self._probes > 0,
+                'probes': self._probes,
+                'max_abs_err': self._worst,
+                'max_ulp_err': self._worst_ulp,
+                'exceedances': self._exceedances,
+                'errors': self._errors,
+                'band': self.max_abs_err,
+                'last': dict(self._last) if self._last else None,
+            }
+
+    def flush(self, timeout: Optional[float] = 30.0) -> bool:
+        """Wait until every submitted probe has been processed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._outstanding == 0:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        """Stop the worker thread (pending probes are processed first)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            thread = self._thread
+        if thread is not None:
+            self._queue.put(None)
+            thread.join(timeout=30.0)

@@ -469,7 +469,7 @@ def fused_pair_logits(
     storm_threshold=16,
     static_argnames=(
         'names', 'k', 'hidden_layers_a', 'hidden_layers_b', 'registry_name',
-        'hidden_dtype_name',
+        'hidden_dtype_name', 'guard',
     ),
 )
 def _pair_probs(
@@ -488,6 +488,7 @@ def _pair_probs(
     hidden_layers_b,
     registry_name,
     hidden_dtype_name=None,
+    guard=False,
 ):
     a, b = fused_pair_logits(
         params_a, params_b, batch, names=names, k=k,
@@ -499,7 +500,20 @@ def _pair_probs(
             jnp.dtype(hidden_dtype_name) if hidden_dtype_name else None
         ),
     )
-    return jax.nn.sigmoid(a), jax.nn.sigmoid(b)
+    out = jax.nn.sigmoid(a), jax.nn.sigmoid(b)
+    if not guard:
+        return out
+    # in-dispatch numeric guard: the nonfinite check runs on the
+    # PROBABILITY outputs — what callers actually consume — because a
+    # ±Inf logit serves a perfectly finite 0/1 through sigmoid (only NaN
+    # propagates); saturated logits (|x| > 88, Inf included) are the
+    # magnitude guard's signal instead. Side-band scalars — the
+    # probability outputs are untouched, and ``guard`` is static so a
+    # fixed setting compiles once per signature (zero steady-state
+    # retraces).
+    from ..obs.numerics import nonfinite_count, overflow_count
+
+    return out + ((nonfinite_count(*out), overflow_count(a, b)),)
 
 
 def fused_pair_probs(
@@ -532,9 +546,12 @@ def fused_pair_probs(
     for clf in (clf_a, clf_b):
         if clf.params is None or clf.mean_ is None or clf.std_ is None:
             raise ValueError('classifier is not fitted')
+    from ..obs import numerics
+
+    guard = numerics.guards_enabled()
     mean_a, std_a = clf_a._device_stats()
     mean_b, std_b = clf_b._device_stats()
-    return _pair_probs(
+    out = _pair_probs(
         clf_a.params,
         clf_b.params,
         mean_a,
@@ -551,7 +568,19 @@ def fused_pair_probs(
         hidden_dtype_name=(
             jnp.dtype(hidden_dtype).name if hidden_dtype is not None else None
         ),
+        guard=guard,
     )
+    if guard:
+        pa, pb, (n_nonfinite, n_overflow) = out
+        # no sync here: the device scalars are stashed for a later
+        # drain_guards() at a point where the dispatch's real outputs
+        # have already been fetched (the serve flush does this per
+        # flush; tracer values — this function inlined under an outer
+        # trace — are skipped inside note_guard)
+        numerics.note_guard('pair_probs', 'probs', n_nonfinite)
+        numerics.note_guard('pair_probs', 'logits', n_overflow, kind='overflow')
+        return pa, pb
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -41,6 +41,8 @@ import pandas as pd
 from ..core.batch import ActionBatch, pack_actions, pad_batch_games, unpack_values
 from ..obs import REGISTRY, counter, gauge, span
 from ..obs.context import RequestContext, new_request_context, record_segment
+from ..obs.numerics import drain_guards
+from ..obs.parity import ParityProbe
 from ..obs.recorder import dump_debug_bundle
 from ..obs.slo import SLOConfig, SLOEngine
 from .batcher import MicroBatcher, Overloaded
@@ -144,6 +146,22 @@ class RatingService:
         that records served traffic (successful ``rate`` submissions and
         committed session ticks) for the continuous-learning loop's
         shadow replay. ``None`` (default) captures nothing.
+    parity : ParityProbe, optional
+        A :class:`~socceraction_tpu.obs.parity.ParityProbe`: a sampled
+        fraction of flushes is re-rated through the materialized
+        reference path **off the flusher thread** and the abs/ulp error
+        recorded per path pair (``num/parity_abs_err{pair=...}`` with
+        the request id as exemplar). A probe past its band fires the
+        rate-limited debug bundle (``reason="parity"``), degrades
+        :meth:`health`, and — through
+        :meth:`~socceraction_tpu.obs.parity.ParityProbe.stats` — feeds
+        the learn gate's fail-closed ``max_parity_err`` input. The
+        probe is closed with the service. ``None`` (default) probes
+        nothing. Independent of the probe, every flush drains the
+        in-dispatch finite guards (:mod:`socceraction_tpu.obs.numerics`):
+        a non-finite value in a served dispatch is counted under
+        ``num/nonfinite_total``, dumps a rate-limited debug bundle
+        (``reason="nonfinite"``) and degrades :meth:`health`.
     debug_dir : str, optional
         Where automatic flight-recorder bundles land
         (:func:`~socceraction_tpu.obs.recorder.dump_debug_bundle` on
@@ -168,6 +186,7 @@ class RatingService:
         slo: Optional[SLOConfig] = None,
         request_deadline_ms: Optional[float] = None,
         capture: Any = None,
+        parity: Optional[ParityProbe] = None,
         debug_dir: Optional[str] = None,
         overload_dump_threshold: int = 64,
         overload_dump_window_s: float = 10.0,
@@ -191,6 +210,20 @@ class RatingService:
         self.max_actions = int(max_actions)
         self.slo_p99_ms = float(slo_p99_ms)
         self.capture = capture
+        self.parity = parity
+        if parity is not None and parity.on_exceed is None:
+            parity.on_exceed = self._on_parity_exceed
+        #: nonfinite guard events drained by THIS service's flushes.
+        #: Scope caveat: the pending-guard ring is process-global and
+        #: only the fused pair path feeds it, so with several services
+        #: (or standalone guarded ``rate_batch`` calls) in one process,
+        #: whichever flush drains first absorbs the event — a NaN
+        #: detected anywhere in the process's rating path degrades the
+        #: draining service. That errs fail-closed on purpose: the
+        #: shared compiled path IS this service's path. Host-recorded
+        #: guards (training, solve_xt) never enter the ring and never
+        #: land here.
+        self._nonfinite_events = 0
         from ..obs.recorder import default_debug_dir
 
         self.debug_dir = debug_dir or default_debug_dir()
@@ -608,6 +641,19 @@ class RatingService:
         t_pad = time.perf_counter()
         values = self._device_rate(host_batch, gs, model, bucket)
         t_dispatch = time.perf_counter()
+        # the dispatch's results are on host now, so its side-band guard
+        # scalars are ready: draining here converts without syncing
+        # anything the flush did not already wait for
+        self._drain_numeric_guards()
+        if self.parity is not None and self.parity.should_sample():
+            self.parity.submit_flush(
+                model, host_batch,
+                gs if self._gs_enabled else None, values,
+                exemplar=next(
+                    (p.ctx.request_id for p in payloads if p.ctx is not None),
+                    None,
+                ),
+            )
 
         results: List[Any] = []
         for i, p in enumerate(payloads):
@@ -641,6 +687,44 @@ class RatingService:
                     pad=pad_s, dispatch=dispatch_s, slice=slice_s
                 )
         return results
+
+    # -- numeric health -----------------------------------------------------
+
+    def _drain_numeric_guards(self) -> None:
+        """Drain pending in-dispatch guards; act on nonzero detections.
+
+        Runs on the flusher thread, after the flush's ``device_get``.
+        A detection is already counted/evented by the drain itself
+        (``num/nonfinite_total`` + ``nonfinite_detected``); the service
+        adds the operational response — the rate-limited debug bundle
+        and the :meth:`health` degradation — for **nonfinite** events
+        only. Overflow events (finite-but-saturating logits) stay a
+        metric-level warning (``num/overflow_guard_total``): the served
+        values were valid probabilities, so they must not flip health or
+        block promotions as if a NaN had shipped.
+        """
+        try:
+            events = drain_guards()
+        except Exception:  # guard telemetry must never fail a flush
+            return
+        bad = [e for e in events if e.kind == 'nonfinite']
+        if not bad:
+            return
+        with self._dump_lock:
+            self._nonfinite_events += len(bad)
+        self._maybe_dump(
+            'nonfinite',
+            {
+                'type': 'nonfinite_dispatch',
+                'events': [e.to_dict() for e in bad],
+            },
+        )
+
+    def _on_parity_exceed(self, observation: Dict[str, Any]) -> None:
+        """Parity-probe band breach: dump the flight recorder (rate-limited)."""
+        self._maybe_dump(
+            'parity', {'type': 'parity_exceeded', 'observation': observation}
+        )
 
     # -- flight recorder + health ------------------------------------------
 
@@ -714,11 +798,15 @@ class RatingService:
 
         Reads only host state and the typed metric snapshot — no device
         work, safe on any thread at any rate. Keys: ``status``
-        (``'ok'`` | ``'flusher-dead'``), the queue state
-        (depth/bounds/last-flush age), the active model
+        (``'ok'`` | ``'degraded'`` | ``'flusher-dead'``), the queue
+        state (depth/bounds/last-flush age), the active model
         ``{'name', 'version'}``, compiled-shape budget vs. ladder, the
-        measured request p99 vs. the ``slo_p99_ms`` budget, rejection
-        and debug-dump totals, and ``last_dump`` (path or None).
+        measured request p99 vs. the ``slo_p99_ms`` budget, the
+        ``numerics`` block (in-dispatch guard detections + parity-probe
+        stats — ``status`` degrades to ``'degraded'`` when this
+        service's flushes detected non-finite values or a parity probe
+        breached its band), rejection and debug-dump totals, and
+        ``last_dump`` (path or None).
         """
         snap = REGISTRY.snapshot()
         # worst p99 across traffic kinds (rate AND session) — a
@@ -748,9 +836,26 @@ class RatingService:
                 self._slo.should_shed('rate')[0]
                 or self._slo.should_shed('session')[0]
             )
+        with self._dump_lock:
+            nonfinite_events = self._nonfinite_events
+        parity_stats = self.parity.stats() if self.parity is not None else None
+        numerics_ok = nonfinite_events == 0 and (
+            parity_stats is None or parity_stats['exceedances'] == 0
+        )
+        if not state['flusher_alive']:
+            status = 'flusher-dead'
+        elif not numerics_ok:
+            status = 'degraded'
+        else:
+            status = 'ok'
         return {
-            'status': 'ok' if state['flusher_alive'] else 'flusher-dead',
+            'status': status,
             **state,
+            'numerics': {
+                'ok': numerics_ok,
+                'nonfinite_events': nonfinite_events,
+                'parity': parity_stats,
+            },
             'model': {'name': name, 'version': version},
             'ladder': list(self.ladder),
             'compiled_shapes': self.compiled_shapes,
@@ -785,8 +890,15 @@ class RatingService:
         return buckets
 
     def close(self, *, drain: bool = True) -> None:
-        """Flush (or fail) queued requests and stop the flusher thread."""
+        """Flush (or fail) queued requests and stop the flusher thread.
+
+        The parity probe (when attached) is closed too — its pending
+        probes are processed first, so a smoke run's last sampled flush
+        is never lost.
+        """
         self._batcher.close(drain=drain)
+        if self.parity is not None:
+            self.parity.close()
 
     def __enter__(self) -> 'RatingService':
         return self
@@ -806,6 +918,22 @@ class RatingService:
         """Distinct ``(bucket, max_actions)`` shapes dispatched so far."""
         with self._shape_lock:
             return len(self._seen_shapes)
+
+    @property
+    def nonfinite_events(self) -> int:
+        """Nonfinite in-dispatch guard events drained by this service.
+
+        Anything above zero means a NaN reached values served through
+        the process's rating path (see the scope caveat on the backing
+        counter: the guard ring is process-global) — the learn gate's
+        numerics input reads this (fail closed with
+        ``GateConfig(max_parity_err=)`` set: traffic served, and
+        captured, by a non-finite dispatch is not promotion evidence).
+        Overflow (saturating-but-finite logits) is excluded — it counts
+        under ``num/overflow_guard_total`` without degrading health.
+        """
+        with self._dump_lock:
+            return self._nonfinite_events
 
 
 def _pad_to_bucket(

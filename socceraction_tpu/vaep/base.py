@@ -738,6 +738,35 @@ class VAEP:
             )
         return values
 
+    def rate_batch_reference(
+        self,
+        batch: ActionBatch,
+        *,
+        dense_overrides: Optional[Dict[str, Any]] = None,
+    ) -> jax.Array:
+        """Materialized-path rating of a batch — the numerics parity oracle.
+
+        The same function of the same parameters as :meth:`rate_batch`,
+        always computed through the materialized feature tensor
+        regardless of the platform profile's path choice — no
+        bucketing, no telemetry, no path selection. This is what the
+        sampled shadow-parity probe
+        (:class:`socceraction_tpu.obs.parity.ParityProbe`) re-rates
+        served flushes through off the flusher thread; values on
+        padding rows are garbage by contract (mask with ``batch.mask``).
+        """
+        if not self._models:
+            raise NotFittedError('fit the model before calling rate')
+        feats = self.compute_features_batch(batch)
+        if dense_overrides:
+            feats = self._apply_dense_overrides(batch, feats, dense_overrides)
+        probs = self._estimate_probabilities_batch(feats)
+        return self._formula_kernel(
+            batch,
+            probs[self._label_columns[0]],
+            probs[self._label_columns[1]],
+        )
+
     def score(self, X: pd.DataFrame, y: pd.DataFrame) -> Dict[str, Dict[str, float]]:
         """Brier score and ROC-AUC of both probability models."""
         if not self._models:

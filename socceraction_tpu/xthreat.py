@@ -438,11 +438,19 @@ class ExpectedThreat:
 
     def _take_solution(self, sol: '_xtops.XTSolution') -> None:
         """Adopt a single-grid :class:`~socceraction_tpu.ops.xt.XTSolution`."""
+        from .obs.numerics import record_nonfinite
+
         self.xT = np.asarray(sol.grid, dtype=np.float64)
         self.n_iter = int(sol.iterations)
         r = float(sol.residual)
         self.solve_residual = r if math.isfinite(r) else None
         self.converged = bool(sol.converged)
+        # numeric guard on the certificate the fit already materialized
+        # for its own metrics (host arrays — zero extra device work): a
+        # non-finite surface or residual is counted into num/* and
+        # recorded as a nonfinite_detected event
+        record_nonfinite('solve_xt', 'grid', int(np.sum(~np.isfinite(self.xT))))
+        record_nonfinite('solve_xt', 'residual', int(not math.isfinite(r)))
 
     def _fit_jax(self, batch: 'ActionBatch', variant: str) -> None:
         if self.solver == 'matrix-free':
@@ -573,6 +581,17 @@ class ExpectedThreat:
         worst = float(self.solve_residual_per_grid_.max())
         self.solve_residual = worst if math.isfinite(worst) else None
         self.converged = bool(self.converged_per_grid_.all())
+        # fleet-wide numeric guard over the certificate arrays the fit
+        # just materialized (host-side — zero extra device work)
+        from .obs.numerics import record_nonfinite
+
+        record_nonfinite(
+            'solve_xt', 'grid', int(np.sum(~np.isfinite(self.grids_)))
+        )
+        record_nonfinite(
+            'solve_xt', 'residual',
+            int(np.sum(~np.isfinite(self.solve_residual_per_grid_))),
+        )
         # the single-surface slot stays zeroed: grouped models rate
         # through the stack (``rate``/``surface``)
         self.xT = np.zeros((self.w, self.l))

@@ -103,6 +103,15 @@ def test_slo_and_drift_areas_are_registered():
     assert 'segment' in tool.KNOWN_LABELS['serve']
 
 
+def test_num_area_and_labels_are_registered():
+    """The numerics observatory's metric area (``num/*``: in-dispatch
+    guards + parity probes) and its label contract are governed by the
+    lint gate from day one (ISSUE 9 satellite)."""
+    tool = _tool()
+    assert 'num' in tool.KNOWN_AREAS
+    assert tool.KNOWN_LABELS['num'] == {'fn', 'output', 'pair'}
+
+
 def test_gate_reports_all_violations_per_site(tmp_path):
     """One site breaking several rules surfaces every violation in one
     run — not one per fix-and-rerun cycle (ISSUE 8 satellite)."""

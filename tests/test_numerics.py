@@ -1,0 +1,596 @@
+"""Tests for the numerics observatory (obs.numerics / obs.parity).
+
+Covers the ISSUE-9 contract: in-dispatch finite guards on the fused
+pair path and the epoch trainer (counts into governed ``num/*``
+metrics, no steady-state retraces, detection end-to-end through the
+serving layer — counters, debug bundle, ``health()`` degradation), the
+sampled shadow-parity probe (fused vs materialized ≤ 1e-5 on CPU,
+exceedance events + hook, the ``incremental_vs_replay`` pair), the
+fail-closed ``GateConfig(max_parity_err=)`` input, the continuous
+learner's rejection of a diverging incremental retrain, the ``obsctl
+numerics`` round-trip, obsctl's one-line missing-runlog errors, and the
+``bench_history`` ledger + ``tools/benchdiff.py`` verdicts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import io
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import (
+    synthetic_actions_frame,
+    write_synthetic_season,
+)
+from socceraction_tpu.ml.mlp import MLPClassifier
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs import numerics as num
+from socceraction_tpu.obs.parity import ParityProbe
+from socceraction_tpu.obs.recorder import RECORDER
+from socceraction_tpu.serve import RatingService
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 256
+
+
+@pytest.fixture(scope='module', autouse=True)
+def _drain_pair_probs_storm_window():
+    """Retire this module's pair-path compiles from the storm window.
+
+    Same rationale as ``tests/test_learn.py``: this module compiles
+    several serving ladders; left in the detector's 60 s rolling window
+    they could push a later module's controlled warmup over the storm
+    threshold by test adjacency.
+    """
+    yield
+    from socceraction_tpu.ops.fused import _pair_probs
+
+    with _pair_probs._lock:
+        _pair_probs._recent.clear()
+
+
+@pytest.fixture(autouse=True)
+def _clean_pending():
+    """Pending guards from other tests must not leak into assertions."""
+    num.clear_pending()
+    yield
+    num.clear_pending()
+
+
+def _fit_model(hidden=(16,), seed_games=(0, 1)):
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=200)
+        for i in seed_games
+    ]
+    model = VAEP()
+    X, y = [], []
+    for i, f in zip(seed_games, frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True),
+        pd.concat(y, ignore_index=True),
+        learner='mlp',
+        tree_params={'hidden': hidden, 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+def _poisoned(model):
+    """A copy-ish of ``model`` with one NaN in a head's first layer."""
+    bad = _fit_model()
+    head = bad._models['scores']
+    params = jax.tree.map(lambda a: np.array(a), head.params)
+    params['params']['Dense_0']['kernel'][0, 0] = np.nan
+    head.params = jax.tree.map(jnp.asarray, params)
+    return bad
+
+
+def _value(snap_name, **labels):
+    return REGISTRY.snapshot().value(snap_name, **labels)
+
+
+# ------------------------------------------------------- guard reductions ----
+
+
+def test_nonfinite_and_overflow_counts_in_jit():
+    @jax.jit
+    def f(x):
+        return num.nonfinite_count(x), num.overflow_count(x, limit=10.0)
+
+    x = jnp.asarray([1.0, np.nan, np.inf, -np.inf, 11.0, -12.0, 3.0])
+    nf, ov = f(x)
+    assert int(nf) == 3
+    # ±inf count as overflow (terminal saturation) — NaN does not
+    # (IEEE comparison is False; NaN is the nonfinite guard's signal)
+    assert int(ov) == 4
+
+
+def test_note_and_drain_records_only_nonzero():
+    before = _value('num/nonfinite_total', fn='t_fn', output='t_out')
+    num.note_guard('t_fn', 't_out', 0)
+    num.note_guard('t_fn', 't_out', 3)
+    num.note_guard('t_fn', 't_ovf', 2, kind='overflow')
+    events = num.drain_guards()
+    assert {(e.kind, e.count) for e in events} == {
+        ('nonfinite', 3), ('overflow', 2),
+    }
+    assert _value('num/nonfinite_total', fn='t_fn', output='t_out') == before + 3
+    assert _value('num/overflow_guard_total', fn='t_fn') >= 2
+    # the nonzero detection is on the flight recorder too
+    kinds = [e['kind'] for e in RECORDER.events()]
+    assert 'nonfinite_detected' in kinds
+    # a second drain is empty (the ring was consumed)
+    assert num.drain_guards() == []
+
+
+def test_pending_ring_is_bounded():
+    ring = num._PendingGuards(capacity=4)
+    for i in range(10):
+        ring.note('f', 'o', 'nonfinite', 0)
+    assert len(ring) == 4
+    assert ring.dropped == 6
+
+
+def test_tracer_values_are_skipped():
+    num.clear_pending()
+
+    @jax.jit
+    def f(x):
+        # a guarded function inlined under an outer trace hands
+        # note_guard a tracer — it must not be stashed
+        num.note_guard('traced', 'out', jnp.sum(x).astype(jnp.int32))
+        return x
+
+    f(jnp.ones(3))
+    assert num.pending_guards() == 0
+
+
+def test_record_nonfinite_zero_is_noop():
+    assert num.record_nonfinite('f', 'o', 0) is None
+    assert num.record_overflow('f', 0) is None
+
+
+# ----------------------------------------------------- pair_probs guard ----
+
+
+def test_clean_rate_batch_notes_guards_and_drains_empty(model):
+    frame = synthetic_actions_frame(game_id=9, seed=9, n_actions=80)
+    batch = model._pack(frame, HOME)
+    model.rate_batch(batch)
+    assert num.pending_guards() >= 1  # nonfinite + overflow scalars noted
+    assert num.drain_guards() == []  # clean model: nothing recorded
+
+
+def test_guard_outputs_do_not_change_probabilities(model):
+    frame = synthetic_actions_frame(game_id=10, seed=10, n_actions=60)
+    batch = model._pack(frame, HOME)
+    a = np.asarray(model.rate_batch(batch, bucket=False))
+    b = np.asarray(model.rate_batch_reference(batch))
+    mask = np.asarray(batch.mask)[..., None]
+    assert np.max(np.abs(np.where(mask, a - b, 0.0))) <= 1e-5
+
+
+# --------------------------------------------------- serve detection e2e ----
+
+
+def test_serve_nonfinite_detection_end_to_end(tmp_path):
+    """The ISSUE-9 acceptance path: an injected non-finite value in a
+    serve flush is counted in ``num/*``, dumps a debug bundle and
+    degrades ``health()``."""
+    bad = _poisoned(_fit_model())
+    before = _value('num/nonfinite_total', fn='pair_probs', output='probs')
+    dumps_before = _value('serve/debug_dumps', reason='nonfinite')
+    with RatingService(
+        bad, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        debug_dir=str(tmp_path / 'debug'),
+    ) as svc:
+        frame = synthetic_actions_frame(game_id=20, seed=20, n_actions=90)
+        out = svc.rate(frame, home_team_id=HOME).result(timeout=60)
+        assert np.isnan(out.to_numpy()).any()  # the dispatch WAS poisoned
+        health = svc.health()
+        assert health['status'] == 'degraded'
+        assert health['numerics']['ok'] is False
+        assert health['numerics']['nonfinite_events'] >= 1
+        assert svc.last_dump_path is not None
+        assert os.path.exists(svc.last_dump_path)
+    assert _value('num/nonfinite_total', fn='pair_probs', output='probs') > before
+    assert _value('serve/debug_dumps', reason='nonfinite') == dumps_before + 1
+    kinds = [e['kind'] for e in RECORDER.events()]
+    assert 'nonfinite_detected' in kinds
+
+
+def test_overflow_guard_does_not_degrade_health(model):
+    """Saturating-but-finite logits are a metric-level warning: the
+    served values were valid probabilities, so health must stay 'ok'
+    and no nonfinite bundle fires."""
+    num.note_guard('pair_probs', 'logits', 5, kind='overflow')
+    before = _value('num/overflow_guard_total', fn='pair_probs')
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        frame = synthetic_actions_frame(game_id=25, seed=25, n_actions=60)
+        svc.rate(frame, home_team_id=HOME).result(timeout=60)
+        health = svc.health()
+        assert health['status'] == 'ok'
+        assert health['numerics']['nonfinite_events'] == 0
+    assert _value('num/overflow_guard_total', fn='pair_probs') == before + 5
+
+
+def test_guards_zero_overhead_on_steady_state(model):
+    """Guards enabled (the default) ⇒ the compiled-shape plateau and the
+    zero-steady-state-retrace contract hold unchanged."""
+    assert num.guards_enabled()
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        shapes = svc.compiled_shapes
+        compiles = _value('xla/compiles', fn='pair_probs')
+        frames = [
+            synthetic_actions_frame(game_id=30 + i, seed=30 + i, n_actions=n)
+            for i, n in enumerate((50, 120, 200, 90))
+        ]
+        for _ in range(3):
+            for f in frames:
+                svc.rate(f, home_team_id=HOME).result(timeout=60)
+        assert svc.compiled_shapes == shapes
+        assert _value('xla/compiles', fn='pair_probs') == compiles
+
+
+# ------------------------------------------------------- training health ----
+
+
+def test_epoch_trainer_health_clean():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (rng.random(256) > 0.5).astype(np.float32)
+    clf = MLPClassifier(hidden=(8,), max_epochs=2, batch_size=64)
+    clf.fit(X, y)
+    h = clf.train_health_
+    assert h['finite'] is True
+    assert h['epochs'] == 2
+    assert h['nonfinite_steps'] == 0
+    assert h['grad_norm_last'] > 0
+    assert np.isfinite(h['weight_norm_last'])
+    # per-epoch norm telemetry landed
+    snap = REGISTRY.snapshot()
+    s = snap.series('train/grad_norm', path='materialized', platform='cpu')
+    assert s is not None and s.count >= 2
+
+
+def test_epoch_trainer_detects_nonfinite_steps():
+    """A NaN injected into a training epoch is counted the whole way:
+    the per-step guard, ``train/nonfinite_loss``, the ``num/*`` counter
+    and the ``finite=False`` verdict."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (rng.random(256) > 0.5).astype(np.float32)
+    X[3, 2] = np.nan
+    before = _value('num/nonfinite_total', fn='train_epoch', output='loss')
+    clf = MLPClassifier(hidden=(8,), max_epochs=2, batch_size=64)
+    clf.fit(X, y)
+    h = clf.train_health_
+    assert h['finite'] is False
+    assert h['nonfinite_steps'] >= 1
+    assert _value('train/nonfinite_loss', path='materialized', platform='cpu') >= 1
+    assert _value('num/nonfinite_total', fn='train_epoch', output='loss') > before
+
+
+def test_epoch_trainer_detects_weight_blowup():
+    """A diverging schedule with no NaN step still fails the verdict:
+    the post-epoch weight norm goes inf."""
+    rng = np.random.default_rng(0)
+    X = np.abs(rng.normal(size=(256, 8)).astype(np.float32))
+    y = (rng.random(256) > 0.5).astype(np.float32)
+    clf = MLPClassifier(
+        hidden=(8,), max_epochs=3, batch_size=64, learning_rate=1e20
+    )
+    clf.fit(X, y)
+    assert clf.train_health_['finite'] is False
+
+
+def test_learner_rejects_diverged_candidate(tmp_path):
+    """The loop-level acceptance: a diverging incremental retrain is
+    rejected with a typed report (and a debug bundle) BEFORE the shadow
+    gate can score NaN probabilities."""
+    from socceraction_tpu.learn import ContinuousLearner, LearnConfig
+    from socceraction_tpu.pipeline.store import SeasonStore
+    from socceraction_tpu.serve import ModelRegistry
+
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=2, n_actions=128, seed=0)
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    registry.publish('vaep', '1', _fit_model())
+    registry.activate('vaep', '1')
+    debug_dir = str(tmp_path / 'debug')
+    with SeasonStore(store_path, mode='a') as store:
+        learner = ContinuousLearner(
+            store, registry,
+            config=LearnConfig(
+                max_actions=128, games_per_batch=2, warm_start=False,
+                debug_dir=debug_dir, fallback_replay_games=2,
+                train_params={
+                    'hidden': (8,), 'max_epochs': 3, 'batch_size': 256,
+                    'learning_rate': 1e20,  # guaranteed blowup
+                },
+            ),
+            prime_watcher=False,  # the stored games count as new
+        )
+        report = learner.run_once()
+    assert report.verdict == 'rejected'
+    assert any('training diverged' in r for r in report.reasons)
+    assert report.candidate_version is None
+    assert registry.active()[:2] == ('vaep', '1')  # the active model held
+    assert glob.glob(os.path.join(debug_dir, 'debug-*.tar.gz'))
+    assert _value('learn/training_diverged') >= 1
+
+
+# ----------------------------------------------------------- parity probe ----
+
+
+def test_parity_probe_matches_reference_via_service(model):
+    probe = ParityProbe(sample_rate=1.0, max_abs_err=1e-4)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        parity=probe,
+    ) as svc:
+        frame = synthetic_actions_frame(game_id=40, seed=40, n_actions=100)
+        fut = svc.rate(frame, home_team_id=HOME)
+        fut.result(timeout=60)
+        assert probe.flush(timeout=60)
+        stats = probe.stats()
+        assert stats['evaluated'] and stats['probes'] >= 1
+        assert stats['max_abs_err'] <= 1e-5
+        assert stats['exceedances'] == 0
+        # the error histogram carries the request id as its exemplar
+        s = REGISTRY.snapshot().series(
+            'num/parity_abs_err', pair='fused_vs_materialized'
+        )
+        assert s is not None and s.count >= 1
+        assert s.exemplar and s.exemplar.get('request_id') == fut.request_id
+        assert svc.health()['numerics']['parity']['probes'] >= 1
+    # close() closed the probe: further sampling is off
+    assert probe.should_sample() is False
+
+
+def test_parity_probe_exceedance_fires_hook_and_events():
+    hits = []
+    probe = ParityProbe(sample_rate=1.0, max_abs_err=1e-6, on_exceed=hits.append)
+    want = np.zeros((2, 8, 3), np.float32)
+    got = want.copy()
+    got[0, 1, 2] = 0.5
+    before = _value('num/parity_exceedances', pair='fused_vs_materialized')
+    obs = probe.compare(
+        'fused_vs_materialized', got, want,
+        mask=np.ones((2, 8), bool), exemplar='req-1',
+    )
+    assert obs['exceeded'] and obs['max_abs_err'] == 0.5
+    assert probe.stats()['exceedances'] == 1
+    assert hits and hits[0]['request_id'] == 'req-1'
+    assert (
+        _value('num/parity_exceedances', pair='fused_vs_materialized')
+        == before + 1
+    )
+    assert 'parity_exceeded' in [e['kind'] for e in RECORDER.events()]
+
+
+def test_parity_mask_excludes_padding_and_nan_semantics():
+    probe = ParityProbe(sample_rate=1.0, max_abs_err=1e-6)
+    got = np.zeros((1, 4, 3), np.float32)
+    want = np.zeros((1, 4, 3), np.float32)
+    got[0, 3] = 99.0  # padded row: garbage by contract
+    mask = np.array([[True, True, True, False]])
+    assert probe.compare('incremental_vs_replay', got, want, mask=mask)[
+        'max_abs_err'
+    ] == 0.0
+    # NaN on both sides agrees; NaN on one side is maximal disagreement
+    got[0, 0, 0] = np.nan
+    want[0, 0, 0] = np.nan
+    assert probe.compare('incremental_vs_replay', got, want, mask=mask)[
+        'max_abs_err'
+    ] == 0.0
+    want[0, 0, 0] = 1.0
+    one_sided = probe.compare('incremental_vs_replay', got, want, mask=mask)
+    assert np.isinf(one_sided['max_abs_err'])
+    # a one-sided NaN on the REFERENCE side must be inf in ULP too —
+    # never a NaN that corrupts the histogram and latches the max
+    got2 = np.zeros((1, 4, 3), np.float32)
+    want2 = np.zeros((1, 4, 3), np.float32)
+    want2[0, 0, 0] = np.nan
+    ref_nan = probe.compare('incremental_vs_replay', got2, want2, mask=mask)
+    assert np.isinf(ref_nan['max_abs_err']) and np.isinf(ref_nan['max_ulp_err'])
+    assert np.isfinite(probe.stats()['probes'])
+    # the second governed pair records under its own label
+    s = REGISTRY.snapshot().series(
+        'num/parity_probes', pair='incremental_vs_replay'
+    )
+    assert s is not None and s.total >= 3
+
+
+def test_parity_sampling_is_deterministic():
+    probe = ParityProbe(sample_rate=0.25, max_abs_err=1.0)
+    decisions = [probe.should_sample() for _ in range(8)]
+    assert decisions == [True, False, False, False, True, False, False, False]
+    assert ParityProbe(sample_rate=0.0).should_sample() is False
+
+
+# -------------------------------------------------------------- learn gate ----
+
+
+def test_gate_parity_band_fails_closed():
+    from socceraction_tpu.learn import GateConfig, evaluate_gate
+
+    cfg = GateConfig(max_parity_err=1e-4)
+    # no probe stats at all → fail closed, even at bootstrap
+    passed, reasons = evaluate_gate(None, {}, cfg, parity=None)
+    assert not passed and any('parity' in r for r in reasons)
+    # evaluated but past the band → blocked with the measured numbers
+    bad = {'evaluated': True, 'probes': 3, 'max_abs_err': 5e-3}
+    passed, reasons = evaluate_gate(None, {}, cfg, parity=bad)
+    assert not passed and any('diverged' in r for r in reasons)
+    # within band → the bootstrap pass-through still applies
+    good = {'evaluated': True, 'probes': 3, 'max_abs_err': 2e-7}
+    passed, reasons = evaluate_gate(None, {}, cfg, parity=good)
+    assert passed
+    # a non-finite value detected in a serve flush fails the gate closed
+    # even when the path-pair parity itself is fine (NaN vs NaN agrees)
+    poisoned = {**good, 'serve_nonfinite_events': 3}
+    passed, reasons = evaluate_gate(None, {}, cfg, parity=poisoned)
+    assert not passed and any('non-finite dispatch' in r for r in reasons)
+    # without the band the input is ignored entirely
+    passed, _ = evaluate_gate(None, {}, GateConfig(), parity=None)
+    assert passed
+
+
+def test_promotion_report_carries_parity():
+    from socceraction_tpu.learn.gate import PromotionReport
+
+    report = PromotionReport(
+        name='vaep', verdict='rejected',
+        parity={'evaluated': True, 'max_abs_err': 1e-3},
+    )
+    assert report.to_dict()['parity']['max_abs_err'] == 1e-3
+
+
+# ------------------------------------------------------ obsctl round trip ----
+
+
+def test_obsctl_numerics_round_trip(model, tmp_path):
+    from socceraction_tpu.obs.trace import RunLog
+    from tools.obsctl import main as obsctl_main
+
+    runlog = str(tmp_path / 'obs.jsonl')
+    probe = ParityProbe(sample_rate=1.0, max_abs_err=1e-4)
+    with RunLog(runlog):
+        with RatingService(
+            model, max_actions=MAX_ACTIONS, max_batch_size=4,
+            max_wait_ms=1.0, parity=probe,
+        ) as svc:
+            frame = synthetic_actions_frame(game_id=50, seed=50, n_actions=80)
+            svc.rate(frame, home_team_id=HOME).result(timeout=60)
+            assert probe.flush(timeout=60)
+        # a host-recorded guard event must round-trip too
+        num.record_nonfinite('t_roundtrip', 'out', 2)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert obsctl_main(['numerics', runlog, '--json']) == 0
+    summary = json.loads(buf.getvalue())
+    pairs = {row['pair']: row for row in summary['parity']}
+    fused = pairs['fused_vs_materialized']
+    assert fused['probes'] >= 1
+    # the registry series is process-lifetime (other tests may have fed
+    # it); the round-trip contract is that the numbers and the exemplar
+    # survive the snapshot → obsctl path intact
+    assert fused['max_abs_err'] is not None
+    assert probe.stats()['max_abs_err'] <= 1e-5
+    assert any(
+        row['fn'] == 't_roundtrip' and row['total'] >= 2
+        for row in summary['nonfinite']
+    )
+    assert any(
+        e.get('event') == 'nonfinite_detected' for e in summary['events']
+    )
+    # the human rendering exits 0 too
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert obsctl_main(['numerics', runlog]) == 0
+
+
+def test_obsctl_missing_runlog_one_line_error(capsys):
+    from tools.obsctl import main as obsctl_main
+
+    for argv in (
+        ['tail', '/no/such/runlog.jsonl'],
+        ['trace', 'rid-1', '/no/such/runlog.jsonl'],
+        ['numerics', '/no/such/runlog.jsonl'],
+        ['promotions', '/no/such/runlog.jsonl'],
+    ):
+        assert obsctl_main(argv) == 1
+        err = capsys.readouterr().err
+        assert err.count('\n') == 1  # ONE line, not a traceback
+        assert 'cannot read' in err and '/no/such/runlog.jsonl' in err
+
+
+# ------------------------------------------------- bench ledger + diff ----
+
+
+def test_bench_persist_artifact_appends_ledger(tmp_path, monkeypatch):
+    import bench
+
+    hist = str(tmp_path / 'hist')
+    monkeypatch.setenv('SOCCERACTION_TPU_BENCH_HISTORY', hist)
+    bench._persist_artifact({'metric': 'm', 'value': 1.0, 'platform': 'cpu'})
+    bench._persist_artifact({'metric': 'm', 'value': 2.0, 'platform': 'cpu'})
+    lines = open(os.path.join(hist, 'ledger.jsonl')).read().splitlines()
+    assert len(lines) == 2
+    entries = [json.loads(l) for l in lines]
+    assert entries[0]['value'] == 1.0 and entries[1]['value'] == 2.0
+    assert all('recorded_unix' in e for e in entries)
+    # disabled via empty override: nothing is written, nothing raises
+    monkeypatch.setenv('SOCCERACTION_TPU_BENCH_HISTORY', '')
+    bench._persist_artifact({'metric': 'm', 'value': 3.0})
+    assert len(open(os.path.join(hist, 'ledger.jsonl')).read().splitlines()) == 2
+
+
+def test_benchdiff_verdicts_and_exit_codes(tmp_path):
+    from tools.benchdiff import compare_artifacts, main as benchdiff_main
+
+    old = {
+        'metric': 'vaep_rate_actions_per_sec', 'platform': 'cpu',
+        'value': 100.0, 'fused_actions_per_sec': 100.0,
+    }
+    new_ok = {**old, 'value': 97.0, 'fused_actions_per_sec': 96.0}
+    new_bad = {**old, 'value': 50.0, 'fused_actions_per_sec': 50.0}
+
+    res = compare_artifacts(old, new_ok)
+    assert res['regressions'] == 0
+    assert all(v['verdict'] == 'ok' for v in res['verdicts'])
+    res = compare_artifacts(old, new_bad)
+    assert res['regressions'] == 2
+    # the headline 'value' verdict is named after the artifact's metric
+    assert res['verdicts'][0]['rate'] == 'vaep_rate_actions_per_sec'
+    # cross-platform comparisons are refused, not scored
+    res = compare_artifacts(old, {**new_ok, 'platform': 'tpu'})
+    assert 'incomparable' in res
+
+    a, b = str(tmp_path / 'a.json'), str(tmp_path / 'b.json')
+    for path, entry in ((a, old), (b, new_bad)):
+        with open(path, 'w') as f:
+            json.dump(entry, f)
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert benchdiff_main([a, b]) == 1  # regression → exit 1
+        assert benchdiff_main([a, a]) == 0  # self-compare → ok
+    # ledger mode: the newest entry vs the latest SAME-metric entry —
+    # an interleaved other-metric line between them must be skipped
+    ledger = str(tmp_path / 'ledger.jsonl')
+    other = {'metric': 'serve_requests_per_sec', 'platform': 'cpu', 'value': 9}
+    with open(ledger, 'w') as f:
+        for entry in (old, other, new_ok):
+            f.write(json.dumps(entry) + '\n')
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert benchdiff_main([ledger, '--json']) == 0
+    res = json.loads(buf.getvalue())
+    assert res['regressions'] == 0 and res['verdicts']
+    # a too-short ledger is a usage error (exit 2), not a crash
+    short = str(tmp_path / 'short.jsonl')
+    with open(short, 'w') as f:
+        f.write(json.dumps(old) + '\n')
+    assert benchdiff_main([short]) == 2
+    assert benchdiff_main([str(tmp_path / 'missing.jsonl')]) == 2

@@ -1,0 +1,247 @@
+"""Two-artifact bench regression verdicts over the ledger's headline rates.
+
+``bench.py`` appends every artifact it emits to the append-only
+``bench_history/ledger.jsonl`` (one JSON object per line, newest last —
+the repo's measured performance trajectory). This tool turns any two
+artifacts into a regression verdict::
+
+    python tools/benchdiff.py                      # last two ledger entries
+    python tools/benchdiff.py old.json new.json    # two artifact files
+    python tools/benchdiff.py --json               # machine-readable
+    python tools/benchdiff.py --threshold 0.05     # tighter band
+
+An artifact argument may be a JSON object file (one ``bench.py`` output)
+or a JSONL ledger (the newest entry is used; with a single ledger
+argument the newest entry is compared against the most recent PREVIOUS
+entry with the same metric+platform — ``make bench-smoke`` interleaves
+several metrics in one ledger, and "the last two lines" would pair a
+serve sweep with an xT sweep). The verdicts cover the
+headline rate keys both artifacts carry (``value`` — the artifact's own
+headline metric — plus the per-path rates like
+``fused_actions_per_sec``): ``regression`` when the new rate dropped
+more than ``--threshold`` (default 10%) below the old, ``improvement``
+when it rose past the same band, ``ok`` between. Artifacts measured on
+different platforms or with different headline metrics are refused as
+``incomparable`` (comparing a TPU run against its CPU fallback would
+manufacture a regression).
+
+Exit codes: 0 all ok/improved, 1 at least one regression, 2 unusable
+input. Wired as ``make bench-diff``; dependency-free (stdlib only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+__all__ = ['HEADLINE_KEYS', 'compare_artifacts', 'main']
+
+#: Rate keys compared when present in BOTH artifacts (all higher-is-better).
+HEADLINE_KEYS: Tuple[str, ...] = (
+    'value',
+    'fused_actions_per_sec',
+    'materialized_actions_per_sec',
+    'fused_bf16_actions_per_sec',
+    'peak_requests_per_sec',
+    'peak_actions_per_sec',
+)
+
+
+def default_ledger() -> str:
+    """The repo ledger path (``SOCCERACTION_TPU_BENCH_HISTORY`` override)."""
+    hist = os.environ.get(
+        'SOCCERACTION_TPU_BENCH_HISTORY', os.path.join(REPO, 'bench_history')
+    )
+    return os.path.join(hist, 'ledger.jsonl')
+
+
+def _read_entries(path: str) -> List[Dict[str, Any]]:
+    """Artifacts from ``path``: a JSON object file or a JSONL ledger."""
+    with open(path, encoding='utf-8') as fh:
+        text = fh.read()
+    stripped = text.strip()
+    if not stripped:
+        return []
+    try:
+        obj = json.loads(stripped)
+        if isinstance(obj, dict):
+            return [obj]
+    except json.JSONDecodeError:
+        pass
+    entries = []
+    for line in stripped.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entry = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # a torn tail line in a live ledger is expected
+        if isinstance(entry, dict):
+            entries.append(entry)
+    return entries
+
+
+def _label(entry: Dict[str, Any]) -> str:
+    ts = entry.get('recorded_unix')
+    metric = entry.get('metric', '?')
+    platform = entry.get('platform', '?')
+    stamp = f'@{ts:.0f}' if isinstance(ts, (int, float)) else ''
+    return f'{metric}[{platform}]{stamp}'
+
+
+def compare_artifacts(
+    old: Dict[str, Any], new: Dict[str, Any], threshold: float = 0.10
+) -> Dict[str, Any]:
+    """Per-rate verdicts between two artifacts (see module docstring)."""
+    result: Dict[str, Any] = {
+        'old': _label(old),
+        'new': _label(new),
+        'threshold': threshold,
+        'verdicts': [],
+        'regressions': 0,
+        'improvements': 0,
+    }
+    if old.get('metric') != new.get('metric') or old.get('platform') != new.get(
+        'platform'
+    ):
+        result['incomparable'] = (
+            f'artifacts measure different things: {_label(old)} vs '
+            f'{_label(new)} — regression math across metrics/platforms '
+            'is meaningless'
+        )
+        return result
+    for key in HEADLINE_KEYS:
+        a, b = old.get(key), new.get(key)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        if a <= 0:
+            continue  # a degraded/zero baseline cannot anchor a ratio
+        ratio = b / a
+        if ratio < 1.0 - threshold:
+            verdict = 'regression'
+            result['regressions'] += 1
+        elif ratio > 1.0 + threshold:
+            verdict = 'improvement'
+            result['improvements'] += 1
+        else:
+            verdict = 'ok'
+        name = new.get('metric', key) if key == 'value' else key
+        result['verdicts'].append(
+            {
+                'rate': name,
+                'old': a,
+                'new': b,
+                'ratio': round(ratio, 4),
+                'verdict': verdict,
+            }
+        )
+    return result
+
+
+def _render(result: Dict[str, Any]) -> None:
+    if 'incomparable' in result:
+        print(f'benchdiff: INCOMPARABLE - {result["incomparable"]}')
+        return
+    print(f'benchdiff: {result["old"]}  ->  {result["new"]}')
+    for v in result['verdicts']:
+        print(
+            f'  {v["verdict"].upper().ljust(11)} {v["rate"]}: '
+            f'{v["old"]:g} -> {v["new"]:g} (x{v["ratio"]:.3f})'
+        )
+    print(
+        f'benchdiff: {len(result["verdicts"])} rate(s), '
+        f'{result["regressions"]} regression(s), '
+        f'{result["improvements"]} improvement(s) '
+        f'(threshold {result["threshold"]:.0%})'
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Parse arguments, compare, print verdicts; returns the exit code."""
+    parser = argparse.ArgumentParser(
+        prog='benchdiff', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        'artifacts', nargs='*',
+        help='0 args: last two ledger entries; 1 ledger: its last two; '
+        '2 args: old then new (JSON artifact or JSONL ledger each)',
+    )
+    parser.add_argument('--threshold', type=float, default=0.10)
+    parser.add_argument('--json', action='store_true')
+    args = parser.parse_args(argv)
+
+    paths = args.artifacts or [default_ledger()]
+    try:
+        if len(paths) == 1:
+            entries = _read_entries(paths[0])
+            if len(entries) < 2:
+                print(
+                    f'benchdiff: need two artifacts; {paths[0]!r} has '
+                    f'{len(entries)} (run `make bench` or `make '
+                    'bench-smoke` twice to grow the ledger)',
+                    file=sys.stderr,
+                )
+                return 2
+            new = entries[-1]
+            # the most recent earlier run of the SAME measurement — a
+            # ledger interleaves metrics (train/serve/xt smokes), and
+            # pairing adjacent lines would compare different things
+            old = next(
+                (
+                    e
+                    for e in reversed(entries[:-1])
+                    if e.get('metric') == new.get('metric')
+                    and e.get('platform') == new.get('platform')
+                ),
+                None,
+            )
+            if old is None:
+                print(
+                    f'benchdiff: no earlier {new.get("metric")!r} '
+                    f'[{new.get("platform")}] entry in {paths[0]!r} to '
+                    'compare against (run the same bench again to grow '
+                    'the ledger)',
+                    file=sys.stderr,
+                )
+                return 2
+        elif len(paths) == 2:
+            old_entries = _read_entries(paths[0])
+            new_entries = _read_entries(paths[1])
+            if not old_entries or not new_entries:
+                print(
+                    'benchdiff: empty artifact '
+                    f'({paths[0]!r} or {paths[1]!r})',
+                    file=sys.stderr,
+                )
+                return 2
+            old, new = old_entries[-1], new_entries[-1]
+        else:
+            print('benchdiff: give at most two artifacts', file=sys.stderr)
+            return 2
+    except OSError as e:
+        print(
+            f'benchdiff: cannot read {getattr(e, "filename", None)!r}: '
+            f'{e.strerror or e}',
+            file=sys.stderr,
+        )
+        return 2
+
+    result = compare_artifacts(old, new, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        _render(result)
+    if 'incomparable' in result:
+        return 2
+    return 1 if result['regressions'] else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -67,6 +67,7 @@ KNOWN_AREAS = {
     'drift',  # traffic-drift watch (learn/drift.py: PSI/KS vs reference)
     'learn',  # continuous-learning loop (learn/: ingest/train/shadow/gate)
     'mem',  # device-memory accounting (obs/memory.py)
+    'num',  # numeric health: in-dispatch guards + parity probes (obs/numerics.py, obs/parity.py)
     'pipeline',  # store/feed/cache stage timings
     'serve',  # online rating service (batcher/session/registry/service)
     'slo',  # SLO engine: burn rates, budgets, sheds (obs/slo.py)
@@ -99,11 +100,17 @@ KNOWN_AREAS = {
 #:   ``window`` fast|slow.
 #: - ``drift``: ``feature`` values are the monitored packed fields plus
 #:   one ``pred_<head>`` per probability head — bounded by DriftConfig.
+#: - ``num``: ``fn`` values are the guarded dispatch sites (pair_probs,
+#:   train_epoch, solve_xt — a handful, like ``xla``'s fn), ``output``
+#:   the guarded output slot per site (probs|logits|loss|grid|residual),
+#:   ``pair`` the parity path-pairs
+#:   (fused_vs_materialized|incremental_vs_replay).
 KNOWN_LABELS = {
     'bench': {'path', 'platform'},
     'drift': {'feature'},
     'learn': {'source', 'stage', 'verdict', 'head', 'model'},
     'mem': {'span', 'device'},
+    'num': {'fn', 'output', 'pair'},
     'pipeline': {'stage'},
     'serve': {'reason', 'kind', 'bucket', 'segment'},
     'slo': {'objective', 'outcome', 'window'},

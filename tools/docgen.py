@@ -31,7 +31,10 @@ PACKAGE = 'socceraction_tpu'
 
 #: operator-facing tool modules documented alongside the package (the
 #: rest of tools/ is build machinery, not API surface)
-EXTRA_MODULES = (('tools.obsctl', os.path.join('tools', 'obsctl.py')),)
+EXTRA_MODULES = (
+    ('tools.obsctl', os.path.join('tools', 'obsctl.py')),
+    ('tools.benchdiff', os.path.join('tools', 'benchdiff.py')),
+)
 
 
 def iter_modules(root: str) -> Iterator[Tuple[str, str]]:

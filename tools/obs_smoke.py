@@ -1,17 +1,25 @@
-"""End-to-end request-tracing smoke: one traced request, reconstructed.
+"""End-to-end request-tracing + numerics smoke: one guarded, probed request.
 
-The ``make obs-smoke`` gate for the request-observability layer: fit a
-tiny VAEP model on synthetic actions, serve ONE rating request through
-a :class:`RatingService` under a :class:`RunLog`, then reconstruct that
-request's queue → flush → dispatch → slice path from the run log with
-``obsctl trace`` and assert every piece is there:
+The ``make obs-smoke`` gate for the request-observability AND
+numerics-observability layers: fit a tiny VAEP model on synthetic
+actions, serve ONE rating request through a :class:`RatingService`
+under a :class:`RunLog` — with the in-dispatch finite guards enabled
+(the default) and a sample-everything
+:class:`~socceraction_tpu.obs.parity.ParityProbe` attached — then
+reconstruct the request with ``obsctl trace`` and the numeric-health
+surface with ``obsctl numerics`` and assert every piece is there:
 
 - the future carries its ``request_id`` / ``RequestContext``;
 - ``request_enqueue`` and ``request_done`` events landed in the log;
 - the ``serve/flush`` span lists the id among its coalesced children;
 - the segment decomposition covers queue_wait / pad / dispatch / slice
   and sums to (at most) the request's wall;
-- the SLO engine scored the request and reports full budget remaining.
+- the SLO engine scored the request and reports full budget remaining;
+- the guarded dispatch detected zero non-finite values and ``health()``
+  reports a clean numerics block;
+- the parity probe re-rated the flush through the materialized
+  reference within 1e-5 max abs error, and ``obsctl numerics`` over the
+  closed run log round-trips the probe's statistics.
 
 Exit 0 on success; any assertion failure is a non-zero exit with the
 reconstructed trace printed for debugging. CPU-sized (a few seconds).
@@ -41,6 +49,7 @@ def main() -> int:
 
     from socceraction_tpu.core.synthetic import synthetic_actions_frame
     from socceraction_tpu.obs import RunLog, SLOConfig
+    from socceraction_tpu.obs.parity import ParityProbe
     from socceraction_tpu.serve import RatingService
     from socceraction_tpu.vaep.base import VAEP
     from tools.obsctl import main as obsctl_main
@@ -58,6 +67,7 @@ def main() -> int:
 
     with tempfile.TemporaryDirectory(prefix='obs-smoke-') as tmp:
         runlog_path = os.path.join(tmp, 'obs.jsonl')
+        probe = ParityProbe(sample_rate=1.0, max_abs_err=1e-4)
         with RunLog(runlog_path, config={'smoke': 'obs'}):
             with RatingService(
                 model,
@@ -65,10 +75,12 @@ def main() -> int:
                 max_batch_size=4,
                 max_wait_ms=1.0,
                 slo=SLOConfig.simple(latency_ms=60_000.0),
+                parity=probe,
             ) as service:
                 future = service.rate(frame, home_team_id=100)
                 ratings = future.result(timeout=120)
                 request_id = future.request_id
+                probe.flush(timeout=120)
                 health = service.health()
         assert len(ratings) == len(frame), 'ratings misaligned with request'
         assert request_id, 'future carries no request id'
@@ -116,6 +128,50 @@ def main() -> int:
         ):
             problems.append(f'unexpected budget burn in {slo}')
 
+        # the numerics half: the guarded dispatch was clean, the parity
+        # probe ran within band, and obsctl numerics round-trips it all
+        numerics = health.get('numerics') or {}
+        if numerics.get('ok') is not True:
+            problems.append(f'health() numerics degraded: {numerics}')
+        if numerics.get('nonfinite_events'):
+            problems.append(
+                f'{numerics["nonfinite_events"]} nonfinite event(s) on a '
+                'clean request'
+            )
+        pstats = probe.stats()
+        if pstats['probes'] < 1:
+            problems.append('parity probe never sampled the flush')
+        elif pstats['max_abs_err'] is None or pstats['max_abs_err'] > 1e-5:
+            problems.append(
+                f'parity vs reference {pstats["max_abs_err"]} > 1e-5'
+            )
+        if pstats['exceedances']:
+            problems.append(f'parity exceedances: {pstats["exceedances"]}')
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = obsctl_main(['numerics', runlog_path, '--json'])
+        if rc != 0:
+            problems.append('obsctl numerics failed on the run log')
+            num_summary = {}
+        else:
+            num_summary = json.loads(out.getvalue())
+            pairs = {
+                row.get('pair'): row
+                for row in num_summary.get('parity', [])
+            }
+            fused = pairs.get('fused_vs_materialized')
+            if fused is None:
+                problems.append(
+                    'obsctl numerics lost the fused_vs_materialized probe'
+                )
+            elif not fused.get('probes'):
+                problems.append(f'numerics round-trip has no probes: {fused}')
+            if any(row['total'] for row in num_summary.get('nonfinite', [])):
+                problems.append(
+                    f'nonfinite totals on a clean run: {num_summary}'
+                )
+
         if problems:
             print(json.dumps(trace, indent=1, sort_keys=True, default=str))
             for p in problems:
@@ -126,7 +182,9 @@ def main() -> int:
         print(
             f'obs-smoke: OK - request {request_id} reconstructed '
             f'(wall {wall * 1e3:.2f}ms, segments {seg_ms}, '
-            f'{len(slo)} SLO objective(s) at full budget)'
+            f'{len(slo)} SLO objective(s) at full budget; numerics clean, '
+            f'parity {pstats["probes"]} probe(s) max abs err '
+            f'{pstats["max_abs_err"]:.2e})'
         )
     return 0
 

@@ -14,6 +14,7 @@ required writing Python. ``obsctl`` is the no-Python surface::
     python tools/obsctl.py bundle /tmp/socceraction-tpu-debug  # post-mortem
     python tools/obsctl.py promotions obs.jsonl  # gate decisions, readable
     python tools/obsctl.py drift obs.jsonl       # drift-watch checks
+    python tools/obsctl.py numerics obs.jsonl    # numeric health (num/*)
 
 ``trace`` reconstructs one request's queue → flush → dispatch → slice
 path from its ``request_enqueue``/``request_done`` events plus the
@@ -22,8 +23,18 @@ path from its ``request_enqueue``/``request_done`` events plus the
 name) and ``--since`` (``5m``-style relative to the log's newest event,
 or an absolute unix timestamp).
 
-``snapshot``/``tail``/``trace``/``bundle``/``promotions``/``drift``
-accept ``--json`` for
+``numerics`` summarizes the numeric-health surface: the ``num/*``
+guard counters (non-finite detections per guarded function/output,
+overflow guards) and parity-probe error statistics per path pair from
+the log's last embedded snapshot — or the live registry with no
+argument — plus the recent ``nonfinite_detected`` /
+``parity_exceeded`` events.
+
+A missing or unreadable runlog path exits 1 with a one-line error (no
+traceback) — the operator-under-pressure contract.
+
+``snapshot``/``tail``/``trace``/``bundle``/``promotions``/``drift``/
+``numerics`` accept ``--json`` for
 machine-readable output (``prom`` *is* a machine format already); the
 default rendering is a compact human table. ``promotions`` tails the
 continuous-learning loop's typed promotion reports (verdict, per-head
@@ -204,6 +215,18 @@ def _fmt_event(event: Dict[str, Any]) -> str:
             f'max_psi={event.get("max_psi")} ({event.get("max_psi_feature")}) '
             f'triggered={event.get("triggered")}'
         )
+    if kind == 'nonfinite_detected':
+        # the generic name line above already printed the fn field
+        parts.append(
+            f'output={event.get("output")} '
+            f'kind={event.get("guard_kind")} count={event.get("count")}'
+        )
+    if kind == 'parity_exceeded':
+        parts.append(
+            f'pair={event.get("pair")} '
+            f'max_abs_err={event.get("max_abs_err")} '
+            f'band={event.get("band")}'
+        )
     return '  '.join(parts)
 
 
@@ -377,6 +400,107 @@ def _cmd_drift(args: argparse.Namespace) -> int:
     return 0
 
 
+def _num_summary(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """Summarize the ``num/*`` instruments of a compact snapshot dict."""
+
+    def series(name: str):
+        return (snapshot.get(name) or {}).get('series', [])
+
+    parity: Dict[str, Dict[str, Any]] = {}
+    for s in series('num/parity_abs_err'):
+        pair = (s.get('labels') or {}).get('pair', '?')
+        entry = parity.setdefault(pair, {'pair': pair})
+        entry['probes'] = s.get('count', 0)
+        entry['max_abs_err'] = s.get('max')
+        entry['p99_abs_err'] = (s.get('quantiles') or {}).get('p99')
+        exemplar = s.get('exemplar') or {}
+        if exemplar.get('request_id'):
+            entry['last_request_id'] = exemplar['request_id']
+    for s in series('num/parity_exceedances'):
+        pair = (s.get('labels') or {}).get('pair', '?')
+        parity.setdefault(pair, {'pair': pair})['exceedances'] = int(
+            s.get('total') or 0
+        )
+    return {
+        'nonfinite': [
+            {
+                'fn': (s.get('labels') or {}).get('fn', '?'),
+                'output': (s.get('labels') or {}).get('output', '?'),
+                'total': int(s.get('total') or 0),
+            }
+            for s in series('num/nonfinite_total')
+        ],
+        'overflow': [
+            {
+                'fn': (s.get('labels') or {}).get('fn', '?'),
+                'total': int(s.get('total') or 0),
+            }
+            for s in series('num/overflow_guard_total')
+        ],
+        'parity': sorted(parity.values(), key=lambda e: e['pair']),
+    }
+
+
+def _cmd_numerics(args: argparse.Namespace) -> int:
+    """``numerics [runlog] [-n N]``: the numeric-health surface.
+
+    ``num/*`` guard counters and parity statistics (per path pair) from
+    the run log's last embedded snapshot — or the live process registry
+    with no argument — plus the most recent ``nonfinite_detected`` and
+    ``parity_exceeded`` events.
+    """
+    guard_events: List[Dict[str, Any]] = []
+    if args.runlog:
+        events = _read_events(args.runlog)
+        snapshot = _last_snapshot(events) or {}
+        guard_events = [
+            e
+            for e in events
+            if (e.get('event') or e.get('kind'))
+            in ('nonfinite_detected', 'parity_exceeded')
+        ][-args.n :]
+        source = args.runlog
+    else:
+        from socceraction_tpu.obs import REGISTRY, snapshot_dict
+
+        snapshot = snapshot_dict(REGISTRY.snapshot(), buckets=False)
+        source = 'live registry'
+    summary = _num_summary(snapshot)
+    summary['events'] = guard_events
+    if args.json:
+        print(json.dumps(summary, sort_keys=True, default=str))
+        return 0
+    for row in summary['nonfinite']:
+        print(
+            f'nonfinite : fn={row["fn"]} output={row["output"]} '
+            f'total={row["total"]}'
+        )
+    for row in summary['overflow']:
+        print(f'overflow  : fn={row["fn"]} total={row["total"]}')
+    for row in summary['parity']:
+        line = (
+            f'parity    : pair={row["pair"]} probes={row.get("probes", 0)} '
+            f'max_abs_err={row.get("max_abs_err")}'
+        )
+        if row.get('exceedances'):
+            line += f' EXCEEDANCES={row["exceedances"]}'
+        if row.get('last_request_id'):
+            line += f' exemplar={row["last_request_id"]}'
+        print(line)
+    for event in guard_events:
+        print('  ' + _fmt_event(event))
+    n_rows = (
+        len(summary['nonfinite'])
+        + len(summary['overflow'])
+        + len(summary['parity'])
+    )
+    print(
+        f'obsctl numerics: {n_rows} num/* series, '
+        f'{len(guard_events)} event(s) from {source}'
+    )
+    return 0
+
+
 def _fmt_promotion(event: Dict[str, Any]) -> str:
     """One human-readable line block per promotion report."""
     lines = []
@@ -515,6 +639,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     sub = parser.add_subparsers(dest='cmd', required=True)
+    # (runlog-reading subcommands share one OSError net at the dispatch
+    # below: a missing/unreadable path is an actionable one-line error,
+    # never a traceback — the operator-under-pressure contract)
 
     p = sub.add_parser('snapshot', help='print a typed registry snapshot')
     p.add_argument('runlog', nargs='?', help='obs.jsonl to read (default: this process)')
@@ -556,6 +683,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=_cmd_drift)
 
     p = sub.add_parser(
+        'numerics', help='numeric health: num/* guards + parity probes'
+    )
+    p.add_argument(
+        'runlog', nargs='?',
+        help='obs.jsonl to read (default: this process)',
+    )
+    p.add_argument('-n', type=int, default=10, help='recent events shown')
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_numerics)
+
+    p = sub.add_parser(
         'promotions', help="tail the continuous-learning loop's gate decisions"
     )
     p.add_argument('runlog')
@@ -570,7 +708,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=_cmd_bundle)
 
     args = parser.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except OSError as e:
+        target = getattr(e, 'filename', None) or getattr(args, 'runlog', None)
+        detail = e.strerror or str(e)
+        print(
+            f'obsctl: cannot read {target!r}: {detail} '
+            '(is the runlog/bundle path right?)',
+            file=sys.stderr,
+        )
+        return 1
 
 
 if __name__ == '__main__':
