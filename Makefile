@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -31,6 +31,15 @@ obs-smoke:
 	$(PY) tools/obsctl.py snapshot
 	$(PY) tools/obsctl.py prom
 	env JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
+
+# the resilience layer, driven end to end on CPU: tools/chaos_smoke.py
+# replays one seeded FaultPlan (flusher death mid-load, breaker
+# trip -> half-open probe -> close) twice through a live RatingService
+# and asserts the injection history is bit-identical, every future
+# resolved, health tracked degraded -> ok, and `obsctl resil`
+# round-trips the fault/breaker surface from the run log
+chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/chaos_smoke.py
 
 types:
 	@$(PY) -c "import mypy" 2>/dev/null \

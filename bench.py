@@ -54,6 +54,11 @@ def _persist_artifact(result: dict) -> None:
     ``SOCCERACTION_TPU_BENCH_HISTORY`` overrides the directory (empty
     disables). The ledger must never sink a measurement: any failure to
     append is swallowed.
+
+    Crash hardening: the whole line goes down in ONE ``os.write`` on an
+    ``O_APPEND`` descriptor and is ``fsync``'d — a bench process killed
+    mid-append leaves at worst one torn tail line (which benchdiff skips
+    with a warning), never an interleaved or silently-buffered entry.
     """
     try:
         root = os.path.dirname(os.path.abspath(__file__))
@@ -64,10 +69,28 @@ def _persist_artifact(result: dict) -> None:
             return
         os.makedirs(hist, exist_ok=True)
         entry = {'recorded_unix': round(time.time(), 3), **result}
-        with open(
-            os.path.join(hist, 'ledger.jsonl'), 'a', encoding='utf-8'
-        ) as f:
-            f.write(json.dumps(entry, sort_keys=True, default=str) + '\n')
+        data = (json.dumps(entry, sort_keys=True, default=str) + '\n').encode(
+            'utf-8'
+        )
+
+        def _append() -> None:
+            fd = os.open(
+                os.path.join(hist, 'ledger.jsonl'),
+                os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                0o644,
+            )
+            try:
+                os.write(fd, data)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+        # transient write errors (disk briefly full) retry with backoff;
+        # anything that survives the budget is swallowed below — the
+        # ledger must never sink the measurement it records
+        from socceraction_tpu.resil.retry import retry_call
+
+        retry_call(_append, site='bench.ledger')
     except Exception:
         pass
 

@@ -35,7 +35,7 @@ report, its optional early retrain trigger, and the gate's fail-closed
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -105,6 +105,42 @@ class DriftReference:
     n_bins: int
     n_actions: int
     model_version: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-able form for the registry training manifest.
+
+        The float arrays are emitted as Python floats — every float32
+        is exactly representable as a float64 and JSON round-trips
+        float64 exactly in Python, so :meth:`from_dict` reconstructs
+        the reference **bit-for-bit**: a drift watch rebuilt from a
+        manifest after a process restart scores windows identically to
+        the in-process watch that wrote it.
+        """
+        return {
+            'names': list(self.names),
+            'lo': [float(v) for v in np.asarray(self.lo, np.float32)],
+            'hi': [float(v) for v in np.asarray(self.hi, np.float32)],
+            'props': [
+                [float(v) for v in row]
+                for row in np.asarray(self.props, np.float32)
+            ],
+            'n_bins': int(self.n_bins),
+            'n_actions': int(self.n_actions),
+            'model_version': self.model_version,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'DriftReference':
+        """Rebuild a reference serialized with :meth:`to_dict` (exact)."""
+        return cls(
+            names=tuple(d['names']),
+            lo=np.asarray(d['lo'], np.float32),
+            hi=np.asarray(d['hi'], np.float32),
+            props=np.asarray(d['props'], np.float32),
+            n_bins=int(d['n_bins']),
+            n_actions=int(d['n_actions']),
+            model_version=d.get('model_version'),
+        )
 
 
 @dataclass
@@ -331,6 +367,39 @@ class DriftWatch:
             ),
             cfg,
         )
+
+    @classmethod
+    def from_manifest(
+        cls,
+        manifest: Dict[str, Any],
+        config: Optional[DriftConfig] = None,
+        *,
+        model_version: Optional[str] = None,
+    ) -> 'DriftWatch':
+        """Rebuild the watch from a registry **training manifest**.
+
+        The restart path: the manifest's ``drift_reference`` block
+        (written by the learner at candidate-stage time, promoted
+        atomically with the checkpoint) reconstructs the exact
+        reference the in-process watch used — a restarted process
+        scores drift against the distribution the active model actually
+        trained on, not a recency guess over the store.
+
+        ``model_version`` stamps the reference with the version it now
+        serves (the manifest was written at *stage* time, before a
+        version existed, so its stored ``model_version`` is None) —
+        drift events then carry the version for operator correlation.
+        """
+        ref = (manifest or {}).get('drift_reference')
+        if not ref:
+            raise ValueError(
+                'manifest carries no drift_reference block '
+                '(pre-resilience version? fall back to from_batch)'
+            )
+        reference = DriftReference.from_dict(ref)
+        if model_version is not None:
+            reference = replace(reference, model_version=model_version)
+        return cls(reference, config)
 
     def check(self, model: Any, batch: Any) -> DriftResult:
         """Score one traffic window; record gauges + events; never raises

@@ -52,7 +52,14 @@ import pandas as pd
 
 from ..obs import counter, histogram, span
 from ..obs.recorder import RECORDER, default_debug_dir, dump_debug_bundle
-from .drift import DriftConfig, DriftResult, DriftWatch
+from ..resil.faults import fault_point
+from ..resil.journal import IterationJournal
+from .drift import (
+    DriftConfig,
+    DriftResult,
+    DriftWatch,
+    build_drift_reference,
+)
 from .gate import (
     GateConfig,
     PromotionReport,
@@ -104,6 +111,13 @@ class LearnConfig:
     family: str = 'standard'
     model_factory: Optional[Callable[[], Any]] = None
     debug_dir: Optional[str] = None
+    #: durable iteration journal (resil.journal.IterationJournal): every
+    #: stage of every iteration is fsync'd here BEFORE its effects
+    #: proceed, and a new learner replays it at startup — consumed games
+    #: are never retrained, a half-finished publish is completed, and
+    #: the decision trail survives any crash. None (default) keeps the
+    #: in-memory-only behavior.
+    journal_path: Optional[str] = None
 
 
 class ContinuousLearner:
@@ -125,9 +139,13 @@ class ContinuousLearner:
         ``service.capture``.
     config : LearnConfig, optional
     prime_watcher : bool
-        ``True`` (default when the registry already has an active model)
-        marks the store's current games as consumed, so the first
-        iteration only trains when *new* matches land.
+        ``True`` (default when the registry already has an active model
+        AND no journal is configured) marks the store's current games as
+        consumed, so the first iteration only trains when *new* matches
+        land. With a ``journal_path`` in the config, the journal's
+        replayed ``consumed`` entries are the priming source instead —
+        games that landed while the process was down stay *pending* and
+        train on the first post-restart iteration.
     """
 
     def __init__(
@@ -148,11 +166,27 @@ class ContinuousLearner:
         )
         self.config = config if config is not None else LearnConfig()
         if prime_watcher is None:
-            prime_watcher = self._active() is not None
+            # with a journal, the journal IS the consumption record: a
+            # blanket "everything present is consumed" prime would mark
+            # games that landed while the process was down as trained
+            # (the exact restart gap the journal closes) — so prime from
+            # the replayed 'consumed' entries instead
+            prime_watcher = (
+                self._active() is not None
+                and not self.config.journal_path
+            )
         self.watcher = SeasonWatcher(store, prime=prime_watcher)
         self.last_report: Optional[PromotionReport] = None
         self._drift_watch: Optional[DriftWatch] = None
         self._drift_version: Optional[str] = None
+        self.journal: Optional[IterationJournal] = (
+            IterationJournal(self.config.journal_path)
+            if self.config.journal_path
+            else None
+        )
+        self.last_recovery: Optional[Dict[str, Any]] = None
+        if self.journal is not None:
+            self._recover()
 
     # -- pieces ------------------------------------------------------------
 
@@ -164,6 +198,116 @@ class ContinuousLearner:
 
     def _debug_dir(self) -> str:
         return self.config.debug_dir or default_debug_dir()
+
+    def _journal_append(self, stage: str, **fields: Any) -> None:
+        """Durably journal one iteration stage (no-op without a journal)."""
+        if self.journal is not None:
+            self.journal.append(
+                stage, model_name=self.config.model_name, **fields
+            )
+
+    def _recover(self) -> None:
+        """Replay the journal: re-consume games, finish half-done publishes.
+
+        Runs once at construction, before the first :meth:`run_once`.
+        Three invariants come out of it (see
+        :mod:`socceraction_tpu.resil.journal` for the stage grammar):
+
+        - **no double-consumed games** — every game any past iteration
+          committed is marked consumed on the fresh watcher, so a crash
+          mid-iteration never retrains data it already trained on;
+        - **no half-published registry** — a ``verdict: promoted``
+          without ``published`` promotes the still-staged candidate
+          under its intended version (the rename is atomic — an intent
+          whose version dir already exists just proceeds); ``published``
+          without ``activated`` activates/swap-warms the version;
+        - **nothing silent** — every completion/abandonment is itself
+          journaled (``recovered`` fields mark it), counted under
+          ``resil/recoveries{outcome}`` and put in the flight recorder.
+
+        A recovery step that *fails* (the registry is gone, the swap
+        target no longer validates) records ``outcome='failed'`` and
+        leaves the journal as-was — the next restart retries; the
+        learner still constructs so the operator can inspect it.
+        """
+        assert self.journal is not None
+        state = self.journal.replay()
+        summary: Dict[str, Any] = {
+            'consumed_games': len(state.consumed_games),
+            'skipped_lines': state.skipped_lines,
+            'pending_stage': state.pending_stage,
+            'outcome': None,
+        }
+        if state.consumed_games:
+            self.watcher.commit(state.consumed_games)
+        pending = state.open_iteration
+        if pending is not None:
+            name = pending.get('model_name') or self.config.model_name
+            tag = pending.get('tag')
+            try:
+                outcome = self._finish_pending(pending, name, tag)
+            except Exception as e:
+                outcome = 'failed'
+                summary['error'] = f'{type(e).__name__}: {e}'
+            summary['outcome'] = outcome
+            counter('resil/recoveries', unit='count').inc(1, outcome=outcome)
+        RECORDER.record('journal_recovery', **summary)
+        try:
+            # dual-write to the run log so `obsctl resil <runlog>` can
+            # show what a restart found (the recorder ring dies with
+            # the process)
+            from ..obs.trace import current_runlog
+
+            log = current_runlog()
+            if log is not None:
+                log.event('journal_recovery', **summary)
+        except Exception:
+            pass  # telemetry must not fail the recovery
+        self.last_recovery = summary
+
+    def _finish_pending(
+        self, pending: Dict[str, Any], name: str, tag: Optional[str]
+    ) -> str:
+        """Complete (or close out) one half-done journaled iteration."""
+        stage = pending.get('stage')
+        verdict = pending.get('verdict')
+        if stage in ('consumed',) or (stage == 'verdict' and verdict is None):
+            # crashed in shadow/gate: games stay consumed, the staged
+            # candidate stays for post-mortems, the iteration closes as
+            # a recorded abandonment (retraining would double-consume)
+            self._journal_append(
+                'verdict', verdict='abandoned', tag=tag, recovered=True
+            )
+            return 'abandoned'
+        if verdict != 'promoted':
+            # a terminal verdict that somehow stayed open — close it
+            self._journal_append(
+                'verdict', verdict='abandoned', tag=tag, recovered=True
+            )
+            return 'abandoned'
+        version = pending.get('version')
+        if stage in ('verdict', 'intent_publish'):
+            if version is None:
+                version = self.registry.next_version(name)
+                self._journal_append(
+                    'intent_publish', version=version, tag=tag, recovered=True
+                )
+            # the crash may have hit between the atomic rename and its
+            # journal entry: a version dir that already exists means the
+            # publish completed — proceed straight to activation
+            if version not in self.registry.versions(name):
+                self.registry.promote_candidate(name, version, tag)
+            self._journal_append(
+                'published', version=version, tag=tag, recovered=True
+            )
+        if self.service is not None:
+            self.service.swap_model(name, version)
+        else:
+            self.registry.activate(name, version)
+        self._journal_append(
+            'activated', version=version, tag=tag, recovered=True
+        )
+        return 'completed_publish'
 
     def _new_model(self, active_model: Any) -> Any:
         """An unfitted candidate shell matching the active feature layout."""
@@ -204,6 +348,59 @@ class ContinuousLearner:
             warm_start=warm,
         )
         return candidate
+
+    def _build_manifest(
+        self, candidate: Any, new_ids: Any
+    ) -> Dict[str, Any]:
+        """The candidate's training manifest (staged with the checkpoint).
+
+        Two provenance facts a restarted process cannot reconstruct
+        from the checkpoint alone:
+
+        - ``trained_game_ids`` — everything this candidate's fit
+          streamed (the whole store at train time: the packed feed is a
+          full-season pass, warm-started or not);
+        - ``drift_reference`` — the frozen PSI/KS reference
+          (:meth:`DriftReference.to_dict`, bit-exact round trip) built
+          from the newest stored matches *with the candidate's own
+          prediction heads*, so once promoted, a drift watch rebuilt
+          from the manifest is the watch the in-process learner uses —
+          the PR 8 restart limitation ("promoted-past games are
+          indistinguishable from training data") closes here.
+
+        The reference is built only under a ``drift`` config (it costs
+        a replay dispatch); the manifest with the id list is written
+        always.
+        """
+        cfg = self.config
+        trained = sorted(self.store.game_ids(), key=str)
+        manifest: Dict[str, Any] = {
+            'format_version': 1,
+            'created_unix': round(time.time(), 3),
+            'model_name': cfg.model_name,
+            'trained_game_ids': trained,
+            'new_game_ids': sorted(list(new_ids), key=str),
+            'drift_reference': None,
+        }
+        if cfg.drift is not None:
+            ids = newest_game_ids(trained, cfg.drift.reference_games)
+            if ids:
+                reference = build_drift_reference(
+                    candidate, self._pack_games(ids), cfg.drift
+                )
+                manifest['drift_reference'] = reference.to_dict()
+                manifest['drift_reference_games'] = list(ids)
+        return manifest
+
+    def _pack_games(self, ids: Any) -> Any:
+        """Pack the given stored games into one replay batch (the shared
+        reference-batch construction of the manifest build and the
+        legacy drift-reference fallback)."""
+        home = self.store.home_team_ids()
+        frames = [
+            (self.store.get_actions(gid), home.get(gid)) for gid in ids
+        ]
+        return pack_replay_batch(frames, max_actions=self.config.max_actions)
 
     def _parity_stats(self) -> Optional[Dict[str, Any]]:
         """The serving layer's numeric-health stats for the gate.
@@ -303,17 +500,19 @@ class ContinuousLearner:
         Returns None when the watch cannot run (no ``drift`` config, no
         active model, no captured traffic) — with the gate's
         ``max_drift_psi`` band set, that absence itself fails closed.
-        The reference is (re)built from the newest stored matches
-        whenever the active version changes, EXCLUDING ``pending_ids``
+        The reference comes from the active version's registry
+        **training manifest** first (:meth:`DriftWatch.from_manifest`):
+        the frozen statistics the promoting learner wrote at stage time
+        travel with the checkpoint, so an in-process rebuild and a
+        process restart reconstruct the *identical* watch — the PR 8
+        restart limitation (pre-restart promoted games indistinguishable
+        from training data) is closed. Versions that predate manifests
+        (bootstrap publishes, old registries) fall back to rebuilding
+        from the newest stored matches, EXCLUDING ``pending_ids``
         (games landed but not yet consumed by a retrain): the active
         model never trained on those, and folding a drifted fresh batch
         into its own reference would make the watch compare drift
-        against drift and read PSI ~0. Known limitation: across a
-        process restart with a primed watcher, games promoted-past
-        before the restart are indistinguishable from training data
-        (the registry keeps no training manifest yet), so a shift that
-        fully landed pre-restart is under-detected until the next
-        promotion rebuilds the world.
+        against drift and read PSI ~0.
         """
         cfg = self.config
         if cfg.drift is None or active_model is None:
@@ -327,24 +526,50 @@ class ContinuousLearner:
             self._drift_watch is None
             or self._drift_version != active_version
         ):
-            pending = set(pending_ids)
-            ids = newest_game_ids(
-                [g for g in self.store.game_ids() if g not in pending],
-                cfg.drift.reference_games,
-            )
-            if not ids:
-                return None
-            home = self.store.home_team_ids()
-            ref_frames = [
-                (self.store.get_actions(gid), home.get(gid)) for gid in ids
-            ]
-            ref_batch = pack_replay_batch(
-                ref_frames, max_actions=cfg.max_actions
-            )
-            self._drift_watch = DriftWatch.from_batch(
-                active_model, ref_batch, cfg.drift,
-                model_version=active_version,
-            )
+            watch: Optional[DriftWatch] = None
+            try:
+                manifest = self.registry.load_manifest(
+                    cfg.model_name, active_version
+                )
+            except OSError:
+                manifest = None  # transient read failure: legacy rebuild
+            except ValueError as e:
+                # a CORRUPT manifest must surface (load_manifest's
+                # contract), but a drift check must not wedge the loop:
+                # flag it loudly, then fall back to the legacy rebuild
+                manifest = None
+                counter('learn/manifest_corrupt', unit='count').inc(1)
+                payload = {
+                    'model': cfg.model_name,
+                    'version': active_version,
+                    'error': f'{type(e).__name__}: {e}',
+                }
+                RECORDER.record('manifest_corrupt', **payload)
+                try:
+                    from ..obs.trace import current_runlog
+
+                    log = current_runlog()
+                    if log is not None:
+                        log.event('manifest_corrupt', **payload)
+                except Exception:
+                    pass
+            if manifest and manifest.get('drift_reference'):
+                watch = DriftWatch.from_manifest(
+                    manifest, cfg.drift, model_version=active_version
+                )
+            if watch is None:
+                pending = set(pending_ids)
+                ids = newest_game_ids(
+                    [g for g in self.store.game_ids() if g not in pending],
+                    cfg.drift.reference_games,
+                )
+                if not ids:
+                    return None
+                watch = DriftWatch.from_batch(
+                    active_model, self._pack_games(ids), cfg.drift,
+                    model_version=active_version,
+                )
+            self._drift_watch = watch
             self._drift_version = active_version
         batch = pack_replay_batch(frames, max_actions=cfg.max_actions)
         return self._drift_watch.check(active_model, batch)
@@ -426,12 +651,18 @@ class ContinuousLearner:
             with timed_stage('train'), span('learn/train', games=len(new_ids)):
                 candidate = self._train_candidate(active_model)
                 tag, _path = self.registry.stage_candidate(
-                    cfg.model_name, candidate
+                    cfg.model_name,
+                    candidate,
+                    manifest=self._build_manifest(candidate, new_ids),
                 )
             # the games are consumed once a candidate was trained over
             # them — a rejected candidate must not retrain the same data
-            # forever, and a crash before this line retries it
+            # forever, and a crash before this line retries it. The
+            # journal entry is written AFTER the in-memory commit but is
+            # the durable half: a restarted learner re-consumes from the
+            # journal, never from memory
             self.watcher.commit(new_ids)
+            self._journal_append('consumed', games=list(new_ids), tag=tag)
 
             # everything past the commit must end in a recorded report —
             # an exception here would otherwise consume the games with no
@@ -447,6 +678,9 @@ class ContinuousLearner:
                 health_reasons = self._train_health_reasons(candidate)
                 if health_reasons:
                     counter('learn/training_diverged', unit='count').inc(1)
+                    self._journal_append(
+                        'verdict', verdict='rejected', tag=tag
+                    )
                     report = PromotionReport(
                         name=cfg.model_name,
                         verdict='rejected',
@@ -505,6 +739,9 @@ class ContinuousLearner:
                     # staged unevaluated and the decision is a typed
                     # report (built OUTSIDE the stage timer, so the
                     # shadow wall it just measured is included)
+                    self._journal_append(
+                        'verdict', verdict='rejected', tag=tag
+                    )
                     report = PromotionReport(
                         name=cfg.model_name,
                         verdict='rejected',
@@ -534,6 +771,7 @@ class ContinuousLearner:
                         parity=parity_stats,
                     )
             except Exception as e:
+                self._journal_append('verdict', verdict='error', tag=tag)
                 report = PromotionReport(
                     name=cfg.model_name,
                     verdict='error',
@@ -570,17 +808,37 @@ class ContinuousLearner:
                 parity=parity_stats or {},
             )
 
+            self._journal_append(
+                'verdict',
+                verdict='promoted' if passed else 'rejected',
+                tag=tag,
+            )
             if passed:
                 try:
                     with timed_stage('publish'), span('learn/publish'):
                         version = self.registry.next_version(cfg.model_name)
+                        # write-ahead intent: a crash between the atomic
+                        # rename below and its 'published' entry is
+                        # recoverable because the intended version is
+                        # already durable (the restart checks whether
+                        # the rename landed and resumes either way)
+                        self._journal_append(
+                            'intent_publish', version=version, tag=tag
+                        )
+                        fault_point('learn.publish', version=version)
                         self.registry.promote_candidate(
                             cfg.model_name, version, tag
+                        )
+                        self._journal_append(
+                            'published', version=version, tag=tag
                         )
                         if self.service is not None:
                             self.service.swap_model(cfg.model_name, version)
                         else:
                             self.registry.activate(cfg.model_name, version)
+                        self._journal_append(
+                            'activated', version=version, tag=tag
+                        )
                         report.candidate_version = version
                         self._transplant_opt_state(candidate)
                 except Exception as e:

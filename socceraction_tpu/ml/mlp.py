@@ -796,29 +796,52 @@ class MLPClassifier:
 
     @classmethod
     def load(cls, path: str) -> 'MLPClassifier':
-        """Load a classifier saved with :meth:`save`."""
+        """Load a classifier saved with :meth:`save`.
+
+        A damaged artifact — truncated write, bit rot, a file that is
+        not an npz at all — raises a ``ValueError`` naming the artifact
+        (zipfile/parse internals make terrible operator errors). The
+        registry path additionally verifies content checksums *before*
+        this runs (``save_model`` records sha256 per head); this guard
+        covers direct ``MLPClassifier.load`` callers.
+        """
         import json
+        import zipfile
 
         from flax import serialization
 
-        with np.load(path, allow_pickle=False) as data:
-            # pre-versioning artifacts (format 1 without the stamp) load;
-            # anything stamped NEWER than this library is rejected up
-            # front with an actionable error
-            version = (
-                int(data['format_version']) if 'format_version' in data else 1
-            )
-            if version > MLP_FORMAT_VERSION:
-                raise ValueError(
-                    f'checkpoint at {path!r} has format_version={version}, '
-                    'newer than this library understands '
-                    f'(<= {MLP_FORMAT_VERSION}); upgrade socceraction_tpu '
-                    'to load it'
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                # pre-versioning artifacts (format 1 without the stamp)
+                # load; anything stamped NEWER than this library is
+                # rejected up front with an actionable error
+                version = (
+                    int(data['format_version'])
+                    if 'format_version' in data
+                    else 1
                 )
-            hyper = json.loads(str(data['hyper_json']))
-            mean = data['mean']
-            std = data['std']
-            raw = data['params_msgpack'].tobytes()
+                if version > MLP_FORMAT_VERSION:
+                    raise ValueError(
+                        f'checkpoint at {path!r} has '
+                        f'format_version={version}, newer than this '
+                        f'library understands (<= {MLP_FORMAT_VERSION}); '
+                        'upgrade socceraction_tpu to load it'
+                    )
+                hyper = json.loads(str(data['hyper_json']))
+                mean = data['mean']
+                std = data['std']
+                raw = data['params_msgpack'].tobytes()
+        except (
+            zipfile.BadZipFile,
+            EOFError,
+            KeyError,
+            json.JSONDecodeError,
+        ) as e:
+            raise ValueError(
+                f'checkpoint artifact corrupt: {path!r} failed to parse '
+                f'as an MLP checkpoint ({type(e).__name__}: {e}); the '
+                'file is truncated, damaged or not a save() artifact'
+            ) from e
         clf = cls(**hyper)
         clf.mean_ = mean.astype(np.float32)
         clf.std_ = std.astype(np.float32)

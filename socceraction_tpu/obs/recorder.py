@@ -173,17 +173,28 @@ def dump_debug_bundle(
         ('memory.json', _json_bytes(memory)),
     )
     tmp = f'{path}.tmp-{os.getpid()}'
-    try:
-        with tarfile.open(tmp, 'w:gz') as tar:
-            for name, payload in members:
-                info = tarfile.TarInfo(name)
-                info.size = len(payload)
-                info.mtime = int(time.time())
-                tar.addfile(info, io.BytesIO(payload))
-        os.replace(tmp, path)  # a killed dump never leaves a partial bundle
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+
+    def _write_bundle() -> None:
+        # write + atomic rename as ONE retried unit: a transient
+        # OSError (disk briefly full, fs failover) rebuilds the tmp
+        # from the already-captured in-memory payloads and tries
+        # again — a post-mortem bundle is exactly the artifact that
+        # must survive a flaky disk
+        try:
+            with tarfile.open(tmp, 'w:gz') as tar:
+                for name, payload in members:
+                    info = tarfile.TarInfo(name)
+                    info.size = len(payload)
+                    info.mtime = int(time.time())
+                    tar.addfile(info, io.BytesIO(payload))
+            os.replace(tmp, path)  # a killed dump never leaves a partial bundle
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    from socceraction_tpu.resil.retry import retry_call
+
+    retry_call(_write_bundle, site='recorder.dump')
 
     rec.record('debug_bundle', path=path, reason=reason)
     log = current_runlog()

@@ -29,8 +29,18 @@ import numpy as np
 import pandas as pd
 
 from socceraction_tpu.obs import timed_labels
+from socceraction_tpu.resil.faults import fault_point
+from socceraction_tpu.resil.retry import RetryPolicy, retry_call
 
 __all__ = ['SeasonStore']
+
+#: Per-file parquet reads retried under this policy: a transient
+#: ``OSError`` (NFS hiccup, briefly-full page cache) backs off and
+#: retries; a missing file (``FileNotFoundError`` → ``KeyError``) or a
+#: schema/projection mismatch raises immediately — the data will not
+#: appear by waiting. Delays are small: per-game files are ~100 KB and
+#: the multi-game reader fans these out across worker threads.
+READ_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.02, max_delay_s=0.5)
 
 _GAME_KEY_RE = re.compile(r'^actions/game_(.+)$')
 
@@ -201,14 +211,23 @@ class SeasonStore:
         import pyarrow.parquet as pq
 
         path = self._parquet_path(key)
-        try:
+
+        def _read_bytes() -> bytes:
+            # the named chaos point + retried unit: the byte slurp is
+            # the only part of the read that touches the filesystem, so
+            # an injected/transient OSError here retries without
+            # re-running the (deterministic) Arrow parse below
+            fault_point('ingest.read', key=key)
             # slurp + parse from memory: one sequential read() instead of
             # the seek-heavy footer/page reads of a file-backed open —
             # measured ~2x per-file on ~100 KB per-game files (projection
             # then skips decode, not IO; per-key store files are small
             # enough that reading all bytes is the right trade)
             with open(path, 'rb') as fh:
-                buf = fh.read()
+                return fh.read()
+
+        try:
+            buf = retry_call(_read_bytes, site='ingest.read', policy=READ_RETRY)
         except FileNotFoundError:
             raise KeyError(key) from None
         pf = pq.ParquetFile(pa.BufferReader(buf))

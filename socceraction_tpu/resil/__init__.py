@@ -1,0 +1,53 @@
+"""Resilience layer: fault injection, retries, circuit breaking, recovery.
+
+The fault-tolerance substrate under the serving and learning
+subsystems — the pieces that keep a production rating service *correct*
+while the world fails around it, and make every failure mode
+reproducible enough to test:
+
+- :mod:`socceraction_tpu.resil.faults` — deterministic fault injection:
+  named :func:`fault_point` markers in the production code paths,
+  zero-cost no-ops until a seeded :class:`FaultPlan` arms them
+  (nth-call / probability / error-type / latency injection), so chaos
+  schedules replay bit-for-bit (``tests/test_chaos.py``,
+  ``make chaos-smoke``).
+- :mod:`socceraction_tpu.resil.retry` — the typed retry engine:
+  :class:`RetryPolicy` (jittered exponential backoff, budgets,
+  transient/permanent classification) and :func:`retry_call`, applied
+  at the transient-error sites (parquet reads, registry checkpoint
+  loads, debug-bundle and ledger writes).
+- :mod:`socceraction_tpu.resil.breaker` — :class:`CircuitBreaker`:
+  consecutive flush-level dispatch failures trip the serving layer onto
+  the materialized reference fallback; a half-open probe dispatch
+  closes it when the fused path recovers.
+- :mod:`socceraction_tpu.resil.journal` — :class:`IterationJournal`:
+  the fsync'd append-only decision trail the continuous learner replays
+  at startup, so a crash at any stage resumes without retraining
+  consumed games or losing a publish halfway.
+
+Everything reports under the governed ``resil`` telemetry area
+(``resil/faults_injected{point,kind}``, ``resil/retries{site,outcome}``,
+``resil/breaker_state``, ``resil/breaker_trips``,
+``resil/breaker_probes{outcome}``, ``resil/recoveries{outcome}``) and
+into the flight recorder; ``obsctl resil`` is the operator surface.
+See ``docs/resilience.md`` for the fault-point catalog, breaker
+semantics, journal format and the recovery runbook.
+"""
+
+from .breaker import CircuitBreaker
+from .faults import FaultPlan, FaultSpec, fault_point, injected_faults
+from .journal import IterationJournal, JournalState
+from .retry import RetryPolicy, classify_error, retry_call
+
+__all__ = [
+    'CircuitBreaker',
+    'FaultPlan',
+    'FaultSpec',
+    'IterationJournal',
+    'JournalState',
+    'RetryPolicy',
+    'classify_error',
+    'fault_point',
+    'injected_faults',
+    'retry_call',
+]

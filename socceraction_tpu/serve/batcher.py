@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -44,6 +45,7 @@ from ..obs.context import (
     record_segment,
 )
 from ..obs.recorder import RECORDER
+from ..resil.faults import fault_point
 
 __all__ = ['DeadlineExceeded', 'MicroBatcher', 'Overloaded']
 
@@ -92,10 +94,30 @@ class MicroBatcher:
         raises :class:`Overloaded`.
     on_crash : callable, optional
         ``on_crash(exc)`` invoked (once, on the dying thread) if the
-        flusher thread itself dies — i.e. an exception escapes the take
-        loop rather than a flush (flush failures land on the affected
-        futures and the thread lives on). The service hooks its
+        flusher thread dies *permanently* — i.e. an exception escapes
+        the take loop rather than a flush (flush failures land on the
+        affected futures and the thread lives on) and the restart
+        supervisor's budget is spent. The service hooks its
         flight-recorder dump here.
+    max_flusher_restarts : int
+        Supervised-restart budget: a crashed flusher thread is replaced
+        (its un-flushed requests re-queued at the front, so nothing is
+        stranded or reordered) up to this many times within
+        ``flusher_restart_window_s``. Past the budget the crash is
+        permanent: queued requests fail, new submits are rejected and
+        ``on_crash`` fires — a crash loop must not masquerade as a
+        healthy service. ``0`` restores the pre-supervision behavior
+        (every crash is permanent).
+    flusher_restart_window_s : float
+        The sliding window the restart budget is counted over.
+    on_restart : callable, optional
+        ``on_restart(exc, n_in_window)`` invoked (on the dying thread,
+        before its replacement starts) per supervised restart; must not
+        raise (it is guarded). Restarts are always recorded in the
+        flight recorder and counted under ``serve/flusher_restarts``
+        regardless — the hook is for callers that want more (no debug
+        bundle by default: the permanent-death ``flusher_crash`` bundle
+        must stay the newest artifact after a crash loop).
     on_request_done : callable, optional
         ``on_request_done(ctx, kind, wall_s, status)`` invoked on the
         flusher thread for every request that reaches a terminal state
@@ -115,6 +137,9 @@ class MicroBatcher:
         on_request_done: Optional[
             Callable[[Optional[RequestContext], str, float, str], None]
         ] = None,
+        max_flusher_restarts: int = 3,
+        flusher_restart_window_s: float = 60.0,
+        on_restart: Optional[Callable[[BaseException, int], None]] = None,
     ) -> None:
         if max_batch_size < 1:
             raise ValueError('max_batch_size must be >= 1')
@@ -134,6 +159,11 @@ class MicroBatcher:
         self._on_request_done = on_request_done
         self._crashed: Optional[BaseException] = None
         self._last_flush_t: Optional[float] = None
+        self.max_flusher_restarts = int(max_flusher_restarts)
+        self.flusher_restart_window_s = float(flusher_restart_window_s)
+        self._on_restart = on_restart
+        self._restart_times: 'deque[float]' = deque()
+        self._restarts_total = 0
 
     # -- submission --------------------------------------------------------
 
@@ -227,36 +257,109 @@ class MicroBatcher:
         return take, reason
 
     def _flush_loop(self) -> None:
+        taken: List[_Request] = []
         try:
             while True:
-                take, reason = self._take()
-                if not take:
+                taken, reason = self._take()
+                if not taken:
                     return
-                self._flush(take, reason)
+                # the named chaos point for flusher-death schedules: an
+                # injected error here escapes the take loop (not the
+                # per-flush guard) and exercises the restart supervisor
+                fault_point('batcher.flush', requests=len(taken))
+                self._flush(taken, reason)
+                taken = []
                 self._last_flush_t = time.monotonic()
         except BaseException as e:  # noqa: BLE001 - the thread is dying
-            # A dead flusher would otherwise strand every queued (and
-            # future) request forever: record the crash, fail what is
-            # queued, reject new submits, and hand the exception to the
-            # crash hook (the service's debug-bundle dump).
-            self._crashed = e
-            counter('serve/flusher_crashes', unit='count').inc(1)
-            RECORDER.record(
-                'flusher_crash', error=f'{type(e).__name__}: {e}',
-                queue_depth=self.queue_depth,
-            )
-            with self._cond:
-                dropped, self._queue = self._queue, []
-            for r in dropped:
-                if r.future.set_running_or_notify_cancel():
-                    r.future.set_exception(
-                        RuntimeError(f'flusher thread died: {e!r}')
-                    )
-            if self._on_crash is not None:
+            self._crash(e, taken)
+
+    def _crash(self, e: BaseException, taken: List[_Request]) -> None:
+        """The dying flusher thread's last act: restart or fail everything.
+
+        Within the supervisor's budget (``max_flusher_restarts`` per
+        ``flusher_restart_window_s``) the thread is replaced and the
+        requests it had taken but not flushed go back to the FRONT of
+        the queue — order preserved, no future stranded, callers never
+        see the crash. Past the budget the crash is permanent (the
+        pre-supervision behavior): record it, fail what is queued,
+        reject new submits, and hand the exception to ``on_crash``.
+        """
+        now = time.monotonic()
+        restarted = False
+        n_window = 0
+        with self._cond:
+            cutoff = now - self.flusher_restart_window_s
+            while self._restart_times and self._restart_times[0] < cutoff:
+                self._restart_times.popleft()
+            if (
+                not self._closed
+                and len(self._restart_times) < self.max_flusher_restarts
+            ):
+                self._restart_times.append(now)
+                self._restarts_total += 1
+                n_window = len(self._restart_times)
+                self._queue[:0] = taken
+                restarted = True
+        if restarted:
+            # account + hook BEFORE the replacement starts: the new
+            # thread may crash instantly (a persistent fault), and its
+            # permanent-death dump must come chronologically after this
+            # restart's, not race it
+            counter('serve/flusher_restarts', unit='count').inc(1)
+            restart_payload = {
+                'error': f'{type(e).__name__}: {e}',
+                'restarts_in_window': n_window,
+                'requeued': len(taken),
+            }
+            RECORDER.record('flusher_restart', **restart_payload)
+            try:
+                # dual-write to the run log so `obsctl resil <runlog>`
+                # can show supervised restarts post-mortem (the recorder
+                # ring dies with the process)
+                from ..obs.trace import current_runlog
+
+                log = current_runlog()
+                if log is not None:
+                    log.event('flusher_restart', **restart_payload)
+            except Exception:
+                pass  # telemetry must not fail the restart
+            if self._on_restart is not None:
                 try:
-                    self._on_crash(e)
-                except Exception:  # the hook must not mask the crash
+                    self._on_restart(e, n_window)
+                except Exception:  # the hook must not kill the handler
                     pass
+            with self._cond:
+                # spawn even if close() raced in: the replacement drains
+                # a closed queue correctly and exits via _take
+                self._thread = threading.Thread(
+                    target=self._flush_loop, name='serve-flusher', daemon=True
+                )
+                self._thread.start()
+                self._cond.notify_all()
+            return
+        # A dead flusher would otherwise strand every queued (and
+        # future) request forever: record the crash, fail what is
+        # queued, reject new submits, and hand the exception to the
+        # crash hook (the service's debug-bundle dump).
+        self._crashed = e
+        counter('serve/flusher_crashes', unit='count').inc(1)
+        RECORDER.record(
+            'flusher_crash', error=f'{type(e).__name__}: {e}',
+            queue_depth=self.queue_depth,
+        )
+        with self._cond:
+            dropped, self._queue = self._queue, []
+        dropped = taken + dropped
+        for r in dropped:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(
+                    RuntimeError(f'flusher thread died: {e!r}')
+                )
+        if self._on_crash is not None:
+            try:
+                self._on_crash(e)
+            except Exception:  # the hook must not mask the crash
+                pass
 
     def _notify_done(self, req: _Request, wall_s: float, status: str) -> None:
         """Invoke the terminal-state hook; a raising hook never escapes."""
@@ -419,6 +522,12 @@ class MicroBatcher:
     def crashed(self) -> Optional[BaseException]:
         """The exception that killed the flusher thread, or None."""
         return self._crashed
+
+    @property
+    def flusher_restarts(self) -> int:
+        """Supervised flusher restarts performed so far (lifetime)."""
+        with self._lock:
+            return self._restarts_total
 
     @property
     def flusher_alive(self) -> bool:

@@ -43,6 +43,7 @@ lifecycle stages on top:
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
@@ -51,8 +52,17 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..obs import counter, span
+from ..resil.faults import fault_point
+from ..resil.retry import RetryPolicy, retry_call
 
 __all__ = ['ModelRegistry']
+
+#: Checkpoint loads retried under this policy: transient filesystem
+#: errors (a registry on network storage mid-failover) back off and
+#: retry; corrupt artifacts (checksum mismatch → ValueError) and missing
+#: versions (FileNotFoundError) raise immediately — waiting cannot fix
+#: either.
+LOAD_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=1.0)
 
 _NAME_RE = re.compile(r'^[A-Za-z0-9][A-Za-z0-9._-]*$')
 
@@ -159,7 +169,12 @@ class ModelRegistry:
         if not os.path.isfile(os.path.join(path, 'meta.json')):
             raise FileNotFoundError(f'no model at {path!r}')
         with span('serve/model_load', model=name, version=version):
-            model = load_model(path)
+
+            def _load() -> Any:
+                fault_point('registry.load', model=name, version=version)
+                return load_model(path)
+
+            model = retry_call(_load, site='registry.load', policy=LOAD_RETRY)
             self.warm(model)
         with self._lock:
             self._loaded.setdefault(key, model)
@@ -313,7 +328,12 @@ class ModelRegistry:
         return os.path.join(self.root, name, _CANDIDATES, tag)
 
     def stage_candidate(
-        self, name: str, model: Any, tag: Optional[str] = None
+        self,
+        name: str,
+        model: Any,
+        tag: Optional[str] = None,
+        *,
+        manifest: Optional[Dict[str, Any]] = None,
     ) -> Tuple[str, str]:
         """Save ``model`` as a staged candidate of ``name``; returns
         ``(tag, path)``.
@@ -325,6 +345,14 @@ class ModelRegistry:
         process-local sequence number (collision-free within a process;
         across processes the timestamp + refusal-to-overwrite guard
         surfaces the race instead of corrupting a checkpoint).
+
+        ``manifest``, when given, is written next to the checkpoint as
+        ``manifest.json`` — the **training manifest** (trained-game ids
+        + frozen drift-reference statistics) that travels with the
+        candidate through :meth:`promote_candidate`'s atomic rename, so
+        every published version carries the provenance a restarted
+        process needs (:meth:`load_manifest`; the drift watch rebuilds
+        its reference from it instead of guessing from store recency).
         """
         if tag is None:
             with self._lock:
@@ -336,7 +364,28 @@ class ModelRegistry:
             raise ValueError(f'candidate {name}/{tag} already staged at {path!r}')
         os.makedirs(path)
         model.save_model(path)
+        if manifest is not None:
+            with open(os.path.join(path, 'manifest.json'), 'w') as f:
+                json.dump(manifest, f, sort_keys=True, default=str)
         return tag, path
+
+    def load_manifest(
+        self, name: str, version: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
+        """The training manifest of ``name``/``version`` (default newest).
+
+        ``None`` when the version predates manifests (bootstrap
+        versions, pre-resilience checkpoints) — callers fall back to
+        their legacy reconstruction; a *corrupt* manifest raises (a
+        half-written provenance record must surface, not silently read
+        as absent).
+        """
+        version = self.resolve_version(name, version)
+        path = os.path.join(self._dir(name, version), 'manifest.json')
+        if not os.path.isfile(path):
+            return None
+        with open(path, encoding='utf-8') as f:
+            return json.load(f)
 
     def candidates(self, name: str) -> List[str]:
         """Staged candidate tags of ``name``, oldest first (by mtime)."""

@@ -45,6 +45,8 @@ from ..obs.numerics import drain_guards
 from ..obs.parity import ParityProbe
 from ..obs.recorder import dump_debug_bundle
 from ..obs.slo import SLOConfig, SLOEngine
+from ..resil.breaker import CircuitBreaker
+from ..resil.faults import fault_point
 from .batcher import MicroBatcher, Overloaded
 from .session import (
     WINDOW_LOCAL_KERNELS,
@@ -162,6 +164,20 @@ class RatingService:
         a non-finite value in a served dispatch is counted under
         ``num/nonfinite_total``, dumps a rate-limited debug bundle
         (``reason="nonfinite"``) and degrades :meth:`health`.
+    breaker : CircuitBreaker, optional
+        The circuit breaker on the fused dispatch
+        (:class:`~socceraction_tpu.resil.breaker.CircuitBreaker`).
+        ``breaker_failures`` consecutive *flush-level* dispatch failures
+        trip it open; flushes then route through the materialized
+        reference fallback (``rate_batch_reference`` — correct values,
+        slower path) instead of failing callers, :meth:`health` reports
+        ``'degraded'`` with the breaker block, and after
+        ``breaker_recovery_s`` one half-open probe flush tries the
+        fused path again — success closes the breaker. The default is a
+        breaker with those knobs; pass an explicit instance to share or
+        tune one, or ``breaker_failures=0`` to disable degradation
+        entirely (dispatch failures then fail their flush's futures, the
+        pre-resilience behavior).
     debug_dir : str, optional
         Where automatic flight-recorder bundles land
         (:func:`~socceraction_tpu.obs.recorder.dump_debug_bundle` on
@@ -187,6 +203,9 @@ class RatingService:
         request_deadline_ms: Optional[float] = None,
         capture: Any = None,
         parity: Optional[ParityProbe] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        breaker_failures: int = 3,
+        breaker_recovery_s: float = 5.0,
         debug_dir: Optional[str] = None,
         overload_dump_threshold: int = 64,
         overload_dump_window_s: float = 10.0,
@@ -246,6 +265,16 @@ class RatingService:
             if slo is not None
             else None
         )
+        if breaker is not None:
+            self._breaker: Optional[CircuitBreaker] = breaker
+        elif int(breaker_failures) > 0:
+            self._breaker = CircuitBreaker(
+                failure_threshold=int(breaker_failures),
+                recovery_time_s=float(breaker_recovery_s),
+                name='serve.dispatch',
+            )
+        else:
+            self._breaker = None
         self._batcher = MicroBatcher(
             self._flush,
             max_batch_size=max_batch_size,
@@ -320,7 +349,12 @@ class RatingService:
         """Atomically swap serving to ``name``/``version`` (default newest).
 
         The new version is validated, layout-guarded and ladder-warmed
-        before activation (:meth:`_prepare_swap_target`).
+        before activation (:meth:`_prepare_swap_target`). That ordering
+        is the corrupt-checkpoint fallback: a damaged artifact (the
+        registry load verifies content checksums and raises a
+        ``ValueError`` naming the artifact) fails *this call* on the
+        caller's thread — the previously active model keeps serving and
+        the flusher never sees the broken candidate.
         """
         if self._registry is None:
             raise RuntimeError('swap_model needs a registry-backed service')
@@ -607,6 +641,7 @@ class RatingService:
                 1, bucket=str(bucket)
             )
             gauge('serve/compiled_shapes', unit='shapes').set(n_shapes)
+        fault_point('serve.dispatch', bucket=bucket)
         batch = jax.device_put(host_batch)
         overrides = (
             {'goalscore': jnp.asarray(gs)}
@@ -615,6 +650,75 @@ class RatingService:
         )
         values = model.rate_batch(batch, dense_overrides=overrides, bucket=False)
         return np.asarray(jax.device_get(values))
+
+    def _reference_rate(
+        self,
+        host_batch: ActionBatch,
+        gs: Optional[np.ndarray],
+        model: Any,
+    ) -> np.ndarray:
+        """The degraded path: the materialized reference rating.
+
+        Same values contract as the fused dispatch (parity-pinned) but
+        computed through the materialized feature tensor — the path the
+        parity probe already keeps warm and honest. Slower per flush;
+        correct, which is what degradation is for.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        batch = jax.device_put(host_batch)
+        overrides = (
+            {'goalscore': jnp.asarray(gs)}
+            if self._gs_enabled and gs is not None
+            else None
+        )
+        values = model.rate_batch_reference(batch, dense_overrides=overrides)
+        return np.asarray(jax.device_get(values))
+
+    def _rate_with_breaker(
+        self,
+        host_batch: ActionBatch,
+        gs: Optional[np.ndarray],
+        model: Any,
+        bucket: int,
+    ) -> Tuple[np.ndarray, str]:
+        """One flush's rating through the breaker; returns (values, path).
+
+        ``path`` is ``'fused'`` (healthy or successful half-open probe)
+        or ``'fallback'`` (breaker open, or this flush's fused dispatch
+        failed). A fused failure is recorded on the breaker and the
+        SAME flush is served through the fallback — callers see
+        degraded latency, never a spurious error, and
+        ``failure_threshold`` consecutive failures trip the breaker so
+        later flushes skip the doomed dispatch entirely. A fallback
+        failure propagates (the batcher fails the flush's futures —
+        when both paths are down there is nothing to degrade to).
+        """
+        breaker = self._breaker
+        if breaker is None:
+            return self._device_rate(host_batch, gs, model, bucket), 'fused'
+        verdict = breaker.allow()
+        if verdict == 'open':
+            counter('serve/fallback_flushes', unit='count').inc(1)
+            return self._reference_rate(host_batch, gs, model), 'fallback'
+        try:
+            values = self._device_rate(host_batch, gs, model, bucket)
+        except Exception as e:
+            tripped = breaker.record_failure(e)
+            if tripped:
+                self._maybe_dump(
+                    'breaker_open',
+                    {
+                        'type': 'breaker_open',
+                        'error': f'{type(e).__name__}: {e}',
+                        'breaker': breaker.to_dict(),
+                    },
+                )
+            counter('serve/fallback_flushes', unit='count').inc(1)
+            return self._reference_rate(host_batch, gs, model), 'fallback'
+        breaker.record_success()
+        return values, 'fused'
 
     def _flush(self, payloads: List[_Payload], bucket: int) -> List[Any]:
         _name, _version, model = self._active()  # ONE read per flush
@@ -639,13 +743,20 @@ class RatingService:
         # (_device_rate's own pad then no-ops; warmup still relies on it)
         host_batch, gs = _pad_to_bucket(host_batch, gs, bucket)
         t_pad = time.perf_counter()
-        values = self._device_rate(host_batch, gs, model, bucket)
+        values, path = self._rate_with_breaker(host_batch, gs, model, bucket)
         t_dispatch = time.perf_counter()
         # the dispatch's results are on host now, so its side-band guard
         # scalars are ready: draining here converts without syncing
         # anything the flush did not already wait for
         self._drain_numeric_guards()
-        if self.parity is not None and self.parity.should_sample():
+        # fallback flushes already ARE the reference path — probing them
+        # would compare the reference against itself and read as parity
+        # evidence for a fused path that never ran
+        if (
+            self.parity is not None
+            and path == 'fused'
+            and self.parity.should_sample()
+        ):
             self.parity.submit_flush(
                 model, host_batch,
                 gs if self._gs_enabled else None, values,
@@ -805,8 +916,12 @@ class RatingService:
         ``numerics`` block (in-dispatch guard detections + parity-probe
         stats — ``status`` degrades to ``'degraded'`` when this
         service's flushes detected non-finite values or a parity probe
-        breached its band), rejection and debug-dump totals, and
-        ``last_dump`` (path or None).
+        breached its band), the ``breaker`` block (a non-closed
+        fused-dispatch breaker also reads ``'degraded'`` — flushes are
+        being served through the reference fallback),
+        ``flusher_restarts`` (supervised restarts absorbed so far),
+        rejection and debug-dump totals, and ``last_dump`` (path or
+        None).
         """
         snap = REGISTRY.snapshot()
         # worst p99 across traffic kinds (rate AND session) — a
@@ -842,9 +957,13 @@ class RatingService:
         numerics_ok = nonfinite_events == 0 and (
             parity_stats is None or parity_stats['exceedances'] == 0
         )
+        breaker_block = (
+            self._breaker.to_dict() if self._breaker is not None else None
+        )
+        breaker_ok = breaker_block is None or breaker_block['state'] == 'closed'
         if not state['flusher_alive']:
             status = 'flusher-dead'
-        elif not numerics_ok:
+        elif not numerics_ok or not breaker_ok:
             status = 'degraded'
         else:
             status = 'ok'
@@ -856,6 +975,8 @@ class RatingService:
                 'nonfinite_events': nonfinite_events,
                 'parity': parity_stats,
             },
+            'breaker': breaker_block,
+            'flusher_restarts': self._batcher.flusher_restarts,
             'model': {'name': name, 'version': version},
             'ladder': list(self.ladder),
             'compiled_shapes': self.compiled_shapes,
@@ -918,6 +1039,11 @@ class RatingService:
         """Distinct ``(bucket, max_actions)`` shapes dispatched so far."""
         with self._shape_lock:
             return len(self._seen_shapes)
+
+    @property
+    def breaker(self) -> Optional[CircuitBreaker]:
+        """The fused-dispatch circuit breaker (None when disabled)."""
+        return self._breaker
 
     @property
     def nonfinite_events(self) -> int:

@@ -70,6 +70,49 @@ def _check_format_version(meta: Dict[str, Any], path: str) -> None:
         )
 
 
+def _file_sha256(path: str) -> str:
+    """Streaming sha256 hex digest of one file."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _verify_checksums(meta: Dict[str, Any], path: str) -> None:
+    """Verify ``meta['checksums']`` before any artifact is deserialized.
+
+    Pre-checksum checkpoints (no ``checksums`` entry) load as before.
+    A missing, truncated or bit-flipped artifact raises a ``ValueError``
+    **naming the artifact** — the actionable operator error — instead
+    of whatever deep deserialization failure (or silent weight
+    corruption) the damaged bytes would otherwise produce downstream.
+    """
+    import os
+
+    checksums = meta.get('checksums')
+    if not checksums:
+        return
+    for rel, want in checksums.items():
+        artifact = os.path.join(path, rel)
+        try:
+            got = _file_sha256(artifact)
+        except FileNotFoundError:
+            raise ValueError(
+                f'checkpoint artifact missing: {artifact!r} is named in '
+                "meta.json's checksums but absent on disk (partial copy "
+                'or tampered checkpoint); re-publish the version'
+            ) from None
+        if got != want:
+            raise ValueError(
+                f'checkpoint artifact corrupt: {artifact!r} sha256 '
+                f'{got[:12]}… does not match the recorded {want[:12]}… '
+                '(truncated write or bit rot); re-publish the version'
+            )
+
+
 xfns_default: List[fs.FeatureTransfomer] = [
     fs.actiontype_onehot,
     fs.result_onehot,
@@ -809,14 +852,17 @@ class VAEP:
                 )
         os.makedirs(os.path.join(path, 'models'), exist_ok=True)
         heads = {}
+        artifacts: List[str] = []
         for col, model in self._models.items():
             if isinstance(model, MLPClassifier):
                 heads[col] = 'mlp'
                 model.save(os.path.join(path, 'models', f'{col}.npz'))
+                artifacts.append(f'models/{col}.npz')
             else:
                 heads[col] = 'pickle'
                 with open(os.path.join(path, 'models', f'{col}.pkl'), 'wb') as f:
                     pickle.dump(model, f)
+                artifacts.append(f'models/{col}.pkl')
         meta = {
             'format_version': CHECKPOINT_FORMAT_VERSION,
             'class': type(self).__name__,
@@ -824,6 +870,14 @@ class VAEP:
             'backend': self.backend,
             'xfns': [fn.__name__ for fn in self.xfns],
             'heads': heads,
+            # content integrity: sha256 per head artifact, verified on
+            # every load — a truncated or bit-flipped checkpoint fails
+            # with an error naming the artifact instead of a deep
+            # deserialization crash (or, worse, silently wrong weights)
+            'checksums': {
+                rel: _file_sha256(os.path.join(path, rel))
+                for rel in sorted(artifacts)
+            },
         }
         with open(os.path.join(path, 'meta.json'), 'w') as f:
             json.dump(meta, f, indent=2)
@@ -838,6 +892,7 @@ class VAEP:
             with open(os.path.join(path, 'meta.json')) as f:
                 meta = json.load(f)
             _check_format_version(meta, path)
+        _verify_checksums(meta, path)
         model = cls(
             xfns=[getattr(cls._fs, name) for name in meta['xfns']],
             nb_prev_actions=meta['nb_prev_actions'],

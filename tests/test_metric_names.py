@@ -112,6 +112,15 @@ def test_num_area_and_labels_are_registered():
     assert tool.KNOWN_LABELS['num'] == {'fn', 'output', 'pair'}
 
 
+def test_resil_area_and_labels_are_registered():
+    """The resilience layer's metric area (``resil/*``: fault injection,
+    retries, breaker, recovery) and its label contract are governed by
+    the lint gate from day one (ISSUE 10 satellite)."""
+    tool = _tool()
+    assert 'resil' in tool.KNOWN_AREAS
+    assert tool.KNOWN_LABELS['resil'] == {'point', 'kind', 'site', 'outcome'}
+
+
 def test_gate_reports_all_violations_per_site(tmp_path):
     """One site breaking several rules surfaces every violation in one
     run — not one per fix-and-rerun cycle (ISSUE 8 satellite)."""

@@ -1,0 +1,639 @@
+"""Unit tests for the resilience layer (socceraction_tpu.resil).
+
+Covers the ISSUE-10 contract piece by piece: deterministic seeded fault
+injection (nth-call / call-set / probability / latency, budget, glob
+matching, double-arm rejection, metric + recorder accounting), the typed
+retry engine (transient-vs-permanent classification, seeded jittered
+backoff, budgets, attempt timeouts, exhaustion surfacing the *last*
+underlying error), the three-state circuit breaker under a fake clock,
+the fsync'd iteration journal (torn-tail tolerance, stage-grammar
+replay), checkpoint content checksums (truncated/bit-flipped artifacts
+fail with an error naming the artifact; ``swap_model`` falls back to
+the active model), the retry adoption at the parquet-read and
+registry-load sites, and benchdiff's torn-ledger-line tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import (
+    synthetic_actions_frame,
+    write_synthetic_season,
+)
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs.recorder import RECORDER
+from socceraction_tpu.pipeline.store import SeasonStore
+from socceraction_tpu.resil import (
+    CircuitBreaker,
+    FaultPlan,
+    FaultSpec,
+    IterationJournal,
+    RetryPolicy,
+    classify_error,
+    fault_point,
+    injected_faults,
+    retry_call,
+)
+from socceraction_tpu.serve import ModelRegistry, RatingService
+from socceraction_tpu.vaep.base import VAEP, load_model
+
+HOME = 100
+
+
+def _snap_value(name, **labels):
+    return REGISTRY.snapshot().value(name, **labels)
+
+
+# ------------------------------------------------------- fault injection ----
+
+
+def test_fault_point_disarmed_is_noop():
+    assert injected_faults() == []
+    fault_point('serve.dispatch', anything=1)  # must not raise or record
+    assert injected_faults() == []
+
+
+def test_fault_plan_nth_on_calls_and_budget():
+    plan = FaultPlan(
+        seed=0,
+        specs=[
+            FaultSpec('a.one', error=RuntimeError, nth=2),
+            FaultSpec('a.set', error=OSError, on_calls=(1, 3), max_injections=1),
+            FaultSpec('a.every', error=OSError, max_injections=2),
+        ],
+    )
+    with plan:
+        fault_point('a.one')  # call 1: no fire
+        with pytest.raises(RuntimeError, match='injected fault'):
+            fault_point('a.one')  # call 2: fires
+        fault_point('a.one')  # nth implies a budget of one
+
+        with pytest.raises(OSError):
+            fault_point('a.set')  # call 1 in the set
+        fault_point('a.set')  # call 2 not in the set
+        fault_point('a.set')  # call 3 IS in the set, but budget spent
+
+        with pytest.raises(OSError):
+            fault_point('a.every')
+        with pytest.raises(OSError):
+            fault_point('a.every')
+        fault_point('a.every')  # budget of 2 spent
+    assert [h['point'] for h in plan.history] == [
+        'a.one', 'a.set', 'a.every', 'a.every',
+    ]
+    assert plan.calls == {'a.one': 3, 'a.set': 3, 'a.every': 3}
+
+
+def test_fault_plan_probability_is_seed_deterministic():
+    def drive(seed):
+        plan = FaultPlan(
+            seed=seed,
+            specs=[FaultSpec('p.read', error=OSError, probability=0.4)],
+        )
+        with plan:
+            for _ in range(40):
+                try:
+                    fault_point('p.read')
+                except OSError:
+                    pass
+        return plan.history
+
+    one, two = drive(7), drive(7)
+    assert one == two  # the reproducibility contract, bit for bit
+    assert 0 < len(one) < 40  # it IS probabilistic
+    assert drive(8) != one  # and the seed is what pins it
+
+
+def test_fault_plan_glob_and_latency():
+    plan = FaultPlan(
+        seed=0,
+        specs=[FaultSpec('serve.*', kind='latency', latency_s=0.05, nth=1)],
+    )
+    with plan:
+        t0 = time.perf_counter()
+        fault_point('serve.dispatch')  # matches the glob; sleeps, no raise
+        waited = time.perf_counter() - t0
+        fault_point('learn.publish')  # no match
+    assert waited >= 0.04
+    assert plan.history == [
+        {
+            'point': 'serve.dispatch', 'kind': 'latency',
+            'call': 1, 'injection': 1, 'info': {},
+        }
+    ]
+
+
+def test_fault_plan_double_arm_rejected():
+    plan = FaultPlan(seed=0)
+    with plan:
+        with pytest.raises(RuntimeError, match='already armed'):
+            FaultPlan(seed=1).arm()
+        # disarming a plan that is not armed is a no-op, not a takeover
+        FaultPlan(seed=1).disarm()
+        assert injected_faults() == []
+    # disarmed cleanly: a new plan can arm now
+    with FaultPlan(seed=2):
+        pass
+
+
+def test_injection_lands_in_metrics_and_flight_recorder():
+    before = _snap_value(
+        'resil/faults_injected', point='x.demo', kind='error'
+    )
+    RECORDER.clear()
+    with FaultPlan(seed=0, specs=[FaultSpec('x.demo', error=OSError, nth=1)]):
+        with pytest.raises(OSError):
+            fault_point('x.demo', batch=3)
+    after = _snap_value('resil/faults_injected', point='x.demo', kind='error')
+    assert after == before + 1
+    events = [e for e in RECORDER.events() if e['kind'] == 'fault_injected']
+    assert events and events[-1]['point'] == 'x.demo'
+    assert events[-1]['fault_kind'] == 'error'
+    assert events[-1]['info'] == {'batch': 3}
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match='kind'):
+        FaultSpec('a', kind='panic')
+    with pytest.raises(ValueError, match='probability'):
+        FaultSpec('a', probability=1.5)
+
+
+# ----------------------------------------------------------------- retry ----
+
+
+def _flaky(fail_times, exc_factory):
+    """A callable failing its first ``fail_times`` calls."""
+    calls = {'n': 0}
+
+    def fn():
+        calls['n'] += 1
+        if calls['n'] <= fail_times:
+            raise exc_factory(calls['n'])
+        return f'ok after {calls["n"]}'
+
+    fn.calls = calls
+    return fn
+
+
+def test_transient_oserror_retries_with_backoff_and_succeeds():
+    """The satellite pin: a transient OSError retries and recovers."""
+    sleeps = []
+    before_r = _snap_value('resil/retries', site='t.read', outcome='retried')
+    before_ok = _snap_value(
+        'resil/retries', site='t.read', outcome='recovered'
+    )
+    fn = _flaky(2, lambda n: OSError(f'flap {n}'))
+    out = retry_call(
+        fn,
+        site='t.read',
+        policy=RetryPolicy(max_attempts=4, base_delay_s=0.01, seed=0),
+        sleep=sleeps.append,
+    )
+    assert out == 'ok after 3'
+    assert len(sleeps) == 2  # one backoff per failed attempt
+    assert sleeps[1] > sleeps[0] * 1.0 or sleeps[1] <= 0.04  # capped doubling
+    snap = REGISTRY.snapshot()
+    assert snap.value('resil/retries', site='t.read', outcome='retried') == (
+        before_r + 2
+    )
+    assert snap.value(
+        'resil/retries', site='t.read', outcome='recovered'
+    ) == before_ok + 1
+
+
+def test_permanent_error_raises_immediately_with_zero_retries():
+    """The satellite pin: a schema/layout error never burns a retry."""
+    sleeps = []
+    before = _snap_value('resil/retries', site='t.schema', outcome='permanent')
+    fn = _flaky(99, lambda n: ValueError('layout mismatch: 7 != 9'))
+    with pytest.raises(ValueError, match='layout mismatch'):
+        retry_call(fn, site='t.schema', sleep=sleeps.append)
+    assert fn.calls['n'] == 1  # exactly one attempt
+    assert sleeps == []  # zero backoffs
+    assert _snap_value(
+        'resil/retries', site='t.schema', outcome='permanent'
+    ) == before + 1
+
+
+def test_filenotfound_is_permanent_despite_being_an_oserror():
+    fn = _flaky(99, lambda n: FileNotFoundError('no such store'))
+    with pytest.raises(FileNotFoundError):
+        retry_call(fn, site='t.missing', sleep=lambda _s: None)
+    assert fn.calls['n'] == 1
+    policy = RetryPolicy()
+    assert classify_error(FileNotFoundError(), policy) == 'permanent'
+    assert classify_error(OSError(), policy) == 'transient'
+    assert classify_error(TimeoutError(), policy) == 'transient'
+    # an unknown failure mode surfaces instead of spinning
+    assert classify_error(ZeroDivisionError(), policy) == 'permanent'
+
+
+def test_exhaustion_surfaces_the_last_underlying_error():
+    """The satellite pin: budget exhaustion re-raises the final OSError —
+    with the attempt count attached — never a synthetic timeout."""
+    before = _snap_value('resil/retries', site='t.flap', outcome='exhausted')
+    fn = _flaky(99, lambda n: OSError(f'disk glitch #{n}'))
+    with pytest.raises(OSError) as exc_info:
+        retry_call(
+            fn,
+            site='t.flap',
+            policy=RetryPolicy(max_attempts=3, seed=0),
+            sleep=lambda _s: None,
+        )
+    msg = str(exc_info.value)
+    assert 'disk glitch #3' in msg  # the LAST error, not the first
+    assert '3 attempt' in msg and 't.flap' in msg
+    assert not isinstance(exc_info.value, TimeoutError)
+    assert fn.calls['n'] == 3
+    assert _snap_value(
+        'resil/retries', site='t.flap', outcome='exhausted'
+    ) == before + 1
+
+
+def test_backoff_schedule_is_seeded_and_capped():
+    policy = RetryPolicy(
+        max_attempts=6, base_delay_s=0.1, max_delay_s=0.3, jitter=0.5, seed=42
+    )
+
+    def schedule():
+        sleeps = []
+        fn = _flaky(5, lambda n: OSError('x'))
+        retry_call(fn, site='t.sched', policy=policy, sleep=sleeps.append)
+        return sleeps
+
+    one, two = schedule(), schedule()
+    assert one == two  # seeded jitter replays exactly
+    assert len(one) == 5
+    # every delay within [(1-jitter)*d, d] of the capped exponential
+    for attempt, got in enumerate(one, start=1):
+        d = min(0.3, 0.1 * 2.0 ** (attempt - 1))
+        assert d * 0.5 - 1e-9 <= got <= d + 1e-9
+
+
+def test_budget_s_surfaces_before_an_unaffordable_sleep():
+    sleeps = []
+    fn = _flaky(99, lambda n: OSError(f'flap {n}'))
+    with pytest.raises(OSError, match='flap'):
+        retry_call(
+            fn,
+            site='t.budget',
+            policy=RetryPolicy(
+                max_attempts=100, base_delay_s=0.2, jitter=0.0, budget_s=0.5,
+            ),
+            sleep=sleeps.append,
+        )
+    # 0.2 slept (0.3 remains); attempt 2's 0.4 backoff does not fit, so
+    # the second failure surfaces instead of sleeping past the budget
+    assert fn.calls['n'] == 2
+    assert sleeps == [pytest.approx(0.2)]
+
+
+def test_attempt_timeout_is_transient_and_bounded():
+    calls = {'n': 0}
+
+    def stuck():
+        calls['n'] += 1
+        if calls['n'] == 1:
+            time.sleep(5.0)  # abandoned by the helper-thread timeout
+        return 'recovered'
+
+    out = retry_call(
+        stuck,
+        site='t.hang',
+        policy=RetryPolicy(max_attempts=2, attempt_timeout_s=0.1, seed=0),
+        sleep=lambda _s: None,
+    )
+    assert out == 'recovered'
+    policy = RetryPolicy()
+    assert classify_error(TimeoutError(), policy) == 'transient'
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError, match='max_attempts'):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match='jitter'):
+        RetryPolicy(jitter=2.0)
+
+
+# ------------------------------------------------ retry-site integration ----
+
+
+def test_parquet_read_retries_injected_transient_fault(tmp_path):
+    """A transient OSError inside the store's byte slurp retries and the
+    read succeeds (the ``ingest.read`` site adoption)."""
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=2, n_actions=32)
+    before = _snap_value(
+        'resil/retries', site='ingest.read', outcome='recovered'
+    )
+    with SeasonStore(store_path) as store:
+        gid = store.game_ids()[0]
+        with FaultPlan(
+            seed=0,
+            specs=[FaultSpec('ingest.read', error=OSError, nth=1)],
+        ) as plan:
+            frame = store.get_actions(gid)
+        assert len(frame) == 32
+        assert [h['point'] for h in plan.history] == ['ingest.read']
+    assert _snap_value(
+        'resil/retries', site='ingest.read', outcome='recovered'
+    ) == before + 1
+
+
+def test_parquet_missing_key_raises_immediately(tmp_path):
+    """A missing per-game file is permanent: KeyError with zero retries."""
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=1, n_actions=32)
+    before = _snap_value(
+        'resil/retries', site='ingest.read', outcome='retried'
+    )
+    with SeasonStore(store_path) as store:
+        with pytest.raises(KeyError):
+            store.get('actions/game_nope')
+    assert _snap_value(
+        'resil/retries', site='ingest.read', outcome='retried'
+    ) == before
+
+
+@pytest.fixture(scope='module')
+def tiny_model():
+    frame = synthetic_actions_frame(
+        game_id=0, home_team_id=HOME, seed=0, n_actions=160
+    )
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': HOME})
+    np.random.seed(0)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': (8,), 'max_epochs': 2},
+    )
+    return model
+
+
+def test_registry_load_retries_injected_transient_fault(tmp_path, tiny_model):
+    reg = ModelRegistry(str(tmp_path / 'reg'))
+    reg.publish('vaep', '1', tiny_model)
+    before = _snap_value(
+        'resil/retries', site='registry.load', outcome='recovered'
+    )
+    with FaultPlan(
+        seed=0, specs=[FaultSpec('registry.load', error=OSError, nth=1)]
+    ):
+        model = reg.load('vaep', '1')
+    assert model._models
+    assert _snap_value(
+        'resil/retries', site='registry.load', outcome='recovered'
+    ) == before + 1
+
+
+# --------------------------------------------------------------- breaker ----
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trip_halfopen_close_cycle():
+    clock = _Clock()
+    b = CircuitBreaker(
+        failure_threshold=3, recovery_time_s=5.0, name='t.path', clock=clock
+    )
+    before_trips = _snap_value('resil/breaker_trips')
+    assert b.allow() == 'closed'
+    b.record_failure(RuntimeError('x'))
+    b.record_failure(RuntimeError('x'))
+    assert b.state == 'closed'  # streak below threshold
+    tripped = b.record_failure(RuntimeError('third'))
+    assert tripped and b.state == 'open' and b.trips == 1
+    assert _snap_value('resil/breaker_trips') == before_trips + 1
+
+    # open: refused up front until the recovery dwell passes
+    assert b.allow() == 'open'
+    clock.t = 4.9
+    assert b.allow() == 'open'
+    clock.t = 5.1
+    assert b.allow() == 'probe'  # exactly one probe admitted
+    assert b.state == 'half_open'
+    assert b.allow() == 'open'  # concurrent callers wait on the probe
+
+    b.record_success()
+    assert b.state == 'closed'
+    assert b.allow() == 'closed'
+    snap = b.to_dict()
+    assert snap['trips'] == 1 and snap['state'] == 'closed'
+    assert snap['last_error'] == 'RuntimeError: third'
+
+
+def test_breaker_probe_failure_reopens_and_restarts_the_clock():
+    clock = _Clock()
+    b = CircuitBreaker(
+        failure_threshold=1, recovery_time_s=2.0, name='t.path2', clock=clock
+    )
+    assert b.record_failure(RuntimeError('boom'))
+    clock.t = 2.5
+    assert b.allow() == 'probe'
+    b.record_failure(RuntimeError('still down'))
+    assert b.state == 'open'
+    assert b.trips == 1  # a failed probe re-opens, it is not a new trip
+    clock.t = 4.0  # only 1.5s since the re-open
+    assert b.allow() == 'open'
+    clock.t = 4.6
+    assert b.allow() == 'probe'
+    b.record_success()
+    assert b.state == 'closed'
+
+
+def test_breaker_success_resets_the_failure_streak():
+    b = CircuitBreaker(failure_threshold=3, name='t.path3', clock=_Clock())
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == 'closed'  # never 3 consecutive
+
+
+def test_breaker_validation():
+    with pytest.raises(ValueError, match='failure_threshold'):
+        CircuitBreaker(failure_threshold=0)
+
+
+# --------------------------------------------------------------- journal ----
+
+
+def test_journal_append_is_durable_jsonl_and_replays(tmp_path):
+    path = str(tmp_path / 'journal.jsonl')
+    j = IterationJournal(path)
+    j.append('consumed', games=[1, 2], tag='cand-a', model_name='vaep')
+    j.append('verdict', verdict='rejected', tag='cand-a')
+    j.append('consumed', games=[3], tag='cand-b', model_name='vaep')
+    state = j.replay()
+    assert state.consumed_games == {1, 2, 3}
+    assert state.iterations == 1  # the rejected one closed
+    assert state.pending_stage == 'consumed'
+    assert state.open_iteration['tag'] == 'cand-b'
+    # entries() round-trips what was appended, in order
+    stages = [e['stage'] for e in j.entries()]
+    assert stages == ['consumed', 'verdict', 'consumed']
+    assert j.tail(2) == j.entries()[-2:]
+
+
+def test_journal_torn_tail_is_skipped_not_fatal(tmp_path):
+    path = str(tmp_path / 'journal.jsonl')
+    j = IterationJournal(path)
+    j.append('consumed', games=[1], tag='t', model_name='vaep')
+    j.append('verdict', verdict='promoted', tag='t')
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write('{"stage": "published", "versi')  # crash mid-append
+    state = j.replay()
+    assert state.skipped_lines == 1
+    assert state.pending_stage == 'verdict'
+    assert state.open_iteration['verdict'] == 'promoted'
+    # a torn tail never blocks new appends
+    j.append('published', version='2', tag='t')
+    assert j.replay().pending_stage == 'published'
+
+
+def test_journal_full_iteration_closes_on_activated(tmp_path):
+    j = IterationJournal(str(tmp_path / 'j.jsonl'))
+    j.append('consumed', games=['g1'], tag='t', model_name='vaep')
+    j.append('verdict', verdict='promoted', tag='t')
+    j.append('intent_publish', version='2', tag='t')
+    j.append('published', version='2', tag='t')
+    j.append('activated', version='2', tag='t')
+    state = j.replay()
+    assert state.iterations == 1
+    assert state.open_iteration is None and state.pending_stage is None
+    assert state.consumed_games == {'g1'}
+
+
+def test_journal_missing_file_replays_empty(tmp_path):
+    state = IterationJournal(str(tmp_path / 'absent.jsonl')).replay()
+    assert state.consumed_games == set()
+    assert state.open_iteration is None and state.iterations == 0
+
+
+# ------------------------------------------------- checkpoint integrity ----
+
+
+def test_checkpoint_checksums_catch_bit_flips_and_missing_files(
+    tmp_path, tiny_model
+):
+    """The satellite pin: a damaged artifact fails with an actionable
+    error NAMING the artifact, on load, before deserialization."""
+    path = str(tmp_path / 'ckpt')
+    tiny_model.save_model(path)
+    with open(os.path.join(path, 'meta.json')) as f:
+        meta = json.load(f)
+    assert set(meta['checksums']) == {
+        'models/scores.npz', 'models/concedes.npz'
+    }
+    assert load_model(path)._models  # intact artifacts verify
+
+    # flip one byte mid-file: sha256 mismatch names the artifact
+    victim = os.path.join(path, 'models', 'scores.npz')
+    blob = bytearray(open(victim, 'rb').read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(victim, 'wb') as f:
+        f.write(bytes(blob))
+    with pytest.raises(ValueError, match='scores.npz.*sha256|corrupt'):
+        load_model(path)
+
+    # a missing artifact is its own actionable error
+    os.unlink(victim)
+    with pytest.raises(ValueError, match='missing.*scores.npz'):
+        load_model(path)
+
+
+def test_pre_checksum_checkpoints_still_load(tmp_path, tiny_model):
+    path = str(tmp_path / 'ckpt-legacy')
+    tiny_model.save_model(path)
+    meta_path = os.path.join(path, 'meta.json')
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta['checksums']  # simulate a pre-resilience checkpoint
+    with open(meta_path, 'w') as f:
+        json.dump(meta, f)
+    assert load_model(path)._models
+
+
+def test_mlp_load_corrupt_npz_is_an_actionable_error(tmp_path):
+    path = str(tmp_path / 'not-a-checkpoint.npz')
+    with open(path, 'wb') as f:
+        f.write(b'PK\x03\x04 definitely truncated garbage')
+    from socceraction_tpu.ml.mlp import MLPClassifier
+
+    with pytest.raises(ValueError, match='corrupt') as exc_info:
+        MLPClassifier.load(path)
+    assert 'not-a-checkpoint.npz' in str(exc_info.value)
+
+
+def test_swap_model_falls_back_to_active_on_corrupt_candidate(
+    tmp_path, tiny_model
+):
+    """The satellite pin: a corrupt promoted version fails the swap on
+    the caller's thread; the active model keeps serving and the flush
+    path never sees the broken candidate."""
+    reg = ModelRegistry(str(tmp_path / 'reg'))
+    reg.publish('vaep', '1', tiny_model)
+    reg.publish('vaep', '2', tiny_model)
+    reg.activate('vaep', '1')
+    # corrupt version 2 on disk AFTER publish (publish re-saves; the
+    # registry's load-time checksum is the guard that must catch this)
+    victim = os.path.join(str(tmp_path / 'reg'), 'vaep', '2', 'models',
+                          'scores.npz')
+    blob = bytearray(open(victim, 'rb').read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(victim, 'wb') as f:
+        f.write(bytes(blob))
+
+    frame = synthetic_actions_frame(
+        game_id=9, home_team_id=HOME, seed=9, n_actions=64
+    )
+    with RatingService(
+        registry=reg, max_actions=256, max_batch_size=2, max_wait_ms=1.0,
+        debug_dir=str(tmp_path / 'debug'),
+    ) as svc:
+        before = svc.rate_sync(frame, home_team_id=HOME, timeout=60)
+        with pytest.raises(ValueError, match='corrupt'):
+            svc.swap_model('vaep', '2')
+        # still serving version 1, bitwise
+        assert reg.active()[:2] == ('vaep', '1')
+        after = svc.rate_sync(frame, home_team_id=HOME, timeout=60)
+        np.testing.assert_array_equal(
+            before.to_numpy(), after.to_numpy()
+        )
+        assert svc.health()['status'] == 'ok'
+
+
+# ------------------------------------------------------------- benchdiff ----
+
+
+def test_benchdiff_skips_torn_ledger_line_with_warning(tmp_path, capsys):
+    """The satellite pin: a corrupt trailing partial line is skipped
+    with a warning instead of failing the whole ledger parse."""
+    import tools.benchdiff as benchdiff
+
+    ledger = str(tmp_path / 'ledger.jsonl')
+    with open(ledger, 'w', encoding='utf-8') as f:
+        f.write(json.dumps({'recorded_unix': 1.0, 'platform': 'cpu'}) + '\n')
+        f.write(json.dumps({'recorded_unix': 2.0, 'platform': 'cpu'}) + '\n')
+        f.write('{"recorded_unix": 3.0, "plat')  # killed mid-append
+    entries = benchdiff._read_entries(ledger)
+    assert [e['recorded_unix'] for e in entries] == [1.0, 2.0]
+    err = capsys.readouterr().err
+    assert 'skipping corrupt ledger line 3' in err

@@ -74,14 +74,22 @@ def _read_entries(path: str) -> List[Dict[str, Any]]:
     except json.JSONDecodeError:
         pass
     entries = []
-    for line in stripped.splitlines():
+    for lineno, line in enumerate(stripped.splitlines(), start=1):
         line = line.strip()
         if not line:
             continue
         try:
             entry = json.loads(line)
         except json.JSONDecodeError:
-            continue  # a torn tail line in a live ledger is expected
+            # a torn tail line (bench killed mid-append) is expected and
+            # must not fail the whole ledger parse — but say so: a torn
+            # line ANYWHERE else suggests real corruption worth a look
+            print(
+                f'benchdiff: warning: skipping corrupt ledger line '
+                f'{lineno} in {path} (torn append?)',
+                file=sys.stderr,
+            )
+            continue
         if isinstance(entry, dict):
             entries.append(entry)
     return entries

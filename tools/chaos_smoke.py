@@ -1,0 +1,248 @@
+"""End-to-end chaos smoke: one seeded fault schedule, replayed twice.
+
+The ``make chaos-smoke`` gate for the resilience layer: fit a tiny VAEP
+model on synthetic actions, then drive the SAME seeded
+:class:`~socceraction_tpu.resil.faults.FaultPlan` through a live
+:class:`~socceraction_tpu.serve.RatingService` twice and assert the
+whole failure story — injection, supervision, degradation, recovery —
+happened, identically, both times:
+
+- a ``batcher.flush`` injection kills the flusher thread mid-load; the
+  supervised restart replaces it, re-queues the taken request, and the
+  caller's future still resolves (no stranded futures, no dropped work);
+- two consecutive ``serve.dispatch`` injections trip the circuit
+  breaker; the affected flushes and everything after them are served
+  through the materialized reference fallback (correct values, degraded
+  health), and after the recovery dwell one half-open probe flush closes
+  the breaker again (health back to ``ok``);
+- the plan's :attr:`~socceraction_tpu.resil.faults.FaultPlan.history`
+  from run 2 is **bit-identical** to run 1 — the reproducibility
+  contract chaos debugging depends on;
+- ``obsctl resil`` over the closed run log round-trips the injected
+  faults, breaker trips/probes and breaker state.
+
+Exit 0 on success; any violated invariant is a non-zero exit with the
+evidence printed. CPU-sized (a few seconds).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+__all__ = ['main']
+
+#: the seeded schedule: one flusher death, two dispatch failures
+SEED = 7
+
+
+def _plan():
+    from socceraction_tpu.resil.faults import FaultPlan, FaultSpec
+
+    return FaultPlan(
+        seed=SEED,
+        specs=[
+            # the flusher's 2nd take dies mid-load -> supervised restart
+            FaultSpec('batcher.flush', error=RuntimeError, nth=2),
+            # dispatch calls 3 and 4 fail -> breaker (threshold 2) trips
+            FaultSpec('serve.dispatch', error=RuntimeError, on_calls=(3, 4)),
+        ],
+    )
+
+
+def _drive(model, frame, runlog_path=None):
+    """One seeded chaos run; returns (history, evidence dict)."""
+    import contextlib as _ctx
+
+    from socceraction_tpu.obs import RunLog
+    from socceraction_tpu.resil.breaker import CircuitBreaker
+    from socceraction_tpu.serve import RatingService
+
+    plan = _plan()
+    # the breaker runs on an injected fake clock so the schedule is
+    # deterministic regardless of host speed: wall-clock dwells would
+    # let a slow run's later flushes drift past the recovery window and
+    # probe-close the breaker before the mid-schedule health check
+    clock = {'t': 0.0}
+    breaker = CircuitBreaker(
+        failure_threshold=2,
+        recovery_time_s=1000.0,
+        name='serve.dispatch',
+        clock=lambda: clock['t'],
+    )
+    log_cm = (
+        RunLog(runlog_path, config={'smoke': 'chaos', 'seed': SEED})
+        if runlog_path
+        else _ctx.nullcontext()
+    )
+    with log_cm:
+        with RatingService(
+            model,
+            max_actions=256,
+            max_batch_size=1,
+            max_wait_ms=1.0,
+            breaker=breaker,
+        ) as service:
+            with plan:
+                ratings = []
+                for _ in range(6):
+                    fut = service.rate(frame, home_team_id=100)
+                    ratings.append(fut.result(timeout=120))
+                health_degraded = service.health()
+                # advance the fake clock past the recovery dwell: the
+                # next flush is the half-open probe; the fused path is
+                # healthy again (the injection budget is spent), so it
+                # closes the breaker
+                clock['t'] += 2000.0
+                fut = service.rate(frame, home_team_id=100)
+                ratings.append(fut.result(timeout=120))
+                health_recovered = service.health()
+            evidence = {
+                'ratings_ok': all(len(r) == len(frame) for r in ratings),
+                'n_requests': len(ratings),
+                'flusher_restarts': service.health()['flusher_restarts'],
+                'breaker_trips': service.breaker.trips,
+                'status_degraded': health_degraded['status'],
+                'breaker_state_degraded': health_degraded['breaker']['state'],
+                'status_recovered': health_recovered['status'],
+                'breaker_state_recovered': health_recovered['breaker'][
+                    'state'
+                ],
+            }
+    return plan.history, evidence
+
+
+def main() -> int:
+    """Drive the seeded chaos schedule twice; returns an exit code."""
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.vaep.base import VAEP
+    from tools.obsctl import main as obsctl_main
+
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=120)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': 100})
+    np.random.seed(0)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': (8,), 'max_epochs': 2},
+    )
+
+    problems = []
+    with tempfile.TemporaryDirectory(prefix='chaos-smoke-') as tmp:
+        runlog_path = os.path.join(tmp, 'obs.jsonl')
+        history1, ev = _drive(model, frame, runlog_path)
+        history2, _ = _drive(model, frame)
+
+        # -- the failure story happened ---------------------------------
+        if not ev['ratings_ok']:
+            problems.append('a rating came back misaligned with its request')
+        if ev['flusher_restarts'] != 1:
+            problems.append(
+                f'expected exactly 1 supervised flusher restart, saw '
+                f'{ev["flusher_restarts"]}'
+            )
+        if ev['breaker_trips'] != 1:
+            problems.append(
+                f'expected exactly 1 breaker trip, saw {ev["breaker_trips"]}'
+            )
+        if (ev['status_degraded'], ev['breaker_state_degraded']) != (
+            'degraded',
+            'open',
+        ):
+            problems.append(
+                'mid-schedule health should be degraded/open, saw '
+                f'{ev["status_degraded"]}/{ev["breaker_state_degraded"]}'
+            )
+        if (ev['status_recovered'], ev['breaker_state_recovered']) != (
+            'ok',
+            'closed',
+        ):
+            problems.append(
+                'post-recovery health should be ok/closed, saw '
+                f'{ev["status_recovered"]}/{ev["breaker_state_recovered"]}'
+            )
+
+        # -- and it happened identically both times ----------------------
+        if history1 != history2:
+            problems.append(
+                f'seed {SEED} is not reproducible:\n'
+                f'  run 1: {json.dumps(history1, sort_keys=True)}\n'
+                f'  run 2: {json.dumps(history2, sort_keys=True)}'
+            )
+        fired = [(h['point'], h['kind']) for h in history1]
+        expected = [
+            ('batcher.flush', 'error'),
+            ('serve.dispatch', 'error'),
+            ('serve.dispatch', 'error'),
+        ]
+        if fired != expected:
+            problems.append(
+                f'injection sequence {fired} != expected {expected}'
+            )
+
+        # -- and obsctl resil reconstructs it from the run log -----------
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = obsctl_main(['resil', runlog_path, '--json'])
+        if rc != 0:
+            problems.append('obsctl resil failed on the run log')
+        else:
+            summary = json.loads(out.getvalue())
+            faults = {
+                (row['point'], row['kind']): row['total']
+                for row in summary.get('faults_injected', [])
+            }
+            if faults.get(('batcher.flush', 'error'), 0) < 1:
+                problems.append(
+                    f'obsctl resil lost the flusher injection: {faults}'
+                )
+            if faults.get(('serve.dispatch', 'error'), 0) < 2:
+                problems.append(
+                    f'obsctl resil lost the dispatch injections: {faults}'
+                )
+            breaker = summary.get('breaker') or {}
+            if not breaker.get('trips'):
+                problems.append(f'obsctl resil lost the breaker trip: {breaker}')
+            if breaker.get('state') != 'closed':
+                problems.append(
+                    f'final breaker state in the log should be closed: '
+                    f'{breaker}'
+                )
+            kinds = {
+                e.get('event') or e.get('kind')
+                for e in summary.get('events', [])
+            }
+            missing = {'fault_injected', 'breaker_transition'} - kinds
+            if missing:
+                problems.append(f'run log missing resil events: {missing}')
+
+    if problems:
+        for p in problems:
+            print(f'chaos-smoke: FAIL - {p}')
+        return 1
+    print(
+        f'chaos-smoke: OK - seed {SEED} reproduced '
+        f'{len(history1)} injection(s) bit-for-bit; flusher restart '
+        'absorbed, breaker tripped -> half-open probe -> closed, '
+        'health ok'
+    )
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

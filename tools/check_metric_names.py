@@ -69,6 +69,7 @@ KNOWN_AREAS = {
     'mem',  # device-memory accounting (obs/memory.py)
     'num',  # numeric health: in-dispatch guards + parity probes (obs/numerics.py, obs/parity.py)
     'pipeline',  # store/feed/cache stage timings
+    'resil',  # fault injection / retries / breaker / recovery (resil/)
     'serve',  # online rating service (batcher/session/registry/service)
     'slo',  # SLO engine: burn rates, budgets, sheds (obs/slo.py)
     'train',  # MLP fit loop + bench training configs
@@ -105,6 +106,13 @@ KNOWN_AREAS = {
 #:   the guarded output slot per site (probs|logits|loss|grid|residual),
 #:   ``pair`` the parity path-pairs
 #:   (fused_vs_materialized|incremental_vs_replay).
+#: - ``resil``: ``point`` values are the named fault points (a literal
+#:   per marker — serve.dispatch, ingest.read, registry.load,
+#:   batcher.flush, learn.publish), ``kind`` error|latency, ``site``
+#:   the retry call sites (one literal per adoption — ingest.read,
+#:   registry.load, recorder.dump, bench.ledger), ``outcome``
+#:   retried|recovered|exhausted|permanent for retries and the
+#:   breaker-probe / recovery verdicts elsewhere — all bounded by code.
 KNOWN_LABELS = {
     'bench': {'path', 'platform'},
     'drift': {'feature'},
@@ -112,6 +120,7 @@ KNOWN_LABELS = {
     'mem': {'span', 'device'},
     'num': {'fn', 'output', 'pair'},
     'pipeline': {'stage'},
+    'resil': {'point', 'kind', 'site', 'outcome'},
     'serve': {'reason', 'kind', 'bucket', 'segment'},
     'slo': {'objective', 'outcome', 'window'},
     'train': {'path', 'platform'},

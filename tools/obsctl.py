@@ -15,6 +15,8 @@ required writing Python. ``obsctl`` is the no-Python surface::
     python tools/obsctl.py promotions obs.jsonl  # gate decisions, readable
     python tools/obsctl.py drift obs.jsonl       # drift-watch checks
     python tools/obsctl.py numerics obs.jsonl    # numeric health (num/*)
+    python tools/obsctl.py resil obs.jsonl       # resilience surface (resil/*)
+    python tools/obsctl.py resil --journal learn-journal.jsonl  # + journal tail
 
 ``trace`` reconstructs one request's queue → flush → dispatch → slice
 path from its ``request_enqueue``/``request_done`` events plus the
@@ -33,8 +35,16 @@ argument — plus the recent ``nonfinite_detected`` /
 A missing or unreadable runlog path exits 1 with a one-line error (no
 traceback) — the operator-under-pressure contract.
 
+``resil`` summarizes the resilience surface: the fused-dispatch circuit
+breaker (state gauge, trips, probe verdicts), per-site retry counters
+(``resil/retries{site,outcome}``), injected-fault totals and the recent
+``fault_injected`` / ``breaker_transition`` / ``retry`` /
+``journal_recovery`` events — plus, with ``--journal``, the tail of a
+continuous-learner iteration journal (the crash-recovery decision
+trail).
+
 ``snapshot``/``tail``/``trace``/``bundle``/``promotions``/``drift``/
-``numerics`` accept ``--json`` for
+``numerics``/``resil`` accept ``--json`` for
 machine-readable output (``prom`` *is* a machine format already); the
 default rendering is a compact human table. ``promotions`` tails the
 continuous-learning loop's typed promotion reports (verdict, per-head
@@ -226,6 +236,36 @@ def _fmt_event(event: Dict[str, Any]) -> str:
             f'pair={event.get("pair")} '
             f'max_abs_err={event.get("max_abs_err")} '
             f'band={event.get("band")}'
+        )
+    if kind == 'fault_injected':
+        parts.append(
+            f'point={event.get("point")} kind={event.get("fault_kind")} '
+            f'call={event.get("call")}'
+        )
+    if kind == 'breaker_transition':
+        parts.append(
+            f'{event.get("breaker")}: {event.get("from")} -> {event.get("to")}'
+            + (
+                f'  last_error={event.get("last_error")}'
+                if event.get('last_error')
+                else ''
+            )
+        )
+    if kind == 'retry':
+        parts.append(
+            f'site={event.get("site")} attempt={event.get("attempt")} '
+            f'delay={event.get("delay_s")}s error={event.get("error")}'
+        )
+    if kind == 'flusher_restart':
+        parts.append(
+            f'restarts_in_window={event.get("restarts_in_window")} '
+            f'requeued={event.get("requeued")} error={event.get("error")}'
+        )
+    if kind == 'journal_recovery':
+        parts.append(
+            f'pending={event.get("pending_stage")} '
+            f'outcome={event.get("outcome")} '
+            f'consumed_games={event.get("consumed_games")}'
         )
     return '  '.join(parts)
 
@@ -501,6 +541,132 @@ def _cmd_numerics(args: argparse.Namespace) -> int:
     return 0
 
 
+#: resil/breaker_state gauge decoding (resil/breaker.py::_STATE_VALUE)
+_BREAKER_STATES = {0: 'closed', 1: 'half_open', 2: 'open'}
+
+
+def _resil_summary(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """Summarize the ``resil/*`` instruments of a compact snapshot dict."""
+
+    def series(name: str):
+        return (snapshot.get(name) or {}).get('series', [])
+
+    def label_rows(name: str, *keys: str):
+        return [
+            {
+                **{k: (s.get('labels') or {}).get(k, '?') for k in keys},
+                'total': int(s.get('total') or 0),
+            }
+            for s in series(name)
+        ]
+
+    state = None
+    for s in series('resil/breaker_state'):
+        raw = s.get('last')
+        if raw is not None:
+            state = _BREAKER_STATES.get(int(raw), f'?{raw}')
+    trips = sum(int(s.get('total') or 0) for s in series('resil/breaker_trips'))
+    return {
+        'breaker': {
+            'state': state,
+            'trips': trips,
+            'probes': label_rows('resil/breaker_probes', 'outcome'),
+        },
+        'retries': label_rows('resil/retries', 'site', 'outcome'),
+        'faults_injected': label_rows(
+            'resil/faults_injected', 'point', 'kind'
+        ),
+        'recoveries': label_rows('resil/recoveries', 'outcome'),
+    }
+
+
+def _cmd_resil(args: argparse.Namespace) -> int:
+    """``resil [runlog] [--journal J] [-n N]``: the resilience surface.
+
+    ``resil/*`` counters and the breaker state from the run log's last
+    embedded snapshot — or the live process registry with no argument —
+    plus the recent ``fault_injected`` / ``breaker_transition`` /
+    ``retry`` / ``flusher_restart`` / ``journal_recovery`` events, and
+    (with ``--journal``) the tail of an iteration journal.
+    """
+    resil_events: List[Dict[str, Any]] = []
+    if args.runlog:
+        events = _read_events(args.runlog)
+        snapshot = _last_snapshot(events) or {}
+        resil_events = [
+            e
+            for e in events
+            if (e.get('event') or e.get('kind'))
+            in (
+                'fault_injected',
+                'breaker_transition',
+                'retry',
+                'flusher_restart',
+                'journal_recovery',
+            )
+        ][-args.n :]
+        source = args.runlog
+    else:
+        from socceraction_tpu.obs import REGISTRY, snapshot_dict
+
+        snapshot = snapshot_dict(REGISTRY.snapshot(), buckets=False)
+        source = 'live registry'
+    summary = _resil_summary(snapshot)
+    summary['events'] = resil_events
+    if args.journal:
+        from socceraction_tpu.resil.journal import IterationJournal
+
+        if not os.path.isfile(args.journal):
+            print(f'obsctl: no journal at {args.journal!r}', file=sys.stderr)
+            return 1
+        summary['journal'] = IterationJournal(args.journal).tail(args.n)
+    if args.json:
+        print(json.dumps(summary, sort_keys=True, default=str))
+        return 0
+    breaker = summary['breaker']
+    if breaker['state'] is not None or breaker['trips']:
+        line = f'breaker   : state={breaker["state"]} trips={breaker["trips"]}'
+        for row in breaker['probes']:
+            line += f' probes[{row["outcome"]}]={row["total"]}'
+        print(line)
+    for row in summary['retries']:
+        print(
+            f'retries   : site={row["site"]} outcome={row["outcome"]} '
+            f'total={row["total"]}'
+        )
+    for row in summary['faults_injected']:
+        print(
+            f'faults    : point={row["point"]} kind={row["kind"]} '
+            f'total={row["total"]}'
+        )
+    for row in summary['recoveries']:
+        print(
+            f'recovery  : outcome={row["outcome"]} total={row["total"]}'
+        )
+    for event in resil_events:
+        print('  ' + _fmt_event(event))
+    for entry in summary.get('journal') or ():
+        print(
+            f'journal   : {_fmt_ts(entry.get("ts"))}  '
+            f'{str(entry.get("stage", "?")).ljust(14)}'
+            + (f' verdict={entry["verdict"]}' if entry.get('verdict') else '')
+            + (f' version={entry["version"]}' if entry.get('version') else '')
+            + (f' tag={entry["tag"]}' if entry.get('tag') else '')
+            + (' (recovered)' if entry.get('recovered') else '')
+        )
+    n_rows = (
+        len(summary['retries'])
+        + len(summary['faults_injected'])
+        + len(summary['recoveries'])
+        + (1 if breaker['state'] is not None else 0)
+    )
+    print(
+        f'obsctl resil: {n_rows} resil row(s), '
+        f'{len(resil_events)} event(s) from {source}'
+    )
+    return 0
+
+
 def _fmt_promotion(event: Dict[str, Any]) -> str:
     """One human-readable line block per promotion report."""
     lines = []
@@ -692,6 +858,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument('-n', type=int, default=10, help='recent events shown')
     p.add_argument('--json', action='store_true')
     p.set_defaults(fn=_cmd_numerics)
+
+    p = sub.add_parser(
+        'resil', help='resilience: breaker, retries, faults, journal'
+    )
+    p.add_argument(
+        'runlog', nargs='?',
+        help='obs.jsonl to read (default: this process)',
+    )
+    p.add_argument(
+        '--journal', help='iteration-journal JSONL to tail alongside'
+    )
+    p.add_argument('-n', type=int, default=10, help='recent entries shown')
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_resil)
 
     p = sub.add_parser(
         'promotions', help="tail the continuous-learning loop's gate decisions"
