@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke capacity-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -40,6 +40,17 @@ obs-smoke:
 # round-trips the fault/breaker surface from the run log
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/chaos_smoke.py
+
+# the capacity observatory, driven end to end on CPU:
+# tools/capacity_smoke.py serves a warm request sequence through a
+# registry-loaded model (live-roofline gauges + device-idle fraction
+# recorded, residency ledger reconciled against the census, zero
+# steady-state retraces preserved, `obsctl capacity` round-trips from
+# the run log AND live) and re-execs `bench.py --cold-start` (a clean
+# child measured process-start -> first-rated-action with a full
+# per-phase breakdown bounded by the wall)
+capacity-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/capacity_smoke.py
 
 types:
 	@$(PY) -c "import mypy" 2>/dev/null \

@@ -47,6 +47,7 @@ import optax
 from flax import linen as nn
 
 from ..obs import counter, histogram, span
+from ..obs.perf import record_dispatch
 from ..obs.xla import instrument_jit
 
 __all__ = ['MLPClassifier', 'MLP_FORMAT_VERSION']
@@ -409,9 +410,18 @@ class MLPClassifier:
                 epoch_health.append(health)
                 # dispatch wall, not device wall: the epoch is async like
                 # every hot path; bench.py owns synced throughput numbers
+                epoch_wall = time.perf_counter() - t0
                 histogram('train/epoch_seconds', unit='s').observe(
-                    time.perf_counter() - t0, **labels
+                    epoch_wall, **labels
                 )
+                # live-roofline feed: inter-epoch gaps drive the
+                # trainer's perf/device_idle_frac and the dispatch-wall
+                # histogram. train_epoch is instrumented cost=False (no
+                # AOT analysis per fit instance), so record_dispatch
+                # finds no flops/bytes here and the achieved-rate
+                # gauges stay absent for this loop — the idle fraction
+                # is the trainer's capacity signal
+                record_dispatch('train_epoch', epoch_wall)
                 counter('train/epochs', unit='count').inc(1, **labels)
                 counter('train/steps', unit='count').inc(
                     trainer.steps, **labels

@@ -36,6 +36,15 @@ where a caller asks for device sync or named scopes):
 - :mod:`socceraction_tpu.obs.parity` — :class:`ParityProbe`, the
   sampled off-thread shadow re-execution of serve flushes through the
   materialized reference path (abs/ulp error histograms per path pair).
+- :mod:`socceraction_tpu.obs.perf` — the live roofline:
+  :func:`record_dispatch` divides AOT cost by measured dispatch walls
+  into ``perf/*`` gauges, with a per-loop device-idle detector.
+- :mod:`socceraction_tpu.obs.residency` — the HBM residency ledger:
+  :func:`claim_bytes` named-owner byte claims, reconciled against the
+  live-array census by :func:`residency_report`.
+- :mod:`socceraction_tpu.obs.coldstart` — the cold-start timeline:
+  phase-marked startup spans anchored at OS process start, reported by
+  :func:`coldstart_report`.
 
 ``socceraction_tpu.utils.profiling`` is a thin façade over this package:
 its ``timed``/``record_value``/``timer_report`` keep working and now
@@ -47,11 +56,14 @@ from typing import Any
 
 __all__ = [
     'CardinalityError',
+    'Claim',
+    'ColdstartTimeline',
     'Counter',
     'DeadlineExceeded',
     'FlightRecorder',
     'Gauge',
     'Histogram',
+    'IdleTracker',
     'InstrumentedJit',
     'GuardEvent',
     'MemorySampler',
@@ -66,6 +78,8 @@ __all__ = [
     'SLOEngine',
     'SLOObjective',
     'Span',
+    'claim_bytes',
+    'coldstart_report',
     'cost_analysis',
     'counter',
     'current_runlog',
@@ -74,6 +88,7 @@ __all__ = [
     'device_memory_stats',
     'drain_guards',
     'dump_debug_bundle',
+    'fn_cost',
     'gauge',
     'guards_enabled',
     'histogram',
@@ -84,9 +99,14 @@ __all__ = [
     'note_guard',
     'observatory_snapshot',
     'overflow_count',
+    'owned_bytes',
+    'perf_snapshot',
+    'process_start_unix',
     'prometheus_text',
+    'record_dispatch',
     'record_nonfinite',
     'record_overflow',
+    'residency_report',
     'run_manifest',
     'sample_device_memory',
     'snapshot_dict',
@@ -109,8 +129,13 @@ _HOMES = {
     'slo': ('SLOConfig', 'SLOEngine', 'SLOObjective'),
     'export': ('prometheus_text', 'snapshot_dict', 'timer_report_compat'),
     'xla': (
-        'InstrumentedJit', 'cost_analysis', 'instrument_jit',
+        'InstrumentedJit', 'cost_analysis', 'fn_cost', 'instrument_jit',
         'observatory_snapshot',
+    ),
+    'perf': ('IdleTracker', 'perf_snapshot', 'record_dispatch'),
+    'residency': ('Claim', 'claim_bytes', 'owned_bytes', 'residency_report'),
+    'coldstart': (
+        'ColdstartTimeline', 'coldstart_report', 'process_start_unix',
     ),
     'memory': (
         'MemorySampler', 'device_memory_stats', 'live_array_census',

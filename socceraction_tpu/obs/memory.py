@@ -106,9 +106,16 @@ def live_array_census(top: int = 10) -> Dict[str, Any]:
     """Aggregate ``jax.live_arrays()`` by ``(dtype, shape)`` on demand.
 
     The "what is actually resident" answer behind an HBM creep: returns
-    ``{'supported', 'n_arrays', 'total_bytes', 'top': [...]}`` with the
-    ``top`` largest buffer groups (count, per-buffer nbytes, total).
-    ``supported=False`` (and nothing else) when jax is not loaded.
+    ``{'supported', 'n_arrays', 'total_bytes', 'top': [...], 'other'}``
+    with the ``top`` largest buffer groups (count, per-buffer nbytes,
+    total). The snapshot is **bounded regardless of how many distinct
+    buffer groups are live**: everything past the top ``top`` is
+    summarized into the single ``other`` bucket (``{'groups', 'count',
+    'total_bytes'}`` — None when nothing overflowed), so a census taken
+    mid-flight during a 1024-grid xT fleet fit (thousands of live
+    buffers across many shapes) stays a fixed-size report whose totals
+    still account for every byte. ``supported=False`` (and nothing
+    else) when jax is not loaded.
     """
     jax = sys.modules.get('jax')
     if jax is None:
@@ -130,6 +137,15 @@ def live_array_census(top: int = 10) -> Dict[str, Any]:
         entry[0] += 1
         entry[1] += nbytes
     ranked = sorted(groups.items(), key=lambda kv: kv[1][1], reverse=True)
+    kept = ranked[: max(top, 0)]
+    rest = ranked[len(kept):]
+    other = None
+    if rest:
+        other = {
+            'groups': len(rest),
+            'count': sum(count for _key, (count, _b) in rest),
+            'total_bytes': sum(nbytes for _key, (_c, nbytes) in rest),
+        }
     return {
         'supported': True,
         'n_arrays': len(arrays),
@@ -141,8 +157,9 @@ def live_array_census(top: int = 10) -> Dict[str, Any]:
                 'count': count,
                 'total_bytes': nbytes,
             }
-            for (dtype, shape), (count, nbytes) in ranked[: max(top, 0)]
+            for (dtype, shape), (count, nbytes) in kept
         ],
+        'other': other,
     }
 
 

@@ -1,0 +1,320 @@
+"""Live roofline: achieved FLOPs/bytes per dispatch + a device-idle detector.
+
+The compile observatory (:mod:`socceraction_tpu.obs.xla`) already knows
+what every hot function *should* cost — the AOT ``cost_analysis()``
+FLOPs and bytes recorded at compile time — and the hot paths already
+time their dispatches. Until now nothing connected the two: "how close
+to the hardware does production actually run" was a bench-only number.
+This module is that connection, the runtime half of the capacity
+observatory:
+
+- :func:`record_dispatch` — called by a hot path with one dispatch's
+  *host-synced* wall (the serve flush, the epoch trainer, the xT fleet
+  solve), it divides the function's AOT cost by the measured wall into
+  governed ``perf/*`` gauges and feeds the per-function idle detector:
+
+  | metric | kind (unit) | meaning |
+  |---|---|---|
+  | ``perf/dispatches`` | counter (count) | dispatches seen (sampled or not) |
+  | ``perf/dispatch_seconds`` | histogram (s) | sampled dispatch walls |
+  | ``perf/achieved_flops`` | gauge (flops/s) | AOT cost FLOPs / measured wall |
+  | ``perf/achieved_bytes`` | gauge (bytes/s) | AOT cost bytes / measured wall |
+  | ``perf/roofline_frac`` | gauge (ratio) | achieved / device peak (binding wall) |
+  | ``perf/device_idle_frac`` | gauge (ratio) | idle fraction of the dispatch loop |
+
+  All labeled ``fn`` (the ``instrument_jit`` name, so the cost lookup
+  and the roofline read the same books) plus an optional ``bucket``
+  (the serve ladder rung / the pow-2 xT fleet size — bounded by
+  construction).
+
+- :class:`IdleTracker` — the device-idle detector: each ``observe``
+  is one dispatch completion with its busy wall; the tracker estimates
+  the fraction of the recent window the loop spent NOT dispatching
+  (inter-dispatch gaps in the serve flusher, inter-epoch gaps in the
+  trainer). "Host-bound in production" becomes a number instead of a
+  bench-only guess.
+
+Honesty caveats (documented, not hidden):
+
+- the cost numbers are XLA's **upper-bound estimate** for the *last
+  analyzed signature* of the function (``cost='first'`` default: the
+  first compile). A smaller bucket dispatch divided by the big-bucket
+  cost over-reads; treat ``roofline_frac`` as a trend line per
+  ``(fn, bucket)`` series, not an absolute efficiency claim.
+- on CPU there is no peak entry in :data:`DEVICE_PEAKS`, so
+  ``roofline_frac`` is never recorded there — ``achieved_flops`` /
+  ``achieved_bytes`` still are (they only need the cost model), which
+  is what the CPU smokes assert.
+- walls must be host-synced to mean anything. The serve flush wall ends
+  after its ``device_get``; the xT solve wall ends after the iteration
+  fetch. The epoch trainer's wall is a *dispatch* wall (its loop is
+  async unless an eval syncs each epoch) — and ``train_epoch`` is
+  instrumented ``cost=False``, so the trainer feeds only the dispatch
+  counter/histogram and the idle detector; its achieved-rate gauges
+  stay absent unless a caller passes explicit ``flops``/``bytes``.
+
+Sampling: ``SOCCERACTION_TPU_PERF_SAMPLE_N`` records the full gauge set
+on every Nth dispatch per function (default 1 — every dispatch; the
+cost is a handful of dict/lock operations, orders of magnitude under
+any real dispatch). ``perf/dispatches`` and the idle detector always
+run (the idle signal needs every gap). ``0`` disables the module
+entirely.
+
+Everything here is importable (and callable) without jax — the obs
+package contract; the device kind is read only when jax is already
+loaded.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from socceraction_tpu.obs.metrics import REGISTRY, MetricRegistry
+
+__all__ = [
+    'DEVICE_PEAKS',
+    'IdleTracker',
+    'device_peaks',
+    'idle_tracker',
+    'perf_snapshot',
+    'record_dispatch',
+    'reset_perf',
+]
+
+#: Peak specs per ``device_kind`` prefix (public TPU spec-sheet numbers;
+#: the one table ``bench.py``'s roofline and the runtime observatory
+#: share). v5 lite (v5e): 197 TFLOP/s bf16 MXU, 819 GB/s HBM. No CPU
+#: entry on purpose: a CPU "roofline fraction" against an MXU peak would
+#: be noise presented as signal.
+DEVICE_PEAKS: Dict[str, Dict[str, float]] = {
+    'TPU v5 lite': {'tflops_bf16': 197.0, 'hbm_gb_s': 819.0},
+    'TPU v5': {'tflops_bf16': 459.0, 'hbm_gb_s': 1228.0},
+    'TPU v4': {'tflops_bf16': 275.0, 'hbm_gb_s': 1228.0},
+}
+
+
+def device_peaks(device_kind: Optional[str]) -> Optional[Dict[str, float]]:
+    """The peak-spec entry whose prefix matches ``device_kind``, or None."""
+    if not device_kind:
+        return None
+    for prefix, peaks in DEVICE_PEAKS.items():
+        if device_kind.startswith(prefix):
+            return peaks
+    return None
+
+
+_detected_kind: Optional[str] = None
+
+
+def _device_kind() -> Optional[str]:
+    """The first device's kind, when jax is already loaded (cached)."""
+    global _detected_kind
+    if _detected_kind is not None:
+        return _detected_kind
+    import sys
+
+    jax = sys.modules.get('jax')
+    if jax is None:
+        return None
+    try:
+        _detected_kind = str(jax.devices()[0].device_kind)
+    except Exception:
+        return None
+    return _detected_kind
+
+
+def _sample_n() -> int:
+    try:
+        return int(os.environ.get('SOCCERACTION_TPU_PERF_SAMPLE_N', '1'))
+    except ValueError:
+        return 1
+
+
+class IdleTracker:
+    """Device-idle estimator over one dispatch loop's completions.
+
+    Each :meth:`observe` call is "one dispatch just completed; it was
+    busy for ``busy_s``". Over the retained window (default 60 s) the
+    idle fraction is ``1 - busy / elapsed`` where ``elapsed`` spans the
+    oldest to the newest completion and ``busy`` sums the walls of the
+    dispatches *completing inside* that span (the oldest sample anchors
+    the span; its own wall ran before it). Needs at least two samples
+    in the window; returns None (recording nothing) before that.
+
+    The estimate is deliberately simple: overlapping async dispatches
+    would double-count busy time (clamped at 0 idle), and a loop that
+    stops dispatching entirely freezes the gauge at its last value —
+    pair it with ``last_flush_age_s``-style liveness for "stopped"
+    versus "busy". ``clock`` is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        window_s: float = 60.0,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: (completion_t, busy_s) pairs, oldest first
+        self._samples: 'deque[tuple]' = deque()
+
+    def observe(self, busy_s: float) -> Optional[float]:
+        """Record one completed dispatch; returns the idle fraction or None."""
+        now = self._clock()
+        with self._lock:
+            self._samples.append((now, float(busy_s)))
+            cutoff = now - self.window_s
+            while self._samples and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+            if len(self._samples) < 2:
+                return None
+            t_oldest = self._samples[0][0]
+            elapsed = now - t_oldest
+            if elapsed <= 0:
+                return None
+            busy = sum(b for t, b in self._samples if t > t_oldest)
+            return min(max(1.0 - busy / elapsed, 0.0), 1.0)
+
+    @property
+    def n_samples(self) -> int:
+        """Completions currently retained in the window."""
+        with self._lock:
+            return len(self._samples)
+
+
+_LOCK = threading.Lock()
+_TRACKERS: Dict[str, IdleTracker] = {}
+_STATS: Dict[str, Dict[str, Any]] = {}
+
+
+def idle_tracker(fn: str, *, window_s: float = 60.0) -> IdleTracker:
+    """The process-wide :class:`IdleTracker` of one dispatch loop."""
+    with _LOCK:
+        tracker = _TRACKERS.get(fn)
+        if tracker is None:
+            tracker = _TRACKERS[fn] = IdleTracker(window_s)
+        return tracker
+
+
+def record_dispatch(
+    fn: str,
+    wall_s: float,
+    *,
+    bucket: Any = None,
+    flops: Optional[float] = None,
+    bytes_accessed: Optional[float] = None,
+    device_kind: Optional[str] = None,
+    registry: Optional[MetricRegistry] = None,
+) -> Optional[Dict[str, Any]]:
+    """Account one host-synced dispatch of ``fn`` into the ``perf/*`` area.
+
+    ``wall_s`` is the measured dispatch wall. ``bucket`` (optional) is a
+    bounded shape label — the serve ladder rung, the pow-2 xT fleet
+    size. ``flops``/``bytes_accessed`` default to the compile
+    observatory's AOT cost for ``fn``
+    (:func:`socceraction_tpu.obs.xla.fn_cost` — whatever
+    ``instrument_jit`` analyzed at compile time); pass them explicitly
+    to decouple from it. ``device_kind`` defaults to the loaded jax
+    backend's first device.
+
+    Returns the computed record (the ``perf_snapshot()`` entry) for the
+    sampled dispatches, None when sampling skipped this one or the
+    module is disabled (``SOCCERACTION_TPU_PERF_SAMPLE_N=0``). The
+    per-function idle detector and the ``perf/dispatches`` counter run
+    on every call regardless — the idle estimate needs every gap.
+    """
+    n = _sample_n()
+    if n <= 0:
+        return None
+    reg = registry if registry is not None else REGISTRY
+    labels: Dict[str, str] = {'fn': fn}
+    if bucket is not None:
+        labels['bucket'] = str(bucket)
+    reg.counter('perf/dispatches', unit='count').inc(1, **labels)
+    idle = idle_tracker(fn).observe(wall_s)
+    if idle is not None:
+        reg.gauge('perf/device_idle_frac', unit='ratio').set(idle, fn=fn)
+
+    with _LOCK:
+        stats = _STATS.setdefault(fn, {'fn': fn, 'dispatches': 0, 'sampled': 0})
+        stats['dispatches'] += 1
+        sampled = (stats['dispatches'] - 1) % n == 0
+        if sampled:
+            stats['sampled'] += 1
+        if idle is not None:
+            stats['idle_frac'] = round(idle, 4)
+    if not sampled:
+        return None
+
+    wall_s = float(wall_s)
+    reg.histogram('perf/dispatch_seconds', unit='s').observe(wall_s, **labels)
+    if flops is None and bytes_accessed is None:
+        from socceraction_tpu.obs.xla import fn_cost
+
+        cost = fn_cost(fn)
+        if cost is not None:
+            flops, bytes_accessed = cost
+    record: Dict[str, Any] = {'last_wall_s': round(wall_s, 6)}
+    achieved_flops = achieved_bytes = None
+    if wall_s > 0:
+        if flops is not None:
+            achieved_flops = float(flops) / wall_s
+            reg.gauge('perf/achieved_flops', unit='flops/s').set(
+                achieved_flops, **labels
+            )
+            record['cost_flops'] = float(flops)
+            record['achieved_flops'] = achieved_flops
+        if bytes_accessed is not None:
+            achieved_bytes = float(bytes_accessed) / wall_s
+            reg.gauge('perf/achieved_bytes', unit='bytes/s').set(
+                achieved_bytes, **labels
+            )
+            record['cost_bytes'] = float(bytes_accessed)
+            record['achieved_bytes'] = achieved_bytes
+    peaks = device_peaks(device_kind if device_kind is not None else _device_kind())
+    if peaks is not None:
+        fracs = []
+        if achieved_flops is not None:
+            fracs.append(achieved_flops / 1e12 / peaks['tflops_bf16'])
+        if achieved_bytes is not None:
+            fracs.append(achieved_bytes / 1e9 / peaks['hbm_gb_s'])
+        if fracs:
+            # the BINDING wall: whichever resource the kernel is closer
+            # to saturating under the cost model (same semantics as the
+            # bench's bound_estimate; can exceed 1 — the cost model
+            # counts fusion-eliminated traffic)
+            roofline = max(fracs)
+            reg.gauge('perf/roofline_frac', unit='ratio').set(
+                roofline, **labels
+            )
+            record['roofline_frac'] = roofline
+    with _LOCK:
+        stats = _STATS[fn]
+        stats.update(record)
+    return dict(stats)
+
+
+def perf_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Every tracked function's latest perf entry, by ``fn``.
+
+    Process-lifetime module totals (dispatch counts, the last sampled
+    wall/achieved/roofline record, the last idle fraction) — the block
+    ``health()``'s capacity section and the bench artifacts embed.
+    """
+    with _LOCK:
+        return {fn: dict(s) for fn, s in sorted(_STATS.items())}
+
+
+def reset_perf() -> None:
+    """Forget every tracker and stat (tests; metrics reset separately)."""
+    global _detected_kind
+    with _LOCK:
+        _TRACKERS.clear()
+        _STATS.clear()
+    _detected_kind = None

@@ -1,0 +1,372 @@
+"""HBM residency ledger: named-owner byte claims reconciled against the census.
+
+``mem/bytes_in_use`` (:mod:`socceraction_tpu.obs.memory`) says how full
+the device is; ``live_array_census()`` says what shapes are resident.
+Neither says *whose* bytes they are — and "what is filling HBM" is the
+question behind every capacity decision (how many model versions fit
+warm, what a quantized table actually saves, whether a cache leaked).
+This module is the attribution layer:
+
+- :func:`claim_bytes` — a subsystem that makes arrays device-resident
+  registers them under a low-cardinality **owner** name (``registry``,
+  ``pipeline_feed``, ``xt_fleet``). The claim's byte size is summed
+  over the pytree's array leaves and recorded into the governed
+  ``mem/owned_bytes{owner}`` gauge. Three release disciplines:
+
+  - **keyed** (``key=...``): re-claiming the same ``(owner, key)``
+    replaces the previous claim (the registry claims per model version
+    and releases evicted versions explicitly);
+  - **scoped**: hold the returned :class:`Claim` and call
+    :meth:`Claim.release` when the arrays leave the device (the xT
+    fleet solver claims its grid stacks for the duration of a fit);
+  - **weak** (``weak=True``): per-leaf ``weakref.finalize`` hooks
+    shrink the claim as the arrays are garbage-collected (the packed
+    pipeline claims each shipped device batch and lets consumption
+    release it) — no explicit release call needed, and a forgotten
+    handle cannot leak ledger bytes forever.
+
+- :func:`residency_report` — the reconciliation: claimed bytes per
+  owner against :func:`~socceraction_tpu.obs.memory.live_array_census`,
+  with the remainder reported as the reserved ``unattributed`` owner
+  (``mem/owned_bytes{owner="unattributed"}``). A growing unattributed
+  remainder is the "HBM creep with no name" alarm.
+
+Documented slack — the ledger is an attribution estimate, not an
+allocator: claimed sizes are ``nbytes`` sums at claim time, so buffer
+donation, aliasing and deferred deletion can make owners over- or
+under-read versus the census by transient amounts
+(``over_attributed_bytes`` in the report makes the direction visible
+instead of clamping it away). Claims of *host* arrays are counted too
+(``nbytes`` is representation-agnostic); claim device trees only where
+HBM attribution is the point.
+
+Importable (and fully functional) without jax: leaf flattening uses
+``jax.tree_util`` when jax is already loaded and a dependency-free
+recursion otherwise; the census half of the report degrades exactly as
+``live_array_census`` does.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+import weakref
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+from socceraction_tpu.obs.metrics import REGISTRY, MetricRegistry
+
+__all__ = [
+    'Claim',
+    'claim_bytes',
+    'owned_bytes',
+    'residency_report',
+    'reset_residency',
+    'tree_nbytes',
+]
+
+#: owner names become label values of ``mem/owned_bytes`` — keep them
+#: label-safe and bounded by construction (a subsystem name, never an id)
+_OWNER_RE = re.compile(r'^[a-z][a-z0-9_]*$')
+
+#: the reconciliation remainder's reserved owner name
+UNATTRIBUTED = 'unattributed'
+
+_claim_seq = itertools.count(1)
+
+
+def _iter_leaves(tree: Any) -> Iterator[Any]:
+    """Array-ish leaves of a pytree, without requiring jax.
+
+    With jax loaded, ``jax.tree_util.tree_leaves`` (the canonical
+    flattening — registered pytrees like ``ActionBatch`` work); without
+    it, a recursion over dict/list/tuple/namedtuple containers.
+    """
+    import sys
+
+    jax = sys.modules.get('jax')
+    if jax is not None:
+        yield from jax.tree_util.tree_leaves(tree)
+        return
+
+    def walk(node: Any) -> Iterator[Any]:
+        if isinstance(node, dict):
+            for v in node.values():
+                yield from walk(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                yield from walk(v)
+        elif node is not None:
+            yield node
+
+    yield from walk(tree)
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total ``nbytes`` over a pytree's array leaves (non-arrays ignored)."""
+    total = 0
+    for leaf in _iter_leaves(tree):
+        nbytes = getattr(leaf, 'nbytes', None)
+        if nbytes is not None:
+            try:
+                total += int(nbytes)
+            except (TypeError, ValueError):
+                continue
+    return total
+
+
+class Claim:
+    """One owner's registered byte claim (see :func:`claim_bytes`)."""
+
+    __slots__ = ('owner', 'key', 'nbytes', '_ledger', '_finalizers', '_released')
+
+    def __init__(
+        self, owner: str, key: Any, nbytes: int, ledger: '_Ledger'
+    ) -> None:
+        self.owner = owner
+        self.key = key
+        self.nbytes = int(nbytes)
+        self._ledger = ledger
+        self._finalizers: List[Any] = []
+        self._released = False
+
+    @property
+    def released(self) -> bool:
+        """True once the claim no longer counts toward its owner."""
+        return self._released
+
+    def release(self) -> None:
+        """Remove this claim from the ledger (idempotent)."""
+        for f in self._finalizers:
+            f.detach()
+        self._finalizers = []
+        self._ledger._drop(self)
+
+    def __repr__(self) -> str:
+        return (
+            f'Claim(owner={self.owner!r}, key={self.key!r}, '
+            f'nbytes={self.nbytes}, released={self._released})'
+        )
+
+
+class _Ledger:
+    """The process-wide claim table behind the module-level functions."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None) -> None:
+        self._lock = threading.Lock()
+        self._registry = registry
+        #: owner -> key -> Claim
+        self._claims: Dict[str, Dict[Any, Claim]] = {}
+        #: (claim, leaf_bytes) shrinks queued by weak-mode finalizers.
+        #: Finalizers run at GC time on WHATEVER thread triggered the
+        #: collection — possibly one already holding ``_lock`` (an
+        #: allocation inside claim()/owned() can start a cyclic GC
+        #: pass), so a finalizer must never take the lock itself: it
+        #: appends here (deque.append is atomic) and the next ledger
+        #: operation applies the backlog under the lock.
+        self._pending_shrinks: 'deque[tuple]' = deque()
+
+    def _reg(self) -> MetricRegistry:
+        return self._registry if self._registry is not None else REGISTRY
+
+    def _record_owner_locked(self, owner: str) -> None:
+        total = sum(c.nbytes for c in self._claims.get(owner, {}).values())
+        self._reg().gauge('mem/owned_bytes', unit='bytes').set(
+            total, owner=owner
+        )
+
+    def claim(
+        self,
+        owner: str,
+        arrays: Any,
+        *,
+        key: Any = None,
+        weak: bool = False,
+    ) -> Claim:
+        if not _OWNER_RE.match(owner) or owner == UNATTRIBUTED:
+            raise ValueError(
+                f'invalid residency owner {owner!r}: want a bounded '
+                "label-safe subsystem name ([a-z][a-z0-9_]*, not "
+                f"{UNATTRIBUTED!r} — that name is the reconciliation "
+                'remainder)'
+            )
+        if key is None:
+            key = f'claim-{next(_claim_seq)}'
+        claim = Claim(owner, key, 0, self)
+        finalizers: List[Any] = []
+        total = 0
+        for leaf in _iter_leaves(arrays):
+            nbytes = getattr(leaf, 'nbytes', None)
+            if nbytes is None:
+                continue
+            try:
+                leaf_bytes = int(nbytes)
+            except (TypeError, ValueError):
+                continue
+            total += leaf_bytes
+            if weak:
+                try:
+                    finalizers.append(
+                        weakref.finalize(
+                            leaf, self._shrink, claim, leaf_bytes
+                        )
+                    )
+                except TypeError:
+                    # a non-weakref-able leaf stays counted until an
+                    # explicit release — better over-attributed than
+                    # silently dropped
+                    pass
+        claim.nbytes = total
+        claim._finalizers = finalizers
+        with self._lock:
+            self._drain_shrinks_locked()
+            by_key = self._claims.setdefault(owner, {})
+            previous = by_key.get(key)
+            by_key[key] = claim
+            self._record_owner_locked(owner)
+        if previous is not None:
+            # detach outside the lock: the previous claim's finalizers
+            # must not fire _shrink against an already-replaced entry
+            for f in previous._finalizers:
+                f.detach()
+            previous._finalizers = []
+            previous._released = True
+        self._reg().counter('mem/claims', unit='count').inc(1, owner=owner)
+        return claim
+
+    def _shrink(self, claim: Claim, leaf_bytes: int) -> None:
+        """Weak-mode leaf finalizer: one collected array leaves the claim.
+
+        Lock-free on purpose (see ``_pending_shrinks``): taking
+        ``_lock`` here would self-deadlock when GC fires on a thread
+        already inside the ledger. The gauge lags until the next ledger
+        operation drains the queue — ``owned_bytes()`` and
+        ``residency_report()`` always drain first, so reads are exact.
+        """
+        self._pending_shrinks.append((claim, leaf_bytes))
+
+    def _drain_shrinks_locked(self) -> None:
+        """Apply queued weak-claim shrinks (caller holds ``_lock``)."""
+        while True:
+            try:
+                claim, leaf_bytes = self._pending_shrinks.popleft()
+            except IndexError:
+                return
+            if claim._released:
+                continue
+            claim.nbytes = max(claim.nbytes - leaf_bytes, 0)
+            if claim.nbytes == 0:
+                by_key = self._claims.get(claim.owner, {})
+                if by_key.get(claim.key) is claim:
+                    del by_key[claim.key]
+                claim._released = True
+            self._record_owner_locked(claim.owner)
+
+    def _drop(self, claim: Claim) -> None:
+        with self._lock:
+            self._drain_shrinks_locked()
+            if claim._released:
+                return
+            claim._released = True
+            by_key = self._claims.get(claim.owner, {})
+            if by_key.get(claim.key) is claim:
+                del by_key[claim.key]
+            self._record_owner_locked(claim.owner)
+
+    def owned(self) -> Dict[str, int]:
+        with self._lock:
+            self._drain_shrinks_locked()
+            return {
+                owner: sum(c.nbytes for c in by_key.values())
+                for owner, by_key in sorted(self._claims.items())
+                if by_key
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pending_shrinks.clear()
+            claims = [
+                c for by_key in self._claims.values() for c in by_key.values()
+            ]
+            self._claims.clear()
+        for c in claims:
+            for f in c._finalizers:
+                f.detach()
+            c._finalizers = []
+            c._released = True
+
+
+_LEDGER = _Ledger()
+
+
+def claim_bytes(
+    owner: str, arrays: Any, *, key: Any = None, weak: bool = False
+) -> Claim:
+    """Register ``arrays``' bytes under ``owner``; returns the :class:`Claim`.
+
+    ``arrays`` is any pytree of array-ish leaves (``nbytes`` summed over
+    leaves; non-array leaves ignored). ``key``, when given, makes the
+    claim *keyed*: a later claim under the same ``(owner, key)``
+    replaces this one (the hot-swap idiom — the registry claims per
+    model version). ``weak=True`` attaches per-leaf finalizers so the
+    claim shrinks (and finally releases) as the arrays are collected —
+    for buffers whose lifetime the claimer does not control (the feed's
+    in-flight batches). Updates ``mem/owned_bytes{owner}`` and counts
+    ``mem/claims{owner}``.
+    """
+    return _LEDGER.claim(owner, arrays, key=key, weak=weak)
+
+
+def owned_bytes() -> Dict[str, int]:
+    """Current claimed bytes per owner (live claims only) — one dict read."""
+    return _LEDGER.owned()
+
+
+def residency_report(
+    *, top: int = 5, census: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """Reconcile the ledger against the live-array census.
+
+    Returns ``{'owners', 'owned_total_bytes', 'census_supported', ...}``;
+    where the census reports (jax loaded), adds ``census_total_bytes``,
+    ``census_n_arrays``, the ``top`` largest census groups,
+    ``unattributed_bytes`` (census minus claims, floored at 0 — recorded
+    as ``mem/owned_bytes{owner="unattributed"}``) and
+    ``over_attributed_bytes`` (claims past the census: released-on-device
+    but still-claimed buffers, or claimed host arrays — the documented
+    slack made visible). Running the census walks every live buffer —
+    an on-demand/report-time cost, deliberately not part of ``health()``.
+    """
+    from socceraction_tpu.obs.memory import live_array_census
+
+    owners = owned_bytes()
+    owned_total = sum(owners.values())
+    out: Dict[str, Any] = {
+        'owners': owners,
+        'owned_total_bytes': owned_total,
+    }
+    if census is None:
+        census = live_array_census(top=top)
+    supported = bool(census.get('supported'))
+    out['census_supported'] = supported
+    if supported:
+        census_total = int(census.get('total_bytes', 0))
+        remainder = census_total - owned_total
+        unattributed = max(remainder, 0)
+        out['census_total_bytes'] = census_total
+        out['census_n_arrays'] = int(census.get('n_arrays', 0))
+        out['census_top'] = list(census.get('top', ()))
+        if census.get('other') is not None:
+            out['census_other'] = dict(census['other'])
+        out['unattributed_bytes'] = unattributed
+        out['over_attributed_bytes'] = max(-remainder, 0)
+        REGISTRY.gauge('mem/owned_bytes', unit='bytes').set(
+            unattributed, owner=UNATTRIBUTED
+        )
+    return out
+
+
+def reset_residency() -> None:
+    """Release every claim (tests; the gauges reset separately)."""
+    _LEDGER.reset()

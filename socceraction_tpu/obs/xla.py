@@ -66,6 +66,7 @@ __all__ = [
     'InstrumentedJit',
     'call_key',
     'cost_analysis',
+    'fn_cost',
     'instrument_jit',
     'observatory_snapshot',
     'signature_of',
@@ -530,6 +531,24 @@ def instrument_jit(
     if name is None:
         name = getattr(fn, '__name__', 'fn').strip('_')
     return InstrumentedJit(fn, name, **kwargs)
+
+
+def fn_cost(name: str) -> Optional[Tuple[float, float]]:
+    """The last recorded AOT ``(flops, bytes accessed)`` of ``fn``, or None.
+
+    Read from the process-lifetime totals, so it survives the instance
+    that compiled (the per-fit epoch trainers). This is the cost the
+    live roofline (:mod:`socceraction_tpu.obs.perf`) divides by measured
+    dispatch walls — by construction the same numbers the ``xla/cost_*``
+    gauges and the bench artifact report. None until a cost-analyzed
+    compile of ``name`` has happened (``cost=False`` functions, cost
+    analysis disabled, or an unsupported backend).
+    """
+    with _TOTALS_LOCK:
+        t = _TOTALS.get(name)
+        if t is None or 'cost_flops' not in t:
+            return None
+        return (t['cost_flops'], t['cost_bytes'])
 
 
 def observatory_snapshot() -> Dict[str, Any]:

@@ -52,6 +52,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..obs import counter, span
+from ..obs.residency import Claim, claim_bytes
 from ..resil.faults import fault_point
 from ..resil.retry import RetryPolicy, retry_call
 
@@ -94,6 +95,11 @@ class ModelRegistry:
         self.root = root
         self._lock = threading.Lock()
         self._loaded: Dict[Tuple[str, str], Any] = {}
+        #: HBM residency claims per cached version (owner ``registry``
+        #: in the ledger) — claimed at load, released when the load
+        #: cache prunes the version, so ``mem/owned_bytes{owner=
+        #: "registry"}`` answers "how many model versions are warm"
+        self._claims: Dict[Tuple[str, str], Claim] = {}
         self._active: Optional[Tuple[str, str, Any]] = None
         self._previous: Optional[Tuple[str, str, Any]] = None
         self._candidate_seq = 0
@@ -177,8 +183,35 @@ class ModelRegistry:
             model = retry_call(_load, site='registry.load', policy=LOAD_RETRY)
             self.warm(model)
         with self._lock:
-            self._loaded.setdefault(key, model)
+            if key not in self._loaded:
+                self._loaded[key] = model
+                # attribute the version's device residency (params +
+                # cached device stats) to the registry: keyed per
+                # version, released when the cache prunes it
+                self._claims[key] = claim_bytes(
+                    'registry', self._resident_arrays(model),
+                    key=f'{name}/{version}',
+                )
             return self._loaded[key]
+
+    @staticmethod
+    def _resident_arrays(model: Any) -> list:
+        """The device-resident arrays :meth:`warm` uploaded for ``model``.
+
+        Per MLP head: the parameter pytree plus the cached device
+        standardization statistics — the bytes one warm model version
+        actually holds in HBM (the residency ledger's ``registry``
+        owner claims exactly these).
+        """
+        from ..ml.mlp import MLPClassifier
+
+        arrays: list = []
+        for clf in getattr(model, '_models', {}).values():
+            if isinstance(clf, MLPClassifier) and clf.params is not None:
+                arrays.append(clf.params)
+                if clf.mean_ is not None and clf.std_ is not None:
+                    arrays.append(clf._device_stats())
+        return arrays
 
     @staticmethod
     def warm(model: Any) -> Any:
@@ -255,6 +288,13 @@ class ModelRegistry:
             if triple is not None
         }
         self._loaded = {k: v for k, v in self._loaded.items() if k in keep}
+        # the evicted versions' residency claims go with them: the
+        # ledger's `registry` owner tracks exactly the cache's warm set
+        # (a caller still holding an evicted model keeps its arrays
+        # live — those bytes then show up as the census's unattributed
+        # remainder, which is the honest place for them)
+        for key in [k for k in self._claims if k not in keep]:
+            self._claims.pop(key).release()
 
     def active(self) -> Tuple[str, str, Any]:
         """The active ``(name, version, model)`` triple (one atomic read)."""

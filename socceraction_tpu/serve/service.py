@@ -43,6 +43,8 @@ from ..obs import REGISTRY, counter, gauge, span
 from ..obs.context import RequestContext, new_request_context, record_segment
 from ..obs.numerics import drain_guards
 from ..obs.parity import ParityProbe
+from ..obs.perf import perf_snapshot, record_dispatch
+from ..obs.residency import owned_bytes
 from ..obs.recorder import dump_debug_bundle
 from ..obs.slo import SLOConfig, SLOEngine
 from ..resil.breaker import CircuitBreaker
@@ -745,6 +747,17 @@ class RatingService:
         t_pad = time.perf_counter()
         values, path = self._rate_with_breaker(host_batch, gs, model, bucket)
         t_dispatch = time.perf_counter()
+        if path == 'fused':
+            # the live roofline's serve feed: the flush's dispatch wall
+            # is host-synced (it ends after the device_get), so AOT cost
+            # over it is an honest achieved rate. Fallback flushes run
+            # the materialized reference — a different program whose
+            # wall must not be divided by the fused path's cost — and
+            # the same call feeds the flusher loop's idle detector
+            # (inter-dispatch gaps -> perf/device_idle_frac).
+            record_dispatch(
+                'pair_probs', t_dispatch - t_pad, bucket=bucket
+            )
         # the dispatch's results are on host now, so its side-band guard
         # scalars are ready: draining here converts without syncing
         # anything the flush did not already wait for
@@ -920,8 +933,13 @@ class RatingService:
         fused-dispatch breaker also reads ``'degraded'`` — flushes are
         being served through the reference fallback),
         ``flusher_restarts`` (supervised restarts absorbed so far),
-        rejection and debug-dump totals, and ``last_dump`` (path or
-        None).
+        the ``capacity`` block (the live roofline's per-function
+        ``perf`` entries — achieved FLOPs/bytes, roofline fraction
+        where a device peak is known, device-idle fraction — plus the
+        residency ledger's ``owned_bytes`` per owner; host state only,
+        no live-array census — that walk is ``obsctl capacity`` /
+        ``residency_report()``'s on-demand cost), rejection and
+        debug-dump totals, and ``last_dump`` (path or None).
         """
         snap = REGISTRY.snapshot()
         # worst p99 across traffic kinds (rate AND session) — a
@@ -961,6 +979,7 @@ class RatingService:
             self._breaker.to_dict() if self._breaker is not None else None
         )
         breaker_ok = breaker_block is None or breaker_block['state'] == 'closed'
+        owned = owned_bytes()
         if not state['flusher_alive']:
             status = 'flusher-dead'
         elif not numerics_ok or not breaker_ok:
@@ -980,6 +999,11 @@ class RatingService:
             'model': {'name': name, 'version': version},
             'ladder': list(self.ladder),
             'compiled_shapes': self.compiled_shapes,
+            'capacity': {
+                'perf': perf_snapshot(),
+                'owned_bytes': owned,
+                'owned_total_bytes': sum(owned.values()),
+            },
             'slo': slo_block,
             'rejected_total': int(snap.value('serve/rejected_total')),
             'debug_dumps': int(

@@ -35,6 +35,8 @@ import numpy as np
 import pandas as pd
 
 from .obs import gauge, histogram, span
+from .obs.perf import record_dispatch
+from .obs.residency import claim_bytes
 from .spadl import config as spadlconfig
 
 try:  # pragma: no cover - import guard mirrors optional-dependency handling
@@ -549,7 +551,6 @@ class ExpectedThreat:
                 max_iter=self.max_iter, solver=variant,
                 group_id=group_id, n_groups=G,
             )
-            self.transition_matrices_ = None
         else:
             counts = _xtops.xt_counts(
                 *fields, l=self.l, w=self.w, group_id=group_id, n_groups=G
@@ -558,7 +559,26 @@ class ExpectedThreat:
             sol = _xtops.solve_xt(
                 probs, eps=self.eps, max_iter=self.max_iter, solver=variant
             )
-            self.transition_matrices_ = np.asarray(probs.transition, np.float64)
+        # HBM residency: the fleet's device stacks — (G, w·l) grids and
+        # probability surfaces, plus the (G, n, n) dense transition
+        # stack when one was built — are the xT layer's footprint while
+        # the fit converts them to host arrays. Claimed under the
+        # `xt_fleet` owner for that window and released on every exit
+        # path, so `mem/owned_bytes{owner="xt_fleet"}` spikes exactly
+        # while the stacks are resident.
+        claim = claim_bytes('xt_fleet', (probs, sol.grid))
+        try:
+            self._adopt_fleet(sol, probs, keys, group_by)
+        finally:
+            claim.release()
+
+    def _adopt_fleet(self, sol, probs, keys: np.ndarray, group_by) -> None:
+        """Convert one fleet solve's device stacks into host model state."""
+        self.transition_matrices_ = (
+            np.asarray(probs.transition, np.float64)
+            if getattr(probs, 'transition', None) is not None
+            else None
+        )
         # the documented single-grid probability slots keep their 2-D
         # contract: grouped stacks live in the *_matrices_ attributes and
         # the single-grid slots stay None (same decision as the zeroed
@@ -705,6 +725,18 @@ class ExpectedThreat:
                 else:
                     self._fit_pandas(actions)
         solve_s = time.perf_counter() - t0
+        if self.backend == 'jax' and not self.keep_heatmaps:
+            # live-roofline feed: the fit wall is host-synced (the
+            # certificate fetch forces the solve), and the fn name
+            # matches the instrumented solver so the AOT cost lookup
+            # finds its books; bucket = the pow-2 fleet size, the same
+            # bounded dimension the xt/* labels use
+            fn = (
+                'solve_xt'
+                if self._effective_solver(n_grids) == 'dense'
+                else 'solve_xt_matrix_free'
+            )
+            record_dispatch(fn, solve_s, bucket=_pow2_bucket(n_grids))
         # grid is user-controlled (any l×w), so these instruments collapse
         # past-budget label sets into the reserved {overflow="true"} series
         # instead of raising — telemetry degrades, fit() never crashes

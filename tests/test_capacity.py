@@ -1,0 +1,569 @@
+"""Tests for the capacity observatory (ISSUE 11).
+
+Covers the three tentpole pieces — the live roofline
+(:mod:`socceraction_tpu.obs.perf`), the HBM residency ledger
+(:mod:`socceraction_tpu.obs.residency`) and the cold-start timeline
+(:mod:`socceraction_tpu.obs.coldstart`) — plus the satellites: the
+bounded live-array census, the owner-tagged residency lifecycle across
+a registry hot-swap and rollback, the jax-free subprocess import pin,
+``obsctl capacity`` round-trips and ``benchdiff``'s lower-is-better
+cold-start direction.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gc
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs.coldstart import (
+    ColdstartTimeline,
+    process_start_unix,
+)
+from socceraction_tpu.obs.metrics import MetricRegistry
+from socceraction_tpu.obs.perf import (
+    DEVICE_PEAKS,
+    IdleTracker,
+    device_peaks,
+    perf_snapshot,
+    record_dispatch,
+    reset_perf,
+)
+from socceraction_tpu.obs.residency import (
+    claim_bytes,
+    owned_bytes,
+    residency_report,
+    reset_residency,
+    tree_nbytes,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_capacity_state():
+    """Perf trackers and residency claims from other tests must not
+    leak into assertions here (both are process-global by design)."""
+    reset_perf()
+    reset_residency()
+    yield
+    reset_perf()
+    reset_residency()
+
+
+# ------------------------------------------------------- idle tracker ----
+
+
+def test_idle_tracker_estimates_loop_idle_fraction():
+    """Three completions 10 s apart, each 2 s busy: the span is 20 s and
+    the two completions inside it account 4 s busy -> 80% idle."""
+    clock = {'t': 0.0}
+    tracker = IdleTracker(window_s=60.0, clock=lambda: clock['t'])
+    assert tracker.observe(2.0) is None  # one sample spans nothing
+    clock['t'] = 10.0
+    idle = tracker.observe(2.0)
+    assert idle == pytest.approx(0.8)
+    clock['t'] = 20.0
+    idle = tracker.observe(2.0)
+    assert idle == pytest.approx(0.8)
+    assert tracker.n_samples == 3
+
+
+def test_idle_tracker_clamps_and_evicts():
+    clock = {'t': 0.0}
+    tracker = IdleTracker(window_s=30.0, clock=lambda: clock['t'])
+    tracker.observe(1.0)
+    clock['t'] = 1.0
+    # busy exceeds the elapsed span (overlapping dispatches): clamp at 0
+    assert tracker.observe(5.0) == 0.0
+    # a sample past the window falls out of the estimate
+    clock['t'] = 100.0
+    assert tracker.observe(1.0) is None  # everything older was evicted
+    assert tracker.n_samples == 1
+
+
+# ---------------------------------------------------- record_dispatch ----
+
+
+def test_record_dispatch_divides_cost_into_gauges():
+    reg = MetricRegistry()
+    record = record_dispatch(
+        'probe_fn',
+        0.5,
+        bucket=4,
+        flops=1e9,
+        bytes_accessed=4e8,
+        device_kind='TPU v5 lite',
+        registry=reg,
+    )
+    assert record is not None
+    assert record['achieved_flops'] == pytest.approx(2e9)
+    assert record['achieved_bytes'] == pytest.approx(8e8)
+    peaks = DEVICE_PEAKS['TPU v5 lite']
+    expected = max(
+        2e9 / 1e12 / peaks['tflops_bf16'], 8e8 / 1e9 / peaks['hbm_gb_s']
+    )
+    assert record['roofline_frac'] == pytest.approx(expected)
+    snap = reg.snapshot()
+    assert snap.value('perf/dispatches', fn='probe_fn', bucket='4') == 1
+    assert snap.value(
+        'perf/achieved_flops', 'last', fn='probe_fn', bucket='4'
+    ) == pytest.approx(2e9)
+    assert snap.value(
+        'perf/roofline_frac', 'last', fn='probe_fn', bucket='4'
+    ) == pytest.approx(expected)
+
+
+def test_record_dispatch_without_peak_records_no_roofline():
+    """On a device with no peak entry (CPU), the achieved rates still
+    record — they only need the cost model — but a roofline fraction
+    would be noise presented as signal, so it must be absent."""
+    reg = MetricRegistry()
+    record = record_dispatch(
+        'probe_fn', 0.5, flops=1e9, device_kind='cpu', registry=reg
+    )
+    assert record['achieved_flops'] == pytest.approx(2e9)
+    assert 'roofline_frac' not in record
+    assert reg.snapshot().get('perf/roofline_frac') is None
+    assert device_peaks('cpu') is None and device_peaks(None) is None
+
+
+def test_record_dispatch_sampling_and_disable(monkeypatch):
+    reg = MetricRegistry()
+    monkeypatch.setenv('SOCCERACTION_TPU_PERF_SAMPLE_N', '3')
+    sampled = [
+        record_dispatch('probe_fn', 0.1, flops=1.0, registry=reg)
+        for _ in range(6)
+    ]
+    # every 3rd dispatch lands the full gauge set (1st, 4th) ...
+    assert [r is not None for r in sampled] == [
+        True, False, False, True, False, False,
+    ]
+    snap = reg.snapshot()
+    # ... but the dispatch counter and idle detector see every call
+    assert snap.value('perf/dispatches', fn='probe_fn') == 6
+    assert perf_snapshot()['probe_fn']['dispatches'] == 6
+    assert perf_snapshot()['probe_fn']['sampled'] == 2
+
+    monkeypatch.setenv('SOCCERACTION_TPU_PERF_SAMPLE_N', '0')
+    assert record_dispatch('off_fn', 0.1, flops=1.0, registry=reg) is None
+    assert 'off_fn' not in perf_snapshot()
+
+
+# -------------------------------------------------- residency ledger ----
+
+
+def test_claim_release_lifecycle_and_keyed_replace():
+    a = np.zeros(1000, np.float32)  # 4000 bytes
+    b = np.zeros(500, np.float64)  # 4000 bytes
+    claim = claim_bytes('probe_owner', [a, b])
+    assert claim.nbytes == 8000
+    assert owned_bytes() == {'probe_owner': 8000}
+
+    # keyed: a re-claim under the same (owner, key) replaces the previous
+    first = claim_bytes('probe_keyed', a, key='v1')
+    replacement = claim_bytes('probe_keyed', b, key='v1')
+    assert first.released and not replacement.released
+    assert owned_bytes()['probe_keyed'] == 4000
+
+    claim.release()
+    claim.release()  # idempotent
+    assert claim.released
+    replacement.release()
+    assert owned_bytes() == {}
+    assert tree_nbytes({'x': a, 'y': (b, None, 'not-an-array')}) == 8000
+
+
+def test_weak_finalizer_is_lock_free():
+    """A weak-claim finalizer runs at GC time on whatever thread
+    triggered the collection — possibly one already inside the ledger
+    holding its lock. The finalizer must therefore never take the lock
+    itself: it queues the shrink and the next ledger operation applies
+    it (a locking finalizer would self-deadlock the serving thread)."""
+    from socceraction_tpu.obs import residency
+
+    arr = np.zeros(256, np.float32)
+    claim = claim_bytes('probe_weak', [arr], weak=True)
+    ledger = residency._LEDGER
+    with ledger._lock:  # simulate GC firing mid-claim on this thread
+        ledger._shrink(claim, 1024)  # must not block or mutate
+        assert claim.nbytes == 1024
+    assert owned_bytes() == {}  # the next ledger op applies the backlog
+    assert claim.released
+
+
+def test_weak_claim_shrinks_as_arrays_are_collected():
+    arrays = [np.zeros(256, np.float32), np.zeros(128, np.float32)]
+    claim = claim_bytes('probe_weak', list(arrays), weak=True)
+    assert claim.nbytes == 1024 + 512
+    del arrays[0]
+    gc.collect()
+    assert owned_bytes()['probe_weak'] == 512
+    del arrays[:]
+    gc.collect()
+    assert owned_bytes() == {}
+    assert claim.released
+
+
+def test_invalid_owner_names_rejected():
+    for bad in ('Registry', 'has-dash', '9lead', '', 'unattributed'):
+        with pytest.raises(ValueError):
+            claim_bytes(bad, np.zeros(4))
+
+
+def test_residency_report_reconciles_against_census():
+    import jax.numpy as jnp
+
+    resident = jnp.zeros(2048, jnp.float32)
+    resident.block_until_ready()
+    claim_bytes('probe_owner', resident)
+    report = residency_report(top=3)
+    assert report['census_supported'] is True
+    assert report['owners'] == {'probe_owner': 8192}
+    # the reconciliation identity: owned + unattributed - over == census
+    assert (
+        report['owned_total_bytes']
+        + report['unattributed_bytes']
+        - report['over_attributed_bytes']
+        == report['census_total_bytes']
+    )
+    # over-attribution (the documented slack) is visible, not clamped:
+    # claim host bytes far past anything the census can see
+    claim_bytes('probe_host', np.zeros(1 << 24, np.uint8))  # 16 MiB host
+    report2 = residency_report(top=3)
+    assert report2['over_attributed_bytes'] > 0
+    assert report2['unattributed_bytes'] >= 0
+    del resident
+
+
+def test_registry_hot_swap_and_rollback_release_bytes(tmp_path):
+    """The ISSUE 11 satellite: across publish -> activate -> hot-swap ->
+    rollback -> prune, ``mem/owned_bytes{owner="registry"}`` tracks
+    exactly the load cache's warm set, the evicted version's bytes are
+    released, and the unattributed remainder stays bounded."""
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.serve import ModelRegistry
+    from socceraction_tpu.vaep.base import VAEP
+
+    def fit(seed):
+        frame = synthetic_actions_frame(game_id=seed, seed=seed, n_actions=200)
+        model = VAEP()
+        game = pd.Series({'game_id': seed, 'home_team_id': 100})
+        np.random.seed(seed)
+        model.fit(
+            model.compute_features(game, frame),
+            model.compute_labels(game, frame),
+            learner='mlp',
+            tree_params={'hidden': (8,), 'max_epochs': 1},
+        )
+        return model
+
+    registry = ModelRegistry(str(tmp_path))
+    for version, seed in (('1', 0), ('2', 1), ('3', 2)):
+        registry.publish('cap', version, fit(seed))
+
+    registry.activate('cap', '1')
+    owned_v1 = owned_bytes()['registry']
+    assert owned_v1 > 0
+    per_version = owned_v1  # one warm version's bytes
+
+    registry.activate('cap', '2')  # hot swap: active=2, previous=1
+    assert owned_bytes()['registry'] == 2 * per_version
+
+    registry.rollback()  # active=1, previous=2 — both stay warm
+    assert owned_bytes()['registry'] == 2 * per_version
+
+    registry.activate('cap', '3')  # active=3, previous=1 -> v2 pruned
+    assert owned_bytes()['registry'] == 2 * per_version
+
+    # the ledger reconciles while models are warm: everything the
+    # registry claims is really resident, so the remainder never goes
+    # negative-and-clamped by more than the documented slack
+    report = residency_report(top=5)
+    assert report['census_total_bytes'] >= report['owners']['registry']
+    assert report['unattributed_bytes'] >= 0
+
+
+# ------------------------------------------------------ census bounds ----
+
+
+def test_live_array_census_caps_groups_with_other_bucket():
+    """A census with more live buffer groups than ``top`` summarizes the
+    tail into one ``other`` bucket whose totals still account for every
+    byte (the 1024-grid fleet-fit hazard, ISSUE 11 satellite)."""
+    import jax.numpy as jnp
+
+    from socceraction_tpu.obs.memory import live_array_census
+
+    keep = [jnp.zeros(17 + i, jnp.float32) for i in range(12)]
+    for arr in keep:
+        arr.block_until_ready()
+    census = live_array_census(top=5)
+    assert census['supported'] is True
+    assert len(census['top']) == 5
+    assert census['other'] is not None
+    assert census['other']['groups'] >= 7
+    accounted = (
+        sum(g['total_bytes'] for g in census['top'])
+        + census['other']['total_bytes']
+    )
+    assert accounted == census['total_bytes']
+    assert census['n_arrays'] == (
+        sum(g['count'] for g in census['top']) + census['other']['count']
+    )
+    # a top wide enough to hold everything reports no overflow bucket
+    assert live_array_census(top=10_000)['other'] is None
+    del keep
+
+
+# -------------------------------------------------- cold-start timeline ----
+
+
+def test_coldstart_timeline_phases_marks_and_wall():
+    timeline = ColdstartTimeline()
+    assert timeline.report() == {'supported': False, 'phases': [], 'marks': {}}
+    anchor = timeline.begin(process_start=1000.0)
+    assert anchor == 1000.0
+    assert timeline.begin(process_start=2000.0) == 1000.0  # first wins
+
+    with timeline.phase('load'):
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError):
+        with timeline.phase('compile'):  # recorded even when the body raises
+            raise RuntimeError('boom')
+    timeline.mark('first_rated_action')
+
+    report = timeline.report()
+    assert report['supported'] is True
+    assert [p['phase'] for p in report['phases']] == ['load', 'compile']
+    assert report['phase_seconds']['load'] >= 0.01
+    assert report['phase_total_s'] == pytest.approx(
+        sum(p['seconds'] for p in report['phases'])
+    )
+    # the anchor predates every phase, so the wall bounds the phase sum
+    assert report['wall_s'] >= report['phase_total_s']
+    assert report['unattributed_s'] >= 0
+    assert 'first_rated_action' in report['marks']
+
+
+def test_coldstart_backdated_phase_charges_interpreter_startup():
+    timeline = ColdstartTimeline()
+    anchor = timeline.begin()
+    with timeline.phase('import', start_unix=anchor):
+        pass
+    report = timeline.report()
+    (phase,) = report['phases']
+    assert phase['start_unix'] == anchor
+    # the backdated phase covers anchor -> now, not just the body's wall
+    assert phase['seconds'] >= 0
+
+
+def test_process_start_unix_on_linux():
+    start = process_start_unix()
+    if start is None:
+        pytest.skip('/proc bookkeeping unavailable on this platform')
+    # the process started before "now" and after the epoch, recently
+    assert 0 < start <= time.time()
+    assert time.time() - start < 7 * 24 * 3600
+
+
+def test_coldstart_phase_events_land_in_runlog(tmp_path):
+    from socceraction_tpu.obs import RunLog
+
+    timeline = ColdstartTimeline()
+    path = str(tmp_path / 'obs.jsonl')
+    with RunLog(path, config={'probe': 'coldstart'}):
+        timeline.begin()
+        with timeline.phase('registry_load'):
+            pass
+        timeline.mark('first_rated_action')
+    kinds = []
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            event = json.loads(line)
+            kinds.append(event.get('event'))
+    assert 'coldstart_phase' in kinds and 'coldstart_mark' in kinds
+
+
+# --------------------------------------------------- jax-free import pin ----
+
+
+def test_capacity_modules_are_jax_free():
+    """The ISSUE 11 satellite: perf, residency and coldstart must import
+    AND function in a process where jax cannot be imported."""
+    code = (
+        'import builtins, sys\n'
+        'real = builtins.__import__\n'
+        'def blocker(name, *a, **k):\n'
+        "    if name == 'jax' or name.startswith('jax.'):\n"
+        "        raise ImportError('jax is blocked in this process')\n"
+        '    return real(name, *a, **k)\n'
+        'builtins.__import__ = blocker\n'
+        'from socceraction_tpu.obs.perf import (\n'
+        '    IdleTracker, perf_snapshot, record_dispatch,\n'
+        ')\n'
+        'from socceraction_tpu.obs.residency import (\n'
+        '    claim_bytes, owned_bytes, residency_report,\n'
+        ')\n'
+        'from socceraction_tpu.obs.coldstart import (\n'
+        '    TIMELINE, coldstart_report, process_start_unix,\n'
+        ')\n'
+        'class Leaf:\n'
+        '    nbytes = 128\n'
+        "claim = claim_bytes('probe_owner', {'a': Leaf(), 'b': [Leaf()]})\n"
+        "assert owned_bytes() == {'probe_owner': 256}\n"
+        'report = residency_report()\n'
+        "assert report['census_supported'] is False\n"
+        "record = record_dispatch('probe_fn', 0.5, flops=1e6)\n"
+        "assert record['achieved_flops'] == 2e6\n"
+        "assert 'probe_fn' in perf_snapshot()\n"
+        'TIMELINE.begin()\n'
+        "with TIMELINE.phase('load'):\n"
+        '    pass\n'
+        "assert coldstart_report()['supported'] is True\n"
+        "assert 'jax' not in sys.modules\n"
+    )
+    env = dict(os.environ, PYTHONPATH=_ROOT)
+    subprocess.run([sys.executable, '-c', code], check=True, env=env)
+
+
+# ------------------------------------------------------ obsctl capacity ----
+
+
+def _obsctl(argv):
+    from tools.obsctl import main as obsctl_main
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = obsctl_main(argv)
+    return rc, out.getvalue()
+
+
+def test_obsctl_capacity_roundtrips_runlog_and_live(tmp_path):
+    from socceraction_tpu.obs import RunLog
+    from socceraction_tpu.obs.coldstart import TIMELINE
+
+    path = str(tmp_path / 'obs.jsonl')
+    arr = np.zeros(1024, np.float32)
+    TIMELINE.reset()
+    try:
+        with RunLog(path, config={'probe': 'capacity'}):
+            for _ in range(2):  # two completions so the idle gauge exists
+                record_dispatch(
+                    'probe_fn', 0.5, bucket=2, flops=1e9,
+                    bytes_accessed=4e8, device_kind='TPU v5 lite',
+                )
+                time.sleep(0.01)
+            claim_bytes('probe_owner', arr)
+            TIMELINE.begin()
+            with TIMELINE.phase('registry_load'):
+                pass
+            TIMELINE.mark('first_rated_action')
+
+        # post-mortem: the run log's embedded snapshot + coldstart events
+        rc, out = _obsctl(['capacity', path, '--json'])
+        assert rc == 0
+        summary = json.loads(out)
+        (row,) = [r for r in summary['perf'] if r['fn'] == 'probe_fn']
+        assert row['bucket'] == '2'
+        assert row['achieved_flops'] == pytest.approx(2e9)
+        assert row['roofline_frac'] > 0
+        # the per-loop idle gauge (fn only, no bucket) merges into the
+        # same row — the runlog rendering matches the live one
+        assert 0 <= row['idle_frac'] <= 1
+        assert summary['owned_bytes']['probe_owner'] == 4096
+        cold = summary['coldstart']
+        assert cold['supported'] is True
+        assert [p['phase'] for p in cold['phases']] == ['registry_load']
+        assert cold['wall_s'] >= cold['phase_total_s'] - 1e-6
+
+        # live: the typed perf snapshot + census-reconciled residency
+        rc, out = _obsctl(['capacity', '--json'])
+        assert rc == 0
+        live = json.loads(out)
+        assert any(r['fn'] == 'probe_fn' for r in live['perf'])
+        assert live['owned_bytes']['probe_owner'] == 4096
+        assert live['residency']['census_supported'] is True
+        assert live['coldstart']['supported'] is True
+
+        # the human rendering mentions every surface
+        rc, out = _obsctl(['capacity', path])
+        assert rc == 0
+        assert 'roofline' in out and 'owned' in out and 'coldstart' in out
+    finally:
+        TIMELINE.reset()
+        REGISTRY.reset()
+
+
+def test_obsctl_capacity_missing_runlog_is_one_line_error(capsys):
+    from tools.obsctl import main as obsctl_main
+
+    rc = obsctl_main(['capacity', '/nonexistent/obs.jsonl'])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert 'cannot read' in err and '\n' not in err.strip()
+
+
+# ------------------------------------------------- benchdiff direction ----
+
+
+def test_benchdiff_cold_start_is_lower_is_better():
+    """A cold start that got SLOWER is the regression (ISSUE 11
+    satellite): benchdiff flips direction for wall-metric artifacts and
+    keeps refusing incomparable pairs."""
+    from tools.benchdiff import compare_artifacts
+
+    old = {'metric': 'cold_start_seconds', 'platform': 'cpu', 'value': 10.0}
+    slower = {**old, 'value': 13.0}
+    faster = {**old, 'value': 7.0}
+
+    res = compare_artifacts(old, slower)
+    (verdict,) = res['verdicts']
+    assert verdict['direction'] == 'lower_is_better'
+    assert verdict['verdict'] == 'regression' and res['regressions'] == 1
+
+    res = compare_artifacts(old, faster)
+    assert res['verdicts'][0]['verdict'] == 'improvement'
+    assert res['regressions'] == 0 and res['improvements'] == 1
+
+    # incomparable artifacts are still refused, not force-compared
+    serve = {'metric': 'serve_requests_per_sec', 'platform': 'cpu', 'value': 45.0}
+    assert 'incomparable' in compare_artifacts(old, serve)
+
+
+def test_benchdiff_serve_roofline_headline_compared():
+    from tools.benchdiff import compare_artifacts
+
+    old = {
+        'metric': 'serve_requests_per_sec',
+        'platform': 'cpu',
+        'value': 45.0,
+        'serve_achieved_flops_per_sec': 1e9,
+    }
+    new = {**old, 'serve_achieved_flops_per_sec': 5e8}
+    res = compare_artifacts(old, new)
+    flops = [v for v in res['verdicts'] if v['rate'] == 'serve_achieved_flops_per_sec']
+    (verdict,) = flops
+    assert verdict['verdict'] == 'regression'
+    assert verdict['direction'] == 'higher_is_better'
+
+
+def test_bench_cold_start_phase_contract():
+    """The ledger breakdown contract: bench's phase tuple is the five
+    startup phases the acceptance criteria name, in startup order."""
+    import bench
+
+    assert bench.COLD_START_PHASES == (
+        'import', 'registry_load', 'device_upload', 'ladder_compile',
+        'first_dispatch',
+    )

@@ -121,6 +121,17 @@ def test_resil_area_and_labels_are_registered():
     assert tool.KNOWN_LABELS['resil'] == {'point', 'kind', 'site', 'outcome'}
 
 
+def test_perf_area_and_capacity_labels_are_registered():
+    """The capacity observatory's metric area (``perf/*``: live roofline
+    + device-idle detector) and its label contract — plus the residency
+    ledger's ``owner`` dimension on the ``mem`` area — are governed by
+    the lint gate from day one (ISSUE 11 satellite)."""
+    tool = _tool()
+    assert 'perf' in tool.KNOWN_AREAS
+    assert tool.KNOWN_LABELS['perf'] == {'fn', 'bucket'}
+    assert 'owner' in tool.KNOWN_LABELS['mem']
+
+
 def test_gate_reports_all_violations_per_site(tmp_path):
     """One site breaking several rules surfaces every violation in one
     run — not one per fix-and-rerun cycle (ISSUE 8 satellite)."""

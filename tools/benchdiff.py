@@ -41,7 +41,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 __all__ = ['HEADLINE_KEYS', 'compare_artifacts', 'main']
 
-#: Rate keys compared when present in BOTH artifacts (all higher-is-better).
+#: Rate keys compared when present in BOTH artifacts (higher-is-better
+#: unless the artifact's metric says otherwise — see LOWER_IS_BETTER).
 HEADLINE_KEYS: Tuple[str, ...] = (
     'value',
     'fused_actions_per_sec',
@@ -49,7 +50,16 @@ HEADLINE_KEYS: Tuple[str, ...] = (
     'fused_bf16_actions_per_sec',
     'peak_requests_per_sec',
     'peak_actions_per_sec',
+    # the capacity observatory's serve headline: AOT cost FLOPs over the
+    # measured flush wall (bench.py serve_throughput embeds it)
+    'serve_achieved_flops_per_sec',
 )
+
+#: Artifact metrics whose headline ``value`` is a WALL, not a rate — a
+#: rise is the regression (``bench.py --cold-start``'s process-start →
+#: first-rated-action seconds). Only ``value`` flips direction: the
+#: other HEADLINE_KEYS stay rates wherever they appear.
+LOWER_IS_BETTER: Tuple[str, ...] = ('cold_start_seconds',)
 
 
 def default_ledger() -> str:
@@ -130,15 +140,18 @@ def compare_artifacts(
             continue
         if a <= 0:
             continue  # a degraded/zero baseline cannot anchor a ratio
+        lower_better = key == 'value' and new.get('metric') in LOWER_IS_BETTER
         ratio = b / a
         if ratio < 1.0 - threshold:
-            verdict = 'regression'
-            result['regressions'] += 1
+            verdict = 'improvement' if lower_better else 'regression'
         elif ratio > 1.0 + threshold:
-            verdict = 'improvement'
-            result['improvements'] += 1
+            verdict = 'regression' if lower_better else 'improvement'
         else:
             verdict = 'ok'
+        if verdict == 'regression':
+            result['regressions'] += 1
+        elif verdict == 'improvement':
+            result['improvements'] += 1
         name = new.get('metric', key) if key == 'value' else key
         result['verdicts'].append(
             {
@@ -146,6 +159,7 @@ def compare_artifacts(
                 'old': a,
                 'new': b,
                 'ratio': round(ratio, 4),
+                'direction': 'lower_is_better' if lower_better else 'higher_is_better',
                 'verdict': verdict,
             }
         )

@@ -1,0 +1,240 @@
+"""End-to-end capacity smoke: a warm serve path + a re-exec'd cold start.
+
+The ``make capacity-smoke`` gate for the capacity observatory, in two
+halves:
+
+**Warm half** — fit a tiny VAEP, publish it through a
+:class:`~socceraction_tpu.serve.ModelRegistry` (so the HBM residency
+ledger's ``registry`` owner claims the warm version's bytes), then
+serve a short request sequence through a live
+:class:`~socceraction_tpu.serve.RatingService` under a ``RunLog`` and
+assert the observatory measured it:
+
+- the live roofline recorded the serve loop: ``perf/dispatches`` and
+  the achieved-rate gauges (``perf/achieved_flops``/``achieved_bytes``
+  — the CPU-honest half; ``perf/roofline_frac`` must be ABSENT on CPU,
+  where no device peak is defined) plus a ``perf/device_idle_frac``
+  sample for the flusher loop;
+- the residency ledger attributes the warm model (``mem/owned_bytes
+  {owner="registry"}`` > 0) and ``residency_report()`` reconciles
+  against the live-array census with the unattributed remainder
+  accounting for exactly the census bytes no owner claimed;
+- ``health()`` carries the capacity block;
+- the sampled perf instrumentation kept the serve path's zero
+  steady-state retraces (compiled-shape plateau across the measured
+  requests);
+- ``obsctl capacity`` round-trips BOTH ways: over the closed run log's
+  embedded snapshot, and live in-process (census included).
+
+**Cold half** — re-exec ``bench.py --cold-start`` (itself a clean-CPU
+subprocess re-exec measuring process start → first rated action) with
+the ledger redirected to a scratch file, and assert the artifact
+contract: every startup phase present (import / registry_load /
+device_upload / ladder_compile / first_dispatch) and the phase sum
+bounded by the measured wall.
+
+Exit 0 on success; any violated invariant is a non-zero exit with the
+evidence printed. CPU-sized (the cold half re-execs two clean Python
+processes, so this is tens of seconds, not seconds).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+__all__ = ['main']
+
+#: requests served in the warm half (≥2 so the idle detector has gaps)
+N_REQUESTS = 6
+
+
+def _warm_half(problems: list) -> None:
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.obs import REGISTRY, RunLog
+    from socceraction_tpu.obs.residency import owned_bytes, residency_report
+    from socceraction_tpu.serve import ModelRegistry, RatingService
+    from socceraction_tpu.vaep.base import VAEP
+    from tools.obsctl import main as obsctl_main
+
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=120)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': 100})
+    np.random.seed(0)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': (8,), 'max_epochs': 2},
+    )
+
+    with tempfile.TemporaryDirectory(prefix='capacity-smoke-') as tmp:
+        registry = ModelRegistry(os.path.join(tmp, 'registry'))
+        registry.publish('capacity', '1', model)
+        registry.activate('capacity', '1')
+        _name, _version, warm_model = registry.active()
+        if owned_bytes().get('registry', 0) <= 0:
+            problems.append(
+                'the residency ledger did not claim the warm model '
+                f'(owned_bytes={owned_bytes()})'
+            )
+
+        runlog_path = os.path.join(tmp, 'obs.jsonl')
+        with RunLog(runlog_path, config={'smoke': 'capacity'}):
+            with RatingService(
+                warm_model, max_actions=256, max_batch_size=4, max_wait_ms=1.0
+            ) as service:
+                service.warmup()
+                # one measured request, then the plateau window: any
+                # steady-state retrace past this point is a regression
+                service.rate_sync(frame, home_team_id=100, timeout=120)
+                shapes_before = service.compiled_shapes
+                for _ in range(N_REQUESTS - 1):
+                    service.rate_sync(frame, home_team_id=100, timeout=120)
+                if service.compiled_shapes != shapes_before:
+                    problems.append(
+                        'steady-state retrace: compiled shapes moved '
+                        f'{shapes_before} -> {service.compiled_shapes} '
+                        'across the measured requests'
+                    )
+                health = service.health()
+            report = residency_report(top=5)
+
+        # -- the live roofline measured the serve loop -------------------
+        snap = REGISTRY.snapshot()
+        if not snap.value('perf/dispatches', fn='pair_probs', bucket='1'):
+            problems.append('no perf/dispatches recorded for the serve loop')
+        if snap.series('perf/achieved_flops', fn='pair_probs', bucket='1') is None:
+            problems.append('no perf/achieved_flops gauge for the serve loop')
+        if snap.series('perf/achieved_bytes', fn='pair_probs', bucket='1') is None:
+            problems.append('no perf/achieved_bytes gauge for the serve loop')
+        if snap.series('perf/device_idle_frac', fn='pair_probs') is None:
+            problems.append('no perf/device_idle_frac for the flusher loop')
+        # no device peak is defined for CPU: a roofline fraction here
+        # would be noise presented as signal — its absence IS the contract
+        if snap.series('perf/roofline_frac', fn='pair_probs', bucket='1'):
+            problems.append('perf/roofline_frac recorded on CPU (no peak)')
+
+        # -- health carries the capacity block ---------------------------
+        capacity = health.get('capacity') or {}
+        if 'pair_probs' not in (capacity.get('perf') or {}):
+            problems.append(f'health() capacity block incomplete: {capacity}')
+        if capacity.get('owned_bytes', {}).get('registry', 0) <= 0:
+            problems.append(
+                'health() capacity block does not attribute the warm model'
+            )
+
+        # -- the ledger reconciles against the census --------------------
+        if not report.get('census_supported'):
+            problems.append('census unsupported with jax loaded')
+        else:
+            accounted = (
+                report['owned_total_bytes']
+                + report['unattributed_bytes']
+                - report['over_attributed_bytes']
+            )
+            if accounted != report['census_total_bytes']:
+                problems.append(
+                    f'residency reconciliation does not balance: {report}'
+                )
+
+        # -- obsctl capacity round-trips, post-mortem and live -----------
+        for argv, source in (
+            (['capacity', runlog_path, '--json'], 'runlog'),
+            (['capacity', '--json'], 'live'),
+        ):
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = obsctl_main(argv)
+            if rc != 0:
+                problems.append(f'obsctl capacity ({source}) exited {rc}')
+                continue
+            summary = json.loads(out.getvalue())
+            fns = {row.get('fn') for row in summary.get('perf', [])}
+            if 'pair_probs' not in fns:
+                problems.append(
+                    f'obsctl capacity ({source}) lost the serve loop: {fns}'
+                )
+            owners = summary.get('owned_bytes') or {}
+            if not owners.get('registry'):
+                problems.append(
+                    f'obsctl capacity ({source}) lost the registry owner: '
+                    f'{owners}'
+                )
+
+
+def _cold_half(problems: list) -> None:
+    from bench import COLD_START_PHASES
+
+    with tempfile.TemporaryDirectory(prefix='capacity-smoke-cold-') as tmp:
+        ledger = os.path.join(tmp, 'ledger.jsonl')
+        env = dict(os.environ)
+        # the env var names the ledger DIRECTORY; bench writes
+        # <dir>/ledger.jsonl inside it
+        env['SOCCERACTION_TPU_BENCH_HISTORY'] = tmp
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, 'bench.py'), '--cold-start'],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get(
+                'SOCCERACTION_TPU_COLDSTART_DEADLINE', 300
+            )),
+        )
+        if proc.returncode != 0:
+            problems.append(
+                f'bench.py --cold-start exited {proc.returncode}: '
+                f'{proc.stderr[-2000:]}'
+            )
+            return
+        if not os.path.isfile(ledger):
+            problems.append('cold start produced no ledger entry')
+            return
+        with open(ledger, encoding='utf-8') as f:
+            entries = [json.loads(line) for line in f if line.strip()]
+        entry = next(
+            (e for e in entries if e.get('metric') == 'cold_start_seconds'),
+            None,
+        )
+        if entry is None:
+            problems.append(f'no cold_start_seconds entry in {entries}')
+            return
+        missing = set(COLD_START_PHASES) - set(entry.get('phase_seconds', {}))
+        if missing:
+            problems.append(f'cold-start phases missing from ledger: {missing}')
+        if entry['phase_total_s'] > entry['value'] + 1e-6:
+            problems.append(
+                f'cold-start phase sum {entry["phase_total_s"]}s exceeds '
+                f'the measured wall {entry["value"]}s'
+            )
+
+
+def main() -> int:
+    """Drive the warm + cold capacity paths; returns an exit code."""
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    problems: list = []
+    _warm_half(problems)
+    _cold_half(problems)
+    if problems:
+        for p in problems:
+            print(f'capacity-smoke: FAIL - {p}')
+        return 1
+    print('capacity-smoke: OK - roofline + residency + cold-start verified')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

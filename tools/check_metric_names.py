@@ -68,6 +68,7 @@ KNOWN_AREAS = {
     'learn',  # continuous-learning loop (learn/: ingest/train/shadow/gate)
     'mem',  # device-memory accounting (obs/memory.py)
     'num',  # numeric health: in-dispatch guards + parity probes (obs/numerics.py, obs/parity.py)
+    'perf',  # live roofline: achieved FLOPs/bytes + device-idle (obs/perf.py)
     'pipeline',  # store/feed/cache stage timings
     'resil',  # fault injection / retries / breaker / recovery (resil/)
     'serve',  # online rating service (batcher/session/registry/service)
@@ -106,6 +107,15 @@ KNOWN_AREAS = {
 #:   the guarded output slot per site (probs|logits|loss|grid|residual),
 #:   ``pair`` the parity path-pairs
 #:   (fused_vs_materialized|incremental_vs_replay).
+#: - ``perf``: ``fn`` values are the instrumented dispatch loops (the
+#:   ``instrument_jit`` names — pair_probs, train_epoch, solve_xt* — so
+#:   the roofline and the compile observatory share books), ``bucket``
+#:   the bounded shape dimension (serve ladder rung / pow-2 xT fleet
+#:   size — bounded by construction, like ``serve``'s bucket).
+#: - ``mem``: ``owner`` values are the residency ledger's registered
+#:   subsystem names (registry, pipeline_feed, xt_fleet) plus the
+#:   reserved ``unattributed`` remainder — a subsystem name by
+#:   contract (``obs/residency.py::_OWNER_RE``), never an id.
 #: - ``resil``: ``point`` values are the named fault points (a literal
 #:   per marker — serve.dispatch, ingest.read, registry.load,
 #:   batcher.flush, learn.publish), ``kind`` error|latency, ``site``
@@ -117,8 +127,9 @@ KNOWN_LABELS = {
     'bench': {'path', 'platform'},
     'drift': {'feature'},
     'learn': {'source', 'stage', 'verdict', 'head', 'model'},
-    'mem': {'span', 'device'},
+    'mem': {'span', 'device', 'owner'},
     'num': {'fn', 'output', 'pair'},
+    'perf': {'fn', 'bucket'},
     'pipeline': {'stage'},
     'resil': {'point', 'kind', 'site', 'outcome'},
     'serve': {'reason', 'kind', 'bucket', 'segment'},

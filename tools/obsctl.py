@@ -17,6 +17,8 @@ required writing Python. ``obsctl`` is the no-Python surface::
     python tools/obsctl.py numerics obs.jsonl    # numeric health (num/*)
     python tools/obsctl.py resil obs.jsonl       # resilience surface (resil/*)
     python tools/obsctl.py resil --journal learn-journal.jsonl  # + journal tail
+    python tools/obsctl.py capacity              # live roofline + residency
+    python tools/obsctl.py capacity obs.jsonl    # + cold-start timeline
 
 ``trace`` reconstructs one request's queue → flush → dispatch → slice
 path from its ``request_enqueue``/``request_done`` events plus the
@@ -43,8 +45,18 @@ breaker (state gauge, trips, probe verdicts), per-site retry counters
 continuous-learner iteration journal (the crash-recovery decision
 trail).
 
+``capacity`` summarizes the capacity observatory: the live roofline's
+``perf/*`` series (achieved FLOPs/bytes over measured dispatch walls,
+roofline fraction where a device peak is known, per-loop device-idle
+fraction), the HBM residency ledger's ``mem/owned_bytes{owner}``
+attribution, and the cold-start timeline — reconstructed from a run
+log's ``coldstart_phase``/``coldstart_mark`` events, or read live from
+the process timeline. The live form additionally reconciles the ledger
+against ``live_array_census()`` (``residency_report()`` — the walk over
+every live buffer is this command's on-demand cost, never ``health()``'s).
+
 ``snapshot``/``tail``/``trace``/``bundle``/``promotions``/``drift``/
-``numerics``/``resil`` accept ``--json`` for
+``numerics``/``resil``/``capacity`` accept ``--json`` for
 machine-readable output (``prom`` *is* a machine format already); the
 default rendering is a compact human table. ``promotions`` tails the
 continuous-learning loop's typed promotion reports (verdict, per-head
@@ -667,6 +679,201 @@ def _cmd_resil(args: argparse.Namespace) -> int:
     return 0
 
 
+def _fmt_bytes(n: Any) -> str:
+    """Human-readable byte count (``1.2 MiB``); raw on non-numbers."""
+    try:
+        value = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ('B', 'KiB', 'MiB', 'GiB'):
+        if abs(value) < 1024.0 or unit == 'GiB':
+            return (
+                f'{value:.0f} {unit}' if unit == 'B' else f'{value:.2f} {unit}'
+            )
+        value /= 1024.0
+    return str(n)
+
+
+def _capacity_summary(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """Summarize the capacity surface of a compact snapshot dict.
+
+    The ``perf/*`` roofline series merged per ``(fn, bucket)`` row plus
+    the residency ledger's ``mem/owned_bytes{owner}`` gauges — the
+    embedded-snapshot half of ``obsctl capacity`` (the census
+    reconciliation and the live timeline need a live process).
+    """
+
+    def series(name: str):
+        return (snapshot.get(name) or {}).get('series', [])
+
+    rows: Dict[Any, Dict[str, Any]] = {}
+
+    def row(labels: Dict[str, Any]) -> Dict[str, Any]:
+        key = (labels.get('fn', '?'), labels.get('bucket'))
+        entry = rows.setdefault(key, {'fn': key[0]})
+        if key[1] is not None:
+            entry['bucket'] = key[1]
+        return entry
+
+    for s in series('perf/dispatches'):
+        row(s.get('labels') or {})['dispatches'] = int(s.get('total') or 0)
+    for name, field in (
+        ('perf/achieved_flops', 'achieved_flops'),
+        ('perf/achieved_bytes', 'achieved_bytes'),
+        ('perf/roofline_frac', 'roofline_frac'),
+    ):
+        for s in series(name):
+            row(s.get('labels') or {})[field] = s.get('last')
+    # the idle gauge is per loop (fn only, no bucket — obs/perf.py
+    # records one detector per dispatch loop): merge it into every row
+    # of that fn so the runlog rendering matches the live one, instead
+    # of splitting each fn into a rates row and an idle-only row
+    for s in series('perf/device_idle_frac'):
+        fn = (s.get('labels') or {}).get('fn', '?')
+        idle = s.get('last')
+        matched = False
+        for (row_fn, _bucket), entry in rows.items():
+            if row_fn == fn:
+                entry['idle_frac'] = idle
+                matched = True
+        if not matched:
+            row({'fn': fn})['idle_frac'] = idle
+    owners = {
+        (s.get('labels') or {}).get('owner', '?'): s.get('last')
+        for s in series('mem/owned_bytes')
+    }
+    return {
+        'perf': [rows[k] for k in sorted(rows, key=str)],
+        'owned_bytes': dict(sorted(owners.items())),
+    }
+
+
+def _coldstart_from_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reconstruct a cold-start timeline from a run log's events.
+
+    The post-mortem half of what :func:`coldstart_report` reports live:
+    ``coldstart_phase`` events in order plus ``coldstart_mark`` stamps;
+    ``wall_s`` appears when both a phase start and a
+    ``first_rated_action`` mark made it into the log.
+    """
+    phases = []
+    marks: Dict[str, float] = {}
+    for e in events:
+        kind = e.get('event') or e.get('kind')
+        if kind == 'coldstart_phase':
+            phases.append(
+                {
+                    'phase': e.get('phase'),
+                    'start_unix': e.get('start_unix'),
+                    'seconds': e.get('seconds'),
+                }
+            )
+        elif kind == 'coldstart_mark' and e.get('mark'):
+            marks[str(e['mark'])] = e.get('unix')
+    out: Dict[str, Any] = {
+        'supported': bool(phases or marks),
+        'phases': phases,
+        'marks': marks,
+        'phase_total_s': sum(float(p['seconds'] or 0.0) for p in phases),
+    }
+    first = marks.get('first_rated_action')
+    starts = [
+        float(p['start_unix']) for p in phases if p.get('start_unix')
+    ]
+    if first is not None and starts:
+        out['wall_s'] = max(float(first) - min(starts), 0.0)
+    return out
+
+
+def _print_capacity(summary: Dict[str, Any], source: str) -> None:
+    for entry in summary.get('perf', []):
+        line = f'roofline  : fn={entry["fn"]}'
+        if entry.get('bucket') is not None:
+            line += f' bucket={entry["bucket"]}'
+        if entry.get('dispatches') is not None:
+            line += f' dispatches={entry["dispatches"]}'
+        if entry.get('last_wall_s') is not None:
+            line += f' wall={entry["last_wall_s"] * 1e3:.2f}ms'
+        if entry.get('achieved_flops') is not None:
+            line += f' {entry["achieved_flops"] / 1e9:.2f} GFLOP/s'
+        if entry.get('achieved_bytes') is not None:
+            line += f' {entry["achieved_bytes"] / 1e9:.2f} GB/s'
+        if entry.get('roofline_frac') is not None:
+            line += f' roofline={entry["roofline_frac"]:.3f}'
+        if entry.get('idle_frac') is not None:
+            line += f' idle={entry["idle_frac"]:.3f}'
+        print(line)
+    residency = summary.get('residency') or {}
+    owners = dict(
+        residency.get('owners') or summary.get('owned_bytes') or {}
+    )
+    for owner, nbytes in sorted(owners.items()):
+        print(f'owned     : owner={owner} {_fmt_bytes(nbytes)}')
+    if residency.get('census_supported'):
+        print(
+            f'census    : {residency.get("census_n_arrays")} arrays '
+            f'{_fmt_bytes(residency.get("census_total_bytes"))} live, '
+            f'unattributed {_fmt_bytes(residency.get("unattributed_bytes"))}'
+            + (
+                f', over-attributed '
+                f'{_fmt_bytes(residency["over_attributed_bytes"])}'
+                if residency.get('over_attributed_bytes')
+                else ''
+            )
+        )
+    coldstart = summary.get('coldstart') or {}
+    if coldstart.get('supported'):
+        path = '  ->  '.join(
+            f'{p["phase"]} {float(p["seconds"] or 0.0):.2f}s'
+            for p in coldstart.get('phases', [])
+        )
+        if path:
+            print(f'coldstart : {path}')
+        if coldstart.get('wall_s') is not None:
+            line = f'coldstart : wall {coldstart["wall_s"]:.2f}s'
+            if coldstart.get('unattributed_s') is not None:
+                line += f' (unattributed {coldstart["unattributed_s"]:.2f}s)'
+            print(line)
+    n_rows = len(summary.get('perf', [])) + len(owners)
+    print(f'obsctl capacity: {n_rows} row(s) from {source}')
+
+
+def _cmd_capacity(args: argparse.Namespace) -> int:
+    """``capacity [runlog]``: roofline + residency + cold-start timeline.
+
+    With a run log: the last embedded snapshot's ``perf/*`` and
+    ``mem/owned_bytes`` series plus a timeline reconstructed from the
+    log's ``coldstart_phase``/``coldstart_mark`` events. Live (no
+    argument): the typed ``perf_snapshot()`` / ``residency_report()``
+    (census reconciliation included — the live-buffer walk is this
+    command's cost, on demand) / ``coldstart_report()``.
+    """
+    if args.runlog:
+        events = _read_events(args.runlog)
+        snapshot = _last_snapshot(events) or {}
+        summary = _capacity_summary(snapshot)
+        summary['coldstart'] = _coldstart_from_events(events)
+        source = args.runlog
+    else:
+        from socceraction_tpu.obs.coldstart import coldstart_report
+        from socceraction_tpu.obs.perf import perf_snapshot
+        from socceraction_tpu.obs.residency import residency_report
+
+        residency = residency_report(top=5)
+        summary = {
+            'perf': list(perf_snapshot().values()),
+            'owned_bytes': residency['owners'],
+            'residency': residency,
+            'coldstart': coldstart_report(),
+        }
+        source = 'live registry'
+    if args.json:
+        print(json.dumps(summary, sort_keys=True, default=str))
+        return 0
+    _print_capacity(summary, source)
+    return 0
+
+
 def _fmt_promotion(event: Dict[str, Any]) -> str:
     """One human-readable line block per promotion report."""
     lines = []
@@ -872,6 +1079,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument('-n', type=int, default=10, help='recent entries shown')
     p.add_argument('--json', action='store_true')
     p.set_defaults(fn=_cmd_resil)
+
+    p = sub.add_parser(
+        'capacity',
+        help='capacity: roofline, residency ledger, cold-start timeline',
+    )
+    p.add_argument(
+        'runlog', nargs='?',
+        help='obs.jsonl to read (default: this process, census included)',
+    )
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_capacity)
 
     p = sub.add_parser(
         'promotions', help="tail the continuous-learning loop's gate decisions"
