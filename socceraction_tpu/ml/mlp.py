@@ -56,7 +56,14 @@ __all__ = ['MLPClassifier', 'MLP_FORMAT_VERSION']
 #: layout change; :meth:`MLPClassifier.load` rejects artifacts from a
 #: NEWER version with a clear error instead of failing deep inside
 #: ``np.load`` key access (the model registry depends on this contract).
-MLP_FORMAT_VERSION = 1
+#: Version 2 adds the ``quantize`` serving mode to the hyperparameters;
+#: :meth:`MLPClassifier.save` stamps the MINIMUM version able to read
+#: the artifact — a ``quantize='none'`` checkpoint still stamps 1, so
+#: pre-quantization libraries keep loading everything that does not use
+#: the feature, while a quantized checkpoint fails them loudly
+#: ("newer than this library understands") instead of crashing on the
+#: unknown hyperparameter.
+MLP_FORMAT_VERSION = 2
 
 
 class _MLP(nn.Module):
@@ -199,6 +206,18 @@ class MLPClassifier:
         logit head accumulates back in f32 —
         :func:`socceraction_tpu.ops.fused._hidden_chain`). Opt-in;
         ``None`` (default) trains fully in f32.
+    quantize : {'none', 'bf16', 'int8'}
+        Storage format of the fused serving fold's combined tables
+        (:mod:`socceraction_tpu.ops.quant`). ``'none'`` (default) serves
+        the bit-pinned f32 path. Narrow modes quantize the prepared
+        tables at fold-build time and dequantize inside the dispatch
+        (f32 accumulation); when set *before* :meth:`fit_packed`, the
+        fused training path also trains quantization-aware
+        (straight-through fake-quant of the per-step tables). Master
+        weights, checkpointed parameters and the materialized reference
+        path stay f32 regardless — quantization is a serving-storage
+        decision, metered in production by the serve layer's
+        ``ParityProbe``.
     """
 
     def __init__(
@@ -211,7 +230,10 @@ class MLPClassifier:
         pos_weight: float = 1.0,
         seed: int = 0,
         train_dtype: Optional[str] = None,
+        quantize: str = 'none',
     ) -> None:
+        from ..ops.quant import check_quantize_mode
+
         self.hidden = tuple(hidden)
         self.learning_rate = learning_rate
         self.batch_size = batch_size
@@ -220,6 +242,7 @@ class MLPClassifier:
         self.pos_weight = pos_weight
         self.seed = seed
         self.train_dtype = train_dtype
+        self.quantize = check_quantize_mode(quantize)
         self.module = _MLP(self.hidden)
         self.params = None
         self._mean: Optional[np.ndarray] = None
@@ -671,6 +694,7 @@ class MLPClassifier:
         pos_w = self.pos_weight
         hidden_layers = len(self.hidden)
         compute_dtype = self._compute_dtype()
+        quantize = self.quantize
 
         if path == 'fused':
 
@@ -684,6 +708,7 @@ class MLPClassifier:
                     mean=mean_dev,
                     std=std_dev,
                     compute_dtype=compute_dtype,
+                    quantize=quantize,
                 )
                 return _weighted_bce(logits, mb['y'], w * mb['w'], pos_w)
 
@@ -790,12 +815,22 @@ class MLPClassifier:
         }
         if self.train_dtype is not None:
             hyper['train_dtype'] = self.train_dtype
+        if self.quantize != 'none':
+            hyper['quantize'] = self.quantize
+        # the stamp is the MINIMUM reader version: a checkpoint that uses
+        # no post-v1 feature stamps 1 so pre-quantization libraries keep
+        # loading it; a quantized one stamps the LITERAL version that
+        # introduced the feature (2 — not MLP_FORMAT_VERSION, which
+        # future features will bump past it), failing older loaders with
+        # the actionable "newer than this library" error instead of a
+        # TypeError on the unknown hyperparameter
+        format_version = 2 if self.quantize != 'none' else 1
         # write through a handle so np.savez honors the exact path instead
         # of appending '.npz'
         with open(path, 'wb') as f:
             np.savez(
                 f,
-                format_version=np.array(MLP_FORMAT_VERSION),
+                format_version=np.array(format_version),
                 params_msgpack=np.frombuffer(
                     serialization.to_bytes(self.params), dtype=np.uint8
                 ),

@@ -491,7 +491,28 @@ class MetricRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._instruments: Dict[str, Instrument] = {}
+        self._sites: Dict[str, str] = {}
         self._preserved: Tuple[str, ...] = ()
+
+    @staticmethod
+    def _caller_site() -> str:
+        """``file.py:lineno`` of the first frame outside this module.
+
+        Captured once per instrument *creation* (not per lookup) and on
+        the conflict path, so the kind/unit-conflict error can point at
+        the two offending registration sites instead of naming only the
+        metric — the runtime half of the ``check_metric_names`` gate's
+        file:line contract.
+        """
+        import sys
+
+        frame = sys._getframe(1)
+        here = __file__
+        while frame is not None and frame.f_code.co_filename == here:
+            frame = frame.f_back
+        if frame is None:
+            return '<unknown>'
+        return f'{frame.f_code.co_filename}:{frame.f_lineno}'
 
     def _instrument(
         self,
@@ -507,11 +528,14 @@ class MetricRegistry:
                 inst = self._instruments[name] = _KINDS[kind](
                     name, unit, help, **kwargs
                 )
+                self._sites[name] = self._caller_site()
             elif inst.kind != kind or inst.unit != unit:
+                first = self._sites.get(name, '<unknown>')
                 raise ValueError(
                     f'metric {name!r} already registered as '
-                    f'{inst.kind}(unit={inst.unit!r}); requested '
-                    f'{kind}(unit={unit!r})'
+                    f'{inst.kind}(unit={inst.unit!r}) at {first}; '
+                    f'requested {kind}(unit={unit!r}) from '
+                    f'{self._caller_site()}'
                 )
             return inst
 
@@ -607,6 +631,7 @@ class MetricRegistry:
         with self._lock:
             if clear:
                 self._instruments.clear()
+                self._sites.clear()
                 self._preserved = ()
                 return
             instruments = [

@@ -140,7 +140,15 @@ class ParityProbe:
         ``(B, A, 3)`` host ratings the service returned. Returns False
         (and counts a drop) when the probe queue is full.
         """
-        item = (model, host_batch, gs, values, exemplar)
+        # the served side's table-storage mode is captured NOW — at
+        # flush time — not when the worker drains the queue: an in-place
+        # set_quantize() on a live model must not relabel observations
+        # whose values the PREVIOUS mode computed
+        try:
+            quant = getattr(model, 'quantize', 'none')
+        except ValueError:  # heads disagree mid-swap: label unknowable
+            quant = 'none'
+        item = (model, host_batch, gs, values, exemplar, quant)
         with self._lock:
             if self._closed:
                 return False
@@ -176,7 +184,9 @@ class ParityProbe:
                 with self._lock:
                     self._outstanding -= 1
 
-    def _probe_one(self, model, host_batch, gs, values, exemplar) -> None:
+    def _probe_one(
+        self, model, host_batch, gs, values, exemplar, quant='none'
+    ) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -186,12 +196,17 @@ class ParityProbe:
             model.rate_batch_reference(batch, dense_overrides=overrides)
         )
         mask = np.asarray(host_batch.mask, dtype=bool)
+        # the reference side is always f32; the SERVED side carries the
+        # table-storage mode captured at submit time — labelling the
+        # error histograms with it makes the probe the in-production
+        # quantization error band (num/parity_abs_err{pair,quant})
         self.compare(
             'fused_vs_materialized',
             np.asarray(values),
             want,
             mask=mask,
             exemplar=exemplar,
+            quant=quant,
         )
 
     # -- the comparison core (public: other invariants feed it too) --------
@@ -204,13 +219,18 @@ class ParityProbe:
         *,
         mask: Optional[np.ndarray] = None,
         exemplar: Optional[str] = None,
+        quant: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Record one parity observation between two value tensors.
 
         ``mask`` (broadcast against the leading axes) restricts the
         comparison to valid rows — padded slots carry garbage by
-        contract. Returns the observation dict (also kept as
-        :attr:`stats`'s ``last``).
+        contract. ``quant`` labels the observation with the served
+        side's table-storage mode (``'bf16'``/``'int8'``) so the error
+        histograms split per mode — the in-production quantization
+        error band; ``None``/``'none'`` (f32 serving) stays unlabeled,
+        keeping the pre-quantization series addresses stable. Returns
+        the observation dict (also kept as :attr:`stats`'s ``last``).
         """
         got = np.asarray(got, dtype=np.float64)
         want = np.asarray(want, dtype=np.float64)
@@ -251,6 +271,7 @@ class ParityProbe:
         exceeded = bool(max_abs > self.max_abs_err)
         observation = {
             'pair': pair,
+            'quant': quant or 'none',
             'max_abs_err': max_abs,
             'max_ulp_err': max_ulp,
             'band': self.max_abs_err,
@@ -259,6 +280,8 @@ class ParityProbe:
             'n_compared': int(valid.sum()),
         }
         labels = {'pair': pair}
+        if quant not in (None, 'none'):
+            labels['quant'] = quant
         REGISTRY.histogram('num/parity_abs_err', unit='value').observe(
             max_abs,
             exemplar={'request_id': exemplar} if exemplar else None,

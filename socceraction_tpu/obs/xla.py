@@ -462,6 +462,19 @@ class InstrumentedJit:
 
     # -- introspection -----------------------------------------------------
 
+    def drain_storm_window(self) -> None:
+        """Retire this function's recent compiles from the storm window.
+
+        For callers running a CONTROLLED burst of warmups (a bench
+        sweeping many configurations, a test compiling several serving
+        ladders back to back) that should not prime the rolling
+        retrace-storm detector against the next configuration's warmup.
+        Counters, signatures and cost books are untouched — only the
+        rolling window clears.
+        """
+        with self._lock:
+            self._recent.clear()
+
     @property
     def n_compiles(self) -> int:
         """Distinct abstract signatures dispatched so far."""

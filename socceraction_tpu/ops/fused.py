@@ -67,6 +67,8 @@ __all__ = [
     'fused_mlp_logits',
     'fused_pair_logits',
     'fused_pair_probs',
+    'PreparedPair',
+    'prepare_pair_fold',
     'TrainStates',
     'TrainLayout',
     'train_layout',
@@ -343,11 +345,7 @@ def _fused_first_layer(
             )
     if dense_blocks:
         x_dense = jnp.concatenate(dense_blocks, axis=-1)
-        W_dense = jnp.concatenate(
-            [jax.lax.slice_in_dim(Wk, o, o + wd, axis=0) for o, wd in dense_spans],
-            axis=0,
-        )
-        h = h + x_dense @ W_dense
+        h = h + x_dense @ _dense_subkernel(Wk, dense_spans)
     return h
 
 
@@ -374,6 +372,64 @@ def _combined_table(
         )
         table = table + rows[registry.combo_rows[name](combo)]
     return table
+
+
+def _layout_split(
+    layout: 'TrainLayout',
+) -> Tuple[List[Tuple[str, int, int]], List[Tuple[int, int]]]:
+    """``(onehot blocks, dense spans)`` of a :class:`TrainLayout`.
+
+    The single source of the span-family split every fold consumer
+    makes: ``blocks`` in :func:`_combined_table`'s ``(name,
+    per_state_width, column_offset)`` form, ``dense_spans`` as ``(off,
+    width)`` row ranges of the folded first-layer kernel. A new span
+    kind must be handled HERE — the prepared serving fold and both
+    training-fold branches split through this one helper, so they
+    cannot drift block by block.
+    """
+    blocks = [
+        (name, width // layout.k, off)
+        for name, kind, off, width in layout.spans
+        if kind == 'onehot'
+    ]
+    dense_spans = [
+        (off, width) for _, kind, off, width in layout.spans if kind == 'dense'
+    ]
+    return blocks, dense_spans
+
+
+def _resolve_kernel(kernel: Optional[str], combo_size: int) -> str:
+    """``'pallas' | 'xla'`` from an explicit request or auto resolution.
+
+    ``None`` / ``'auto'`` resolve through
+    :func:`socceraction_tpu.ops.gather_matmul.fused_kernel_method` (env
+    override + platform-profile gate). Anything else that is not exactly
+    ``'pallas'``/``'xla'`` raises — a typo must not silently measure the
+    auto-resolved lowering while reporting the requested one.
+    """
+    if kernel in ('pallas', 'xla'):
+        return kernel
+    if kernel is None or kernel == 'auto':
+        from .gather_matmul import fused_kernel_method
+
+        return fused_kernel_method(combo_size)
+    raise ValueError(f"kernel={kernel!r} (want None|'auto'|'pallas'|'xla')")
+
+
+def _dense_subkernel(
+    Wk: jax.Array, dense_spans: List[Tuple[int, int]]
+) -> jax.Array:
+    """The ``(D, H)`` dense rows of a folded first-layer kernel, in
+    layout order (``(0, H)`` for a layout with no dense spans)."""
+    if not dense_spans:
+        return jnp.zeros((0, Wk.shape[1]), Wk.dtype)
+    return jnp.concatenate(
+        [
+            jax.lax.slice_in_dim(Wk, off, off + width, axis=0)
+            for off, width in dense_spans
+        ],
+        axis=0,
+    )
 
 
 def _hidden_chain(
@@ -415,6 +471,301 @@ def _hidden_chain(
     if hidden_dtype is not None:
         x = x.astype(h.dtype)  # logit head accumulates at full precision
     return (x @ jnp.asarray(d_out['kernel']) + jnp.asarray(d_out['bias']))[..., 0]
+
+
+# --------------------------------------------------------------------------
+# prepared serving fold: precomputed (optionally quantized) combined tables
+# --------------------------------------------------------------------------
+#
+# The legacy two-head dispatch (`_pair_probs`) re-folds the combined
+# tables from the master Dense_0 rows on every flush. The *prepared* form
+# folds ONCE — at registry warm time — into a device-resident stack of
+# per-state tables plus the dense sub-kernel, optionally quantized to
+# bf16 / symmetric-per-column int8 (:mod:`socceraction_tpu.ops.quant`),
+# and dispatches through the fused gather+matmul first layer
+# (:mod:`socceraction_tpu.ops.gather_matmul`). Storage narrows; every
+# accumulation stays f32. The legacy XLA dispatch is kept verbatim as the
+# bit-pinned fallback for (quantize='none', kernel='xla').
+
+
+class PreparedPair(NamedTuple):
+    """A two-head serving fold, precomputed (and optionally quantized).
+
+    ``tables`` is the ``(k, combo_size, H_a + H_b)`` stack of per-state
+    combined tables built by :func:`_combined_table` from both heads'
+    standardization-folded first layers (a
+    :class:`~socceraction_tpu.ops.quant.QuantizedArray` — data plane,
+    int8 refinement plane, f32 per-row scales), ``w_dense`` the
+    ``(D, H_a+H_b)`` dense sub-kernel in the same storage, ``bias`` the
+    folded ``(H_a+H_b,)`` f32 bias. ``quantize`` names the storage
+    format; ``h_a_width`` splits the stacked hidden axis back into the
+    two heads.
+    """
+
+    tables: Any  # QuantizedArray
+    w_dense: Any  # QuantizedArray
+    bias: jax.Array
+    quantize: str
+    h_a_width: int
+    n_features: int
+
+    @property
+    def table_scale(self) -> Optional[jax.Array]:
+        """f32 per-row scales of the combined tables (int8 only)."""
+        return self.tables.scale
+
+    @property
+    def w_dense_scale(self) -> Optional[jax.Array]:
+        """f32 per-row scales of the dense sub-kernel (int8 only)."""
+        return self.w_dense.scale
+
+    @property
+    def table_nbytes(self) -> int:
+        """Device bytes of the combined tables (planes + scales) — the
+        HBM residency the quantization modes trade against each other;
+        the bench's ``table_bytes`` headline and the registry residency
+        pins read exactly this."""
+        from .quant import quantized_nbytes
+
+        return quantized_nbytes(self.tables)
+
+    @property
+    def total_nbytes(self) -> int:
+        """Device bytes of the whole prepared fold."""
+        from .quant import quantized_nbytes
+
+        return (
+            self.table_nbytes
+            + quantized_nbytes(self.w_dense)
+            + int(self.bias.size) * 4
+        )
+
+    def arrays(self) -> List[jax.Array]:
+        """The device-resident leaves (for residency claims)."""
+        return [
+            a for a in (*self.tables, *self.w_dense, self.bias)
+            if a is not None
+        ]
+
+
+def _abstract_batch(G: int = 1, A: int = 16) -> Any:
+    """A ShapeDtypeStruct :class:`ActionBatch` for layout resolution.
+
+    :func:`train_layout` only needs shapes/dtypes (``jax.eval_shape``
+    over the feature kernels), so the prepared fold can resolve its
+    column layout without a real batch in hand — registry warm-up
+    prepares models before any traffic exists.
+    """
+    from ..core.batch import ActionBatch
+
+    S = jax.ShapeDtypeStruct
+    f, i, b = jnp.float32, jnp.int32, jnp.bool_
+    return ActionBatch(
+        type_id=S((G, A), i), result_id=S((G, A), i),
+        bodypart_id=S((G, A), i), period_id=S((G, A), i),
+        is_home=S((G, A), b), time_seconds=S((G, A), f),
+        start_x=S((G, A), f), start_y=S((G, A), f),
+        end_x=S((G, A), f), end_y=S((G, A), f),
+        mask=S((G, A), b), n_actions=S((G,), i),
+        game_id=S((G,), i), row_index=S((G, A), i),
+    )
+
+
+def _shared_quantize_mode(clf_a: Any, clf_b: Any) -> str:
+    """The (single) quantize mode of a served head pair."""
+    modes = {getattr(clf, 'quantize', 'none') or 'none' for clf in (clf_a, clf_b)}
+    if len(modes) > 1:
+        raise ValueError(
+            f'paired heads disagree on quantize mode: {sorted(modes)}; '
+            'set the same mode on both (VAEP.set_quantize)'
+        )
+    return modes.pop()
+
+
+def prepare_pair_fold(
+    clf_a: Any,
+    clf_b: Any,
+    *,
+    names: Tuple[str, ...],
+    k: int,
+    registry_name: str = 'standard',
+    quantize: str = 'none',
+    table_scale: Optional[Any] = None,
+    w_dense_scale: Optional[Any] = None,
+) -> PreparedPair:
+    """Build the prepared (optionally quantized) two-head serving fold.
+
+    Folds standardization into both heads' first layers, stacks them to
+    width ``H_a + H_b`` (module NOTE), builds the per-state combined
+    tables ONCE via :func:`_combined_table` — the same single source the
+    per-dispatch fold uses, so the f32 prepared fold is the same values
+    the legacy dispatch folds — and quantizes tables + dense sub-kernel
+    to ``quantize`` storage. ``table_scale``/``w_dense_scale``, when
+    given, pin the int8 scales instead of deriving them from the weights
+    (the checkpoint-restore path: ``models/quant_scales.npz`` rides the
+    ``save_model`` artifact so a re-loaded model serves the exact bytes
+    the published version did).
+    """
+    from .quant import check_quantize_mode, quantize_columns, quantize_with_scale
+
+    check_quantize_mode(quantize)
+    for clf in (clf_a, clf_b):
+        if clf.params is None or clf.mean_ is None or clf.std_ is None:
+            raise ValueError('classifier is not fitted')
+    registry = REGISTRIES[registry_name]
+    mean_a, std_a = clf_a._device_stats()
+    mean_b, std_b = clf_b._device_stats()
+    Wk_a, bias_a = _standardized_first_layer(clf_a.params['params'], mean_a, std_a)
+    Wk_b, bias_b = _standardized_first_layer(clf_b.params['params'], mean_b, std_b)
+    Wk = jnp.concatenate([Wk_a, Wk_b], axis=1)
+    bias = jnp.concatenate([bias_a, bias_b])
+    layout = train_layout(
+        _abstract_batch(), names=tuple(names), k=k, registry_name=registry_name
+    )
+    if Wk.shape[0] != layout.n_features:
+        raise ValueError(
+            f'first-layer kernels have {Wk.shape[0]} input rows but the '
+            f'feature layout ({layout.names!r}, k={k}) emits '
+            f'{layout.n_features} columns'
+        )
+    blocks, dense_spans = _layout_split(layout)
+    tables = jnp.stack(
+        [_combined_table(Wk, i, blocks, registry) for i in range(k)]
+    )
+    w_dense = _dense_subkernel(Wk, dense_spans)
+    from .quant import QuantizedArray
+
+    if quantize == 'int8' and table_scale is not None:
+        if w_dense_scale is None:
+            raise ValueError(
+                'int8 scale pinning needs BOTH table_scale and '
+                'w_dense_scale (a checkpoint persists the pair in '
+                'models/quant_scales.npz); got table_scale without '
+                'w_dense_scale'
+            )
+        t_scale = jnp.asarray(table_scale, jnp.float32)
+        w_scale = jnp.asarray(w_dense_scale, jnp.float32)
+        t_q = QuantizedArray(*quantize_with_scale(tables, t_scale), t_scale)
+        w_q = QuantizedArray(*quantize_with_scale(w_dense, w_scale), w_scale)
+    else:
+        t_q = quantize_columns(tables, quantize)
+        w_q = quantize_columns(w_dense, quantize)
+    return PreparedPair(
+        tables=t_q,
+        w_dense=w_q,
+        bias=bias,
+        quantize=quantize,
+        h_a_width=int(Wk_a.shape[1]),
+        n_features=int(layout.n_features),
+    )
+
+
+def _packed_rows(
+    s: Any,
+    batch: Any,
+    *,
+    names: Tuple[str, ...],
+    k: int,
+    registry: FusedRegistry,
+    dense_overrides: Optional[Dict[str, jax.Array]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """``(x_dense (N, D), combo_ids (N, k))`` rows of a batch.
+
+    The dispatch-side half of the prepared fold: dense feature blocks
+    (with the serving layer's ``dense_overrides`` substituted, same
+    contract as :func:`_fused_first_layer`) concatenated in layout
+    order, plus one combined categorical id per state.
+    """
+    dense_blocks: List[jax.Array] = []
+    for name in names:
+        if name in registry.onehot_specs:
+            continue
+        block = (dense_overrides or {}).get(name)
+        if block is None:
+            block = registry.kernels[name](s)
+        elif block.shape[:2] != batch.type_id.shape:
+            raise ValueError(
+                f'dense override {name!r} has leading shape '
+                f'{block.shape[:2]}, batch is {batch.type_id.shape}'
+            )
+        dense_blocks.append(block)
+    G, A = batch.type_id.shape
+    n = G * A
+    x_dense = (
+        jnp.concatenate(dense_blocks, axis=-1).reshape(n, -1).astype(jnp.float32)
+        if dense_blocks
+        else jnp.zeros((n, 0), jnp.float32)
+    )
+    ids = jnp.stack(
+        [registry.combo_ids(s, i).reshape(n) for i in range(k)], axis=1
+    ).astype(jnp.int32)
+    return x_dense, ids
+
+
+@functools.partial(
+    instrument_jit, name='pair_probs_prepared',
+    # same controlled-compile budget as the legacy dispatch: a full
+    # serve-ladder warmup plus a hot-swap prewarm are not a storm
+    storm_threshold=16,
+    static_argnames=(
+        'names', 'k', 'hidden_layers_a', 'hidden_layers_b', 'registry_name',
+        'h_a_width', 'quantize', 'kernel', 'hidden_dtype_name', 'guard',
+    ),
+)
+def _pair_probs_prepared(
+    tables_q,
+    w_dense_q,
+    bias,
+    hidden_a,
+    hidden_b,
+    batch,
+    dense_overrides=None,
+    *,
+    names,
+    k,
+    hidden_layers_a,
+    hidden_layers_b,
+    registry_name,
+    h_a_width,
+    quantize,
+    kernel,
+    hidden_dtype_name=None,
+    guard=False,
+):
+    from .gather_matmul import fused_first_layer_quant
+    from .quant import dequantize
+
+    registry = REGISTRIES[registry_name]
+    s = registry.make_states(batch, k)
+    x_dense, ids = _packed_rows(
+        s, batch, names=names, k=k, registry=registry,
+        dense_overrides=dense_overrides,
+    )
+    if x_dense.shape[1] != w_dense_q.data.shape[0]:
+        raise ValueError(
+            f'prepared fold has a {w_dense_q.data.shape[0]}-column dense '
+            f'sub-kernel but the feature layout ({names!r}, k={k}) emits '
+            f'{x_dense.shape[1]} dense columns'
+        )
+    # int8 storage expands to a transient f32 table INSIDE this dispatch
+    # (never resident); bf16 rides into the kernel and widens in VMEM
+    tables = dequantize(*tables_q) if quantize == 'int8' else tables_q.data
+    w_dense = dequantize(*w_dense_q) if quantize == 'int8' else w_dense_q.data
+    h = fused_first_layer_quant(
+        tables, w_dense, bias, ids, x_dense, method=kernel
+    )
+    G, A = batch.type_id.shape
+    h = h.reshape(G, A, -1)
+    hidden_dtype = jnp.dtype(hidden_dtype_name) if hidden_dtype_name else None
+    a = _hidden_chain(hidden_a, h[..., :h_a_width], hidden_layers_a, hidden_dtype)
+    b = _hidden_chain(hidden_b, h[..., h_a_width:], hidden_layers_b, hidden_dtype)
+    out = jax.nn.sigmoid(a), jax.nn.sigmoid(b)
+    if not guard:
+        return out
+    # same side-band guard contract as the legacy dispatch (`_pair_probs`)
+    from ..obs.numerics import nonfinite_count, overflow_count
+
+    return out + ((nonfinite_count(*out), overflow_count(a, b)),)
 
 
 def fused_pair_logits(
@@ -526,6 +877,9 @@ def fused_pair_probs(
     registry_name: str = 'standard',
     dense_overrides: Optional[Dict[str, jax.Array]] = None,
     hidden_dtype: Optional[Any] = None,
+    prepared: Optional[PreparedPair] = None,
+    quantize: Optional[str] = None,
+    kernel: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Probabilities of two MLP heads in one jitted stacked-fold call.
 
@@ -538,6 +892,21 @@ def fused_pair_probs(
     for suffix windows this way). ``hidden_dtype`` opts the hidden
     pipeline into a narrower dtype (:func:`_hidden_chain`).
 
+    Two dispatch layers ride on top (both measured, ISSUE 12):
+
+    - ``quantize`` (default: the heads' shared
+      :attr:`~socceraction_tpu.ml.mlp.MLPClassifier.quantize` mode)
+      selects the table storage format. ``'none'`` + ``kernel='xla'`` is
+      the legacy per-dispatch fold (`_pair_probs`) — the bit-pinned
+      fallback; any other combination dispatches through a
+      :class:`PreparedPair` (pass ``prepared`` to reuse a cached fold —
+      ``VAEP.rate_batch`` and the registry warm path do; without it the
+      fold is rebuilt per call, correct but slow).
+    - ``kernel`` (default: ``SOCCERACTION_TPU_FUSED_KERNEL`` / the
+      platform profile's Pallas gate —
+      :func:`socceraction_tpu.ops.gather_matmul.fused_kernel_method`)
+      selects the first-layer lowering.
+
     Standardization constants come from the classifiers' cached device
     copies (:meth:`~socceraction_tpu.ml.mlp.MLPClassifier._device_stats`),
     so a warm (registry-resident) model does not re-upload ``mean_``/
@@ -548,28 +917,77 @@ def fused_pair_probs(
             raise ValueError('classifier is not fitted')
     from ..obs import numerics
 
+    registry = REGISTRIES[registry_name]
+    mode = quantize if quantize is not None else _shared_quantize_mode(clf_a, clf_b)
+    if prepared is not None and prepared.quantize != mode:
+        # same contract as _resolve_kernel: a conflicting request must
+        # never silently serve the fold's storage while the caller
+        # reports (and gates) the mode it asked for
+        raise ValueError(
+            f'prepared fold holds {prepared.quantize!r} storage but the '
+            f'requested quantize mode is {mode!r} — rebuild the fold '
+            'with prepare_pair_fold for the requested mode'
+        )
+    method = _resolve_kernel(kernel, registry.combo_size)
     guard = numerics.guards_enabled()
-    mean_a, std_a = clf_a._device_stats()
-    mean_b, std_b = clf_b._device_stats()
-    out = _pair_probs(
-        clf_a.params,
-        clf_b.params,
-        mean_a,
-        std_a,
-        mean_b,
-        std_b,
-        batch,
-        dense_overrides,
-        names=tuple(names),
-        k=k,
-        hidden_layers_a=len(clf_a.hidden),
-        hidden_layers_b=len(clf_b.hidden),
-        registry_name=registry_name,
-        hidden_dtype_name=(
-            jnp.dtype(hidden_dtype).name if hidden_dtype is not None else None
-        ),
-        guard=guard,
+    hidden_dtype_name = (
+        jnp.dtype(hidden_dtype).name if hidden_dtype is not None else None
     )
+    if prepared is None and mode == 'none' and method == 'xla':
+        # the bit-pinned legacy lowering: per-dispatch fold from Dense_0
+        mean_a, std_a = clf_a._device_stats()
+        mean_b, std_b = clf_b._device_stats()
+        out = _pair_probs(
+            clf_a.params,
+            clf_b.params,
+            mean_a,
+            std_a,
+            mean_b,
+            std_b,
+            batch,
+            dense_overrides,
+            names=tuple(names),
+            k=k,
+            hidden_layers_a=len(clf_a.hidden),
+            hidden_layers_b=len(clf_b.hidden),
+            registry_name=registry_name,
+            hidden_dtype_name=hidden_dtype_name,
+            guard=guard,
+        )
+    else:
+        prep = prepared
+        if prep is None:
+            prep = prepare_pair_fold(
+                clf_a, clf_b, names=tuple(names), k=k,
+                registry_name=registry_name, quantize=mode,
+            )
+        hidden_a = {
+            name: leaf for name, leaf in clf_a.params['params'].items()
+            if name != 'Dense_0'
+        }
+        hidden_b = {
+            name: leaf for name, leaf in clf_b.params['params'].items()
+            if name != 'Dense_0'
+        }
+        out = _pair_probs_prepared(
+            prep.tables,
+            prep.w_dense,
+            prep.bias,
+            hidden_a,
+            hidden_b,
+            batch,
+            dense_overrides,
+            names=tuple(names),
+            k=k,
+            hidden_layers_a=len(clf_a.hidden),
+            hidden_layers_b=len(clf_b.hidden),
+            registry_name=registry_name,
+            h_a_width=prep.h_a_width,
+            quantize=prep.quantize,
+            kernel=method,
+            hidden_dtype_name=hidden_dtype_name,
+            guard=guard,
+        )
     if guard:
         pa, pb, (n_nonfinite, n_overflow) = out
         # no sync here: the device scalars are stashed for a later
@@ -813,6 +1231,8 @@ def fused_train_logits(
     mean: Optional[jax.Array] = None,
     std: Optional[jax.Array] = None,
     compute_dtype: Optional[Any] = None,
+    quantize: str = 'none',
+    kernel: Optional[str] = None,
 ) -> jax.Array:
     """Differentiable MLP logits over packed training rows -> ``(N,)``.
 
@@ -831,8 +1251,26 @@ def fused_train_logits(
     the post-relu hidden pipeline; the fold, the gathers and the logit
     head stay f32 (master weights are always f32 — the optimizer never
     sees the cast).
+
+    ``quantize`` (``'none'`` | ``'bf16'`` | ``'int8'``) trains
+    *quantization-aware*: the freshly folded per-state tables and the
+    dense sub-kernel pass through the straight-through
+    :func:`socceraction_tpu.ops.quant.fake_quant` every step, so the
+    loss sees exactly the values quantized serving will gather while the
+    gradient flows through unchanged — the fit the prepared serving fold
+    (:func:`prepare_pair_fold`) then quantizes for real. ``kernel``
+    selects the first-layer lowering (default: the
+    ``SOCCERACTION_TPU_FUSED_KERNEL`` / platform-profile resolution);
+    (``'none'``, ``'xla'``) keeps the original per-gather lowering
+    bit-for-bit. The fused-kernel path runs the dense sub-matmul in f32
+    regardless of ``compute_dtype`` (the hidden pipeline still narrows).
     """
+    from .gather_matmul import fused_first_layer
+    from .quant import check_quantize_mode, fake_quant
+
+    check_quantize_mode(quantize)
     registry = REGISTRIES[layout.registry_name]
+    method = _resolve_kernel(kernel, registry.combo_size)
     leaves = params['params']
     Wk, bias = _standardized_first_layer(leaves, mean, std)
     if Wk.shape[0] != layout.n_features:
@@ -842,27 +1280,33 @@ def fused_train_logits(
             f'{layout.n_features} columns'
         )
     H = Wk.shape[1]
+    blocks, dense_spans = _layout_split(layout)
+    if quantize != 'none' or method != 'xla':
+        # fused first layer: one pass over the batch for the gathers AND
+        # the dense matmul (ops/gather_matmul.py), with the tables
+        # fake-quantized (STE) when training quantization-aware
+        tables = jnp.stack(
+            [_combined_table(Wk, i, blocks, registry) for i in range(layout.k)]
+        )
+        if quantize != 'none':
+            tables = fake_quant(tables, quantize)
+        if dense_spans and x_dense.shape[1]:
+            W_dense = _dense_subkernel(Wk, dense_spans)
+            if quantize != 'none':
+                W_dense = fake_quant(W_dense, quantize)
+        else:
+            W_dense = jnp.zeros((0, H), Wk.dtype)
+        h = fused_first_layer(
+            tables, W_dense, bias, combo_ids, x_dense, method
+        )
+        return _hidden_chain(leaves, h, hidden_layers, compute_dtype)
     h = jnp.zeros((x_dense.shape[0], H), Wk.dtype) + bias
-    blocks = [
-        (name, width // layout.k, off)
-        for name, kind, off, width in layout.spans
-        if kind == 'onehot'
-    ]
     if blocks:
         for i in range(layout.k):
             table = _combined_table(Wk, i, blocks, registry)
             h = h + table_lookup(table, combo_ids[:, i], registry.combo_size)
-    dense_spans = [
-        (off, width) for _, kind, off, width in layout.spans if kind == 'dense'
-    ]
     if dense_spans and x_dense.shape[1]:
-        W_dense = jnp.concatenate(
-            [
-                jax.lax.slice_in_dim(Wk, off, off + width, axis=0)
-                for off, width in dense_spans
-            ],
-            axis=0,
-        )
+        W_dense = _dense_subkernel(Wk, dense_spans)
         x = x_dense
         if compute_dtype is not None:
             x = x.astype(compute_dtype)

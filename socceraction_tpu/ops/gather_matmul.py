@@ -1,0 +1,294 @@
+"""Fused gather + dense-matmul first layer: one pass over the batch.
+
+The fused rating/training hot path applies an MLP first layer as
+
+``h = bias + Σ_{i<k} tables[i][ids[:, i]] + x_dense @ W_dense``
+
+(:mod:`socceraction_tpu.ops.fused`): ``k`` combined-table row gathers
+plus one small dense matmul. Lowered through XLA those are ``k + 1``
+separate HBM round-trips of the ``(N, H)`` accumulator — each gather
+materializes an ``(N, H)`` intermediate that the next add reads back.
+The Pallas kernel here fuses all of them into ONE pass over the batch:
+
+- the batch is tiled into ``CHUNK_ROWS``-row blocks; per block the
+  ``(CHUNK_ROWS, H)`` accumulator lives in VMEM for the whole first
+  layer — bias, the ``k`` gathers and the dense matmul land on it
+  without ever round-tripping HBM;
+- each gather is recast as the *blocked one-hot contraction* the
+  segment-sum kernel (:mod:`socceraction_tpu.ops.segment`) measured
+  2.5× over the conflict-serialized scatter on v5e: the ``(CHUNK_ROWS,
+  R)`` one-hot mask is an iota compare built on the VPU and contracted
+  against the table on the MXU. A one-hot row selects exactly one table
+  row, so the contraction is *exact* — bit-identical to the gather;
+- narrow tables are widened in VMEM: bf16 storage
+  (:mod:`socceraction_tpu.ops.quant`) reaches the MXU via an in-kernel
+  ``astype``; int8 storage is expanded to a transient f32 table inside
+  the same dispatch (:func:`socceraction_tpu.ops.quant.dequantize` —
+  base + packed 2-bit refinement + per-row scale) before the kernel
+  consumes it. Either way accumulation is f32 throughout and nothing
+  dequantized becomes HBM-*resident*.
+
+Dispatch (``SOCCERACTION_TPU_FUSED_KERNEL=auto|pallas|xla``):
+``auto`` runs Pallas on TPU while the combined-table row count is
+within the committed platform profile's
+``pallas.fused_gather_matmul_max_combo`` (the same measured-crossover
+source as the segment-sum gates — ``ops/platform_profiles.json``), XLA
+otherwise; ``pallas`` forces the kernel (interpret mode off-TPU — how
+the CPU tests exercise it); ``xla`` forces the portable lowering. The
+XLA lowering is the bit-pinned fallback: both methods share the same
+padded operands and the same accumulation order, and
+``tests/test_quant.py`` pins them *bitwise* equal on CPU (under jit —
+both run jitted in production).
+
+The differentiable entry (:func:`fused_first_layer`) carries a custom
+VJP so the fused-training fold can run through the kernel: the backward
+of the gathers is the row-wise segment sum the table-lookup machinery
+already owns (:func:`socceraction_tpu.ops.segment.segment_sum_rows` —
+the one-hot MXU contraction on TPU), and the dense matmul's cotangents
+are the usual transposed products.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    'CHUNK_ROWS',
+    'FUSED_KERNEL_METHODS',
+    'fused_kernel_method',
+    'fused_first_layer',
+    'fused_first_layer_quant',
+]
+
+#: Batch rows per Pallas grid step (the VMEM-resident accumulator's
+#: leading dim). 256 keeps the per-block one-hot mask (256 × R_pad) and
+#: the f32 accumulator comfortably inside VMEM next to the tables.
+CHUNK_ROWS = 256
+
+_LANES = 128  # TPU lane width: last-dim padding quantum
+
+FUSED_KERNEL_METHODS = ('auto', 'pallas', 'xla')
+
+_ENV = 'SOCCERACTION_TPU_FUSED_KERNEL'
+
+
+def _env_method() -> str:
+    method = os.environ.get(_ENV, 'auto')
+    if method not in FUSED_KERNEL_METHODS:
+        raise ValueError(f'{_ENV}={method!r} (want auto|pallas|xla)')
+    return method
+
+
+def fused_kernel_method(combo_size: Optional[int] = None) -> str:
+    """Resolve the first-layer kernel for this process: 'pallas' | 'xla'.
+
+    ``auto`` (the default) selects Pallas on TPU while ``combo_size``
+    (the combined-table row count — the one-hot contraction's lane
+    dimension) is within the platform profile's
+    ``fused_gather_matmul_max_combo`` gate; XLA otherwise, and always on
+    non-TPU backends (where the real kernel cannot run — the ``pallas``
+    *override* still runs it in interpret mode, which is how the unit
+    tests exercise the kernel on CPU).
+    """
+    method = _env_method()
+    if method != 'auto':
+        return method
+    if jax.default_backend() != 'tpu':
+        return 'xla'
+    from .profile import pallas_profile
+
+    gate = int(pallas_profile()['fused_gather_matmul_max_combo'])
+    if combo_size is not None and combo_size > gate:
+        return 'xla'
+    return 'pallas'
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _kernel(ids_ref, x_ref, tables_ref, w_ref, bias_ref, out_ref, *, k: int):
+    """One ``(CHUNK_ROWS, H)`` block of first-layer activations.
+
+    Accumulation order matches the XLA lowering exactly (bias, then the
+    ``k`` state gathers, then the dense matmul) — the bitwise-parity
+    contract between the two dispatch methods.
+    """
+    acc = jnp.zeros(out_ref.shape, jnp.float32) + bias_ref[:]
+    r_pad = tables_ref.shape[1]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, r_pad), 1)
+    for i in range(k):
+        onehot = (ids_ref[:, i : i + 1] == lanes).astype(jnp.float32)
+        # bf16 storage widens in VMEM; exact: each one-hot row selects
+        # one table row (or none for the -1 padding rows), so the MXU
+        # contraction IS the gather
+        rows = jnp.dot(
+            onehot,
+            tables_ref[i].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        acc = acc + rows
+    acc = acc + jnp.dot(
+        x_ref[:],
+        w_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    out_ref[:] = acc
+
+
+def _padded_operands(tables, w, bias, ids, x):
+    """Shared zero padding for BOTH dispatch methods.
+
+    Padding to lane multiples is a Pallas layout requirement; the XLA
+    lowering uses the *same* padded operands so the two methods run the
+    same adds on the same values — the bitwise-parity contract. Padded
+    table rows/columns are zeros (selected by no valid id, contributing
+    exact ``+0.0`` terms), padded batch rows carry id ``-1`` (matching
+    no one-hot lane) and zero dense features.
+    """
+    n, d = x.shape
+    _, r, h = tables.shape
+    n_pad = _round_up(max(n, 1), CHUNK_ROWS)
+    r_pad = _round_up(r, _LANES)
+    h_pad = _round_up(h, _LANES)
+    d_pad = _round_up(max(d, 1), _LANES)
+    tables = jnp.pad(tables, ((0, 0), (0, r_pad - r), (0, h_pad - h)))
+    w = jnp.pad(w, ((0, d_pad - d), (0, h_pad - h)))
+    bias = jnp.pad(bias.reshape(1, -1).astype(jnp.float32), ((0, 0), (0, h_pad - h)))
+    ids = jnp.pad(
+        ids.astype(jnp.int32), ((0, n_pad - n), (0, 0)), constant_values=-1
+    )
+    x = jnp.pad(x.astype(jnp.float32), ((0, n_pad - n), (0, d_pad - d)))
+    return tables, w, bias, ids, x, n, h
+
+
+def _forward(tables, w, bias, ids, x, *, method: str):
+    if method not in ('pallas', 'xla'):
+        raise ValueError(f'fused kernel method {method!r} (want pallas|xla)')
+    k = ids.shape[1]
+    tables, w, bias, ids, x, n, h = _padded_operands(tables, w, bias, ids, x)
+    if method == 'xla':
+        out = jnp.zeros((x.shape[0], bias.shape[1]), jnp.float32) + bias
+        for i in range(k):
+            # padding rows carry id -1: wrap to the (all-zero) last
+            # padded table row so the gather stays in bounds; those rows
+            # are sliced off below anyway
+            out = out + tables[i].astype(jnp.float32)[ids[:, i]]
+        out = out + jnp.dot(
+            x,
+            w.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return out[:n, :h]
+    r_pad, h_pad = tables.shape[1], tables.shape[2]
+    d_pad = x.shape[1]
+    grid = (x.shape[0] // CHUNK_ROWS,)
+    out = pl.pallas_call(
+        functools.partial(_kernel, k=k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((CHUNK_ROWS, k), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((CHUNK_ROWS, d_pad), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, r_pad, h_pad), lambda c: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d_pad, h_pad), lambda c: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h_pad), lambda c: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (CHUNK_ROWS, h_pad), lambda c: (c, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], h_pad), jnp.float32),
+        interpret=jax.default_backend() != 'tpu',
+    )(ids, x, tables, w, bias)
+    return out[:n, :h]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def fused_first_layer(
+    tables: jax.Array,
+    w_dense: jax.Array,
+    bias: jax.Array,
+    ids: jax.Array,
+    x_dense: jax.Array,
+    method: str,
+) -> jax.Array:
+    """Differentiable fused first layer over packed rows -> ``(N, H)``.
+
+    ``tables`` is the ``(k, R, H)`` f32 stack of per-state combined
+    tables, ``w_dense`` the ``(D, H)`` dense sub-kernel, ``bias`` the
+    ``(H,)`` (standardization-folded) bias, ``ids`` the ``(N, k)``
+    combined categorical ids and ``x_dense`` the ``(N, D)`` dense rows.
+    ``method`` selects the lowering (``'pallas'`` | ``'xla'`` — resolve
+    ``'auto'`` first via :func:`fused_kernel_method`).
+
+    The custom VJP makes the kernel trainable: the table cotangent is
+    the row-wise segment sum (one-hot MXU contraction on TPU —
+    :func:`socceraction_tpu.ops.segment.segment_sum_rows`), exactly the
+    backward :func:`socceraction_tpu.ops.fused.table_lookup` gives the
+    per-gather form.
+    """
+    return _forward(tables, w_dense, bias, ids, x_dense, method=method)
+
+
+def _ffl_fwd(tables, w_dense, bias, ids, x_dense, method):
+    out = _forward(tables, w_dense, bias, ids, x_dense, method=method)
+    return out, (tables.shape, ids, x_dense, w_dense)
+
+
+def _ffl_bwd(method, res, g):
+    import numpy as _np
+
+    from .segment import segment_sum_rows
+
+    tables_shape, ids, x_dense, w_dense = res
+    k, num_rows, _h = tables_shape
+    g = g.astype(jnp.float32)
+    d_tables = jnp.stack(
+        [segment_sum_rows(g, ids[:, i], num_rows) for i in range(k)]
+    )
+    d_w = jax.lax.dot_general(
+        x_dense, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    d_bias = jnp.sum(g, axis=0)
+    d_x = jnp.dot(
+        g, w_dense.T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    d_ids = _np.zeros(ids.shape, dtype=jax.dtypes.float0)  # int ids: no tangent
+    return d_tables, d_w, d_bias, d_ids, d_x
+
+
+fused_first_layer.defvjp(_ffl_fwd, _ffl_bwd)
+
+
+def fused_first_layer_quant(
+    tables: jax.Array,
+    w_dense: jax.Array,
+    bias: jax.Array,
+    ids: jax.Array,
+    x_dense: jax.Array,
+    *,
+    method: str,
+) -> jax.Array:
+    """Serving twin of :func:`fused_first_layer` over narrow storage.
+
+    ``tables``/``w_dense`` may be f32 or bf16 — bf16 widens inside the
+    kernel (int8 storage is expanded to a transient f32 table by the
+    caller via :func:`socceraction_tpu.ops.quant.dequantize`, in the
+    same dispatch). Not differentiable (training quantization goes
+    through :func:`socceraction_tpu.ops.quant.fake_quant` and the f32
+    :func:`fused_first_layer`).
+    """
+    return _forward(tables, w_dense, bias, ids, x_dense, method=method)

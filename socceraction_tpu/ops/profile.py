@@ -42,8 +42,10 @@ __all__ = [
     'FUSED_PATH_HIDDEN_DTYPES',
     'OPT_IN_PATHS',
     'hidden_dtype_for',
+    'PALLAS_PROFILE_DEFAULTS',
     'RATING_PATHS',
     'load_profiles',
+    'pallas_profile',
     'preferred_rating_path',
     'record_measurement',
 ]
@@ -95,6 +97,67 @@ def _current_platform() -> str:
     import jax
 
     return jax.devices()[0].platform
+
+
+#: Fallback Pallas auto-dispatch thresholds, used when the committed
+#: profile carries no ``pallas`` section (or no profile file shipped at
+#: all). Values are the v5e measurements the segment-sum crossover table
+#: records (``benchmarks/segment_crossover.py``; ops/segment.py module
+#: docstring) — the ONE source both the scalar/row-wise segment kernels
+#: and the fused gather-matmul kernel read their gates from, so a
+#: re-measured chip generation updates every dispatch site by editing
+#: ``platform_profiles.json``, never a second hardcoded constant.
+PALLAS_PROFILE_DEFAULTS: Dict[str, Any] = {
+    # scalar segment-sum: Pallas one-hot contraction wins up to here
+    'segment_max_segments': 2048,
+    # row-wise segment-sum (the fused-train backward): same crossover
+    'rows_onehot_max_segments': 2048,
+    # fused gather+matmul first layer: the one-hot side of the kernel is
+    # the same blocked contraction, gated on the combined-table rows
+    'fused_gather_matmul_max_combo': 2048,
+}
+
+
+def pallas_profile() -> Dict[str, Any]:
+    """The committed Pallas dispatch thresholds, default-filled.
+
+    Reads the ``pallas`` section of ``platform_profiles.json`` (cached
+    like the rating-path profile) and overlays it on
+    :data:`PALLAS_PROFILE_DEFAULTS`, so a profile missing the section —
+    or a wheel missing the data file — degrades to the measured v5e
+    defaults instead of crashing an import.
+    """
+    try:
+        section = load_profiles().get('pallas', {})
+    except (OSError, ValueError):
+        section = {}
+    merged = dict(PALLAS_PROFILE_DEFAULTS)
+    for key, value in section.items():
+        if key == 'source':  # provenance note, not a threshold
+            continue
+        # a typo'd key OR a malformed value silently keeping (or
+        # crashing over) the hardcoded default is exactly the
+        # retune-that-never-happened / import-crash failure this
+        # single-source section exists to prevent — warn and keep the
+        # measured default (segment.py reads this at import time)
+        problem = None
+        if key not in merged:
+            problem = f'unknown key (known: {sorted(PALLAS_PROFILE_DEFAULTS)})'
+        else:
+            try:
+                merged[key] = int(value)
+            except (TypeError, ValueError):
+                problem = f'non-integer value {value!r}'
+        if problem:
+            import warnings
+
+            warnings.warn(
+                f'platform_profiles.json pallas section: {key!r} — '
+                f'{problem}; ignored, the built-in default stays in '
+                'effect',
+                stacklevel=2,
+            )
+    return merged
 
 
 def hidden_dtype_for(path: str) -> Optional[Any]:

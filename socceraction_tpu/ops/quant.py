@@ -1,0 +1,241 @@
+"""Narrow-precision storage for the fused combined tables.
+
+The combined-table fold (:mod:`socceraction_tpu.ops.fused`) is a gather
+plus adds — tolerant of narrow *storage* as long as accumulation stays
+f32. This module owns the storage formats the prepared serving fold
+(:func:`socceraction_tpu.ops.fused.prepare_pair_fold`) and the QAT
+training fold (:func:`socceraction_tpu.ops.fused.fused_train_logits`)
+quantize into:
+
+- ``'none'`` — f32 storage (the identity format; one code path for all
+  three modes keeps the quantized paths from forking).
+- ``'bf16'`` — bfloat16 storage, dequantized by a plain ``astype``
+  inside the fused kernel. Halves table bytes; round-trip relative
+  error is bounded by bf16's 8 significand bits (``2**-8`` per
+  element).
+- ``'int8'`` — symmetric per-column-scaled int8 with f32 scales, plus a
+  packed 2-bit refinement plane (1.25 bytes/element, a 3.1× table-byte
+  reduction vs f32):
+
+  * ``scale[r] = max_h |t[r, h]| / 127`` — one f32 scale per *table
+    row*, which IS one scale per input feature column (group): a
+    combined-table row is the fold of the one-hot input columns
+    selecting it, and the standardization fold divides each input
+    column's weights by its own ``σ``, so magnitudes vary by orders of
+    magnitude *across* rows (rare one-hots have tiny ``σ``) while
+    staying homogeneous along the hidden axis within a row. Scaling
+    along the hidden axis instead would let one rare combo's huge row
+    set the quantization step for every common row — measured ~30×
+    worse on the golden game.
+  * base plane ``round(t / scale)`` clipped to ``[-127, 127]`` int8.
+  * refinement plane: the rounding residual re-quantized on a 4-level
+    grid (codes packed four-per-byte, :func:`_pack_codes`), shrinking
+    the absolute error bound from ``scale/2`` to ``scale/8`` per
+    element. Plain int8 measures 2–4e-3 max-abs-err on golden-game VAEP
+    values — information-theoretically stuck above the 1e-3 serving
+    band — while base+refinement lands ~4× lower at 1.25 bytes instead
+    of 2 (bf16) or 4 (f32).
+
+Accumulation is f32 everywhere: quantization narrows what is *stored*
+(and therefore what a warm model version holds in HBM), never what is
+summed — ``'int8'`` storage is expanded to transient f32 tables inside
+the dispatch (:func:`dequantize`) and the fused gather+matmul consumes
+those; nothing f32 becomes resident. The in-production error meter for
+these formats is the serve layer's
+:class:`~socceraction_tpu.obs.parity.ParityProbe`
+(``num/parity_abs_err{pair,quant}`` — gate quantized serving at
+``max_abs_err <= 1e-3``; see ``docs/observability.md``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    'QUANTIZE_MODES',
+    'INT8_QMAX',
+    'QuantizedArray',
+    'check_quantize_mode',
+    'quantize_columns',
+    'quantize_with_scale',
+    'dequantize',
+    'fake_quant',
+    'quantized_nbytes',
+]
+
+#: The supported table storage formats, in widening order of error band.
+QUANTIZE_MODES = ('none', 'bf16', 'int8')
+
+#: Symmetric int8 clip bound (``-128`` is excluded so the grid is
+#: symmetric and ``-t`` quantizes to exactly ``-q(t)``).
+INT8_QMAX = 127.0
+
+#: Codes per packed refinement byte (2 bits each).
+_CODES_PER_BYTE = 4
+
+
+class QuantizedArray(NamedTuple):
+    """One array in quantized storage: data plane, refinement, scales.
+
+    ``resid`` and ``scale`` are ``None`` except for ``'int8'``:
+    ``data`` int8 ``(..., R, H)``, ``resid`` uint8
+    ``(..., R, ceil(H/4))`` packed 2-bit refinement codes, ``scale``
+    f32 ``(..., R, 1)`` per-row symmetric scales. ``'bf16'`` stores
+    ``data`` bfloat16; ``'none'`` f32.
+    """
+
+    data: jax.Array
+    resid: Optional[jax.Array]
+    scale: Optional[jax.Array]
+
+
+def check_quantize_mode(mode: str) -> str:
+    """Validate (and return) a quantization mode string."""
+    if mode not in QUANTIZE_MODES:
+        raise ValueError(
+            f'unknown quantize mode {mode!r} (want one of {QUANTIZE_MODES})'
+        )
+    return mode
+
+
+def _pack_codes(codes: jax.Array) -> jax.Array:
+    """Pack 4-level codes (values 0..3) four-per-byte along the last axis.
+
+    The last axis is split into ``ceil(H/4)`` quarter-blocks laid out
+    contiguously: byte ``c`` carries the codes of columns ``c``,
+    ``c + Hq``, ``c + 2·Hq``, ``c + 3·Hq`` in bit pairs ``0-1`` … ``6-7``
+    (columns past ``H`` pad as code 0 and are sliced off on unpack).
+    """
+    h = codes.shape[-1]
+    hq = -(-h // _CODES_PER_BYTE)
+    pad = hq * _CODES_PER_BYTE - h
+    if pad:
+        codes = jnp.pad(codes, [(0, 0)] * (codes.ndim - 1) + [(0, pad)])
+    packed = jnp.zeros(codes.shape[:-1] + (hq,), jnp.uint8)
+    for j in range(_CODES_PER_BYTE):
+        block = codes[..., j * hq : (j + 1) * hq].astype(jnp.uint8)
+        packed = packed | (block << (2 * j))
+    return packed
+
+
+def _unpack_codes(packed: jax.Array, h: int) -> jax.Array:
+    """Inverse of :func:`_pack_codes` -> f32 codes ``(..., h)``."""
+    parts = [
+        ((packed >> (2 * j)) & 3).astype(jnp.float32)
+        for j in range(_CODES_PER_BYTE)
+    ]
+    return jnp.concatenate(parts, axis=-1)[..., :h]
+
+
+def quantize_columns(t: jax.Array, mode: str) -> QuantizedArray:
+    """Quantize ``(..., R, H)`` f32 tables to ``mode`` storage.
+
+    For ``'int8'`` the f32 symmetric scale is per row — i.e. per input
+    feature column, module docstring — reduced over the hidden axis
+    ``-1``, so a stacked ``(k, R, H)`` pair fold gets one scale per
+    state per table row. An all-zero row quantizes with scale 0 so it
+    reconstructs to EXACT zeros — the centered 4-level refinement grid
+    has no zero level, so any positive scale would serve ``scale/8``
+    where the table stored nothing. The refinement plane always rides
+    along.
+    """
+    check_quantize_mode(mode)
+    t = jnp.asarray(t, jnp.float32)
+    if mode == 'none':
+        return QuantizedArray(t, None, None)
+    if mode == 'bf16':
+        return QuantizedArray(t.astype(jnp.bfloat16), None, None)
+    amax = jnp.max(jnp.abs(t), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 0.0).astype(jnp.float32)
+    data, resid = quantize_with_scale(t, scale)
+    return QuantizedArray(data, resid, scale)
+
+
+def quantize_with_scale(
+    t: jax.Array, scale: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """int8 base + packed refinement for ``t`` under FIXED f32 scales.
+
+    The checkpoint-stable entry: a loaded model re-quantizes its fold
+    with the scales persisted in the checkpoint
+    (``models/quant_scales.npz``), so the served int8 representation is
+    bit-identical across library versions as long as the (checksummed)
+    parameters are. Returns ``(data int8, resid uint8-packed)``.
+    """
+    t = jnp.asarray(t, jnp.float32)
+    # scale 0 marks an all-zero row (quantize_columns): its grid is 0,
+    # never 0/0 — the row reconstructs as exact zeros under any codes
+    grid = jnp.where(scale > 0, t / jnp.where(scale > 0, scale, 1.0), 0.0)
+    base = jnp.clip(jnp.round(grid), -INT8_QMAX, INT8_QMAX)
+    # rounding residual in grid units ∈ [-0.5, 0.5], onto a centered
+    # 4-level grid (codes 0..3 -> levels (code - 1.5) / 4): worst-case
+    # error drops from scale/2 to scale/8
+    r = grid - base
+    codes = jnp.clip(jnp.round(r * _CODES_PER_BYTE + 1.5), 0, 3)
+    return base.astype(jnp.int8), _pack_codes(codes)
+
+
+def dequantize(
+    data: jax.Array,
+    resid: Optional[jax.Array] = None,
+    scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """f32 view of quantized storage (transient — built per dispatch).
+
+    ``'none'``/``'bf16'`` widen by ``astype``; ``'int8'`` reconstructs
+    ``scale · (base + (code - 1.5)/4)``. The result feeds the fused
+    gather+matmul inside the same jit — quantized models never hold an
+    f32 table in HBM *residency*, only in per-dispatch transients.
+    """
+    x = data.astype(jnp.float32)
+    if scale is None:
+        return x
+    if resid is not None:
+        x = x + (_unpack_codes(resid, x.shape[-1]) - 1.5) / _CODES_PER_BYTE
+    return x * scale
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def fake_quant(t: jax.Array, mode: str) -> jax.Array:
+    """Quantize→dequantize round trip with a straight-through gradient.
+
+    The QAT hook of the fused training fold: with
+    ``MLPClassifier(quantize=...)`` the per-state tables (and the dense
+    sub-kernel) pass through this every step, so the loss is computed on
+    exactly the values quantized serving will produce while the
+    (non-differentiable) rounding is skipped by the backward —
+    ``d fake_quant / d t = 1`` (the straight-through estimator).
+    ``mode='none'`` is the identity.
+    """
+    q = quantize_columns(t, mode)
+    return dequantize(q.data, q.resid, q.scale)
+
+
+def _fake_quant_fwd(t, mode):
+    return fake_quant(t, mode), None
+
+
+def _fake_quant_bwd(mode, _res, g):
+    return (g,)
+
+
+fake_quant.defvjp(_fake_quant_fwd, _fake_quant_bwd)
+
+
+def quantized_nbytes(q: Any) -> int:
+    """Device bytes of one :class:`QuantizedArray` (planes + scales).
+
+    The number the bench's HBM table-bytes headline and the registry
+    residency pins report — computed from shapes/dtypes, so it equals
+    what :func:`socceraction_tpu.obs.residency.claim_bytes` attributes
+    for the same arrays.
+    """
+    n = 0
+    for a in q:
+        if a is not None:
+            n += int(a.size) * jnp.dtype(a.dtype).itemsize
+    return n

@@ -71,16 +71,24 @@ __all__ = [
     'segment_sum_2d',
 ]
 
+from .profile import pallas_profile
+
 CHUNK = 512  # actions per grid step
 SEG_BLOCK = 1024  # segment (grid-cell) lanes per grid step
-PALLAS_MAX_SEGMENTS = 2048  # crossover to XLA scatter, measured on v5e
-# (module docstring; re-derive with benchmarks/segment_crossover.py)
+
+#: Crossover to the XLA scatter, measured on v5e (module docstring;
+#: re-derive with ``benchmarks/segment_crossover.py``). Read from the
+#: committed platform profile (``platform_profiles.json``, ``pallas``
+#: section) — the SAME source the fused gather-matmul kernel's dispatch
+#: gate reads (:func:`socceraction_tpu.ops.gather_matmul.fused_kernel_method`),
+#: so a re-measured chip updates every Pallas gate in one place.
+PALLAS_MAX_SEGMENTS = int(pallas_profile()['segment_max_segments'])
 
 #: Row-wise variant (:func:`segment_sum_rows`): past this many segments the
 #: (N, S) one-hot mask stops paying for itself and the XLA scatter takes
 #: over. The fused-train backward gathers into combined tables of at most
-#: T*R*B = 552 rows, far inside the bound.
-ROWS_ONEHOT_MAX_SEGMENTS = 2048
+#: T*R*B = 552 rows, far inside the bound. Same profile source as above.
+ROWS_ONEHOT_MAX_SEGMENTS = int(pallas_profile()['rows_onehot_max_segments'])
 
 
 def _kernel(ids_ref, vals_ref, out_ref):

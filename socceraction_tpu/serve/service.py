@@ -317,6 +317,42 @@ class RatingService:
         """Game-state depth ``k`` of the serving model."""
         return int(self.model.nb_prev_actions)
 
+    def _model_quantize(self) -> str:
+        """Table-storage mode of the serving model ('none' when unknown).
+
+        Mid-swap head disagreement (or a tree-head model with no fused
+        fold) reports 'none' — health() must never raise over a label.
+        """
+        try:
+            return str(getattr(self.model, 'quantize', 'none'))
+        except ValueError:
+            return 'none'
+
+    def _model_kernel(self) -> str:
+        """Resolved first-layer lowering serving this process.
+
+        The value of :func:`~socceraction_tpu.ops.gather_matmul.fused_kernel_method`
+        for the serving model's combined-table size — what a flush will
+        actually dispatch through, after the env override and the
+        platform-profile gate ('xla' for non-fused models: there is no
+        first-layer kernel to select).
+        """
+        model = self.model
+        if not getattr(model, '_can_fuse', lambda: False)():
+            return 'xla'
+        from ..ops.fused import REGISTRIES
+        from ..ops.gather_matmul import fused_kernel_method
+
+        registry = REGISTRIES[model._fused_registry]
+        try:
+            return fused_kernel_method(registry.combo_size)
+        except ValueError:
+            # a malformed SOCCERACTION_TPU_FUSED_KERNEL value must not
+            # take down the health endpoint the operator needs to
+            # diagnose it — the flush path raises (and degrades) on its
+            # own terms
+            return 'invalid'
+
     def _prepare_swap_target(self, name: str, version: str) -> Any:
         """Load, validate, layout-guard and ladder-warm a swap target.
 
@@ -996,7 +1032,15 @@ class RatingService:
             },
             'breaker': breaker_block,
             'flusher_restarts': self._batcher.flusher_restarts,
-            'model': {'name': name, 'version': version},
+            'model': {
+                'name': name,
+                'version': version,
+                # the serving numerics configuration: table-storage mode
+                # + the resolved first-layer lowering (operators gating a
+                # quantized deploy read these next to numerics.parity)
+                'quantize': self._model_quantize(),
+                'kernel': self._model_kernel(),
+            },
             'ladder': list(self.ladder),
             'compiled_shapes': self.compiled_shapes,
             'capacity': {

@@ -56,7 +56,20 @@ class NotFittedError(ValueError):
 #: change; loaders reject artifacts from a NEWER version with a clear
 #: error instead of failing deep inside key access (the model registry,
 #: :mod:`socceraction_tpu.serve.registry`, depends on this contract).
-CHECKPOINT_FORMAT_VERSION = 1
+#: Version 2 adds quantized-serving metadata (``quantize`` mode +
+#: ``models/quant_scales.npz``). ``save_model`` stamps the MINIMUM
+#: version able to read the artifact: an unquantized checkpoint still
+#: stamps 1 (pre-quantization libraries keep loading it unchanged),
+#: while a quantized one stamps 2 so an older loader fails with the
+#: actionable "newer than this library understands — upgrade" error
+#: instead of serving f32 where the publisher validated int8.
+CHECKPOINT_FORMAT_VERSION = 2
+
+#: Relative path of the persisted int8 quantization scales inside a
+#: quantized ``save_model`` checkpoint — sha256-checksummed in
+#: ``meta.json`` like every other artifact, so a re-loaded model serves
+#: the exact int8 representation the published version was gated on.
+_QUANT_SCALES_ARTIFACT = 'models/quant_scales.npz'
 
 
 def _check_format_version(meta: Dict[str, Any], path: str) -> None:
@@ -148,6 +161,8 @@ def _mlp_hyperparams(clf: MLPClassifier) -> Dict[str, Any]:
     }
     if clf.train_dtype is not None:
         hyper['train_dtype'] = clf.train_dtype
+    if clf.quantize != 'none':
+        hyper['quantize'] = clf.quantize
     return hyper
 
 
@@ -199,6 +214,10 @@ class VAEP:
         self.nb_prev_actions = nb_prev_actions
         self.backend = backend
         self._feature_names_cache: Dict[Tuple[Any, ...], List[str]] = {}
+        #: cached (key, PreparedPair) serving fold — see _prepared_pair
+        self._pair_prep: Optional[Tuple[Any, Any]] = None
+        #: int8 scales restored from a quantized checkpoint (or None)
+        self._quant_scales: Optional[Dict[str, Any]] = None
 
     def _default_xfns(self) -> List[fs.FeatureTransfomer]:
         return list(xfns_default)
@@ -344,6 +363,7 @@ class VAEP:
             self._models[col] = fit_fn(
                 X_train, y_train[col], eval_set, tree_params, fit_params
             )
+        self._drop_stale_quant_state()
         return self
 
     def fit_packed(
@@ -532,6 +552,7 @@ class VAEP:
                     head_tree, head_fit,
                     names=names, k=k, registry=registry, mean=mean, std=std,
                 )
+        self._drop_stale_quant_state()
         return self
 
     @staticmethod
@@ -616,6 +637,171 @@ class VAEP:
             and self._fused_registry is not None
             and all(isinstance(m, MLPClassifier) for m in self._models.values())
         )
+
+    # -- quantized serving fold --------------------------------------------
+
+    def _drop_stale_quant_state(self) -> None:
+        """Invalidate fold + persisted scales after a (re)fit.
+
+        Checkpoint-pinned int8 scales describe the WEIGHTS they were
+        derived from: requantizing refit parameters under them clips any
+        row whose magnitude outgrew ``old_scale * 127`` — unbounded
+        error the parity band would only catch after the fact. A refit
+        therefore re-derives scales from the new weights (and
+        ``save_model`` persists the fresh pair).
+        """
+        self._pair_prep = None
+        self._quant_scales = None
+
+    @property
+    def quantize(self) -> str:
+        """The (shared) table-storage mode of the MLP heads.
+
+        ``'none'`` | ``'bf16'`` | ``'int8'``
+        (:mod:`socceraction_tpu.ops.quant`). Heads that disagree raise —
+        the pair fold stacks both heads into one table set, so the mode
+        is a model-level decision (:meth:`set_quantize`).
+        """
+        modes = {
+            m.quantize for m in self._models.values()
+            if isinstance(m, MLPClassifier)
+        }
+        if not modes:
+            return 'none'
+        if len(modes) > 1:
+            raise ValueError(
+                f'heads disagree on quantize mode: {sorted(modes)}; '
+                'set one mode for the whole model with set_quantize()'
+            )
+        return modes.pop()
+
+    def set_quantize(self, mode: str) -> 'VAEP':
+        """Set the serving table-storage mode on every MLP head.
+
+        Post-training quantization: an already-fitted f32 model switches
+        to quantized serving in place (the prepared fold is rebuilt on
+        the next :meth:`rate_batch` / registry warm). Set the mode on the
+        classifier *before* :meth:`fit_packed` instead to also train
+        quantization-aware (``tree_params={'quantize': ...}``).
+        Stale persisted scales are dropped when the mode changes — they
+        described the previous mode's fold.
+        """
+        from ..ops.quant import check_quantize_mode
+
+        check_quantize_mode(mode)
+        if mode != 'none':
+            if not self._models:
+                raise NotFittedError('fit the model before set_quantize')
+            non_mlp = [
+                col for col, m in self._models.items()
+                if not isinstance(m, MLPClassifier)
+            ]
+            if non_mlp:
+                raise ValueError(
+                    f'quantized serving needs MLP heads; {non_mlp!r} are '
+                    'not (tree heads have no fused fold to quantize)'
+                )
+            if not self._can_fuse():
+                # e.g. a subclass without a fused registry: there is no
+                # serving fold to quantize, so the mode would silently
+                # serve f32 and save_model could not persist scales
+                raise ValueError(
+                    'quantized serving needs the fused serving fold; '
+                    'this model configuration cannot fuse '
+                    '(no fused registry / incompatible heads)'
+                )
+        try:
+            changed = mode != self.quantize
+        except ValueError:
+            changed = True
+        for m in self._models.values():
+            if isinstance(m, MLPClassifier):
+                m.quantize = mode
+        self._pair_prep = None
+        if changed:
+            self._quant_scales = None
+        return self
+
+    def _prepared_pair(self):
+        """The cached serving fold, or ``None`` when the bit-pinned
+        legacy dispatch serves this configuration.
+
+        Built (and cached per parameter/stats identity, so a hot-swap or
+        refit rebuilds it) whenever the active ``(quantize, kernel)``
+        configuration dispatches through prepared tables: any quantized
+        mode, or the Pallas kernel (which gathers from materialized
+        tables). Checkpoint-persisted int8 scales, when present, pin the
+        quantized representation to the published version's bytes.
+        """
+        from ..ops.fused import prepare_pair_fold
+        from ..ops.gather_matmul import fused_kernel_method
+        from ..ops.fused import REGISTRIES
+
+        if not self._can_fuse():
+            return None
+        mode = self.quantize
+        registry = REGISTRIES[self._fused_registry]
+        method = fused_kernel_method(registry.combo_size)
+        if mode == 'none' and method == 'xla':
+            return None
+        cols = list(self._label_columns)
+        clf_a, clf_b = self._models[cols[0]], self._models[cols[1]]
+        # identity key holds REFERENCES to the exact objects the fold
+        # was built from (compared with `is`, never id()): a refit that
+        # frees the old params could otherwise recycle their addresses
+        # and silently serve the previous weights' tables
+        key = (
+            (mode, tuple(self._kernel_names()), self.nb_prev_actions),
+            (
+                clf_a.params, clf_b.params,
+                clf_a._mean, clf_a._std, clf_b._mean, clf_b._std,
+            ),
+        )
+        cached = getattr(self, '_pair_prep', None)
+        if (
+            cached is not None
+            and cached[0][0] == key[0]
+            and all(a is b for a, b in zip(cached[0][1], key[1]))
+        ):
+            return cached[1]
+        scales = getattr(self, '_quant_scales', None) or {}
+        prep = prepare_pair_fold(
+            clf_a, clf_b,
+            names=self._kernel_names(),
+            k=self.nb_prev_actions,
+            registry_name=self._fused_registry,
+            quantize=mode,
+            table_scale=scales.get('table_scale') if mode == 'int8' else None,
+            w_dense_scale=(
+                scales.get('w_dense_scale') if mode == 'int8' else None
+            ),
+        )
+        self._pair_prep = (key, prep)
+        return prep
+
+    def warm_serving(self) -> Optional[Any]:
+        """Build (and device-warm) the prepared serving fold, if any.
+
+        Called by the model registry's warm path
+        (:meth:`socceraction_tpu.serve.registry.ModelRegistry.warm`) so
+        a loaded version's quantized tables are resident — and claimed
+        in the HBM residency ledger — before the first flush, not
+        during it. Returns the :class:`PreparedPair` or ``None`` when
+        the legacy dispatch serves this configuration.
+        """
+        return self._prepared_pair() if self._can_fuse() else None
+
+    def serving_arrays(self) -> List[Any]:
+        """Device arrays of the cached prepared fold (residency claims)."""
+        cached = getattr(self, '_pair_prep', None)
+        return cached[1].arrays() if cached is not None else []
+
+    def serving_table_bytes(self) -> Optional[int]:
+        """HBM bytes of the cached prepared fold's combined tables
+        (+ int8 scales), or ``None`` when the legacy dispatch serves —
+        the quantization headline the bench and the residency pins read."""
+        cached = getattr(self, '_pair_prep', None)
+        return cached[1].table_nbytes if cached is not None else None
 
     @staticmethod
     def _bucketable(batch: ActionBatch) -> bool:
@@ -737,7 +923,10 @@ class VAEP:
                 from ..ops.fused import fused_pair_probs
 
                 # one jitted trace for both heads so XLA shares the
-                # per-state views and dense feature blocks between them
+                # per-state views and dense feature blocks between them.
+                # The cached prepared fold (quantized tables / Pallas
+                # kernel configurations) rides along so the fold is
+                # built once per model, never per dispatch
                 cols = list(self._label_columns)
                 pair = fused_pair_probs(
                     self._models[cols[0]],
@@ -748,6 +937,7 @@ class VAEP:
                     registry_name=self._fused_registry,
                     dense_overrides=dense_overrides,
                     hidden_dtype=hidden_dtype_for(path),
+                    prepared=self._prepared_pair(),
                 )
                 probs = dict(zip(cols, pair))
             else:
@@ -863,13 +1053,39 @@ class VAEP:
                 with open(os.path.join(path, 'models', f'{col}.pkl'), 'wb') as f:
                     pickle.dump(model, f)
                 artifacts.append(f'models/{col}.pkl')
+        quantize = self.quantize
+        if quantize == 'int8':
+            # persist the symmetric per-column scales next to the heads
+            # (checksummed below): a loader re-quantizes the (equally
+            # checksummed) parameters under these EXACT scales, so the
+            # served int8 representation is bit-stable across library
+            # versions — never re-derived from a re-run of the fold
+            prep = self._prepared_pair()
+            if prep is None:  # heads quantized without set_quantize()
+                raise ValueError(
+                    'quantize="int8" but this model has no fused '
+                    'serving fold to persist scales for — set the mode '
+                    'through set_quantize(), which validates fusability'
+                )
+            np.savez(
+                os.path.join(path, _QUANT_SCALES_ARTIFACT),
+                table_scale=np.asarray(prep.table_scale),
+                w_dense_scale=np.asarray(prep.w_dense_scale),
+            )
+            artifacts.append(_QUANT_SCALES_ARTIFACT)
         meta = {
-            'format_version': CHECKPOINT_FORMAT_VERSION,
+            # the stamp is the MINIMUM reader version (see
+            # CHECKPOINT_FORMAT_VERSION): quantized checkpoints need a
+            # v2-aware loader (the LITERAL 2 — future format bumps must
+            # not inflate the floor of a feature v2 can read); everything
+            # else stays loadable by v1
+            'format_version': 2 if quantize != 'none' else 1,
             'class': type(self).__name__,
             'nb_prev_actions': self.nb_prev_actions,
             'backend': self.backend,
             'xfns': [fn.__name__ for fn in self.xfns],
             'heads': heads,
+            **({'quantize': quantize} if quantize != 'none' else {}),
             # content integrity: sha256 per head artifact, verified on
             # every load — a truncated or bit-flipped checkpoint fails
             # with an error naming the artifact instead of a deep
@@ -906,6 +1122,21 @@ class VAEP:
             else:
                 with open(os.path.join(path, 'models', f'{col}.pkl'), 'rb') as f:
                     model._models[col] = pickle.load(f)
+        quantize = meta.get('quantize', 'none')
+        if quantize != 'none':
+            # belt and braces: the heads' own hyperparameters already
+            # restored the mode; the meta-level stamp re-asserts it so a
+            # hand-edited checkpoint cannot half-quantize a model
+            for m in model._models.values():
+                if isinstance(m, MLPClassifier):
+                    m.quantize = quantize
+        scales_path = os.path.join(path, _QUANT_SCALES_ARTIFACT)
+        if quantize == 'int8' and os.path.isfile(scales_path):
+            with np.load(scales_path) as data:
+                model._quant_scales = {
+                    'table_scale': np.asarray(data['table_scale']),
+                    'w_dense_scale': np.asarray(data['w_dense_scale']),
+                }
         return model
 
 

@@ -109,7 +109,17 @@ def test_num_area_and_labels_are_registered():
     lint gate from day one (ISSUE 9 satellite)."""
     tool = _tool()
     assert 'num' in tool.KNOWN_AREAS
-    assert tool.KNOWN_LABELS['num'] == {'fn', 'output', 'pair'}
+    assert tool.KNOWN_LABELS['num'] == {'fn', 'output', 'pair', 'quant'}
+
+
+def test_quant_kernel_labels_are_registered():
+    """The quantized-serving dimensions land governed (ISSUE 12
+    satellite): the parity histograms' ``quant`` storage-mode label on
+    the ``num`` area, and the bench sweep's ``quant``/``kernel``
+    (storage mode × first-layer lowering) labels on ``bench``."""
+    tool = _tool()
+    assert 'quant' in tool.KNOWN_LABELS['num']
+    assert {'quant', 'kernel'} <= tool.KNOWN_LABELS['bench']
 
 
 def test_resil_area_and_labels_are_registered():

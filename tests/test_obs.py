@@ -82,6 +82,11 @@ def test_kind_and_unit_conflicts_raise():
         reg.histogram('area/x', unit='ms')
     # same kind + unit: get-or-create returns the same instrument
     assert reg.histogram('area/x', unit='s') is reg.histogram('area/x', unit='s')
+    # the conflict error points at BOTH offending registration sites
+    # (file:line), not only the metric name (ISSUE 12 satellite)
+    with pytest.raises(ValueError, match=r'test_obs\.py:\d+') as err:
+        reg.gauge('area/x')
+    assert str(err.value).count('test_obs.py:') == 2
 
 
 def test_label_cardinality_guard():

@@ -401,7 +401,12 @@ def test_health_reports_queue_model_and_slo(model, frame):
     assert h['status'] == 'ok' and h['flusher_alive'] is True
     assert h['queue_depth'] == 0 and h['max_queue'] >= 4
     assert h['last_flush_age_s'] is not None and h['last_flush_age_s'] >= 0
-    assert h['model'] == {'name': 'default', 'version': '0'}
+    # the model block also names the serving numerics configuration
+    # (table-storage mode + resolved first-layer lowering, ISSUE 12)
+    assert h['model'] == {
+        'name': 'default', 'version': '0',
+        'quantize': 'none', 'kernel': 'xla',
+    }
     assert h['compiled_shapes'] == len(h['ladder'])
     assert h['slo']['budget_p99_ms'] == 60_000.0
     assert h['slo']['request_p99_ms'] > 0 and h['slo']['ok'] is True

@@ -1,0 +1,723 @@
+"""Tests for quantized combined tables + the fused gather-matmul kernel.
+
+The ISSUE-12 contract: narrow-precision table storage
+(``ops/quant.py`` — bf16 / symmetric per-column int8 with a packed
+2-bit refinement plane) with per-block round-trip error bounds; the
+Pallas gather+matmul first layer (``ops/gather_matmul.py``) bitwise
+equal to its XLA lowering on CPU (interpret mode) including the custom
+VJP; the quantized fused serve path within ``1e-3`` of the f32
+materialized reference on the golden game while the f32 prepared fold
+stays ≤ ``1e-5``; quantized serve end-to-end through ``RatingService``
+with ``ParityProbe`` sampling (``num/parity_abs_err{pair,quant}``);
+zero steady-state retraces across the bucket ladder for every
+``(quantize, kernel)`` combo; the registry residency byte-delta pin for
+a quantized vs f32 warm model; the checkpoint-format-v2 persistence of
+the quantization mode + int8 scales (bit-stable restore, checksummed,
+pre-quant checkpoints unchanged, loud error on an older loader); and
+the single platform-profile source shared by every Pallas dispatch
+gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.ml.mlp import MLPClassifier
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs.parity import ParityProbe
+from socceraction_tpu.ops import gather_matmul as gm
+from socceraction_tpu.ops import quant as Q
+from socceraction_tpu.serve import RatingService
+from socceraction_tpu.vaep.base import VAEP, load_model
+
+HOME = 100
+MAX_ACTIONS = 256
+
+COMBOS = tuple(
+    (quantize, kernel)
+    for quantize in Q.QUANTIZE_MODES
+    for kernel in ('xla', 'pallas')
+)
+
+
+@pytest.fixture(scope='module', autouse=True)
+def _drain_pair_probs_storm_window():
+    """Retire this module's serving-ladder compiles from the storm
+    window (same rationale as tests/test_numerics.py): six (quantize,
+    kernel) combos compile six ladders."""
+    yield
+    from socceraction_tpu.ops.fused import _pair_probs, _pair_probs_prepared
+
+    for fn in (_pair_probs, _pair_probs_prepared):
+        fn.drain_storm_window()
+
+
+def _fit_model(hidden=(16,), seed_games=(0, 1), max_epochs=2):
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=200)
+        for i in seed_games
+    ]
+    model = VAEP()
+    X, y = [], []
+    for i, f in zip(seed_games, frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True),
+        pd.concat(y, ignore_index=True),
+        learner='mlp',
+        tree_params={'hidden': hidden, 'max_epochs': max_epochs},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+@pytest.fixture(scope='module')
+def golden_model(spadl_actions):
+    """A VAEP MLP fitted on the 200-action golden game (the acceptance
+    gate's reference workload)."""
+    model = VAEP()
+    game = pd.Series({'game_id': 8657, 'home_team_id': 782})
+    X = model.compute_features(game, spadl_actions)
+    y = model.compute_labels(game, spadl_actions)
+    np.random.seed(0)
+    model.fit(
+        X, y, learner='mlp', tree_params={'hidden': (64, 64), 'max_epochs': 4}
+    )
+    return model
+
+
+# ------------------------------------------------ storage round trips ----
+
+
+def _spread_tables(shape=(3, 50, 48), seed=0):
+    """f32 tables whose per-row magnitudes span orders of magnitude —
+    the combined-table regime the per-row scales exist for."""
+    rng = np.random.default_rng(seed)
+    t = rng.normal(size=shape).astype(np.float32)
+    t *= 10.0 ** rng.uniform(-3, 1, size=shape[:-1] + (1,)).astype(np.float32)
+    return jnp.asarray(t)
+
+
+def test_quantize_mode_validation():
+    assert Q.check_quantize_mode('none') == 'none'
+    with pytest.raises(ValueError, match='unknown quantize mode'):
+        Q.check_quantize_mode('fp8')
+    with pytest.raises(ValueError, match='unknown quantize mode'):
+        MLPClassifier(quantize='int4')
+
+
+def test_none_mode_is_identity():
+    t = _spread_tables()
+    q = Q.quantize_columns(t, 'none')
+    assert q.resid is None and q.scale is None
+    assert q.data.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(Q.dequantize(*q)), np.asarray(t))
+
+
+def test_bf16_round_trip_error_bound():
+    """bf16 storage: per-element relative error bounded by the 8
+    significand bits (2**-8 of the element magnitude)."""
+    t = _spread_tables()
+    q = Q.quantize_columns(t, 'bf16')
+    assert q.data.dtype == jnp.bfloat16
+    assert q.resid is None and q.scale is None
+    back = np.asarray(Q.dequantize(*q))
+    err = np.abs(back - np.asarray(t))
+    bound = np.abs(np.asarray(t)) * 2.0**-8 + 1e-30
+    assert np.all(err <= bound)
+
+
+def test_int8_round_trip_error_bound_per_block():
+    """int8 + 2-bit refinement: per-element absolute error ≤ scale/8
+    where scale is the PER-ROW symmetric scale (amax/127) — the
+    per-block bound the serving band is built on."""
+    t = _spread_tables()
+    q = Q.quantize_columns(t, 'int8')
+    assert q.data.dtype == jnp.int8
+    assert q.resid.dtype == jnp.uint8
+    scale = np.asarray(q.scale)
+    np.testing.assert_allclose(
+        scale,
+        np.max(np.abs(np.asarray(t)), axis=-1, keepdims=True) / Q.INT8_QMAX,
+        rtol=1e-6,
+    )
+    back = np.asarray(Q.dequantize(*q))
+    err = np.abs(back - np.asarray(t))
+    # scale/8 is the refinement grid's bound; the slack covers f32
+    # rounding of the dequantize product AND an element landing within
+    # float-ulp of a refinement rounding boundary (where the code can
+    # tip either way and overshoot the ideal bound by ~eps·|grid|)
+    assert np.all(err <= scale * (0.125 + 1e-4) + np.abs(np.asarray(t)) * 1e-5)
+    # the refinement plane is load-bearing: base alone is stuck at scale/2
+    base_only = np.asarray(q.data, np.float32) * scale
+    base_err = np.max(np.abs(base_only - np.asarray(t)) / scale)
+    assert base_err > 0.25  # rounding residuals really reach ~scale/2
+
+
+def test_int8_symmetry_and_zero_rows():
+    t = _spread_tables()
+    q_pos = Q.quantize_columns(t, 'int8')
+    q_neg = Q.quantize_columns(-t, 'int8')
+    # the BASE grid is symmetric (-128 is excluded): -t's base plane is
+    # exactly -base(t). The refinement plane's half-to-even rounding
+    # boundaries are not sign-symmetric, so the full reconstruction is
+    # only bound-symmetric — both signs hold the same scale/8 bound.
+    np.testing.assert_array_equal(
+        np.asarray(q_neg.data), -np.asarray(q_pos.data)
+    )
+    np.testing.assert_array_equal(np.asarray(q_neg.scale), np.asarray(q_pos.scale))
+    err_neg = np.abs(np.asarray(Q.dequantize(*q_neg)) - (-np.asarray(t)))
+    bound = (
+        np.asarray(q_pos.scale) * (0.125 + 1e-4)
+        + np.abs(np.asarray(t)) * 1e-5
+    )
+    assert np.all(err_neg <= bound)
+    # an all-zero row marks itself with scale 0 and reconstructs to
+    # EXACT zeros (the centered refinement grid has no zero level — a
+    # positive scale would serve scale/8 where the table stored nothing)
+    z = Q.quantize_columns(jnp.zeros((2, 4, 8)), 'int8')
+    assert np.all(np.asarray(z.scale) == 0.0)
+    assert np.all(np.asarray(Q.dequantize(*z)) == 0.0)
+
+
+@pytest.mark.parametrize('h', [1, 3, 4, 5, 48, 127])
+def test_refinement_pack_unpack_inverse(h):
+    """The packed 2-bit plane round-trips for every last-axis size,
+    including the padded non-multiple-of-4 widths."""
+    rng = np.random.default_rng(h)
+    codes = jnp.asarray(rng.integers(0, 4, size=(3, 7, h)))
+    packed = Q._pack_codes(codes)
+    assert packed.shape == (3, 7, -(-h // 4))
+    np.testing.assert_array_equal(
+        np.asarray(Q._unpack_codes(packed, h)), np.asarray(codes, np.float32)
+    )
+
+
+def test_fixed_scale_quantization_is_bit_stable():
+    """``quantize_with_scale`` under pinned scales reproduces the exact
+    planes — the checkpoint-restore contract."""
+    t = _spread_tables()
+    q = Q.quantize_columns(t, 'int8')
+    data2, resid2 = Q.quantize_with_scale(t, q.scale)
+    np.testing.assert_array_equal(np.asarray(q.data), np.asarray(data2))
+    np.testing.assert_array_equal(np.asarray(q.resid), np.asarray(resid2))
+
+
+def test_quantized_nbytes_and_reduction():
+    """int8 storage is a ≥3x table-byte reduction vs f32; bf16 is 2x —
+    the HBM headline the bench and the residency pins report."""
+    t = _spread_tables(shape=(3, 64, 128))
+    f32 = Q.quantized_nbytes(Q.quantize_columns(t, 'none'))
+    assert f32 == t.size * 4
+    assert Q.quantized_nbytes(Q.quantize_columns(t, 'bf16')) * 2 == f32
+    int8 = Q.quantized_nbytes(Q.quantize_columns(t, 'int8'))
+    assert f32 / int8 >= 3.0
+
+
+def test_fake_quant_straight_through_gradient():
+    t = _spread_tables()
+    for mode in Q.QUANTIZE_MODES:
+        out = Q.fake_quant(t, mode)
+        q = Q.quantize_columns(t, mode)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(Q.dequantize(*q))
+        )
+        g = jax.grad(lambda x: jnp.sum(Q.fake_quant(x, mode) * 3.0))(t)
+        # the straight-through estimator: d fake_quant / d t == 1
+        assert np.all(np.asarray(g) == 3.0)
+
+
+# ------------------------------------- gather+matmul kernel parity ----
+
+
+def _first_layer_operands(k=3, r=50, h=48, n=300, d=7, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(k, r, h)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(d, h)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(h,)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, r, size=(n, k)).astype(np.int32)),
+        jnp.asarray(rng.normal(size=(n, d)).astype(np.float32)),
+    )
+
+
+@pytest.mark.parametrize(
+    'shape',
+    [
+        dict(k=3, r=50, h=48, n=300, d=7),  # nothing lane/chunk aligned
+        dict(k=1, r=128, h=128, n=256, d=0),  # aligned, no dense block
+        dict(k=2, r=5, h=130, n=1, d=130),  # singleton batch, odd pads
+    ],
+)
+def test_pallas_interpret_vs_xla_bitwise(shape):
+    """The Pallas kernel (interpret mode on CPU) and the XLA lowering
+    run the same adds on the same padded operands — bitwise equal under
+    jit, exactly as the two dispatch methods run in production."""
+    tables, w, bias, ids, x = _first_layer_operands(**shape)
+    run = {
+        m: jax.jit(lambda t, w_, b, i, x_, m=m: gm.fused_first_layer(
+            t, w_, b, i, x_, m
+        ))
+        for m in ('pallas', 'xla')
+    }
+    out_p = np.asarray(run['pallas'](tables, w, bias, ids, x))
+    out_x = np.asarray(run['xla'](tables, w, bias, ids, x))
+    assert out_p.shape == (shape['n'], shape['h'])
+    np.testing.assert_array_equal(out_p, out_x)
+    # and both equal the plain gather formulation (the one-hot MXU
+    # contraction is exact, not approximate)
+    k = shape['k']
+    ref = bias + sum(tables[i][ids[:, i]] for i in range(k))
+    if shape['d']:
+        ref = ref + jnp.dot(
+            x, w,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    np.testing.assert_allclose(out_x, np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize('method', ['pallas', 'xla'])
+def test_fused_first_layer_custom_vjp(method):
+    """The kernel is trainable: cotangents match the reference gather
+    formulation for every operand, under both lowerings."""
+    tables, w, bias, ids, x = _first_layer_operands()
+    k = tables.shape[0]
+
+    def loss(t, w_, b, x_):
+        return jnp.sum(gm.fused_first_layer(t, w_, b, ids, x_, method) ** 2)
+
+    def ref_loss(t, w_, b, x_):
+        h = b + sum(t[i][ids[:, i]] for i in range(k)) + x_ @ w_
+        return jnp.sum(h**2)
+
+    got = jax.grad(loss, argnums=(0, 1, 2, 3))(tables, w, bias, x)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2, 3))(tables, w, bias, x)
+    for g, r in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), atol=1e-3, rtol=1e-5
+        )
+
+
+def test_kernel_method_env_and_profile_gate(monkeypatch):
+    """``SOCCERACTION_TPU_FUSED_KERNEL`` forces the lowering; ``auto``
+    resolves 'xla' off-TPU and applies the platform-profile combo gate
+    on TPU — the same committed source as the segment-sum thresholds."""
+    monkeypatch.delenv(gm._ENV, raising=False)
+    assert gm.fused_kernel_method(10) == 'xla'  # CPU backend: auto -> xla
+    monkeypatch.setenv(gm._ENV, 'pallas')
+    assert gm.fused_kernel_method(10**9) == 'pallas'  # override beats gate
+    monkeypatch.setenv(gm._ENV, 'xla')
+    assert gm.fused_kernel_method(1) == 'xla'
+    monkeypatch.setenv(gm._ENV, 'bogus')
+    with pytest.raises(ValueError, match='auto|pallas|xla'):
+        gm.fused_kernel_method(1)
+    # on TPU, auto applies the profile's measured crossover
+    monkeypatch.delenv(gm._ENV, raising=False)
+    monkeypatch.setattr(gm.jax, 'default_backend', lambda: 'tpu')
+    from socceraction_tpu.ops.profile import pallas_profile
+
+    gate = int(pallas_profile()['fused_gather_matmul_max_combo'])
+    assert gm.fused_kernel_method(gate) == 'pallas'
+    assert gm.fused_kernel_method(gate + 1) == 'xla'
+    assert gm.fused_kernel_method(None) == 'pallas'  # unknown size: kernel
+
+
+def test_pallas_gates_share_one_profile_source():
+    """The segment-sum thresholds and the fused-kernel combo gate read
+    the SAME committed profile section (``platform_profiles.json``,
+    ``pallas``) — no second hardcoded constant (ISSUE 12 satellite)."""
+    from socceraction_tpu.ops import segment
+    from socceraction_tpu.ops.profile import (
+        PALLAS_PROFILE_DEFAULTS,
+        load_profiles,
+        pallas_profile,
+    )
+
+    prof = pallas_profile()
+    assert segment.PALLAS_MAX_SEGMENTS == prof['segment_max_segments']
+    assert segment.ROWS_ONEHOT_MAX_SEGMENTS == prof['rows_onehot_max_segments']
+    assert set(PALLAS_PROFILE_DEFAULTS) == {
+        'segment_max_segments',
+        'rows_onehot_max_segments',
+        'fused_gather_matmul_max_combo',
+    }
+    # the committed profile carries the section (the defaults are the
+    # wheel-missing-data-file fallback, not the normal read path)
+    committed = load_profiles()['pallas']
+    for key in PALLAS_PROFILE_DEFAULTS:
+        assert prof[key] == committed[key]
+
+
+# ----------------------------------------- quantized fused serving ----
+
+
+def test_quantized_band_on_golden_game(golden_model, spadl_actions):
+    """The acceptance gate: quantized serving within ``1e-3`` of the
+    f32 reference on the golden game; the f32 prepared fold (the Pallas
+    configuration's table source) stays ≤ ``1e-5`` vs materialized."""
+    game = pd.Series({'game_id': 8657, 'home_team_id': 782})
+    model = golden_model
+    spadl = spadl_actions
+    ref = model.rate(game, spadl)['vaep_value'].to_numpy()
+    try:
+        for mode in ('bf16', 'int8'):
+            model.set_quantize(mode)
+            got = model.rate(game, spadl)['vaep_value'].to_numpy()
+            err = float(np.max(np.abs(got - ref)))
+            assert err <= 1e-3, (mode, err)
+        # f32 prepared fold (forced Pallas kernel): inside the f32 band
+        model.set_quantize('none')
+        os.environ[gm._ENV] = 'pallas'
+        try:
+            got = model.rate(game, spadl)['vaep_value'].to_numpy()
+        finally:
+            del os.environ[gm._ENV]
+        assert float(np.max(np.abs(got - ref))) <= 1e-5
+    finally:
+        model.set_quantize('none')
+
+
+def test_prepared_fold_matches_legacy_dispatch(model):
+    """(quantize='none', kernel='pallas') gathers from tables holding
+    exactly the values the legacy per-dispatch fold folds — same
+    single-source ``_combined_table``."""
+    frame = synthetic_actions_frame(game_id=50, seed=50, n_actions=120)
+    batch = model._pack(frame, HOME)
+    ref = np.asarray(model.rate_batch(batch, bucket=False))
+    os.environ[gm._ENV] = 'pallas'
+    try:
+        model._pair_prep = None
+        got = np.asarray(model.rate_batch(batch, bucket=False))
+    finally:
+        del os.environ[gm._ENV]
+        model._pair_prep = None
+    mask = np.asarray(batch.mask)[..., None]
+    assert np.max(np.abs(np.where(mask, got - ref, 0.0))) <= 1e-5
+
+
+def test_set_quantize_validation(model):
+    with pytest.raises(ValueError, match='unknown quantize mode'):
+        model.set_quantize('fp4')
+    unfitted = VAEP()
+    with pytest.raises(ValueError, match='fit the model'):
+        unfitted.set_quantize('int8')
+    clf_a, clf_b = (m for m in model._models.values())
+    clf_a.quantize = 'int8'
+    try:
+        with pytest.raises(ValueError, match='disagree'):
+            _ = model.quantize
+    finally:
+        clf_a.quantize = 'none'
+    assert model.quantize == 'none'
+
+
+def test_quantized_serve_e2e_with_parity_probe(golden_model, spadl_actions):
+    """Quantized serving end-to-end through ``RatingService``: the
+    sampled ``ParityProbe`` re-rates flushes through the f32
+    materialized reference and records the error under the served
+    storage mode's ``quant`` label — the in-production quantization
+    error band (gate: ``max_abs_err <= 1e-3``), driven on the golden
+    game itself."""
+    model = golden_model
+    model.set_quantize('int8')
+    probe = ParityProbe(sample_rate=1.0, max_abs_err=1e-3)
+    try:
+        with RatingService(
+            model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+            parity=probe,
+        ) as svc:
+            fut = svc.rate(spadl_actions, home_team_id=782)
+            fut.result(timeout=60)
+            assert probe.flush(timeout=60)
+            stats = probe.stats()
+            assert stats['probes'] >= 1
+            assert stats['exceedances'] == 0
+            assert stats['max_abs_err'] <= 1e-3
+            assert stats['last']['quant'] == 'int8'
+            # the health surface names the serving numerics config
+            health = svc.health()
+            assert health['model']['quantize'] == 'int8'
+            assert health['model']['kernel'] in ('pallas', 'xla')
+            assert health['numerics']['parity']['probes'] >= 1
+        # the error histogram splits per storage mode: the quantized
+        # observation landed under {pair, quant='int8'}
+        s = REGISTRY.snapshot().series(
+            'num/parity_abs_err', pair='fused_vs_materialized', quant='int8'
+        )
+        assert s is not None and s.count >= 1
+    finally:
+        model.set_quantize('none')
+
+
+@pytest.mark.parametrize('quantize,kernel', COMBOS)
+def test_zero_steady_state_retraces_per_combo(model, quantize, kernel):
+    """Every (quantize, kernel) combo holds the serving contract: after
+    warmup the bucket ladder owns the compiled-shape count and steady
+    traffic compiles NOTHING new."""
+    model.set_quantize(quantize)
+    os.environ[gm._ENV] = kernel
+    try:
+        with RatingService(
+            model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+        ) as svc:
+            svc.warmup()
+            shapes = svc.compiled_shapes
+            snap = REGISTRY.snapshot()
+            compiles = sum(
+                snap.value('xla/compiles', fn=fn)
+                for fn in ('pair_probs', 'pair_probs_prepared')
+            )
+            frames = [
+                synthetic_actions_frame(
+                    game_id=70 + i, seed=70 + i, n_actions=n
+                )
+                for i, n in enumerate((50, 120, 200))
+            ]
+            for _ in range(2):
+                for f in frames:
+                    svc.rate(f, home_team_id=HOME).result(timeout=120)
+            assert svc.compiled_shapes == shapes
+            snap = REGISTRY.snapshot()
+            assert compiles == sum(
+                snap.value('xla/compiles', fn=fn)
+                for fn in ('pair_probs', 'pair_probs_prepared')
+            )
+    finally:
+        del os.environ[gm._ENV]
+        model.set_quantize('none')
+
+
+# -------------------------------------------- registry residency pin ----
+
+
+def test_registry_residency_delta_quantized_vs_f32(tmp_path, golden_model):
+    """A warm int8 version claims measurably fewer HBM bytes than the
+    same model warm in f32 — by EXACTLY the prepared fold's byte delta
+    (params/stats are identical), pinned through the registry's keyed
+    residency claims (``mem/owned_bytes{owner="registry"}``).
+
+    Uses the production-width golden model: the ≥3x table-byte pin
+    includes the f32 scales + refinement-plane overhead, which only
+    amortizes over realistic hidden widths (H=128 here; a (16,)-hidden
+    toy head would sit at 2.9x)."""
+    from socceraction_tpu.obs.residency import owned_bytes
+    from socceraction_tpu.serve import ModelRegistry
+
+    model = golden_model
+    registry = ModelRegistry(str(tmp_path))
+    model.set_quantize('none')
+    registry.publish('q', 'f32', model)
+    model.set_quantize('int8')
+    try:
+        registry.publish('q', 'int8', model)
+    finally:
+        model.set_quantize('none')
+
+    def warm_bytes(version):
+        reg = ModelRegistry(str(tmp_path))
+        before = owned_bytes().get('registry', 0)
+        loaded = reg.load('q', version)
+        claimed = owned_bytes().get('registry', 0) - before
+        return loaded, claimed
+
+    # the f32 comparison point is the f32 PREPARED fold resident (the
+    # Pallas-serving configuration); with the legacy XLA dispatch no
+    # fold is resident at all and there is nothing to compare bytes to
+    os.environ[gm._ENV] = 'pallas'
+    try:
+        m_f32, bytes_f32 = warm_bytes('f32')
+    finally:
+        del os.environ[gm._ENV]
+    m_int8, bytes_int8 = warm_bytes('int8')
+    prep_f32 = m_f32._pair_prep[1]
+    prep_int8 = m_int8._pair_prep[1]
+    assert prep_f32.quantize == 'none' and prep_int8.quantize == 'int8'
+    # the table-byte reduction the bench headlines: int8 ≥ 3x vs f32
+    assert prep_f32.table_nbytes / prep_int8.table_nbytes >= 3.0
+    # the registry claim delta IS the prepared-fold delta
+    assert bytes_f32 - bytes_int8 == (
+        prep_f32.total_nbytes - prep_int8.total_nbytes
+    )
+    assert bytes_int8 < bytes_f32
+
+
+# ------------------------------------------- checkpoint persistence ----
+
+
+def test_quantized_checkpoint_round_trip_bit_stable(tmp_path, model):
+    """A quantized checkpoint persists the mode + int8 scales
+    (checksummed) and restores to the EXACT served representation."""
+    game = pd.Series({'game_id': 0, 'home_team_id': HOME})
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=200)
+    model.set_quantize('int8')
+    try:
+        want = model.rate(game, frame)['vaep_value'].to_numpy()
+        path = str(tmp_path / 'ckpt')
+        model.save_model(path)
+        with open(os.path.join(path, 'meta.json')) as f:
+            meta = json.load(f)
+        assert meta['format_version'] == 2
+        assert meta['quantize'] == 'int8'
+        assert 'models/quant_scales.npz' in meta['checksums']
+    finally:
+        model.set_quantize('none')
+
+    restored = load_model(path)
+    assert restored.quantize == 'int8'
+    assert restored._quant_scales is not None
+    got = restored.rate(game, frame)['vaep_value'].to_numpy()
+    np.testing.assert_array_equal(got, want)
+    # the restored fold quantized under the PERSISTED scales
+    prep = restored._pair_prep[1]
+    np.testing.assert_array_equal(
+        np.asarray(prep.table_scale),
+        restored._quant_scales['table_scale'],
+    )
+
+
+def test_unquantized_checkpoint_stays_v1(tmp_path, model):
+    """No post-v1 feature used ⇒ the checkpoint stamps format 1 and a
+    pre-quantization library keeps loading it unchanged."""
+    model.set_quantize('none')
+    path = str(tmp_path / 'plain')
+    model.save_model(path)
+    with open(os.path.join(path, 'meta.json')) as f:
+        meta = json.load(f)
+    assert meta['format_version'] == 1
+    assert 'quantize' not in meta
+    assert not os.path.exists(os.path.join(path, 'models', 'quant_scales.npz'))
+    assert load_model(path).quantize == 'none'
+
+
+def test_quantized_checkpoint_fails_older_loader_loudly(tmp_path, model):
+    """A v2 (quantized) checkpoint meeting a loader that only
+    understands v1 fails with the actionable 'newer than this library'
+    error — never a deep KeyError or silent f32 serving."""
+    import socceraction_tpu.vaep.base as vb
+
+    model.set_quantize('int8')
+    try:
+        path = str(tmp_path / 'v2')
+        model.save_model(path)
+    finally:
+        model.set_quantize('none')
+    old = vb.CHECKPOINT_FORMAT_VERSION
+    vb.CHECKPOINT_FORMAT_VERSION = 1  # simulate the pre-quant library
+    try:
+        with pytest.raises(ValueError, match='newer than this library'):
+            load_model(path)
+    finally:
+        vb.CHECKPOINT_FORMAT_VERSION = old
+
+
+def test_corrupt_quant_scales_artifact_is_named(tmp_path, model):
+    """The scales ride the same sha256 contract as every artifact: a
+    bit-flip fails the load NAMING models/quant_scales.npz."""
+    model.set_quantize('int8')
+    try:
+        path = str(tmp_path / 'corrupt')
+        model.save_model(path)
+    finally:
+        model.set_quantize('none')
+    scales = os.path.join(path, 'models', 'quant_scales.npz')
+    with open(scales, 'r+b') as f:
+        f.seek(12)
+        byte = f.read(1)
+        f.seek(12)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(ValueError, match='quant_scales'):
+        load_model(path)
+
+
+def test_obsctl_parity_rows_split_per_quant_mode():
+    """``obsctl numerics`` renders the quantized band as its own row —
+    a quant-labeled series must never merge into (and overwrite) the
+    unlabeled f32 row of the same pair."""
+    from socceraction_tpu.obs import snapshot_dict
+    from tools.obsctl import _num_summary
+
+    probe = ParityProbe(sample_rate=1.0, max_abs_err=1e-3)
+    ones = np.ones((1, 4), bool)
+    got = np.zeros((1, 4, 2), np.float32)
+    probe.compare(
+        'fused_vs_materialized', got + 1e-4, got, mask=ones, quant='int8'
+    )
+    probe.compare('fused_vs_materialized', got + 1e-7, got, mask=ones)
+    rows = _num_summary(snapshot_dict(REGISTRY.snapshot()))['parity']
+    by_quant = {
+        r.get('quant'): r for r in rows
+        if r['pair'] == 'fused_vs_materialized'
+    }
+    # two distinct rows: the quantized band and the unlabeled f32 band
+    # (the REGISTRY is process-global, so only existence and the
+    # quantized row's floor are order-independent assertions)
+    assert 'int8' in by_quant and None in by_quant
+    assert by_quant['int8']['max_abs_err'] >= 9e-5
+
+
+# ------------------------------------------------ benchdiff direction ----
+
+
+def test_benchdiff_quant_table_bytes_is_lower_is_better():
+    """The HBM table-bytes ledger metric: GROWTH is the regression
+    (fewer model versions fit warm) — benchdiff flips direction like it
+    does for cold-start walls (ISSUE 12 satellite)."""
+    from tools.benchdiff import compare_artifacts
+
+    old = {
+        'metric': 'vaep_quant_table_bytes', 'platform': 'cpu',
+        'value': 271584,
+    }
+    grew = {**old, 'value': 847872}
+    shrank = {**old, 'value': 200000}
+
+    res = compare_artifacts(old, grew)
+    (verdict,) = res['verdicts']
+    assert verdict['direction'] == 'lower_is_better'
+    assert verdict['verdict'] == 'regression' and res['regressions'] == 1
+
+    res = compare_artifacts(old, shrank)
+    assert res['verdicts'][0]['verdict'] == 'improvement'
+    assert res['regressions'] == 0 and res['improvements'] == 1
+
+
+# ---------------------------------------------- QAT training fold ----
+
+
+def test_fit_packed_quantization_aware_trains(model):
+    """``MLPClassifier(quantize=...)`` trains through the fused fold
+    with straight-through fake-quant: finite loss, params update, and
+    the fitted head serves quantized within the band."""
+    from socceraction_tpu.core.synthetic import synthetic_batch
+    from socceraction_tpu.ops.labels import scores_concedes
+
+    batch = synthetic_batch(n_games=2, n_actions=256, seed=11)
+    ys, _ = scores_concedes(batch)
+    y = np.asarray(ys, np.float32).reshape(-1)
+    names = tuple(model._kernel_names())
+    clf = MLPClassifier(hidden=(8,), max_epochs=2, quantize='int8')
+    clf.fit_packed(batch, y, names=names, k=model.nb_prev_actions)
+    assert clf.params is not None
+    assert clf.train_health_['nonfinite_steps'] == 0
+    probs = np.asarray(
+        clf.predict_proba_device_batch(
+            batch, names=names, k=model.nb_prev_actions
+        )
+    )
+    assert np.all(np.isfinite(probs))

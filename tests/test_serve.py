@@ -603,8 +603,20 @@ def test_mlp_checkpoint_format_version_stamp(tmp_path, model):
     path = str(tmp_path / 'head.npz')
     clf.save(path)
     with np.load(path) as data:
-        assert int(data['format_version']) == MLP_FORMAT_VERSION
+        # the stamp is the MINIMUM reader version: this head uses no
+        # post-v1 feature, so pre-quantization libraries keep loading it
+        assert int(data['format_version']) == 1
     MLPClassifier.load(path)  # current version round-trips
+
+    # a quantized head stamps the LITERAL version that introduced the
+    # feature (2) — not MLP_FORMAT_VERSION, which future features bump
+    clf.quantize = 'int8'
+    quant_path = str(tmp_path / 'head_quant.npz')
+    clf.save(quant_path)
+    clf.quantize = 'none'
+    with np.load(quant_path) as data:
+        assert int(data['format_version']) == 2
+    assert MLPClassifier.load(quant_path).quantize == 'int8'
 
     # forge a FUTURE artifact: the loader must reject it up front
     with np.load(path) as data:
@@ -631,7 +643,10 @@ def test_vaep_checkpoint_format_version_gate(tmp_path, model):
     meta_path = os.path.join(path, 'meta.json')
     with open(meta_path) as f:
         meta = json.load(f)
-    assert meta['format_version'] == CHECKPOINT_FORMAT_VERSION
+    # minimum-reader-version stamp: an unquantized checkpoint stays
+    # loadable by pre-quantization libraries (stamps 1, not the
+    # library's own CHECKPOINT_FORMAT_VERSION)
+    assert meta['format_version'] == 1
     load_model(path)  # current version round-trips
 
     meta['format_version'] = CHECKPOINT_FORMAT_VERSION + 1

@@ -55,11 +55,16 @@ HEADLINE_KEYS: Tuple[str, ...] = (
     'serve_achieved_flops_per_sec',
 )
 
-#: Artifact metrics whose headline ``value`` is a WALL, not a rate — a
-#: rise is the regression (``bench.py --cold-start``'s process-start →
-#: first-rated-action seconds). Only ``value`` flips direction: the
-#: other HEADLINE_KEYS stay rates wherever they appear.
-LOWER_IS_BETTER: Tuple[str, ...] = ('cold_start_seconds',)
+#: Artifact metrics whose headline ``value`` is a WALL or a SIZE, not a
+#: rate — a rise is the regression (``bench.py --cold-start``'s
+#: process-start → first-rated-action seconds; the quantized fold's HBM
+#: table bytes, where growth means fewer model versions fit warm). Only
+#: ``value`` flips direction: the other HEADLINE_KEYS stay rates
+#: wherever they appear.
+LOWER_IS_BETTER: Tuple[str, ...] = (
+    'cold_start_seconds',
+    'vaep_quant_table_bytes',
+)
 
 
 def default_ledger() -> str:

@@ -106,7 +106,15 @@ KNOWN_AREAS = {
 #:   train_epoch, solve_xt — a handful, like ``xla``'s fn), ``output``
 #:   the guarded output slot per site (probs|logits|loss|grid|residual),
 #:   ``pair`` the parity path-pairs
-#:   (fused_vs_materialized|incremental_vs_replay).
+#:   (fused_vs_materialized|incremental_vs_replay), ``quant`` the served
+#:   side's table-storage mode on parity observations (bf16|int8,
+#:   ``ops/quant.py::QUANTIZE_MODES``; f32 serving stays unlabeled so
+#:   pre-quantization series addresses are stable) — the parity
+#:   histograms split per mode are the in-production quantization error
+#:   band.
+#: - ``bench``: ``quant``/``kernel`` label the vaep_fused_quant sweep's
+#:   summary gauges per (storage mode, first-layer lowering) — both
+#:   bounded by code (QUANTIZE_MODES × pallas|xla).
 #: - ``perf``: ``fn`` values are the instrumented dispatch loops (the
 #:   ``instrument_jit`` names — pair_probs, train_epoch, solve_xt* — so
 #:   the roofline and the compile observatory share books), ``bucket``
@@ -124,11 +132,11 @@ KNOWN_AREAS = {
 #:   retried|recovered|exhausted|permanent for retries and the
 #:   breaker-probe / recovery verdicts elsewhere — all bounded by code.
 KNOWN_LABELS = {
-    'bench': {'path', 'platform'},
+    'bench': {'path', 'platform', 'quant', 'kernel'},
     'drift': {'feature'},
     'learn': {'source', 'stage', 'verdict', 'head', 'model'},
     'mem': {'span', 'device', 'owner'},
-    'num': {'fn', 'output', 'pair'},
+    'num': {'fn', 'output', 'pair', 'quant'},
     'perf': {'fn', 'bucket'},
     'pipeline': {'stage'},
     'resil': {'point', 'kind', 'site', 'outcome'},

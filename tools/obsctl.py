@@ -458,10 +458,22 @@ def _num_summary(snapshot: Dict[str, Any]) -> Dict[str, Any]:
     def series(name: str):
         return (snapshot.get(name) or {}).get('series', [])
 
-    parity: Dict[str, Dict[str, Any]] = {}
+    # keyed by (pair, quant): quantized serving records its error band
+    # under a separate `quant`-labeled series (f32 stays unlabeled) and
+    # the two must never merge into one row
+    parity: Dict[Any, Dict[str, Any]] = {}
+
+    def parity_entry(s):
+        labels = s.get('labels') or {}
+        pair = labels.get('pair', '?')
+        quant = labels.get('quant')
+        entry = parity.setdefault((pair, quant), {'pair': pair})
+        if quant:
+            entry['quant'] = quant
+        return entry
+
     for s in series('num/parity_abs_err'):
-        pair = (s.get('labels') or {}).get('pair', '?')
-        entry = parity.setdefault(pair, {'pair': pair})
+        entry = parity_entry(s)
         entry['probes'] = s.get('count', 0)
         entry['max_abs_err'] = s.get('max')
         entry['p99_abs_err'] = (s.get('quantiles') or {}).get('p99')
@@ -469,10 +481,7 @@ def _num_summary(snapshot: Dict[str, Any]) -> Dict[str, Any]:
         if exemplar.get('request_id'):
             entry['last_request_id'] = exemplar['request_id']
     for s in series('num/parity_exceedances'):
-        pair = (s.get('labels') or {}).get('pair', '?')
-        parity.setdefault(pair, {'pair': pair})['exceedances'] = int(
-            s.get('total') or 0
-        )
+        parity_entry(s)['exceedances'] = int(s.get('total') or 0)
     return {
         'nonfinite': [
             {
