@@ -41,14 +41,17 @@ obs-smoke:
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/chaos_smoke.py
 
-# the capacity observatory, driven end to end on CPU:
-# tools/capacity_smoke.py serves a warm request sequence through a
-# registry-loaded model (live-roofline gauges + device-idle fraction
-# recorded, residency ledger reconciled against the census, zero
-# steady-state retraces preserved, `obsctl capacity` round-trips from
-# the run log AND live) and re-execs `bench.py --cold-start` (a clean
-# child measured process-start -> first-rated-action with a full
-# per-phase breakdown bounded by the wall)
+# the capacity observatory + the AOT serving pipeline, driven end to
+# end on CPU: tools/capacity_smoke.py serves a warm request sequence
+# through a registry-loaded model (live-roofline gauges + device-idle
+# fraction recorded, residency ledger reconciled against the census,
+# zero steady-state retraces preserved, `obsctl capacity` round-trips
+# from the run log AND live), and re-execs `bench.py --cold-start`
+# (the cold vs cache-hit vs AOT-shipped matrix of clean children:
+# per-phase breakdowns bounded by their walls, AOT wall strictly below
+# cold, and — off the AOT tier's ledger entry, whose child ran against
+# a version published WITH serialized executables — ladder_compile ~ 0
+# with serve/aot_loads{outcome=hit} >= the ladder rung count)
 capacity-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/capacity_smoke.py
 

@@ -26,6 +26,9 @@ alignment.
 
 from __future__ import annotations
 
+import os
+from typing import Optional
+
 # xT grid
 XT_GRID_LENGTH: int = 16  # N: cells along pitch length (x)
 XT_GRID_WIDTH: int = 12  # M: cells along pitch width (y)
@@ -46,3 +49,24 @@ MAX_DRIBBLE_DURATION: float = 10.0
 # TPU runtime
 DEFAULT_BACKEND: str = 'jax'
 ACTION_AXIS_ALIGNMENT: int = 128  # TPU lane width the action axis pads to
+
+#: Environment variable naming the persistent XLA compilation cache
+#: directory — the middle tier of the cold-start ladder (shipped AOT
+#: executables > this cache > cold compile). Unset (the default) leaves
+#: jax's compilation cache off; pointing it at a shared directory makes
+#: every replica after the first hit warm compiles instead of paying
+#: XLA again. Applied lazily by
+#: :func:`socceraction_tpu.serve.aot.enable_compile_cache` (wired into
+#: ``RatingService.warmup``) so this module stays import-light.
+COMPILE_CACHE_ENV: str = 'SOCCERACTION_TPU_COMPILE_CACHE'
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The configured persistent compile-cache directory, or ``None``.
+
+    Reads ``SOCCERACTION_TPU_COMPILE_CACHE`` at call time (not import
+    time — tests and the cold-start bench flip it per subprocess); an
+    empty value means disabled, same as unset.
+    """
+    path = os.environ.get(COMPILE_CACHE_ENV, '').strip()
+    return path or None

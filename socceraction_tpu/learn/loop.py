@@ -118,6 +118,15 @@ class LearnConfig:
     #: the decision trail survives any crash. None (default) keeps the
     #: in-memory-only behavior.
     journal_path: Optional[str] = None
+    #: serving shapes to compile + ship as AOT executables with every
+    #: staged candidate (``{'ladder': (1, ..., B), 'max_actions': N}`` —
+    #: match the replicas' ``RatingService`` bucket ladder/capacity).
+    #: The artifacts ride the candidate through the promotion's atomic
+    #: rename, so a replica hot-swapping to the promoted version warms
+    #: by deserializing instead of recompiling
+    #: (:mod:`socceraction_tpu.serve.aot`). ``None`` (default) ships
+    #: none — the training process then never pays the export compile.
+    aot: Optional[Dict[str, Any]] = None
 
 
 class ContinuousLearner:
@@ -654,6 +663,7 @@ class ContinuousLearner:
                     cfg.model_name,
                     candidate,
                     manifest=self._build_manifest(candidate, new_ids),
+                    aot=cfg.aot,
                 )
             # the games are consumed once a candidate was trained over
             # them — a rejected candidate must not retrain the same data

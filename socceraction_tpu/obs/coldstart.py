@@ -1,10 +1,13 @@
 """Cold-start timeline: phase-marked startup spans from process start.
 
-ROADMAP item 5 (AOT-shipped executables, instant scale-out) needs its
+ROADMAP item 5 (AOT-shipped executables, instant scale-out) needed its
 meter built first: a replica's worth is "process start → first rated
 action", and optimizing it requires knowing where those seconds go —
-interpreter+jax import, checkpoint load, device upload, per-rung ladder
-compile, first dispatch. This module is that meter:
+interpreter+jax import, checkpoint load, device upload, AOT
+deserialization (``aot_deserialize``, a first-class phase since the
+shipped-executable tier landed — ≈0 on a cold start, the whole point
+when artifacts match), per-rung ladder compile, first dispatch. This
+module is that meter:
 
 - :func:`process_start_unix` — the OS's record of when this process
   started (``/proc/self/stat`` start time against the boot clock), so

@@ -43,6 +43,18 @@ the shared instrument:
   compile rather than doubling; ``cost=True`` analyzes every signature,
   ``cost=False`` (or ``SOCCERACTION_TPU_XLA_COST=0``) none.
 
+- **preloaded executables** (:meth:`InstrumentedJit.preload`) — the
+  deserialize half of the AOT-shipped serving pipeline
+  (:mod:`socceraction_tpu.serve.aot`): a compiled executable
+  deserialized from a registry artifact is installed under its exact
+  abstract call key, and every later call with that signature dispatches
+  straight through it — no trace, no XLA compile, nothing counted under
+  ``xla/compiles``. Deserialized programs have no lowering left to
+  re-cost, so ``preload`` seeds the cost books (:func:`fn_cost`, the
+  ``xla/cost_*`` gauges) from the export-time analysis the artifact's
+  manifest carries — the live roofline keeps working over AOT-served
+  dispatches.
+
 Everything here is importable without jax (the obs package contract);
 jax is touched only when a function is actually instrumented or called.
 """
@@ -337,6 +349,9 @@ class InstrumentedJit:
         self._lock = threading.Lock()
         #: fast call key -> human-readable signature
         self._signatures: Dict[Any, Tuple[Tuple[str, str], ...]] = {}
+        #: fast call key -> deserialized AOT executable (see preload);
+        #: mutated only under the lock, read lock-free on the call path
+        self._preloaded: Dict[Any, Any] = {}
         self._last_sig: Optional[Tuple[Tuple[str, str], ...]] = None
         self._recent: 'deque[float]' = deque()
         self.n_storms = 0
@@ -357,6 +372,18 @@ class InstrumentedJit:
             # inlined into an outer trace: no dispatch, no compile here
             return self._jit(*args, **kwargs)
         key = (treedef, tuple(_leaf_key(x) for x in leaves), static)
+        if self._preloaded:
+            compiled = self._preloaded.get(key)
+            if compiled is not None:
+                # the AOT-shipped path: a deserialized executable serves
+                # this exact signature — statics were baked in at export
+                # time, so only the dynamic arguments travel
+                if self._static_names:
+                    kwargs = {
+                        k: v for k, v in kwargs.items()
+                        if k not in self._static_names
+                    }
+                return compiled(*args, **kwargs)
         if key in self._signatures:
             return self._jit(*args, **kwargs)
         return self._first_call(key, args, kwargs)
@@ -459,6 +486,60 @@ class InstrumentedJit:
             if log is not None:
                 log.event('retrace_storm', **storm_event)
             RECORDER.record('retrace_storm', **storm_event)
+
+    # -- AOT preloading ----------------------------------------------------
+
+    def preload(
+        self,
+        key: Any,
+        compiled: Any,
+        *,
+        cost: Optional[Tuple[float, float]] = None,
+    ) -> None:
+        """Install a deserialized executable under an abstract call key.
+
+        ``key`` is :func:`call_key` of the call the executable was
+        compiled for (the loader recomputes it from ``ShapeDtypeStruct``
+        specs — array leaves key by shape/dtype, so spec-derived and
+        live-call keys coincide); ``compiled`` is the loaded executable
+        (:func:`jax.experimental.serialize_executable.deserialize_and_load`),
+        called with the dynamic arguments only. Later calls matching
+        ``key`` dispatch through it: no trace, no compile, nothing
+        counted under ``xla/compiles`` — the signature deliberately does
+        NOT register in the compile books, because no compile happened.
+
+        ``cost`` seeds the function's cost books (:func:`fn_cost`, the
+        ``xla/cost_*`` gauges) with the export-time AOT analysis: a
+        deserialized program has no lowering to re-analyze, and without
+        the carried cost the live roofline would divide by nothing.
+        Re-preloading a key replaces the executable (same-architecture
+        model versions share signatures — the weights are runtime
+        arguments, so one preloaded program serves every hot-swap of the
+        architecture it was exported from).
+        """
+        with self._lock:
+            self._preloaded[key] = compiled
+            if cost is not None:
+                self.last_cost = (float(cost[0]), float(cost[1]))
+        if cost is not None:
+            flops, bytes_acc = float(cost[0]), float(cost[1])
+            reg = self._registry
+            reg.gauge('xla/cost_flops', unit='flops').set(flops, fn=self.name)
+            reg.gauge('xla/cost_bytes', unit='bytes').set(
+                bytes_acc, fn=self.name
+            )
+            _bump_totals(self.name, cost=(flops, bytes_acc))
+
+    @property
+    def n_preloaded(self) -> int:
+        """Distinct preloaded AOT signatures installed."""
+        with self._lock:
+            return len(self._preloaded)
+
+    def clear_preloaded(self) -> None:
+        """Drop every preloaded executable (tests; later calls compile)."""
+        with self._lock:
+            self._preloaded.clear()
 
     # -- introspection -----------------------------------------------------
 

@@ -10,11 +10,14 @@ on the packed ``(G, A)`` batch. The lag clamps at each game's first row
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from ..config import CORNER_PRIOR, PENALTY_PRIOR, SAMEPHASE_SECONDS
 from ..core.batch import ActionBatch
+from ..obs.xla import instrument_jit
 from ..spadl import config as spadlconfig
 from .labels import _goal_masks
 
@@ -66,7 +69,12 @@ def vaep_core(
     return jnp.stack([offensive, defensive, offensive + defensive], axis=-1)
 
 
-@jax.jit
+# instrumented (not plain jax.jit) so the serving dispatch's OTHER
+# compiled program is first-class in the compile observatory — and so
+# the AOT exporter (serve/aot.py) can serialize + preload it per shape
+# bucket exactly like the pair dispatch; one compile per bucket is the
+# whole ladder budget, far under the default storm threshold
+@functools.partial(instrument_jit, name='vaep_values')
 def vaep_values(
     batch: ActionBatch, p_scores: jax.Array, p_concedes: jax.Array
 ) -> jax.Array:

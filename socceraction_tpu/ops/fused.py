@@ -67,7 +67,9 @@ __all__ = [
     'fused_mlp_logits',
     'fused_pair_logits',
     'fused_pair_probs',
+    'PairDispatchPlan',
     'PreparedPair',
+    'pair_dispatch_plan',
     'prepare_pair_fold',
     'TrainStates',
     'TrainLayout',
@@ -867,6 +869,134 @@ def _pair_probs(
     return out + ((nonfinite_count(*out), overflow_count(a, b)),)
 
 
+class PairDispatchPlan(NamedTuple):
+    """One serving dispatch, fully resolved but not yet called.
+
+    ``fn`` is the :class:`~socceraction_tpu.obs.xla.InstrumentedJit`
+    that will run (``_pair_probs`` for the bit-pinned legacy
+    configuration, ``_pair_probs_prepared`` otherwise), ``args`` the
+    dynamic positional arguments and ``kwargs`` the static keyword
+    arguments, exactly as :func:`fused_pair_probs` would pass them.
+    This is the shared contract between the live dispatch and the AOT
+    exporter (:mod:`socceraction_tpu.serve.aot`): the exporter builds
+    the same plan over ``ShapeDtypeStruct`` specs, lowers
+    ``fn.lower(*args, **kwargs)`` and serializes the compiled program,
+    so the shipped executable is keyed by the *identical* abstract
+    signature the serving flush will call with.
+    """
+
+    fn: Any
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    guard: bool
+    quantize: str
+    kernel: str
+
+
+def pair_dispatch_plan(
+    clf_a: Any,
+    clf_b: Any,
+    batch: Any,
+    *,
+    names: Tuple[str, ...],
+    k: int,
+    registry_name: str = 'standard',
+    dense_overrides: Optional[Dict[str, jax.Array]] = None,
+    hidden_dtype: Optional[Any] = None,
+    prepared: Optional[PreparedPair] = None,
+    quantize: Optional[str] = None,
+    kernel: Optional[str] = None,
+) -> PairDispatchPlan:
+    """Resolve which jitted program one pair dispatch runs, with its args.
+
+    The argument-assembly half of :func:`fused_pair_probs`, factored out
+    so the AOT exporter and the live dispatch can never skew: both build
+    the plan here, one calls it, the other lowers it from specs
+    (``batch`` / ``dense_overrides`` may be ``ShapeDtypeStruct`` trees —
+    nothing here inspects values).
+    """
+    for clf in (clf_a, clf_b):
+        if clf.params is None or clf.mean_ is None or clf.std_ is None:
+            raise ValueError('classifier is not fitted')
+    from ..obs import numerics
+
+    registry = REGISTRIES[registry_name]
+    mode = quantize if quantize is not None else _shared_quantize_mode(clf_a, clf_b)
+    if prepared is not None and prepared.quantize != mode:
+        # same contract as _resolve_kernel: a conflicting request must
+        # never silently serve the fold's storage while the caller
+        # reports (and gates) the mode it asked for
+        raise ValueError(
+            f'prepared fold holds {prepared.quantize!r} storage but the '
+            f'requested quantize mode is {mode!r} — rebuild the fold '
+            'with prepare_pair_fold for the requested mode'
+        )
+    method = _resolve_kernel(kernel, registry.combo_size)
+    guard = numerics.guards_enabled()
+    hidden_dtype_name = (
+        jnp.dtype(hidden_dtype).name if hidden_dtype is not None else None
+    )
+    if prepared is None and mode == 'none' and method == 'xla':
+        # the bit-pinned legacy lowering: per-dispatch fold from Dense_0
+        mean_a, std_a = clf_a._device_stats()
+        mean_b, std_b = clf_b._device_stats()
+        return PairDispatchPlan(
+            fn=_pair_probs,
+            args=(
+                clf_a.params, clf_b.params, mean_a, std_a, mean_b, std_b,
+                batch, dense_overrides,
+            ),
+            kwargs=dict(
+                names=tuple(names),
+                k=k,
+                hidden_layers_a=len(clf_a.hidden),
+                hidden_layers_b=len(clf_b.hidden),
+                registry_name=registry_name,
+                hidden_dtype_name=hidden_dtype_name,
+                guard=guard,
+            ),
+            guard=guard,
+            quantize=mode,
+            kernel=method,
+        )
+    prep = prepared
+    if prep is None:
+        prep = prepare_pair_fold(
+            clf_a, clf_b, names=tuple(names), k=k,
+            registry_name=registry_name, quantize=mode,
+        )
+    hidden_a = {
+        name: leaf for name, leaf in clf_a.params['params'].items()
+        if name != 'Dense_0'
+    }
+    hidden_b = {
+        name: leaf for name, leaf in clf_b.params['params'].items()
+        if name != 'Dense_0'
+    }
+    return PairDispatchPlan(
+        fn=_pair_probs_prepared,
+        args=(
+            prep.tables, prep.w_dense, prep.bias, hidden_a, hidden_b,
+            batch, dense_overrides,
+        ),
+        kwargs=dict(
+            names=tuple(names),
+            k=k,
+            hidden_layers_a=len(clf_a.hidden),
+            hidden_layers_b=len(clf_b.hidden),
+            registry_name=registry_name,
+            h_a_width=prep.h_a_width,
+            quantize=prep.quantize,
+            kernel=method,
+            hidden_dtype_name=hidden_dtype_name,
+            guard=guard,
+        ),
+        guard=guard,
+        quantize=prep.quantize,
+        kernel=method,
+    )
+
+
 def fused_pair_probs(
     clf_a: Any,
     clf_b: Any,
@@ -912,82 +1042,16 @@ def fused_pair_probs(
     so a warm (registry-resident) model does not re-upload ``mean_``/
     ``std_`` on every call.
     """
-    for clf in (clf_a, clf_b):
-        if clf.params is None or clf.mean_ is None or clf.std_ is None:
-            raise ValueError('classifier is not fitted')
     from ..obs import numerics
 
-    registry = REGISTRIES[registry_name]
-    mode = quantize if quantize is not None else _shared_quantize_mode(clf_a, clf_b)
-    if prepared is not None and prepared.quantize != mode:
-        # same contract as _resolve_kernel: a conflicting request must
-        # never silently serve the fold's storage while the caller
-        # reports (and gates) the mode it asked for
-        raise ValueError(
-            f'prepared fold holds {prepared.quantize!r} storage but the '
-            f'requested quantize mode is {mode!r} — rebuild the fold '
-            'with prepare_pair_fold for the requested mode'
-        )
-    method = _resolve_kernel(kernel, registry.combo_size)
-    guard = numerics.guards_enabled()
-    hidden_dtype_name = (
-        jnp.dtype(hidden_dtype).name if hidden_dtype is not None else None
+    plan = pair_dispatch_plan(
+        clf_a, clf_b, batch,
+        names=names, k=k, registry_name=registry_name,
+        dense_overrides=dense_overrides, hidden_dtype=hidden_dtype,
+        prepared=prepared, quantize=quantize, kernel=kernel,
     )
-    if prepared is None and mode == 'none' and method == 'xla':
-        # the bit-pinned legacy lowering: per-dispatch fold from Dense_0
-        mean_a, std_a = clf_a._device_stats()
-        mean_b, std_b = clf_b._device_stats()
-        out = _pair_probs(
-            clf_a.params,
-            clf_b.params,
-            mean_a,
-            std_a,
-            mean_b,
-            std_b,
-            batch,
-            dense_overrides,
-            names=tuple(names),
-            k=k,
-            hidden_layers_a=len(clf_a.hidden),
-            hidden_layers_b=len(clf_b.hidden),
-            registry_name=registry_name,
-            hidden_dtype_name=hidden_dtype_name,
-            guard=guard,
-        )
-    else:
-        prep = prepared
-        if prep is None:
-            prep = prepare_pair_fold(
-                clf_a, clf_b, names=tuple(names), k=k,
-                registry_name=registry_name, quantize=mode,
-            )
-        hidden_a = {
-            name: leaf for name, leaf in clf_a.params['params'].items()
-            if name != 'Dense_0'
-        }
-        hidden_b = {
-            name: leaf for name, leaf in clf_b.params['params'].items()
-            if name != 'Dense_0'
-        }
-        out = _pair_probs_prepared(
-            prep.tables,
-            prep.w_dense,
-            prep.bias,
-            hidden_a,
-            hidden_b,
-            batch,
-            dense_overrides,
-            names=tuple(names),
-            k=k,
-            hidden_layers_a=len(clf_a.hidden),
-            hidden_layers_b=len(clf_b.hidden),
-            registry_name=registry_name,
-            h_a_width=prep.h_a_width,
-            quantize=prep.quantize,
-            kernel=method,
-            hidden_dtype_name=hidden_dtype_name,
-            guard=guard,
-        )
+    out = plan.fn(*plan.args, **plan.kwargs)
+    guard = plan.guard
     if guard:
         pa, pb, (n_nonfinite, n_overflow) = out
         # no sync here: the device scalars are stashed for a later

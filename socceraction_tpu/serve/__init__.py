@@ -13,6 +13,11 @@ concurrent callers onto the fused one-dispatch rating path:
 - :mod:`socceraction_tpu.serve.registry` — :class:`ModelRegistry`,
   named+versioned checkpoints with warm device residency and atomic
   hot-swap.
+- :mod:`socceraction_tpu.serve.aot` — AOT-serialized serving
+  executables: compile the ladder once, ship the compiled programs in
+  the registry version dir, and let every matching replica warm by
+  deserializing instead of recompiling (plus the persistent
+  compile-cache middle tier, ``SOCCERACTION_TPU_COMPILE_CACHE``).
 - :mod:`socceraction_tpu.serve.service` — :class:`RatingService`, the
   front end (``rate() -> Future``, ``open_session``, ``swap_model``,
   ``rollback_model``), fully instrumented under the ``serve`` telemetry
@@ -26,23 +31,25 @@ Quickstart::
     from socceraction_tpu.serve import RatingService
 
     service = RatingService(model, max_wait_ms=2.0)
-    service.warmup()                      # compile the bucket ladder
+    service.warmup()                      # AOT artifacts > compile cache
     fut = service.rate(actions_df, home_team_id=782)
     values = fut.result()                 # offensive/defensive/vaep cols
 
     live = service.open_session('match-1', home_team_id=782)
     live.add_actions(first_minutes_df)    # rates only the new suffix
 
-See ``docs/serving.md`` for the architecture and overload/swap
-semantics.
+Submodules load lazily (PEP 562): ``from socceraction_tpu.serve import
+ModelRegistry`` pulls neither jax nor pandas, so control-plane
+processes — registry listings, AOT-manifest/fingerprint inspection,
+``obsctl`` — stay import-light; the heavy service machinery loads the
+first time :class:`RatingService`/:class:`MatchSession` (or anything
+else from the data plane) is touched. Pinned by the import-audit tests.
+
+See ``docs/serving.md`` for the architecture, the cold-start runbook
+and overload/swap semantics.
 """
 
-from ..obs.context import DeadlineExceeded
-from .batcher import MicroBatcher, Overloaded
-from .capture import TrafficCapture
-from .registry import ModelRegistry
-from .service import RatingService, SLOShed
-from .session import MatchSession
+from typing import Any
 
 __all__ = [
     'DeadlineExceeded',
@@ -54,3 +61,43 @@ __all__ = [
     'MatchSession',
     'TrafficCapture',
 ]
+
+#: exported name -> (submodule, attribute) for the lazy loader; kept
+#: explicit so ``__all__`` and the resolution table cannot drift apart
+_LAZY = {
+    'DeadlineExceeded': ('socceraction_tpu.obs.context', 'DeadlineExceeded'),
+    'MicroBatcher': ('socceraction_tpu.serve.batcher', 'MicroBatcher'),
+    'Overloaded': ('socceraction_tpu.serve.batcher', 'Overloaded'),
+    'ModelRegistry': ('socceraction_tpu.serve.registry', 'ModelRegistry'),
+    'RatingService': ('socceraction_tpu.serve.service', 'RatingService'),
+    'SLOShed': ('socceraction_tpu.serve.service', 'SLOShed'),
+    'MatchSession': ('socceraction_tpu.serve.session', 'MatchSession'),
+    'TrafficCapture': ('socceraction_tpu.serve.capture', 'TrafficCapture'),
+}
+
+
+_SUBMODULES = {
+    'aot', 'batcher', 'capture', 'registry', 'service', 'session',
+}
+
+
+def __getattr__(name: str) -> Any:
+    import importlib
+
+    if name in _SUBMODULES:
+        # attribute-style submodule access (serve.batcher) without a
+        # prior explicit import of the submodule
+        return importlib.import_module(f'{__name__}.{name}')
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f'module {__name__!r} has no attribute {name!r}'
+        ) from None
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value  # cache: next access skips the import hook
+    return value
+
+
+def __dir__() -> list:
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,618 @@
+"""AOT-serialized serving executables: compile once, ship, deserialize.
+
+ROADMAP item 5's closing move. A "millions of users" service scales out
+by starting replicas, and PR 11's cold-start ledger put a number on what
+each one costs: ~10s on CPU, of which the per-rung ladder compile is the
+second-largest phase. Every replica was re-deriving the *same* XLA
+programs from the *same* checkpoint on the *same* platform. This module
+makes the compiled programs themselves registry artifacts:
+
+- :func:`export_serving_aot` — for each bucket rung of a model's
+  serving ladder, build the exact dispatch plan the flush will run
+  (:func:`socceraction_tpu.ops.fused.pair_dispatch_plan` — the shared
+  single source, so exporter and server can never skew), lower it from
+  ``ShapeDtypeStruct`` specs, compile, and serialize the compiled
+  executable (``jax.experimental.serialize_executable``) into
+  ``<dir>/aot/`` next to a ``manifest.json`` carrying the environment
+  fingerprint, per-artifact sha256 checksums (the PR 10 discipline) and
+  the export-time XLA cost analysis. Both compiled programs of a
+  serving dispatch ship: the two-head pair dispatch *and* the
+  ``vaep_values`` formula kernel.
+- :func:`load_serving_aot` — the deserialize tier of
+  ``RatingService.warmup()``: when the stored fingerprint matches the
+  running process, every artifact is checksum-verified, deserialized
+  and preloaded into its jit's signature cache
+  (:meth:`socceraction_tpu.obs.xla.InstrumentedJit.preload`), so the
+  ladder warmup dispatches through shipped executables instead of
+  compiling. A fingerprint mismatch degrades loudly-but-gracefully:
+  ``outcome='stale'`` (counted, evented, in ``health()['aot']``) and
+  the service recompiles — wrong executables are never served. Artifact
+  reads run through the ``registry.aot`` fault point and the typed
+  retry policy; a corrupt/truncated artifact is a *named* failure that
+  falls back to recompile, never a failed swap.
+- :func:`enable_compile_cache` — the middle tier: jax's persistent
+  compilation cache (``SOCCERACTION_TPU_COMPILE_CACHE`` via
+  :mod:`socceraction_tpu.config`), for replicas without shipped
+  artifacts that still share a filesystem.
+
+The serialized executables are **weight-independent**: model parameters
+and prepared tables are runtime *arguments* of the compiled programs,
+so one exported ladder serves every same-architecture version — a
+hot-swap to a retrained model reuses the preloaded programs with the
+new weights, and re-loading a newer version's artifacts just replaces
+identical keys.
+
+Everything here is importable without jax (module contract shared with
+the rest of :mod:`socceraction_tpu.obs`): jax loads only when artifacts
+are actually exported, loaded, or the cache enabled. ``read_manifest``
+is deliberately jax-free so control-plane tooling (``obsctl``) can
+inspect shipped fingerprints without paying the jax import.
+
+Outcomes land in ``serve/aot_loads{outcome=hit|stale|miss}`` (one
+``hit`` per deserialized artifact — the capacity smoke asserts hits ≥
+ladder rungs — one ``stale``/``miss`` per load attempt) plus an
+``aot_load`` event in the flight recorder and the active run log.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ..config import compile_cache_dir
+from ..obs import counter
+from ..resil.faults import fault_point
+from ..resil.retry import RetryPolicy, retry_call
+
+__all__ = [
+    'AOT_DIRNAME',
+    'AOT_FORMAT',
+    'enable_compile_cache',
+    'env_fingerprint',
+    'export_serving_aot',
+    'fingerprint_diff',
+    'last_aot_load',
+    'load_serving_aot',
+    'read_manifest',
+]
+
+#: subdirectory of a registry version dir holding the shipped executables
+AOT_DIRNAME = 'aot'
+
+#: manifest format; a reader refuses anything newer (same stance as the
+#: checkpoint format stamps)
+AOT_FORMAT = 1
+
+#: Artifact reads retried under this policy: transient filesystem errors
+#: (registry on network storage mid-failover) back off and retry;
+#: checksum mismatches and parse failures (ValueError) are permanent —
+#: the caller falls back to recompiling, waiting cannot fix bit rot.
+AOT_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=1.0)
+
+#: the last load attempt's summary (process-wide), for live ``obsctl
+#: capacity`` — the runlog-free counterpart of the ``aot_load`` event
+_LAST_LOAD: Optional[Dict[str, Any]] = None
+_LAST_LOAD_LOCK = threading.Lock()
+
+
+def last_aot_load() -> Optional[Dict[str, Any]]:
+    """The most recent :func:`load_serving_aot` summary, or ``None``."""
+    with _LAST_LOAD_LOCK:
+        return dict(_LAST_LOAD) if _LAST_LOAD is not None else None
+
+
+def _note_load(summary: Dict[str, Any]) -> None:
+    global _LAST_LOAD
+    with _LAST_LOAD_LOCK:
+        _LAST_LOAD = dict(summary)
+
+
+def _emit_event(kind: str, **payload: Any) -> None:
+    """Recorder + run-log fan-out; telemetry must never fail a load."""
+    try:
+        from ..obs.recorder import RECORDER
+        from ..obs.trace import current_runlog
+
+        RECORDER.record(kind, **payload)
+        log = current_runlog()
+        if log is not None:
+            log.event(kind, **payload)
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------
+# environment fingerprint
+# --------------------------------------------------------------------------
+
+
+def _profile_sha256() -> str:
+    """sha256 of the committed platform-profile file (or 'absent').
+
+    The profile gates the Pallas kernel and the rating path, both of
+    which select *which* program serves — two processes with different
+    profiles may compile different executables for the same model.
+    """
+    from ..ops import profile as _profile
+
+    path = getattr(_profile, '_PROFILE_FILE', None)
+    try:
+        with open(path, 'rb') as f:  # type: ignore[arg-type]
+            return hashlib.sha256(f.read()).hexdigest()
+    except (OSError, TypeError):
+        return 'absent'
+
+
+def env_fingerprint() -> Dict[str, str]:
+    """The compiled-program compatibility key of THIS process.
+
+    Everything that changes what (or whether) a serialized executable
+    can serve here: jax/jaxlib versions and the backend + device kind
+    (the PJRT executable format is tied to all four), the platform
+    profile hash and resolved rating path / first-layer kernel (they
+    select which program compiles), the in-dispatch guard flag (it
+    changes the program's outputs) and the checkpoint format (what a
+    version dir's weights mean). Imports jax — callers that only need
+    to *read* a shipped fingerprint use :func:`read_manifest`.
+    """
+    import jax
+    import jaxlib
+
+    from ..ml.mlp import MLP_FORMAT_VERSION
+    from ..obs import numerics
+    from ..ops.gather_matmul import fused_kernel_method
+    from ..ops.profile import preferred_rating_path
+
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        device_kind = 'unknown'
+    try:
+        kernel = fused_kernel_method()
+    except Exception:
+        kernel = 'invalid'
+    return {
+        'aot_format': str(AOT_FORMAT),
+        'jax': str(jax.__version__),
+        'jaxlib': str(jaxlib.__version__),
+        'backend': str(jax.default_backend()),
+        'device_kind': str(device_kind),
+        'platform_profile_sha256': _profile_sha256(),
+        'rating_path': str(preferred_rating_path()),
+        'kernel': str(kernel),
+        'guards': '1' if numerics.guards_enabled() else '0',
+        'checkpoint_format': str(MLP_FORMAT_VERSION),
+    }
+
+
+def fingerprint_diff(
+    stored: Dict[str, Any], current: Dict[str, Any]
+) -> List[str]:
+    """Keys on which two fingerprints disagree (empty = compatible).
+
+    Compared over the union of keys: a field one side lacks IS a
+    mismatch (an older manifest without ``guards`` must not silently
+    pass a guard-enabled process).
+    """
+    keys = set(stored) | set(current)
+    return sorted(
+        k for k in keys if str(stored.get(k)) != str(current.get(k))
+    )
+
+
+# --------------------------------------------------------------------------
+# the serving plans: one (pair, formula) program pair per ladder rung
+# --------------------------------------------------------------------------
+
+
+def _spec_tree(tree: Any) -> Any:
+    """Array leaves -> ShapeDtypeStructs (specs pass through unchanged)."""
+    import jax
+
+    from ..obs.xla import _spec_leaf
+
+    return jax.tree_util.tree_map(_spec_leaf, tree)
+
+
+def _serving_plans(
+    model: Any, *, ladder: Tuple[int, ...], max_actions: int
+) -> Iterator[Tuple[str, Any, Tuple[Any, ...], Dict[str, Any]]]:
+    """Yield ``(entry_id, jit, spec_args, kwargs)`` per serving program.
+
+    One pair dispatch plus one formula kernel per bucket rung, with the
+    argument trees the live flush will use — ``dense_overrides`` carries
+    the goalscore block exactly when the model has the kernel (the
+    serving layer injects it on EVERY request for such models, so there
+    is one program per rung, not two). Everything is resolved through
+    :func:`~socceraction_tpu.ops.fused.pair_dispatch_plan`, the same
+    single source the dispatch uses.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import formula as _formula
+    from ..ops.fused import _abstract_batch, pair_dispatch_plan
+    from ..ops.profile import (
+        FUSED_PATH_HIDDEN_DTYPES,
+        hidden_dtype_for,
+        preferred_rating_path,
+    )
+
+    if getattr(model, '_fused_registry', None) != 'standard':
+        # the serving plans below are the STANDARD family's: the batch
+        # spec is the standard ActionBatch and the formula program is
+        # ops.formula.vaep_values — lowering an atomic model over them
+        # would either crash or export programs whose keys never match
+        # a live dispatch (a silent always-recompile "hit"). Same
+        # boundary as RatingService._validate_model, stated at export
+        # time instead of serve time.
+        raise ValueError(
+            'AOT export covers standard-SPADL serving models '
+            f'(got fused registry {getattr(model, "_fused_registry", None)!r})'
+        )
+    path = preferred_rating_path()
+    if not getattr(model, '_can_fuse', lambda: False)() or (
+        path not in FUSED_PATH_HIDDEN_DTYPES
+    ):
+        raise ValueError(
+            'AOT export covers the fused serving path; this model/'
+            f'platform configuration rates through {path!r} without a '
+            'fused dispatch to serialize'
+        )
+    cols = list(model._label_columns)
+    clf_a, clf_b = model._models[cols[0]], model._models[cols[1]]
+    gs = 'goalscore' in model._kernel_names()
+    A = int(max_actions)
+    for b in ladder:
+        b = int(b)
+        batch_spec = _abstract_batch(G=b, A=A)
+        overrides = (
+            {'goalscore': jax.ShapeDtypeStruct((b, A, 3), jnp.float32)}
+            if gs
+            else None
+        )
+        plan = pair_dispatch_plan(
+            clf_a,
+            clf_b,
+            batch_spec,
+            names=model._kernel_names(),
+            k=model.nb_prev_actions,
+            registry_name=model._fused_registry,
+            dense_overrides=overrides,
+            hidden_dtype=hidden_dtype_for(path),
+            prepared=model._prepared_pair(),
+        )
+        yield (
+            f'pair-b{b}',
+            plan.fn,
+            _spec_tree(plan.args),
+            plan.kwargs,
+        )
+        probs = jax.ShapeDtypeStruct((b, A), jnp.float32)
+        yield (
+            f'formula-b{b}',
+            _formula.vaep_values,
+            (batch_spec, probs, probs),
+            {},
+        )
+
+
+def _plan_signature(fn: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> str:
+    """The human-readable abstract signature string of one plan.
+
+    Stored per artifact and re-derived at load time from the *loaded*
+    model: an artifact exported for a different architecture (or static
+    configuration) can never preload under a signature it was not
+    compiled for — the string IS the exact-abstract-signature guard.
+    """
+    from ..obs.xla import signature_of
+
+    sig = signature_of(args, kwargs, fn._static_names)
+    return ' '.join(f'{p}={d}' for p, d in sig)
+
+
+# --------------------------------------------------------------------------
+# export
+# --------------------------------------------------------------------------
+
+
+def export_serving_aot(
+    model: Any,
+    dest: str,
+    *,
+    ladder: Tuple[int, ...],
+    max_actions: int,
+) -> Dict[str, Any]:
+    """Compile ``model``'s serving ladder and serialize it into ``dest``.
+
+    ``dest`` is the ``aot/`` directory (created; must not already hold a
+    manifest — artifacts are immutable like everything else in the
+    registry). ``ladder`` / ``max_actions`` are the serving shapes to
+    cover (``RatingService``'s bucket ladder and action-axis capacity —
+    export with the shapes replicas will serve). Each program is
+    AOT-lowered from specs (never touching live buffers or the dispatch
+    cache), compiled, cost-analyzed and serialized; the manifest records
+    the environment fingerprint, per-artifact sha256 and the cost books
+    that :func:`load_serving_aot` seeds the compile observatory with.
+    Returns the manifest dict.
+    """
+    from jax.experimental import serialize_executable as _se
+
+    manifest_path = os.path.join(dest, 'manifest.json')
+    if os.path.exists(manifest_path):
+        raise ValueError(
+            f'AOT artifacts already exist at {dest!r}; they are '
+            'immutable — export into a fresh version/candidate instead'
+        )
+    os.makedirs(dest, exist_ok=True)
+    entries: List[Dict[str, Any]] = []
+    for entry_id, fn, spec_args, kwargs in _serving_plans(
+        model, ladder=tuple(ladder), max_actions=max_actions
+    ):
+        compiled = fn.lower(*spec_args, **kwargs).compile()
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0]
+            cost_flops = float(cost.get('flops', 0.0))
+            cost_bytes = float(cost.get('bytes accessed', 0.0))
+        except Exception:
+            cost_flops = cost_bytes = None  # type: ignore[assignment]
+        blob = pickle.dumps(_se.serialize(compiled), protocol=4)
+        filename = f'{entry_id}.jaxexec'
+        with open(os.path.join(dest, filename), 'wb') as f:
+            f.write(blob)
+        entries.append(
+            {
+                'id': entry_id,
+                'file': filename,
+                'fn': fn.name,
+                'sha256': hashlib.sha256(blob).hexdigest(),
+                'nbytes': len(blob),
+                'cost_flops': cost_flops,
+                'cost_bytes': cost_bytes,
+                'signature': _plan_signature(fn, spec_args, kwargs),
+            }
+        )
+    manifest = {
+        'format': AOT_FORMAT,
+        'fingerprint': env_fingerprint(),
+        'created_unix': time.time(),
+        'ladder': [int(b) for b in ladder],
+        'max_actions': int(max_actions),
+        'entries': entries,
+    }
+    with open(manifest_path, 'w', encoding='utf-8') as f:
+        json.dump(manifest, f, sort_keys=True)
+    return manifest
+
+
+# --------------------------------------------------------------------------
+# load
+# --------------------------------------------------------------------------
+
+
+def read_manifest(aot_dir: str) -> Optional[Dict[str, Any]]:
+    """The AOT manifest of ``aot_dir``, or ``None`` when absent.
+
+    jax-free (control-plane tooling inspects shipped fingerprints with
+    it). A *corrupt* manifest raises ``ValueError`` naming the file —
+    half-written provenance must surface, not read as absent; a reader
+    newer than this library is refused like a too-new checkpoint.
+    """
+    path = os.path.join(aot_dir, 'manifest.json')
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ValueError(
+            f'AOT manifest corrupt: {path!r} failed to parse '
+            f'({type(e).__name__}: {e})'
+        ) from e
+    if not isinstance(manifest, dict) or 'entries' not in manifest:
+        raise ValueError(
+            f'AOT manifest corrupt: {path!r} is not a manifest object'
+        )
+    if int(manifest.get('format', 0)) > AOT_FORMAT:
+        raise ValueError(
+            f'AOT manifest at {path!r} has format={manifest.get("format")}, '
+            f'newer than this library understands (<= {AOT_FORMAT}); '
+            'upgrade socceraction_tpu to load it'
+        )
+    return manifest
+
+
+def _read_artifact(aot_dir: str, entry: Dict[str, Any]) -> bytes:
+    """One checksum-verified artifact read (the ``registry.aot`` site).
+
+    The fault point sits INSIDE the retried callable, so an injected
+    transient error exercises the retry policy and an injected
+    ``ValueError`` (bit rot) surfaces immediately — both paths then hit
+    the caller's recompile fallback.
+    """
+    path = os.path.join(aot_dir, entry['file'])
+
+    def _read() -> bytes:
+        fault_point('registry.aot', artifact=entry['file'])
+        with open(path, 'rb') as f:
+            blob = f.read()
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != entry.get('sha256'):
+            raise ValueError(
+                f'AOT artifact corrupt: {path!r} sha256 {digest[:12]}… '
+                f'does not match the manifest ({str(entry.get("sha256"))[:12]}…); '
+                'the executable is truncated or damaged — recompiling'
+            )
+        return blob
+
+    return retry_call(_read, site='registry.aot', policy=AOT_RETRY)
+
+
+def load_serving_aot(
+    model: Any,
+    aot_dir: str,
+    *,
+    ladder: Tuple[int, ...],
+    max_actions: int,
+    context: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Deserialize shipped executables and preload the serving jits.
+
+    The tier-1 half of ``RatingService.warmup()``. Never raises: the
+    summary dict's ``outcome`` is
+
+    - ``'hit'`` — fingerprint matched and every covered rung's programs
+      were checksum-verified, deserialized and preloaded (one
+      ``serve/aot_loads{outcome="hit"}`` count per artifact);
+    - ``'stale'`` — artifacts exist but were built under a different
+      environment (or architecture): nothing preloads, ``mismatch``
+      names the fingerprint keys (or signatures) that moved, and the
+      caller recompiles — loudly counted, never silently served;
+    - ``'miss'`` — no artifacts, or a corrupt/unreadable artifact
+      (``reason`` says which): the caller recompiles.
+
+    Partial failures fail the whole load as ``'miss'`` *after* the
+    already-preloaded rungs were installed — those rungs still skip
+    their compile; the missing rungs compile in the warmup loop (the
+    degraded-not-broken contract of every registry artifact).
+    """
+    summary: Dict[str, Any] = {
+        'outcome': 'miss',
+        'entries_loaded': 0,
+        'aot_dir': aot_dir,
+        **(context or {}),
+    }
+    try:
+        # OSError included: a registry on network storage mid-failover
+        # can fail the manifest open itself — the never-raises contract
+        # (warmups and swaps degrade to recompile, never fail) covers
+        # the manifest read exactly like the artifact reads below
+        manifest = read_manifest(aot_dir)
+    except (ValueError, OSError) as e:
+        summary['reason'] = f'{type(e).__name__}: {e}'
+        return _finish_load(summary)
+    if manifest is None:
+        summary['reason'] = 'no AOT artifacts shipped'
+        return _finish_load(summary, count=False)
+    stored = dict(manifest.get('fingerprint') or {})
+    summary['fingerprint'] = stored
+    current = env_fingerprint()
+    mismatch = fingerprint_diff(stored, current)
+    if mismatch:
+        summary['outcome'] = 'stale'
+        summary['mismatch'] = {
+            k: {'stored': stored.get(k), 'current': current.get(k)}
+            for k in mismatch
+        }
+        return _finish_load(summary)
+    from jax.experimental import serialize_executable as _se
+
+    from ..obs.xla import call_key
+
+    by_id = {e.get('id'): e for e in manifest.get('entries', [])}
+    loaded = 0
+    try:
+        for entry_id, fn, spec_args, kwargs in _serving_plans(
+            model, ladder=tuple(ladder), max_actions=max_actions
+        ):
+            entry = by_id.get(entry_id)
+            if entry is None:
+                summary['reason'] = (
+                    f'artifact {entry_id!r} missing from the manifest '
+                    f'(shipped ladder {manifest.get("ladder")}, '
+                    f'max_actions {manifest.get("max_actions")})'
+                )
+                return _finish_load(summary)
+            signature = _plan_signature(fn, spec_args, kwargs)
+            if entry.get('signature') != signature:
+                # exported for a different architecture / static config:
+                # the same staleness class as a fingerprint mismatch
+                summary['outcome'] = 'stale'
+                summary['mismatch'] = {
+                    entry_id: {
+                        'stored': entry.get('signature'),
+                        'current': signature,
+                    }
+                }
+                return _finish_load(summary)
+            blob = _read_artifact(aot_dir, entry)
+            compiled = _se.deserialize_and_load(*pickle.loads(blob))
+            cost = (
+                (entry['cost_flops'], entry['cost_bytes'])
+                if entry.get('cost_flops') is not None
+                else None
+            )
+            key = call_key(spec_args, kwargs, fn._static_names)
+            fn.preload(key, compiled, cost=cost)
+            loaded += 1
+            summary['entries_loaded'] = loaded
+            counter('serve/aot_loads', unit='count').inc(1, outcome='hit')
+    except Exception as e:
+        summary['reason'] = f'{type(e).__name__}: {e}'
+        return _finish_load(summary)
+    summary['outcome'] = 'hit'
+    return _finish_load(summary, count=False)
+
+
+def _finish_load(summary: Dict[str, Any], count: bool = True) -> Dict[str, Any]:
+    """Count the terminal outcome, emit the event, stash the summary.
+
+    ``hit`` outcomes were already counted per artifact (the smoke's
+    "hits ≥ ladder rungs" contract needs per-artifact granularity);
+    ``stale``/``miss`` count once per load attempt. A fully absent
+    ``aot/`` dir does not count a miss — a model-backed service with no
+    registry must not page anyone — but still stashes the summary.
+    """
+    if count and summary['outcome'] in ('stale', 'miss'):
+        counter('serve/aot_loads', unit='count').inc(
+            1, outcome=summary['outcome']
+        )
+    _emit_event('aot_load', **summary)
+    _note_load(summary)
+    return summary
+
+
+# --------------------------------------------------------------------------
+# the persistent compile cache (tier 2)
+# --------------------------------------------------------------------------
+
+_CACHE_LOCK = threading.Lock()
+_CACHE_ENABLED: Optional[str] = None
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at ``path`` (idempotent).
+
+    ``path`` defaults to ``SOCCERACTION_TPU_COMPILE_CACHE``
+    (:func:`socceraction_tpu.config.compile_cache_dir`); with neither
+    set this is a no-op returning ``None`` — the cache stays off, the
+    stock jax behavior. Enabled, every XLA compile is written to (and
+    looked up in) ``path`` with no size/time floor, so a replica whose
+    fingerprint missed the shipped artifacts still warms from the cache
+    a sibling already paid for. Returns the active cache dir.
+    """
+    global _CACHE_ENABLED
+    path = path or compile_cache_dir()
+    if not path:
+        return _CACHE_ENABLED
+    with _CACHE_LOCK:
+        if _CACHE_ENABLED == path:
+            return path
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', path)
+        # replicas share SMALL programs too (the formula kernel, the
+        # low rungs): no entry-size or compile-time floor
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+        _CACHE_ENABLED = path
+    _emit_event('compile_cache_enabled', path=path)
+    return path

@@ -115,11 +115,27 @@ class ModelRegistry:
                 )
         return os.path.join(self.root, name, version)
 
-    def publish(self, name: str, version: str, model: Any) -> str:
+    def publish(
+        self,
+        name: str,
+        version: str,
+        model: Any,
+        *,
+        aot: Optional[Dict[str, Any]] = None,
+    ) -> str:
         """Save a fitted model as ``name``/``version``; returns its path.
 
         Refuses to overwrite an existing version — versions are immutable
         (republish under a new version instead).
+
+        ``aot`` (``{'ladder': (...), 'max_actions': N}``) additionally
+        compiles the model's serving ladder and ships the serialized
+        executables in an ``aot/`` subdirectory of the version
+        (:func:`socceraction_tpu.serve.aot.export_serving_aot`) — a
+        replica whose environment fingerprint matches then warms by
+        deserializing instead of compiling. Export with the shapes
+        replicas serve (``RatingService``'s bucket ladder /
+        ``max_actions``).
         """
         path = self._dir(name, version)
         if os.path.exists(path):
@@ -129,7 +145,74 @@ class ModelRegistry:
             )
         os.makedirs(path)
         model.save_model(path)
+        if aot is not None:
+            self._export_aot_into(model, path, aot)
         return path
+
+    @staticmethod
+    def _export_aot_into(model: Any, path: str, aot: Dict[str, Any]) -> None:
+        """Ship the serving executables inside a version/candidate dir.
+
+        A failed export (non-fusable model, a forced non-fused rating
+        path, an XLA error) removes the just-created directory before
+        re-raising: the immutability guard would otherwise refuse every
+        retry of the same version, stranding a slot the caller can
+        neither complete nor redo. (A *crash* mid-export needs no
+        cleanup — the manifest is written last, so a manifest-less
+        ``aot/`` reads as no-artifacts and the version serves via
+        recompile.)
+        """
+        from .aot import AOT_DIRNAME, export_serving_aot
+
+        try:
+            export_serving_aot(
+                model,
+                os.path.join(path, AOT_DIRNAME),
+                ladder=tuple(aot['ladder']),
+                max_actions=int(aot['max_actions']),
+            )
+        except Exception:
+            shutil.rmtree(path, ignore_errors=True)
+            raise
+
+    def aot_dir(self, name: str, version: str) -> str:
+        """The ``aot/`` artifact directory of ``name``/``version``.
+
+        Purely a path computation — existence (and fingerprint match)
+        is the loader's business: ``RatingService.warmup`` treats an
+        absent directory as the no-artifacts tier.
+        """
+        from .aot import AOT_DIRNAME
+
+        return os.path.join(self._dir(name, version), AOT_DIRNAME)
+
+    def export_aot(
+        self,
+        name: str,
+        version: Optional[str] = None,
+        *,
+        ladder: Any,
+        max_actions: int,
+    ) -> Dict[str, Any]:
+        """Retro-fit AOT artifacts onto an already-published version.
+
+        The backfill path for versions published before AOT shipping
+        (or with different serving shapes): loads the version, compiles
+        its ladder and writes ``aot/`` into the version dir. The
+        artifact set itself is immutable once written (same stance as
+        the checkpoint: re-export into a new version instead). Returns
+        the manifest.
+        """
+        from .aot import export_serving_aot
+
+        version = self.resolve_version(name, version)
+        model = self.load(name, version)
+        return export_serving_aot(
+            model,
+            self.aot_dir(name, version),
+            ladder=tuple(ladder),
+            max_actions=int(max_actions),
+        )
 
     def names(self) -> List[str]:
         """Published model names."""
@@ -388,6 +471,7 @@ class ModelRegistry:
         tag: Optional[str] = None,
         *,
         manifest: Optional[Dict[str, Any]] = None,
+        aot: Optional[Dict[str, Any]] = None,
     ) -> Tuple[str, str]:
         """Save ``model`` as a staged candidate of ``name``; returns
         ``(tag, path)``.
@@ -407,6 +491,12 @@ class ModelRegistry:
         every published version carries the provenance a restarted
         process needs (:meth:`load_manifest`; the drift watch rebuilds
         its reference from it instead of guessing from store recency).
+
+        ``aot`` (``{'ladder': ..., 'max_actions': ...}``) ships the
+        serving executables in the candidate's ``aot/`` subdirectory —
+        it rides :meth:`promote_candidate`'s atomic rename with the
+        checkpoint, so the version a gate promotes already carries the
+        compiled programs and a hot-swapping replica never recompiles.
         """
         if tag is None:
             with self._lock:
@@ -421,6 +511,8 @@ class ModelRegistry:
         if manifest is not None:
             with open(os.path.join(path, 'manifest.json'), 'w') as f:
                 json.dump(manifest, f, sort_keys=True, default=str)
+        if aot is not None:
+            self._export_aot_into(model, path, aot)
         return tag, path
 
     def load_manifest(

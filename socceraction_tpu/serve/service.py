@@ -180,6 +180,14 @@ class RatingService:
         tune one, or ``breaker_failures=0`` to disable degradation
         entirely (dispatch failures then fail their flush's futures, the
         pre-resilience behavior).
+    aot_dir : str, optional
+        An explicit AOT artifact directory (the ``aot/`` layout
+        :func:`socceraction_tpu.serve.aot.export_serving_aot` writes)
+        for model-backed services. Registry-backed services resolve the
+        active version's ``aot/`` directory automatically; this
+        parameter is the escape hatch when the model object arrives
+        without its registry (the cold-start bench child). ``None``
+        (default) with no registry disables the AOT tier.
     debug_dir : str, optional
         Where automatic flight-recorder bundles land
         (:func:`~socceraction_tpu.obs.recorder.dump_debug_bundle` on
@@ -208,6 +216,7 @@ class RatingService:
         breaker: Optional[CircuitBreaker] = None,
         breaker_failures: int = 3,
         breaker_recovery_s: float = 5.0,
+        aot_dir: Optional[str] = None,
         debug_dir: Optional[str] = None,
         overload_dump_threshold: int = 64,
         overload_dump_window_s: float = 10.0,
@@ -287,6 +296,13 @@ class RatingService:
         )
         self._shape_lock = threading.Lock()
         self._seen_shapes: set = set()
+        #: explicit artifact source for model-backed services
+        self._aot_dir_override = aot_dir
+        #: last AOT load summary + the (name, version) it was tried for
+        self._aot_state: Optional[Dict[str, Any]] = None
+        self._aot_tried_for: Optional[Tuple[str, str]] = None
+        #: tier-2 (persistent compile cache) status from the last warmup
+        self._cache_state: Optional[Dict[str, Any]] = None
 
     # -- model plumbing ----------------------------------------------------
 
@@ -366,7 +382,12 @@ class RatingService:
         and without this the first post-swap request would pay its
         compile inside its latency budget (observed ~1s on CPU);
         same-arch targets hit the jit cache and cost a few no-op
-        dispatches.
+        dispatches. When the target version ships AOT artifacts
+        (``aot/``, see :mod:`socceraction_tpu.serve.aot`) they are
+        deserialized first, so even a *different*-architecture swap
+        pre-warms by loading executables instead of compiling — and a
+        corrupt or stale artifact set degrades to the compile loop
+        below, never failing the swap.
         """
         old = self.model
         new = self._registry.load(name, version)
@@ -378,6 +399,7 @@ class RatingService:
                 'swap target changes the feature layout '
                 '(nb_prev_actions/xfns); start a new RatingService for it'
             )
+        self._load_aot_for(name, version, new)
         A = self.max_actions
         for b in self._batcher.ladder:
             self._device_rate(_empty_host_batch(1, A), _empty_gs(1, A), new, b)
@@ -953,6 +975,36 @@ class RatingService:
                 },
             )
 
+    def _aot_block(self) -> Dict[str, Any]:
+        """The ``health()['aot']`` entry: the last AOT-tier load verdict.
+
+        ``available`` is False until a load was attempted (model-backed
+        service without artifacts, or warmup not yet run); afterwards
+        the block carries the outcome (``hit``/``stale``/``miss``),
+        entries loaded, the shipped fingerprint, and — for ``stale`` —
+        the mismatched fingerprint keys an operator needs to see
+        *which* environment axis moved (jaxlib upgrade? different
+        device kind?) without digging through the recorder.
+        """
+        state = self._aot_state
+        if state is None:
+            block: Dict[str, Any] = {'available': False}
+        else:
+            block = {
+                'available': True,
+                'outcome': state.get('outcome'),
+                'entries_loaded': state.get('entries_loaded', 0),
+            }
+            for key in ('model', 'reason', 'mismatch', 'fingerprint'):
+                if state.get(key) is not None:
+                    block[key] = state[key]
+        if self._cache_state is not None:
+            # tier 2's status: dir (None = off/broken) and, when the
+            # configured cache failed to enable, the error — "off by
+            # choice" and "silently inactive" must read differently
+            block['compile_cache'] = dict(self._cache_state)
+        return block
+
     def health(self) -> Dict[str, Any]:
         """Liveness/pressure dict for external pollers (one cheap call).
 
@@ -969,6 +1021,9 @@ class RatingService:
         fused-dispatch breaker also reads ``'degraded'`` — flushes are
         being served through the reference fallback),
         ``flusher_restarts`` (supervised restarts absorbed so far),
+        the ``aot`` block (the shipped-executable tier's last load
+        verdict — outcome, entries, fingerprint; see
+        :mod:`socceraction_tpu.serve.aot`),
         the ``capacity`` block (the live roofline's per-function
         ``perf`` entries — achieved FLOPs/bytes, roofline fraction
         where a device peak is known, device-idle fraction — plus the
@@ -1043,6 +1098,7 @@ class RatingService:
             },
             'ladder': list(self.ladder),
             'compiled_shapes': self.compiled_shapes,
+            'aot': self._aot_block(),
             'capacity': {
                 'perf': perf_snapshot(),
                 'owned_bytes': owned,
@@ -1061,17 +1117,110 @@ class RatingService:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> Tuple[int, ...]:
-        """Compile the bucket ladder up front with all-padding batches.
+    def _aot_source(self, name: str, version: str) -> Optional[str]:
+        """Where this service's shipped executables live, or ``None``."""
+        if self._aot_dir_override is not None:
+            return self._aot_dir_override
+        if self._registry is not None:
+            return self._registry.aot_dir(name, version)
+        return None
 
-        Serving the first real request on a cold shape pays XLA
-        compilation inside its latency budget; warmup moves that cost to
-        startup (and after it, the per-bucket trace counters must stay
-        flat — pinned by the tests and the ``serve_throughput`` bench).
-        Returns the buckets warmed.
+    def _load_aot_for(
+        self, name: str, version: str, model: Any
+    ) -> Optional[Dict[str, Any]]:
+        """Try the AOT tier for one model version; never raises.
+
+        The whole deserialize path — manifest parse, fingerprint check,
+        checksum-verified artifact reads (the ``registry.aot`` fault
+        point + retry site), preloading — lives in
+        :func:`socceraction_tpu.serve.aot.load_serving_aot`, which
+        reports every failure as a counted ``stale``/``miss`` outcome
+        instead of raising. So a corrupt artifact, a moved jaxlib or a
+        foreign device kind can never fail a warmup or a swap: the
+        caller's compile loop runs right after and pays XLA for
+        whatever did not preload.
+        """
+        source = self._aot_source(name, version)
+        if source is None:
+            return None
+        from .aot import load_serving_aot
+
+        state = load_serving_aot(
+            model,
+            source,
+            ladder=self._batcher.ladder,
+            max_actions=self.max_actions,
+            context={'model': f'{name}/{version}'},
+        )
+        self._aot_state = state
+        self._aot_tried_for = (name, version)
+        return state
+
+    def load_aot(self) -> Optional[Dict[str, Any]]:
+        """Deserialize shipped executables for the active model (tier 1).
+
+        The explicit first tier of :meth:`warmup` — callers that meter
+        their cold start phase-by-phase (``bench.py --cold-start``'s
+        ``aot_deserialize`` phase) run it separately; ``warmup()``
+        otherwise runs it implicitly. Returns the load summary
+        (``outcome`` ``hit``/``stale``/``miss`` — see
+        :func:`socceraction_tpu.serve.aot.load_serving_aot`), or
+        ``None`` when the service has no artifact source (model-backed,
+        no ``aot_dir=``). Idempotent per active version.
+        """
+        name, version, model = self._active()
+        if self._aot_tried_for == (name, version):
+            return self._aot_state
+        return self._load_aot_for(name, version, model)
+
+    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> Tuple[int, ...]:
+        """Warm the bucket ladder: deserialize > cached compile > compile.
+
+        Three tiers, best available first (the cold-start ladder the
+        serving runbook is written around):
+
+        1. **shipped AOT executables** — :meth:`load_aot`: when the
+           registry version carries ``aot/`` artifacts and the
+           environment fingerprint matches, every rung's compiled
+           programs deserialize and preload; the warmup dispatches
+           below then execute them without compiling anything.
+        2. **persistent compile cache** — when
+           ``SOCCERACTION_TPU_COMPILE_CACHE`` names a directory
+           (:func:`socceraction_tpu.serve.aot.enable_compile_cache`),
+           rungs that did not preload compile through jax's persistent
+           cache — a warm cache turns XLA compiles into reads.
+        3. **cold compile** — the pre-AOT behavior; serving the first
+           real request on a cold shape would otherwise pay XLA inside
+           its latency budget.
+
+        After warmup the per-bucket trace counters must stay flat
+        regardless of tier (pinned by the tests and the
+        ``serve_throughput`` bench). Returns the buckets warmed.
         """
         buckets = tuple(buckets) if buckets is not None else self._batcher.ladder
-        _name, _version, model = self._active()
+        name, version, model = self._active()
+        from .aot import enable_compile_cache
+
+        try:
+            self._cache_state = {'dir': enable_compile_cache()}
+        except Exception as e:
+            # a broken cache dir must not fail warmup — but a configured
+            # tier silently inactive is the exact failure mode this
+            # module's loud-degradation stance exists for: record it
+            # where the AOT outcomes already live (health()['aot'],
+            # flight recorder) so "cache off by choice" and "cache
+            # broken" are distinguishable
+            self._cache_state = {
+                'dir': None, 'error': f'{type(e).__name__}: {e}'
+            }
+            from ..obs.recorder import RECORDER
+
+            try:
+                RECORDER.record('compile_cache_error', **self._cache_state)
+            except Exception:
+                pass
+        if self._aot_tried_for != (name, version):
+            self._load_aot_for(name, version, model)
         A = self.max_actions
         with span('serve/warmup', buckets=list(buckets)):
             for b in buckets:

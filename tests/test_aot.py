@@ -1,0 +1,570 @@
+"""AOT-serialized serving executables (ISSUE 13).
+
+The instant-scale-out pipeline end to end: export the serving ladder's
+compiled programs into a registry version's ``aot/`` directory, have a
+service warm by deserializing instead of compiling (zero jit compiles
+across warmup AND live traffic, values bit-identical to the compiled
+path), and degrade loudly-but-gracefully — a fingerprint from another
+environment loads via recompile with ``outcome=stale`` counted, a
+corrupt/truncated artifact (or an injected ``registry.aot`` fault) is a
+``miss`` that never fails a warmup or a swap. Plus the import-audit
+satellites: ``import socceraction_tpu`` stays under a committed budget
+touching no heavy module, and the control plane (registry + AOT
+manifest inspection) imports jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.serve import ModelRegistry, RatingService
+from socceraction_tpu.serve.aot import (
+    AOT_DIRNAME,
+    env_fingerprint,
+    export_serving_aot,
+    fingerprint_diff,
+    load_serving_aot,
+    read_manifest,
+)
+from socceraction_tpu.vaep.base import VAEP
+
+LADDER = (1, 2)
+MAX_ACTIONS = 256
+
+pytestmark = pytest.mark.filterwarnings('ignore::DeprecationWarning')
+
+
+def _fit_model(hidden=(8,), seed=0):
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=120)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': 100})
+    np.random.seed(seed)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': list(hidden), 'max_epochs': 2},
+    )
+    return model, frame
+
+
+@pytest.fixture(scope='module')
+def fitted():
+    return _fit_model(hidden=(8,))
+
+
+@pytest.fixture(scope='module', autouse=True)
+def _clean_preloads():
+    """Preloaded executables must not leak into other test modules.
+
+    Functionally harmless (same program, same values), but compile-count
+    pins elsewhere assume the jit path; also retire this module's
+    legitimate export/warmup compiles from the storm windows (same
+    adjacency hazard test_learn documents).
+    """
+    yield
+    from socceraction_tpu.ops import formula as _formula
+    from socceraction_tpu.ops.fused import _pair_probs, _pair_probs_prepared
+
+    for fn in (_pair_probs, _pair_probs_prepared, _formula.vaep_values):
+        fn.clear_preloaded()
+        fn.drain_storm_window()
+
+
+def _publish_with_aot(tmp_path, model, name='aot', version='1'):
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    registry.publish(
+        name, version, model,
+        aot={'ladder': LADDER, 'max_actions': MAX_ACTIONS},
+    )
+    return registry
+
+
+def _aot_load_count(outcome):
+    return int(REGISTRY.snapshot().value('serve/aot_loads', outcome=outcome))
+
+
+# ----------------------------------------------------------- export ----
+
+
+def test_export_writes_manifest_fingerprint_and_checksums(tmp_path, fitted):
+    model, _frame = fitted
+    registry = _publish_with_aot(tmp_path, model)
+    aot_dir = registry.aot_dir('aot', '1')
+    manifest = read_manifest(aot_dir)
+    assert manifest is not None and manifest['format'] == 1
+    assert manifest['ladder'] == list(LADDER)
+    assert manifest['max_actions'] == MAX_ACTIONS
+    # one pair + one formula program per rung
+    ids = {e['id'] for e in manifest['entries']}
+    assert ids == {
+        f'{kind}-b{b}' for kind in ('pair', 'formula') for b in LADDER
+    }
+    # sha256-checksummed like every other registry artifact, and the
+    # export-time cost books ride along for the roofline
+    import hashlib
+
+    for entry in manifest['entries']:
+        with open(os.path.join(aot_dir, entry['file']), 'rb') as f:
+            blob = f.read()
+        assert hashlib.sha256(blob).hexdigest() == entry['sha256']
+        assert entry['nbytes'] == len(blob)
+        assert entry['signature']
+    # the fingerprint covers the compatibility axes the loader gates on
+    fp = manifest['fingerprint']
+    for key in (
+        'jax', 'jaxlib', 'backend', 'device_kind',
+        'platform_profile_sha256', 'rating_path', 'kernel', 'guards',
+        'checkpoint_format',
+    ):
+        assert key in fp, key
+    assert fingerprint_diff(fp, env_fingerprint()) == []
+    # artifacts are immutable: re-export refuses
+    with pytest.raises(ValueError, match='immutable'):
+        export_serving_aot(
+            model, aot_dir, ladder=LADDER, max_actions=MAX_ACTIONS
+        )
+
+
+# -------------------------------------------------- hit: no compiles ----
+
+
+def test_aot_hit_serves_without_compiling(tmp_path):
+    # a DISTINCT architecture so its abstract signatures are fresh in
+    # this process — the zero-compile assertion must not be satisfied by
+    # another test's jit cache
+    model, frame = _fit_model(hidden=(11,), seed=3)
+    registry = _publish_with_aot(tmp_path, model)
+    registry.activate('aot', '1')
+
+    from socceraction_tpu.ops import formula as _formula
+    from socceraction_tpu.ops.fused import pair_dispatch_plan, _abstract_batch
+
+    cols = list(model._label_columns)
+    plan = pair_dispatch_plan(
+        model._models[cols[0]], model._models[cols[1]], _abstract_batch(),
+        names=model._kernel_names(), k=model.nb_prev_actions,
+    )
+    pair_before = plan.fn.n_compiles
+    formula_before = _formula.vaep_values.n_compiles
+    hits_before = _aot_load_count('hit')
+
+    service = RatingService(
+        registry=registry, max_actions=MAX_ACTIONS,
+        max_batch_size=LADDER[-1], max_wait_ms=1.0,
+    )
+    with service:
+        state = service.load_aot()
+        assert state['outcome'] == 'hit'
+        assert state['entries_loaded'] == 2 * len(LADDER)
+        assert _aot_load_count('hit') - hits_before == 2 * len(LADDER)
+        service.warmup()
+        shapes = service.compiled_shapes
+        rated = service.rate_sync(frame, home_team_id=100, timeout=120)
+        # steady state: no new shapes, and — the tentpole — no compiles
+        # anywhere, warmup included: every program deserialized
+        assert service.compiled_shapes == shapes
+        health = service.health()
+    assert plan.fn.n_compiles == pair_before
+    assert _formula.vaep_values.n_compiles == formula_before
+    assert plan.fn.n_preloaded >= len(LADDER)
+
+    # the health surface names the tier's verdict
+    assert health['aot']['available'] is True
+    assert health['aot']['outcome'] == 'hit'
+
+    # served values are the compiled path's values, bit-for-bit
+    reference = model.rate(
+        pd.Series({'game_id': 1, 'home_team_id': 100}), frame
+    )
+    cols3 = ['offensive_value', 'defensive_value', 'vaep_value']
+    np.testing.assert_allclose(
+        rated[cols3].to_numpy(), reference[cols3].to_numpy(), atol=1e-5
+    )
+
+    # the cost books carried through from the manifest: the roofline's
+    # fn_cost lookup works even though no lowering happened here
+    from socceraction_tpu.obs.xla import fn_cost
+
+    assert fn_cost(plan.fn.name) is not None
+
+
+# ------------------------------------------- stale: loud + graceful ----
+
+
+def test_fingerprint_staleness_recompiles_and_counts(tmp_path):
+    model, frame = _fit_model(hidden=(9,), seed=5)
+    registry = _publish_with_aot(tmp_path, model)
+    registry.activate('aot', '1')
+    aot_dir = registry.aot_dir('aot', '1')
+
+    # doctor the shipped fingerprint: a different jaxlib + device kind,
+    # as if the artifacts were built on another machine image
+    manifest_path = os.path.join(aot_dir, 'manifest.json')
+    with open(manifest_path, encoding='utf-8') as f:
+        manifest = json.load(f)
+    manifest['fingerprint']['jaxlib'] = '0.0.1-elsewhere'
+    manifest['fingerprint']['device_kind'] = 'TPU v9'
+    with open(manifest_path, 'w', encoding='utf-8') as f:
+        json.dump(manifest, f)
+
+    stale_before = _aot_load_count('stale')
+    service = RatingService(
+        registry=registry, max_actions=MAX_ACTIONS,
+        max_batch_size=LADDER[-1], max_wait_ms=1.0,
+    )
+    with service:
+        state = service.load_aot()
+        assert state['outcome'] == 'stale'
+        assert set(state['mismatch']) == {'jaxlib', 'device_kind'}
+        assert state['entries_loaded'] == 0
+        assert _aot_load_count('stale') == stale_before + 1
+        # degrades to recompile: warmup + serving still work, and the
+        # values are the compiled path's (nothing half-loaded serves)
+        service.warmup()
+        rated = service.rate_sync(frame, home_team_id=100, timeout=120)
+        health = service.health()
+    assert health['aot']['outcome'] == 'stale'
+    assert health['aot']['mismatch']['jaxlib']['stored'] == '0.0.1-elsewhere'
+    reference = model.rate(
+        pd.Series({'game_id': 1, 'home_team_id': 100}), frame
+    )
+    cols3 = ['offensive_value', 'defensive_value', 'vaep_value']
+    np.testing.assert_allclose(
+        rated[cols3].to_numpy(), reference[cols3].to_numpy(), atol=1e-5
+    )
+
+
+def test_architecture_mismatch_is_stale_not_wrong_program(tmp_path):
+    """Artifacts exported for one architecture must never preload for
+    another: the per-entry abstract-signature guard reports stale."""
+    exported, _ = _fit_model(hidden=(7,), seed=1)
+    serving, _frame = _fit_model(hidden=(13,), seed=2)
+    aot_dir = str(tmp_path / AOT_DIRNAME)
+    export_serving_aot(
+        exported, aot_dir, ladder=LADDER, max_actions=MAX_ACTIONS
+    )
+    state = load_serving_aot(
+        serving, aot_dir, ladder=LADDER, max_actions=MAX_ACTIONS
+    )
+    assert state['outcome'] == 'stale'
+    assert state['entries_loaded'] == 0
+    assert 'pair-b1' in state['mismatch']
+
+
+# ------------------------------------------------- miss: corruption ----
+
+
+def test_corrupt_artifact_is_named_miss_and_never_fails_swap(tmp_path):
+    model, frame = _fit_model(hidden=(10,), seed=7)
+    registry = _publish_with_aot(tmp_path, model)
+    registry.activate('aot', '1')
+    aot_dir = registry.aot_dir('aot', '1')
+
+    # truncate one executable: checksum verification must name it
+    victim = os.path.join(aot_dir, f'pair-b{LADDER[0]}.jaxexec')
+    with open(victim, 'r+b') as f:
+        f.truncate(32)
+
+    miss_before = _aot_load_count('miss')
+    service = RatingService(
+        registry=registry, max_actions=MAX_ACTIONS,
+        max_batch_size=LADDER[-1], max_wait_ms=1.0,
+    )
+    with service:
+        state = service.load_aot()
+        assert state['outcome'] == 'miss'
+        assert 'pair-b1.jaxexec' in state['reason']
+        assert 'corrupt' in state['reason']
+        assert _aot_load_count('miss') == miss_before + 1
+        service.warmup()  # recompiles; never raises
+        service.rate_sync(frame, home_team_id=100, timeout=120)
+
+    # the swap path shares the fallback: publish a v2 with equally
+    # corrupt artifacts — the swap must succeed via recompile
+    registry.publish(
+        'aot', '2', model, aot={'ladder': LADDER, 'max_actions': MAX_ACTIONS}
+    )
+    v2_manifest = os.path.join(registry.aot_dir('aot', '2'), 'manifest.json')
+    with open(v2_manifest, 'w', encoding='utf-8') as f:
+        f.write('{ torn json')
+    service2 = RatingService(
+        registry=registry, max_actions=MAX_ACTIONS,
+        max_batch_size=LADDER[-1], max_wait_ms=1.0,
+    )
+    with service2:
+        assert service2.swap_model('aot', '2') == ('aot', '2')
+        assert service2.health()['aot']['outcome'] == 'miss'
+        service2.rate_sync(frame, home_team_id=100, timeout=120)
+
+
+def test_registry_aot_fault_point_is_retried_then_falls_back(tmp_path):
+    """``registry.aot`` is a named fault point inside the retried read:
+    a transient injected error is retried to success; an exhausted
+    budget falls back to recompile as a miss — never an exception."""
+    from socceraction_tpu.resil.faults import FaultPlan, FaultSpec
+
+    model, _frame = _fit_model(hidden=(6,), seed=9)
+    aot_dir = str(tmp_path / AOT_DIRNAME)
+    export_serving_aot(
+        model, aot_dir, ladder=LADDER, max_actions=MAX_ACTIONS
+    )
+
+    # nth=1: the first artifact read fails once, the retry succeeds
+    with FaultPlan(
+        seed=3, specs=[FaultSpec('registry.aot', error=OSError, nth=1)]
+    ) as plan:
+        state = load_serving_aot(
+            model, aot_dir, ladder=LADDER, max_actions=MAX_ACTIONS
+        )
+    assert state['outcome'] == 'hit'
+    assert [h['point'] for h in plan.history] == ['registry.aot']
+
+    # every read failing permanently exhausts the retry budget -> miss
+    from socceraction_tpu.ops.fused import _pair_probs
+
+    _pair_probs.clear_preloaded()
+    with FaultPlan(
+        seed=4,
+        specs=[FaultSpec('registry.aot', error=OSError, probability=1.0)],
+    ):
+        state = load_serving_aot(
+            model, aot_dir, ladder=LADDER, max_actions=MAX_ACTIONS
+        )
+    assert state['outcome'] == 'miss'
+    assert 'OSError' in state['reason']
+
+
+# ------------------------------------------ registry + learn surface ----
+
+
+def test_stage_candidate_aot_rides_the_atomic_promotion(tmp_path, fitted):
+    model, _frame = fitted
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    tag, path = registry.stage_candidate(
+        'learned', model,
+        aot={'ladder': LADDER, 'max_actions': MAX_ACTIONS},
+    )
+    assert read_manifest(os.path.join(path, AOT_DIRNAME)) is not None
+    registry.promote_candidate('learned', '1', tag)
+    # the artifacts rode the rename: the published version ships them
+    manifest = read_manifest(registry.aot_dir('learned', '1'))
+    assert manifest is not None
+    assert manifest['ladder'] == list(LADDER)
+
+
+def test_failed_aot_export_leaves_publish_retryable(tmp_path, fitted,
+                                                    monkeypatch):
+    """An export failure inside ``publish(aot=...)`` must not strand an
+    immutable version dir the caller can neither complete nor redo —
+    the just-created directory is removed before the error surfaces,
+    and a corrected publish of the SAME version succeeds."""
+    model, _frame = fitted
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    # force the non-fused rating path: the exporter refuses loudly
+    monkeypatch.setenv('SOCCERACTION_TPU_RATING_PATH', 'materialized')
+    with pytest.raises(ValueError, match='fused serving path'):
+        registry.publish(
+            'retry', '1', model,
+            aot={'ladder': LADDER, 'max_actions': MAX_ACTIONS},
+        )
+    assert registry.versions('retry') == []
+    monkeypatch.delenv('SOCCERACTION_TPU_RATING_PATH')
+    registry.publish(
+        'retry', '1', model,
+        aot={'ladder': LADDER, 'max_actions': MAX_ACTIONS},
+    )
+    assert registry.versions('retry') == ['1']
+    assert read_manifest(registry.aot_dir('retry', '1')) is not None
+
+
+def test_non_standard_models_are_refused_at_export(tmp_path):
+    """The exporter's plans are the standard family's: a model from
+    another fused registry (atomic) must fail loudly at export time
+    instead of shipping programs whose keys can never match a live
+    dispatch. The guard fires before anything else touches the model."""
+
+    class _AtomicLike:
+        _fused_registry = 'atomic'
+
+    with pytest.raises(ValueError, match='standard-SPADL'):
+        export_serving_aot(
+            _AtomicLike(), str(tmp_path / AOT_DIRNAME),
+            ladder=LADDER, max_actions=MAX_ACTIONS,
+        )
+
+
+def test_learn_config_carries_aot_spec():
+    from socceraction_tpu.learn.loop import LearnConfig
+
+    cfg = LearnConfig(aot={'ladder': (1, 2), 'max_actions': 128})
+    assert cfg.aot == {'ladder': (1, 2), 'max_actions': 128}
+    assert LearnConfig().aot is None
+
+
+# ------------------------------------------------------ import audit ----
+
+
+def test_package_import_stays_light_and_heavy_free():
+    """The import-time budget pin: ``import socceraction_tpu`` touches
+    no jax module (extending the existing jax-free pins with pandas and
+    numpy) and stays under a committed wall budget, so the cold-start
+    bill's import phase cannot regress silently at the package layer.
+    ``SOCCERACTION_TPU_IMPORT_BUDGET_S`` loosens the budget for
+    pathological CI filesystems."""
+    code = (
+        'import os, sys, time\n'
+        't0 = time.perf_counter()\n'
+        'import socceraction_tpu\n'
+        'wall = time.perf_counter() - t0\n'
+        "bad = [m for m in ('jax', 'jaxlib', 'pandas', 'numpy', 'flax')\n"
+        '       if m in sys.modules]\n'
+        "assert not bad, f'heavy modules leaked into package import: {bad}'\n"
+        "budget = float(os.environ.get('SOCCERACTION_TPU_IMPORT_BUDGET_S', '2.5'))\n"
+        "assert wall < budget, (\n"
+        "    f'import socceraction_tpu took {wall:.3f}s, budget {budget}s'\n"
+        ')\n'
+        'print(f"{wall:.4f}")\n'
+    )
+    proc = subprocess.run(
+        [sys.executable, '-c', code], capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert float(proc.stdout.strip()) < 2.5
+
+
+def test_control_plane_imports_are_jax_free(tmp_path, fitted):
+    """Registry listing + AOT manifest/fingerprint inspection — the
+    control-plane half of the cold-start bill — must never pull jax or
+    pandas: the serve package resolves submodules lazily and
+    ``read_manifest`` is stdlib-only."""
+    model, _frame = fitted
+    registry = _publish_with_aot(tmp_path, model)
+    aot_dir = registry.aot_dir('aot', '1')
+    code = (
+        'import sys\n'
+        'from socceraction_tpu.serve import ModelRegistry\n'
+        'from socceraction_tpu.serve.aot import read_manifest\n'
+        f'registry = ModelRegistry({str(tmp_path / "registry")!r})\n'
+        "assert registry.versions('aot') == ['1']\n"
+        f'manifest = read_manifest({aot_dir!r})\n'
+        "assert manifest['ladder'] == [1, 2]\n"
+        "assert 'jaxlib' in manifest['fingerprint']\n"
+        "bad = [m for m in ('jax', 'jaxlib', 'pandas', 'flax')\n"
+        '       if m in sys.modules]\n'
+        "assert not bad, f'heavy modules leaked: {bad}'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, '-c', code], capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+# ------------------------------------------------- obsctl + benchdiff ----
+
+
+def test_obsctl_capacity_renders_aot_tier(tmp_path, fitted, capsys):
+    """The AOT tier (hit|stale|miss counts + last fingerprint) renders
+    next to the cold-start timeline, live and from a run log, and the
+    ``--json`` form round-trips."""
+    import contextlib
+    import io
+
+    from socceraction_tpu.obs import RunLog
+    from tools.obsctl import main as obsctl_main
+
+    model, frame = fitted
+    registry = _publish_with_aot(tmp_path, model, name='obs', version='1')
+    registry.activate('obs', '1')
+    runlog = str(tmp_path / 'obs.jsonl')
+    with RunLog(runlog, config={'probe': 'aot'}):
+        service = RatingService(
+            registry=registry, max_actions=MAX_ACTIONS,
+            max_batch_size=LADDER[-1], max_wait_ms=1.0,
+        )
+        with service:
+            assert service.load_aot()['outcome'] == 'hit'
+            service.warmup()
+            service.rate_sync(frame, home_team_id=100, timeout=120)
+
+    for argv, source in (
+        (['capacity', runlog, '--json'], 'runlog'),
+        (['capacity', '--json'], 'live'),
+    ):
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = obsctl_main(argv)
+        assert rc == 0, source
+        summary = json.loads(out.getvalue())
+        aot = summary.get('aot') or {}
+        assert int((aot.get('loads') or {}).get('hit', 0)) >= 2 * len(LADDER), (
+            source,
+            aot,
+        )
+        last = aot.get('last') or {}
+        assert last.get('outcome') == 'hit', (source, last)
+        assert 'jaxlib' in (last.get('fingerprint') or {}), (source, last)
+
+    # human rendering names the tier
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        assert obsctl_main(['capacity', runlog]) == 0
+    text = out.getvalue()
+    assert 'aot' in text and 'hit' in text and 'fingerprint' in text
+
+
+def test_benchdiff_cold_start_diffs_per_phase():
+    """A cold-start regression names the phase that moved — and the
+    wall verdict (not the phase diagnosis) owns the exit code."""
+    from tools.benchdiff import compare_artifacts
+
+    old = {
+        'metric': 'cold_start_seconds', 'platform': 'cpu', 'value': 10.0,
+        'phase_seconds': {
+            'import': 5.7, 'registry_load': 1.3, 'device_upload': 0.02,
+            'aot_deserialize': 0.0, 'ladder_compile': 3.1,
+            'first_dispatch': 0.15,
+        },
+    }
+    new = {
+        **old, 'value': 13.2,
+        'phase_seconds': {
+            **old['phase_seconds'], 'ladder_compile': 6.3,
+            'aot_deserialize': 0.2,
+        },
+    }
+    res = compare_artifacts(old, new)
+    by_phase = {p['phase']: p for p in res['phases']}
+    assert by_phase['ladder_compile']['verdict'] == 'regression'
+    assert by_phase['import']['verdict'] == 'ok'
+    # a phase growing from exactly 0 has no ratio: reported as appeared
+    assert by_phase['aot_deserialize']['verdict'] == 'appeared'
+    # sub-jitter phases are not diffed (0.02s wiggle is noise)
+    assert 'device_upload' not in by_phase
+    # the wall regressed too — that is what flips the exit code
+    assert res['regressions'] == 1
+    assert res['verdicts'][0]['verdict'] == 'regression'
+
+    # per-phase improvements render for the AOT family metrics as well
+    aot_old = {
+        'metric': 'cold_start_aot_seconds', 'platform': 'cpu', 'value': 7.0,
+        'phase_seconds': {'ladder_compile': 3.0},
+    }
+    aot_new = {
+        'metric': 'cold_start_aot_seconds', 'platform': 'cpu', 'value': 6.0,
+        'phase_seconds': {'ladder_compile': 0.1},
+    }
+    res = compare_artifacts(aot_old, aot_new)
+    assert res['phases'][0]['verdict'] == 'improvement'
+    assert res['verdicts'][0]['verdict'] == 'improvement'

@@ -559,11 +559,19 @@ def test_benchdiff_serve_roofline_headline_compared():
 
 
 def test_bench_cold_start_phase_contract():
-    """The ledger breakdown contract: bench's phase tuple is the five
-    startup phases the acceptance criteria name, in startup order."""
+    """The ledger breakdown contract: bench's phase tuple is the six
+    startup phases the acceptance criteria name, in startup order —
+    ``aot_deserialize`` became first-class with the AOT serving
+    pipeline (ISSUE 13), present (≈0) even on a cold start so
+    per-phase trajectories stay comparable across tiers."""
     import bench
 
     assert bench.COLD_START_PHASES == (
-        'import', 'registry_load', 'device_upload', 'ladder_compile',
-        'first_dispatch',
+        'import', 'registry_load', 'device_upload', 'aot_deserialize',
+        'ladder_compile', 'first_dispatch',
     )
+    assert bench.COLD_START_TIER_METRICS == {
+        'cold': 'cold_start_seconds',
+        'cache': 'cold_start_cache_hit_seconds',
+        'aot': 'cold_start_aot_seconds',
+    }

@@ -23,7 +23,10 @@ more than ``--threshold`` (default 10%) below the old, ``improvement``
 when it rose past the same band, ``ok`` between. Artifacts measured on
 different platforms or with different headline metrics are refused as
 ``incomparable`` (comparing a TPU run against its CPU fallback would
-manufacture a regression).
+manufacture a regression). Cold-start artifacts additionally diff
+**per phase** (import / registry_load / device_upload /
+aot_deserialize / ladder_compile / first_dispatch): the wall verdict
+gates, the phase verdicts name which startup phase moved.
 
 Exit codes: 0 all ok/improved, 1 at least one regression, 2 unusable
 input. Wired as ``make bench-diff``; dependency-free (stdlib only).
@@ -63,8 +66,29 @@ HEADLINE_KEYS: Tuple[str, ...] = (
 #: wherever they appear.
 LOWER_IS_BETTER: Tuple[str, ...] = (
     'cold_start_seconds',
+    'cold_start_cache_hit_seconds',
+    'cold_start_aot_seconds',
     'vaep_quant_table_bytes',
 )
+
+#: Wall-breakdown metrics (the cold-start family): when BOTH artifacts
+#: carry a ``phase_seconds`` dict, each shared phase gets its own
+#: lower-is-better verdict — a cold-start regression then NAMES the
+#: phase that moved (import? ladder_compile? aot_deserialize?) instead
+#: of reporting an opaque wall. Phase verdicts use a floor
+#: (PHASE_MIN_SECONDS) so a 0.01s→0.02s jitter on a near-zero phase
+#: cannot page anyone, and they never count toward the exit-code
+#: regression tally on their own when the wall stayed inside the band —
+#: they are the diagnosis, the wall is the gate.
+PHASE_BREAKDOWN_METRICS: Tuple[str, ...] = (
+    'cold_start_seconds',
+    'cold_start_cache_hit_seconds',
+    'cold_start_aot_seconds',
+)
+
+#: phases below this wall (in BOTH artifacts) are skipped in the
+#: per-phase diff: ratios over hundredths of a second are noise
+PHASE_MIN_SECONDS = 0.1
 
 
 def default_ledger() -> str:
@@ -168,7 +192,53 @@ def compare_artifacts(
                 'verdict': verdict,
             }
         )
+    result['phases'] = _phase_verdicts(old, new, threshold)
     return result
+
+
+def _phase_verdicts(
+    old: Dict[str, Any], new: Dict[str, Any], threshold: float
+) -> List[Dict[str, Any]]:
+    """Per-phase wall verdicts for the cold-start family (see
+    PHASE_BREAKDOWN_METRICS): the diagnosis layer under the wall
+    verdict, naming WHICH startup phase moved."""
+    if new.get('metric') not in PHASE_BREAKDOWN_METRICS:
+        return []
+    old_phases = old.get('phase_seconds')
+    new_phases = new.get('phase_seconds')
+    if not isinstance(old_phases, dict) or not isinstance(new_phases, dict):
+        return []
+    verdicts: List[Dict[str, Any]] = []
+    for phase in sorted(set(old_phases) & set(new_phases)):
+        a, b = old_phases.get(phase), new_phases.get(phase)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        if max(a, b) < PHASE_MIN_SECONDS:
+            continue  # sub-jitter phase: a ratio here is noise
+        if a <= 0:
+            # a phase that appeared from ~0 (aot_deserialize landing, a
+            # new compile step) has no ratio; report it without a verdict
+            verdicts.append(
+                {'phase': phase, 'old': a, 'new': b, 'verdict': 'appeared'}
+            )
+            continue
+        ratio = b / a
+        if ratio > 1.0 + threshold:
+            verdict = 'regression'
+        elif ratio < 1.0 - threshold:
+            verdict = 'improvement'
+        else:
+            verdict = 'ok'
+        verdicts.append(
+            {
+                'phase': phase,
+                'old': a,
+                'new': b,
+                'ratio': round(ratio, 4),
+                'verdict': verdict,
+            }
+        )
+    return verdicts
 
 
 def _render(result: Dict[str, Any]) -> None:
@@ -181,6 +251,14 @@ def _render(result: Dict[str, Any]) -> None:
             f'  {v["verdict"].upper().ljust(11)} {v["rate"]}: '
             f'{v["old"]:g} -> {v["new"]:g} (x{v["ratio"]:.3f})'
         )
+    for p in result.get('phases', []):
+        line = (
+            f'    phase {p["verdict"].upper().ljust(11)} {p["phase"]}: '
+            f'{p["old"]:g}s -> {p["new"]:g}s'
+        )
+        if 'ratio' in p:
+            line += f' (x{p["ratio"]:.3f})'
+        print(line)
     print(
         f'benchdiff: {len(result["verdicts"])} rate(s), '
         f'{result["regressions"]} regression(s), '

@@ -26,16 +26,25 @@ assert the observatory measured it:
 - ``obsctl capacity`` round-trips BOTH ways: over the closed run log's
   embedded snapshot, and live in-process (census included).
 
-**Cold half** — re-exec ``bench.py --cold-start`` (itself a clean-CPU
-subprocess re-exec measuring process start → first rated action) with
-the ledger redirected to a scratch file, and assert the artifact
-contract: every startup phase present (import / registry_load /
-device_upload / ladder_compile / first_dispatch) and the phase sum
-bounded by the measured wall.
+**Cold half** — re-exec ``bench.py --cold-start`` (the cold vs
+cache-hit vs AOT-shipped matrix of clean-CPU children) with the ledger
+redirected to a scratch file, and assert the artifact contract: one
+ledger entry per tier, every startup phase present (import /
+registry_load / device_upload / aot_deserialize / ladder_compile /
+first_dispatch), each phase sum bounded by its wall, and the AOT tier's
+wall strictly below the cold one.
+
+The matrix's AOT tier *is* the ISSUE 13 CI leg — the bench publishes
+the registry version with serialized executables and re-execs a clean
+child against it — so its contract is asserted here off the ledger
+entry that child wrote: ``ladder_compile ≈ 0`` and
+``serve/aot_loads{outcome="hit"}`` ≥ the ladder rung count (the child
+reports its counter into the artifact as ``aot_hits``), with no extra
+child re-exec of our own.
 
 Exit 0 on success; any violated invariant is a non-zero exit with the
-evidence printed. CPU-sized (the cold half re-execs two clean Python
-processes, so this is tens of seconds, not seconds).
+evidence printed. CPU-sized, but the cold half re-execs several clean
+Python processes — minutes, not seconds.
 """
 
 from __future__ import annotations
@@ -176,7 +185,7 @@ def _warm_half(problems: list) -> None:
 
 
 def _cold_half(problems: list) -> None:
-    from bench import COLD_START_PHASES
+    from bench import COLD_START_PHASES, COLD_START_TIER_METRICS
 
     with tempfile.TemporaryDirectory(prefix='capacity-smoke-cold-') as tmp:
         ledger = os.path.join(tmp, 'ledger.jsonl')
@@ -184,16 +193,30 @@ def _cold_half(problems: list) -> None:
         # the env var names the ledger DIRECTORY; bench writes
         # <dir>/ledger.jsonl inside it
         env['SOCCERACTION_TPU_BENCH_HISTORY'] = tmp
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, 'bench.py'), '--cold-start'],
-            env=env,
-            cwd=REPO,
-            capture_output=True,
-            text=True,
-            timeout=float(os.environ.get(
-                'SOCCERACTION_TPU_COLDSTART_DEADLINE', 300
-            )),
-        )
+        # SOCCERACTION_TPU_COLDSTART_DEADLINE is bench's PER-CHILD
+        # budget; the matrix runs four children plus the parent's fit +
+        # AOT export, so the outer timeout scales from it instead of
+        # reusing it verbatim (which would kill a healthy matrix whose
+        # children are each inside budget)
+        per_child = float(os.environ.get(
+            'SOCCERACTION_TPU_COLDSTART_DEADLINE', 300
+        ))
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, 'bench.py'),
+                    '--cold-start',
+                ],
+                env=env,
+                cwd=REPO,
+                capture_output=True,
+                text=True,
+                timeout=4 * per_child + 240,
+            )
+        except subprocess.TimeoutExpired as e:
+            problems.append(f'bench.py --cold-start timed out: {e}')
+            return
         if proc.returncode != 0:
             problems.append(
                 f'bench.py --cold-start exited {proc.returncode}: '
@@ -205,21 +228,64 @@ def _cold_half(problems: list) -> None:
             return
         with open(ledger, encoding='utf-8') as f:
             entries = [json.loads(line) for line in f if line.strip()]
-        entry = next(
-            (e for e in entries if e.get('metric') == 'cold_start_seconds'),
-            None,
-        )
-        if entry is None:
-            problems.append(f'no cold_start_seconds entry in {entries}')
-            return
-        missing = set(COLD_START_PHASES) - set(entry.get('phase_seconds', {}))
-        if missing:
-            problems.append(f'cold-start phases missing from ledger: {missing}')
-        if entry['phase_total_s'] > entry['value'] + 1e-6:
-            problems.append(
-                f'cold-start phase sum {entry["phase_total_s"]}s exceeds '
-                f'the measured wall {entry["value"]}s'
+        # the full matrix lands: one ledger entry per warm tier, each
+        # with the complete phase breakdown bounded by its wall
+        by_metric = {e.get('metric'): e for e in entries}
+        for tier, metric in COLD_START_TIER_METRICS.items():
+            entry = by_metric.get(metric)
+            if entry is None:
+                problems.append(
+                    f'no {metric} ledger entry (tier {tier}) in '
+                    f'{sorted(by_metric)}'
+                )
+                continue
+            missing = set(COLD_START_PHASES) - set(
+                entry.get('phase_seconds', {})
             )
+            if missing:
+                problems.append(
+                    f'[{tier}] cold-start phases missing from ledger: '
+                    f'{missing}'
+                )
+            if entry['phase_total_s'] > entry['value'] + 1e-6:
+                problems.append(
+                    f'[{tier}] phase sum {entry["phase_total_s"]}s exceeds '
+                    f'the measured wall {entry["value"]}s'
+                )
+        cold = by_metric.get('cold_start_seconds')
+        aot = by_metric.get('cold_start_aot_seconds')
+        if cold and aot:
+            if aot['value'] >= cold['value']:
+                problems.append(
+                    f'AOT-shipped wall {aot["value"]}s not below the '
+                    f'cold wall {cold["value"]}s'
+                )
+            # the ISSUE 13 AOT leg, read off the ledger the matrix's
+            # published-with-artifacts clean child just wrote (no extra
+            # child re-exec): the executables deserialized
+            # (outcome=hit), every rung's programs were hit-counted
+            # (serve/aot_loads{outcome=hit} ≥ ladder rungs — the child
+            # reports its counter into the artifact), and the ladder
+            # compile collapsed to ≈ 0
+            if (aot.get('aot') or {}).get('outcome') != 'hit':
+                problems.append(
+                    f'AOT tier did not deserialize: {aot.get("aot")}'
+                )
+            ladder_rungs = 3  # bench's matrix exports ladder (1, 2, 4)
+            if int(aot.get('aot_hits', 0)) < ladder_rungs:
+                problems.append(
+                    f'aot_loads{{outcome=hit}} = {aot.get("aot_hits")} < '
+                    f'ladder rung count {ladder_rungs}'
+                )
+            ladder_compile = (
+                aot.get('phase_seconds', {}).get('ladder_compile')
+            )
+            if ladder_compile is None or ladder_compile > 0.5:
+                problems.append(
+                    f'AOT tier ladder_compile = {ladder_compile}s, '
+                    'expected ~0 (deserialized executables must cover '
+                    'the ladder)'
+                )
 
 
 def main() -> int:
@@ -232,7 +298,10 @@ def main() -> int:
         for p in problems:
             print(f'capacity-smoke: FAIL - {p}')
         return 1
-    print('capacity-smoke: OK - roofline + residency + cold-start verified')
+    print(
+        'capacity-smoke: OK - roofline + residency + cold-start matrix '
+        '+ AOT deserialize verified'
+    )
     return 0
 
 

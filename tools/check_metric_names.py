@@ -140,7 +140,9 @@ KNOWN_LABELS = {
     'perf': {'fn', 'bucket'},
     'pipeline': {'stage'},
     'resil': {'point', 'kind', 'site', 'outcome'},
-    'serve': {'reason', 'kind', 'bucket', 'segment'},
+    # serve: ``outcome`` is the AOT-tier load verdict (hit|stale|miss,
+    # serve/aot_loads — serve/aot.py's three-valued contract)
+    'serve': {'reason', 'kind', 'bucket', 'segment', 'outcome'},
     'slo': {'objective', 'outcome', 'window'},
     'train': {'path', 'platform'},
     'vaep': {'path', 'platform'},

@@ -751,10 +751,35 @@ def _capacity_summary(snapshot: Dict[str, Any]) -> Dict[str, Any]:
         (s.get('labels') or {}).get('owner', '?'): s.get('last')
         for s in series('mem/owned_bytes')
     }
+    aot_counts = {
+        (s.get('labels') or {}).get('outcome', '?'): int(s.get('total') or 0)
+        for s in series('serve/aot_loads')
+    }
     return {
         'perf': [rows[k] for k in sorted(rows, key=str)],
         'owned_bytes': dict(sorted(owners.items())),
+        'aot': {'loads': dict(sorted(aot_counts.items()))},
     }
+
+
+def _aot_from_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The last ``aot_load`` event's verdict (the fingerprint half of
+    the AOT tier — counters say how often, the event says under which
+    environment and why)."""
+    last = None
+    for e in events:
+        if (e.get('event') or e.get('kind')) == 'aot_load':
+            last = e
+    if last is None:
+        return {}
+    out = {
+        'outcome': last.get('outcome'),
+        'entries_loaded': last.get('entries_loaded'),
+    }
+    for key in ('model', 'reason', 'mismatch', 'fingerprint'):
+        if last.get(key) is not None:
+            out[key] = last[key]
+    return out
 
 
 def _coldstart_from_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -843,37 +868,77 @@ def _print_capacity(summary: Dict[str, Any], source: str) -> None:
             if coldstart.get('unattributed_s') is not None:
                 line += f' (unattributed {coldstart["unattributed_s"]:.2f}s)'
             print(line)
+    aot = summary.get('aot') or {}
+    loads = dict(aot.get('loads') or {})
+    last = aot.get('last') or {}
+    if loads or last:
+        counts = ' '.join(
+            f'{k}={loads.get(k, 0)}' for k in ('hit', 'stale', 'miss')
+        )
+        line = f'aot       : loads {counts}'
+        if last.get('outcome'):
+            line += f', last {last["outcome"]}'
+            if last.get('model'):
+                line += f' ({last["model"]})'
+        print(line)
+        fp = last.get('fingerprint') or {}
+        if fp:
+            print(
+                'aot       : fingerprint '
+                + ' '.join(
+                    f'{k}={fp[k]}'
+                    for k in ('jax', 'jaxlib', 'backend', 'device_kind')
+                    if k in fp
+                )
+            )
+        for key, entry in sorted((last.get('mismatch') or {}).items()):
+            print(
+                f'aot       : STALE {key}: shipped '
+                f'{entry.get("stored")!r} vs running {entry.get("current")!r}'
+            )
     n_rows = len(summary.get('perf', [])) + len(owners)
     print(f'obsctl capacity: {n_rows} row(s) from {source}')
 
 
 def _cmd_capacity(args: argparse.Namespace) -> int:
-    """``capacity [runlog]``: roofline + residency + cold-start timeline.
+    """``capacity [runlog]``: roofline + residency + cold-start + AOT.
 
-    With a run log: the last embedded snapshot's ``perf/*`` and
-    ``mem/owned_bytes`` series plus a timeline reconstructed from the
-    log's ``coldstart_phase``/``coldstart_mark`` events. Live (no
-    argument): the typed ``perf_snapshot()`` / ``residency_report()``
-    (census reconciliation included — the live-buffer walk is this
-    command's cost, on demand) / ``coldstart_report()``.
+    With a run log: the last embedded snapshot's ``perf/*``,
+    ``mem/owned_bytes`` and ``serve/aot_loads`` series plus a timeline
+    reconstructed from the log's ``coldstart_phase``/``coldstart_mark``
+    events and the last ``aot_load`` event's verdict (outcome, shipped
+    fingerprint, staleness diff). Live (no argument): the typed
+    ``perf_snapshot()`` / ``residency_report()`` (census reconciliation
+    included — the live-buffer walk is this command's cost, on demand)
+    / ``coldstart_report()`` / ``serve.aot.last_aot_load()``.
     """
     if args.runlog:
         events = _read_events(args.runlog)
         snapshot = _last_snapshot(events) or {}
         summary = _capacity_summary(snapshot)
         summary['coldstart'] = _coldstart_from_events(events)
+        summary.setdefault('aot', {})['last'] = _aot_from_events(events)
         source = args.runlog
     else:
+        from socceraction_tpu.obs import REGISTRY
         from socceraction_tpu.obs.coldstart import coldstart_report
         from socceraction_tpu.obs.perf import perf_snapshot
         from socceraction_tpu.obs.residency import residency_report
+        from socceraction_tpu.serve.aot import last_aot_load
 
         residency = residency_report(top=5)
+        snap = REGISTRY.snapshot()
+        aot_series = snap.get('serve/aot_loads')
+        loads = {
+            s.labels.get('outcome', '?'): int(s.total)
+            for s in (aot_series.series if aot_series is not None else ())
+        }
         summary = {
             'perf': list(perf_snapshot().values()),
             'owned_bytes': residency['owners'],
             'residency': residency,
             'coldstart': coldstart_report(),
+            'aot': {'loads': loads, 'last': last_aot_load() or {}},
         }
         source = 'live registry'
     if args.json:
