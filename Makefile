@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke capacity-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke capacity-smoke fleet-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -54,6 +54,20 @@ chaos-smoke:
 # with serve/aot_loads{outcome=hit} >= the ladder rung count)
 capacity-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/capacity_smoke.py
+
+# the cross-process telemetry plane, driven end to end on CPU:
+# tools/fleet_smoke.py spawns 4 REAL replica processes serving traffic
+# behind telemetry endpoints, scrapes them through a FleetAggregator and
+# asserts merged counters equal the per-replica sums exactly, the
+# mesh-wide SLO burn evaluates over the merged snapshot, a killed
+# replica reads stale within one scrape interval (kept in the sums,
+# never a silent hole), and `obsctl trace` stitches one request across
+# two processes' run logs; then bench.py --fleet-smoke measures the
+# plane's own scrape+merge wall at 1/4/16 replicas into the ledger
+# (fleet_scrape_seconds / fleet_merge_seconds, lower-is-better)
+fleet-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
+	env JAX_PLATFORMS=cpu $(PY) bench.py --fleet-smoke
 
 types:
 	@$(PY) -c "import mypy" 2>/dev/null \

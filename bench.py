@@ -2046,9 +2046,50 @@ def _serve_smoke() -> None:
         sys.exit(rc)
     seconds = float(os.environ.get('SOCCERACTION_TPU_BENCH_SERVE_SECONDS', 2))
     model = _fit_serve_model()
-    out = _bench_serve_throughput(duration_s=seconds, clients=(1, 4), model=model)
+    # the sweep runs UNDER SCRAPE: a live telemetry endpoint over the
+    # process registry is polled throughout, so the plateau and
+    # zero-retrace gates below also pin that scraping a replica costs
+    # it no compiles — the fleet plane's zero-interference contract
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from socceraction_tpu.obs.endpoint import Telemetry, scrape
+    from socceraction_tpu.obs.endpoint import serve as _serve_ep
+
+    scrape_stats = {'n': 0, 'errors': 0}
+    stop_scraping = _threading.Event()
+    with _tempfile.TemporaryDirectory(prefix='serve-smoke-scrape-') as scrape_dir:
+        endpoint = _serve_ep(
+            telemetry=Telemetry(replica='serve-smoke'),
+            unix_path=os.path.join(scrape_dir, 'replica.sock'),
+        )
+
+        def _scrape_loop() -> None:
+            while not stop_scraping.is_set():
+                try:
+                    scrape(endpoint.address, timeout=5.0)
+                    scrape_stats['n'] += 1
+                except Exception:
+                    scrape_stats['errors'] += 1
+                stop_scraping.wait(0.1)
+
+        scraper = _threading.Thread(target=_scrape_loop, daemon=True)
+        scraper.start()
+        try:
+            out = _bench_serve_throughput(
+                duration_s=seconds, clients=(1, 4), model=model
+            )
+        finally:
+            stop_scraping.set()
+            scraper.join(timeout=10)
+            endpoint.close()
+    assert scrape_stats['n'] >= 1 and scrape_stats['errors'] == 0, (
+        f'the under-scrape leg never scraped cleanly: {scrape_stats}'
+    )
+    out['scrapes_during_sweep'] = scrape_stats['n']
     # zero-retrace gate: steady offered load after warmup must compile
-    # nothing new and trip no retrace storm (compile observatory)
+    # nothing new and trip no retrace storm (compile observatory) —
+    # WITH the replica under scrape throughout
     assert out['compiled_shapes_plateaued'] is True, out['levels']
     # with the in-dispatch finite guards enabled (the default), the
     # compiled-shape plateau and zero-steady-state-retrace gates must
@@ -2094,6 +2135,141 @@ def _serve_smoke() -> None:
         ],
     })
     print(json.dumps(artifact))
+
+
+def _bench_fleet_overhead(
+    replica_counts=(1, 4, 16), *, n_requests: int = 400, n_passes: int = 5
+) -> dict:
+    """Scrape+merge wall of the fleet telemetry plane at N replicas.
+
+    Spins N in-process telemetry endpoints (unix sockets) over
+    representative per-replica registries (~a serve snapshot's worth of
+    instruments and bucketed observations), then times the
+    ``FleetAggregator``'s full scrape pass and the merge separately
+    (best of ``n_passes`` — the floor is the signal; a scrape shares
+    the box with the serving process and must stay cheap). Pure host
+    work, jax-free.
+    """
+    import random as _random
+    import tempfile as _tempfile
+
+    from socceraction_tpu.obs.endpoint import Telemetry, serve as _serve_ep
+    from socceraction_tpu.obs.fleet import FleetAggregator
+    from socceraction_tpu.obs.metrics import MetricRegistry
+    from socceraction_tpu.obs.wire import ReplicaRegistry
+
+    def replica_registry(seed: int) -> MetricRegistry:
+        reg = MetricRegistry()
+        rng = _random.Random(seed)
+        requests = reg.counter('serve/requests', unit='requests')
+        lat = reg.histogram('serve/request_seconds', unit='s')
+        seg = reg.histogram('serve/segment_seconds', unit='s')
+        depth = reg.gauge('serve/queue_depth', unit='requests')
+        events = reg.counter('slo/events', unit='requests')
+        for i in range(n_requests):
+            requests.inc(1, kind='rate')
+            wall = rng.lognormvariate(-4, 1)
+            lat.observe(wall, kind='rate', exemplar={'request_id': f's{seed}-{i}'})
+            for segment in ('queue_wait', 'pad', 'dispatch', 'slice'):
+                seg.observe(wall / 4, segment=segment)
+            depth.set(i % 9)
+            events.inc(1, objective='errors', outcome='good')
+        return reg
+
+    levels = []
+    with _tempfile.TemporaryDirectory(prefix='fleet-bench-') as tmp:
+        for n in replica_counts:
+            rr = ReplicaRegistry(max_replicas=max(64, n + 1))
+            endpoints = []
+            per_replica_total = float(n_requests)
+            for i in range(n):
+                endpoints.append(
+                    _serve_ep(
+                        telemetry=Telemetry(
+                            replica=f'replica-{i}',
+                            registry=replica_registry(seed=i),
+                        ),
+                        unix_path=os.path.join(tmp, f'l{n}-r{i}.sock'),
+                    )
+                )
+            fleet_registry = MetricRegistry()
+            aggregator = FleetAggregator(
+                {
+                    f'replica-{i}': endpoints[i].address
+                    for i in range(n)
+                },
+                registry=fleet_registry,
+                replica_registry=rr,
+            )
+            try:
+                for _ in range(n_passes):
+                    aggregator.scrape()
+                    snapshot = aggregator.aggregate()
+                merged_total = snapshot.typed().value(
+                    'serve/requests', kind='rate'
+                )
+                assert merged_total == n * per_replica_total, (
+                    f'{n} replicas: merged {merged_total} != '
+                    f'{n * per_replica_total}'
+                )
+                fsnap = fleet_registry.snapshot()
+                scrape_s = fsnap.value(
+                    'fleet/scrape_seconds', stat='min'
+                )
+                merge_s = fsnap.value('fleet/merge_seconds', stat='min')
+            finally:
+                for endpoint in endpoints:
+                    endpoint.close()
+            levels.append(
+                {
+                    'replicas': n,
+                    'scrape_seconds': scrape_s,
+                    'merge_seconds': merge_s,
+                    'scrape_seconds_per_replica': scrape_s / n,
+                    'merged_series_requests': merged_total,
+                }
+            )
+    return {
+        'levels': levels,
+        'n_requests_per_replica': n_requests,
+        'n_passes': n_passes,
+    }
+
+
+def _fleet_smoke() -> None:
+    """``make fleet-smoke`` (bench half): the scrape+merge overhead sweep.
+
+    The live end-to-end fleet gate is ``tools/fleet_smoke.py`` (real
+    replica processes); this half measures the plane's own cost — the
+    front end scrapes and merges on the serving box, so the wall at
+    1/4/16 replicas is a ledger trajectory (``fleet_scrape_seconds`` /
+    ``fleet_merge_seconds``, lower is better in benchdiff). No clean-CPU
+    re-exec: the whole path is jax-free host work.
+    """
+    out = _bench_fleet_overhead()
+    top = out['levels'][-1]
+    base = {
+        'platform': 'cpu',
+        'smoke': True,
+        'replicas': top['replicas'],
+        **out,
+    }
+    scrape_artifact = {
+        'metric': 'fleet_scrape_seconds',
+        'value': top['scrape_seconds'],
+        'unit': 's',
+        **base,
+    }
+    merge_artifact = {
+        'metric': 'fleet_merge_seconds',
+        'value': top['merge_seconds'],
+        'unit': 's',
+        **base,
+    }
+    _persist_artifact(scrape_artifact)
+    _persist_artifact(merge_artifact)
+    print(json.dumps(scrape_artifact))
+    print(json.dumps(merge_artifact))
 
 
 def _xt_smoke() -> None:
@@ -2482,6 +2658,9 @@ def main() -> None:
         return
     if '--learn-smoke' in sys.argv:
         _learn_smoke()
+        return
+    if '--fleet-smoke' in sys.argv:
+        _fleet_smoke()
         return
     if '--impl' in sys.argv:
         print(json.dumps(bench_impl()))

@@ -45,6 +45,18 @@ where a caller asks for device sync or named scopes):
 - :mod:`socceraction_tpu.obs.coldstart` — the cold-start timeline:
   phase-marked startup spans anchored at OS process start, reported by
   :func:`coldstart_report`.
+- :mod:`socceraction_tpu.obs.wire` — the cross-process snapshot wire
+  format: versioned :func:`encode_snapshot`/:func:`decode_snapshot`
+  documents and :func:`merge_wires` per-kind merge semantics (counters
+  sum, gauges gain a governed ``replica`` label, histograms merge
+  bucket-wise exactly).
+- :mod:`socceraction_tpu.obs.endpoint` — the per-replica exposition
+  endpoint: a stdlib HTTP server (unix socket default, TCP opt-in)
+  serving ``/snapshot``, ``/health``, ``/metrics`` and ``/tail``, plus
+  the :func:`scrape` client half.
+- :mod:`socceraction_tpu.obs.fleet` — :class:`FleetAggregator`:
+  scrape/ingest N replica snapshots, loud staleness, merged fleet
+  snapshot, mesh-wide SLO evaluation and per-replica divergence.
 
 ``socceraction_tpu.utils.profiling`` is a thin façade over this package:
 its ``timed``/``record_value``/``timer_report`` keep working and now
@@ -60,6 +72,8 @@ __all__ = [
     'ColdstartTimeline',
     'Counter',
     'DeadlineExceeded',
+    'FleetAggregator',
+    'FleetSnapshot',
     'FlightRecorder',
     'Gauge',
     'Histogram',
@@ -71,29 +85,37 @@ __all__ = [
     'ParityProbe',
     'RECORDER',
     'REGISTRY',
+    'REPLICAS',
     'RegistrySnapshot',
+    'ReplicaRegistry',
     'RequestContext',
     'RunLog',
     'SLOConfig',
     'SLOEngine',
     'SLOObjective',
     'Span',
+    'Telemetry',
+    'TelemetryEndpoint',
+    'WireError',
     'claim_bytes',
     'coldstart_report',
     'cost_analysis',
     'counter',
     'current_runlog',
     'current_span',
+    'decode_snapshot',
     'default_debug_dir',
     'device_memory_stats',
     'drain_guards',
     'dump_debug_bundle',
+    'encode_snapshot',
     'fn_cost',
     'gauge',
     'guards_enabled',
     'histogram',
     'instrument_jit',
     'live_array_census',
+    'merge_wires',
     'new_request_context',
     'nonfinite_count',
     'note_guard',
@@ -109,10 +131,14 @@ __all__ = [
     'residency_report',
     'run_manifest',
     'sample_device_memory',
+    'scrape',
+    'scrape_health',
+    'serve_telemetry',
     'snapshot_dict',
     'span',
     'timed_labels',
     'timer_report_compat',
+    'typed_snapshot_from_dict',
 ]
 
 _HOMES = {
@@ -151,6 +177,15 @@ _HOMES = {
         'record_overflow',
     ),
     'parity': ('ParityProbe',),
+    'wire': (
+        'REPLICAS', 'ReplicaRegistry', 'WireError', 'decode_snapshot',
+        'encode_snapshot', 'merge_wires', 'typed_snapshot_from_dict',
+    ),
+    'endpoint': (
+        'Telemetry', 'TelemetryEndpoint', 'scrape', 'scrape_health',
+        'serve_telemetry',
+    ),
+    'fleet': ('FleetAggregator', 'FleetSnapshot'),
 }
 _HOME_BY_SYMBOL = {
     name: module for module, names in _HOMES.items() for name in names

@@ -23,7 +23,17 @@ identity that rides the request's future across that boundary:
   :func:`record_request_done`) land in the active
   :class:`~socceraction_tpu.obs.trace.RunLog` and the flight-recorder
   ring, so ``obsctl trace <request_id>`` can reconstruct one request's
-  full queue→flush→dispatch→slice path through a shared dispatch.
+  full queue→flush→dispatch→slice path through a shared dispatch;
+- carried **across the process boundary** by :meth:`RequestContext.to_wire`
+  / :meth:`RequestContext.from_wire`: a front-end process mints the
+  context, ships the headers with the request over whatever transport
+  the topology uses, and the replica process reconstructs a context
+  with the SAME ``request_id`` (and the remaining deadline re-anchored
+  to its own clock — ``perf_counter`` instants never cross processes),
+  one ``hop`` deeper. ``RatingService.rate(context=...)`` accepts the
+  reconstructed context, so ``obsctl trace <id> front.jsonl
+  replica.jsonl`` stitches one request's timeline across both
+  processes' run logs.
 
 Everything here is stdlib-only and jax-free, like the rest of the obs
 substrate.
@@ -80,7 +90,9 @@ class RequestContext:
     ``deadline_t`` is an absolute ``time.perf_counter()`` instant (None:
     no deadline); ``segments`` is filled in by the batcher (queue_wait)
     and the service's flush (pad / dispatch / slice) as the request
-    moves through the pipeline.
+    moves through the pipeline. ``hop`` counts process boundaries the
+    request has crossed (0: minted here; a replica serving a front-end
+    request sees 1).
     """
 
     request_id: str
@@ -90,6 +102,8 @@ class RequestContext:
     #: innermost open span id on the submitting thread (trace linkage)
     parent_span_id: Optional[int] = None
     segments: Dict[str, float] = field(default_factory=dict)
+    #: process boundaries crossed so far (to_wire/from_wire increment it)
+    hop: int = 0
 
     def remaining_s(self, now: Optional[float] = None) -> Optional[float]:
         """Seconds until the deadline (negative: expired); None without one."""
@@ -101,6 +115,58 @@ class RequestContext:
         """True once the deadline has passed (always False without one)."""
         remaining = self.remaining_s(now)
         return remaining is not None and remaining <= 0.0
+
+    # -- the process hop ---------------------------------------------------
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Serialize the identity that must survive a process hop.
+
+        Plain JSON-able headers: the ``request_id`` (preserved
+        end-to-end — the stitch key for ``obsctl trace`` across run
+        logs), the traffic ``kind``, the hop count, and the deadline as
+        *remaining milliseconds at encode time* — absolute
+        ``perf_counter`` instants are process-local, so the receiver
+        re-anchors what is left of the budget on its own clock (network
+        time in flight is deliberately charged to the caller's budget).
+        Span ids and segments stay home: they are process-local
+        observations, recorded per process and joined by the id.
+        """
+        headers: Dict[str, Any] = {
+            'request_id': self.request_id,
+            'kind': self.kind,
+            'hop': self.hop,
+        }
+        remaining = self.remaining_s()
+        if remaining is not None:
+            headers['deadline_remaining_ms'] = remaining * 1e3
+        return headers
+
+    @classmethod
+    def from_wire(cls, headers: Dict[str, Any]) -> 'RequestContext':
+        """Reconstruct a context shipped by :meth:`to_wire`, one hop on.
+
+        The ``request_id`` is preserved verbatim; ``enqueue_t`` is this
+        process's receive instant (its queue-wait segment starts now);
+        the deadline re-anchors the shipped remaining budget.
+        """
+        request_id = headers.get('request_id')
+        if not request_id:
+            raise ValueError(
+                f'wire context carries no request_id: {headers!r}'
+            )
+        now = time.perf_counter()
+        remaining_ms = headers.get('deadline_remaining_ms')
+        return cls(
+            request_id=str(request_id),
+            kind=str(headers.get('kind') or 'rate'),
+            enqueue_t=now,
+            deadline_t=(
+                now + float(remaining_ms) / 1e3
+                if remaining_ms is not None
+                else None
+            ),
+            hop=int(headers.get('hop') or 0) + 1,
+        )
 
 
 def new_request_context(
@@ -152,14 +218,16 @@ def record_request_enqueue(ctx: RequestContext, queue_depth: int) -> None:
 
     log = current_runlog()
     if log is not None:
-        log.event(
-            'request_enqueue',
-            request_id=ctx.request_id,
-            request_kind=ctx.kind,
-            queue_depth=queue_depth,
-            parent_span_id=ctx.parent_span_id,
-            deadline_in_s=ctx.remaining_s(),
-        )
+        fields: Dict[str, Any] = {
+            'request_id': ctx.request_id,
+            'request_kind': ctx.kind,
+            'queue_depth': queue_depth,
+            'parent_span_id': ctx.parent_span_id,
+            'deadline_in_s': ctx.remaining_s(),
+        }
+        if ctx.hop:
+            fields['hop'] = ctx.hop
+        log.event('request_enqueue', **fields)
 
 
 def record_request_done(
@@ -192,6 +260,8 @@ def record_request_done(
         'wall_s': wall_s,
         'segments': dict(ctx.segments),
     }
+    if ctx.hop:
+        fields['hop'] = ctx.hop
     if bucket is not None:
         fields['bucket'] = bucket
     if coalesced is not None:

@@ -12,6 +12,11 @@ Two wire formats plus one compatibility shim:
   ``pipeline_feed_queue_depth_chunks``).
 - :func:`snapshot_dict` — a plain-JSON rendering of the typed snapshot
   (for artifacts and the ``obs.jsonl`` ``metrics`` events).
+
+Both renderings emit deterministically in sorted ``(name, labels)``
+order — instruments are name-sorted by the registry snapshot, series
+label-sorted here — so scrape diffs, golden tests and the fleet wire
+round trip are stable across runs and dict-ordering changes.
 - :func:`timer_report_compat` — the legacy ``timer_report()`` shape
   (``{name: {count, total, mean, max, unit, total_s, mean_s, max_s}}``)
   so pre-obs consumers keep reading while they migrate; the ``*_s`` keys
@@ -21,11 +26,30 @@ Two wire formats plus one compatibility shim:
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Mapping, Optional
+from typing import Any, Dict, List, Mapping, Optional, Tuple
 
-from socceraction_tpu.obs.metrics import RegistrySnapshot, SeriesSnapshot
+from socceraction_tpu.obs.metrics import (
+    InstrumentSnapshot,
+    RegistrySnapshot,
+    SeriesSnapshot,
+)
 
 __all__ = ['prometheus_text', 'snapshot_dict', 'timer_report_compat']
+
+
+def _sorted_series(inst: InstrumentSnapshot) -> Tuple[SeriesSnapshot, ...]:
+    """An instrument's series in sorted ``labels`` order.
+
+    Series are stored in first-use order, which depends on runtime
+    arrival — two runs of the same workload (or one run before/after a
+    dict-ordering change) would otherwise emit the same series in
+    different orders, making scrape diffs and golden tests flap.
+    Together with the registry snapshot's name-sorted instruments, this
+    makes both expositions deterministic in (name, labels).
+    """
+    return tuple(
+        sorted(inst.series, key=lambda s: sorted(s.labels.items()))
+    )
 
 #: units already spelled out by the convention's trailing name segment —
 #: appending them again would produce ``_seconds_seconds``
@@ -113,7 +137,7 @@ def prometheus_text(snapshot: RegistrySnapshot) -> str:
         lines.extend(
             _prom_header(pname, name, inst.unit, inst.kind, inst.help)
         )
-        for s in inst.series:
+        for s in _sorted_series(inst):
             labels = _prom_labels(s.labels)
             if inst.kind == 'histogram':
                 for le, cum in s.buckets or ():
@@ -166,7 +190,9 @@ def snapshot_dict(
         name: {
             'kind': inst.kind,
             'unit': inst.unit,
-            'series': [_series_dict(s, buckets) for s in inst.series],
+            'series': [
+                _series_dict(s, buckets) for s in _sorted_series(inst)
+            ],
         }
         for name, inst in snapshot.instruments.items()
     }

@@ -67,6 +67,7 @@ __all__ = [
     'counter',
     'gauge',
     'histogram',
+    'quantile_estimate',
     'timed_labels',
 ]
 
@@ -83,6 +84,46 @@ DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
 )
 
 _QUANTILES = (0.5, 0.9, 0.99)
+
+
+def quantile_estimate(
+    bounds: Tuple[float, ...],
+    counts: Tuple[int, ...],
+    count: int,
+    min_value: float,
+    max_value: float,
+    q: float,
+) -> float:
+    """Estimate the q-quantile from per-bucket counts.
+
+    ``bounds`` are the finite upper edges, ``counts`` the per-bucket
+    (non-cumulative) sample counts with one trailing overflow bucket
+    (``len(counts) == len(bounds) + 1``). Log-linear interpolation
+    inside the containing bucket, clamped to the observed min/max —
+    the single estimator behind :class:`Series` quantiles AND the
+    cross-process histogram merge (:mod:`socceraction_tpu.obs.wire`),
+    so a merged fleet histogram quotes exactly the estimate a single
+    series fed the concatenated stream would.
+    """
+    rank = q * count
+    cum = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if cum + c >= rank:
+            if i >= len(bounds):  # overflow bucket
+                return max_value
+            hi = bounds[i]
+            lo = bounds[i - 1] if i else hi / 10.0 ** 0.25
+            frac = (rank - cum) / c
+            est = 10.0 ** (
+                math.log10(max(lo, 1e-300))
+                + frac
+                * (math.log10(max(hi, 1e-300)) - math.log10(max(lo, 1e-300)))
+            )
+            return min(max(est, min_value), max_value)
+        cum += c
+    return max_value
 
 
 class CardinalityError(ValueError):
@@ -262,28 +303,13 @@ class Series:
     # snapshot -------------------------------------------------------------
 
     def _quantile_locked(self, q: float) -> float:
-        """Estimate the q-quantile from the bucket counts (log-linear
-        interpolation inside the containing bucket, clamped to the
-        observed min/max)."""
+        """Estimate the q-quantile from the bucket counts (see
+        :func:`quantile_estimate` — the shared estimator)."""
         assert self._bucket_counts is not None
-        rank = q * self.count
-        cum = 0
-        for i, c in enumerate(self._bucket_counts):
-            if not c:
-                continue
-            if cum + c >= rank:
-                if i >= len(self._buckets):  # overflow bucket
-                    return self.max
-                hi = self._buckets[i]
-                lo = self._buckets[i - 1] if i else hi / 10.0 ** 0.25
-                frac = (rank - cum) / c
-                est = 10.0 ** (
-                    math.log10(max(lo, 1e-300))
-                    + frac * (math.log10(max(hi, 1e-300)) - math.log10(max(lo, 1e-300)))
-                )
-                return min(max(est, self.min), self.max)
-            cum += c
-        return self.max
+        return quantile_estimate(
+            self._buckets, tuple(self._bucket_counts), self.count,
+            self.min, self.max, q,
+        )
 
     def snapshot(self) -> SeriesSnapshot:
         """Consistent point-in-time view of this series."""

@@ -488,6 +488,7 @@ class RatingService:
         *,
         home_team_id: Any = None,
         deadline_ms: Optional[float] = None,
+        context: Optional[RequestContext] = None,
     ) -> Future:
         """Rate one match's SPADL actions; returns a Future of a DataFrame.
 
@@ -508,6 +509,16 @@ class RatingService:
         bounds the total wait: a request still queued past it fails with
         :class:`~socceraction_tpu.obs.context.DeadlineExceeded` instead
         of dispatching late.
+
+        ``context`` accepts a pre-built :class:`RequestContext` — the
+        process-hop form: a front-end process ships
+        ``ctx.to_wire()`` with the request, the replica reconstructs it
+        with :meth:`RequestContext.from_wire` and passes it here, so
+        the ``request_id`` (and the remaining deadline budget) survive
+        the hop end-to-end and ``obsctl trace <id>`` can stitch the
+        request across both processes' run logs. ``deadline_ms`` is
+        ignored when a context is given: the shipped context already
+        carries the caller's remaining budget.
 
         Raises :class:`~socceraction_tpu.serve.batcher.Overloaded`
         synchronously when the admission queue is full, and its subclass
@@ -544,13 +555,16 @@ class RatingService:
             if self._gs_enabled
             else None
         )
-        ctx = new_request_context(
-            'rate',
-            deadline_ms=(
-                deadline_ms if deadline_ms is not None
-                else self.request_deadline_ms
-            ),
-        )
+        if context is not None:
+            ctx = context
+        else:
+            ctx = new_request_context(
+                'rate',
+                deadline_ms=(
+                    deadline_ms if deadline_ms is not None
+                    else self.request_deadline_ms
+                ),
+            )
         payload = _Payload(staging, gs, keep=None, index=actions.index, ctx=ctx)
         future = self._submit(payload, 'rate', ctx)
         # capture ONLY on success, via the future: shed (Overloaded)
@@ -1114,6 +1128,26 @@ class RatingService:
             'last_dump': self.last_dump_path,
             'uptime_s': time.monotonic() - self._started_t,
         }
+
+    def telemetry(self, replica: Optional[str] = None) -> Any:
+        """This replica's exposition bundle for the fleet scrape surface.
+
+        Returns an :class:`~socceraction_tpu.obs.endpoint.Telemetry`
+        wired to the process registry, this service's :meth:`health`
+        and the flight recorder; start the per-replica endpoint with::
+
+            from socceraction_tpu.obs.endpoint import serve
+            endpoint = serve(telemetry=service.telemetry(replica='replica-0'))
+
+        ``replica`` is the fleet slot name, governed by the bounded
+        :class:`~socceraction_tpu.obs.wire.ReplicaRegistry` (default: a
+        host-pid id). Every route reads host state only — a replica
+        under scrape never touches the device, keeping the compiled
+        ladder's zero steady-state retraces.
+        """
+        from ..obs.endpoint import Telemetry
+
+        return Telemetry(replica=replica, health=self.health)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -1,0 +1,767 @@
+"""The cross-process telemetry plane (ISSUE 14): wire, endpoint, fleet.
+
+Covers the tentpole's four pieces without spawning real serving
+replicas (``make fleet-smoke`` does that): the versioned wire format's
+round trip and per-kind merge semantics — including the
+histogram-merge-exactness satellite (merged p50/p99 equals the
+estimate over the concatenated raw stream, overflow-label and
+exemplar-carry cases) — the stdlib exposition endpoint over unix
+socket and TCP, the ``FleetAggregator``'s staleness / mesh-wide SLO /
+divergence, the ``RequestContext`` process hop, and the obsctl
+multi-runlog surface (trace stitching, fleet post-mortem, corrupt-line
+policy). The jax-free import contract for all three new modules is
+pinned in a subprocess, same as the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from socceraction_tpu.obs.export import snapshot_dict
+from socceraction_tpu.obs.metrics import MetricRegistry
+from socceraction_tpu.obs.wire import (
+    ReplicaRegistry,
+    WireError,
+    decode_snapshot,
+    encode_snapshot,
+    merge_wires,
+    typed_snapshot_from_dict,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def obsctl_main(argv):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        'obsctl', os.path.join(_ROOT, 'tools', 'obsctl.py')
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+def _obsctl(argv):
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = obsctl_main(argv)
+    return rc, out.getvalue()
+
+
+def _registry() -> ReplicaRegistry:
+    # a fresh bounded registry per test: the process-wide one would
+    # accumulate ids across tests and eventually hit its budget
+    return ReplicaRegistry()
+
+
+def _draws(seed, n=200):
+    rng = random.Random(seed)
+    return [rng.lognormvariate(-3, 1) for _ in range(n)]
+
+
+def _replica_registry(seed, n=200):
+    reg = MetricRegistry()
+    c = reg.counter('serve/requests', unit='requests')
+    h = reg.histogram('serve/request_seconds', unit='s')
+    g = reg.gauge('serve/queue_depth', unit='requests')
+    for i, v in enumerate(_draws(seed, n)):
+        c.inc(1, kind='rate')
+        h.observe(v, kind='rate', exemplar={'request_id': f'r{seed}-{i}'})
+        g.set(i % 7)
+    return reg
+
+
+# -- wire format ------------------------------------------------------------
+
+
+def test_wire_roundtrip_is_bit_exact_against_snapshot_dict():
+    reg = _replica_registry(seed=1)
+    snap = reg.snapshot()
+    wire = encode_snapshot(snap, replica='replica-0', registry=_registry())
+    decoded = decode_snapshot(json.dumps(wire))
+    assert decoded['metrics'] == snapshot_dict(snap)
+    assert decoded['replica'] == 'replica-0'
+    assert decoded['wire_version'] == 1
+
+
+def test_wire_version_policy_rejects_newer_refuses_garbage():
+    reg = _replica_registry(seed=1, n=3)
+    wire = encode_snapshot(
+        reg.snapshot(), replica='replica-0', registry=_registry()
+    )
+    newer = dict(wire, wire_version=99)
+    with pytest.raises(WireError, match='newer than this library'):
+        decode_snapshot(newer)
+    with pytest.raises(WireError, match='wire_version'):
+        decode_snapshot({'metrics': {}})
+    with pytest.raises(WireError, match='not valid JSON'):
+        decode_snapshot('{torn')
+    with pytest.raises(WireError, match='missing'):
+        decode_snapshot({'wire_version': 1, 'metrics': {}})
+
+
+def test_encode_requires_registered_id_shape():
+    reg = _replica_registry(seed=1, n=1)
+    with pytest.raises(WireError, match='invalid replica id'):
+        encode_snapshot(
+            reg.snapshot(), replica='NOT A SLOT', registry=_registry()
+        )
+
+
+def test_counters_sum_exactly_and_gauges_carry_replica_labels():
+    rr = _registry()
+    regs = {f'replica-{i}': _replica_registry(seed=i, n=50 + i) for i in range(3)}
+    wires = [
+        encode_snapshot(reg.snapshot(), replica=rid, registry=rr)
+        for rid, reg in regs.items()
+    ]
+    merged = merge_wires(wires, registry=rr)
+    total = merged['serve/requests']['series'][0]['total']
+    assert total == 50 + 51 + 52  # integer-exact counter sum
+    gauge_labels = {
+        tuple(sorted(s['labels'].items()))
+        for s in merged['serve/queue_depth']['series']
+    }
+    assert gauge_labels == {
+        (('replica', 'replica-0'),),
+        (('replica', 'replica-1'),),
+        (('replica', 'replica-2'),),
+    }
+    # re-merging an already-merged document does not double-label gauges
+    rr.register('fleet')
+    remerged = merge_wires(
+        [
+            {
+                'wire_version': 1,
+                'replica': 'fleet',
+                'time_unix': time.time(),
+                'metrics': merged,
+            }
+        ],
+        registry=rr,
+    )
+    assert {
+        tuple(sorted(s['labels'].items()))
+        for s in remerged['serve/queue_depth']['series']
+    } == gauge_labels
+
+
+def test_histogram_merge_is_exact_vs_concatenated_stream():
+    """The merge-exactness satellite: merging K replica histograms then
+    querying p50/p99 equals the estimate a single series fed the
+    concatenated raw stream produces — the shared bucket estimator over
+    identical bucket counts/min/max, so equality is exact, not banded.
+    Sums merge as the sum of per-replica sums (bit-exact in exact
+    arithmetic; vs. the sequential stream only f64 association
+    differs)."""
+    rr = _registry()
+    seeds = (1, 2, 3, 4)
+    wires = [
+        encode_snapshot(
+            _replica_registry(seed=s).snapshot(),
+            replica=f'replica-{s}',
+            registry=rr,
+        )
+        for s in seeds
+    ]
+    merged = merge_wires(wires, registry=rr)
+    concat = MetricRegistry()
+    h = concat.histogram('serve/request_seconds', unit='s')
+    for s in seeds:
+        for v in _draws(s):
+            h.observe(v, kind='rate')
+    ref = snapshot_dict(concat.snapshot())['serve/request_seconds']['series'][0]
+    got = merged['serve/request_seconds']['series'][0]
+    assert got['count'] == ref['count'] == 800
+    assert got['buckets'] == ref['buckets']
+    assert got['quantiles'] == ref['quantiles']  # p50/p90/p99, exact
+    assert got['min'] == ref['min'] and got['max'] == ref['max']
+    assert got['total'] == pytest.approx(ref['total'], rel=1e-12)
+
+
+def test_histogram_merge_overflow_label_and_exemplar_carry():
+    """The reserved ``{overflow="true"}`` series merges like any other
+    label set, and the merged exemplar is the newest by timestamp
+    regardless of document order."""
+    rr = _registry()
+
+    def one(rid, ts, exemplar_id, overflow_n):
+        reg = MetricRegistry()
+        h = reg.histogram('serve/request_seconds', unit='s')
+        h.observe(0.5, kind='rate', exemplar={'request_id': exemplar_id})
+        # force the exemplar timestamp, then the overflow series
+        series = h.labels(kind='rate')
+        series._exemplar['ts'] = ts
+        for _ in range(overflow_n):
+            h.labels(overflow='true').observe(123.0)
+        return encode_snapshot(reg.snapshot(), replica=rid, registry=rr)
+
+    newest_first = [
+        one('replica-0', ts=2000.0, exemplar_id='newest', overflow_n=2),
+        one('replica-1', ts=1000.0, exemplar_id='older', overflow_n=3),
+    ]
+    merged = merge_wires(newest_first, registry=rr)
+    series = {
+        tuple(sorted(s['labels'].items())): s
+        for s in merged['serve/request_seconds']['series']
+    }
+    overflow = series[(('overflow', 'true'),)]
+    assert overflow['count'] == 5
+    rate = series[(('kind', 'rate'),)]
+    assert rate['exemplar']['request_id'] == 'newest'
+
+
+def test_merge_refuses_kind_unit_and_bucket_conflicts():
+    rr = _registry()
+    a = MetricRegistry()
+    a.counter('area/thing', unit='count').inc(1)
+    b = MetricRegistry()
+    b.gauge('area/thing', unit='value').set(1)
+    wa = encode_snapshot(a.snapshot(), replica='replica-0', registry=rr)
+    wb = encode_snapshot(b.snapshot(), replica='replica-1', registry=rr)
+    with pytest.raises(WireError, match='conflicting instrument'):
+        merge_wires([wa, wb], registry=rr)
+    c = MetricRegistry()
+    c.histogram('area/lat', unit='s', buckets=(0.1, 1.0)).observe(0.5)
+    d = MetricRegistry()
+    d.histogram('area/lat', unit='s', buckets=(0.2, 2.0)).observe(0.5)
+    wc = encode_snapshot(c.snapshot(), replica='replica-2', registry=rr)
+    wd = encode_snapshot(d.snapshot(), replica='replica-3', registry=rr)
+    with pytest.raises(WireError, match='bucket boundaries differ'):
+        merge_wires([wc, wd], registry=rr)
+
+
+def test_compact_snapshots_merge_without_quantiles():
+    """Run-log embedded snapshots (buckets=False) still merge their
+    exact scalars; quantiles are dropped, never fabricated."""
+    rr = _registry()
+    wires = []
+    for i in (0, 1):
+        reg = _replica_registry(seed=i, n=20)
+        wires.append(
+            {
+                'wire_version': 1,
+                'replica': f'replica-{i}',
+                'time_unix': time.time(),
+                'metrics': snapshot_dict(reg.snapshot(), buckets=False),
+            }
+        )
+    merged = merge_wires(wires, registry=rr)
+    series = merged['serve/request_seconds']['series'][0]
+    assert series['count'] == 40
+    assert 'quantiles' not in series and 'buckets' not in series
+
+
+def test_typed_snapshot_from_dict_round_trips_consumers():
+    reg = _replica_registry(seed=5, n=30)
+    typed = typed_snapshot_from_dict(snapshot_dict(reg.snapshot()))
+    assert typed.value('serve/requests', kind='rate') == 30
+    series = typed.series('serve/request_seconds', kind='rate')
+    assert series.count == 30 and series.quantiles is not None
+    assert series.buckets[-1][0] == float('inf')
+
+
+# -- endpoint ---------------------------------------------------------------
+
+
+@pytest.fixture
+def endpoint_pair(tmp_path):
+    from socceraction_tpu.obs.endpoint import Telemetry, serve
+
+    reg = _replica_registry(seed=9, n=25)
+    telemetry = Telemetry(
+        replica='endpoint-test',
+        registry=reg,
+        health=lambda: {'status': 'ok', 'queue_depth': 3},
+    )
+    ep = serve(telemetry=telemetry, unix_path=str(tmp_path / 'r.sock'))
+    yield ep, reg
+    ep.close()
+
+
+def test_endpoint_serves_all_routes_over_unix_socket(endpoint_pair):
+    from socceraction_tpu.obs.endpoint import fetch, scrape, scrape_health
+
+    ep, reg = endpoint_pair
+    doc = scrape(ep.address)
+    assert doc['replica'] == 'endpoint-test'
+    assert doc['metrics'] == snapshot_dict(reg.snapshot())
+    health = scrape_health(ep.address)
+    assert health['status'] == 'ok' and health['replica'] == 'endpoint-test'
+    prom = fetch(ep.address, '/metrics').decode()
+    assert 'serve_requests_total{kind="rate"} 25.0' in prom
+    tail = fetch(ep.address, '/tail?n=3').decode()
+    for line in tail.splitlines():
+        if line.strip():
+            json.loads(line)  # JSONL contract
+    # n=0 means zero events, not the whole ring (events[-0:] trap)
+    assert fetch(ep.address, '/tail?n=0').decode().strip() == ''
+    # socket file permissions ARE the access control
+    assert os.stat(ep.address).st_mode & 0o777 == 0o600
+
+
+def test_endpoint_unknown_route_and_close_unlink(endpoint_pair, tmp_path):
+    from socceraction_tpu.obs.endpoint import EndpointError, fetch
+
+    ep, _ = endpoint_pair
+    with pytest.raises(EndpointError, match='404'):
+        fetch(ep.address, '/nope')
+    path = ep.address
+    ep.close()
+    assert not os.path.exists(path)
+    with pytest.raises(EndpointError, match='cannot reach'):
+        fetch(path, '/snapshot')
+
+
+def test_endpoint_tcp_opt_in_loopback():
+    from socceraction_tpu.obs.endpoint import Telemetry, scrape, serve
+
+    reg = _replica_registry(seed=11, n=5)
+    with serve(
+        telemetry=Telemetry(replica='endpoint-tcp', registry=reg),
+        tcp=('127.0.0.1', 0),
+    ) as ep:
+        assert ep.address.startswith('tcp://127.0.0.1:')
+        doc = scrape(ep.address)
+        assert doc['replica'] == 'endpoint-tcp'
+
+
+def test_endpoint_broken_health_is_a_500_not_a_dead_server(tmp_path):
+    from socceraction_tpu.obs.endpoint import (
+        EndpointError,
+        Telemetry,
+        fetch,
+        serve,
+    )
+
+    def broken():
+        raise RuntimeError('health bug')
+
+    with serve(
+        telemetry=Telemetry(
+            replica='endpoint-broken',
+            registry=MetricRegistry(),
+            health=broken,
+        ),
+        unix_path=str(tmp_path / 'b.sock'),
+    ) as ep:
+        with pytest.raises(EndpointError, match='500'):
+            fetch(ep.address, '/health')
+        # the server survived: the next route still answers
+        assert fetch(ep.address, '/metrics') is not None
+
+
+# -- fleet aggregation ------------------------------------------------------
+
+
+def _slo_replica(seed, n_good, n_bad, latency_s=0.01):
+    reg = MetricRegistry()
+    events = reg.counter('slo/events', unit='requests')
+    h = reg.histogram('serve/request_seconds', unit='s')
+    for _ in range(n_good):
+        events.inc(1, objective='errors', outcome='good')
+        h.observe(latency_s, kind='rate')
+    for _ in range(n_bad):
+        events.inc(1, objective='errors', outcome='bad')
+    return reg
+
+
+def test_aggregator_merges_staleness_slo_and_divergence():
+    from socceraction_tpu.obs.fleet import FleetAggregator
+    from socceraction_tpu.obs.slo import SLOConfig
+
+    clock = [100.0]
+    rr = _registry()
+    agg = FleetAggregator(
+        stale_after_s=5.0,
+        slo=SLOConfig.simple(latency_ms=250.0, min_events=10),
+        registry=MetricRegistry(),
+        replica_registry=rr,
+        time_fn=lambda: clock[0],
+    )
+    regs = {
+        'replica-0': _slo_replica(0, 100, 0),
+        'replica-1': _slo_replica(1, 100, 0),
+        # replica-2 degrades alone: 20x latency, 1/3 errors
+        'replica-2': _slo_replica(2, 100, 50, latency_s=0.2),
+    }
+    for rid, reg in regs.items():
+        agg.ingest(encode_snapshot(reg.snapshot(), replica=rid, registry=rr))
+    snap = agg.aggregate()
+    assert snap.status == 'degraded'  # the sick replica degrades the fleet
+    assert snap.stale_replicas == ()
+    # mesh-wide SLO: burn evaluated over the MERGED slo/events series
+    errors = snap.slo['objectives']['errors']
+    assert errors['window_events_slow'] == 350
+    assert errors['breaching'] is True
+    shed, reason = agg.should_shed('rate')
+    assert shed and reason['objective'] == 'errors'
+    sick = {r['replica'] for r in snap.divergence if r['sick']}
+    assert sick == {'replica-2'}
+    p99_row = next(
+        r
+        for r in snap.divergence
+        if r['replica'] == 'replica-2' and r['signal'] == 'request_p99_s'
+    )
+    assert p99_row['ratio'] >= 3.0
+    # staleness: no refresh past the horizon flips the replica stale and
+    # keeps its counters in the merged sums (never a silent hole)
+    clock[0] += 6.0
+    agg.ingest(
+        encode_snapshot(
+            regs['replica-0'].snapshot(), replica='replica-0', registry=rr
+        )
+    )
+    agg.ingest(
+        encode_snapshot(
+            regs['replica-1'].snapshot(), replica='replica-1', registry=rr
+        )
+    )
+    snap = agg.aggregate()
+    assert snap.stale_replicas == ('replica-2',)
+    assert snap.status == 'degraded'
+    assert (
+        snap.typed().value('slo/events', objective='errors', outcome='bad')
+        == 50
+    )
+
+
+def test_aggregator_scrape_failure_is_loud(tmp_path):
+    from socceraction_tpu.obs.endpoint import Telemetry, serve
+    from socceraction_tpu.obs.fleet import FleetAggregator
+
+    rr = _registry()
+    reg = _replica_registry(seed=3, n=10)
+    ep = serve(
+        telemetry=Telemetry(replica='replica-0', registry=reg),
+        unix_path=str(tmp_path / 'r0.sock'),
+    )
+    fleet_reg = MetricRegistry()
+    agg = FleetAggregator(
+        {
+            'replica-0': ep.address,
+            'replica-1': str(tmp_path / 'never-there.sock'),
+        },
+        stale_after_s=60.0,
+        registry=fleet_reg,
+        replica_registry=rr,
+    )
+    outcomes = agg.scrape()
+    assert outcomes == {'replica-0': True, 'replica-1': False}
+    snap = agg.aggregate()
+    assert snap.stale_replicas == ('replica-1',)
+    assert snap.status == 'degraded'
+    state = {r.replica: r for r in snap.replicas}
+    assert state['replica-1'].error is not None
+    fsnap = fleet_reg.snapshot()
+    assert fsnap.value('fleet/scrapes', replica='replica-0', outcome='ok') == 1
+    assert (
+        fsnap.value('fleet/scrapes', replica='replica-1', outcome='error') == 1
+    )
+    assert fsnap.value('fleet/scrape_seconds', stat='count') == 1
+    assert fsnap.value('fleet/merge_seconds', stat='count') == 1
+    ep.close()
+
+
+def test_aggregator_rejects_misidentified_endpoint(tmp_path):
+    from socceraction_tpu.obs.endpoint import Telemetry, serve
+    from socceraction_tpu.obs.fleet import FleetAggregator
+
+    rr = _registry()
+    ep = serve(
+        telemetry=Telemetry(
+            replica='replica-9', registry=MetricRegistry()
+        ),
+        unix_path=str(tmp_path / 'r9.sock'),
+    )
+    agg = FleetAggregator(
+        {'replica-0': ep.address},
+        registry=MetricRegistry(),
+        replica_registry=rr,
+    )
+    rr.register('replica-9')
+    outcomes = agg.scrape()
+    assert outcomes == {'replica-0': False}
+    state = {r.replica: r for r in agg.aggregate().replicas}
+    assert 'identifies as' in (state['replica-0'].error or '')
+    ep.close()
+
+
+# -- the process hop --------------------------------------------------------
+
+
+def test_request_context_survives_the_wire_hop():
+    from socceraction_tpu.obs.context import RequestContext, new_request_context
+
+    ctx = new_request_context('rate', deadline_ms=500.0)
+    headers = ctx.to_wire()
+    assert headers['request_id'] == ctx.request_id
+    assert 0.0 < headers['deadline_remaining_ms'] <= 500.0
+    back = RequestContext.from_wire(json.loads(json.dumps(headers)))
+    assert back.request_id == ctx.request_id  # preserved end-to-end
+    assert back.kind == 'rate'
+    assert back.hop == 1
+    remaining = back.remaining_s()
+    assert remaining is not None and 0.0 < remaining <= 0.5
+    # a second hop increments again; span linkage stays process-local
+    hop2 = RequestContext.from_wire(back.to_wire())
+    assert hop2.hop == 2 and hop2.parent_span_id is None
+    # no deadline ships as no deadline
+    free = RequestContext.from_wire(
+        new_request_context('session').to_wire()
+    )
+    assert free.deadline_t is None and free.kind == 'session'
+    with pytest.raises(ValueError, match='request_id'):
+        RequestContext.from_wire({'kind': 'rate'})
+
+
+# -- obsctl: multi-runlog loader, trace stitching, fleet --------------------
+
+
+def _write_runlog(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as fh:
+        for event in events:
+            fh.write(json.dumps(event) + '\n')
+
+
+@pytest.fixture
+def two_process_logs(tmp_path):
+    rid = 'proc-1-2a'
+    t = time.time()
+    front = [
+        {'ts': t, 'event': 'run_start', 'thread': 'main', 'manifest': {}},
+        {
+            'ts': t + 0.001, 'event': 'request_enqueue', 'request_id': rid,
+            'request_kind': 'rate', 'queue_depth': 0,
+        },
+        {
+            'ts': t + 0.080, 'event': 'request_done', 'request_id': rid,
+            'request_kind': 'rate', 'status': 'ok', 'wall_s': 0.079,
+            'segments': {},
+        },
+    ]
+    reg = _replica_registry(seed=21, n=4)
+    replica = [
+        {'ts': t + 0.002, 'event': 'run_start', 'thread': 'main', 'manifest': {}},
+        {
+            'ts': t + 0.010, 'event': 'request_enqueue', 'request_id': rid,
+            'request_kind': 'rate', 'queue_depth': 1, 'hop': 1,
+        },
+        {
+            'ts': t + 0.030, 'event': 'span_close', 'name': 'serve/flush',
+            'span_id': 7, 'duration_s': 0.02, 'thread': 'flusher',
+            'attrs': {'bucket': 1, 'request_ids': [rid]},
+        },
+        {
+            'ts': t + 0.050, 'event': 'request_done', 'request_id': rid,
+            'request_kind': 'rate', 'status': 'ok', 'wall_s': 0.04,
+            'hop': 1, 'bucket': 1, 'coalesced': 1,
+            'segments': {
+                'queue_wait': 0.005, 'pad': 0.001,
+                'dispatch': 0.03, 'slice': 0.002,
+            },
+        },
+        {
+            'ts': t + 0.060, 'event': 'metrics', 'thread': 'main',
+            'metrics': snapshot_dict(reg.snapshot(), buckets=False),
+        },
+    ]
+    front_path = str(tmp_path / 'front' / 'obs.jsonl')
+    replica_path = str(tmp_path / 'replica-0' / 'obs.jsonl')
+    _write_runlog(front_path, front)
+    _write_runlog(replica_path, replica)
+    return rid, front_path, replica_path
+
+
+def test_obsctl_trace_stitches_across_two_runlogs(two_process_logs):
+    rid, front, replica = two_process_logs
+    rc, out = _obsctl(['trace', rid, front, replica, '--json'])
+    assert rc == 0
+    trace = json.loads(out)
+    assert trace['request_id'] == rid
+    hops = trace['hops']
+    assert [h['hop'] for h in hops] == [0, 1]
+    assert hops[0]['runlog'] == front and hops[1]['runlog'] == replica
+    # front-end enqueue -> replica flush -> dispatch -> slice
+    assert hops[0]['enqueue'] is not None
+    assert hops[1]['flush'] is not None
+    assert set(trace['segments']) == {'queue_wait', 'pad', 'dispatch', 'slice'}
+    # the top-level status comes from the hop that dispatched
+    assert trace['status'] == 'ok' and trace['bucket'] == 1
+    rc, human = _obsctl(['trace', rid, front, replica])
+    assert rc == 0
+    assert 'hop 0' in human and 'hop 1' in human
+    assert 'dispatch' in human
+    # unknown id across several logs: one clean error line
+    rc, _ = _obsctl(['trace', 'nope', front, replica, '--json'])
+    assert rc == 1
+
+
+def test_obsctl_multi_runlog_corrupt_line_policy(two_process_logs, capsys):
+    rid, front, replica = two_process_logs
+    with open(replica, 'a', encoding='utf-8') as fh:
+        fh.write('{"torn half of a li\n')
+    rc, out = _obsctl(['tail', front, replica, '--json', '-n', '50'])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert 'skipping corrupt line' in err and replica in err
+    events = [json.loads(l) for l in out.splitlines() if l.strip()]
+    # merged ts-ordered with per-event provenance
+    assert all('_runlog' in e for e in events)
+    ts = [e['ts'] for e in events]
+    assert ts == sorted(ts)
+    # a missing file is one actionable line, not a traceback
+    rc, _ = _obsctl(['trace', rid, front, '/no/such/obs.jsonl'])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert err.count('\n') == 1 and '/no/such/obs.jsonl' in err
+
+
+def test_obsctl_single_runlog_tail_shape_unchanged(two_process_logs):
+    _rid, _front, replica = two_process_logs
+    rc, out = _obsctl(['tail', replica, '--json', '-n', '50'])
+    assert rc == 0
+    events = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert events and all('_runlog' not in e for e in events)
+
+
+def test_obsctl_fleet_post_mortem_over_runlogs(two_process_logs, tmp_path):
+    _rid, _front, replica = two_process_logs
+    # a second replica log with its own counters
+    reg = _replica_registry(seed=22, n=6)
+    other = str(tmp_path / 'replica-1' / 'obs.jsonl')
+    _write_runlog(
+        other,
+        [
+            {
+                'ts': time.time(), 'event': 'metrics', 'thread': 'main',
+                'metrics': snapshot_dict(reg.snapshot(), buckets=False),
+            }
+        ],
+    )
+    rc, out = _obsctl(['fleet', replica, other, '--json'])
+    assert rc == 0
+    summary = json.loads(out)
+    replicas = {r['replica'] for r in summary['replicas']}
+    assert replicas == {'replica-0', 'replica-1'}
+    total = sum(
+        s['total']
+        for s in summary['metrics']['serve/requests']['series']
+        if s['labels'].get('kind') == 'rate'
+    )
+    assert total == 4 + 6
+    # human rendering lists replicas and the merged table
+    rc, human = _obsctl(['fleet', replica, other])
+    assert rc == 0
+    assert 'replica-0' in human and 'serve/requests' in human
+    # no inputs at all is an actionable error
+    rc, _ = _obsctl(['fleet'])
+    assert rc == 1
+    # a runlog directory name past the 64-char wire id cap truncates
+    # instead of crashing with a WireError traceback
+    long_dir = tmp_path / ('very-descriptively-named-replica-directory-' * 3)
+    long_log = str(long_dir / 'obs.jsonl')
+    _write_runlog(
+        long_log,
+        [
+            {
+                'ts': time.time(), 'event': 'metrics', 'thread': 'main',
+                'metrics': snapshot_dict(
+                    _replica_registry(seed=23, n=2).snapshot(), buckets=False
+                ),
+            }
+        ],
+    )
+    rc, out = _obsctl(['fleet', long_log, '--json'])
+    assert rc == 0
+    summary = json.loads(out)
+    assert len(summary['replicas'][0]['replica']) <= 64
+
+
+# -- bench + benchdiff wiring ----------------------------------------------
+
+
+def test_bench_fleet_overhead_measures_and_merges_exactly():
+    """``bench.py --fleet-smoke``'s measurement core: live endpoints at
+    each replica count, positive scrape/merge walls, and the merged
+    counter total exactly ``n_replicas × per-replica`` (asserted inside
+    the bench too — a failed merge fails the measurement)."""
+    sys.path.insert(0, _ROOT)
+    try:
+        from bench import _bench_fleet_overhead
+    finally:
+        sys.path.remove(_ROOT)
+
+    out = _bench_fleet_overhead(
+        replica_counts=(1, 2), n_requests=20, n_passes=2
+    )
+    assert [lvl['replicas'] for lvl in out['levels']] == [1, 2]
+    for lvl in out['levels']:
+        assert lvl['scrape_seconds'] > 0.0 and lvl['merge_seconds'] > 0.0
+        assert lvl['merged_series_requests'] == 20.0 * lvl['replicas']
+
+
+def test_benchdiff_knows_fleet_metrics_are_lower_is_better():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        'benchdiff', os.path.join(_ROOT, 'tools', 'benchdiff.py')
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert 'fleet_scrape_seconds' in mod.LOWER_IS_BETTER
+    assert 'fleet_merge_seconds' in mod.LOWER_IS_BETTER
+
+
+# -- jax-free import contract ----------------------------------------------
+
+
+def test_wire_endpoint_fleet_are_jax_free():
+    """The cross-process plane must import and run — encode, serve,
+    scrape, merge, aggregate — in a process where jax cannot be
+    imported (the front end is exactly such a process)."""
+    code = (
+        'import builtins, sys\n'
+        'real = builtins.__import__\n'
+        'def blocker(name, *a, **k):\n'
+        "    if name == 'jax' or name.startswith('jax.'):\n"
+        "        raise ImportError('jax is blocked in this process')\n"
+        '    return real(name, *a, **k)\n'
+        'builtins.__import__ = blocker\n'
+        'import tempfile, os\n'
+        'from socceraction_tpu.obs.metrics import MetricRegistry\n'
+        'from socceraction_tpu.obs.wire import (\n'
+        '    ReplicaRegistry, encode_snapshot, merge_wires,\n'
+        ')\n'
+        'from socceraction_tpu.obs.endpoint import Telemetry, scrape, serve\n'
+        'from socceraction_tpu.obs.fleet import FleetAggregator\n'
+        'rr = ReplicaRegistry()\n'
+        'reg = MetricRegistry()\n'
+        "reg.counter('serve/requests', unit='requests').inc(3, kind='rate')\n"
+        "sock = os.path.join(tempfile.mkdtemp(), 'r.sock')\n"
+        'ep = serve(\n'
+        "    telemetry=Telemetry(replica='replica-0', registry=reg),\n"
+        '    unix_path=sock,\n'
+        ')\n'
+        "agg = FleetAggregator({'replica-0': sock}, registry=MetricRegistry())\n"
+        'assert agg.scrape() == {"replica-0": True}\n'
+        'snap = agg.aggregate()\n'
+        "assert snap.typed().value('serve/requests', kind='rate') == 3\n"
+        'ep.close()\n'
+        "assert 'jax' not in sys.modules\n"
+    )
+    env = dict(os.environ, PYTHONPATH=_ROOT)
+    subprocess.run(
+        [sys.executable, '-c', code], check=True, env=env, timeout=60
+    )

@@ -142,6 +142,35 @@ def test_perf_area_and_capacity_labels_are_registered():
     assert 'owner' in tool.KNOWN_LABELS['mem']
 
 
+def test_fleet_area_and_labels_are_registered():
+    """The cross-process telemetry plane's metric area (``fleet/*``:
+    scrapes, staleness, divergence) and its label contract are governed
+    by the lint gate from day one (ISSUE 14 satellite) — and the
+    ``replica`` label's cardinality contract is real code: ids come
+    from the bounded ``ReplicaRegistry``, never free-form strings."""
+    tool = _tool()
+    assert 'fleet' in tool.KNOWN_AREAS
+    assert tool.KNOWN_LABELS['fleet'] == {
+        'replica', 'state', 'outcome', 'signal'
+    }
+    import pytest
+
+    from socceraction_tpu.obs.wire import ReplicaRegistry, WireError
+
+    registry = ReplicaRegistry(max_replicas=2)
+    registry.register('replica-0')
+    registry.register('replica-0')  # idempotent: not a second slot
+    registry.register('replica-1')
+    with pytest.raises(WireError, match='registry full'):
+        registry.register('replica-2')
+    with pytest.raises(WireError, match='invalid replica id'):
+        ReplicaRegistry().register('NOT OK!')
+    with pytest.raises(WireError, match='invalid replica id'):
+        # free-form per-instance strings (too long) are exactly the
+        # cardinality leak the bound exists to stop
+        ReplicaRegistry().register('x' * 80)
+
+
 def test_gate_reports_all_violations_per_site(tmp_path):
     """One site breaking several rules surfaces every violation in one
     run — not one per fix-and-rerun cycle (ISSUE 8 satellite)."""

@@ -253,7 +253,12 @@ def test_preserve_shields_prefixes_from_reset():
 
 def test_prometheus_exposition_golden_text():
     reg = MetricRegistry()
+    # first-use order 'shot' AFTER 'goal' is deliberately NOT sorted:
+    # the exposition must emit series in sorted (name, labels) order so
+    # scrape diffs and this golden text are stable across runs and
+    # dict-ordering changes (ISSUE 14 satellite)
     reg.counter('area/events', unit='count').inc(3, kind='shot')
+    reg.counter('area/events', unit='count').inc(1, kind='goal')
     reg.gauge('pipeline/feed_queue_depth', unit='chunks').set(2)
     h = reg.histogram('pipeline/stage_seconds', unit='s', buckets=(0.1, 1.0, 10.0))
     h.observe(0.5, stage='read')
@@ -262,6 +267,7 @@ def test_prometheus_exposition_golden_text():
     assert text == (
         '# HELP area_events_total area/events (count)\n'
         '# TYPE area_events_total counter\n'
+        'area_events_total{kind="goal"} 1.0\n'
         'area_events_total{kind="shot"} 3.0\n'
         '# HELP pipeline_feed_queue_depth_chunks pipeline/feed_queue_depth (chunks)\n'
         '# TYPE pipeline_feed_queue_depth_chunks gauge\n'
@@ -276,6 +282,22 @@ def test_prometheus_exposition_golden_text():
         'pipeline_stage_seconds_bucket{stage="read",le="+Inf"} 2\n'
         'pipeline_stage_seconds_sum{stage="read"} 5.5\n'
         'pipeline_stage_seconds_count{stage="read"} 2\n'
+    )
+
+
+def test_exposition_series_order_is_deterministic():
+    """Two registries fed the same series in different arrival orders
+    must render byte-identical expositions (sorted (name, labels))."""
+    a, b = MetricRegistry(), MetricRegistry()
+    a.counter('area/events', unit='count').inc(1, kind='shot')
+    a.counter('area/events', unit='count').inc(2, kind='goal')
+    b.counter('area/events', unit='count').inc(2, kind='goal')
+    b.counter('area/events', unit='count').inc(1, kind='shot')
+    assert obs_export.prometheus_text(a.snapshot()) == obs_export.prometheus_text(
+        b.snapshot()
+    )
+    assert obs_export.snapshot_dict(a.snapshot()) == obs_export.snapshot_dict(
+        b.snapshot()
     )
 
 

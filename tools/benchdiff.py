@@ -69,6 +69,11 @@ LOWER_IS_BETTER: Tuple[str, ...] = (
     'cold_start_cache_hit_seconds',
     'cold_start_aot_seconds',
     'vaep_quant_table_bytes',
+    # the fleet telemetry plane's own overhead (bench.py --fleet-smoke:
+    # scrape + merge wall at the top replica count) — the front end
+    # pays these on the serving box, so growth is the regression
+    'fleet_scrape_seconds',
+    'fleet_merge_seconds',
 )
 
 #: Wall-breakdown metrics (the cold-start family): when BOTH artifacts

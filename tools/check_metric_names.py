@@ -65,6 +65,7 @@ NAME_TAKING_CALLS = {
 KNOWN_AREAS = {
     'bench',  # bench.py headline gauges
     'drift',  # traffic-drift watch (learn/drift.py: PSI/KS vs reference)
+    'fleet',  # cross-process aggregation (obs/fleet.py: scrapes/staleness/divergence)
     'learn',  # continuous-learning loop (learn/: ingest/train/shadow/gate)
     'mem',  # device-memory accounting (obs/memory.py)
     'num',  # numeric health: in-dispatch guards + parity probes (obs/numerics.py, obs/parity.py)
@@ -131,9 +132,20 @@ KNOWN_AREAS = {
 #:   registry.load, recorder.dump, bench.ledger), ``outcome``
 #:   retried|recovered|exhausted|permanent for retries and the
 #:   breaker-probe / recovery verdicts elsewhere — all bounded by code.
+#: - ``fleet``: ``replica`` values MUST come from the bounded
+#:   ``obs/wire.py::ReplicaRegistry`` (validated id shape, hard budget,
+#:   default 64 slots) — a replica id is a stable process-slot *name*
+#:   (``replica-0``), never a free-form string (a pod hash, a
+#:   timestamp); ``encode_snapshot``/``merge_wires``/``FleetAggregator``
+#:   all refuse unregistered or malformed ids, so the gauge-merge's
+#:   ``replica`` label and every ``fleet/*`` series stay bounded by the
+#:   same contract. ``state`` is ok|stale, ``outcome`` ok|error (scrape
+#:   verdicts), ``signal`` the divergence signal set
+#:   (``obs/fleet.py::DIVERGENCE_SIGNALS``) — all bounded by code.
 KNOWN_LABELS = {
     'bench': {'path', 'platform', 'quant', 'kernel'},
     'drift': {'feature'},
+    'fleet': {'replica', 'state', 'outcome', 'signal'},
     'learn': {'source', 'stage', 'verdict', 'head', 'model'},
     'mem': {'span', 'device', 'owner'},
     'num': {'fn', 'output', 'pair', 'quant'},

@@ -1,0 +1,520 @@
+"""End-to-end fleet smoke: 4 real replica processes under one aggregator.
+
+The ``make fleet-smoke`` gate for the cross-process telemetry plane.
+One parent process publishes a tiny fitted VAEP through a
+:class:`~socceraction_tpu.serve.ModelRegistry`, then spawns **four real
+replica processes** (this same file in ``--replica`` mode), each of
+which loads the model, serves its own synthetic traffic through a live
+:class:`~socceraction_tpu.serve.RatingService` under a ``RunLog``, and
+exposes a telemetry endpoint on a unix socket. The parent then asserts
+the plane's contracts:
+
+1. **Exact merge.** A :class:`~socceraction_tpu.obs.fleet.FleetAggregator`
+   scrapes all four endpoints; the merged ``serve/requests`` counter
+   must equal the per-replica totals' sum EXACTLY (counter-merge is
+   integer-exact), with per-replica queue-depth gauges surviving side
+   by side under ``replica`` labels.
+2. **Mesh-wide SLO.** Each replica scores its requests through its own
+   ``slo=`` engine; the aggregator re-evaluates the burn-rate engine
+   over the MERGED ``slo/events`` series, so the mesh-wide window event
+   count equals the fleet's total terminal requests.
+3. **Cross-process trace.** The parent mints a
+   :class:`~socceraction_tpu.obs.context.RequestContext`, records the
+   front-end enqueue in its own run log, ships ``ctx.to_wire()`` to
+   replica-0 through a job file; the replica reconstructs the context
+   (``from_wire``) and rates under it. ``obsctl trace <id>
+   front/obs.jsonl replica-0/obs.jsonl`` must stitch the two processes
+   into one hop-ordered timeline with the ``request_id`` preserved
+   end-to-end and the replica's queue→pad→dispatch→slice segments
+   attached.
+4. **Loud staleness.** The parent SIGKILLs one replica; the next
+   scrape + aggregate (one scrape interval later) must flag exactly
+   that replica stale, degrade the fleet status, and KEEP its
+   last-known counters in the merged sums — a dead replica is a loud
+   fleet-health fact, never a silent hole that makes fleet totals dip.
+5. **obsctl round trip.** ``obsctl fleet`` renders the same picture
+   live (``--endpoint`` scrapes) and post-mortem (the replicas' run
+   logs).
+
+Exit 0 on success; any violated invariant is a non-zero exit with the
+evidence printed. CPU-sized, but it really does run five Python
+processes — a couple of minutes, not seconds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+__all__ = ['main']
+
+N_REPLICAS = 4
+#: per-replica self-served request counts (distinct so the exact-sum
+#: assertion cannot pass by accident of symmetry)
+REQUESTS = tuple(3 + i for i in range(N_REPLICAS))
+READY_TIMEOUT_S = 240.0
+JOB_TIMEOUT_S = 120.0
+#: the aggregator's staleness horizon; the kill assertion scrapes once
+#: after this interval
+STALE_AFTER_S = 1.0
+
+
+# ---------------------------------------------------------------------------
+# replica mode: one process slot of the fleet
+# ---------------------------------------------------------------------------
+
+
+def _run_replica(args: list) -> int:
+    """``fleet_smoke.py --replica <id> <registry> <rundir> <socket>``.
+
+    Load the published model, serve self-generated traffic under a
+    RunLog + SLO engine, expose the telemetry endpoint, then process
+    job files (``<rundir>/jobs/*.json``: wire trace headers + a frame
+    seed) until a STOP file appears.
+    """
+    replica_id, registry_dir, rundir, socket_path, n_requests = (
+        args[0], args[1], args[2], args[3], int(args[4])
+    )
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.obs import RunLog, SLOConfig
+    from socceraction_tpu.obs.context import RequestContext
+    from socceraction_tpu.obs.endpoint import serve as serve_telemetry
+    from socceraction_tpu.serve import ModelRegistry, RatingService
+
+    registry = ModelRegistry(registry_dir)
+    # activation is per-process state: every replica activates the
+    # published version for itself (the registry DIRECTORY is shared)
+    registry.activate('fleet', '1')
+    _name, _version, model = registry.active()
+    jobs_dir = os.path.join(rundir, 'jobs')
+    os.makedirs(jobs_dir, exist_ok=True)
+    frame = synthetic_actions_frame(
+        game_id=0, seed=17, n_actions=96, home_team_id=100
+    )
+    with RunLog(os.path.join(rundir, 'obs.jsonl'), config={'replica': replica_id}):
+        with RatingService(
+            model,
+            max_actions=256,
+            max_batch_size=4,
+            max_wait_ms=1.0,
+            slo=SLOConfig.simple(latency_ms=60_000.0),
+        ) as service:
+            service.warmup()
+            for _ in range(n_requests):
+                service.rate_sync(frame, home_team_id=100, timeout=120)
+            with serve_telemetry(
+                telemetry=service.telemetry(replica=replica_id),
+                unix_path=socket_path,
+            ):
+                with open(os.path.join(rundir, 'READY'), 'w') as fh:
+                    fh.write(str(n_requests))
+                stop = os.path.join(rundir, 'STOP')
+                while not os.path.exists(stop):
+                    for name in sorted(os.listdir(jobs_dir)):
+                        if not name.endswith('.json'):
+                            continue
+                        job_path = os.path.join(jobs_dir, name)
+                        with open(job_path, encoding='utf-8') as fh:
+                            job = json.load(fh)
+                        os.unlink(job_path)
+                        ctx = RequestContext.from_wire(job['headers'])
+                        job_frame = synthetic_actions_frame(
+                            game_id=0,
+                            seed=int(job['seed']),
+                            n_actions=int(job['n_actions']),
+                            home_team_id=100,
+                        )
+                        result = service.rate(
+                            job_frame, home_team_id=100, context=ctx
+                        ).result(timeout=120)
+                        with open(job_path + '.done', 'w') as fh:
+                            json.dump(
+                                {
+                                    'request_id': ctx.request_id,
+                                    'hop': ctx.hop,
+                                    'n_rated': int(len(result)),
+                                },
+                                fh,
+                            )
+                    time.sleep(0.05)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent mode: publish, spawn, aggregate, assert
+# ---------------------------------------------------------------------------
+
+
+def _publish_model(registry_dir: str) -> None:
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.serve import ModelRegistry
+    from socceraction_tpu.vaep.base import VAEP
+
+    frame = synthetic_actions_frame(game_id=0, seed=0, n_actions=120)
+    model = VAEP()
+    game = pd.Series({'game_id': 0, 'home_team_id': 100})
+    np.random.seed(0)
+    model.fit(
+        model.compute_features(game, frame),
+        model.compute_labels(game, frame),
+        learner='mlp',
+        tree_params={'hidden': (8,), 'max_epochs': 2},
+    )
+    registry = ModelRegistry(registry_dir)
+    registry.publish('fleet', '1', model)
+    registry.activate('fleet', '1')
+
+
+def _wait_for(paths: list, timeout_s: float, what: str, problems: list) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(os.path.exists(p) for p in paths):
+            return True
+        time.sleep(0.1)
+    missing = [p for p in paths if not os.path.exists(p)]
+    problems.append(f'timed out waiting for {what}: missing {missing}')
+    return False
+
+
+def _per_replica_total(doc: dict, name: str, **labels: str) -> float:
+    for series in (doc['metrics'].get(name) or {}).get('series', ()):
+        if all(
+            (series.get('labels') or {}).get(k) == v
+            for k, v in labels.items()
+        ):
+            return float(series.get('total') or 0.0)
+    return 0.0
+
+
+def _obsctl(argv: list) -> tuple:
+    from tools.obsctl import main as obsctl_main
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = obsctl_main(argv)
+    return rc, out.getvalue()
+
+
+def main() -> int:
+    """Drive the fleet smoke (parent mode); returns an exit code."""
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    problems: list = []
+    from socceraction_tpu.obs import RunLog, SLOConfig
+    from socceraction_tpu.obs.context import (
+        new_request_context,
+        record_request_done,
+        record_request_enqueue,
+    )
+    from socceraction_tpu.obs.fleet import FleetAggregator
+    from socceraction_tpu.obs.metrics import MetricRegistry
+
+    with tempfile.TemporaryDirectory(prefix='fleet-smoke-') as tmp:
+        registry_dir = os.path.join(tmp, 'registry')
+        _publish_model(registry_dir)
+        replica_ids = [f'replica-{i}' for i in range(N_REPLICAS)]
+        rundirs = {rid: os.path.join(tmp, rid) for rid in replica_ids}
+        sockets = {
+            rid: os.path.join(tmp, f'{rid}.sock') for rid in replica_ids
+        }
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        children = {}
+        child_logs = {}
+        for i, rid in enumerate(replica_ids):
+            os.makedirs(rundirs[rid], exist_ok=True)
+            # child output goes to a file, never a PIPE: a chatty child
+            # (jax warnings, job-loop tracebacks) writing past the ~64KB
+            # pipe buffer with nobody reading would block forever and
+            # read as a misleading READY timeout
+            log_path = os.path.join(rundirs[rid], 'child.log')
+            child_logs[rid] = log_path
+            log_fh = open(log_path, 'w')
+            children[rid] = subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__), '--replica',
+                    rid, registry_dir, rundirs[rid], sockets[rid],
+                    str(REQUESTS[i]),
+                ],
+                env=env,
+                cwd=REPO,
+                stdout=log_fh,
+                stderr=subprocess.STDOUT,
+            )
+            log_fh.close()  # the child holds its own descriptor
+
+        def _child_tail(rid: str) -> str:
+            try:
+                with open(child_logs[rid], encoding='utf-8') as fh:
+                    return fh.read()[-2000:]
+            except OSError:
+                return '<no child log>'
+        try:
+            ready = _wait_for(
+                [os.path.join(d, 'READY') for d in rundirs.values()],
+                READY_TIMEOUT_S, 'replica READY files', problems,
+            )
+            if not ready:
+                for rid, proc in children.items():
+                    if proc.poll() is not None:
+                        problems.append(
+                            f'{rid} exited {proc.returncode} early: '
+                            f'{_child_tail(rid)}'
+                        )
+                return _finish(problems)
+
+            # -- 1/2: scrape all four, exact merge + mesh-wide SLO -------
+            # sick_factor far above the default: four cold CPU processes
+            # warm up under scheduler contention, so cross-replica p99
+            # jitter here is environment noise, not the signal this
+            # smoke gates on (tests/test_fleet.py pins divergence with
+            # controlled inputs)
+            aggregator = FleetAggregator(
+                {rid: sockets[rid] for rid in replica_ids},
+                stale_after_s=STALE_AFTER_S,
+                sick_factor=50.0,
+                slo=SLOConfig.simple(latency_ms=60_000.0),
+                registry=MetricRegistry(),
+            )
+            outcomes = aggregator.scrape()
+            if not all(outcomes.values()):
+                problems.append(f'initial scrape failed: {outcomes}')
+            snap = aggregator.aggregate()
+            if snap.status != 'ok' or snap.stale_replicas:
+                problems.append(
+                    f'fresh fleet not ok: status={snap.status} '
+                    f'stale={snap.stale_replicas} '
+                    f'divergence={[r for r in snap.divergence if r["sick"]]}'
+                )
+            docs = {rid: aggregator.last_wire(rid) for rid in replica_ids}
+            per_replica = {
+                rid: _per_replica_total(
+                    docs[rid], 'serve/requests', kind='rate'
+                )
+                for rid in replica_ids
+            }
+            merged_total = snap.typed().value('serve/requests', kind='rate')
+            if merged_total != sum(per_replica.values()):
+                problems.append(
+                    f'merged serve/requests {merged_total} != per-replica '
+                    f'sum {sum(per_replica.values())} ({per_replica})'
+                )
+            expected = dict(zip(replica_ids, (float(n) for n in REQUESTS)))
+            if per_replica != expected:
+                problems.append(
+                    f'per-replica request counts {per_replica} != served '
+                    f'{expected}'
+                )
+            typed = snap.typed()
+            depth_replicas = {
+                s.labels.get('replica')
+                for s in (
+                    typed.get('serve/queue_depth').series
+                    if typed.get('serve/queue_depth') is not None
+                    else ()
+                )
+            }
+            if depth_replicas != set(replica_ids):
+                problems.append(
+                    'gauge merge lost replica labels: '
+                    f'{sorted(depth_replicas)}'
+                )
+            if snap.slo is None:
+                problems.append('no mesh-wide SLO evaluation on the snapshot')
+            else:
+                errors_entry = snap.slo['objectives']['errors']
+                fleet_events = errors_entry['window_events_slow']
+                if fleet_events != sum(REQUESTS):
+                    problems.append(
+                        f'mesh-wide SLO window saw {fleet_events} events, '
+                        f'fleet served {sum(REQUESTS)}'
+                    )
+
+            # -- 3: kill one replica -> loud staleness, no silent hole.
+            # Runs BEFORE the cross-process job so no new traffic lands
+            # between the two scrapes and the merged totals must match
+            # the first scrape's sum exactly.
+            victim = replica_ids[-1]
+            victim_total = per_replica[victim]
+            children[victim].send_signal(signal.SIGKILL)
+            children[victim].wait(timeout=30)
+            time.sleep(STALE_AFTER_S)
+            outcomes = aggregator.scrape()
+            if outcomes.get(victim):
+                problems.append(f'scrape of killed {victim} reported ok')
+            snap = aggregator.aggregate()
+            if snap.stale_replicas != (victim,):
+                problems.append(
+                    f'stale replicas {snap.stale_replicas}, want '
+                    f'({victim!r},) one scrape interval after the kill'
+                )
+            if snap.status != 'degraded':
+                problems.append(
+                    f'fleet status {snap.status!r} with a dead replica'
+                )
+            merged_after = snap.typed().value('serve/requests', kind='rate')
+            if merged_after != sum(per_replica.values()):
+                problems.append(
+                    f'dead {victim} fell out of the merged sums: '
+                    f'{merged_after} != {sum(per_replica.values())} — a '
+                    'stale replica must stay in, flagged'
+                )
+            if victim_total <= 0:
+                problems.append('victim served no requests before the kill')
+
+            # -- 4: cross-process trace over the job hop -----------------
+            front_log = os.path.join(tmp, 'front', 'obs.jsonl')
+            target = replica_ids[0]
+            with RunLog(front_log, config={'role': 'front'}):
+                ctx = new_request_context('rate')
+                record_request_enqueue(ctx, queue_depth=0)
+                t0 = time.perf_counter()
+                job = {
+                    'headers': ctx.to_wire(),
+                    'seed': 99,
+                    'n_actions': 80,
+                }
+                job_path = os.path.join(
+                    rundirs[target], 'jobs', 'job-1.json'
+                )
+                with open(job_path + '.tmp', 'w') as fh:
+                    json.dump(job, fh)
+                os.replace(job_path + '.tmp', job_path)
+                done_path = job_path + '.done'
+                if _wait_for(
+                    [done_path], JOB_TIMEOUT_S, 'the cross-process job',
+                    problems,
+                ):
+                    with open(done_path, encoding='utf-8') as fh:
+                        done = json.load(fh)
+                    if done['request_id'] != ctx.request_id:
+                        problems.append(
+                            f'request id mutated over the hop: sent '
+                            f'{ctx.request_id}, replica saw '
+                            f'{done["request_id"]}'
+                        )
+                    if done['hop'] != 1:
+                        problems.append(
+                            f'hop count {done["hop"]} != 1 after one '
+                            'process boundary'
+                        )
+                    record_request_done(
+                        ctx, 'ok', time.perf_counter() - t0
+                    )
+            rc, out = _obsctl(
+                [
+                    'trace', ctx.request_id, front_log,
+                    os.path.join(rundirs[target], 'obs.jsonl'), '--json',
+                ]
+            )
+            if rc != 0:
+                problems.append(f'obsctl trace exited {rc}')
+            else:
+                trace = json.loads(out)
+                hops = trace.get('hops') or []
+                if len(hops) != 2:
+                    problems.append(
+                        f'obsctl trace stitched {len(hops)} hop(s), want 2'
+                    )
+                elif not (
+                    hops[0]['enqueue'] is not None
+                    and hops[1]['flush'] is not None
+                    and {'queue_wait', 'pad', 'dispatch', 'slice'}
+                    <= set(trace.get('segments') or {})
+                ):
+                    problems.append(
+                        'obsctl trace did not reconstruct front-end '
+                        'enqueue -> replica flush -> dispatch -> slice: '
+                        f'{out[:400]}'
+                    )
+
+            # -- 5: obsctl fleet round trips, live and post-mortem -------
+            live_endpoints: list = []
+            for rid in replica_ids[:-1]:
+                live_endpoints += ['--endpoint', sockets[rid]]
+            rc, out = _obsctl(['fleet', *live_endpoints, '--json'])
+            if rc != 0:
+                problems.append(f'obsctl fleet (live) exited {rc}')
+            else:
+                summary = json.loads(out)
+                got = {r['replica'] for r in summary['replicas']}
+                if got != set(replica_ids[:-1]):
+                    problems.append(
+                        f'obsctl fleet (live) lost replicas: {sorted(got)}'
+                    )
+        finally:
+            for rid in replica_ids:
+                with open(
+                    os.path.join(rundirs[rid], 'STOP'), 'w'
+                ) as fh:
+                    fh.write('stop')
+            for rid, proc in children.items():
+                if proc.poll() is None:
+                    try:
+                        proc.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait(timeout=10)
+                        problems.append(f'{rid} had to be killed at stop')
+        for rid, proc in children.items():
+            if rid != replica_ids[-1] and proc.returncode != 0:
+                problems.append(
+                    f'{rid} exited {proc.returncode}: {_child_tail(rid)}'
+                )
+
+        # post-mortem: the closed run logs reconstruct the same fleet
+        survivors = [
+            os.path.join(rundirs[rid], 'obs.jsonl')
+            for rid in replica_ids[:-1]
+        ]
+        rc, out = _obsctl(['fleet', *survivors, '--json'])
+        if rc != 0:
+            problems.append(f'obsctl fleet (post-mortem) exited {rc}')
+        else:
+            summary = json.loads(out)
+            merged = summary['metrics'].get('serve/requests') or {}
+            total = sum(
+                float(s.get('total') or 0.0)
+                for s in merged.get('series', ())
+                if (s.get('labels') or {}).get('kind') == 'rate'
+            )
+            # the survivors' closed logs include the cross-process job
+            # on replica-0, so >= their self-served counts
+            floor = sum(REQUESTS[:-1])
+            if total < floor:
+                problems.append(
+                    f'post-mortem merge lost requests: {total} < {floor}'
+                )
+    return _finish(problems)
+
+
+def _finish(problems: list) -> int:
+    if problems:
+        for p in problems:
+            print(f'fleet-smoke: FAIL - {p}')
+        return 1
+    print(
+        'fleet-smoke: OK - 4 replicas scraped, merged counters exact, '
+        'mesh-wide SLO over merged series, cross-process trace stitched, '
+        'killed replica loud-stale (kept in sums), obsctl fleet round-trips'
+    )
+    return 0
+
+
+if __name__ == '__main__':
+    if len(sys.argv) > 1 and sys.argv[1] == '--replica':
+        os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+        sys.exit(_run_replica(sys.argv[2:]))
+    sys.exit(main())

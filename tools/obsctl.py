@@ -10,6 +10,9 @@ required writing Python. ``obsctl`` is the no-Python surface::
     python tools/obsctl.py tail obs.jsonl -n 30  # recent events, readable
     python tools/obsctl.py tail obs.jsonl --area serve --since 5m
     python tools/obsctl.py trace <request_id> obs.jsonl  # one request's path
+    python tools/obsctl.py trace <id> front/obs.jsonl replica-0/obs.jsonl
+    #   ^ several run logs stitch one request ACROSS processes (the id
+    #     rides RequestContext.to_wire() over the hop)
     python tools/obsctl.py prom obs.jsonl        # Prometheus text
     python tools/obsctl.py bundle /tmp/socceraction-tpu-debug  # post-mortem
     python tools/obsctl.py promotions obs.jsonl  # gate decisions, readable
@@ -19,6 +22,15 @@ required writing Python. ``obsctl`` is the no-Python surface::
     python tools/obsctl.py resil --journal learn-journal.jsonl  # + journal tail
     python tools/obsctl.py capacity              # live roofline + residency
     python tools/obsctl.py capacity obs.jsonl    # + cold-start timeline
+    python tools/obsctl.py fleet --endpoint /run/r0.sock --endpoint /run/r1.sock
+    python tools/obsctl.py fleet replica-0/obs.jsonl replica-1/obs.jsonl
+
+``fleet`` renders the aggregated mesh: every replica's merged snapshot
+(counters summed, gauges per replica, histograms merged bucket-wise),
+per-replica staleness (a dead replica is flagged, never silently
+dropped from the sums) and the divergence table (a replica 3x past the
+fleet median p99/parity, or with a non-closed breaker, is called out) —
+live from ``--endpoint`` scrapes or post-mortem from replica run logs.
 
 ``trace`` reconstructs one request's queue → flush → dispatch → slice
 path from its ``request_enqueue``/``request_done`` events plus the
@@ -98,14 +110,42 @@ __all__ = ['main']
 def _read_events(path: str) -> List[Dict[str, Any]]:
     events = []
     with open(path, encoding='utf-8') as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, start=1):
             line = line.strip()
             if not line:
                 continue
             try:
                 events.append(json.loads(line))
             except json.JSONDecodeError:
-                continue  # a torn tail line in a live log is expected
+                # a torn tail line in a live log is expected and must not
+                # fail the read — but say so, per line (the benchdiff
+                # ledger-reader policy): a torn line anywhere else
+                # suggests real corruption worth a look
+                print(
+                    f'obsctl: warning: skipping corrupt line {lineno} '
+                    f'in {path} (torn write?)',
+                    file=sys.stderr,
+                )
+                continue
+    return events
+
+
+def _read_events_multi(paths: List[str]) -> List[Dict[str, Any]]:
+    """Read several run logs into one ``ts``-ordered event stream.
+
+    The multi-process form every runlog-taking subcommand shares: each
+    event is annotated with its source log under ``_runlog`` (stripped
+    from ``--json`` output only where the single-log shape is pinned),
+    corrupt lines skip per file with a warning, and a missing file is
+    one actionable error line (the ``OSError`` net in :func:`main`)
+    naming the path — never a traceback.
+    """
+    events: List[Dict[str, Any]] = []
+    for path in paths:
+        for event in _read_events(path):
+            event['_runlog'] = path
+            events.append(event)
+    events.sort(key=lambda e: float(e.get('ts') or 0.0))
     return events
 
 
@@ -326,61 +366,82 @@ def _filter_events(
 
 
 def _cmd_tail(args: argparse.Namespace) -> int:
-    """``tail <runlog> [-n N] [--area A] [--span S] [--since T]``."""
-    events = _filter_events(_read_events(args.runlog), args)[-args.n :]
+    """``tail <runlog> [runlog ...] [-n N] [--area A] [--span S] [--since T]``.
+
+    Several run logs merge into one ``ts``-ordered stream (the
+    fleet post-mortem view); each event then carries/shows its source
+    log (``_runlog`` in ``--json``, a ``[basename]`` prefix in the
+    human rendering). A single log keeps the original byte-identical
+    output shape.
+    """
+    multi = len(args.runlog) > 1
+    events = _filter_events(_read_events_multi(args.runlog), args)[-args.n :]
+    if not multi:
+        for event in events:
+            event.pop('_runlog', None)
     if args.json:
         for event in events:
             print(json.dumps(event, sort_keys=True))
         return 0
     for event in events:
-        print(_fmt_event(event))
-    print(f'obsctl tail: {len(events)} event(s) from {args.runlog}')
+        src = event.pop('_runlog', None)
+        prefix = f'[{os.path.basename(os.path.dirname(src) or src)}] ' if multi and src else ''
+        print(prefix + _fmt_event(event))
+    logs = ', '.join(args.runlog)
+    print(f'obsctl tail: {len(events)} event(s) from {logs}')
     return 0
 
 
-def _cmd_trace(args: argparse.Namespace) -> int:
-    """``trace <request_id> <runlog>``: one request's full path.
+def _trace_hops(rid: str, paths: List[str]) -> List[Dict[str, Any]]:
+    """One hop record per run log that saw the request, path-ordered.
 
-    Reconstructs queue → flush → dispatch → slice from the request's
-    ``request_enqueue`` / ``request_done`` events plus the
-    ``serve/flush`` span that lists the id among its coalesced children.
+    A hop is one process's view of the request: its
+    ``request_enqueue``/``request_done`` events plus the ``serve/flush``
+    span that coalesced it there. Hops order by the context's ``hop``
+    counter (stamped by ``RequestContext.from_wire`` on every process
+    boundary), then by first-seen timestamp — front-end enqueue before
+    replica flush even when the two hosts' clocks disagree slightly.
     """
-    rid = args.request_id
-    enqueue = done = flush = None
-    for event in _read_events(args.runlog):
+    hops: Dict[str, Dict[str, Any]] = {}
+
+    def hop_for(src: str) -> Dict[str, Any]:
+        return hops.setdefault(
+            src,
+            {'runlog': src, 'enqueue': None, 'flush': None, 'done': None},
+        )
+
+    for event in _read_events_multi(paths):
         et = event.get('event') or event.get('kind')
+        src = event.pop('_runlog')
         if event.get('request_id') == rid:
             if et == 'request_enqueue':
-                enqueue = event
+                hop_for(src)['enqueue'] = event
             elif et == 'request_done':
-                done = event
+                hop_for(src)['done'] = event
         elif et == 'span_close' and event.get('name') == 'serve/flush':
             attrs = event.get('attrs') or {}
             if rid in (attrs.get('request_ids') or ()):
-                flush = event
-    if enqueue is None and done is None and flush is None:
-        print(
-            f'obsctl: no events for request {rid} in {args.runlog}',
-            file=sys.stderr,
+                hop_for(src)['flush'] = event
+
+    def order_key(rec: Dict[str, Any]) -> Any:
+        events = [e for e in (rec['enqueue'], rec['done'], rec['flush']) if e]
+        hop_no = max(
+            (int(e.get('hop') or 0) for e in events), default=0
         )
-        return 1
-    segments = (done or {}).get('segments') or {}
-    trace = {
-        'request_id': rid,
-        'kind': (done or enqueue or {}).get('request_kind'),
-        'status': (done or {}).get('status'),
-        'wall_s': (done or {}).get('wall_s'),
-        'segments': segments,
-        'bucket': (done or {}).get('bucket'),
-        'coalesced': (done or {}).get('coalesced'),
-        'enqueue': enqueue,
-        'flush': flush,
-        'done': done,
-    }
-    if args.json:
-        print(json.dumps(trace, sort_keys=True, default=str))
-        return 0
-    print(f'request: {rid}  kind={trace["kind"]}  status={trace["status"]}')
+        first_ts = min(
+            (float(e.get('ts') or 0.0) for e in events), default=0.0
+        )
+        return (hop_no, first_ts)
+
+    ordered = sorted(hops.values(), key=order_key)
+    for rec in ordered:
+        events = [e for e in (rec['enqueue'], rec['done'], rec['flush']) if e]
+        rec['hop'] = max((int(e.get('hop') or 0) for e in events), default=0)
+    return ordered
+
+
+def _print_trace_hop(rec: Dict[str, Any]) -> None:
+    enqueue, flush, done = rec['enqueue'], rec['flush'], rec['done']
     if enqueue is not None:
         depth = enqueue.get('queue_depth')
         print(
@@ -400,6 +461,7 @@ def _cmd_trace(args: argparse.Namespace) -> int:
             f'coalesced={len(attrs.get("request_ids") or ())}  '
             f'{(flush.get("duration_s") or 0.0) * 1e3:.2f}ms'
         )
+    segments = (done or {}).get('segments') or {}
     if segments:
         path = '  ->  '.join(
             f'{seg} {segments[seg] * 1e3:.2f}ms'
@@ -416,6 +478,61 @@ def _cmd_trace(args: argparse.Namespace) -> int:
         if done.get('error'):
             line += f'  error={done["error"]}'
         print(line)
+
+
+def _cmd_trace(args: argparse.Namespace) -> int:
+    """``trace <request_id> <runlog> [runlog ...]``: one request's path.
+
+    Reconstructs queue → flush → dispatch → slice from the request's
+    ``request_enqueue`` / ``request_done`` events plus the
+    ``serve/flush`` span that lists the id among its coalesced children.
+    Several run logs stitch the request ACROSS processes: the
+    ``request_id`` rides ``RequestContext.to_wire()`` over the hop, so
+    the front end's enqueue and the replica's flush/dispatch/slice join
+    into one hop-ordered timeline.
+    """
+    rid = args.request_id
+    hops = _trace_hops(rid, args.runlog)
+    if not hops:
+        logs = ', '.join(args.runlog)
+        print(f'obsctl: no events for request {rid} in {logs}', file=sys.stderr)
+        return 1
+    # the dispatching hop (segments recorded) carries the authoritative
+    # status/wall; the FIRST hop carries the end-to-end enqueue
+    final = next(
+        (
+            rec
+            for rec in reversed(hops)
+            if (rec['done'] or {}).get('segments')
+        ),
+        hops[-1],
+    )
+    done = final['done']
+    enqueue = hops[0]['enqueue']
+    segments = (done or {}).get('segments') or {}
+    trace = {
+        'request_id': rid,
+        'kind': (done or enqueue or {}).get('request_kind'),
+        'status': (done or {}).get('status'),
+        'wall_s': (done or {}).get('wall_s'),
+        'segments': segments,
+        'bucket': (done or {}).get('bucket'),
+        'coalesced': (done or {}).get('coalesced'),
+        'enqueue': enqueue,
+        'flush': final['flush'],
+        'done': done,
+        'hops': hops,
+    }
+    if args.json:
+        print(json.dumps(trace, sort_keys=True, default=str))
+        return 0
+    print(f'request: {rid}  kind={trace["kind"]}  status={trace["status"]}')
+    if len(hops) == 1:
+        _print_trace_hop(hops[0])
+        return 0
+    for rec in hops:
+        print(f'-- hop {rec["hop"]}  {rec["runlog"]}')
+        _print_trace_hop(rec)
     return 0
 
 
@@ -948,6 +1065,159 @@ def _cmd_capacity(args: argparse.Namespace) -> int:
     return 0
 
 
+def _runlog_replica_id(path: str, taken: set) -> str:
+    """A replica id for a post-mortem run log: its directory's basename.
+
+    The fleet layout writes one run-log directory per replica
+    (``replica-0/obs.jsonl``), so the directory name IS the slot name;
+    sanitized to the wire id shape and de-duplicated.
+    """
+    import re
+
+    base = os.path.basename(os.path.dirname(os.path.abspath(path)))
+    rid = re.sub(r'[^a-z0-9_.-]', '-', base.lower()).strip('-') or 'replica'
+    if not rid[0].isalnum():
+        rid = 'r' + rid
+    # the wire id shape caps at 64 chars; leave room for the dedup suffix
+    rid = rid[:60]
+    candidate, n = rid, 2
+    while candidate in taken:
+        candidate, n = f'{rid}-{n}', n + 1
+    taken.add(candidate)
+    return candidate
+
+
+def _cmd_fleet(args: argparse.Namespace) -> int:
+    """``fleet [runlog ...] [--endpoint ADDR ...]``: the aggregated mesh.
+
+    Live: scrape each ``--endpoint`` (unix socket path or host:port —
+    the replica names itself through its wire document), aggregate, and
+    render the merged snapshot, per-replica staleness and the
+    divergence table. Post-mortem: each run log's last embedded
+    ``metrics`` snapshot is ingested as one replica's document (replica
+    id: the log's directory name), then merged the same way — compact
+    embedded snapshots merge without quantile estimates, which the
+    divergence table says rather than hides. Mesh-wide SLO *burn* needs
+    an objective config and a window of evaluations, so it lives in the
+    front end's :class:`FleetAggregator`; here the merged ``slo/events``
+    evidence renders directly.
+    """
+    from socceraction_tpu.obs.fleet import FleetAggregator
+    from socceraction_tpu.obs.metrics import MetricRegistry
+    from socceraction_tpu.obs.wire import WireError, encode_snapshot
+
+    if not args.runlog and not args.endpoint:
+        print(
+            'obsctl: fleet needs run logs and/or --endpoint addresses',
+            file=sys.stderr,
+        )
+        return 1
+    # a private registry: obsctl is a reader, its fleet/* bookkeeping
+    # must not leak into the live process registry it may be asked to
+    # render next
+    aggregator = FleetAggregator(
+        registry=MetricRegistry(), stale_after_s=args.stale_after
+    )
+    problems: List[str] = []
+    for address in args.endpoint or ():
+        from socceraction_tpu.obs.endpoint import EndpointError, scrape
+
+        # WireError covers a malformed/newer-versioned document or an
+        # ungoverned replica id — operator problems, never tracebacks
+        try:
+            doc = scrape(address)
+            aggregator.add_replica(str(doc['replica']), address)
+            aggregator.ingest(doc)
+        except (EndpointError, WireError) as e:
+            problems.append(f'endpoint {address}: {e}')
+            continue
+    taken: set = set()
+    for path in args.runlog or ():
+        events = _read_events(path)
+        snapshot = _last_snapshot(events)
+        if snapshot is None:
+            problems.append(f'no metrics event in {path}')
+            continue
+        ts = max(
+            (float(e.get('ts') or 0.0) for e in events), default=None
+        )
+        try:
+            aggregator.ingest(
+                encode_snapshot(
+                    snapshot,
+                    replica=_runlog_replica_id(path, taken),
+                    time_unix=ts,
+                )
+            )
+        except WireError as e:
+            problems.append(f'{path}: {e}')
+    try:
+        snap = aggregator.aggregate()
+    except WireError as e:
+        # conflicting instrument definitions across replicas (skewed
+        # code?) — one actionable line, not a traceback
+        print(f'obsctl: cannot merge the fleet: {e}', file=sys.stderr)
+        return 1
+    summary = {
+        'status': snap.status,
+        'replicas': [
+            {
+                'replica': r.replica,
+                'address': r.address,
+                'reachable': r.reachable,
+                'stale': r.stale,
+                'age_s': r.age_s,
+                'error': r.error,
+            }
+            for r in snap.replicas
+        ],
+        'metrics': snap.metrics,
+        'divergence': list(snap.divergence),
+        'problems': problems,
+    }
+    if args.json:
+        print(json.dumps(summary, sort_keys=True, default=str))
+        return 0 if snap.replicas else 1
+    for row in summary['replicas']:
+        line = f'replica   : {row["replica"]}'
+        if row['address']:
+            line += f'  {row["address"]}'
+        if row['age_s'] is not None:
+            line += f'  age={row["age_s"]:.1f}s'
+        line += '  STALE' if row['stale'] else '  ok'
+        if row['error']:
+            line += f'  ({row["error"]})'
+        print(line)
+    for row in summary['divergence']:
+        if not row['sick']:
+            continue
+        ratio = (
+            f'{row["ratio"]:.1f}x median'
+            if row['ratio'] is not None
+            else 'non-closed'
+        )
+        print(
+            f'diverging : {row["replica"]}  {row["signal"]}='
+            f'{row["value"]:.6g}  ({ratio})'
+        )
+    # merged slo/events evidence, per objective
+    for s in (snap.metrics.get('slo/events') or {}).get('series', ()):
+        labels = s.get('labels') or {}
+        print(
+            f'slo       : objective={labels.get("objective", "?")} '
+            f'outcome={labels.get("outcome", "?")} total={s.get("total"):g}'
+        )
+    _print_snapshot(snap.metrics, as_json=False)
+    for p in problems:
+        print(f'obsctl: warning: {p}', file=sys.stderr)
+    n_stale = len(snap.stale_replicas)
+    print(
+        f'obsctl fleet: {len(snap.replicas)} replica(s), {n_stale} stale, '
+        f'status={snap.status}'
+    )
+    return 0 if snap.replicas else 1
+
+
 def _fmt_promotion(event: Dict[str, Any]) -> str:
     """One human-readable line block per promotion report."""
     lines = []
@@ -1100,7 +1370,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=_cmd_prom)
 
     p = sub.add_parser('tail', help='recent run-log events, human-readable')
-    p.add_argument('runlog')
+    p.add_argument(
+        'runlog', nargs='+',
+        help='one or more obs.jsonl logs (several merge ts-ordered)',
+    )
     p.add_argument('-n', type=int, default=20)
     p.add_argument(
         '--area',
@@ -1119,9 +1392,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         'trace', help="reconstruct one request's queue->flush->dispatch path"
     )
     p.add_argument('request_id')
-    p.add_argument('runlog')
+    p.add_argument(
+        'runlog', nargs='+',
+        help='one or more obs.jsonl logs (several stitch the request '
+        'across processes)',
+    )
     p.add_argument('--json', action='store_true')
     p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser(
+        'fleet',
+        help='aggregate replica snapshots: merge, staleness, divergence',
+    )
+    p.add_argument(
+        'runlog', nargs='*',
+        help='replica run logs to ingest post-mortem (replica id = the '
+        "log's directory name)",
+    )
+    p.add_argument(
+        '--endpoint', action='append', metavar='ADDR',
+        help='live replica telemetry endpoint (unix socket path or '
+        'host:port); repeatable',
+    )
+    p.add_argument(
+        '--stale-after', type=float, default=10.0,
+        help='seconds after which an unrefreshed replica reads stale',
+    )
+    p.add_argument('--json', action='store_true')
+    p.set_defaults(fn=_cmd_fleet)
 
     p = sub.add_parser('drift', help="tail the drift watch's check events")
     p.add_argument('runlog')
