@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke capacity-smoke fleet-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke capacity-smoke fleet-smoke mesh-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -68,6 +68,20 @@ capacity-smoke:
 fleet-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
 	env JAX_PLATFORMS=cpu $(PY) bench.py --fleet-smoke
+
+# mesh-sharded serving, driven end to end on CPU: tools/mesh_smoke.py
+# runs a ServingFrontend over a 4-replica RatingService on an 8-virtual-
+# device mesh (client -> unix-socket front end -> flush lanes -> replica
+# devices), asserting the cores-aware scaling gate (>=2x req/s at 4
+# replicas when >=4 physical cores; no-regression floor + printed note
+# otherwise), zero steady-state retraces per replica, a bitwise mesh
+# swap + rollback round trip, and the fleet scrape merging the
+# per-replica serve metrics exactly; then bench.py --mesh-sweep records
+# the 1/2/4/8-replica scaling curve (serve_req_per_sec_r4 + per-replica
+# segment decomposition + scaling efficiency) into the ledger
+mesh-smoke:
+	$(PY) tools/mesh_smoke.py
+	$(PY) bench.py --mesh-sweep
 
 types:
 	@$(PY) -c "import mypy" 2>/dev/null \

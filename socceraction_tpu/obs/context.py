@@ -197,18 +197,23 @@ def new_request_context(
 
 
 def record_segment(
-    segment: str, seconds: float, request_id: Optional[str] = None
+    segment: str, seconds: float, request_id: Optional[str] = None,
+    **labels: str,
 ) -> None:
     """One sample of the per-request wall decomposition.
 
     Lands in ``serve/segment_seconds{segment=...}`` with ``request_id``
     attached as the series' exemplar — the operator's jump from "p99 of
     queue_wait spiked" to one concrete request to ``obsctl trace``.
+    Lane-scoped callers (the mesh-replicated flush paths) add a
+    ``replica=`` label so the decomposition splits per replica;
+    single-lane services pass nothing and the series stays unchanged.
     """
     histogram('serve/segment_seconds', unit='s').observe(
         seconds,
         exemplar={'request_id': request_id} if request_id else None,
         segment=segment,
+        **labels,
     )
 
 

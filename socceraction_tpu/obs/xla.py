@@ -134,14 +134,55 @@ def _cost_enabled() -> bool:
     return os.environ.get('SOCCERACTION_TPU_XLA_COST', '1') != '0'
 
 
+_DEFAULT_DEVICE_ID: Optional[int] = None
+
+
+def _off_default_device_id(x: Any) -> Optional[int]:
+    """Device id of a leaf committed off the default device, else None.
+
+    ``jax.jit``'s own cache keys committed argument placement: the same
+    shapes on another device are a *different executable*. The mesh
+    serving tier (:mod:`socceraction_tpu.parallel.serve`) dispatches
+    per-replica flushes with every argument committed to that replica's
+    device, so the observatory must key placement too — otherwise the
+    second replica's compile is invisible (the shape-only key already
+    exists) and, worse, a device-0-bound AOT preloaded executable would
+    serve a replica lane it was never compiled for. Default-device and
+    host/numpy leaves contribute ``None`` so spec-derived AOT keys (no
+    placement) still coincide with live default-path calls; sharded
+    multi-device arrays key by shape alone (their sharding is resolved
+    inside the jitted program, not by this fast path).
+    """
+    sharding = getattr(x, 'sharding', None)
+    if sharding is None:
+        return None
+    try:
+        device_set = sharding.device_set
+        if len(device_set) != 1:
+            return None
+        (d,) = device_set
+        did = d.id
+    except Exception:
+        return None
+    global _DEFAULT_DEVICE_ID
+    if _DEFAULT_DEVICE_ID is None:
+        import jax
+
+        _DEFAULT_DEVICE_ID = jax.local_devices()[0].id
+    return None if did == _DEFAULT_DEVICE_ID else did
+
+
 def _leaf_desc(x: Any) -> str:
     """One leaf of an abstract signature: ``float32[64,1664]``, a scalar
     *type* (dynamic Python scalars are cached by aval, not value), or
-    repr for anything else."""
+    repr for anything else. Leaves committed off the default device
+    carry an ``@d<id>`` suffix (see :func:`_off_default_device_id`)."""
     shape = getattr(x, 'shape', None)
     dtype = getattr(x, 'dtype', None)
     if shape is not None and dtype is not None:
-        return f'{dtype}[{",".join(str(d) for d in shape)}]'
+        desc = f'{dtype}[{",".join(str(d) for d in shape)}]'
+        did = _off_default_device_id(x)
+        return desc if did is None else f'{desc}@d{did}'
     if isinstance(x, (bool, int, float, complex)):
         # a dynamic Python scalar traces as a weak-typed 0-d array: its
         # VALUE does not key the jit cache, so it must not key ours
@@ -155,7 +196,10 @@ def _leaf_key(x: Any) -> Any:
     shape = getattr(x, 'shape', None)
     dtype = getattr(x, 'dtype', None)
     if shape is not None and dtype is not None:
-        return (dtype, tuple(shape))
+        did = _off_default_device_id(x)
+        if did is None:
+            return (dtype, tuple(shape))
+        return (dtype, tuple(shape), did)
     if isinstance(x, (bool, int, float, complex)):
         return type(x)  # dynamic scalar: keyed by aval, not value
     return repr(x)

@@ -17,18 +17,29 @@ first-class subsystem built on ``jax.sharding``:
 - for sequences too long for one device, the **action axis** itself can
   shard over a ``(games, seq)`` mesh with halo-exchange kernels
   (:mod:`~socceraction_tpu.parallel.sequence` — the action-stream analog
-  of ring attention).
+  of ring attention),
+- serving fan-out replicates the fused rating dispatch across a 1-D
+  ``replicas`` mesh (:mod:`~socceraction_tpu.parallel.serve` —
+  replicated params, batch-sharded games, zero collectives), the
+  execution tier behind ``RatingService(n_replicas=N)``.
 """
 
 from .mesh import (
     batch_sharding,
     make_mesh,
+    make_replica_mesh,
     pad_games,
     replicated,
     shard_batch,
 )
 from .xt import sharded_xt_counts, sharded_xt_fit, sharded_xt_fit_matrix_free
-from .vaep import make_train_step, sharded_rate, train_distributed
+from .vaep import (
+    data_parallel_rate,
+    make_train_step,
+    sharded_rate,
+    train_distributed,
+)
+from .serve import ReplicaDispatcher
 from .sequence import (
     make_sequence_mesh,
     sequence_features,
@@ -40,6 +51,7 @@ from .sequence import (
 
 __all__ = [
     'make_mesh',
+    'make_replica_mesh',
     'batch_sharding',
     'pad_games',
     'replicated',
@@ -47,9 +59,11 @@ __all__ = [
     'sharded_xt_counts',
     'sharded_xt_fit',
     'sharded_xt_fit_matrix_free',
+    'data_parallel_rate',
     'make_train_step',
     'sharded_rate',
     'train_distributed',
+    'ReplicaDispatcher',
     'make_sequence_mesh',
     'shard_batch_seq',
     'sequence_features',

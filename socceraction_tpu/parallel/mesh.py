@@ -24,7 +24,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.batch import ActionBatch
 
-__all__ = ['make_mesh', 'batch_sharding', 'pad_games', 'replicated', 'shard_batch']
+__all__ = [
+    'make_mesh',
+    'make_replica_mesh',
+    'batch_sharding',
+    'pad_games',
+    'replicated',
+    'shard_batch',
+]
 
 
 def make_mesh(
@@ -57,6 +64,38 @@ def make_mesh(
         )
     arr = np.asarray(devices).reshape(n // model_parallel, model_parallel)
     return Mesh(arr, axis_names=('games', 'model'))
+
+
+def make_replica_mesh(
+    n_replicas: Optional[int] = None,
+    *,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build the 1-D ``('replicas',)`` mesh of the serving fan-out.
+
+    The serving tier (:mod:`socceraction_tpu.parallel.serve`) is pure
+    data parallelism with a different contract than the training mesh:
+    params are replicated once at model load, each replica owns whole
+    flush batches (scattered along the game axis by
+    ``shard_map`` — resolved through the compat shim,
+    :mod:`socceraction_tpu.ops.compat` — for gang dispatches, or
+    committed per-device for independent flush lanes), and no
+    collective ever crosses the axis. A distinct axis name keeps a
+    serving mesh from ever being confused with a ``('games','model')``
+    training mesh in sharding specs.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_replicas is not None:
+            devices = devices[:n_replicas]
+    devices = list(devices)
+    if n_replicas is not None and len(devices) < n_replicas:
+        raise ValueError(
+            f'{n_replicas} replicas requested but only {len(devices)} '
+            'devices are available (on CPU, raise '
+            '--xla_force_host_platform_device_count)'
+        )
+    return Mesh(np.asarray(devices), axis_names=('replicas',))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

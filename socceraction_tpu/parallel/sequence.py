@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.batch import ActionBatch, AtomicActionBatch
+from ..ops.compat import axis_size, shard_map
 
 __all__ = [
     'make_sequence_mesh',
@@ -230,7 +231,7 @@ def _left_halo(x: jax.Array, h: int, axis_name: str) -> jax.Array:
     left-aligned, so shard 0's first local column is the game's first row.
     """
     _check_halo(h, x.shape[1])
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     tail = x[:, -h:]
     recv = jax.lax.ppermute(tail, axis_name, [(i, (i + 1) % n) for i in range(n)])
@@ -241,7 +242,7 @@ def _left_halo(x: jax.Array, h: int, axis_name: str) -> jax.Array:
 def _right_halo(x: jax.Array, h: int, axis_name: str) -> jax.Array:
     """``(G, h)`` columns owned by the right neighbor (edge: replicate last)."""
     _check_halo(h, x.shape[1])
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     head = x[:, :h]
     recv = jax.lax.ppermute(head, axis_name, [(i, (i - 1) % n) for i in range(n)])
@@ -298,7 +299,7 @@ def _goalscore_seq(fam: _Family, batch: Any, axis_name: str) -> jax.Array:
     def prefixed(g):
         local = jnp.cumsum(g.astype(f), axis=1) - g.astype(f)
         sums = jax.lax.all_gather(g.astype(f).sum(axis=1), axis_name)  # (n, G)
-        n = jax.lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         before = (jnp.arange(n) < idx)[:, None]  # exclusive scan mask
         return local + (sums * before).sum(axis=0)[:, None]
@@ -336,7 +337,7 @@ def sequence_features(
         return jnp.concatenate(blocks, axis=-1)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(_batch_specs(fam),),
@@ -387,7 +388,7 @@ def sequence_labels(
         return scores, concedes
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(_batch_specs(fam),),
@@ -423,7 +424,7 @@ def sequence_values(
         )
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(_batch_specs(fam), P('games', 'seq'), P('games', 'seq')),
@@ -510,7 +511,7 @@ def sequence_rate(model: Any, batch: Any, mesh: Mesh) -> jax.Array:
         )
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(_batch_specs(fam),),

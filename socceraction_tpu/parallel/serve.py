@@ -1,0 +1,316 @@
+"""Replica fan-out execution tier for the serving dispatch path.
+
+:class:`~socceraction_tpu.serve.service.RatingService` multiplexes every
+caller onto ONE device; this module is the compute half of the N-replica
+topology the fleet telemetry plane (wire merge, per-replica endpoints,
+mesh-wide SLO) was built for. It owns exactly the device-placement story:
+
+- **params replicated once at model load** — the serving dispatch's
+  parameter-side arguments (the model params + folded device stats of the
+  legacy lowering, or the prepared quantized fold) are resolved ONCE via
+  :func:`~socceraction_tpu.ops.fused.pair_dispatch_plan` and committed to
+  every replica device up front. Flushes ship only the batch.
+- **per-replica lane dispatch** (:meth:`ReplicaDispatcher.rate_replica`)
+  — the service's N flush lanes each dispatch to their own device with
+  every argument committed there, so lanes never contend for one chip
+  and a dispatch is in flight per replica. The program is the *same*
+  instrumented jit the single-device path runs (``pair_probs`` /
+  ``pair_probs_prepared`` + the ``vaep_values`` formula kernel), so the
+  single-replica output is bitwise the existing path's on CPU — only the
+  argument placement differs, never the computation.
+- **gang dispatch** (:meth:`ReplicaDispatcher.rate_mesh`) — one
+  ``shard_map`` call over the 1-D ``('replicas',)`` mesh
+  (:func:`~socceraction_tpu.parallel.mesh.make_replica_mesh`, through
+  the compat shim :mod:`socceraction_tpu.ops.compat`): per-replica flush
+  batches, each already padded to the same bucket rung, are concatenated
+  and scattered along the game axis; every shard runs the fused pair
+  probs + formula body with the replicated params. No collective crosses
+  the axis — the rating is game-local by construction — so the gang form
+  is pure SPMD fan-out. The offline twin of the lane form; the bench's
+  replica sweep and the parity tests pin both against the single-device
+  path.
+
+The tier is deliberately jax-heavy and policy-free: admission, queues,
+breakers, swaps and telemetry stay in ``serve/``; this module only
+answers "run this padded staging batch on replica ``i`` (or on all of
+them) and give me host values".
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.batch import ActionBatch
+from ..ops.compat import has_shard_map, shard_map
+from .mesh import make_replica_mesh
+
+__all__ = ['ReplicaDispatcher']
+
+
+class ReplicaDispatcher:
+    """Replicated-params, batch-scattered executor for one fitted model.
+
+    Parameters
+    ----------
+    model : VAEP
+        A fitted model whose label heads can serve through the fused
+        pair dispatch (``_can_fuse()`` and a fused-path platform
+        profile). The materialized path has no replica tier — it is the
+        degradation target, not the scale-out one.
+    n_replicas : int
+        Size of the ``('replicas',)`` mesh axis.
+    devices : sequence, optional
+        Explicit device list (default: the first ``n_replicas`` of
+        ``jax.devices()``). Replica ``0`` should be the process default
+        device so the single-replica configuration stays bitwise the
+        pre-mesh service.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        n_replicas: int = 1,
+        *,
+        devices: Optional[Sequence[Any]] = None,
+    ) -> None:
+        import jax
+
+        from ..ops.fused import pair_dispatch_plan
+        from ..ops.profile import (
+            FUSED_PATH_HIDDEN_DTYPES,
+            hidden_dtype_for,
+            preferred_rating_path,
+        )
+
+        n_replicas = int(n_replicas)
+        if n_replicas < 1:
+            raise ValueError('n_replicas must be >= 1')
+        path = preferred_rating_path()
+        if not (
+            getattr(model, '_can_fuse', lambda: False)()
+            and path in FUSED_PATH_HIDDEN_DTYPES
+        ):
+            raise ValueError(
+                'replica fan-out serves the fused dispatch path only; this '
+                f'model/platform resolves the {path!r} rating path '
+                '(materialized serving stays single-device — it is the '
+                'breaker fallback, not the scale-out tier)'
+            )
+        self.model = model
+        self.n_replicas = n_replicas
+        self.mesh = make_replica_mesh(n_replicas, devices=devices)
+        self.devices: Tuple[Any, ...] = tuple(self.mesh.devices.flat)
+        cols = list(model._label_columns)
+        clf_a, clf_b = model._models[cols[0]], model._models[cols[1]]
+        # Resolve the dispatch ONCE (fn + params-side args + statics);
+        # nothing in the plan inspects batch values, so batch/overrides
+        # slots stay None here and are filled per dispatch. This is the
+        # same resolution ``VAEP.rate_batch`` performs, so lane dispatch
+        # runs the identical program under the identical statics.
+        self._plan = pair_dispatch_plan(
+            clf_a,
+            clf_b,
+            None,
+            names=model._kernel_names(),
+            k=model.nb_prev_actions,
+            registry_name=model._fused_registry,
+            dense_overrides=None,
+            hidden_dtype=hidden_dtype_for(path),
+            prepared=model._prepared_pair(),
+        )
+        # params + device stats (or the prepared fold) replicated once at
+        # model load: one committed copy per replica device. Replica 0 is
+        # the default device, so its copy aliases what the single-device
+        # path already holds resident.
+        param_args = self._plan.args[:-2]
+        self._params: Tuple[Any, ...] = tuple(
+            jax.device_put(param_args, d) for d in self.devices
+        )
+        #: lazily built mesh-replicated copy for the gang form
+        self._gang_params: Any = None
+        self._gang_lock = threading.Lock()
+        self._gang_fns: Dict[bool, Any] = {}
+
+    # -- shared dispatch plumbing ------------------------------------------
+
+    def _dispatch_kwargs(self) -> Tuple[Dict[str, Any], bool]:
+        """The plan's static kwargs with ``guard`` re-resolved per call.
+
+        Guards are a runtime toggle; the plan carries the value at
+        build time. ``guard`` is a static argname, so a fixed setting
+        still compiles once per signature.
+        """
+        from ..obs import numerics
+
+        guard = numerics.guards_enabled()
+        if guard == self._plan.kwargs.get('guard'):
+            return self._plan.kwargs, guard
+        kwargs = dict(self._plan.kwargs)
+        kwargs['guard'] = guard
+        return kwargs, guard
+
+    def _pair_values(self, params, batch, overrides, kwargs, guard):
+        """One fused pair dispatch + formula kernel; notes guard events."""
+        from ..obs import numerics
+
+        out = self._plan.fn(*params, batch, overrides, **kwargs)
+        if guard:
+            pa, pb, (n_nonfinite, n_overflow) = out
+            # same side-band contract as fused_pair_probs: stash now,
+            # drain after the flush's outputs were fetched
+            numerics.note_guard('pair_probs', 'probs', n_nonfinite)
+            numerics.note_guard(
+                'pair_probs', 'logits', n_overflow, kind='overflow'
+            )
+        else:
+            pa, pb = out
+        return self.model._formula_kernel(batch, pa, pb)
+
+    # -- lane form: one replica, one committed dispatch --------------------
+
+    def rate_replica(
+        self,
+        replica: int,
+        host_batch: ActionBatch,
+        gs: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Rate one padded staging batch on replica ``replica``.
+
+        Every argument is committed to the replica's device before
+        dispatch, so concurrent lanes each keep exactly one dispatch in
+        flight on their own chip. Returns host ``(G, A, 3)`` values —
+        bitwise what ``VAEP.rate_batch(bucket=False)`` returns for the
+        same staging batch on CPU (same program, same values, different
+        placement).
+        """
+        import jax
+
+        d = self.devices[replica]
+        batch = jax.device_put(host_batch, d)
+        overrides = (
+            {'goalscore': jax.device_put(np.asarray(gs), d)}
+            if gs is not None
+            else None
+        )
+        kwargs, guard = self._dispatch_kwargs()
+        values = self._pair_values(
+            self._params[replica], batch, overrides, kwargs, guard
+        )
+        return np.asarray(jax.device_get(values))
+
+    # -- gang form: one shard_map over the whole mesh ----------------------
+
+    def _gang_fn(self, with_gs: bool) -> Any:
+        """The jitted ``shard_map`` gang dispatch (cached per arity)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        fn = self._gang_fns.get(with_gs)
+        if fn is not None:
+            return fn
+        if not has_shard_map():
+            raise RuntimeError(
+                'no shard_map in this jax build; the gang dispatch needs '
+                'it (per-replica lane dispatch does not)'
+            )
+        # the gang body runs under an outer trace, where the side-band
+        # guard scalars cannot be stashed (note_guard skips tracers) —
+        # the serving lanes keep guards; the gang form is the
+        # bench/parity twin and dispatches unguarded
+        kwargs = dict(self._plan.kwargs)
+        kwargs['guard'] = False
+        plan_fn = self._plan.fn
+        formula = self.model._formula_kernel
+
+        def body(params, batch, gs):
+            overrides = {'goalscore': gs} if gs is not None else None
+            pa, pb = plan_fn(*params, batch, overrides, **kwargs)
+            return formula(batch, pa, pb)
+
+        if with_gs:
+            mapped = shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(P(), P('replicas'), P('replicas')),
+                out_specs=P('replicas'),
+            )
+        else:
+            mapped = shard_map(
+                functools.partial(body, gs=None),
+                mesh=self.mesh,
+                in_specs=(P(), P('replicas')),
+                out_specs=P('replicas'),
+            )
+        fn = jax.jit(mapped)
+        self._gang_fns[with_gs] = fn
+        return fn
+
+    def _gang_replicated_params(self) -> Any:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        with self._gang_lock:
+            if self._gang_params is None:
+                self._gang_params = jax.device_put(
+                    self._plan.args[:-2], NamedSharding(self.mesh, P())
+                )
+            return self._gang_params
+
+    def rate_mesh(
+        self,
+        host_batches: Sequence[ActionBatch],
+        gs_list: Optional[Sequence[Optional[np.ndarray]]] = None,
+    ) -> List[np.ndarray]:
+        """One gang dispatch: every replica's flush in a single program.
+
+        ``host_batches`` carries one staging batch per replica, each
+        already padded to the SAME bucket rung (per-replica ladders pad
+        before the scatter, so every shard executes the pinned bucket
+        shape). The batches are concatenated along the game axis,
+        scattered over ``('replicas',)`` by ``shard_map``, rated
+        against the mesh-replicated params, and the ``(G, A, 3)``
+        values are split back per replica.
+        """
+        import jax
+
+        R = self.n_replicas
+        if len(host_batches) != R:
+            raise ValueError(
+                f'{len(host_batches)} flush batches for {R} replicas; '
+                'the gang dispatch takes exactly one per replica'
+            )
+        per = host_batches[0].n_games
+        for hb in host_batches:
+            if hb.n_games != per:
+                raise ValueError(
+                    'per-replica flush batches must share one bucket rung '
+                    f'(got game counts {[b.n_games for b in host_batches]}); '
+                    'pad each lane to the common rung before the scatter'
+                )
+        stacked = jax.tree.map(
+            lambda *xs: np.concatenate(xs, axis=0), *host_batches
+        )
+        params = self._gang_replicated_params()
+        with_gs = gs_list is not None and any(g is not None for g in gs_list)
+        if with_gs:
+            # all-or-none: a goalscore override SUBSTITUTES the computed
+            # dense block, so "absent" cannot be emulated with zeros —
+            # a mixed gang would silently rate some shards wrong
+            if any(g is None for g in gs_list):  # type: ignore[union-attr]
+                raise ValueError(
+                    'gang dispatch needs a goalscore block for every '
+                    'replica or for none (an override replaces the '
+                    'computed feature; zeros are not "no override")'
+                )
+            gs = np.concatenate(
+                [np.asarray(g) for g in gs_list], axis=0  # type: ignore[union-attr]
+            )
+            values = self._gang_fn(True)(params, stacked, gs)
+        else:
+            values = self._gang_fn(False)(params, stacked)
+        values = np.asarray(jax.device_get(values))
+        return [values[i * per : (i + 1) * per] for i in range(R)]

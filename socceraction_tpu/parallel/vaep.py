@@ -31,7 +31,13 @@ from ..ops.fused import fused_pair_logits
 from ..ops.labels import scores_concedes
 from .mesh import shard_batch
 
-__all__ = ['make_train_step', 'param_shardings', 'sharded_rate', 'train_distributed']
+__all__ = [
+    'data_parallel_rate',
+    'make_train_step',
+    'param_shardings',
+    'sharded_rate',
+    'train_distributed',
+]
 
 
 def param_shardings(params: Any, mesh: Mesh) -> Any:
@@ -188,3 +194,37 @@ def sharded_rate(
     """
     sharded = shard_batch(batch, mesh)
     return model.rate_batch(sharded), sharded
+
+
+def data_parallel_rate(
+    model: Any,
+    host_batches: Sequence[ActionBatch],
+    *,
+    n_replicas: int = None,
+    devices: Sequence[Any] = None,
+) -> Tuple[np.ndarray, ...]:
+    """Rate N equal-shaped host batches, one per replica, in one dispatch.
+
+    The `shard_map` counterpart to :func:`sharded_rate`: where that
+    function shards ONE batch's game axis via sharding annotations and
+    lets XLA insert collectives, this one ships N already-split batches
+    through the serving tier's gang dispatch
+    (:meth:`~socceraction_tpu.parallel.serve.ReplicaDispatcher.rate_mesh`)
+    — replicated params, per-replica batch shards, no collectives at
+    all. Requires the fused rating path (the materialized path stays
+    single-device; it is the serving breaker's fallback).
+
+    Returns one ``(G, A, 3)`` numpy value array per input batch, each
+    bitwise-identical to ``model.rate_batch(batch, bucket=False)`` on
+    that batch alone.
+    """
+    from .serve import ReplicaDispatcher
+
+    n = len(host_batches) if n_replicas is None else int(n_replicas)
+    if n != len(host_batches):
+        raise ValueError(
+            f'{len(host_batches)} batches for {n} replicas — '
+            'gang dispatch needs exactly one batch per replica'
+        )
+    dispatcher = ReplicaDispatcher(model, n, devices=devices)
+    return tuple(dispatcher.rate_mesh(list(host_batches)))

@@ -17,6 +17,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.batch import ActionBatch
+from ..ops.compat import shard_map
 from ..ops.xt import (
     XTCounts,
     XTProbabilities,
@@ -51,7 +52,7 @@ def sharded_xt_counts(batch: ActionBatch, mesh: Mesh, *, l: int, w: int) -> XTCo
     multiple of the ``'games'`` axis size; see
     :func:`~socceraction_tpu.parallel.mesh.shard_batch`).
     """
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_local_counts, l=l, w=w),
         mesh=mesh,
         in_specs=P('games'),
@@ -147,11 +148,11 @@ def sharded_xt_fit_matrix_free(
         return sol.grid, sol.iterations
 
     if group_id is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             local_fit, mesh=mesh, in_specs=P('games'), out_specs=P()
         )
         return fn(batch)
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fit, mesh=mesh, in_specs=(P('games'), P('games')), out_specs=P()
     )
     return fn(batch, group_id)

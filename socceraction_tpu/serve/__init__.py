@@ -25,6 +25,11 @@ concurrent callers onto the fused one-dispatch rating path:
 - :mod:`socceraction_tpu.serve.capture` — :class:`TrafficCapture`, the
   bounded ring of recently served traffic the continuous-learning
   loop's shadow evaluation (:mod:`socceraction_tpu.learn`) replays.
+- :mod:`socceraction_tpu.serve.frontend` — :class:`ServingFrontend` /
+  :class:`FrontendClient`, the cross-process door: a unix-socket RPC
+  server over one (possibly mesh-replicated) :class:`RatingService`,
+  forwarding ``RequestContext.to_wire()`` so traces stitch client →
+  front end → replica flush.
 
 Quickstart::
 
@@ -60,6 +65,9 @@ __all__ = [
     'SLOShed',
     'MatchSession',
     'TrafficCapture',
+    'ServingFrontend',
+    'FrontendClient',
+    'FrontendError',
 ]
 
 #: exported name -> (submodule, attribute) for the lazy loader; kept
@@ -73,11 +81,14 @@ _LAZY = {
     'SLOShed': ('socceraction_tpu.serve.service', 'SLOShed'),
     'MatchSession': ('socceraction_tpu.serve.session', 'MatchSession'),
     'TrafficCapture': ('socceraction_tpu.serve.capture', 'TrafficCapture'),
+    'ServingFrontend': ('socceraction_tpu.serve.frontend', 'ServingFrontend'),
+    'FrontendClient': ('socceraction_tpu.serve.frontend', 'FrontendClient'),
+    'FrontendError': ('socceraction_tpu.serve.frontend', 'FrontendError'),
 }
 
 
 _SUBMODULES = {
-    'aot', 'batcher', 'capture', 'registry', 'service', 'session',
+    'aot', 'batcher', 'capture', 'frontend', 'registry', 'service', 'session',
 }
 
 

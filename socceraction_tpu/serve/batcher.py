@@ -24,16 +24,29 @@ The batcher is policy-only: it never touches jax. A ``runner`` callable
 turns a list of payloads plus a bucket size into one result per payload;
 the batcher owns the queue, the deadline clock, the futures and the
 ``serve/*`` telemetry. Everything is thread-safe; all device work happens
-on the single flusher thread.
+on the flusher threads.
+
+With ``n_lanes > 1`` (the mesh-serving fan-out) N flusher threads drain
+the ONE shared queue concurrently: each lane takes a flush, dispatches it
+through the runner with its lane index (one in-flight dispatch per
+replica device), and goes back for more — a sick or slow replica never
+blocks the others' take loop. Crash supervision is per lane: a lane's
+restart budget is its own, and a permanently dead lane strands nothing —
+its un-flushed requests go back to the shared queue for live lanes, and
+only the death of the LAST live lane fails the queue and rejects new
+submits. Flush-scoped telemetry carries a ``replica=`` label when lanes
+are named (``lane_names``, validated against the
+:class:`~socceraction_tpu.obs.wire.ReplicaRegistry` by the service).
 """
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.batch import bucket_ladder
 from ..obs import counter, gauge, histogram, span
@@ -82,7 +95,10 @@ class MicroBatcher:
         ``runner(payloads, bucket) -> results`` — rates one coalesced
         batch; ``bucket >= len(payloads)`` is the ladder size the device
         batch must be padded to, and ``results`` must align with
-        ``payloads``. Runs on the flusher thread only.
+        ``payloads``. Runs on a flusher thread only. A runner declaring
+        a ``lane`` parameter receives the dispatching lane's index as
+        ``lane=<int>`` (the service routes it to that replica's device);
+        a two-argument runner keeps working unchanged.
     max_batch_size : int
         Flush immediately once this many requests are waiting. Also the
         top of the bucket ladder (rounded up to a power of two).
@@ -124,6 +140,16 @@ class MicroBatcher:
         (``status`` in ``'ok'`` | ``'error'`` | ``'expired'``). The
         service hooks its SLO engine here; the hook must not raise (a
         raising hook is swallowed, never the flush).
+    n_lanes : int
+        Concurrent flusher threads draining the shared queue (default 1,
+        the classic single-flusher batcher). The mesh service runs one
+        lane per replica so every replica keeps one dispatch in flight.
+        Restart budgets, crash state and flush telemetry are per lane.
+    lane_names : sequence of str, optional
+        Telemetry identity per lane (the service passes replica ids).
+        When given, flush-scoped ``serve/*`` series carry a
+        ``replica=<name>`` label; when omitted they stay unlabeled, so a
+        single-lane batcher's series are byte-identical to before.
     """
 
     def __init__(
@@ -140,30 +166,74 @@ class MicroBatcher:
         max_flusher_restarts: int = 3,
         flusher_restart_window_s: float = 60.0,
         on_restart: Optional[Callable[[BaseException, int], None]] = None,
+        n_lanes: int = 1,
+        lane_names: Optional[Sequence[str]] = None,
     ) -> None:
         if max_batch_size < 1:
             raise ValueError('max_batch_size must be >= 1')
         if max_queue < max_batch_size:
             raise ValueError('max_queue must be >= max_batch_size')
+        if n_lanes < 1:
+            raise ValueError('n_lanes must be >= 1')
+        if lane_names is not None and len(lane_names) != n_lanes:
+            raise ValueError(
+                f'{len(lane_names)} lane_names for {n_lanes} lanes'
+            )
         self._runner = runner
         self.max_batch_size = max_batch_size
         self.max_wait_s = max_wait_ms / 1000.0
         self.max_queue = max_queue
         self.ladder: Tuple[int, ...] = bucket_ladder(max_batch_size)
+        self.n_lanes = int(n_lanes)
+        self.lane_names: Optional[Tuple[str, ...]] = (
+            tuple(lane_names) if lane_names is not None else None
+        )
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[_Request] = []
         self._closed = False
-        self._thread: Optional[threading.Thread] = None
+        self._threads: Dict[int, threading.Thread] = {}
         self._on_crash = on_crash
         self._on_request_done = on_request_done
-        self._crashed: Optional[BaseException] = None
+        self._crashed_lanes: Dict[int, BaseException] = {}
         self._last_flush_t: Optional[float] = None
         self.max_flusher_restarts = int(max_flusher_restarts)
         self.flusher_restart_window_s = float(flusher_restart_window_s)
         self._on_restart = on_restart
-        self._restart_times: 'deque[float]' = deque()
+        self._restart_times: Dict[int, 'deque[float]'] = {
+            i: deque() for i in range(self.n_lanes)
+        }
         self._restarts_total = 0
+
+    @property
+    def _runner(self) -> Callable:
+        return self._runner_fn
+
+    @_runner.setter
+    def _runner(self, runner: Callable) -> None:
+        # a runner declaring `lane` gets the dispatching lane's index;
+        # legacy (payloads, bucket) runners keep working unchanged. A
+        # setter (not a one-shot __init__ probe) so tests that swap
+        # `_runner` for a two-arg stub get the legacy calling convention.
+        self._runner_fn = runner
+        try:
+            self._runner_takes_lane = (
+                'lane' in inspect.signature(runner).parameters
+            )
+        except (TypeError, ValueError):  # builtins / C callables
+            self._runner_takes_lane = False
+
+    def _lane_kw(self, lane: int) -> Dict[str, str]:
+        """The ``replica=`` label of one lane's flush-scoped series."""
+        if self.lane_names is None:
+            return {}
+        return {'replica': self.lane_names[lane]}
+
+    def _lane_label(self, lane: int) -> str:
+        return (
+            self.lane_names[lane] if self.lane_names is not None
+            else str(lane)
+        )
 
     # -- submission --------------------------------------------------------
 
@@ -189,9 +259,10 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 raise RuntimeError('batcher is closed')
-            if self._crashed is not None:
+            if len(self._crashed_lanes) >= self.n_lanes:
+                exc = next(iter(self._crashed_lanes.values()))
                 raise RuntimeError(
-                    f'flusher thread died: {self._crashed!r} '
+                    f'flusher thread died: {exc!r} '
                     '(see the debug bundle; start a new service)'
                 )
             if len(self._queue) >= self.max_queue:
@@ -202,11 +273,9 @@ class MicroBatcher:
                 )
             self._queue.append(req)
             depth = len(self._queue)
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._flush_loop, name='serve-flusher', daemon=True
-                )
-                self._thread.start()
+            if not self._threads:
+                for lane in range(self.n_lanes):
+                    self._spawn_lane(lane)
             self._cond.notify()
         gauge('serve/queue_depth', unit='requests').set(depth)
         counter('serve/requests', unit='requests').inc(1, kind=kind)
@@ -215,6 +284,17 @@ class MicroBatcher:
             req.future.context = ctx  # type: ignore[attr-defined]
             record_request_enqueue(ctx, depth)
         return req.future
+
+    def _spawn_lane(self, lane: int) -> None:
+        """Start (or replace) lane ``lane``'s flusher thread. Lock held."""
+        name = 'serve-flusher' if self.n_lanes == 1 else (
+            f'serve-flusher-{self._lane_label(lane)}'
+        )
+        t = threading.Thread(
+            target=self._flush_loop, args=(lane,), name=name, daemon=True
+        )
+        self._threads[lane] = t
+        t.start()
 
     def bucket_for(self, n: int) -> int:
         """The smallest ladder rung admitting ``n`` requests."""
@@ -256,7 +336,7 @@ class MicroBatcher:
         gauge('serve/queue_depth', unit='requests').set(depth)
         return take, reason
 
-    def _flush_loop(self) -> None:
+    def _flush_loop(self, lane: int = 0) -> None:
         taken: List[_Request] = []
         try:
             while True:
@@ -267,37 +347,43 @@ class MicroBatcher:
                 # injected error here escapes the take loop (not the
                 # per-flush guard) and exercises the restart supervisor
                 fault_point('batcher.flush', requests=len(taken))
-                self._flush(taken, reason)
+                self._flush(taken, reason, lane)
                 taken = []
                 self._last_flush_t = time.monotonic()
         except BaseException as e:  # noqa: BLE001 - the thread is dying
-            self._crash(e, taken)
+            self._crash(e, taken, lane)
 
-    def _crash(self, e: BaseException, taken: List[_Request]) -> None:
-        """The dying flusher thread's last act: restart or fail everything.
+    def _crash(
+        self, e: BaseException, taken: List[_Request], lane: int
+    ) -> None:
+        """A dying flusher thread's last act: restart, retire or fail all.
 
-        Within the supervisor's budget (``max_flusher_restarts`` per
-        ``flusher_restart_window_s``) the thread is replaced and the
-        requests it had taken but not flushed go back to the FRONT of
-        the queue — order preserved, no future stranded, callers never
-        see the crash. Past the budget the crash is permanent (the
-        pre-supervision behavior): record it, fail what is queued,
-        reject new submits, and hand the exception to ``on_crash``.
+        Within the lane's budget (``max_flusher_restarts`` per
+        ``flusher_restart_window_s``, counted per lane) the thread is
+        replaced and the requests it had taken but not flushed go back
+        to the FRONT of the queue — order preserved, no future stranded,
+        callers never see the crash. Past the budget the lane's death is
+        permanent — but with live lanes remaining it retires ALONE: its
+        taken requests re-queue for the survivors and submits keep
+        flowing (the mesh topology's single-sick-replica degradation).
+        Only the LAST live lane's permanent death fails the queue,
+        rejects new submits and fires ``on_crash``.
         """
         now = time.monotonic()
         restarted = False
         n_window = 0
         with self._cond:
+            times = self._restart_times[lane]
             cutoff = now - self.flusher_restart_window_s
-            while self._restart_times and self._restart_times[0] < cutoff:
-                self._restart_times.popleft()
+            while times and times[0] < cutoff:
+                times.popleft()
             if (
                 not self._closed
-                and len(self._restart_times) < self.max_flusher_restarts
+                and len(times) < self.max_flusher_restarts
             ):
-                self._restart_times.append(now)
+                times.append(now)
                 self._restarts_total += 1
-                n_window = len(self._restart_times)
+                n_window = len(times)
                 self._queue[:0] = taken
                 restarted = True
         if restarted:
@@ -305,11 +391,14 @@ class MicroBatcher:
             # thread may crash instantly (a persistent fault), and its
             # permanent-death dump must come chronologically after this
             # restart's, not race it
-            counter('serve/flusher_restarts', unit='count').inc(1)
+            counter('serve/flusher_restarts', unit='count').inc(
+                1, **self._lane_kw(lane)
+            )
             restart_payload = {
                 'error': f'{type(e).__name__}: {e}',
                 'restarts_in_window': n_window,
                 'requeued': len(taken),
+                'lane': self._lane_label(lane),
             }
             RECORDER.record('flusher_restart', **restart_payload)
             try:
@@ -331,24 +420,31 @@ class MicroBatcher:
             with self._cond:
                 # spawn even if close() raced in: the replacement drains
                 # a closed queue correctly and exits via _take
-                self._thread = threading.Thread(
-                    target=self._flush_loop, name='serve-flusher', daemon=True
-                )
-                self._thread.start()
+                self._spawn_lane(lane)
                 self._cond.notify_all()
             return
-        # A dead flusher would otherwise strand every queued (and
-        # future) request forever: record the crash, fail what is
-        # queued, reject new submits, and hand the exception to the
-        # crash hook (the service's debug-bundle dump).
-        self._crashed = e
-        counter('serve/flusher_crashes', unit='count').inc(1)
-        RECORDER.record(
-            'flusher_crash', error=f'{type(e).__name__}: {e}',
-            queue_depth=self.queue_depth,
+        counter('serve/flusher_crashes', unit='count').inc(
+            1, **self._lane_kw(lane)
         )
         with self._cond:
-            dropped, self._queue = self._queue, []
+            self._crashed_lanes[lane] = e
+            last_lane = len(self._crashed_lanes) >= self.n_lanes
+            if last_lane:
+                dropped, self._queue = self._queue, []
+            else:
+                # survivors drain these: order preserved, nothing strands
+                self._queue[:0] = taken
+                self._cond.notify_all()
+        RECORDER.record(
+            'flusher_crash', error=f'{type(e).__name__}: {e}',
+            queue_depth=self.queue_depth, lane=self._lane_label(lane),
+            last_lane=last_lane,
+        )
+        if not last_lane:
+            return
+        # The LAST flusher died: anything queued (and any future submit)
+        # would otherwise strand forever — fail it all and hand the
+        # exception to the crash hook (the service's debug-bundle dump).
         dropped = taken + dropped
         for r in dropped:
             if r.future.set_running_or_notify_cancel():
@@ -392,14 +488,14 @@ class MicroBatcher:
         self._notify_done(req, wait, 'expired')
         req.future.set_exception(err)
 
-    def _flush(self, take: List[_Request], reason: str) -> None:
+    def _flush(self, take: List[_Request], reason: str, lane: int = 0) -> None:
         # Transition every future to RUNNING; a caller that cancel()ed
         # while queued is dropped here. After this point cancel() can no
         # longer succeed, so set_result below cannot raise
         # InvalidStateError and kill the flusher thread.
         take = [r for r in take if r.future.set_running_or_notify_cancel()]
         try:
-            self._flush_running(take, reason)
+            self._flush_running(take, reason, lane)
         except BaseException as e:  # noqa: BLE001 - never strand a future
             # a RUNNING future whose flush died any other way than the
             # runner path below would hang its caller forever (and the
@@ -442,7 +538,9 @@ class MicroBatcher:
             self._notify_done(r, wall, 'error')
             r.future.set_exception(exc)
 
-    def _flush_running(self, take: List[_Request], reason: str) -> None:
+    def _flush_running(
+        self, take: List[_Request], reason: str, lane: int = 0
+    ) -> None:
         now = time.perf_counter()
         live: List[_Request] = []
         for r in take:
@@ -452,15 +550,16 @@ class MicroBatcher:
                 live.append(r)
         if not live:
             return
+        lane_kw = self._lane_kw(lane)
         bucket = self.bucket_for(len(live))
         fill = len(live) / bucket
-        counter('serve/flushes', unit='count').inc(1, reason=reason)
+        counter('serve/flushes', unit='count').inc(1, reason=reason, **lane_kw)
         gauge('serve/batch_fill_ratio', unit='ratio').set(fill)
         request_ids = [r.ctx.request_id for r in live if r.ctx is not None]
         RECORDER.record(
             'serve_queue', taken=len(live), bucket=bucket, reason=reason,
             queue_depth=self.queue_depth, fill_ratio=fill,
-            request_ids=request_ids,
+            request_ids=request_ids, lane=self._lane_label(lane),
         )
         # every coalesced request's queue wait ends here: the flush owns
         # the rest of the wall (pad/dispatch/slice, recorded by the runner)
@@ -470,19 +569,24 @@ class MicroBatcher:
             if r.ctx is not None:
                 r.ctx.segments['queue_wait'] = wait
             record_segment(
-                'queue_wait', wait, r.ctx.request_id if r.ctx else None
+                'queue_wait', wait, r.ctx.request_id if r.ctx else None,
+                **lane_kw,
             )
         try:
             # the flush span lists the coalesced request ids: the link
             # from one shared dispatch back to every request it served
             with span(
                 'serve/flush', requests=len(live), bucket=bucket,
-                request_ids=request_ids,
+                request_ids=request_ids, **lane_kw,
             ) as flush_span:
                 with histogram('serve/flush_seconds', unit='s').time(
-                    bucket=str(bucket)
+                    bucket=str(bucket), **lane_kw
                 ):
-                    results = self._runner([r.payload for r in live], bucket)
+                    payloads = [r.payload for r in live]
+                    if self._runner_takes_lane:
+                        results = self._runner(payloads, bucket, lane=lane)
+                    else:
+                        results = self._runner(payloads, bucket)
             if len(results) != len(live):
                 raise RuntimeError(
                     f'runner returned {len(results)} results for '
@@ -520,8 +624,21 @@ class MicroBatcher:
 
     @property
     def crashed(self) -> Optional[BaseException]:
-        """The exception that killed the flusher thread, or None."""
-        return self._crashed
+        """The exception that killed the LAST flusher thread, or None.
+
+        A multi-lane batcher with live lanes remaining reports None here
+        (it still serves); :attr:`dead_lanes` names partial casualties.
+        """
+        with self._lock:
+            if len(self._crashed_lanes) < self.n_lanes:
+                return None
+            return next(iter(self._crashed_lanes.values()))
+
+    @property
+    def dead_lanes(self) -> Dict[int, BaseException]:
+        """Lanes whose flusher died permanently (index -> exception)."""
+        with self._lock:
+            return dict(self._crashed_lanes)
 
     @property
     def flusher_restarts(self) -> int:
@@ -531,12 +648,13 @@ class MicroBatcher:
 
     @property
     def flusher_alive(self) -> bool:
-        """False once the flusher thread has died (crash or exit); True
-        while it runs or before it has lazily started."""
-        if self._crashed is not None:
-            return False
-        thread = self._thread
-        return thread is None or thread.is_alive()
+        """False once ALL flusher lanes have died (crash or exit); True
+        while any runs or before they have lazily started."""
+        with self._lock:
+            if len(self._crashed_lanes) >= self.n_lanes:
+                return False
+            threads = list(self._threads.values())
+        return not threads or any(t.is_alive() for t in threads)
 
     @property
     def last_flush_age_s(self) -> Optional[float]:
@@ -550,9 +668,7 @@ class MicroBatcher:
         """Stop the flusher. ``drain=True`` (default) rates what is queued
         first; ``drain=False`` fails queued requests with RuntimeError."""
         with self._cond:
-            if self._closed:
-                thread = self._thread
-            else:
+            if not self._closed:
                 self._closed = True
                 if not drain:
                     dropped, self._queue = self._queue, []
@@ -561,10 +677,10 @@ class MicroBatcher:
                             r.future.set_exception(
                                 RuntimeError('batcher closed before flush')
                             )
-                thread = self._thread
             self._cond.notify_all()
-        if thread is not None:
-            thread.join(timeout=30.0)
+            threads = list(self._threads.values())
+        for t in threads:
+            t.join(timeout=30.0)
 
     def __enter__(self) -> 'MicroBatcher':
         return self

@@ -180,6 +180,23 @@ class RatingService:
         tune one, or ``breaker_failures=0`` to disable degradation
         entirely (dispatch failures then fail their flush's futures, the
         pre-resilience behavior).
+    n_replicas : int
+        Replica fan-out across the device mesh (default 1, the classic
+        single-device service — byte-identical behavior). With ``N > 1``
+        the service becomes the mesh topology's one front door: N flush
+        lanes drain the shared queue concurrently, each lane dispatching
+        to its own device through a
+        :class:`~socceraction_tpu.parallel.serve.ReplicaDispatcher`
+        (params replicated once per device at model load), with a
+        per-replica circuit breaker (a sick replica degrades ALONE onto
+        the materialized fallback; the others stay fused), per-replica
+        shape accounting (``serve/shape_traces{replica=}``), and
+        mesh-wide atomic hot-swap: a swap target is ladder-warmed on
+        EVERY replica before any of them activates it — one failed warm
+        aborts the swap fleet-wide. Replica ids ``r0..rN-1`` are
+        registered with the fleet's
+        :class:`~socceraction_tpu.obs.wire.ReplicaRegistry`. Requires
+        ``N`` visible devices and a fused-dispatch-capable model.
     aot_dir : str, optional
         An explicit AOT artifact directory (the ``aot/`` layout
         :func:`socceraction_tpu.serve.aot.export_serving_aot` writes)
@@ -216,6 +233,7 @@ class RatingService:
         breaker: Optional[CircuitBreaker] = None,
         breaker_failures: int = 3,
         breaker_recovery_s: float = 5.0,
+        n_replicas: int = 1,
         aot_dir: Optional[str] = None,
         debug_dir: Optional[str] = None,
         overload_dump_threshold: int = 64,
@@ -276,16 +294,54 @@ class RatingService:
             if slo is not None
             else None
         )
-        if breaker is not None:
-            self._breaker: Optional[CircuitBreaker] = breaker
-        elif int(breaker_failures) > 0:
-            self._breaker = CircuitBreaker(
-                failure_threshold=int(breaker_failures),
-                recovery_time_s=float(breaker_recovery_s),
-                name='serve.dispatch',
+        self.n_replicas = int(n_replicas)
+        if self.n_replicas < 1:
+            raise ValueError('n_replicas must be >= 1')
+        if self.n_replicas > 1:
+            if breaker is not None:
+                raise ValueError(
+                    'a shared breaker instance defeats per-replica '
+                    'degradation; with n_replicas > 1 the service builds '
+                    'one breaker per replica from breaker_failures/'
+                    'breaker_recovery_s'
+                )
+            from ..obs.wire import REPLICAS
+
+            self.replica_ids: Tuple[str, ...] = tuple(
+                REPLICAS.register(f'r{i}') for i in range(self.n_replicas)
             )
+            self._breakers: List[Optional[CircuitBreaker]] = [
+                CircuitBreaker(
+                    failure_threshold=int(breaker_failures),
+                    recovery_time_s=float(breaker_recovery_s),
+                    name=f'serve.dispatch.{rid}',
+                )
+                if int(breaker_failures) > 0
+                else None
+                for rid in self.replica_ids
+            ]
+            # fail at construction, not first flush: the fan-out needs
+            # one device per replica and the fused dispatch path, and a
+            # service that cannot serve its topology must say so here
+            self._dispatchers: List[Tuple[Any, Any]] = [
+                (first, self._build_dispatcher(first))
+            ]
         else:
-            self._breaker = None
+            self.replica_ids = ()
+            if breaker is not None:
+                self._breakers = [breaker]
+            elif int(breaker_failures) > 0:
+                self._breakers = [
+                    CircuitBreaker(
+                        failure_threshold=int(breaker_failures),
+                        recovery_time_s=float(breaker_recovery_s),
+                        name='serve.dispatch',
+                    )
+                ]
+            else:
+                self._breakers = [None]
+            self._dispatchers = []
+        self._dispatcher_lock = threading.Lock()
         self._batcher = MicroBatcher(
             self._flush,
             max_batch_size=max_batch_size,
@@ -293,6 +349,8 @@ class RatingService:
             max_queue=max_queue,
             on_crash=self._on_flusher_crash,
             on_request_done=self._on_request_done,
+            n_lanes=self.n_replicas,
+            lane_names=self.replica_ids or None,
         )
         self._shape_lock = threading.Lock()
         self._seen_shapes: set = set()
@@ -369,6 +427,47 @@ class RatingService:
             # own terms
             return 'invalid'
 
+    # -- replica fan-out plumbing ------------------------------------------
+
+    @property
+    def _breaker(self) -> Optional[CircuitBreaker]:
+        """Lane 0's breaker — the single-replica service's only one."""
+        return self._breakers[0]
+
+    def _replica_kw(self, lane: int) -> Dict[str, str]:
+        """The ``replica=`` label of one lane's serve-area series."""
+        if not self.replica_ids:
+            return {}
+        return {'replica': self.replica_ids[lane]}
+
+    def _build_dispatcher(self, model: Any) -> Any:
+        """A :class:`~socceraction_tpu.parallel.serve.ReplicaDispatcher`
+        for one model: params committed to every replica device once."""
+        from ..parallel.serve import ReplicaDispatcher
+
+        return ReplicaDispatcher(model, self.n_replicas)
+
+    def _dispatcher_for(self, model: Any) -> Any:
+        """The mesh executor serving ``model`` (built once per model).
+
+        Keyed by model identity, bounded to the registry's working set
+        (active + swap target + rollback source): a flush that read the
+        active model mid-swap keeps ITS model's dispatcher even while a
+        new one warms, so swap atomicity extends to the replica tier.
+        """
+        with self._dispatcher_lock:
+            for m, d in self._dispatchers:
+                if m is model:
+                    return d
+        dispatcher = self._build_dispatcher(model)
+        with self._dispatcher_lock:
+            for m, d in self._dispatchers:
+                if m is model:  # lost a build race: keep the first
+                    return d
+            self._dispatchers.append((model, dispatcher))
+            del self._dispatchers[:-3]
+        return dispatcher
+
     def _prepare_swap_target(self, name: str, version: str) -> Any:
         """Load, validate, layout-guard and ladder-warm a swap target.
 
@@ -401,8 +500,17 @@ class RatingService:
             )
         self._load_aot_for(name, version, new)
         A = self.max_actions
-        for b in self._batcher.ladder:
-            self._device_rate(_empty_host_batch(1, A), _empty_gs(1, A), new, b)
+        # mesh-wide atomicity: EVERY replica is prepared (dispatcher
+        # params committed to its device) and ladder-warmed before the
+        # caller activates the target anywhere — one replica failing to
+        # warm raises out of this loop and aborts the swap for all of
+        # them, so no mixed-version mesh can ever serve
+        for lane in range(self.n_replicas):
+            for b in self._batcher.ladder:
+                self._device_rate(
+                    _empty_host_batch(1, A), _empty_gs(1, A), new, b,
+                    lane=lane,
+                )
         return new
 
     def swap_model(self, name: str, version: Optional[str] = None) -> Tuple[str, str]:
@@ -698,13 +806,23 @@ class RatingService:
         gs: Optional[np.ndarray],
         model: Any,
         bucket: int,
+        lane: int = 0,
     ) -> np.ndarray:
-        """Pad to the bucket, dispatch ``rate_batch``, fetch to host."""
+        """Pad to the bucket, dispatch on ``lane``'s device, fetch to host.
+
+        The single-replica service dispatches ``rate_batch`` on the
+        default device (the pre-mesh path, byte for byte); the fan-out
+        service routes every lane — replica 0 included — through the
+        mesh executor's committed per-device dispatch, which runs the
+        same program (bitwise-pinned by the parity tests). Shape
+        accounting is per replica: each lane compiles its own ladder,
+        and the trace counters must plateau per replica.
+        """
         import jax
         import jax.numpy as jnp
 
         host_batch, gs = _pad_to_bucket(host_batch, gs, bucket)
-        key = (bucket, host_batch.max_actions)
+        key = (bucket, host_batch.max_actions, lane)
         with self._shape_lock:
             new_shape = key not in self._seen_shapes
             if new_shape:
@@ -712,10 +830,14 @@ class RatingService:
                 n_shapes = len(self._seen_shapes)
         if new_shape:
             counter('serve/shape_traces', unit='count').inc(
-                1, bucket=str(bucket)
+                1, bucket=str(bucket), **self._replica_kw(lane)
             )
             gauge('serve/compiled_shapes', unit='shapes').set(n_shapes)
         fault_point('serve.dispatch', bucket=bucket)
+        if self.n_replicas > 1:
+            return self._dispatcher_for(model).rate_replica(
+                lane, host_batch, gs if self._gs_enabled else None
+            )
         batch = jax.device_put(host_batch)
         overrides = (
             {'goalscore': jnp.asarray(gs)}
@@ -756,8 +878,9 @@ class RatingService:
         gs: Optional[np.ndarray],
         model: Any,
         bucket: int,
+        lane: int = 0,
     ) -> Tuple[np.ndarray, str]:
-        """One flush's rating through the breaker; returns (values, path).
+        """One flush's rating through its lane's breaker; (values, path).
 
         ``path`` is ``'fused'`` (healthy or successful half-open probe)
         or ``'fallback'`` (breaker open, or this flush's fused dispatch
@@ -768,16 +891,26 @@ class RatingService:
         later flushes skip the doomed dispatch entirely. A fallback
         failure propagates (the batcher fails the flush's futures —
         when both paths are down there is nothing to degrade to).
+
+        Each replica lane carries its OWN breaker: a device fault on one
+        replica trips that lane alone onto the materialized fallback
+        while the other lanes keep dispatching fused — the mesh
+        topology's single-sick-replica degradation, pinned by test.
         """
-        breaker = self._breaker
+        breaker = self._breakers[lane]
         if breaker is None:
-            return self._device_rate(host_batch, gs, model, bucket), 'fused'
+            return (
+                self._device_rate(host_batch, gs, model, bucket, lane),
+                'fused',
+            )
         verdict = breaker.allow()
         if verdict == 'open':
-            counter('serve/fallback_flushes', unit='count').inc(1)
+            counter('serve/fallback_flushes', unit='count').inc(
+                1, **self._replica_kw(lane)
+            )
             return self._reference_rate(host_batch, gs, model), 'fallback'
         try:
-            values = self._device_rate(host_batch, gs, model, bucket)
+            values = self._device_rate(host_batch, gs, model, bucket, lane)
         except Exception as e:
             tripped = breaker.record_failure(e)
             if tripped:
@@ -789,12 +922,16 @@ class RatingService:
                         'breaker': breaker.to_dict(),
                     },
                 )
-            counter('serve/fallback_flushes', unit='count').inc(1)
+            counter('serve/fallback_flushes', unit='count').inc(
+                1, **self._replica_kw(lane)
+            )
             return self._reference_rate(host_batch, gs, model), 'fallback'
         breaker.record_success()
         return values, 'fused'
 
-    def _flush(self, payloads: List[_Payload], bucket: int) -> List[Any]:
+    def _flush(
+        self, payloads: List[_Payload], bucket: int, *, lane: int = 0
+    ) -> List[Any]:
         _name, _version, model = self._active()  # ONE read per flush
         t0 = time.perf_counter()
         stagings = [p.staging for p in payloads]
@@ -817,7 +954,9 @@ class RatingService:
         # (_device_rate's own pad then no-ops; warmup still relies on it)
         host_batch, gs = _pad_to_bucket(host_batch, gs, bucket)
         t_pad = time.perf_counter()
-        values, path = self._rate_with_breaker(host_batch, gs, model, bucket)
+        values, path = self._rate_with_breaker(
+            host_batch, gs, model, bucket, lane
+        )
         t_dispatch = time.perf_counter()
         if path == 'fused':
             # the live roofline's serve feed: the flush's dispatch wall
@@ -874,9 +1013,10 @@ class RatingService:
         pad_s = t_pad - t0
         dispatch_s = t_dispatch - t_pad
         slice_s = t_slice - t_dispatch
-        record_segment('pad', pad_s, exemplar)
-        record_segment('dispatch', dispatch_s, exemplar)
-        record_segment('slice', slice_s, exemplar)
+        replica_kw = self._replica_kw(lane)
+        record_segment('pad', pad_s, exemplar, **replica_kw)
+        record_segment('dispatch', dispatch_s, exemplar, **replica_kw)
+        record_segment('slice', slice_s, exemplar, **replica_kw)
         for p in payloads:
             if p.ctx is not None:
                 p.ctx.segments.update(
@@ -1084,16 +1224,49 @@ class RatingService:
             self._breaker.to_dict() if self._breaker is not None else None
         )
         breaker_ok = breaker_block is None or breaker_block['state'] == 'closed'
+        replicas_block: Optional[Dict[str, Any]] = None
+        sick: List[str] = []
+        if self.replica_ids:
+            # the mesh view: one entry per replica, naming exactly which
+            # lane is sick (breaker open/probing, or its flusher retired)
+            dead = self._batcher.dead_lanes
+            per_replica: Dict[str, Any] = {}
+            for lane, rid in enumerate(self.replica_ids):
+                b = self._breakers[lane]
+                b_dict = b.to_dict() if b is not None else None
+                lane_dead = lane in dead
+                healthy = not lane_dead and (
+                    b_dict is None or b_dict['state'] == 'closed'
+                )
+                per_replica[rid] = {
+                    'breaker': b_dict,
+                    'flusher_dead': lane_dead,
+                    'healthy': healthy,
+                }
+                if not healthy:
+                    sick.append(rid)
+                breaker_ok = breaker_ok and (
+                    b_dict is None or b_dict['state'] == 'closed'
+                )
+            replicas_block = {
+                'n': self.n_replicas,
+                'per_replica': per_replica,
+                'sick': sick,
+            }
         owned = owned_bytes()
         if not state['flusher_alive']:
             status = 'flusher-dead'
-        elif not numerics_ok or not breaker_ok:
+        elif not numerics_ok or not breaker_ok or sick:
             status = 'degraded'
         else:
             status = 'ok'
+        out_replicas = (
+            {'replicas': replicas_block} if replicas_block is not None else {}
+        )
         return {
             'status': status,
             **state,
+            **out_replicas,
             'numerics': {
                 'ok': numerics_ok,
                 'nonfinite_events': nonfinite_events,
@@ -1257,8 +1430,15 @@ class RatingService:
             self._load_aot_for(name, version, model)
         A = self.max_actions
         with span('serve/warmup', buckets=list(buckets)):
-            for b in buckets:
-                self._device_rate(_empty_host_batch(1, A), _empty_gs(1, A), model, b)
+            # every replica warms its own ladder: lanes compile (or
+            # preload) independently, so steady-state traffic retraces
+            # on NO replica, not just replica 0
+            for lane in range(self.n_replicas):
+                for b in buckets:
+                    self._device_rate(
+                        _empty_host_batch(1, A), _empty_gs(1, A), model, b,
+                        lane=lane,
+                    )
         return buckets
 
     def close(self, *, drain: bool = True) -> None:
@@ -1293,8 +1473,16 @@ class RatingService:
 
     @property
     def breaker(self) -> Optional[CircuitBreaker]:
-        """The fused-dispatch circuit breaker (None when disabled)."""
-        return self._breaker
+        """The fused-dispatch circuit breaker (None when disabled).
+
+        Replica 0's on a fan-out service — :attr:`breakers` has them all.
+        """
+        return self._breakers[0]
+
+    @property
+    def breakers(self) -> Tuple[Optional[CircuitBreaker], ...]:
+        """Every lane's circuit breaker, indexed by replica."""
+        return tuple(self._breakers)
 
     @property
     def nonfinite_events(self) -> int:

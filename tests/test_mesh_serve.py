@@ -1,0 +1,636 @@
+"""Tests for mesh-sharded serving (socceraction_tpu.parallel.serve +
+the replica fan-out inside serve/).
+
+Covers the ISSUE-16 contract: per-replica lane dispatch bitwise equal
+to ``rate_batch``, the ``shard_map`` gang form, 1-vs-N replica service
+parity, mesh-wide hot-swap atomicity (one lane's failed warm aborts
+the swap for every lane), single-sick-replica degradation (one tripped
+breaker degrades that lane ALONE onto the fallback while the others
+stay fused and health names the replica), the N-lane MicroBatcher's
+crash isolation, and the unix-socket front end's RPC round trip —
+including deadline propagation and ``obsctl trace`` stitching the
+client hop to the replica flush on the preserved request id.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from conftest import requires_shard_map
+from socceraction_tpu.core.batch import pack_actions, unpack_values
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs import trace as obs_trace
+from socceraction_tpu.parallel import data_parallel_rate
+from socceraction_tpu.parallel.serve import ReplicaDispatcher
+from socceraction_tpu.resil.faults import FaultPlan, FaultSpec
+from socceraction_tpu.serve import (
+    MicroBatcher,
+    ModelRegistry,
+    RatingService,
+)
+from socceraction_tpu.serve.frontend import (
+    FrontendClient,
+    FrontendError,
+    ServingFrontend,
+)
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 512
+N_REPLICAS = 4
+
+
+def _fit_model(hidden=(16,), seed_games=(0, 1)):
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=300)
+        for i in seed_games
+    ]
+    model = VAEP()
+    X, y = [], []
+    for i, f in zip(seed_games, frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True),
+        pd.concat(y, ignore_index=True),
+        learner='mlp',
+        tree_params={'hidden': hidden, 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+@pytest.fixture(scope='module')
+def model_b():
+    """Same feature layout, different weights (the hot-swap partner)."""
+    return _fit_model(seed_games=(2, 3))
+
+
+@pytest.fixture
+def mesh_registry(tmp_path, model, model_b):
+    reg = ModelRegistry(str(tmp_path / 'models'))
+    reg.publish('vaep', '1', model)
+    reg.publish('vaep', '2', model_b)
+    reg.activate('vaep', '1')
+    return reg
+
+
+def _reference(model, frame, max_actions=MAX_ACTIONS):
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=max_actions)
+    return unpack_values(model.rate_batch(batch, bucket=False), batch)
+
+
+def _frames(n, base=50, lo=60, hi=200):
+    rng = np.random.default_rng(base)
+    return [
+        synthetic_actions_frame(
+            game_id=base + i, seed=base + i,
+            n_actions=int(rng.integers(lo, hi)),
+        )
+        for i in range(n)
+    ]
+
+
+# ----------------------------------------------------- ReplicaDispatcher ----
+
+
+def test_lane_dispatch_is_bitwise_the_single_device_path(model):
+    """Every replica lane returns exactly ``rate_batch(bucket=False)``'s
+    values: same program, same statics — only argument placement moves."""
+    frame = synthetic_actions_frame(game_id=50, seed=50, n_actions=200)
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=MAX_ACTIONS)
+    ref = np.asarray(model.rate_batch(batch, bucket=False))
+    disp = ReplicaDispatcher(model, n_replicas=N_REPLICAS)
+    assert len(disp.devices) == N_REPLICAS
+    for r in range(N_REPLICAS):
+        out = disp.rate_replica(r, batch)
+        assert out.shape == ref.shape
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_lane_dispatch_goalscore_override_parity(model):
+    """An override rides the lane dispatch bitwise too (it SUBSTITUTES
+    the computed feature, so parity must hold under it as well)."""
+    frame = synthetic_actions_frame(game_id=51, seed=51, n_actions=150)
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=MAX_ACTIONS)
+    gs = np.random.default_rng(0).normal(
+        size=(batch.n_games, batch.max_actions, 3)
+    ).astype(np.float32)
+    ref = np.asarray(
+        model.rate_batch(batch, dense_overrides={'goalscore': gs}, bucket=False)
+    )
+    disp = ReplicaDispatcher(model, n_replicas=2)
+    np.testing.assert_array_equal(disp.rate_replica(1, batch, gs), ref)
+
+
+@requires_shard_map
+def test_gang_dispatch_parity(model):
+    """``rate_mesh``: one shard_map over ('replicas',) returns each
+    shard's values — the single-replica gang bitwise, the 4-replica
+    gang within float tolerance of the single-device program."""
+    frame = synthetic_actions_frame(game_id=52, seed=52, n_actions=180)
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=MAX_ACTIONS)
+    ref = np.asarray(model.rate_batch(batch, bucket=False))
+
+    (g1,) = ReplicaDispatcher(model, n_replicas=1).rate_mesh([batch])
+    np.testing.assert_array_equal(g1, ref)
+
+    disp = ReplicaDispatcher(model, n_replicas=N_REPLICAS)
+    outs = disp.rate_mesh([batch] * N_REPLICAS)
+    assert len(outs) == N_REPLICAS
+    for out in outs:
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+@requires_shard_map
+def test_gang_dispatch_rejects_mixed_goalscore(model):
+    """All-or-none: a goalscore override replaces the computed dense
+    block, so a mixed gang (zeros are not "no override") must refuse."""
+    frame = synthetic_actions_frame(game_id=53, seed=53, n_actions=100)
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=MAX_ACTIONS)
+    gs = np.zeros((batch.n_games, batch.max_actions, 3), dtype=np.float32)
+    disp = ReplicaDispatcher(model, n_replicas=2)
+    with pytest.raises(ValueError, match='every replica or for none'):
+        disp.rate_mesh([batch, batch], [gs, None])
+    ref = np.asarray(
+        model.rate_batch(batch, dense_overrides={'goalscore': gs}, bucket=False)
+    )
+    for out in disp.rate_mesh([batch, batch], [gs, gs]):
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_dispatcher_validates_topology(model):
+    import jax
+
+    with pytest.raises(ValueError, match='n_replicas must be >= 1'):
+        ReplicaDispatcher(model, 0)
+    with pytest.raises(ValueError, match='devices are available'):
+        ReplicaDispatcher(model, jax.device_count() + 1)
+
+
+@requires_shard_map
+def test_data_parallel_rate_matches_single_device(model):
+    frame = synthetic_actions_frame(game_id=54, seed=54, n_actions=160)
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=MAX_ACTIONS)
+    ref = np.asarray(model.rate_batch(batch, bucket=False))
+    outs = data_parallel_rate(model, [batch] * N_REPLICAS)
+    assert len(outs) == N_REPLICAS
+    for out in outs:
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+    with pytest.raises(ValueError, match='one batch per replica'):
+        data_parallel_rate(model, [batch, batch], n_replicas=4)
+
+
+# ------------------------------------------------- N-replica RatingService ----
+
+
+def test_one_vs_four_replica_service_bitwise_parity(model):
+    """The mesh service is a pure fan-out: its values are bitwise the
+    single-replica service's for the same requests, health carries the
+    per-replica block, and steady traffic compiles nothing."""
+    frames = _frames(8)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc1:
+        ref = [svc1.rate_sync(f, home_team_id=HOME, timeout=60) for f in frames]
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        n_replicas=N_REPLICAS,
+    ) as svc:
+        assert svc.replica_ids == ('r0', 'r1', 'r2', 'r3')
+        svc.warmup()
+        futs = [svc.rate(f, home_team_id=HOME) for f in frames]
+        for r, fut in zip(ref, futs):
+            out = fut.result(timeout=60)
+            assert (out.index == r.index).all()
+            np.testing.assert_array_equal(out.to_numpy(), r.to_numpy())
+
+        health = svc.health()
+        assert health['status'] == 'ok'
+        replicas = health['replicas']
+        assert replicas['n'] == N_REPLICAS and replicas['sick'] == []
+        assert set(replicas['per_replica']) == set(svc.replica_ids)
+
+        # steady state: more of the same traffic retraces nothing
+        shapes = svc.compiled_shapes
+        futs = [svc.rate(f, home_team_id=HOME) for f in frames]
+        for fut in futs:
+            fut.result(timeout=60)
+        assert svc.compiled_shapes == shapes
+
+
+def test_mesh_service_breaker_topology(model):
+    """n_replicas > 1 builds one breaker per lane (a shared instance
+    defeats per-replica degradation and is refused at construction)."""
+    from socceraction_tpu.resil.breaker import CircuitBreaker
+
+    with pytest.raises(ValueError, match='per-replica'):
+        RatingService(
+            model, max_actions=256, n_replicas=2,
+            breaker=CircuitBreaker(failure_threshold=2, name='serve.dispatch'),
+        )
+    with RatingService(
+        model, max_actions=256, max_batch_size=2, n_replicas=N_REPLICAS,
+    ) as svc:
+        assert len(svc.breakers) == N_REPLICAS
+        assert svc.breaker is svc.breakers[0]
+        names = {b.name for b in svc.breakers}
+        assert names == {f'serve.dispatch.r{i}' for i in range(N_REPLICAS)}
+    with RatingService(
+        model, max_actions=256, max_batch_size=2, n_replicas=2,
+        breaker_failures=0,
+    ) as svc:
+        assert svc.breakers == (None, None)
+
+
+def test_mesh_swap_failed_warm_aborts_all_replicas(mesh_registry, model, model_b):
+    """Mesh-wide swap atomicity: EVERY lane warms before any activates.
+
+    A fault injected into a LATER lane's ladder warm (lane 0 already
+    warmed clean) must abort the swap for the whole mesh — no lane ever
+    serves version 2 — and the same swap succeeds once the fault clears.
+    """
+    probe = synthetic_actions_frame(game_id=90, seed=90, n_actions=150)
+    ref_a = np.asarray(_reference(model, probe))
+    ref_b = np.asarray(_reference(model_b, probe))
+    assert not np.array_equal(ref_a, ref_b)
+
+    with RatingService(
+        registry=mesh_registry, max_actions=MAX_ACTIONS, max_batch_size=4,
+        max_wait_ms=1.0, n_replicas=N_REPLICAS,
+    ) as svc:
+        svc.warmup()
+        out = svc.rate_sync(probe, home_team_id=HOME, timeout=60)
+        np.testing.assert_array_equal(out.to_numpy(), ref_a)
+
+        # the warm loop is deterministic — lane 0 takes calls
+        # 1..len(ladder), lane 1 the next len(ladder), ... — so failing
+        # call len(ladder)+2 fails lane 1's warm AFTER lane 0 finished
+        k = len(svc.ladder) + 2
+        plan = FaultPlan(
+            seed=0,
+            specs=[
+                FaultSpec('serve.dispatch', error=RuntimeError, on_calls=(k,))
+            ],
+        )
+        with plan:
+            with pytest.raises(RuntimeError):
+                svc.swap_model('vaep', '2')
+        assert [h['point'] for h in plan.history] == ['serve.dispatch']
+
+        # no mixed-version mesh: every subsequent request (whatever lane
+        # flushes it) still serves version 1, bitwise — and the failed
+        # rollout degraded nothing
+        for _ in range(N_REPLICAS):
+            out = svc.rate_sync(probe, home_team_id=HOME, timeout=60)
+            np.testing.assert_array_equal(out.to_numpy(), ref_a)
+        health = svc.health()
+        assert health['model']['version'] == '1'
+        assert health['status'] == 'ok'
+
+        # fault cleared: the identical swap lands mesh-wide, and
+        # rollback restores version 1 — both bitwise
+        assert svc.swap_model('vaep', '2') == ('vaep', '2')
+        out = svc.rate_sync(probe, home_team_id=HOME, timeout=60)
+        np.testing.assert_array_equal(out.to_numpy(), ref_b)
+        assert svc.rollback_model() == ('vaep', '1')
+        out = svc.rate_sync(probe, home_team_id=HOME, timeout=60)
+        np.testing.assert_array_equal(out.to_numpy(), ref_a)
+
+
+def test_single_sick_replica_degrades_alone(model):
+    """One lane's open breaker degrades THAT lane onto the materialized
+    fallback; the other lanes keep dispatching fused, every caller still
+    gets correct values, and health names the sick replica."""
+    sick = 2
+    rid = f'r{sick}'
+    frames = _frames(4, base=80, lo=80, hi=120)
+    expected = [np.asarray(_reference(model, f)) for f in frames]
+    before = REGISTRY.snapshot()
+
+    def fallbacks(snap, replica):
+        return snap.value('serve/fallback_flushes', replica=replica)
+
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=2, max_wait_ms=1.0,
+        n_replicas=N_REPLICAS, breaker_failures=2, breaker_recovery_s=1000.0,
+    ) as svc:
+        svc.warmup()
+        # deterministic trip: two consecutive failures recorded on lane
+        # 2's breaker (a FaultSpec matches the point name mesh-wide and
+        # cannot single out a lane)
+        svc.breakers[sick].record_failure(RuntimeError('induced device fault'))
+        svc.breakers[sick].record_failure(RuntimeError('induced device fault'))
+        assert svc.breakers[sick].state == 'open'
+
+        health = svc.health()
+        assert health['status'] == 'degraded'
+        assert health['replicas']['sick'] == [rid]
+        per = health['replicas']['per_replica']
+        assert per[rid]['healthy'] is False
+        assert per[rid]['breaker']['state'] == 'open'
+        for other in svc.replica_ids:
+            if other != rid:
+                assert per[other]['healthy'] is True
+
+        # drive traffic until the sick lane has served at least one
+        # fallback flush (lanes race for the queue, so which lane
+        # flushes a given request is scheduling-dependent)
+        sick_served = False
+        deadline = time.monotonic() + 60.0
+        while not sick_served and time.monotonic() < deadline:
+            futs = [svc.rate(f, home_team_id=HOME) for f in frames]
+            for fut, exp in zip(futs, expected):
+                np.testing.assert_allclose(
+                    fut.result(timeout=60).to_numpy(), exp, atol=1e-4
+                )
+            snap = REGISTRY.snapshot()
+            sick_served = fallbacks(snap, rid) > fallbacks(before, rid)
+        assert sick_served, 'sick lane never took a flush in 60s'
+
+        # the healthy lanes never fell back — degradation stayed local
+        snap = REGISTRY.snapshot()
+        for other in svc.replica_ids:
+            if other != rid:
+                assert fallbacks(snap, other) == fallbacks(before, other)
+        assert svc.breakers[sick].state == 'open'  # dwell not elapsed
+
+
+# --------------------------------------------------- multi-lane batcher ----
+
+
+def test_batcher_lanes_flush_concurrently():
+    """N lanes really drain the one queue in parallel: a barrier only
+    every lane can satisfy trips, with each lane's flush in flight at
+    the same time."""
+    barrier = threading.Barrier(4, timeout=30)
+    lanes_seen = set()
+
+    def runner(payloads, bucket, lane):
+        barrier.wait()
+        lanes_seen.add(lane)
+        return [p * 10 for p in payloads]
+
+    with MicroBatcher(
+        runner, max_batch_size=1, max_wait_ms=0.0, n_lanes=4,
+        lane_names=('r0', 'r1', 'r2', 'r3'),
+    ) as b:
+        futs = [b.submit(i) for i in range(4)]
+        assert sorted(f.result(timeout=30) for f in futs) == [0, 10, 20, 30]
+    assert lanes_seen == {0, 1, 2, 3}
+    snap = REGISTRY.snapshot()
+    for name in ('r0', 'r1', 'r2', 'r3'):
+        assert sum(
+            snap.value('serve/flushes', reason=reason, replica=name)
+            for reason in ('full', 'deadline')
+        ) >= 1
+
+
+def test_batcher_single_lane_death_leaves_survivors_serving():
+    """One lane's permanent death retires it ALONE: its taken requests
+    re-queue for the survivors, submits keep flowing, and only
+    ``dead_lanes`` records the casualty."""
+    plan = FaultPlan(
+        seed=0,
+        specs=[FaultSpec('batcher.flush', error=RuntimeError, on_calls=(1,))],
+    )
+    with MicroBatcher(
+        lambda p, b: [x * 10 for x in p], max_batch_size=1, max_wait_ms=0.0,
+        n_lanes=4, max_flusher_restarts=0,
+    ) as b:
+        with plan:
+            futs = [b.submit(i) for i in range(8)]
+            assert sorted(f.result(timeout=30) for f in futs) == [
+                i * 10 for i in range(8)
+            ]
+        assert len(b.dead_lanes) == 1
+        assert b.crashed is None  # the SERVICE is not dead
+        assert b.flusher_alive
+        # survivors still serve new submits
+        assert b.submit(99).result(timeout=30) == 990
+    assert plan.injections() == 1
+
+
+def test_batcher_all_lanes_dead_fails_queue_and_rejects():
+    """Only the LAST live lane's permanent death fails the queue,
+    rejects new submits, and fires on_crash exactly once."""
+    crashes = []
+    plan = FaultPlan(
+        seed=0, specs=[FaultSpec('batcher.flush', error=RuntimeError)]
+    )
+    b = MicroBatcher(
+        lambda p, bk: p, max_batch_size=1, max_wait_ms=0.0, n_lanes=2,
+        max_flusher_restarts=0, on_crash=crashes.append,
+    )
+    try:
+        with plan:
+            fut = b.submit('doomed')
+            with pytest.raises(RuntimeError, match='flusher thread died'):
+                fut.result(timeout=30)
+        assert len(b.dead_lanes) == 2
+        assert isinstance(b.crashed, RuntimeError)
+        assert not b.flusher_alive
+        assert len(crashes) == 1
+        with pytest.raises(RuntimeError, match='flusher thread died'):
+            b.submit('rejected')
+    finally:
+        plan.disarm()
+        b.close()
+
+
+def test_batcher_validates_lane_config():
+    runner = lambda p, b: p  # noqa: E731
+    with pytest.raises(ValueError, match='n_lanes must be >= 1'):
+        MicroBatcher(runner, n_lanes=0)
+    with pytest.raises(ValueError, match='lane_names'):
+        MicroBatcher(runner, n_lanes=2, lane_names=('only-one',))
+
+
+# ------------------------------------------------------------- front end ----
+
+
+@pytest.fixture
+def frontend(model, tmp_path):
+    sock = str(tmp_path / 'frontend.sock')
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        n_replicas=2,
+    ) as svc:
+        with ServingFrontend(svc, unix_path=sock):
+            yield svc, FrontendClient(sock), sock
+    assert not os.path.exists(sock), 'socket not unlinked on close'
+
+
+def test_frontend_rate_round_trip_is_bitwise(frontend, model):
+    svc, client, _sock = frontend
+    frame = synthetic_actions_frame(game_id=70, seed=70, n_actions=150)
+    ref = svc.rate_sync(frame, home_team_id=HOME, timeout=60)
+    out = client.rate(frame, home_team_id=HOME)
+    assert list(out.columns) == list(ref.columns)
+    assert (out.index == ref.index).all()
+    np.testing.assert_array_equal(out.to_numpy(), ref.to_numpy())
+    assert client.last_request_id
+
+    health = client.health()
+    assert health['status'] == 'ok'
+    assert health['replicas']['n'] == 2
+
+
+def test_frontend_deadline_propagates_to_the_flush(frontend):
+    """An impossible client deadline ships over the wire and fails the
+    request at the service (504) or sheds it (429) — never serves late
+    as if nothing happened."""
+    _svc, client, _sock = frontend
+    frame = synthetic_actions_frame(game_id=71, seed=71, n_actions=100)
+    with pytest.raises(FrontendError) as err:
+        client.rate(frame, home_team_id=HOME, deadline_ms=0.001)
+    assert err.value.status in (504, 429)
+    # a generous deadline rides the same wire field and succeeds
+    out = client.rate(frame, home_team_id=HOME, deadline_ms=60_000)
+    assert len(out) == len(frame)
+
+
+def test_frontend_sessions_round_trip(frontend):
+    svc, client, _sock = frontend
+    frame = synthetic_actions_frame(game_id=72, seed=72, n_actions=120)
+    half = len(frame) // 2
+
+    sid = client.open_session('m1', home_team_id=HOME)
+    v1 = client.session_add(sid, frame.iloc[:half])
+    v2 = client.session_add(sid, frame.iloc[half:])
+    ref = svc.open_session('m2', home_team_id=HOME)
+    np.testing.assert_array_equal(
+        v1.to_numpy(), ref.add_actions(frame.iloc[:half]).to_numpy()
+    )
+    np.testing.assert_array_equal(
+        v2.to_numpy(), ref.add_actions(frame.iloc[half:]).to_numpy()
+    )
+    client.session_close(sid)
+    with pytest.raises(FrontendError) as err:
+        client.session_add(sid, frame.iloc[:4])
+    assert err.value.status == 400
+
+
+def test_frontend_error_mapping(frontend):
+    _svc, client, _sock = frontend
+    with pytest.raises(FrontendError) as err:
+        client._call('POST', '/rate', {'actions': {'columns': {}}})
+    assert err.value.status == 400
+    assert not err.value.retriable
+    with pytest.raises(FrontendError) as err:
+        client._call('POST', '/nope', {})
+    assert err.value.status == 404
+
+
+def test_frontend_trace_stitches_client_hop_to_replica_flush(model, tmp_path):
+    """``obsctl trace <request_id>`` reconstructs the full path: the
+    client hop's enqueue/done plus the service hop (hop=1 via
+    RequestContext.to_wire) with the flush-segment decomposition — on
+    ONE preserved request id across both run logs."""
+    sock = str(tmp_path / 'fe.sock')
+    log = obs_trace.RunLog(str(tmp_path / 'combined.jsonl'))
+    frame = synthetic_actions_frame(game_id=77, seed=77, n_actions=120)
+    with log:
+        with RatingService(
+            model, max_actions=MAX_ACTIONS, max_batch_size=4,
+            max_wait_ms=1.0, n_replicas=2,
+        ) as svc:
+            with ServingFrontend(svc, unix_path=sock):
+                client = FrontendClient(sock)
+                client.rate(frame, home_team_id=HOME)
+                rid = client.last_request_id
+
+    with open(log.path, encoding='utf-8') as fh:
+        events = [json.loads(line) for line in fh if line.strip()]
+    mine = [
+        e for e in events
+        if e.get('request_id') == rid
+        and e['event'] in ('request_enqueue', 'request_done')
+    ]
+    # both processes' views landed: hop 0 (client) and hop 1 (service),
+    # each with its enqueue and done, on the SAME request id
+    by_hop = {}
+    for e in mine:
+        by_hop.setdefault(int(e.get('hop') or 0), []).append(e)
+    assert set(by_hop) == {0, 1}
+    for hop, hop_events in by_hop.items():
+        assert {e['event'] for e in hop_events} == {
+            'request_enqueue', 'request_done'
+        }
+    service_done = next(
+        e for e in by_hop[1] if e['event'] == 'request_done'
+    )
+    assert service_done['status'] == 'ok'
+    assert {'queue_wait', 'pad', 'dispatch', 'slice'} <= set(
+        service_done['segments']
+    )
+
+    # split per originating process (the in-process test's stand-in for
+    # fleet_smoke's two real processes) and stitch through the CLI
+    run_start = [e for e in events if e.get('event') == 'run_start']
+    client_log = str(tmp_path / 'client' / 'obs.jsonl')
+    server_log = str(tmp_path / 'server' / 'obs.jsonl')
+    for path, hop in ((client_log, 0), (server_log, 1)):
+        os.makedirs(os.path.dirname(path))
+        with open(path, 'w', encoding='utf-8') as fh:
+            for e in run_start + by_hop[hop]:
+                fh.write(json.dumps(e) + '\n')
+
+    from tools.obsctl import main as obsctl_main
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = obsctl_main(['trace', rid, client_log, server_log, '--json'])
+    assert rc == 0
+    trace = json.loads(out.getvalue())
+    assert trace['request_id'] == rid
+    assert [h['hop'] for h in trace['hops']] == [0, 1]
+    assert trace['hops'][0]['enqueue'] is not None
+    assert trace['status'] == 'ok'
+    assert {'queue_wait', 'pad', 'dispatch', 'slice'} <= set(trace['segments'])
+
+
+# ------------------------------------------------------------ governance ----
+
+
+def test_benchdiff_headline_includes_replica_sweep():
+    """The replica sweep's ledger metric is a benchdiff headline: a
+    regression in 4-replica throughput fails ``make bench-diff``."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        'benchdiff', os.path.join(root, 'tools', 'benchdiff.py')
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert 'serve_req_per_sec_r4' in mod.HEADLINE_KEYS
+
+
+def test_make_and_ci_run_the_mesh_smoke():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, 'Makefile'), encoding='utf-8') as fh:
+        makefile = fh.read()
+    target = makefile.split('mesh-smoke:')[1].split('\n\n')[0]
+    assert 'tools/mesh_smoke.py' in target
+    assert '--mesh-sweep' in target
+    with open(
+        os.path.join(root, '.github', 'workflows', 'ci.yml'), encoding='utf-8'
+    ) as fh:
+        assert 'make mesh-smoke' in fh.read()

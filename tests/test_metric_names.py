@@ -153,6 +153,12 @@ def test_fleet_area_and_labels_are_registered():
     assert tool.KNOWN_LABELS['fleet'] == {
         'replica', 'state', 'outcome', 'signal'
     }
+    # mesh-replicated serving (ISSUE 16) splits flush-scoped serve
+    # metrics per lane under the SAME bounded-id contract: the serve
+    # area registers ``replica`` too, ids minted via REPLICAS.register
+    assert tool.KNOWN_LABELS['serve'] == {
+        'reason', 'kind', 'bucket', 'segment', 'outcome', 'replica'
+    }
     import pytest
 
     from socceraction_tpu.obs.wire import ReplicaRegistry, WireError

@@ -53,6 +53,9 @@ HEADLINE_KEYS: Tuple[str, ...] = (
     'fused_bf16_actions_per_sec',
     'peak_requests_per_sec',
     'peak_actions_per_sec',
+    # the mesh-replicated serving sweep's headline: sustained req/s at 4
+    # replicas (bench.py --mesh-sweep; its `value` duplicates this key)
+    'serve_req_per_sec_r4',
     # the capacity observatory's serve headline: AOT cost FLOPs over the
     # measured flush wall (bench.py serve_throughput embeds it)
     'serve_achieved_flops_per_sec',

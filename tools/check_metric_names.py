@@ -153,8 +153,13 @@ KNOWN_LABELS = {
     'pipeline': {'stage'},
     'resil': {'point', 'kind', 'site', 'outcome'},
     # serve: ``outcome`` is the AOT-tier load verdict (hit|stale|miss,
-    # serve/aot_loads — serve/aot.py's three-valued contract)
-    'serve': {'reason', 'kind', 'bucket', 'segment', 'outcome'},
+    # serve/aot_loads — serve/aot.py's three-valued contract).
+    # ``replica`` values are lane ids minted through the same bounded
+    # ``obs/wire.py::ReplicaRegistry`` contract as the fleet area
+    # (RatingService registers ``r0..r{N-1}`` at construction): flush-
+    # scoped serve metrics split per mesh replica lane, and the
+    # single-replica service emits the unlabeled legacy series.
+    'serve': {'reason', 'kind', 'bucket', 'segment', 'outcome', 'replica'},
     'slo': {'objective', 'outcome', 'window'},
     'train': {'path', 'platform'},
     'vaep': {'path', 'platform'},

@@ -1,0 +1,302 @@
+"""End-to-end mesh-serving smoke: front end + replica fan-out, one process.
+
+The ``make mesh-smoke`` gate for mesh-sharded serving (ISSUE 16): one
+process hosts a :class:`~socceraction_tpu.serve.RatingService` over an
+8-virtual-device CPU mesh behind a :class:`ServingFrontend` unix
+socket, and client threads drive it through
+:class:`~socceraction_tpu.serve.FrontendClient` — the full client →
+front end → flush-lane → replica-device path. Asserted contracts:
+
+1. **Scaling, honestly.** Sustained front-end req/s at 4 replicas vs 1
+   replica. On a box with >= 4 physical cores the 4-replica service
+   must clear **2x** the 1-replica rate; on fewer cores the lanes
+   time-slice the same silicon, so the gate degrades to a
+   no-regression floor and PRINTS that the scaling claim was not
+   checkable here (a 1-core CI box measuring "4x" would be fiction).
+2. **Zero steady-state retraces per replica.** After each service's
+   warmup (which compiles every lane's bucket ladder), the measured
+   traffic must compile NOTHING: ``compiled_shapes`` frozen and zero
+   new ``xla/compiles{fn=pair_probs}``.
+3. **Mesh-wide swap + rollback round trip.** ``swap_model`` on the
+   4-replica service (every lane warmed before any activates) must
+   change the served values to the new version's — bitwise, through
+   the front end — and ``rollback_model`` must restore the old
+   version's values bitwise.
+4. **Fleet scrape merges per-replica serve metrics exactly.** A
+   :class:`~socceraction_tpu.obs.fleet.FleetAggregator` scraping this
+   process's telemetry endpoint must reproduce ``serve/requests``
+   integer-exactly, with the per-lane ``serve/flushes{replica=...}``
+   series surviving the wire side by side and summing to the local
+   total.
+
+Exit 0 on success; any violated invariant exits non-zero with the
+evidence printed. CPU-sized (~a minute); re-execs itself with
+``--xla_force_host_platform_device_count=8`` so the mesh exists before
+jax initializes. Wired as ``make mesh-smoke`` next to fleet-smoke in CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+__all__ = ['main']
+
+N_REPLICAS = 4
+N_CLIENTS = 4
+DURATION_S = float(os.environ.get('SOCCERACTION_TPU_MESH_SMOKE_SECONDS', 2.0))
+HOME = 100
+
+
+def _reexec_with_mesh() -> None:
+    """Re-exec with 8 virtual CPU devices (must precede jax import)."""
+    flags = os.environ.get('XLA_FLAGS', '')
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    if platforms == 'cpu' and 'xla_force_host_platform_device_count' in flags:
+        return
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8'
+    ).strip()
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    rc = subprocess.call(
+        [sys.executable, os.path.abspath(__file__)], env=env, cwd=REPO
+    )
+    sys.exit(rc)
+
+
+def _fit_model(seed: int):
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.vaep.base import VAEP
+
+    games = (seed, seed + 1)
+    frames = [
+        synthetic_actions_frame(game_id=g, seed=g, n_actions=300)
+        for g in games
+    ]
+    model = VAEP()
+    X, y = [], []
+    for g, f in zip(games, frames):
+        game = pd.Series({'game_id': g, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(seed)
+    model.fit(
+        pd.concat(X, ignore_index=True), pd.concat(y, ignore_index=True),
+        learner='mlp', tree_params={'hidden': (16,), 'max_epochs': 2},
+    )
+    return model
+
+
+def _drive(client_path: str, pool, duration_s: float) -> float:
+    """Closed-loop FrontendClient threads; returns sustained req/s."""
+    from socceraction_tpu.serve.frontend import FrontendClient, FrontendError
+
+    counts = [0] * N_CLIENTS
+    stop = time.perf_counter() + duration_s
+
+    def client(ci: int) -> None:
+        c = FrontendClient(client_path)
+        k = ci
+        while time.perf_counter() < stop:
+            frame = pool[k % len(pool)]
+            k += 1
+            try:
+                c.rate(frame, home_team_id=HOME)
+            except FrontendError as e:
+                if not e.retriable:
+                    raise
+                continue
+            counts[ci] += 1
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(ci,)) for ci in range(N_CLIENTS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(counts) / (time.perf_counter() - t0)
+
+
+def main() -> int:
+    _reexec_with_mesh()
+
+    import numpy as np
+    import pandas as pd
+
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.obs import REGISTRY
+    from socceraction_tpu.obs.endpoint import serve as serve_telemetry
+    from socceraction_tpu.obs.fleet import FleetAggregator
+    from socceraction_tpu.serve import ModelRegistry, RatingService
+    from socceraction_tpu.serve.frontend import FrontendClient, ServingFrontend
+
+    evidence: dict = {'cores': os.cpu_count(), 'duration_s': DURATION_S}
+    model_a = _fit_model(0)
+    model_b = _fit_model(7)
+    pool = [
+        synthetic_actions_frame(game_id=40 + i, seed=40 + i, n_actions=120)
+        for i in range(8)
+    ]
+    probe = synthetic_actions_frame(game_id=60, seed=60, n_actions=150)
+
+    def reference(model):
+        game = pd.Series({'game_id': 60, 'home_team_id': HOME})
+        return model.rate(game, probe).to_numpy()
+
+    ref_a, ref_b = reference(model_a), reference(model_b)
+    assert not np.array_equal(ref_a, ref_b), 'v1/v2 models must differ'
+
+    with tempfile.TemporaryDirectory(prefix='mesh-smoke-') as tmp:
+        registry = ModelRegistry(os.path.join(tmp, 'models'))
+        registry.publish('vaep', '1', model_a)
+        registry.publish('vaep', '2', model_b)
+        registry.activate('vaep', '1')
+
+        def service(n_replicas: int) -> RatingService:
+            return RatingService(
+                registry=registry, max_actions=512, max_batch_size=4,
+                max_wait_ms=2.0, max_queue=256, n_replicas=n_replicas,
+            )
+
+        def steady_leg(n_replicas: int, key: str) -> float:
+            sock = os.path.join(tmp, f'{key}.sock')
+            with service(n_replicas) as svc:
+                with ServingFrontend(svc, unix_path=sock):
+                    svc.warmup()
+                    shapes = svc.compiled_shapes
+                    compiles = REGISTRY.snapshot().value(
+                        'xla/compiles', fn='pair_probs'
+                    )
+                    rate = _drive(sock, pool, DURATION_S)
+                    # gate 2: steady traffic compiles nothing on any lane
+                    assert svc.compiled_shapes == shapes, (
+                        f'{key}: steady-state retrace '
+                        f'({shapes} -> {svc.compiled_shapes} shapes)'
+                    )
+                    new_compiles = REGISTRY.snapshot().value(
+                        'xla/compiles', fn='pair_probs'
+                    ) - compiles
+                    assert new_compiles == 0, (
+                        f'{key}: {new_compiles} pair_probs compiles during '
+                        'steady traffic'
+                    )
+                    health = svc.health()
+                    assert health['status'] == 'ok', health
+                    if n_replicas > 1:
+                        assert health['replicas']['sick'] == [], health
+            evidence[f'req_per_sec_{key}'] = round(rate, 1)
+            return rate
+
+        rate1 = steady_leg(1, 'r1')
+        rate4 = steady_leg(N_REPLICAS, 'r4')
+
+        # gate 1: the scaling claim, only where it is measurable
+        cores = os.cpu_count() or 1
+        if cores >= N_REPLICAS:
+            assert rate4 >= 2.0 * rate1, (
+                f'{N_REPLICAS} replicas sustained {rate4:.1f} req/s vs '
+                f'{rate1:.1f} at 1 replica on {cores} cores — expected >= 2x'
+            )
+            evidence['scaling_gate'] = '>=2x enforced'
+        else:
+            assert rate4 >= 0.4 * rate1, (
+                f'replica fan-out REGRESSED throughput on {cores} core(s): '
+                f'{rate4:.1f} req/s at {N_REPLICAS} replicas vs {rate1:.1f} '
+                'at 1 — overlap bookkeeping must not cost >60%'
+            )
+            evidence['scaling_gate'] = (
+                f'NOT CHECKABLE: {cores} core(s) < {N_REPLICAS} replicas — '
+                'lanes time-slice one core; enforced no-regression floor only'
+            )
+            print(
+                f'mesh-smoke NOTE: only {cores} physical core(s); the >=2x '
+                'scaling gate needs >= 4 — ran the no-regression floor instead'
+            )
+
+        # gates 3+4 on a fresh 4-replica service under a live endpoint
+        sock = os.path.join(tmp, 'swap.sock')
+        with service(N_REPLICAS) as svc:
+            with ServingFrontend(svc, unix_path=sock):
+                svc.warmup()
+                client = FrontendClient(sock)
+                out1 = client.rate(probe, home_team_id=HOME).to_numpy()
+                assert np.array_equal(out1, ref_a), 'v1 served wrong values'
+
+                # gate 3: mesh-wide swap (every lane warmed before any
+                # activates) then rollback, bitwise through the front end
+                assert svc.swap_model('vaep', '2') == ('vaep', '2')
+                out2 = client.rate(probe, home_team_id=HOME).to_numpy()
+                assert np.array_equal(out2, ref_b), (
+                    'post-swap values are not version 2\'s'
+                )
+                assert svc.rollback_model() == ('vaep', '1')
+                out3 = client.rate(probe, home_team_id=HOME).to_numpy()
+                assert np.array_equal(out3, ref_a), (
+                    'post-rollback values are not version 1\'s'
+                )
+                evidence['swap_rollback'] = 'bitwise round trip ok'
+
+                # gate 4: the fleet plane merges this process's
+                # per-replica serve metrics integer-exactly
+                local = REGISTRY.snapshot()
+                with serve_telemetry(
+                    telemetry=svc.telemetry(replica='mesh-front'),
+                    unix_path=os.path.join(tmp, 'telemetry.sock'),
+                ) as endpoint:
+                    agg = FleetAggregator(
+                        {'mesh-front': endpoint.address}, stale_after_s=30.0
+                    )
+                    assert agg.scrape() == {'mesh-front': True}
+                    fleet = agg.aggregate()
+                assert fleet.status == 'ok', fleet.status
+                merged = fleet.typed()
+                local = REGISTRY.snapshot()
+                assert (
+                    merged.value('serve/requests', kind='rate')
+                    == local.value('serve/requests', kind='rate')
+                    > 0
+                ), 'fleet merge lost serve/requests'
+                lanes_local = lanes_merged = 0
+                for rid in svc.replica_ids:
+                    for snap, tally in ((local, 'local'), (merged, 'merged')):
+                        n = sum(
+                            int(snap.value(
+                                'serve/flushes', reason=reason, replica=rid
+                            ))
+                            for reason in ('full', 'deadline')
+                        )
+                        if tally == 'local':
+                            lanes_local += n
+                        else:
+                            lanes_merged += n
+                assert lanes_local == lanes_merged > 0, (
+                    f'per-replica flush series did not survive the wire '
+                    f'exactly: local={lanes_local} merged={lanes_merged}'
+                )
+                evidence['fleet_merge'] = {
+                    'serve_requests': int(merged.value('serve/requests', kind='rate')),
+                    'replica_flushes': lanes_merged,
+                }
+
+    print('mesh-smoke OK ' + json.dumps(evidence, sort_keys=True))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
