@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff learn-smoke obs-smoke chaos-smoke capacity-smoke fleet-smoke mesh-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff cf-smoke learn-smoke obs-smoke chaos-smoke capacity-smoke fleet-smoke mesh-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -110,6 +110,14 @@ bench-smoke:
 	$(PY) bench.py --train-smoke
 	$(PY) bench.py --serve-smoke
 	$(PY) bench.py --xt-smoke
+
+# the counterfactual scenario engine driven end to end on CPU: one
+# folded dispatch values a whole perturbation grid at 1/8/64
+# perturbations, asserted bitwise equal to the looped per-perturbation
+# baseline with zero steady-state retraces per perturbation bucket; the
+# cf_values_per_sec headline lands in the ledger
+cf-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --cf-smoke
 
 # regression verdicts between the two newest bench_history/ ledger
 # entries (every bench/smoke artifact is appended there); exits 1 on a

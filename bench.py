@@ -499,6 +499,18 @@ def _bench_extra_configs() -> dict:
         duration_s=min(serve_s, 4.0)
     )
 
+    # --- counterfactual scenario engine (ISSUE 18): cf values/s at
+    # --- 1/64/4096 perturbations, one folded dispatch each ----------------
+    cf_counts = tuple(
+        int(x) for x in os.environ.get(
+            'SOCCERACTION_TPU_BENCH_CF_COUNTS', '1,64,4096'
+        ).split(',')
+    )
+    cf_looped = int(os.environ.get('SOCCERACTION_TPU_BENCH_CF_LOOPED', 64))
+    out['counterfactual_sweep'] = _bench_counterfactual(
+        p_counts=cf_counts, looped_at=cf_looped
+    )
+
     learn_games = int(os.environ.get('SOCCERACTION_TPU_BENCH_LEARN_GAMES', 24))
     out['continuous_learning'] = _bench_continuous_learning(games=learn_games)
     return out
@@ -677,6 +689,139 @@ def _bench_xt_batched(
             'speedup_vs_sequential': round(seq_wall / batched_wall, 1)
             if batched_wall else None,
         }
+    return out
+
+
+def _bench_counterfactual(
+    *,
+    p_counts: tuple = (1, 64, 4096),
+    n_actions: int = 256,
+    max_actions: int = 512,
+    looped_at: int = 64,
+    model=None,
+) -> dict:
+    """Counterfactual scenario engine: cf values/s per perturbation count.
+
+    Values a ``P``-perturbation end-location grid over one match in ONE
+    folded ``rate_batch`` dispatch (:mod:`socceraction_tpu.scenario`)
+    at each ``p_counts`` level, recording seconds per dispatch, valued
+    counterfactuals per second, and the per-bucket compile accounting:
+    the first dispatch at a new perturbation bucket may compile (that
+    rung of the ladder), a repeat at the same bucket must compile
+    NOTHING (``steady_state_compiles``, gated by ``--cf-smoke``).
+
+    The looped baseline (one ``rate_batch`` call per perturbation, the
+    pre-engine cost of a grid) is measured once at ``looped_at``
+    perturbations — its per-value rate is P-invariant (P independent
+    dispatches), so ``speedup_at_max_vs_looped_rate`` compares the top
+    fused level against it without paying ``max(p_counts)`` sequential
+    dispatches. The fused-vs-looped value block at ``looped_at`` is also
+    compared elementwise — ``parity_bitwise`` must hold on CPU (the
+    acceptance oracle; quantized/TPU paths assert closeness upstream).
+    """
+    import numpy as np
+
+    from socceraction_tpu.core.batch import pack_actions
+    from socceraction_tpu.core.synthetic import synthetic_actions_frame
+    from socceraction_tpu.obs import REGISTRY
+    from socceraction_tpu.obs.xla import fn_cost
+    from socceraction_tpu.scenario import (
+        bucket_perturbations,
+        end_location_grid,
+        pad_perturbations,
+        rate_scenarios_batch,
+        rate_scenarios_looped,
+    )
+
+    if model is None:
+        model = _fit_serve_model()
+    frame = synthetic_actions_frame(game_id=900, seed=900, n_actions=n_actions)
+    batch, _ids = pack_actions(
+        frame, home_team_id=100, max_actions=max_actions, as_numpy=True
+    )
+
+    def _grid(P: int):
+        # an end-location sweep padded up to exactly P slots: every level
+        # is a realistic product grid, snapped like the serving verb snaps
+        nx = max(1, int(np.sqrt(P)))
+        ny = max(1, P // nx)
+        while nx * ny > P:
+            ny -= 1
+        g = end_location_grid(nx=nx, ny=max(1, ny))
+        return pad_perturbations(g, P) if g.n_perturbations < P else g
+
+    def _compiles() -> float:
+        snap = REGISTRY.snapshot()
+        return sum(
+            snap.value('xla/compiles', fn=fn) or 0
+            for fn in ('pair_probs', 'pair_probs_prepared')
+        )
+
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    out: dict = {
+        'n_actions': n_actions,
+        'max_actions': max_actions,
+        'levels': [],
+    }
+    for P in p_counts:
+        grid = _grid(int(P))
+        c0 = _compiles()
+        t0 = time.perf_counter()
+        values = rate_scenarios_batch(model, batch, grid, bucket=True)
+        warm_dt = time.perf_counter() - t0
+        first_compiles = _compiles() - c0
+        c1 = _compiles()
+        dt, reliable = _measure(
+            lambda: rate_scenarios_batch(model, batch, grid, bucket=True),
+            (), n_iters=3,
+        )
+        level = {
+            'P': int(P),
+            'bucket': bucket_perturbations(int(P)),
+            'seconds_per_dispatch': round(dt, 5),
+            'first_dispatch_seconds': round(warm_dt, 5),
+            'cf_values_per_sec': round(int(P) * n_actions / dt, 1),
+            'compiles_first_dispatch': first_compiles,
+            'steady_state_compiles': _compiles() - c1,
+            **({} if reliable else {'measurement_unreliable': True}),
+        }
+        if values.shape != (grid.n_perturbations, 1, max_actions, 3):
+            level['shape_error'] = list(values.shape)
+        out['levels'].append(level)
+    cost = fn_cost('pair_probs') or fn_cost('pair_probs_prepared')
+    top = out['levels'][-1]
+    if cost is not None:
+        top['cost_flops'], top['cost_bytes'] = cost
+        top['roofline'] = _roofline(
+            device_kind, top['seconds_per_dispatch'], *cost
+        )
+
+    # the pre-engine baseline: P sequential dispatches of the same grid
+    lg = _grid(int(looped_at))
+    fused_block = rate_scenarios_batch(model, batch, lg, bucket=True)
+    looped_block = rate_scenarios_looped(model, batch, lg, bucket=True)  # warm
+    t0 = time.perf_counter()
+    rate_scenarios_looped(model, batch, lg, bucket=True)
+    dt_looped = time.perf_counter() - t0  # one pass IS P timed dispatches
+    looped_rate = int(looped_at) * n_actions / dt_looped
+    fused_at_looped = next(
+        (lv for lv in out['levels'] if lv['P'] == int(looped_at)), None
+    )
+    out['looped_baseline'] = {
+        'P': int(looped_at),
+        'seconds_total': round(dt_looped, 4),
+        'cf_values_per_sec': round(looped_rate, 1),
+    }
+    out['parity_bitwise'] = bool(np.array_equal(fused_block, looped_block))
+    if fused_at_looped is not None:
+        out['speedup_vs_looped'] = round(
+            fused_at_looped['cf_values_per_sec'] / looped_rate, 1
+        )
+    out['speedup_at_max_vs_looped_rate'] = round(
+        top['cf_values_per_sec'] / looped_rate, 1
+    )
     return out
 
 
@@ -2535,6 +2680,60 @@ def _xt_smoke() -> None:
     print(json.dumps(artifact))
 
 
+def _cf_smoke() -> None:
+    """``make cf-smoke``: the counterfactual scenario engine at CPU scale.
+
+    Drives :func:`_bench_counterfactual` at 1/8/64 perturbations and
+    asserts the engine's structural acceptance gates where they are
+    exact: the fused grid dispatch is BITWISE equal to the looped
+    per-perturbation baseline on CPU, and re-dispatching a warm
+    perturbation bucket compiles nothing (zero steady-state retraces —
+    the bucket ladder owns the compiled-shape count, not the request
+    mix). The measured speedup and the ``cf_values_per_sec`` headline
+    land in the ledger for ``tools/benchdiff.py``. Same clean-CPU
+    re-exec recipe as :func:`_xt_smoke`.
+    """
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    axon_disabled = os.environ.get('PALLAS_AXON_POOL_IPS', 'unset') == ''
+    if not (platforms == 'cpu' and axon_disabled):
+        here = os.path.dirname(os.path.abspath(__file__))
+        rc = subprocess.call(
+            [sys.executable, os.path.join(here, 'bench.py'), '--cf-smoke'],
+            env=_cpu_env(),
+            cwd=here,
+        )
+        sys.exit(rc)
+    out = _bench_counterfactual(
+        p_counts=(1, 8, 64), n_actions=128, max_actions=256, looped_at=64
+    )
+    for level in out['levels']:
+        assert level['steady_state_compiles'] == 0, (
+            f"P={level['P']} compiled {level['steady_state_compiles']} "
+            'programs re-dispatching a warm perturbation bucket — the '
+            'scenario fold retraced'
+        )
+    assert out['parity_bitwise'], (
+        'fused grid valuation diverged from the looped per-perturbation '
+        'baseline on CPU — the fold is not a pure reordering'
+    )
+    assert out['speedup_vs_looped'] > 1.0, (
+        f"fused dispatch is not faster than the loop it replaces "
+        f"({out['speedup_vs_looped']}x at P={out['looped_baseline']['P']})"
+    )
+    top = out['levels'][-1]
+    artifact = {
+        'metric': 'cf_values_per_sec',
+        'value': top['cf_values_per_sec'],
+        'cf_values_per_sec': top['cf_values_per_sec'],
+        'unit': 'values/sec',
+        'platform': 'cpu',
+        'smoke': True,
+        **out,
+    }
+    _persist_artifact(artifact)
+    print(json.dumps(artifact))
+
+
 def _build_coldstart_registry(root: str) -> None:
     """Fit a small standard-SPADL VAEP and publish it as ``coldstart/1``.
 
@@ -2875,6 +3074,9 @@ def main() -> None:
         return
     if '--xt-smoke' in sys.argv:
         _xt_smoke()
+        return
+    if '--cf-smoke' in sys.argv:
+        _cf_smoke()
         return
     if '--learn-smoke' in sys.argv:
         _learn_smoke()

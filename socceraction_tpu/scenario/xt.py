@@ -1,0 +1,88 @@
+"""Scenario fleets on the xT side: one grouped solve for every variant.
+
+Where the VAEP half of the engine folds perturbations into the *game*
+axis, the xT half folds them into the **fleet** axis that the batched
+solver already has: every scenario (a perturbed-transition variant, a
+score-state slice, a phase slice) becomes one ``group_id`` of a single
+grouped :meth:`~socceraction_tpu.xthreat.ExpectedThreat.fit`, whose
+whole fleet of surfaces is counted by one scatter-add and solved in ONE
+``while_loop`` dispatch with per-grid convergence certificates
+(``converged_per_grid_`` / ``solve_residual_per_grid_``). The grouped
+fleet is pinned elementwise-equal to per-scenario single fits by
+``tests/test_scenario.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Optional, Union
+
+import pandas as pd
+
+from ..xthreat import ExpectedThreat
+
+__all__ = ['SCENARIO_COLUMN', 'xt_scenario_fleet']
+
+#: The synthetic group column :func:`xt_scenario_fleet` keys the fleet by.
+SCENARIO_COLUMN = '__scenario__'
+
+#: A scenario spec: a ready action frame, or a transform applied to the
+#: base frame (``None`` means "the factual frame, untouched").
+Scenario = Union[pd.DataFrame, Callable[[pd.DataFrame], pd.DataFrame], None]
+
+
+def xt_scenario_fleet(
+    actions: Optional[pd.DataFrame],
+    scenarios: Mapping[Any, Scenario],
+    **model_kwargs: Any,
+) -> ExpectedThreat:
+    """Fit one grouped xT model over a whole fleet of scenario frames.
+
+    Parameters
+    ----------
+    actions
+        The factual SPADL action frame every callable scenario perturbs.
+        May be ``None`` when every scenario supplies its own frame.
+    scenarios
+        ``{key: scenario}`` — each value is a DataFrame (used as-is), a
+        callable ``frame -> frame`` transform of ``actions`` (the
+        perturbed-transition form: flip results, reweight moves, slice
+        phases), or ``None`` for the untouched factual frame.
+    model_kwargs
+        Forwarded to :class:`~socceraction_tpu.xthreat.ExpectedThreat`
+        (``l``, ``w``, ``variant``, ``solver``, ...).
+
+    Returns the fitted grouped model: ``surface(key)`` gives each
+    scenario's surface, ``group_keys_`` lists the fleet, and the
+    per-grid certificate vectors report each scenario's convergence —
+    all from ONE grouped solve, never one fit per scenario.
+    """
+    if not scenarios:
+        raise ValueError('xt_scenario_fleet needs at least one scenario')
+    frames = []
+    for key, spec in scenarios.items():
+        if callable(spec):
+            if actions is None:
+                raise ValueError(
+                    f'scenario {key!r} is a transform but no base actions '
+                    'frame was given'
+                )
+            frame = spec(actions.copy())
+        elif spec is None:
+            if actions is None:
+                raise ValueError(
+                    f'scenario {key!r} is None (factual) but no base '
+                    'actions frame was given'
+                )
+            frame = actions.copy()
+        else:
+            frame = spec.copy()
+        if SCENARIO_COLUMN in frame.columns:
+            raise ValueError(
+                f'scenario frames must not already carry {SCENARIO_COLUMN!r}'
+            )
+        frame[SCENARIO_COLUMN] = key
+        frames.append(frame)
+    combined = pd.concat(frames, ignore_index=True)
+    model = ExpectedThreat(**model_kwargs)
+    model.fit(combined, group_by=SCENARIO_COLUMN)
+    return model

@@ -19,9 +19,10 @@ concurrent callers onto the fused one-dispatch rating path:
   deserializing instead of recompiling (plus the persistent
   compile-cache middle tier, ``SOCCERACTION_TPU_COMPILE_CACHE``).
 - :mod:`socceraction_tpu.serve.service` — :class:`RatingService`, the
-  front end (``rate() -> Future``, ``open_session``, ``swap_model``,
-  ``rollback_model``), fully instrumented under the ``serve`` telemetry
-  area.
+  front end (``rate() -> Future``, ``rate_scenarios() -> Future`` — the
+  counterfactual verb over :mod:`socceraction_tpu.scenario` grids —
+  ``open_session``, ``swap_model``, ``rollback_model``), fully
+  instrumented under the ``serve`` telemetry area.
 - :mod:`socceraction_tpu.serve.capture` — :class:`TrafficCapture`, the
   bounded ring of recently served traffic the continuous-learning
   loop's shadow evaluation (:mod:`socceraction_tpu.learn`) replays.

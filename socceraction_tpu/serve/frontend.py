@@ -169,7 +169,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         else:
             self._send(404, {
                 'error': f'unknown route GET {path!r}',
-                'routes': ['GET /health', 'POST /rate', 'POST /session/*'],
+                'routes': [
+                    'GET /health', 'POST /rate', 'POST /scenarios',
+                    'POST /session/*',
+                ],
             })
 
     def do_POST(self) -> None:  # noqa: N802 (http.server contract)
@@ -184,6 +187,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         try:
             if path == '/rate':
                 self._send(200, frontend.handle_rate(doc))
+            elif path == '/scenarios':
+                self._send(200, frontend.handle_scenarios(doc))
             elif path == '/session/open':
                 self._send(200, frontend.handle_session_open(doc))
             elif path == '/session/add':
@@ -317,6 +322,38 @@ class ServingFrontend:
         out = _values_to_wire(values)
         out['request_id'] = ctx.request_id if ctx is not None else None
         return out
+
+    def handle_scenarios(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """``POST /scenarios``: value a counterfactual grid for one match.
+
+        The wire form of
+        :meth:`~socceraction_tpu.serve.service.RatingService.rate_scenarios`:
+        ``doc['grid']`` is a
+        :meth:`~socceraction_tpu.scenario.grid.ScenarioGrid.to_wire`
+        document, the reply carries the flat ``(P, n_rows, 3)`` value
+        block plus its shape, the value column names and the frame's
+        row index — everything a decision-heatmap client needs to
+        reassemble ranked tables without a second round trip.
+        """
+        from ..scenario.grid import ScenarioGrid
+
+        frame = _frame_from_wire(doc.get('actions') or {})
+        grid = ScenarioGrid.from_wire(doc.get('grid') or {})
+        ctx = self._context_of(doc)
+        future = self.service.rate_scenarios(
+            frame,
+            grid,
+            home_team_id=doc.get('home_team_id'),
+            context=ctx,
+        )
+        values = np.asarray(self._await(future, ctx), dtype=np.float64)
+        return {
+            'shape': list(values.shape),
+            'values': values.ravel().tolist(),
+            'columns': list(RATING_COLUMNS),
+            'index': np.asarray(frame.index).tolist(),
+            'request_id': ctx.request_id if ctx is not None else None,
+        }
 
     def handle_session_open(self, doc: Dict[str, Any]) -> Dict[str, Any]:
         """``POST /session/open``: open a match session, return its id."""
@@ -465,6 +502,48 @@ class FrontendClient:
         record_request_done(ctx, 'ok', _time.perf_counter() - t0)
         self.last_request_id = out.get('request_id', ctx.request_id)
         return _values_from_wire(out)
+
+    def rate_scenarios(
+        self,
+        actions: pd.DataFrame,
+        grid: Any,
+        *,
+        home_team_id: Any = None,
+        deadline_ms: Optional[float] = None,
+    ) -> np.ndarray:
+        """Value a counterfactual grid through the front end (blocking).
+
+        Ships the frame plus ``grid.to_wire()`` to ``POST /scenarios``
+        and returns the ``(P, len(actions), 3)`` value array — the same
+        contract as ``RatingService.rate_scenarios_sync``, across the
+        process boundary, with the request id preserved for trace
+        stitching exactly like :meth:`rate`.
+        """
+        import time as _time
+
+        from ..obs.context import record_request_done, record_request_enqueue
+
+        ctx = new_request_context('scenario', deadline_ms=deadline_ms)
+        record_request_enqueue(ctx, queue_depth=0)
+        t0 = _time.perf_counter()
+        try:
+            out = self._call('POST', '/scenarios', {
+                'actions': _frame_to_wire(actions),
+                'grid': grid.to_wire(),
+                'home_team_id': home_team_id,
+                'context': ctx.to_wire(),
+            })
+        except Exception as e:
+            record_request_done(
+                ctx, 'error', _time.perf_counter() - t0,
+                error=f'{type(e).__name__}: {e}',
+            )
+            raise
+        record_request_done(ctx, 'ok', _time.perf_counter() - t0)
+        self.last_request_id = out.get('request_id', ctx.request_id)
+        return np.asarray(out['values'], dtype=np.float64).reshape(
+            out['shape']
+        )
 
     def health(self) -> Dict[str, Any]:
         """The service's health dict, across the boundary."""

@@ -39,7 +39,7 @@ import numpy as np
 import pandas as pd
 
 from ..core.batch import ActionBatch, pack_actions, pad_batch_games, unpack_values
-from ..obs import REGISTRY, counter, gauge, span
+from ..obs import REGISTRY, counter, gauge, histogram, span
 from ..obs.context import RequestContext, new_request_context, record_segment
 from ..obs.numerics import drain_guards
 from ..obs.parity import ParityProbe
@@ -49,6 +49,13 @@ from ..obs.recorder import dump_debug_bundle
 from ..obs.slo import SLOConfig, SLOEngine
 from ..resil.breaker import CircuitBreaker
 from ..resil.faults import fault_point
+from ..scenario.engine import (
+    bucket_perturbations,
+    expand_scenarios,
+    perturbation_ladder,
+    rate_scenarios_reference,
+)
+from ..scenario.grid import ScenarioGrid, pad_perturbations
 from .batcher import MicroBatcher, Overloaded
 from .session import (
     WINDOW_LOCAL_KERNELS,
@@ -98,6 +105,26 @@ class _Payload:
         self.gs = gs  # (1, A, 3) f32 goalscore block
         self.keep = keep  # None (whole frame) | (context, m) window slice
         self.index = index  # pandas index for frame requests
+        self.ctx = ctx  # RequestContext (trace identity + segments)
+
+
+class _ScenarioPayload:
+    """One packed counterfactual request: a staging batch plus its grid.
+
+    Rides the same batcher queue as :class:`_Payload` (admission,
+    deadline expiry, SLO scoring and lane fan-out all apply unchanged)
+    but is dispatched as its own flush: the grid's perturbation axis is
+    folded into the game axis at its own power-of-two bucket, so it can
+    never share a game-axis bucket with coalesced rate traffic.
+    """
+
+    __slots__ = ('staging', 'gs', 'grid', 'index', 'ctx')
+
+    def __init__(self, staging, gs, grid, index=None, ctx=None) -> None:
+        self.staging = staging  # host ActionBatch, (1, A) numpy fields
+        self.gs = gs  # (1, A, 3) f32 goalscore block
+        self.grid = grid  # ScenarioGrid, P perturbations
+        self.index = index  # pandas index of the request frame
         self.ctx = ctx  # RequestContext (trace identity + segments)
 
 
@@ -197,6 +224,13 @@ class RatingService:
         registered with the fleet's
         :class:`~socceraction_tpu.obs.wire.ReplicaRegistry`. Requires
         ``N`` visible devices and a fused-dispatch-capable model.
+    max_perturbations : int
+        Top of the scenario verb's perturbation bucket ladder
+        (:meth:`rate_scenarios`). A grid with more perturbations than
+        this is rejected at call time; the ladder itself is
+        ``(1, 2, 4, ..., max_perturbations)`` (rounded up to a power of
+        two), and :meth:`warmup` with ``scenario_buckets=`` pre-compiles
+        chosen rungs so steady-state scenario traffic never retraces.
     aot_dir : str, optional
         An explicit AOT artifact directory (the ``aot/`` layout
         :func:`socceraction_tpu.serve.aot.export_serving_aot` writes)
@@ -234,6 +268,7 @@ class RatingService:
         breaker_failures: int = 3,
         breaker_recovery_s: float = 5.0,
         n_replicas: int = 1,
+        max_perturbations: int = 4096,
         aot_dir: Optional[str] = None,
         debug_dir: Optional[str] = None,
         overload_dump_threshold: int = 64,
@@ -297,6 +332,9 @@ class RatingService:
         self.n_replicas = int(n_replicas)
         if self.n_replicas < 1:
             raise ValueError('n_replicas must be >= 1')
+        self.max_perturbations = int(max_perturbations)
+        if self.max_perturbations < 1:
+            raise ValueError('max_perturbations must be >= 1')
         if self.n_replicas > 1:
             if breaker is not None:
                 raise ValueError(
@@ -354,6 +392,7 @@ class RatingService:
         )
         self._shape_lock = threading.Lock()
         self._seen_shapes: set = set()
+        self._seen_scenario_buckets: set = set()
         #: explicit artifact source for model-backed services
         self._aot_dir_override = aot_dir
         #: last AOT load summary + the (name, version) it was tried for
@@ -707,6 +746,121 @@ class RatingService:
             actions, home_team_id=home_team_id, deadline_ms=deadline_ms
         ).result(timeout)
 
+    def rate_scenarios(
+        self,
+        actions: pd.DataFrame,
+        grid: ScenarioGrid,
+        *,
+        home_team_id: Any = None,
+        deadline_ms: Optional[float] = None,
+        context: Optional[RequestContext] = None,
+    ) -> Future:
+        """Value every perturbation of one match in ONE fused dispatch.
+
+        The counterfactual verb: ``actions`` is a single game's SPADL
+        frame (same contract as :meth:`rate`), ``grid`` a
+        :class:`~socceraction_tpu.scenario.grid.ScenarioGrid` of ``P``
+        alternatives per action. The future resolves to a
+        ``(P, len(actions), 3)`` float array — perturbation ``p``'s rows
+        align with ``actions``' row order and carry the usual
+        ``offensive/defensive/vaep`` triplet; row ``p`` is exactly what
+        :meth:`rate` would return for the frame with perturbation ``p``
+        applied (bitwise on CPU, pinned by test).
+
+        ``P`` is snapped to its own power-of-two bucket
+        (:func:`~socceraction_tpu.scenario.engine.bucket_perturbations`,
+        edge-padded grid, result sliced back), so 1/64/4096-perturbation
+        traffic each hits one compiled plateau — and because the folded
+        dispatch is *the same program* as a ``P_bucket``-game rate flush,
+        field-update grids reuse the serving rungs' compiled programs,
+        warmup and AOT artifacts verbatim (custom dense-override grids
+        compile their own signature once per bucket). Admission control,
+        deadlines, SLO scoring (kind ``'scenario'``), the per-lane
+        circuit breaker (fallback: the looped materialized reference —
+        correct, slow) and the flight recorder all apply exactly as for
+        :meth:`rate`; metrics land under the ``scenario`` area with
+        ``n_perturbations_bucket`` labels.
+        """
+        if len(actions) == 0:
+            raise ValueError('cannot rate scenarios for an empty actions frame')
+        self._check_admission('scenario')
+        if not isinstance(grid, ScenarioGrid):
+            raise TypeError(
+                'rate_scenarios needs a ScenarioGrid (build one with '
+                'end_location_grid / action_type_sweep / custom_grid)'
+            )
+        P = grid.n_perturbations
+        if P > self.max_perturbations:
+            raise ValueError(
+                f'{P} perturbations exceed the scenario ladder '
+                f'(max_perturbations={self.max_perturbations})'
+            )
+        if 'game_id' in actions.columns and actions['game_id'].nunique() > 1:
+            raise ValueError(
+                'one request rates one match; split multi-game frames '
+                '(or use rate_scenarios_batch for offline grids)'
+            )
+        if home_team_id is None:
+            if 'home_team_id' not in actions.columns:
+                raise ValueError('home_team_id is required')
+            home_team_id = actions['home_team_id'].iloc[0]
+        if len(actions) > self.max_actions:
+            raise ValueError(
+                f'{len(actions)} actions exceed the service window '
+                f'(max_actions={self.max_actions})'
+            )
+        frame = actions
+        if 'game_id' not in frame.columns:
+            frame = frame.assign(game_id=0)
+        staging, _ids = pack_actions(
+            frame, home_team_id=home_team_id, max_actions=self.max_actions,
+            as_numpy=True,
+        )
+        # fail malformed grids HERE, on the caller's thread, with the
+        # model's named validation errors — not on the flusher
+        for name, upd in grid.field_updates.items():
+            if upd.ndim == 3 and upd.shape[1:] != (1, self.max_actions):
+                raise ValueError(
+                    f'field update {name!r} has shape {upd.shape}; per-action '
+                    f'updates must be (P, 1, max_actions) = '
+                    f'({P}, 1, {self.max_actions}) for this service'
+                )
+        model = self.model
+        for name, block in grid.dense_overrides.items():
+            model._validate_dense_overrides(staging, {name: block[0]})
+        gs = (
+            self._frame_goalscore(frame, home_team_id)
+            if self._gs_enabled
+            else None
+        )
+        if context is not None:
+            ctx = context
+        else:
+            ctx = new_request_context(
+                'scenario',
+                deadline_ms=(
+                    deadline_ms if deadline_ms is not None
+                    else self.request_deadline_ms
+                ),
+            )
+        counter('scenario/requests', unit='count').inc(1, verb='serve')
+        payload = _ScenarioPayload(staging, gs, grid, actions.index, ctx)
+        return self._submit(payload, 'scenario', ctx)
+
+    def rate_scenarios_sync(
+        self,
+        actions: pd.DataFrame,
+        grid: ScenarioGrid,
+        *,
+        home_team_id: Any = None,
+        timeout: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`rate_scenarios`."""
+        return self.rate_scenarios(
+            actions, grid, home_team_id=home_team_id, deadline_ms=deadline_ms
+        ).result(timeout)
+
     def open_session(self, match_id: Any, *, home_team_id: Any) -> MatchSession:
         """Start a live-match streaming session (see :class:`MatchSession`)."""
         names = set(self.model._kernel_names())
@@ -807,6 +961,7 @@ class RatingService:
         model: Any,
         bucket: int,
         lane: int = 0,
+        extra_overrides: Optional[Dict[str, np.ndarray]] = None,
     ) -> np.ndarray:
         """Pad to the bucket, dispatch on ``lane``'s device, fetch to host.
 
@@ -817,6 +972,14 @@ class RatingService:
         same program (bitwise-pinned by the parity tests). Shape
         accounting is per replica: each lane compiles its own ladder,
         and the trace counters must plateau per replica.
+
+        ``extra_overrides`` carries a scenario grid's custom dense
+        blocks (already expanded to ``(bucket, A, width)``). The replica
+        dispatcher's wire protocol only ships the goalscore block, so a
+        dispatch WITH extra overrides runs locally on the lane's default
+        path even on a fan-out service — the rare custom-grid case
+        degrades to local dispatch rather than growing the mesh wire
+        format.
         """
         import jax
         import jax.numpy as jnp
@@ -834,17 +997,23 @@ class RatingService:
             )
             gauge('serve/compiled_shapes', unit='shapes').set(n_shapes)
         fault_point('serve.dispatch', bucket=bucket)
-        if self.n_replicas > 1:
+        if self.n_replicas > 1 and not extra_overrides:
             return self._dispatcher_for(model).rate_replica(
                 lane, host_batch, gs if self._gs_enabled else None
             )
         batch = jax.device_put(host_batch)
-        overrides = (
-            {'goalscore': jnp.asarray(gs)}
-            if self._gs_enabled and gs is not None
-            else None
+        overrides: Dict[str, Any] = {}
+        if self._gs_enabled and gs is not None:
+            overrides['goalscore'] = jnp.asarray(gs)
+        if extra_overrides:
+            # custom scenario dense-override blocks: same program shape
+            # discipline, their own compiled signature per bucket
+            overrides.update(
+                {k: jnp.asarray(v) for k, v in extra_overrides.items()}
+            )
+        values = model.rate_batch(
+            batch, dense_overrides=overrides or None, bucket=False
         )
-        values = model.rate_batch(batch, dense_overrides=overrides, bucket=False)
         return np.asarray(jax.device_get(values))
 
     def _reference_rate(
@@ -930,6 +1099,180 @@ class RatingService:
         return values, 'fused'
 
     def _flush(
+        self, payloads: List[Any], bucket: int, *, lane: int = 0
+    ) -> List[Any]:
+        """The batcher's runner: route a take to its dispatch shape(s).
+
+        Plain rate/session payloads coalesce into one bucket-padded
+        dispatch (:meth:`_flush_rate`, the classic path — byte for byte
+        when no scenario traffic is queued). Scenario payloads fold
+        their perturbation axis into the game axis at their OWN bucket,
+        so each dispatches as its own flush (:meth:`_flush_scenario`);
+        a mixed take is partitioned and results are reassembled in
+        payload order.
+        """
+        if not any(isinstance(p, _ScenarioPayload) for p in payloads):
+            return self._flush_rate(payloads, bucket, lane=lane)
+        plain = [p for p in payloads if not isinstance(p, _ScenarioPayload)]
+        results: Dict[int, Any] = {}
+        if plain:
+            plain_bucket = self._batcher.bucket_for(len(plain))
+            for p, r in zip(
+                plain, self._flush_rate(plain, plain_bucket, lane=lane)
+            ):
+                results[id(p)] = r
+        for p in payloads:
+            if isinstance(p, _ScenarioPayload):
+                results[id(p)] = self._flush_scenario(p, lane=lane)
+        return [results[id(p)] for p in payloads]
+
+    def _flush_scenario(
+        self, p: '_ScenarioPayload', *, lane: int = 0
+    ) -> np.ndarray:
+        """One scenario request -> ``(P, n_rows, 3)``, ONE fused dispatch.
+
+        The perturbation count snaps to its power-of-two bucket
+        (edge-padded grid, sliced back), the grid expands to a
+        ``(P_bucket, A)`` staging batch, and the dispatch goes through
+        the lane's breaker exactly like a rate flush — a field-update
+        grid at bucket ``b`` runs the SAME compiled program as a
+        ``b``-game rate flush, so scenario rungs share warmup, the
+        compile cache and AOT artifacts with the serving ladder.
+        """
+        _name, _version, model = self._active()  # ONE read per flush
+        t0 = time.perf_counter()
+        P = p.grid.n_perturbations
+        p_bucket = bucket_perturbations(P)
+        grid = pad_perturbations(p.grid, p_bucket)
+        expanded, extra = expand_scenarios(p.staging, grid)
+        if 'goalscore' in extra:
+            # a grid that perturbs goalscore overrides the service's
+            # factual block — one source per dense name, grid wins
+            gs_full: Optional[np.ndarray] = extra.pop('goalscore')
+        elif self._gs_enabled and p.gs is not None:
+            gs_full = np.tile(p.gs, (p_bucket, 1, 1))
+        else:
+            gs_full = None
+        bucket_label = str(p_bucket)
+        with self._shape_lock:
+            new_bucket = p_bucket not in self._seen_scenario_buckets
+            if new_bucket:
+                self._seen_scenario_buckets.add(p_bucket)
+        if new_bucket:
+            counter('scenario/shape_traces', unit='count').inc(
+                1, n_perturbations_bucket=bucket_label
+            )
+        t_pad = time.perf_counter()
+        values, path = self._rate_scenarios_with_breaker(
+            p, expanded, gs_full, extra or None, model, p_bucket, lane
+        )
+        t_dispatch = time.perf_counter()
+        dispatch_s = t_dispatch - t_pad
+        if path == 'fused':
+            # the scenario dispatch runs the pair program at the
+            # perturbation bucket: feed the live roofline like any
+            # other fused flush
+            record_dispatch('pair_probs', dispatch_s, bucket=p_bucket)
+            counter('scenario/dispatches', unit='count').inc(
+                1, n_perturbations_bucket=bucket_label
+            )
+        else:
+            counter('scenario/fallbacks', unit='count').inc(1)
+        self._drain_numeric_guards()
+        rows = np.stack(
+            [unpack_values(values[q : q + 1], p.staging) for q in range(P)]
+        )
+        t_slice = time.perf_counter()
+        histogram('scenario/dispatch_seconds', unit='s').observe(
+            dispatch_s, n_perturbations_bucket=bucket_label
+        )
+        n_values = P * rows.shape[1]
+        counter('scenario/values', unit='values').inc(n_values)
+        if dispatch_s > 0:
+            gauge('scenario/values_per_sec', unit='values/s').set(
+                n_values / dispatch_s, n_perturbations_bucket=bucket_label
+            )
+        exemplar = p.ctx.request_id if p.ctx is not None else None
+        replica_kw = self._replica_kw(lane)
+        pad_s = t_pad - t0
+        slice_s = t_slice - t_dispatch
+        record_segment('pad', pad_s, exemplar, **replica_kw)
+        record_segment('dispatch', dispatch_s, exemplar, **replica_kw)
+        record_segment('slice', slice_s, exemplar, **replica_kw)
+        if p.ctx is not None:
+            p.ctx.segments.update(
+                pad=pad_s, dispatch=dispatch_s, slice=slice_s
+            )
+        return rows
+
+    def _rate_scenarios_with_breaker(
+        self,
+        p: '_ScenarioPayload',
+        expanded: ActionBatch,
+        gs_full: Optional[np.ndarray],
+        extra: Optional[Dict[str, np.ndarray]],
+        model: Any,
+        p_bucket: int,
+        lane: int,
+    ) -> Tuple[np.ndarray, str]:
+        """The scenario dispatch through its lane's breaker; (values, path).
+
+        Same contract as :meth:`_rate_with_breaker` — ``'fused'`` means
+        the one-dispatch expanded batch served, ``'fallback'`` means the
+        looped materialized reference
+        (:func:`~socceraction_tpu.scenario.engine.rate_scenarios_reference`
+        over the UNPADDED grid: ``P`` slow-but-correct dispatches,
+        counted against the same breaker state as rate flushes so a sick
+        device degrades every verb on the lane together).
+        """
+
+        def fallback() -> np.ndarray:
+            counter('serve/fallback_flushes', unit='count').inc(
+                1, **self._replica_kw(lane)
+            )
+            overrides = (
+                {'goalscore': p.gs}
+                if self._gs_enabled and p.gs is not None
+                and 'goalscore' not in p.grid.dense_overrides
+                else None
+            )
+            ref = rate_scenarios_reference(
+                model, p.staging, p.grid, dense_overrides=overrides
+            )
+            return ref.reshape(ref.shape[0], *ref.shape[2:])
+
+        breaker = self._breakers[lane]
+        if breaker is None:
+            return (
+                self._device_rate(
+                    expanded, gs_full, model, p_bucket, lane,
+                    extra_overrides=extra,
+                ),
+                'fused',
+            )
+        if breaker.allow() == 'open':
+            return fallback(), 'fallback'
+        try:
+            values = self._device_rate(
+                expanded, gs_full, model, p_bucket, lane,
+                extra_overrides=extra,
+            )
+        except Exception as e:
+            tripped = breaker.record_failure(e)
+            if tripped:
+                self._maybe_dump(
+                    'breaker_open',
+                    {
+                        'type': 'breaker_open',
+                        'error': f'{type(e).__name__}: {e}',
+                        'breaker': breaker.to_dict(),
+                    },
+                )
+            return fallback(), 'fallback'
+        breaker.record_success()
+        return values, 'fused'
+
+    def _flush_rate(
         self, payloads: List[_Payload], bucket: int, *, lane: int = 0
     ) -> List[Any]:
         _name, _version, model = self._active()  # ONE read per flush
@@ -1380,8 +1723,21 @@ class RatingService:
             return self._aot_state
         return self._load_aot_for(name, version, model)
 
-    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> Tuple[int, ...]:
+    def warmup(
+        self,
+        buckets: Optional[Tuple[int, ...]] = None,
+        *,
+        scenario_buckets: Optional[Tuple[int, ...]] = None,
+    ) -> Tuple[int, ...]:
         """Warm the bucket ladder: deserialize > cached compile > compile.
+
+        ``scenario_buckets`` unions extra perturbation-bucket rungs into
+        the warm set: a scenario dispatch at bucket ``b`` runs the SAME
+        program as a ``b``-game rate flush, so warming (or
+        AOT-exporting) a ladder that includes the scenario buckets —
+        e.g. ``service.scenario_ladder`` or just ``(64, 4096)`` — makes
+        steady-state scenario traffic retrace-free with no scenario-
+        specific compile machinery at all.
 
         Three tiers, best available first (the cold-start ladder the
         serving runbook is written around):
@@ -1405,6 +1761,10 @@ class RatingService:
         ``serve_throughput`` bench). Returns the buckets warmed.
         """
         buckets = tuple(buckets) if buckets is not None else self._batcher.ladder
+        if scenario_buckets:
+            buckets = tuple(
+                sorted(set(buckets) | {int(b) for b in scenario_buckets})
+            )
         name, version, model = self._active()
         from .aot import enable_compile_cache
 
@@ -1464,6 +1824,19 @@ class RatingService:
     def ladder(self) -> Tuple[int, ...]:
         """The bucket ladder (compiled-shape budget) of this service."""
         return self._batcher.ladder
+
+    @property
+    def scenario_ladder(self) -> Tuple[int, ...]:
+        """The scenario verb's perturbation bucket ladder.
+
+        ``(1, 2, 4, ..., max_perturbations)`` — every rung a scenario
+        request's ``P`` can snap to. Each rung is the same compiled
+        program as a rate flush of that many games, so
+        ``warmup(scenario_buckets=service.scenario_ladder)`` (or an AOT
+        export whose ladder includes these rungs) covers the verb
+        end to end.
+        """
+        return perturbation_ladder(self.max_perturbations)
 
     @property
     def compiled_shapes(self) -> int:

@@ -816,6 +816,64 @@ class VAEP:
         except Exception:
             return False
 
+    def _dense_override_widths(self, batch: ActionBatch) -> Dict[str, int]:
+        """``{kernel name: column width}`` of the overridable dense blocks.
+
+        Derived from the training layout once per (feature set, k,
+        registry) and cached on the model — validation must not pay an
+        ``eval_shape`` walk per rating call.
+        """
+        key = (
+            tuple(self._kernel_names()),
+            self.nb_prev_actions,
+            self._fused_registry,
+        )
+        cached = getattr(self, '_dense_widths_cache', None)
+        if cached is None or cached[0] != key:
+            from ..ops.fused import train_layout
+
+            layout = train_layout(
+                batch, names=self._kernel_names(), k=self.nb_prev_actions,
+                registry_name=self._fused_registry,
+            )
+            widths = {
+                sp[0]: int(sp[3]) for sp in layout.spans if sp[1] == 'dense'
+            }
+            cached = (key, widths)
+            self._dense_widths_cache = cached
+        return cached[1]
+
+    def _validate_dense_overrides(
+        self, batch: ActionBatch, dense_overrides
+    ) -> None:
+        """Fail fast — by name, before any padding or dispatch.
+
+        A wrong override key or a wrong ``(G, A, width)`` block would
+        otherwise surface as a broadcast/XLA shape error deep inside the
+        fused fold, far from the caller. Both rating paths call this
+        up front against the *unpadded* batch, so the error names the
+        shapes the caller actually passed.
+        """
+        if not dense_overrides:
+            return
+        widths = self._dense_override_widths(batch)
+        G, A = batch.n_games, batch.max_actions
+        for name, block in dense_overrides.items():
+            if name not in widths:
+                raise ValueError(
+                    f'dense override {name!r} is not a dense feature block '
+                    f'of this model (one-hot blocks cannot be overridden); '
+                    f'overridable blocks: {sorted(widths)}'
+                )
+            shape = tuple(np.shape(block))
+            expected = (G, A, widths[name])
+            if shape != expected:
+                raise ValueError(
+                    f'dense override {name!r} has shape {shape}, expected '
+                    f'(n_games, max_actions, width) = {expected} for this '
+                    f'batch and model'
+                )
+
     def _apply_dense_overrides(
         self, batch: ActionBatch, feats: jax.Array, dense_overrides
     ) -> jax.Array:
@@ -896,6 +954,7 @@ class VAEP:
         """
         if not self._models:
             raise NotFittedError('fit the model before calling rate')
+        self._validate_dense_overrides(batch, dense_overrides)
         from ..ops.profile import preferred_rating_path
 
         path = preferred_rating_path()
@@ -990,6 +1049,7 @@ class VAEP:
         """
         if not self._models:
             raise NotFittedError('fit the model before calling rate')
+        self._validate_dense_overrides(batch, dense_overrides)
         feats = self.compute_features_batch(batch)
         if dense_overrides:
             feats = self._apply_dense_overrides(batch, feats, dense_overrides)

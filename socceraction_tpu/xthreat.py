@@ -94,6 +94,15 @@ def _count(x: np.ndarray, y: np.ndarray, l: int = N, w: int = M) -> np.ndarray:
     return np.bincount(flat, minlength=w * l).astype(np.float64).reshape(w, l)
 
 
+def _preview_keys(keys: Any, limit: int = 8) -> str:
+    """A bounded, readable preview of a grouped fit's key set for errors."""
+    items = list(keys)
+    shown = ', '.join(repr(k) for k in items[:limit])
+    if len(items) > limit:
+        shown += f', ... ({len(items) - limit} more)'
+    return f'[{shown}]'
+
+
 def _safe_divide(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.divide(a, b, out=np.zeros_like(a, dtype=np.float64), where=b != 0)
 
@@ -808,8 +817,10 @@ class ExpectedThreat:
             group_by = self.group_by_
         if group_by is None:
             raise ValueError(
-                'this model was grouped by a per-action array; pass '
-                'group_by= to rate'
+                'this model was grouped by a per-action array, so rate() '
+                'cannot look the keys up in a frame column; pass group_by= '
+                '(a column name or a per-action key array) to rate. Fitted '
+                f'group keys: {_preview_keys(self.group_keys_)}'
             )
         if not isinstance(actions, pd.DataFrame):
             raise ValueError('rating a grouped model requires a DataFrame')
@@ -871,7 +882,12 @@ class ExpectedThreat:
             raise NotFittedError('fit the model with group_by= first')
         idx = pd.Index(self.group_keys_).get_indexer([key])[0]
         if idx < 0:
-            raise KeyError(key)
+            raise KeyError(
+                f'{key!r} is not a fitted group key; this fit has '
+                f'{len(self.group_keys_)} keys: '
+                f'{_preview_keys(self.group_keys_)} (rate() maps unseen '
+                'keys to NaN instead of raising)'
+            )
         return self.grids_[idx]
 
     def surfaces(self) -> dict:
@@ -900,7 +916,12 @@ class ExpectedThreat:
         if self.grids_ is not None:
             return self._rate_grouped(actions, use_interpolation, group_by)
         if group_by is not None:
-            raise ValueError('group_by rating requires a group_by fit')
+            raise ValueError(
+                'group_by rating requires a group_by fit: this model was '
+                'fit as a single surface; refit with '
+                'fit(actions, group_by=<column or per-action array>) to '
+                'rate per group'
+            )
         if not np.any(self.xT):
             raise NotFittedError('fit the model before calling rate')
 

@@ -177,6 +177,22 @@ def test_fleet_area_and_labels_are_registered():
         ReplicaRegistry().register('x' * 80)
 
 
+def test_scenario_area_and_labels_are_registered():
+    """The counterfactual engine's metric area (``scenario/*``) and its
+    label contract are governed by the lint gate from day one (ISSUE 18
+    satellite): ``n_perturbations_bucket`` follows the same
+    power-of-two cardinality law as ``xt``'s ``n_grids`` — the bucketing
+    helper must emit exactly the ladder values."""
+    tool = _tool()
+    assert 'scenario' in tool.KNOWN_AREAS
+    assert tool.KNOWN_LABELS['scenario'] == {'verb', 'n_perturbations_bucket'}
+    from socceraction_tpu.scenario import bucket_perturbations
+
+    assert [
+        bucket_perturbations(n) for n in (1, 2, 3, 64, 65, 4095, 4096)
+    ] == [1, 2, 4, 64, 128, 4096, 4096]
+
+
 def test_gate_reports_all_violations_per_site(tmp_path):
     """One site breaking several rules surfaces every violation in one
     run — not one per fix-and-rerun cycle (ISSUE 8 satellite)."""

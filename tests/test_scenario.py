@@ -1,0 +1,552 @@
+"""Tests for the counterfactual scenario engine (ops tier).
+
+The ISSUE-18 contract, library side: a :class:`ScenarioGrid` of ``P``
+perturbations folded into the game axis and valued by ONE fused
+``rate_batch`` dispatch — bitwise equal on CPU to ``P`` looped
+per-perturbation calls, across pad shapes and every ``(quantize,
+kernel)`` serving combo; dense-override grids through the same fold;
+the grid builders' geometry/validation/wire contracts; the product
+helpers (decision surfaces, pass-option rankings); the grouped xT
+scenario fleet elementwise-equal to per-scenario single fits; and the
+satellite pins — upfront named ``dense_overrides`` validation on BOTH
+rating paths, and the xthreat grouped-model error messages that name
+the fitted keys (plus the all-unseen-keys NaN path that never touches
+the interpolator).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu import xthreat as xt
+from socceraction_tpu.core.batch import pack_actions
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.ops import gather_matmul as gm
+from socceraction_tpu.ops import quant as Q
+from socceraction_tpu.ops import xt as _xtops
+from socceraction_tpu.scenario import (
+    ScenarioGrid,
+    action_type_sweep,
+    bucket_perturbations,
+    custom_grid,
+    decision_surface,
+    end_location_grid,
+    expand_scenarios,
+    pad_perturbations,
+    pass_option_ranking,
+    perturbation_ladder,
+    rate_scenarios_batch,
+    rate_scenarios_looped,
+    rate_scenarios_reference,
+    xt_scenario_fleet,
+)
+from socceraction_tpu.spadl import config as spadlconfig
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 256
+
+COMBOS = tuple(
+    (quantize, kernel)
+    for quantize in Q.QUANTIZE_MODES
+    for kernel in ('xla', 'pallas')
+)
+
+
+@pytest.fixture(scope='module', autouse=True)
+def _drain_pair_probs_storm_window():
+    """Retire this module's scenario-shape compiles from the storm
+    window (same rationale as tests/test_quant.py): the pad-shape and
+    combo sweeps compile several expanded game-axis shapes."""
+    yield
+    from socceraction_tpu.ops.fused import _pair_probs, _pair_probs_prepared
+
+    for fn in (_pair_probs, _pair_probs_prepared):
+        fn.drain_storm_window()
+
+
+def _fit_model():
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=200)
+        for i in (0, 1)
+    ]
+    model = VAEP()
+    X, y = [], []
+    for i, f in zip((0, 1), frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True),
+        pd.concat(y, ignore_index=True),
+        learner='mlp',
+        tree_params={'hidden': (16,), 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+def _batch(n_games=1, n_actions=120, max_actions=MAX_ACTIONS, seed0=40):
+    frames = [
+        synthetic_actions_frame(
+            game_id=seed0 + i, seed=seed0 + i, n_actions=n_actions
+        )
+        for i in range(n_games)
+    ]
+    frame = pd.concat(frames, ignore_index=True)
+    batch, _ids = pack_actions(
+        frame,
+        {seed0 + i: HOME for i in range(n_games)},
+        max_actions=max_actions,
+        as_numpy=True,
+    )
+    return batch
+
+
+# ------------------------------------------------ fused vs looped ----
+
+
+@pytest.mark.parametrize(
+    'n_games,n_actions,max_actions',
+    [
+        (1, 50, 64),
+        (1, 120, MAX_ACTIONS),
+        (2, 200, MAX_ACTIONS),
+        (3, 37, 128),
+    ],
+)
+def test_fused_matches_looped_bitwise_across_pad_shapes(
+    model, n_games, n_actions, max_actions
+):
+    """The headline parity: one folded dispatch == P looped rate_batch
+    calls, bit for bit on CPU, regardless of game count and pad shape."""
+    batch = _batch(n_games, n_actions, max_actions)
+    for grid in (
+        end_location_grid(
+            nx=4,
+            ny=3,
+            pitch_length=spadlconfig.field_length,
+            pitch_width=spadlconfig.field_width,
+        ),
+        action_type_sweep(type_ids=[0, 1, 2, 11, 21]),
+    ):
+        P = grid.n_perturbations
+        fused = rate_scenarios_batch(model, batch, grid, bucket=False)
+        looped = rate_scenarios_looped(model, batch, grid, bucket=False)
+        assert fused.shape == (P, n_games, max_actions, 3)
+        np.testing.assert_array_equal(fused, looped)
+
+
+def test_fused_matches_looped_with_bucketing(model):
+    """Parity holds through the power-of-two game-axis bucketing too
+    (the expanded P*G axis snaps to a different rung than G does)."""
+    batch = _batch(1, 80, 128)
+    grid = action_type_sweep(type_ids=[0, 1, 2])
+    np.testing.assert_array_equal(
+        rate_scenarios_batch(model, batch, grid, bucket=True),
+        rate_scenarios_looped(model, batch, grid, bucket=True),
+    )
+
+
+def test_fused_matches_materialized_reference(model):
+    """The deepest oracle: the fused fold over the grid stays within the
+    f32 fused-vs-materialized band of the looped reference path."""
+    batch = _batch(1, 60, 64)
+    grid = end_location_grid(
+        nx=3,
+        ny=2,
+        pitch_length=spadlconfig.field_length,
+        pitch_width=spadlconfig.field_width,
+    )
+    fused = rate_scenarios_batch(model, batch, grid, bucket=False)
+    ref = rate_scenarios_reference(model, batch, grid)
+    mask = np.asarray(batch.mask)[None, ..., None]
+    assert np.max(np.abs(np.where(mask, fused - ref, 0.0))) <= 1e-4
+
+
+@pytest.mark.parametrize('quantize,kernel', COMBOS)
+def test_parity_per_quantize_kernel_combo(model, quantize, kernel):
+    """Every (quantize, kernel) serving combo preserves the fold's
+    bitwise parity: quantization changes the numbers, never the
+    fused-vs-looped agreement (both paths run the same tables)."""
+    batch = _batch(1, 90, 128)
+    grid = action_type_sweep(type_ids=[0, 1, 11])
+    model.set_quantize(quantize)
+    os.environ[gm._ENV] = kernel
+    try:
+        fused = rate_scenarios_batch(model, batch, grid, bucket=False)
+        looped = rate_scenarios_looped(model, batch, grid, bucket=False)
+    finally:
+        del os.environ[gm._ENV]
+        model.set_quantize('none')
+    np.testing.assert_array_equal(fused, looped)
+
+
+def test_dense_override_grid_parity(model):
+    """A grid perturbing a dense feature block (not an action field)
+    rides the same fold: per-perturbation (G, A, width) slices equal the
+    one-dispatch (P*G, A, width) block."""
+    batch = _batch(2, 70, 128)
+    widths = model._dense_override_widths(batch)
+    name = 'goalscore' if 'goalscore' in widths else sorted(widths)[0]
+    w = widths[name]
+    P = 3
+    rng = np.random.default_rng(3)
+    block = rng.standard_normal(
+        (P, batch.n_games, batch.max_actions, w)
+    ).astype(np.float32)
+    grid = custom_grid(dense_overrides={name: block})
+    np.testing.assert_array_equal(
+        rate_scenarios_batch(model, batch, grid, bucket=False),
+        rate_scenarios_looped(model, batch, grid, bucket=False),
+    )
+
+
+def test_caller_dense_override_is_tiled_and_conflicts_are_named(model):
+    """A caller-side per-game block (the serving goalscore carry) tiles
+    across perturbations; naming the same block in BOTH grid and caller
+    fails loudly instead of silently preferring one."""
+    batch = _batch(1, 50, 64)
+    widths = model._dense_override_widths(batch)
+    name = 'goalscore' if 'goalscore' in widths else sorted(widths)[0]
+    w = widths[name]
+    per_game = np.random.default_rng(5).standard_normal(
+        (batch.n_games, batch.max_actions, w)
+    ).astype(np.float32)
+    grid = action_type_sweep(type_ids=[0, 1])
+    np.testing.assert_array_equal(
+        rate_scenarios_batch(
+            model, batch, grid, dense_overrides={name: per_game}, bucket=False
+        ),
+        rate_scenarios_looped(
+            model, batch, grid, dense_overrides={name: per_game}, bucket=False
+        ),
+    )
+    both = custom_grid(
+        field_updates={'type_id': [0, 1]},
+        dense_overrides={name: np.tile(per_game, (2, 1, 1, 1))},
+    )
+    with pytest.raises(ValueError, match='both by the grid and the caller'):
+        expand_scenarios(batch, both, dense_overrides={name: per_game})
+
+
+# ------------------------------------------------ grids and ladder ----
+
+
+def test_perturbation_ladder_and_bucketing():
+    assert perturbation_ladder(4096) == (
+        1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+    )
+    assert [bucket_perturbations(n) for n in (1, 2, 3, 64, 65, 4096)] == [
+        1, 2, 4, 64, 128, 4096,
+    ]
+
+
+def test_end_location_grid_geometry():
+    grid = end_location_grid(nx=4, ny=3, pitch_length=105.0, pitch_width=68.0)
+    assert grid.n_perturbations == 12
+    xs, ys = grid.meta['xs'], grid.meta['ys']
+    assert len(xs) == 4 and len(ys) == 3
+    # cell centers, not edges: first center is half a cell in
+    assert xs[0] == pytest.approx(105.0 / 4 / 2)
+    assert ys[0] == pytest.approx(68.0 / 3 / 2)
+    # perturbation p = iy*nx + ix targets (xs[ix], ys[iy])
+    ex, ey = grid.field_updates['end_x'], grid.field_updates['end_y']
+    assert ex.shape == (12,) and ey.shape == (12,)
+    for iy in range(3):
+        for ix in range(4):
+            p = iy * 4 + ix
+            assert ex[p] == pytest.approx(xs[ix])
+            assert ey[p] == pytest.approx(ys[iy])
+
+
+def test_action_type_sweep_defaults_to_full_vocabulary():
+    grid = action_type_sweep()
+    n_types = len(spadlconfig.actiontypes)
+    assert grid.n_perturbations == n_types
+    assert grid.field_updates['type_id'].dtype == np.int32
+    assert list(grid.field_updates['type_id']) == list(range(n_types))
+    assert grid.meta['type_names'] == list(spadlconfig.actiontypes)
+    fixed = action_type_sweep(type_ids=[2, 5], result_id=1, bodypart_id=0)
+    assert fixed.n_perturbations == 2
+    assert list(fixed.field_updates['result_id']) == [1, 1]
+    assert list(fixed.field_updates['bodypart_id']) == [0, 0]
+
+
+def test_grid_validation_errors():
+    with pytest.raises(ValueError, match='not a perturbable action field'):
+        ScenarioGrid(field_updates={'mask': [True]})
+    with pytest.raises(ValueError, match='inconsistent perturbation counts'):
+        ScenarioGrid(field_updates={'end_x': [1.0, 2.0], 'end_y': [1.0]})
+    with pytest.raises(ValueError, match='at least one field update'):
+        ScenarioGrid()
+    with pytest.raises(ValueError, match=r'\(P,\) or \(P, G, A\)'):
+        ScenarioGrid(field_updates={'end_x': np.zeros((2, 3))})
+    with pytest.raises(ValueError, match=r'\(P, G, A, width\)'):
+        ScenarioGrid(dense_overrides={'goalscore': np.zeros((2, 3, 4))})
+    # id fields cast to int32, coordinates to float32
+    g = ScenarioGrid(field_updates={'type_id': [0, 1], 'end_x': [1, 2]})
+    assert g.field_updates['type_id'].dtype == np.int32
+    assert g.field_updates['end_x'].dtype == np.float32
+
+
+def test_expand_scenarios_shape_errors(model):
+    batch = _batch(1, 30, 64)
+    bad = ScenarioGrid(
+        field_updates={'end_x': np.zeros((2, 3, 64), dtype=np.float32)}
+    )
+    with pytest.raises(ValueError, match=r'batch needs \(P, G, A\)'):
+        expand_scenarios(batch, bad)
+    bad_dense = ScenarioGrid(
+        dense_overrides={'goalscore': np.zeros((2, 3, 64, 3))}
+    )
+    with pytest.raises(ValueError, match=r'\(G, A\) ='):
+        expand_scenarios(batch, bad_dense)
+
+
+def test_expand_scenarios_tiles_bookkeeping_and_rewrites_fields():
+    batch = _batch(2, 25, 32)
+    grid = custom_grid(field_updates={'end_x': [10.0, 20.0, 30.0]})
+    expanded, overrides = expand_scenarios(batch, grid)
+    P, G, A = 3, batch.n_games, batch.max_actions
+    assert expanded.n_games == P * G and not overrides
+    # perturbation-major: games [p*G, (p+1)*G) carry perturbation p
+    ex = np.asarray(expanded.end_x).reshape(P, G, A)
+    for p, v in enumerate((10.0, 20.0, 30.0)):
+        assert np.all(ex[p] == np.float32(v))
+    # padding stays padding in every copy
+    np.testing.assert_array_equal(
+        np.asarray(expanded.mask).reshape(P, G, A),
+        np.broadcast_to(np.asarray(batch.mask), (P, G, A)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(expanded.n_actions).reshape(P, G),
+        np.broadcast_to(np.asarray(batch.n_actions), (P, G)),
+    )
+
+
+def test_pad_perturbations_edge_pads():
+    grid = custom_grid(field_updates={'type_id': [3, 5], 'end_x': [1.0, 2.0]})
+    padded = pad_perturbations(grid, 8)
+    assert padded.n_perturbations == 8
+    assert list(padded.field_updates['type_id']) == [3, 5, 5, 5, 5, 5, 5, 5]
+    assert list(padded.field_updates['end_x']) == [1.0, 2.0] + [2.0] * 6
+    assert pad_perturbations(grid, 2) is not padded
+    assert pad_perturbations(grid, 2).n_perturbations == 2
+
+
+def test_grid_wire_round_trip():
+    rng = np.random.default_rng(0)
+    grid = custom_grid(
+        field_updates={
+            'type_id': [0, 21],
+            'end_x': rng.standard_normal((2, 1, 8)).astype(np.float32),
+        },
+        dense_overrides={
+            'goalscore': rng.standard_normal((2, 1, 8, 3)).astype(np.float32)
+        },
+        meta={'builder': 'custom', 'note': 'wire'},
+    )
+    back = ScenarioGrid.from_wire(grid.to_wire())
+    assert back.meta == grid.meta
+    assert set(back.field_updates) == set(grid.field_updates)
+    for k, v in grid.field_updates.items():
+        assert back.field_updates[k].dtype == v.dtype
+        np.testing.assert_array_equal(back.field_updates[k], v)
+    np.testing.assert_array_equal(
+        back.dense_overrides['goalscore'], grid.dense_overrides['goalscore']
+    )
+
+
+# ------------------------------------------------ product helpers ----
+
+
+def test_decision_surface_reshapes_the_grid(model):
+    batch = _batch(1, 40, 64)
+    grid = end_location_grid(
+        nx=4,
+        ny=3,
+        pitch_length=spadlconfig.field_length,
+        pitch_width=spadlconfig.field_width,
+    )
+    values = rate_scenarios_batch(model, batch, grid, bucket=False)
+    surf = decision_surface(values, grid, game=0, action=2)
+    assert surf.shape == (3, 4)
+    # row iy, col ix == perturbation iy*nx + ix's vaep value
+    np.testing.assert_array_equal(
+        surf, values[:, 0, 2, 2].reshape(3, 4)
+    )
+    off = decision_surface(values, grid, game=0, action=2,
+                           column='offensive_value')
+    np.testing.assert_array_equal(off, values[:, 0, 2, 0].reshape(3, 4))
+    with pytest.raises(ValueError, match='end_location_grid'):
+        decision_surface(values[:2], action_type_sweep(type_ids=[0, 1]))
+
+
+def test_pass_option_ranking_orders_and_labels(model):
+    batch = _batch(1, 40, 64)
+    grid = action_type_sweep(type_ids=[0, 1, 2, 11, 21])
+    values = rate_scenarios_batch(model, batch, grid, bucket=False)
+    table = pass_option_ranking(values, grid, game=0, action=5)
+    assert len(table) == 5
+    col = table['vaep_value'].to_numpy()
+    assert np.all(np.diff(col) <= 0)  # descending
+    assert list(table['rank']) == [1, 2, 3, 4, 5]
+    assert set(table['type_id']) == {0, 1, 2, 11, 21}
+    assert table['type_name'].iloc[0] == spadlconfig.actiontypes[
+        int(table['type_id'].iloc[0])
+    ]
+    top2 = pass_option_ranking(values, grid, game=0, action=5, top=2)
+    assert len(top2) == 2
+    pd.testing.assert_frame_equal(top2, table.head(2))
+    with pytest.raises(ValueError, match='shape'):
+        pass_option_ranking(values[:, :, :, :2], grid)
+
+
+# ------------------------------------------------ xT scenario fleet ----
+
+
+@pytest.fixture(scope='module')
+def xt_frame():
+    frames = [
+        synthetic_actions_frame(game_id=2000 + g, n_actions=700, seed=100 + g)
+        for g in range(3)
+    ]
+    return pd.concat(frames, ignore_index=True)
+
+
+def test_xt_fleet_matches_single_fits_elementwise(xt_frame):
+    """One grouped solve over the scenario fleet is elementwise-equal to
+    fitting each scenario frame on its own — with per-grid convergence
+    certificates for every scenario."""
+
+    def flip(frame):
+        out = frame.copy()
+        out['result_id'] = 1 - out['result_id'].clip(0, 1)
+        return out
+
+    scenarios = {
+        'factual': None,
+        'flipped': flip,
+        'short': xt_frame.head(900),
+    }
+    fleet = xt_scenario_fleet(
+        xt_frame, scenarios, l=16, w=12, backend='jax'
+    )
+    assert sorted(fleet.group_keys_.tolist()) == sorted(scenarios)
+    assert fleet.converged_per_grid_.all()
+    assert fleet.grids_.shape == (3, 12, 16)
+    for key, spec in scenarios.items():
+        if callable(spec):
+            frame = spec(xt_frame)
+        elif spec is None:
+            frame = xt_frame
+        else:
+            frame = spec
+        single = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(frame)
+        np.testing.assert_array_equal(
+            np.asarray(fleet.surface(key)), np.asarray(single.xT)
+        )
+
+
+def test_xt_fleet_input_validation(xt_frame):
+    with pytest.raises(ValueError, match='at least one scenario'):
+        xt_scenario_fleet(xt_frame, {})
+    with pytest.raises(ValueError, match='no base actions'):
+        xt_scenario_fleet(None, {'a': lambda f: f})
+    with pytest.raises(ValueError, match='no base'):
+        xt_scenario_fleet(None, {'a': None})
+    tainted = xt_frame.head(10).copy()
+    tainted['__scenario__'] = 'x'
+    with pytest.raises(ValueError, match='must not already carry'):
+        xt_scenario_fleet(xt_frame, {'a': tainted})
+
+
+# --------------------------------- satellite: dense-override guards ----
+
+
+def test_rate_batch_rejects_unknown_dense_override_by_name(model):
+    batch = _batch(1, 30, 64)
+    bad = {'actiontype_onehot': np.zeros((1, 64, 23), dtype=np.float32)}
+    with pytest.raises(ValueError, match='not a dense feature block'):
+        model.rate_batch(batch, dense_overrides=bad)
+    with pytest.raises(ValueError, match='overridable blocks'):
+        model.rate_batch_reference(batch, dense_overrides=bad)
+
+
+def test_rate_batch_rejects_wrong_dense_override_shape(model):
+    batch = _batch(1, 30, 64)
+    widths = model._dense_override_widths(batch)
+    name = 'goalscore' if 'goalscore' in widths else sorted(widths)[0]
+    bad = {name: np.zeros((1, 64, widths[name] + 1), dtype=np.float32)}
+    with pytest.raises(ValueError, match=r'expected \(n_games, max_actions'):
+        model.rate_batch(batch, dense_overrides=bad)
+    with pytest.raises(ValueError, match='has shape'):
+        model.rate_batch_reference(batch, dense_overrides=bad)
+
+
+# --------------------------------- satellite: grouped-xT error paths ----
+
+
+def test_xt_surface_unseen_key_names_the_fitted_keys(xt_frame):
+    model = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        xt_frame, group_by='team_id'
+    )
+    with pytest.raises(KeyError, match='not a fitted group key'):
+        model.surface('no-such-team')
+    try:
+        model.surface('no-such-team')
+    except KeyError as err:
+        msg = str(err)
+        assert str(len(model.group_keys_)) in msg
+        assert str(model.group_keys_[0]) in msg
+        assert 'NaN' in msg  # points at the rate() escape hatch
+
+
+def test_xt_ungrouped_rate_with_group_by_says_refit(xt_frame):
+    single = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(xt_frame)
+    with pytest.raises(ValueError, match='requires a group_by fit'):
+        single.rate(xt_frame, group_by='team_id')
+
+
+def test_xt_array_grouped_rate_requires_explicit_keys(xt_frame):
+    phase = (np.arange(len(xt_frame)) % 3).astype(np.int64)
+    model = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        xt_frame, group_by=phase
+    )
+    with pytest.raises(ValueError, match='per-action array'):
+        model.rate(xt_frame)
+    # the message names the fitted keys so the caller can construct one
+    try:
+        model.rate(xt_frame)
+    except ValueError as err:
+        assert '0' in str(err)
+
+
+def test_xt_all_unseen_keys_rate_nan_without_touching_grids(
+    xt_frame, monkeypatch
+):
+    """A frame whose keys the fit never saw rates all-NaN — and on the
+    interpolated path the early return fires BEFORE any fine-grid
+    upsampling (no 680x1050 fleet materialized for nothing)."""
+    model = xt.ExpectedThreat(l=16, w=12, backend='jax').fit(
+        xt_frame, group_by='team_id'
+    )
+    unseen = np.full(len(xt_frame), -424242, dtype=np.int64)
+
+    def boom(*a, **k):  # pragma: no cover - must never run
+        raise AssertionError('interpolate_grid touched for all-unseen keys')
+
+    monkeypatch.setattr(_xtops, 'interpolate_grid', boom)
+    vals = model.rate(xt_frame, use_interpolation=True, group_by=unseen)
+    assert vals.shape == (len(xt_frame),)
+    assert np.all(np.isnan(vals))

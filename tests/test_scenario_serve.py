@@ -1,0 +1,337 @@
+"""Tests for the counterfactual serving verb (``rate_scenarios``).
+
+The ISSUE-18 contract, serving side: ``RatingService.rate_scenarios``
+values a ``P``-perturbation grid in ONE fused dispatch bitwise equal to
+the looped goalscore-carrying ``rate_batch`` oracle; ``P`` snaps to its
+own power-of-two bucket ladder so warmup (``scenario_buckets=``) makes
+steady-state scenario traffic retrace-free; the per-lane circuit
+breaker degrades the verb onto the looped materialized reference
+(correct, slow) instead of failing; deadlines shed queued scenario
+requests exactly like rate requests; mixed rate+scenario takes
+partition and reassemble in order; the caller thread gets the named
+validation errors (never the flusher); and the frontend ``POST
+/scenarios`` RPC round-trips the grid and the ``(P, n, 3)`` value block
+bit for bit.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from socceraction_tpu.core.batch import pack_actions, unpack_values
+from socceraction_tpu.core.synthetic import synthetic_actions_frame
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.obs.context import DeadlineExceeded
+from socceraction_tpu.scenario import (
+    action_type_sweep,
+    custom_grid,
+    end_location_grid,
+    rate_scenarios_looped,
+)
+from socceraction_tpu.serve import (
+    FrontendClient,
+    FrontendError,
+    RatingService,
+    ServingFrontend,
+)
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 256
+
+
+@pytest.fixture(scope='module', autouse=True)
+def _drain_pair_probs_storm_window():
+    """Retire this module's serving-ladder compiles from the storm
+    window (same rationale as tests/test_quant.py)."""
+    yield
+    from socceraction_tpu.ops.fused import _pair_probs, _pair_probs_prepared
+
+    for fn in (_pair_probs, _pair_probs_prepared):
+        fn.drain_storm_window()
+
+
+def _fit_model():
+    frames = [
+        synthetic_actions_frame(game_id=i, seed=i, n_actions=200)
+        for i in (0, 1)
+    ]
+    model = VAEP()
+    X, y = [], []
+    for i, f in zip((0, 1), frames):
+        game = pd.Series({'game_id': i, 'home_team_id': HOME})
+        X.append(model.compute_features(game, f))
+        y.append(model.compute_labels(game, f))
+    np.random.seed(0)
+    model.fit(
+        pd.concat(X, ignore_index=True),
+        pd.concat(y, ignore_index=True),
+        learner='mlp',
+        tree_params={'hidden': (16,), 'max_epochs': 2},
+    )
+    return model
+
+
+@pytest.fixture(scope='module')
+def model():
+    return _fit_model()
+
+
+def _frame(n_actions=120, game_id=90):
+    return synthetic_actions_frame(
+        game_id=game_id, seed=game_id, n_actions=n_actions
+    )
+
+
+def _looped_oracle(svc, model, frame, grid):
+    """What the serving verb must match: one rate_batch per perturbation
+    over the request's staging batch, carrying the FACTUAL goalscore
+    block (the scenario fold never recomputes score state from the
+    perturbed fields)."""
+    staging, _ = pack_actions(
+        frame, home_team_id=HOME, max_actions=svc.max_actions, as_numpy=True
+    )
+    overrides = (
+        {'goalscore': svc._frame_goalscore(frame, HOME)}
+        if svc._gs_enabled
+        else None
+    )
+    looped = rate_scenarios_looped(
+        model, staging, grid, dense_overrides=overrides, bucket=False
+    )
+    return np.stack(
+        [unpack_values(looped[p], staging) for p in range(looped.shape[0])]
+    )
+
+
+# --------------------------------------------------------- the verb ----
+
+
+def test_rate_scenarios_matches_looped_oracle_bitwise(model):
+    frame = _frame(120)
+    grid = action_type_sweep(type_ids=[0, 1, 2, 11, 21])
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        out = svc.rate_scenarios_sync(frame, grid, home_team_id=HOME)
+    assert out.shape == (5, len(frame), 3)
+    np.testing.assert_array_equal(out, _looped_oracle(svc, model, frame, grid))
+
+
+def test_rate_scenarios_end_location_grid_and_product_flow(model):
+    """The product path end to end: an end-location sweep served, then
+    folded into a heatmap — P=12 snaps to bucket 16 transparently."""
+    from socceraction_tpu.scenario import decision_surface
+
+    frame = _frame(80, game_id=91)
+    grid = end_location_grid(nx=4, ny=3)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        out = svc.rate_scenarios_sync(frame, grid, home_team_id=HOME)
+        np.testing.assert_array_equal(
+            out, _looped_oracle(svc, model, frame, grid)
+        )
+    # the serving verb's (P, n, 3) block folds directly (single game)
+    surf = decision_surface(out, grid, game=0, action=3)
+    assert surf.shape == (3, 4)
+    np.testing.assert_array_equal(surf.ravel(), out[:, 3, 2])
+
+
+def test_scenario_zero_steady_state_retraces_after_warmup(model):
+    """Warming the scenario rungs (same compiled program as a rate flush
+    of that many games) makes scenario traffic compile NOTHING new."""
+    frame = _frame(100, game_id=92)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        max_perturbations=8,
+    ) as svc:
+        assert svc.scenario_ladder == (1, 2, 4, 8)
+        svc.warmup(scenario_buckets=svc.scenario_ladder)
+        shapes = svc.compiled_shapes
+        snap = REGISTRY.snapshot()
+        compiles = sum(
+            snap.value('xla/compiles', fn=fn)
+            for fn in ('pair_probs', 'pair_probs_prepared')
+        )
+        traces_before = snap.value(
+            'scenario/shape_traces', n_perturbations_bucket='8'
+        ) or 0
+        # P=5 and P=7 both snap to the warmed bucket 8; repeats re-use it
+        for _ in range(2):
+            for p_count in (5, 7):
+                grid = action_type_sweep(type_ids=list(range(p_count)))
+                out = svc.rate_scenarios_sync(frame, grid, home_team_id=HOME)
+                assert out.shape == (p_count, len(frame), 3)
+        assert svc.compiled_shapes == shapes
+        snap = REGISTRY.snapshot()
+        assert compiles == sum(
+            snap.value('xla/compiles', fn=fn)
+            for fn in ('pair_probs', 'pair_probs_prepared')
+        )
+        # the whole plateau is ONE scenario shape trace (bucket 8)
+        assert REGISTRY.snapshot().value(
+            'scenario/shape_traces', n_perturbations_bucket='8'
+        ) == traces_before + 1
+
+
+def test_scenario_breaker_fallback_serves_correct_values(model, monkeypatch):
+    """A sick device dispatch degrades the verb onto the looped
+    materialized reference: the future still resolves, values stay in
+    the fused-vs-materialized band, and the fallback is counted."""
+    frame = _frame(60, game_id=93)
+    grid = action_type_sweep(type_ids=[0, 1, 2])
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        fused = svc.rate_scenarios_sync(frame, grid, home_team_id=HOME)
+        fallbacks = REGISTRY.snapshot().value('scenario/fallbacks') or 0
+
+        def boom(*a, **k):
+            raise RuntimeError('injected device failure')
+
+        monkeypatch.setattr(svc, '_device_rate', boom)
+        degraded = svc.rate_scenarios_sync(frame, grid, home_team_id=HOME)
+    assert degraded.shape == fused.shape
+    np.testing.assert_allclose(degraded, fused, atol=1e-4)
+    snap = REGISTRY.snapshot()
+    assert snap.value('scenario/fallbacks') == fallbacks + 1
+
+
+def test_scenario_deadline_shed(model):
+    """A scenario request still queued past its deadline fails with
+    DeadlineExceeded and is never dispatched — same shedding contract
+    as rate requests (it rides the same queue)."""
+    frame = _frame(50, game_id=94)
+    grid = action_type_sweep(type_ids=[0, 1])
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=8, max_wait_ms=200.0
+    ) as svc:
+        svc.warmup()
+        fut = svc.rate_scenarios(frame, grid, home_team_id=HOME, deadline_ms=5)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=60)
+    assert 'queue_wait' in fut.context.segments
+    assert 'dispatch' not in fut.context.segments
+
+
+def test_mixed_flush_partitions_and_reassembles_in_order(model):
+    """One coalesced take mixing rate and scenario payloads: each verb
+    dispatches at its own bucket and every future gets its own result."""
+    rate_frame = _frame(70, game_id=95)
+    scn_frame = _frame(40, game_id=96)
+    grid = action_type_sweep(type_ids=[0, 1, 2])
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=8, max_wait_ms=60.0
+    ) as svc:
+        svc.warmup()
+        rate_ref = svc.rate_sync(rate_frame, home_team_id=HOME, timeout=60)
+        scn_ref = svc.rate_scenarios_sync(scn_frame, grid, home_team_id=HOME)
+        # enqueue within one wait window so they coalesce into one take
+        futs = [
+            svc.rate(rate_frame, home_team_id=HOME),
+            svc.rate_scenarios(scn_frame, grid, home_team_id=HOME),
+            svc.rate(rate_frame, home_team_id=HOME),
+        ]
+        r1, s, r2 = (f.result(timeout=120) for f in futs)
+    np.testing.assert_array_equal(r1.to_numpy(), rate_ref.to_numpy())
+    np.testing.assert_array_equal(r2.to_numpy(), rate_ref.to_numpy())
+    np.testing.assert_array_equal(s, scn_ref)
+
+
+def test_rate_scenarios_caller_thread_validation(model):
+    frame = _frame(30, game_id=97)
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0,
+        max_perturbations=4,
+    ) as svc:
+        with pytest.raises(TypeError, match='ScenarioGrid'):
+            svc.rate_scenarios(frame, {'end_x': [1.0]}, home_team_id=HOME)
+        with pytest.raises(ValueError, match='max_perturbations=4'):
+            svc.rate_scenarios(
+                frame, action_type_sweep(), home_team_id=HOME
+            )
+        with pytest.raises(ValueError, match='empty actions frame'):
+            svc.rate_scenarios(
+                frame.iloc[:0], action_type_sweep(type_ids=[0]),
+                home_team_id=HOME,
+            )
+        multi = pd.concat(
+            [frame, _frame(30, game_id=98)], ignore_index=True
+        )
+        with pytest.raises(ValueError, match='one request rates one match'):
+            svc.rate_scenarios(
+                multi, action_type_sweep(type_ids=[0]), home_team_id=HOME
+            )
+        # a malformed per-action update fails HERE, naming the shape
+        bad_shape = custom_grid(
+            field_updates={'end_x': np.zeros((2, 1, 99), dtype=np.float32)}
+        )
+        with pytest.raises(ValueError, match=r'\(P, 1, max_actions\)'):
+            svc.rate_scenarios(frame, bad_shape, home_team_id=HOME)
+        # a dense block the model can't override fails with the model's
+        # named validation error, not a flusher-side shape blowup
+        bad_dense = custom_grid(
+            dense_overrides={
+                'actiontype_onehot': np.zeros(
+                    (2, 1, MAX_ACTIONS, 23), dtype=np.float32
+                )
+            }
+        )
+        with pytest.raises(ValueError, match='not a dense feature block'):
+            svc.rate_scenarios(frame, bad_dense, home_team_id=HOME)
+
+
+def test_rate_scenarios_validates_max_perturbations_config():
+    with pytest.raises(ValueError, match='max_perturbations'):
+        RatingService(
+            _fit_model(), max_actions=64, max_perturbations=0
+        )
+
+
+# --------------------------------------------------------- frontend ----
+
+
+@pytest.fixture(scope='module')
+def frontend(model, tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp('scn') / 'frontend.sock')
+    with RatingService(
+        model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        with ServingFrontend(svc, unix_path=sock):
+            yield svc, FrontendClient(sock)
+    assert not os.path.exists(sock)
+
+
+def test_frontend_scenario_round_trip_is_bitwise(frontend):
+    svc, client = frontend
+    frame = _frame(90, game_id=99)
+    grid = action_type_sweep(type_ids=[0, 1, 11])
+    ref = svc.rate_scenarios_sync(frame, grid, home_team_id=HOME)
+    out = client.rate_scenarios(frame, grid, home_team_id=HOME)
+    assert out.shape == ref.shape == (3, len(frame), 3)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_frontend_scenario_error_mapping(frontend):
+    _svc, client = frontend
+    frame = _frame(20, game_id=100)
+    bad = custom_grid(
+        dense_overrides={
+            'actiontype_onehot': np.zeros(
+                (2, 1, MAX_ACTIONS, 23), dtype=np.float32
+            )
+        }
+    )
+    with pytest.raises(FrontendError) as err:
+        client.rate_scenarios(frame, bad, home_team_id=HOME)
+    assert err.value.status == 400
+    assert 'dense feature block' in str(err.value)

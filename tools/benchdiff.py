@@ -59,6 +59,10 @@ HEADLINE_KEYS: Tuple[str, ...] = (
     # the capacity observatory's serve headline: AOT cost FLOPs over the
     # measured flush wall (bench.py serve_throughput embeds it)
     'serve_achieved_flops_per_sec',
+    # the counterfactual engine's headline: valued counterfactuals per
+    # second in one folded dispatch (bench.py --cf-smoke; its `value`
+    # duplicates this key)
+    'cf_values_per_sec',
 )
 
 #: Artifact metrics whose headline ``value`` is a WALL or a SIZE, not a

@@ -72,6 +72,7 @@ KNOWN_AREAS = {
     'perf',  # live roofline: achieved FLOPs/bytes + device-idle (obs/perf.py)
     'pipeline',  # store/feed/cache stage timings
     'resil',  # fault injection / retries / breaker / recovery (resil/)
+    'scenario',  # counterfactual engine: one-dispatch grid valuation (scenario/)
     'serve',  # online rating service (batcher/session/registry/service)
     'slo',  # SLO engine: burn rates, budgets, sheds (obs/slo.py)
     'train',  # MLP fit loop + bench training configs
@@ -132,6 +133,12 @@ KNOWN_AREAS = {
 #:   registry.load, recorder.dump, bench.ledger), ``outcome``
 #:   retried|recovered|exhausted|permanent for retries and the
 #:   breaker-probe / recovery verdicts elsewhere — all bounded by code.
+#: - ``scenario``: ``n_perturbations_bucket`` is a grid's perturbation
+#:   count and MUST be bucketed to powers of two
+#:   (``scenario.engine.bucket_perturbations`` — the same ladder law as
+#:   ``xt``'s ``n_grids``): an arbitrary ``P`` would mint a series per
+#:   distinct grid size. ``verb`` is the bounded entry-point set
+#:   (batch|looped|reference|serve).
 #: - ``fleet``: ``replica`` values MUST come from the bounded
 #:   ``obs/wire.py::ReplicaRegistry`` (validated id shape, hard budget,
 #:   default 64 slots) — a replica id is a stable process-slot *name*
@@ -152,6 +159,7 @@ KNOWN_LABELS = {
     'perf': {'fn', 'bucket'},
     'pipeline': {'stage'},
     'resil': {'point', 'kind', 'site', 'outcome'},
+    'scenario': {'verb', 'n_perturbations_bucket'},
     # serve: ``outcome`` is the AOT-tier load verdict (hit|stale|miss,
     # serve/aot_loads — serve/aot.py's three-valued contract).
     # ``replica`` values are lane ids minted through the same bounded
