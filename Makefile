@@ -8,7 +8,7 @@
 
 PY ?= python
 
-.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff cf-smoke learn-smoke obs-smoke chaos-smoke capacity-smoke fleet-smoke mesh-smoke coverage walkthrough-outputs docs docs-check
+.PHONY: check lint compile types test test-all e2e-synthetic bench bench-smoke bench-diff cf-smoke seq-smoke learn-smoke obs-smoke chaos-smoke capacity-smoke fleet-smoke mesh-smoke coverage walkthrough-outputs docs docs-check
 
 check: compile lint types docs-check test
 
@@ -118,6 +118,15 @@ bench-smoke:
 # cf_values_per_sec headline lands in the ledger
 cf-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --cf-smoke
+
+# the sequence head driven end to end on CPU: one-dispatch-per-epoch GRU
+# training through fit_packed(learner='seq') (per-head epoch trace count
+# pinned to 1), then rung-padded serving — mixed window lengths through
+# the warmed (bucket x window-rung) grid with zero steady-state retraces
+# and served values bitwise the direct rate_batch reference; the
+# seq_actions_per_sec headline lands in the ledger
+seq-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --seq-smoke
 
 # regression verdicts between the two newest bench_history/ ledger
 # entries (every bench/smoke artifact is appended there); exits 1 on a

@@ -2734,6 +2734,158 @@ def _cf_smoke() -> None:
     print(json.dumps(artifact))
 
 
+def _bench_seq(
+    *,
+    n_games: int = 6,
+    max_actions: int = 256,
+    epochs: int = 3,
+) -> dict:
+    """Sequence-head valuation: one-dispatch training + rung-padded serving.
+
+    Two sections. ``seq_train_epoch`` fits both GRU heads through
+    ``VAEP.fit_packed(learner='seq')`` and records the per-head
+    epoch-program trace count — the one-dispatch-per-epoch contract the
+    smoke pins — plus the packed-training action rate.  ``seq_rate``
+    serves the fitted model through a ``RatingService`` whose ladder is
+    padded in TIME as well as batch (``core.batch.window_ladder``):
+    after warmup, mixed window lengths (40..~max_actions actions) must
+    dispatch through the warmed (bucket × rung) grid compiling nothing,
+    and the served values must be bitwise the direct ``rate_batch``
+    reference on CPU. The ``seq_actions_per_sec`` headline lands in the
+    ledger for ``tools/benchdiff.py``.
+    """
+    import numpy as np
+
+    from socceraction_tpu.core.batch import (
+        pack_actions,
+        unpack_values,
+        window_ladder,
+    )
+    from socceraction_tpu.core.synthetic import (
+        synthetic_actions_frame,
+        synthetic_batch,
+    )
+    from socceraction_tpu.serve import RatingService
+    from socceraction_tpu.vaep.base import VAEP
+
+    batch = synthetic_batch(n_games=n_games, n_actions=max_actions, seed=900)
+    model = VAEP(nb_prev_actions=3)
+    t0 = time.perf_counter()
+    model.fit_packed(
+        batch,
+        learner='seq',
+        tree_params={
+            'max_epochs': epochs, 'embed_dim': 16, 'hidden': 32,
+            'readout': 32,
+        },
+    )
+    fit_s = time.perf_counter() - t0
+    heads = model._models
+    total_actions = int(np.asarray(batch.n_actions).sum())
+    out: dict = {
+        'n_games': n_games,
+        'max_actions': max_actions,
+        'seq_train_epoch': {
+            'epochs': epochs,
+            'heads': len(heads),
+            'fit_seconds_total': round(fit_s, 4),
+            'seconds_per_epoch': round(fit_s / (epochs * len(heads)), 5),
+            'epoch_traces': {
+                col: int(clf.n_epoch_traces_) for col, clf in heads.items()
+            },
+            'train_actions_per_sec': round(
+                total_actions * epochs * len(heads) / fit_s, 1
+            ),
+        },
+    }
+
+    frames = [
+        synthetic_actions_frame(game_id=910 + i, seed=910 + i, n_actions=n)
+        for i, n in enumerate((40, 120, max_actions - 12, 60, 200))
+    ]
+    with RatingService(
+        model, max_actions=max_actions, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        shapes_after_warm = svc.compiled_shapes
+        probe = frames[2]
+        b1, _ = pack_actions(probe, home_team_id=100, max_actions=max_actions)
+        ref = np.asarray(
+            unpack_values(model.rate_batch(b1, bucket=False), b1)
+        )
+        served = svc.rate_sync(probe, home_team_id=100, timeout=300)
+        vals = served[
+            ['offensive_value', 'defensive_value', 'vaep_value']
+        ].to_numpy()
+        parity_bitwise = bool(np.array_equal(vals, ref))
+        t0 = time.perf_counter()
+        rated = 0
+        for f in frames:
+            svc.rate_sync(f, home_team_id=100, timeout=300)
+            rated += len(f)
+        dt = time.perf_counter() - t0
+        out['seq_rate'] = {
+            'window_rungs': list(window_ladder(max_actions)),
+            'compiled_shapes_after_warmup': shapes_after_warm,
+            'steady_state_retraces': svc.compiled_shapes - shapes_after_warm,
+            'parity_bitwise': parity_bitwise,
+            'rated_actions': rated,
+            'seconds_total': round(dt, 4),
+            'seq_actions_per_sec': round(rated / dt, 1),
+        }
+    return out
+
+
+def _seq_smoke() -> None:
+    """``make seq-smoke``: the sequence head's acceptance gates at CPU scale.
+
+    Drives :func:`_bench_seq` and asserts the structural contracts where
+    they are exact on CPU: every head's epoch program traced ONCE
+    (one-dispatch-per-epoch training), mixed window lengths re-dispatch
+    the warmed (bucket × window-rung) grid compiling NOTHING (zero
+    steady-state retraces — the time-rung ladder owns the compiled-shape
+    count), and the served values are bitwise the direct ``rate_batch``
+    reference. Same clean-CPU re-exec recipe as :func:`_cf_smoke`.
+    """
+    platforms = os.environ.get('JAX_PLATFORMS', '').strip().lower()
+    axon_disabled = os.environ.get('PALLAS_AXON_POOL_IPS', 'unset') == ''
+    if not (platforms == 'cpu' and axon_disabled):
+        here = os.path.dirname(os.path.abspath(__file__))
+        rc = subprocess.call(
+            [sys.executable, os.path.join(here, 'bench.py'), '--seq-smoke'],
+            env=_cpu_env(),
+            cwd=here,
+        )
+        sys.exit(rc)
+    out = _bench_seq(n_games=6, max_actions=256, epochs=3)
+    for col, n in out['seq_train_epoch']['epoch_traces'].items():
+        assert n == 1, (
+            f'head {col!r} traced its epoch program {n} times — seq '
+            'training must be ONE scan dispatch per epoch'
+        )
+    assert out['seq_rate']['steady_state_retraces'] == 0, (
+        f"mixed window lengths compiled "
+        f"{out['seq_rate']['steady_state_retraces']} new program(s) after "
+        'warmup — the window-rung ladder leaked a shape'
+    )
+    assert out['seq_rate']['parity_bitwise'], (
+        'rung-padded serving diverged from the direct rate_batch '
+        'reference on CPU — time slicing is not a pure truncation of '
+        'masked tails'
+    )
+    artifact = {
+        'metric': 'seq_actions_per_sec',
+        'value': out['seq_rate']['seq_actions_per_sec'],
+        'seq_actions_per_sec': out['seq_rate']['seq_actions_per_sec'],
+        'unit': 'actions/sec',
+        'platform': 'cpu',
+        'smoke': True,
+        **out,
+    }
+    _persist_artifact(artifact)
+    print(json.dumps(artifact))
+
+
 def _build_coldstart_registry(root: str) -> None:
     """Fit a small standard-SPADL VAEP and publish it as ``coldstart/1``.
 
@@ -3077,6 +3229,9 @@ def main() -> None:
         return
     if '--cf-smoke' in sys.argv:
         _cf_smoke()
+        return
+    if '--seq-smoke' in sys.argv:
+        _seq_smoke()
         return
     if '--learn-smoke' in sys.argv:
         _learn_smoke()

@@ -9,7 +9,7 @@ handles and the atomic batch packer.
 
 from __future__ import annotations
 
-from typing import List
+from typing import Any, List
 
 from ...core.batch import AtomicActionBatch, pack_atomic_actions
 from ...ops import atomic as _atomicops
@@ -58,6 +58,6 @@ class AtomicVAEP(VAEP):
     def _default_xfns(self) -> List[fs.FeatureTransfomer]:
         return list(xfns_default)
 
-    def _pack(self, game_actions, home_team_id) -> AtomicActionBatch:
+    def _pack(self, game_actions: Any, home_team_id: int) -> AtomicActionBatch:
         batch, _ = pack_atomic_actions(game_actions, home_team_id=home_team_id)
         return batch

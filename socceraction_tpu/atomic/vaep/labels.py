@@ -15,7 +15,7 @@ from ...vaep.labels import _lookahead
 from ..spadl import config as atomicspadl
 
 
-def _goal_masks(actions: pd.DataFrame):
+def _goal_masks(actions: pd.DataFrame) -> tuple[np.ndarray, np.ndarray]:
     goal = (actions['type_id'] == atomicspadl.GOAL).to_numpy()
     owngoal = (actions['type_id'] == atomicspadl.OWNGOAL).to_numpy()
     return goal, owngoal

@@ -42,6 +42,8 @@ __all__ = [
     'pad_length',
     'bucket_games',
     'bucket_ladder',
+    'bucket_window',
+    'window_ladder',
     'pad_batch_games',
 ]
 
@@ -143,17 +145,17 @@ _ATOMIC_INT_COLS = ('type_id', 'bodypart_id', 'period_id')
 
 
 def _pack_frame(
-    actions,
-    home_team_ids,
-    home_team_id,
-    max_actions,
-    float_dtype,
-    device,
-    float_cols,
-    int_cols,
-    make_batch,
-    as_numpy=False,
-):
+    actions: pd.DataFrame,
+    home_team_ids: Any,
+    home_team_id: Optional[Any],
+    max_actions: Optional[int],
+    float_dtype: Any,
+    device: Any,
+    float_cols: Tuple[str, ...],
+    int_cols: Tuple[str, ...],
+    make_batch: Any,
+    as_numpy: bool = False,
+) -> Tuple[Any, Any]:
     """Shared packing core: group by game, left-align, pad, build the batch.
 
     ``make_batch`` is the batch dataclass constructor, called with one
@@ -343,6 +345,43 @@ def bucket_ladder(max_games: int) -> Tuple[int, ...]:
     """
     top = bucket_games(max_games)
     return tuple(1 << i for i in range(top.bit_length()))
+
+
+def bucket_window(n: int, max_actions: int) -> int:
+    """Round a valid-action count up to its window-length rung.
+
+    The time-axis analog of :func:`bucket_games`: serving a sequence head
+    over windows whose action axis tracks the longest live game would
+    retrace once per unique length. Rungs are power-of-two multiples of
+    the 128-wide lane tile (128, 256, 512, ...) capped at ``max_actions``,
+    so the compiled-shape set stays ``O(log2(max_actions / 128))`` and
+    every rung keeps the action axis MXU/VPU tile aligned.
+    """
+    if n < 0:
+        raise ValueError(f'need a non-negative action count, got {n}')
+    if max_actions < 1:
+        raise ValueError(f'need a positive capacity, got {max_actions}')
+    rung = pad_length(max(n, 1))
+    rung = 1 << (rung - 1).bit_length()
+    return min(rung, max_actions)
+
+
+def window_ladder(max_actions: int) -> Tuple[int, ...]:
+    """Every window-length rung up to ``max_actions``, ascending.
+
+    ``max_actions`` itself is always the top rung (it is the capacity the
+    service padded to at pack time, not necessarily a power of two), so a
+    full-capacity window never retraces outside the warmed set.
+    """
+    rungs = []
+    n = 1
+    while True:
+        rung = bucket_window(n, max_actions)
+        rungs.append(rung)
+        if rung >= max_actions:
+            break
+        n = rung + 1
+    return tuple(rungs)
 
 
 def pad_batch_games(batch: Any, n_games: int) -> Any:

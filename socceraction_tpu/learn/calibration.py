@@ -46,7 +46,9 @@ __all__ = [
 _EPS = 1e-12
 
 
-def _flatten(probs: Any, labels: Any, weights: Any):
+def _flatten(
+    probs: Any, labels: Any, weights: Any
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     p = jnp.asarray(probs, jnp.float32).reshape(-1)
     y = jnp.asarray(labels, jnp.float32).reshape(-1)
     if weights is None:
@@ -61,14 +63,18 @@ def _flatten(probs: Any, labels: Any, weights: Any):
     return p, y, w
 
 
-def _binned_sums(p, y, w, n_bins: int):
+def _binned_sums(
+    p: jax.Array, y: jax.Array, w: jax.Array, n_bins: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Weighted per-bin (mass, Σw·p, Σw·y) over equal-width bins."""
     bins = jnp.clip((p * n_bins).astype(jnp.int32), 0, n_bins - 1)
     seg = partial(jax.ops.segment_sum, segment_ids=bins, num_segments=n_bins)
     return seg(w), seg(w * p), seg(w * y)
 
 
-def _point_metrics(p, y, w, n_bins: int):
+def _point_metrics(
+    p: jax.Array, y: jax.Array, w: jax.Array, n_bins: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """(n, ece, brier, reliability, resolution, uncertainty) — one trace."""
     wsum, psum, ysum = _binned_sums(p, y, w, n_bins)
     n = jnp.maximum(jnp.sum(w), _EPS)
@@ -84,7 +90,9 @@ def _point_metrics(p, y, w, n_bins: int):
 
 
 @partial(jax.jit, static_argnames=('n_bins',))
-def _curve_kernel(p, y, w, n_bins: int):
+def _curve_kernel(
+    p: jax.Array, y: jax.Array, w: jax.Array, n_bins: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     wsum, psum, ysum = _binned_sums(p, y, w, n_bins)
     conf = psum / jnp.maximum(wsum, _EPS)
     acc = ysum / jnp.maximum(wsum, _EPS)
@@ -92,7 +100,15 @@ def _curve_kernel(p, y, w, n_bins: int):
 
 
 @partial(jax.jit, static_argnames=('n_bins', 'n_boot'))
-def _summary_kernel(p, y, w, seed, n_bins: int, n_boot: int, ci: float):
+def _summary_kernel(
+    p: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    seed: int,
+    n_bins: int,
+    n_boot: int,
+    ci: float,
+) -> Tuple[jax.Array, ...]:
     n, ece, brier, rel, res, unc = _point_metrics(p, y, w, n_bins)
 
     def one_resample(key):

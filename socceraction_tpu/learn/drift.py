@@ -214,7 +214,7 @@ def _stack_rows(
     return tuple(names), x, w
 
 
-def _weighted_props(xi, w, lo_i, hi_i, n_bins: int):
+def _weighted_props(xi: Any, w: Any, lo_i: Any, hi_i: Any, n_bins: int) -> Any:
     """Masked equal-width bin proportions of one stacked row (traced)."""
     import jax
     import jax.numpy as jnp
@@ -226,7 +226,7 @@ def _weighted_props(xi, w, lo_i, hi_i, n_bins: int):
     return cnt / jnp.maximum(jnp.sum(cnt), _EPS)
 
 
-def _props_kernel(x, w, lo, hi, n_bins: int):
+def _props_kernel(x: Any, w: Any, lo: Any, hi: Any, n_bins: int) -> Any:
     import jax
 
     return jax.vmap(
@@ -235,7 +235,7 @@ def _props_kernel(x, w, lo, hi, n_bins: int):
 
 
 @lru_cache(maxsize=None)
-def _jitted(n_bins: int):
+def _jitted(n_bins: int) -> Tuple[Any, Any]:
     """Jitted (props, drift) kernels for one static bin count."""
     import jax
     import jax.numpy as jnp

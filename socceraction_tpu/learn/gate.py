@@ -106,6 +106,10 @@ class PromotionReport:
     #: the serving parity probe's lifetime stats at gate time
     #: (``ParityProbe.stats()``; empty when no probe is attached)
     parity: Dict[str, Any] = field(default_factory=dict)
+    #: the candidate's per-head architecture (``{head: 'mlp'|'seq'|...}``)
+    #: so operators can tell which model KIND a verdict judged — an mlp
+    #: and a seq candidate pass the same gates but are different programs
+    archs: Dict[str, str] = field(default_factory=dict)
     stage_seconds: Dict[str, float] = field(default_factory=dict)
     time_unix: float = field(default_factory=time.time)
 
@@ -130,6 +134,7 @@ class PromotionReport:
             'replay': dict(self.replay),
             'drift': dict(self.drift),
             'parity': dict(self.parity),
+            'archs': dict(self.archs),
             'stage_seconds': {
                 k: round(v, 6) for k, v in self.stage_seconds.items()
             },

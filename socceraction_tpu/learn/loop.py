@@ -129,6 +129,28 @@ class LearnConfig:
     aot: Optional[Dict[str, Any]] = None
 
 
+def _head_archs(model: Any) -> Dict[str, str]:
+    """Per-head architecture kinds of a VAEP model (``{}`` for ``None``).
+
+    The short names match the checkpoint head-kind vocabulary
+    (``'mlp'``/``'seq'``); anything else — tree learners, test doubles —
+    reports its class name, so the promotion record never loses the
+    information, it just gets less pretty.
+    """
+    from ..ml.mlp import MLPClassifier
+    from ..seq.classifier import SeqClassifier
+
+    kinds: Dict[str, str] = {}
+    for col, head in getattr(model, '_models', {}).items():
+        if isinstance(head, SeqClassifier):
+            kinds[col] = 'seq'
+        elif isinstance(head, MLPClassifier):
+            kinds[col] = 'mlp'
+        else:
+            kinds[col] = type(head).__name__
+    return kinds
+
+
 class ContinuousLearner:
     """Drives the stream → train → shadow-eval → gated hot-swap loop.
 
@@ -640,6 +662,7 @@ class ContinuousLearner:
                     reasons=reasons,
                     active_version=active_version,
                     drift=drift_res.to_dict() if drift_res else {},
+                    archs=_head_archs(active_model),
                     stage_seconds=dict(stage_s),
                 )
                 self._finish(report)
@@ -699,6 +722,7 @@ class ContinuousLearner:
                         candidate_tag=tag,
                         new_games=list(new_ids),
                         drift=drift_res.to_dict() if drift_res else {},
+                        archs=_head_archs(candidate),
                         stage_seconds=dict(stage_s),
                     )
                     self.registry.gc_candidates(
@@ -763,6 +787,7 @@ class ContinuousLearner:
                         candidate_tag=tag,
                         new_games=list(new_ids),
                         drift=drift_res.to_dict() if drift_res else {},
+                        archs=_head_archs(candidate),
                         stage_seconds=dict(stage_s),
                     )
                     self.registry.gc_candidates(
@@ -791,6 +816,7 @@ class ContinuousLearner:
                     active_version=active_version,
                     candidate_tag=tag,
                     new_games=list(new_ids),
+                    archs=_head_archs(candidate),
                     stage_seconds=dict(stage_s),
                 )
                 self.registry.gc_candidates(
@@ -816,6 +842,7 @@ class ContinuousLearner:
                 },
                 drift=drift_res.to_dict() if drift_res else {},
                 parity=parity_stats or {},
+                archs=_head_archs(candidate),
             )
 
             self._journal_append(
@@ -896,9 +923,14 @@ class ContinuousLearner:
         deliberately exclude optimizer state. Transplanting the
         in-process state keeps the next iteration's warm start a true
         optimizer continuation; across process restarts it degrades
-        gracefully to a params-only warm start.
+        gracefully to a params-only warm start. Architecture-checked per
+        head: both packed head kinds (MLP and the seq head) carry adam
+        state, but state only transplants between heads of the SAME
+        class — a cross-architecture promotion starts the next iteration
+        cold, which is also what its warm-start path does.
         """
         from ..ml.mlp import MLPClassifier
+        from ..seq.classifier import SeqClassifier
 
         try:
             active = self.registry.active()[2]
@@ -907,8 +939,8 @@ class ContinuousLearner:
         for col, head in getattr(active, '_models', {}).items():
             cand_head = candidate._models.get(col)
             if (
-                isinstance(head, MLPClassifier)
-                and isinstance(cand_head, MLPClassifier)
+                isinstance(head, (MLPClassifier, SeqClassifier))
+                and type(cand_head) is type(head)
                 and cand_head.opt_state_ is not None
             ):
                 head.opt_state_ = cand_head.opt_state_

@@ -80,13 +80,21 @@ def replay_probs(model: Any, batch: ActionBatch) -> Dict[str, np.ndarray]:
     """Per-head probability tensors ``(G, A)`` of one model on one batch.
 
     Deliberately the *same* path for every model under comparison:
-    materialized features from the device feature kernels, probabilities
-    from each head (device MLPs stay on device; tree heads go through
-    their host predictors). Values on padding rows are garbage by
-    contract — callers mask with ``batch.mask``.
+    each head's own reference representation over one shared batch
+    (device MLPs read the materialized feature tensor, sequence heads
+    read the packed game states, tree heads go through their host
+    predictors). Values on padding rows are garbage by contract —
+    callers mask with ``batch.mask``. The feature tensor is only
+    materialized when some head actually consumes it — an all-sequence
+    model replays straight from the packed representation.
     """
-    feats = model.compute_features_batch(batch)
-    probs = model._estimate_probabilities_batch(feats)
+    from ..seq.classifier import SeqClassifier
+
+    need_feats = any(
+        not isinstance(m, SeqClassifier) for m in model._models.values()
+    )
+    feats = model.compute_features_batch(batch) if need_feats else None
+    probs = model._estimate_probabilities_batch(feats, batch=batch)
     return {col: np.asarray(p) for col, p in probs.items()}
 
 

@@ -174,6 +174,38 @@ def fit_mlp_packed(
     )
 
 
+def fit_seq_packed(
+    batch: Any,
+    y: Any,
+    eval_set: EvalSet = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+    *,
+    names: Any,
+    k: int,
+    registry: str = 'standard',
+    mean: Any = None,
+    std: Any = None,
+) -> Any:
+    """The GRU sequence head trained on packed game states (ISSUE 19).
+
+    Same calling convention as :func:`fit_mlp_packed` — the packed
+    learners are interchangeable behind ``VAEP.fit_packed(learner=...)``
+    — but the head is a
+    :class:`~socceraction_tpu.seq.classifier.SeqClassifier`: an ordered
+    model of the k-action window that can credit defensive / off-ball
+    value the per-state MLP cannot (arXiv 2106.01786).
+    """
+    from ..seq.classifier import SeqClassifier
+
+    model = SeqClassifier(**(tree_params or {}))
+    es = eval_set[0] if eval_set else None
+    return model.fit_packed(
+        batch, y, names=tuple(names), k=k, registry=registry,
+        eval_set=es, mean=mean, std=std, **(fit_params or {}),
+    )
+
+
 LEARNERS: Dict[str, Any] = {
     'xgboost': fit_xgboost,
     'catboost': fit_catboost,
@@ -186,4 +218,5 @@ LEARNERS: Dict[str, Any] = {
 #: (``VAEP.fit_packed``). Trees require the materialized feature matrix.
 PACKED_LEARNERS: Dict[str, Any] = {
     'mlp': fit_mlp_packed,
+    'seq': fit_seq_packed,
 }

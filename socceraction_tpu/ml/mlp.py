@@ -38,7 +38,7 @@ once per epoch — and per-batch losses are normalized by the *real*
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -70,14 +70,16 @@ class _MLP(nn.Module):
     hidden: Sequence[int]
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x: jax.Array) -> jax.Array:
         for h in self.hidden:
             x = nn.Dense(h)(x)
             x = nn.relu(x)
         return nn.Dense(1)(x)[..., 0]  # logits
 
 
-def _weighted_bce(logits, y, w, pos_w):
+def _weighted_bce(
+    logits: jax.Array, y: jax.Array, w: jax.Array, pos_w: jax.Array
+) -> jax.Array:
     """Σ bce·w·posw / Σw — wrapped/padded rows (w=0) contribute nothing."""
     losses = optax.sigmoid_binary_cross_entropy(logits, y)
     weights = w * jnp.where(y > 0.5, pos_w, 1.0)
@@ -105,7 +107,14 @@ class _EpochTrainer:
     ``train_health_``).
     """
 
-    def __init__(self, loss_fn, tx, n: int, batch_size: int, seed: int):
+    def __init__(
+        self,
+        loss_fn: Callable[..., Any],
+        tx: Any,
+        n: int,
+        batch_size: int,
+        seed: int,
+    ) -> None:
         self.n = n
         self.batch_size = min(batch_size, n)
         # ceil so the tail is trained on; the last batch wraps around the
@@ -311,19 +320,19 @@ class MLPClassifier:
             self._std_dev = jnp.asarray(self._std)
         return self._mean_dev, self._std_dev
 
-    def _compute_dtype(self):
+    def _compute_dtype(self) -> Optional[Any]:
         return jnp.dtype(self.train_dtype) if self.train_dtype else None
 
     # -- training ----------------------------------------------------------
 
-    def _init_params(self, n_features: int):
+    def _init_params(self, n_features: int) -> Any:
         # distinct stream from the epoch shuffle keys (fold_in(seed, epoch)
         # for epoch in 0..max_epochs): a shared key would correlate the
         # init bits with epoch-1's minibatch permutation
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), 2**31 - 1)
         return self.module.init(rng, jnp.zeros((1, n_features)))
 
-    def _check_init_params(self, init_params: Any, n_features: int):
+    def _check_init_params(self, init_params: Any, n_features: int) -> Any:
         """Validate + deep-copy a warm-start parameter pytree.
 
         The structure and every leaf shape must match a fresh init of
@@ -354,7 +363,9 @@ class MLPClassifier:
             )
         return jax.tree.map(lambda a: jnp.array(a, jnp.float32), init_params)
 
-    def _dense_logits(self, params, x, mean, std):
+    def _dense_logits(
+        self, params: Any, x: jax.Array, mean: jax.Array, std: jax.Array
+    ) -> jax.Array:
         """``module.apply`` on standardized rows, optionally narrowed.
 
         The narrowed form follows the same policy as the fused path: the
@@ -383,16 +394,16 @@ class MLPClassifier:
 
     def _fit_loop(
         self,
-        params,
-        data,
+        params: Any,
+        data: Any,
         n: int,
-        loss_fn,
-        eval_data=None,
+        loss_fn: Callable[..., Any],
+        eval_data: Any = None,
         *,
         path: str,
         n_samples: Optional[int] = None,
         init_opt_state: Any = None,
-    ):
+    ) -> Any:
         """Shared epoch loop: scan-train, eval, early-stop, telemetry.
 
         ``loss_fn(params, minibatch, slot_weights)`` is the per-batch
@@ -475,7 +486,9 @@ class MLPClassifier:
         self._record_train_health(epoch_health, labels, path)
         return self
 
-    def _record_train_health(self, epoch_health, labels, path) -> None:
+    def _record_train_health(
+        self, epoch_health: Any, labels: Dict[str, str], path: str
+    ) -> None:
         """Materialize the per-epoch health scalars; record + verdict.
 
         One host conversion at the END of the fit (the epochs were
@@ -646,7 +659,7 @@ class MLPClassifier:
         std: Optional[Any] = None,
         path: str = 'fused',
         init_params: Any = None,
-    ):
+    ) -> Tuple[Any, Any, Any, Any, Any, Any]:
         """Build the packed training problem (also used by ``bench.py``).
 
         Returns ``(params, data, loss_fn, make_data, states, layout)``:
@@ -744,7 +757,9 @@ class MLPClassifier:
         return params, data, loss_fn, make_data, states, layout
 
     @staticmethod
-    def _resolve_states(batch, *, names, k, registry):
+    def _resolve_states(
+        batch: Any, *, names: Tuple[str, ...], k: int, registry: str
+    ) -> Tuple[Any, Any, Any]:
         """``batch`` -> (TrainStates, TrainLayout, ActionBatch | None)."""
         from ..ops.fused import TrainStates, build_train_states
 
@@ -760,7 +775,7 @@ class MLPClassifier:
         return states, layout, batch
 
     @staticmethod
-    def _materialize_features(batch, layout):
+    def _materialize_features(batch: Any, layout: Any) -> jax.Array:
         if layout.registry_name == 'atomic':
             from ..ops.atomic import compute_features
         else:

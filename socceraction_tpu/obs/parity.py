@@ -185,7 +185,13 @@ class ParityProbe:
                     self._outstanding -= 1
 
     def _probe_one(
-        self, model, host_batch, gs, values, exemplar, quant='none'
+        self,
+        model: Any,
+        host_batch: Any,
+        gs: Optional[np.ndarray],
+        values: np.ndarray,
+        exemplar: Any,
+        quant: str = 'none',
     ) -> None:
         import jax
         import jax.numpy as jnp

@@ -432,7 +432,9 @@ class InstrumentedJit:
             return self._jit(*args, **kwargs)
         return self._first_call(key, args, kwargs)
 
-    def _first_call(self, key, args, kwargs):
+    def _first_call(
+        self, key: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]
+    ) -> Any:
         sig = signature_of(args, kwargs, self._static_names)
         with self._lock:
             fresh = key not in self._signatures
@@ -488,7 +490,14 @@ class InstrumentedJit:
         self._note_compile_event(sig, prev, dt, flops, bytes_acc)
         return out
 
-    def _note_compile_event(self, sig, prev, dt, flops, bytes_acc):
+    def _note_compile_event(
+        self,
+        sig: Any,
+        prev: Any,
+        dt: float,
+        flops: Optional[float],
+        bytes_acc: Optional[float],
+    ) -> None:
         """RunLog/recorder events + the rate-over-window storm detector."""
         from socceraction_tpu.obs.recorder import RECORDER
         from socceraction_tpu.obs.trace import current_runlog

@@ -41,7 +41,7 @@ _ONEHOT_GROUPS: Tuple[Tuple[str, Tuple[int, ...]], ...] = tuple(
 class _AtomicStates:
     """Per-state views of an atomic batch, left-to-right mirror applied."""
 
-    def __init__(self, batch: AtomicActionBatch, k: int):
+    def __init__(self, batch: AtomicActionBatch, k: int) -> None:
         self.k = k
         # follow the packed float dtype (see ops.features._States)
         f = self.f = batch.time_seconds.dtype

@@ -26,7 +26,7 @@ backends agree column-for-column by construction.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +55,7 @@ def _shift_gather(arr: jax.Array, i: int) -> jax.Array:
 class _States:
     """Per-state views of a batch, with the left-to-right mirror applied."""
 
-    def __init__(self, batch: ActionBatch, k: int):
+    def __init__(self, batch: ActionBatch, k: int) -> None:
         self.k = k
         # Follow the packed float dtype: float32 in production, float64
         # when packed with float_dtype=np.float64 under JAX x64 (the
@@ -82,7 +82,9 @@ class _States:
         self.end_y = [ltr(_shift_gather(batch.end_y, i).astype(f), W) for i in range(k)]
 
 
-def _stack(cols: List[jax.Array], f, like: jax.Array = None) -> jax.Array:
+def _stack(
+    cols: List[jax.Array], f: Any, like: Optional[jax.Array] = None
+) -> jax.Array:
     """Stack per-column ``(G, A)`` arrays into a ``(G, A, F)`` block of dtype ``f``.
 
     An empty column list yields a zero-width block (state features with

@@ -260,7 +260,9 @@ def fused_mlp_logits(
     return _hidden_chain(leaves, h, hidden_layers, hidden_dtype)
 
 
-def _standardized_first_layer(leaves, mean, std) -> Tuple[jax.Array, jax.Array]:
+def _standardized_first_layer(
+    leaves: Any, mean: Optional[Any], std: Optional[Any]
+) -> Tuple[jax.Array, jax.Array]:
     """Dense_0 (kernel, bias) with standardization folded in.
 
     ``(x - μ)/σ @ W + b == x @ (W/σ) + (b - μ @ W/σ)`` — the gather
@@ -435,7 +437,7 @@ def _dense_subkernel(
 
 
 def _hidden_chain(
-    leaves,
+    leaves: Any,
     h: jax.Array,
     hidden_layers: int,
     hidden_dtype: Optional[Any] = None,
@@ -715,25 +717,25 @@ def _packed_rows(
     ),
 )
 def _pair_probs_prepared(
-    tables_q,
-    w_dense_q,
-    bias,
-    hidden_a,
-    hidden_b,
-    batch,
-    dense_overrides=None,
+    tables_q: Any,
+    w_dense_q: Any,
+    bias: Any,
+    hidden_a: Any,
+    hidden_b: Any,
+    batch: Any,
+    dense_overrides: Optional[Dict[str, jax.Array]] = None,
     *,
-    names,
-    k,
-    hidden_layers_a,
-    hidden_layers_b,
-    registry_name,
-    h_a_width,
-    quantize,
-    kernel,
-    hidden_dtype_name=None,
-    guard=False,
-):
+    names: Tuple[str, ...],
+    k: int,
+    hidden_layers_a: int,
+    hidden_layers_b: int,
+    registry_name: str,
+    h_a_width: int,
+    quantize: str,
+    kernel: str,
+    hidden_dtype_name: Optional[str] = None,
+    guard: bool = False,
+) -> Any:
     from .gather_matmul import fused_first_layer_quant
     from .quant import dequantize
 
@@ -826,23 +828,23 @@ def fused_pair_logits(
     ),
 )
 def _pair_probs(
-    params_a,
-    params_b,
-    mean_a,
-    std_a,
-    mean_b,
-    std_b,
-    batch,
-    dense_overrides=None,
+    params_a: Any,
+    params_b: Any,
+    mean_a: Any,
+    std_a: Any,
+    mean_b: Any,
+    std_b: Any,
+    batch: Any,
+    dense_overrides: Optional[Dict[str, jax.Array]] = None,
     *,
-    names,
-    k,
-    hidden_layers_a,
-    hidden_layers_b,
-    registry_name,
-    hidden_dtype_name=None,
-    guard=False,
-):
+    names: Tuple[str, ...],
+    k: int,
+    hidden_layers_a: int,
+    hidden_layers_b: int,
+    registry_name: str,
+    hidden_dtype_name: Optional[str] = None,
+    guard: bool = False,
+) -> Any:
     a, b = fused_pair_logits(
         params_a, params_b, batch, names=names, k=k,
         hidden_layers_a=hidden_layers_a, hidden_layers_b=hidden_layers_b,
@@ -1150,7 +1152,9 @@ def train_layout(
     instrument_jit, name='train_states',
     static_argnames=('names', 'k', 'registry_name'),
 )
-def _train_states_arrays(batch, *, names, k, registry_name):
+def _train_states_arrays(
+    batch: Any, *, names: Tuple[str, ...], k: int, registry_name: str
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     registry = REGISTRIES[registry_name]
     s = registry.make_states(batch, k)
     dense_blocks = [
@@ -1267,11 +1271,13 @@ def table_lookup(table: jax.Array, ids: jax.Array, num_rows: int) -> jax.Array:
     return table[ids]
 
 
-def _table_lookup_fwd(table, ids, num_rows):
+def _table_lookup_fwd(
+    table: jax.Array, ids: jax.Array, num_rows: int
+) -> Tuple[jax.Array, jax.Array]:
     return table[ids], ids
 
 
-def _table_lookup_bwd(num_rows, ids, g):
+def _table_lookup_bwd(num_rows: int, ids: jax.Array, g: jax.Array) -> Tuple[jax.Array, Any]:
     from .segment import segment_sum_rows
 
     import numpy as _np

@@ -52,7 +52,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -114,7 +114,16 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _kernel(ids_ref, x_ref, tables_ref, w_ref, bias_ref, out_ref, *, k: int):
+def _kernel(
+    ids_ref: Any,
+    x_ref: Any,
+    tables_ref: Any,
+    w_ref: Any,
+    bias_ref: Any,
+    out_ref: Any,
+    *,
+    k: int,
+) -> None:
     """One ``(CHUNK_ROWS, H)`` block of first-layer activations.
 
     Accumulation order matches the XLA lowering exactly (bias, then the
@@ -145,7 +154,9 @@ def _kernel(ids_ref, x_ref, tables_ref, w_ref, bias_ref, out_ref, *, k: int):
     out_ref[:] = acc
 
 
-def _padded_operands(tables, w, bias, ids, x):
+def _padded_operands(
+    tables: jax.Array, w: jax.Array, bias: jax.Array, ids: jax.Array, x: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, int, int]:
     """Shared zero padding for BOTH dispatch methods.
 
     Padding to lane multiples is a Pallas layout requirement; the XLA
@@ -171,7 +182,15 @@ def _padded_operands(tables, w, bias, ids, x):
     return tables, w, bias, ids, x, n, h
 
 
-def _forward(tables, w, bias, ids, x, *, method: str):
+def _forward(
+    tables: jax.Array,
+    w: jax.Array,
+    bias: jax.Array,
+    ids: jax.Array,
+    x: jax.Array,
+    *,
+    method: str,
+) -> jax.Array:
     if method not in ('pallas', 'xla'):
         raise ValueError(f'fused kernel method {method!r} (want pallas|xla)')
     k = ids.shape[1]
@@ -239,12 +258,19 @@ def fused_first_layer(
     return _forward(tables, w_dense, bias, ids, x_dense, method=method)
 
 
-def _ffl_fwd(tables, w_dense, bias, ids, x_dense, method):
+def _ffl_fwd(
+    tables: jax.Array,
+    w_dense: jax.Array,
+    bias: jax.Array,
+    ids: jax.Array,
+    x_dense: jax.Array,
+    method: str,
+) -> Tuple[jax.Array, Any]:
     out = _forward(tables, w_dense, bias, ids, x_dense, method=method)
     return out, (tables.shape, ids, x_dense, w_dense)
 
 
-def _ffl_bwd(method, res, g):
+def _ffl_bwd(method: str, res: Any, g: jax.Array) -> Any:
     import numpy as _np
 
     from .segment import segment_sum_rows

@@ -215,11 +215,11 @@ def fake_quant(t: jax.Array, mode: str) -> jax.Array:
     return dequantize(q.data, q.resid, q.scale)
 
 
-def _fake_quant_fwd(t, mode):
+def _fake_quant_fwd(t: jax.Array, mode: str) -> Tuple[jax.Array, None]:
     return fake_quant(t, mode), None
 
 
-def _fake_quant_bwd(mode, _res, g):
+def _fake_quant_bwd(mode: str, _res: None, g: jax.Array) -> Tuple[jax.Array]:
     return (g,)
 
 

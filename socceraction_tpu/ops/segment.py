@@ -56,7 +56,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -91,7 +91,7 @@ PALLAS_MAX_SEGMENTS = int(pallas_profile()['segment_max_segments'])
 ROWS_ONEHOT_MAX_SEGMENTS = int(pallas_profile()['rows_onehot_max_segments'])
 
 
-def _kernel(ids_ref, vals_ref, out_ref):
+def _kernel(ids_ref: Any, vals_ref: Any, out_ref: Any) -> None:
     s = pl.program_id(0)  # segment-block index (slow axis)
     c = pl.program_id(1)  # chunk index (fast axis -> VMEM accumulation)
 

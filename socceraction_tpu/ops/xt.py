@@ -42,7 +42,7 @@ certificate. See ``docs/xt.md`` for the selection guide.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple, Union
+from typing import Callable, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -218,7 +218,9 @@ def _resolve_solver(solver: Optional[str], accelerate: bool) -> str:
     return solver
 
 
-def _value_iteration(sweep, gs: jax.Array, eps: float, max_iter: int):
+def _value_iteration(
+    sweep: Callable[[jax.Array], jax.Array], gs: jax.Array, eps: float, max_iter: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """``xT <- sweep(xT)`` to convergence inside a ``lax.while_loop``.
 
     Convergence uses the reference's signed test ``any(new - old > eps)``
@@ -250,7 +252,9 @@ def _value_iteration(sweep, gs: jax.Array, eps: float, max_iter: int):
 _ANDERSON_MEMORY = 3  # history depth m; m=2-4 is the sweet spot in practice
 
 
-def _value_iteration_anderson(sweep, gs: jax.Array, eps: float, max_iter: int):
+def _value_iteration_anderson(
+    sweep: Callable[[jax.Array], jax.Array], gs: jax.Array, eps: float, max_iter: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Anderson-accelerated fixed-point iteration for ``x = sweep(x)``.
 
     The xT sweep is an affine contraction (``x <- gs + p_move ⊙ T x``), so
@@ -331,7 +335,9 @@ _MIN_GAMMA_SQ = 1e-12
 _MODULUS_POWER_SWEEPS = 8
 
 
-def _contraction_modulus(sweep, gs: jax.Array) -> jax.Array:
+def _contraction_modulus(
+    sweep: Callable[[jax.Array], jax.Array], gs: jax.Array
+) -> jax.Array:
     """Estimate the sweep's *effective* contraction factor, per grid.
 
     The sweep is affine: ``x -> gs + p_move ⊙ (T x)`` with linear part
@@ -379,7 +385,9 @@ def _nesterov_cap(gamma: jax.Array) -> jax.Array:
     )
 
 
-def _value_iteration_anchored(sweep, gs: jax.Array, eps: float, max_iter: int):
+def _value_iteration_anchored(
+    sweep: Callable[[jax.Array], jax.Array], gs: jax.Array, eps: float, max_iter: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Halpern-anchored value iteration (Anc-VI, arXiv 2305.16569).
 
     ``x^{k+1} = β_{k+1} x^0 + (1 - β_{k+1}) f(x^k)`` with the paper's
@@ -426,7 +434,9 @@ def _value_iteration_anchored(sweep, gs: jax.Array, eps: float, max_iter: int):
     return out, it, resid
 
 
-def _value_iteration_momentum(sweep, gs: jax.Array, eps: float, max_iter: int):
+def _value_iteration_momentum(
+    sweep: Callable[[jax.Array], jax.Array], gs: jax.Array, eps: float, max_iter: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Nesterov-momentum value iteration with adaptive restart.
 
     The first-order accelerated scheme of arXiv 1905.09963 applied to
@@ -480,8 +490,12 @@ _SINGLE_GRID_LOOPS = {
 
 
 def _batched_value_iteration(
-    sweep, gs: jax.Array, eps: float, max_iter: int, solver: str
-):
+    sweep: Callable[[jax.Array], jax.Array],
+    gs: jax.Array,
+    eps: float,
+    max_iter: int,
+    solver: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Solve a ``(G, w, l)`` fleet of grids in ONE ``while_loop``.
 
     All grids advance in lockstep inside a single loop — every sweep is

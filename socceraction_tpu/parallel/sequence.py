@@ -76,7 +76,14 @@ class _Family(NamedTuple):
     formula: Callable
 
 
-def _standard_formula(get, lag, ps, pc, psp, pcp):
+def _standard_formula(
+    get: Callable[[str], Any],
+    lag: Callable[[str], Any],
+    ps: Any,
+    pc: Any,
+    psp: Any,
+    pcp: Any,
+) -> Any:
     from ..ops.formula import vaep_core
 
     return vaep_core(
@@ -93,7 +100,14 @@ def _standard_formula(get, lag, ps, pc, psp, pcp):
     )
 
 
-def _atomic_formula(get, lag, ps, pc, psp, pcp):
+def _atomic_formula(
+    get: Callable[[str], Any],
+    lag: Callable[[str], Any],
+    ps: Any,
+    pc: Any,
+    psp: Any,
+    pcp: Any,
+) -> Any:
     from ..ops.atomic import vaep_core
 
     return vaep_core(

@@ -153,7 +153,14 @@ class ReplicaDispatcher:
         kwargs['guard'] = guard
         return kwargs, guard
 
-    def _pair_values(self, params, batch, overrides, kwargs, guard):
+    def _pair_values(
+        self,
+        params: Any,
+        batch: Any,
+        overrides: Any,
+        kwargs: Dict[str, Any],
+        guard: bool,
+    ) -> Any:
         """One fused pair dispatch + formula kernel; notes guard events."""
         from ..obs import numerics
 

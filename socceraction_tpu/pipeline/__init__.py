@@ -25,6 +25,8 @@ This package makes that convention first-class:
   cache *overlapped* with the epoch instead of as an up-front pass.
 """
 
+from typing import Any, List
+
 __all__ = [
     'PackedSeason',
     'SeasonStore',
@@ -53,7 +55,7 @@ _EXPORTS = {
 }
 
 
-def __getattr__(name):
+def __getattr__(name: str) -> Any:
     if name in _EXPORTS:
         import importlib
 
@@ -68,5 +70,5 @@ def __getattr__(name):
     )
 
 
-def __dir__():
+def __dir__() -> List[str]:
     return sorted(set(globals()) | set(__all__))

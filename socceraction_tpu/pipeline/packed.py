@@ -73,8 +73,14 @@ class _Family:
     """Column layout + packing recipe of one action family."""
 
     def __init__(
-        self, name, float_cols, int_cols, batch_cls, packer, key_prefix
-    ):
+        self,
+        name: str,
+        float_cols: Tuple[str, ...],
+        int_cols: Tuple[str, ...],
+        batch_cls: Any,
+        packer: Any,
+        key_prefix: str,
+    ) -> None:
         self.name = name
         self.float_cols = float_cols
         self.int_cols = int_cols
@@ -339,7 +345,7 @@ class PackedSeason:
         return batch, list(game_ids)
 
 
-def _int_wire_name(int_cols) -> str:
+def _int_wire_name(int_cols: Sequence[np.ndarray]) -> str:
     """``'int8'`` when every id column fits, else ``'int32'``.
 
     Every SPADL vocabulary fits int8; a store with exotic ids ships
@@ -351,7 +357,9 @@ def _int_wire_name(int_cols) -> str:
     return 'int8'
 
 
-def _ship_wire(fam, floats, ints, is_home, n_act, device) -> Any:
+def _ship_wire(
+    fam: _Family, floats: Any, ints: Any, is_home: Any, n_act: Any, device: Any
+) -> Any:
     """Transfer the wire arrays and rebuild the batch on device.
 
     Dispatch time (``jax.device_put`` of the four wire arrays + the

@@ -1,0 +1,31 @@
+"""Sequence-model valuation: a GRU head over the k-action window.
+
+The second head architecture behind the VAEP probability interface
+(arXiv 2106.01786's deep-sequence direction on this repo's packed
+pipeline): token embedding through the fused combined-id machinery, a
+small unrolled GRU, a dense-conditioned readout. Train through
+``VAEP.fit_packed(learner='seq')``; serve through the standard
+``RatingService`` ladder, padded in time as well as batch
+(``core.batch.bucket_window``). ``docs/sequence.md`` is the narrative
+entry point.
+"""
+
+from .classifier import SEQ_FORMAT_VERSION as SEQ_FORMAT_VERSION
+from .classifier import SeqClassifier as SeqClassifier
+from .model import dense_stats as dense_stats
+from .model import init_seq_params as init_seq_params
+from .model import seq_logits as seq_logits
+from .model import seq_pair_probs as seq_pair_probs
+from .model import seq_param_shapes as seq_param_shapes
+from .model import seq_train_logits as seq_train_logits
+
+__all__ = [
+    'SEQ_FORMAT_VERSION',
+    'SeqClassifier',
+    'dense_stats',
+    'init_seq_params',
+    'seq_logits',
+    'seq_pair_probs',
+    'seq_param_shapes',
+    'seq_train_logits',
+]

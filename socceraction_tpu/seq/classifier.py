@@ -1,0 +1,389 @@
+"""The GRU sequence head as a trainable binary classifier.
+
+``SeqClassifier`` is the second head architecture behind the VAEP
+probability interface: same labels, same packed
+:class:`~socceraction_tpu.ops.fused.TrainStates` input, same
+one-dispatch-per-epoch training discipline — a different function of
+the window. It deliberately does **not** subclass
+:class:`~socceraction_tpu.ml.mlp.MLPClassifier` (the fused serving
+fold's ``isinstance`` dispatch must keep meaning "an MLP head"); the
+pieces that are genuinely architecture-agnostic — the scan-epoch fit
+loop, the training-health verdict, the cached standardization stats —
+are shared as unbound functions instead, so there is exactly one
+implementation of each and the seq head inherits every fix for free.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ml.mlp import MLPClassifier, _weighted_bce
+from ..obs import counter, histogram
+from .model import init_seq_params, seq_param_shapes, seq_train_logits
+
+__all__ = ['SeqClassifier', 'SEQ_FORMAT_VERSION']
+
+#: Version stamped into :meth:`SeqClassifier.save` artifacts — the seq
+#: head's own lineage, independent of ``MLP_FORMAT_VERSION`` (the two
+#: artifact layouts evolve separately). :meth:`SeqClassifier.load`
+#: rejects artifacts stamped NEWER than this with an actionable error,
+#: the same contract the model registry relies on for MLP heads.
+SEQ_FORMAT_VERSION = 1
+
+
+class SeqClassifier:
+    """Binary classifier: GRU over the k-action window -> sigmoid.
+
+    Parameters
+    ----------
+    embed_dim : int
+        Width of the combined-id token embedding (the
+        ``(combo_size, E)`` table trained through
+        :func:`~socceraction_tpu.ops.fused.table_lookup`).
+    hidden : int
+        GRU hidden-state width.
+    readout : int
+        Width of the dense-conditioned readout layer.
+    learning_rate, batch_size, max_epochs, patience, pos_weight, seed
+        Training protocol knobs, identical in meaning to
+        :class:`~socceraction_tpu.ml.mlp.MLPClassifier`.
+    """
+
+    def __init__(
+        self,
+        embed_dim: int = 32,
+        hidden: int = 64,
+        readout: int = 64,
+        learning_rate: float = 1e-3,
+        batch_size: int = 8192,
+        max_epochs: int = 50,
+        patience: int = 5,
+        pos_weight: float = 1.0,
+        seed: int = 0,
+    ) -> None:
+        self.embed_dim = int(embed_dim)
+        self.hidden = int(hidden)
+        self.readout = int(readout)
+        self.learning_rate = learning_rate
+        self.batch_size = batch_size
+        self.max_epochs = max_epochs
+        self.patience = patience
+        self.pos_weight = pos_weight
+        self.seed = seed
+        self.params: Any = None
+        self._mean: Optional[np.ndarray] = None
+        self._std: Optional[np.ndarray] = None
+        self._mean_dev: Any = None
+        self._std_dev: Any = None
+        #: epoch-function retrace count of the last fit (1 == the epoch
+        #: compiled once and was reused across every epoch) — the same
+        #: pin the MLP carries; ``tests/test_seq.py`` asserts it
+        self.n_epoch_traces_: int = 0
+        #: adam state matching :attr:`params` (see the MLP's docs); the
+        #: continuous-learning loop transplants it across warm starts
+        self.opt_state_: Any = None
+        #: training-health verdict of the last fit (same schema as the
+        #: MLP's) — the learn loop's divergence rejection reads it
+        #: through the identical attribute, so a diverging seq candidate
+        #: is fail-closed rejected by the same gate
+        self.train_health_: Optional[Dict[str, Any]] = None
+
+    # -- shared machinery (unbound reuse, NOT subclassing) ------------------
+    # These are attribute-generic: they only touch hyperparameters and
+    # fitted-state attributes both classes define. Sharing the function
+    # objects keeps one implementation of the epoch loop and the health
+    # verdict without making a SeqClassifier satisfy
+    # ``isinstance(x, MLPClassifier)`` (which gates the fused MLP fold).
+    mean_ = MLPClassifier.mean_
+    std_ = MLPClassifier.std_
+    _device_stats = MLPClassifier._device_stats
+    _fit_loop = MLPClassifier._fit_loop
+    _record_train_health = MLPClassifier._record_train_health
+    _resolve_states = staticmethod(MLPClassifier._resolve_states)
+
+    # -- training -----------------------------------------------------------
+
+    def _layout_dims(self, layout: Any) -> Tuple[int, int]:
+        """``(combo_size, n_dense)`` of a layout — the init-shape inputs."""
+        from ..ops.fused import REGISTRIES
+
+        registry = REGISTRIES[layout.registry_name]
+        n_dense = sum(
+            w for _n, kind, _o, w in layout.spans if kind == 'dense'
+        )
+        return int(registry.combo_size), int(n_dense)
+
+    def _init_params(self, layout: Any) -> Dict[str, Any]:
+        combo_size, n_dense = self._layout_dims(layout)
+        return init_seq_params(
+            self.seed,
+            combo_size=combo_size,
+            n_dense=n_dense,
+            embed_dim=self.embed_dim,
+            hidden=self.hidden,
+            readout=self.readout,
+        )
+
+    def _check_init_params(
+        self, init_params: Any, layout: Any
+    ) -> Dict[str, Any]:
+        """Validate + deep-copy a warm-start pytree (donation safety).
+
+        Same contract as the MLP's ``_check_init_params``: structure and
+        leaf shapes must match a fresh init for this architecture and
+        layout (an abstract template — nothing is allocated), and the
+        copy is mandatory because the epoch dispatch donates its
+        parameter buffers.
+        """
+        combo_size, n_dense = self._layout_dims(layout)
+        template = seq_param_shapes(
+            combo_size=combo_size,
+            n_dense=n_dense,
+            embed_dim=self.embed_dim,
+            hidden=self.hidden,
+            readout=self.readout,
+        )
+        t_struct = jax.tree.structure(template)
+        i_struct = jax.tree.structure(init_params)
+        if t_struct != i_struct:
+            raise ValueError(
+                f'init_params tree structure {i_struct} does not match '
+                f'this classifier (embed_dim={self.embed_dim}, '
+                f'hidden={self.hidden}, readout={self.readout}): {t_struct}'
+            )
+        t_shapes = [jnp.shape(leaf) for leaf in jax.tree.leaves(template)]
+        i_shapes = [jnp.shape(leaf) for leaf in jax.tree.leaves(init_params)]
+        if t_shapes != i_shapes:
+            raise ValueError(
+                f'init_params leaf shapes {i_shapes} do not match the '
+                f'feature layout / architecture ({t_shapes}); warm starts '
+                'require an unchanged layout'
+            )
+        return jax.tree.map(lambda a: jnp.array(a, jnp.float32), init_params)
+
+    def fit_packed(
+        self,
+        batch: Any,
+        y: Any,
+        *,
+        names: Tuple[str, ...],
+        k: int,
+        registry: str = 'standard',
+        eval_set: Optional[Tuple[Any, Any]] = None,
+        mean: Optional[Any] = None,
+        std: Optional[Any] = None,
+        path: str = 'seq',
+        init_params: Any = None,
+        init_opt_state: Any = None,
+    ) -> 'SeqClassifier':
+        """Train the GRU head on packed game states — same entry as the MLP.
+
+        Identical signature and protocol to
+        :meth:`~socceraction_tpu.ml.mlp.MLPClassifier.fit_packed` (the
+        learner registry depends on that): packed batch or precomputed
+        ``(TrainStates, TrainLayout)``, full-column statistics (computed
+        from the packed form when not provided — kept full-length so
+        stats stay interchangeable with MLP heads across warm starts),
+        early stopping on ``eval_set``, warm starts via
+        ``init_params``/``init_opt_state``. Each epoch is ONE jitted
+        scan dispatch (``n_epoch_traces_`` pins it).
+        """
+        from ..ops.fused import packed_feature_stats
+
+        t0 = time.perf_counter()
+        states, layout, _raw = self._resolve_states(
+            batch, names=tuple(names), k=k, registry=registry
+        )
+        yd = jnp.asarray(y, dtype=jnp.float32).reshape(-1)
+        if yd.shape[0] != states.weight.shape[0]:
+            raise ValueError(
+                f'labels have {yd.shape[0]} rows, packed states have '
+                f'{states.weight.shape[0]}'
+            )
+        if mean is None or std is None:
+            mean, raw_std = packed_feature_stats(states, layout)
+            std = jnp.where(raw_std > 0, raw_std, 1.0)
+        self.mean_ = np.asarray(mean)
+        self.std_ = np.asarray(std)
+        self._mean_dev = jnp.asarray(mean)
+        self._std_dev = jnp.asarray(std)
+        mean_dev, std_dev = self._device_stats()
+
+        if init_params is None:
+            params = self._init_params(layout)
+        else:
+            params = self._check_init_params(init_params, layout)
+        pos_w = self.pos_weight
+
+        def loss_fn(params: Any, mb: Dict[str, Any], w: jax.Array) -> jax.Array:
+            logits = seq_train_logits(
+                params, mb['x'], mb['ids'],
+                layout=layout, mean=mean_dev, std=std_dev,
+            )
+            return _weighted_bce(logits, mb['y'], w * mb['w'], pos_w)
+
+        data = {
+            'x': states.x_dense,
+            'ids': states.combo_ids,
+            'w': states.weight,
+            'y': yd,
+        }
+        eval_data = None
+        if eval_set is not None:
+            ev_states, ev_layout, _ev_batch = self._resolve_states(
+                eval_set[0], names=tuple(names), k=k, registry=registry
+            )
+            if ev_layout.n_features != layout.n_features:
+                raise ValueError('eval_set feature layout differs from train')
+            ev_y = jnp.asarray(eval_set[1], dtype=jnp.float32).reshape(-1)
+            eval_data = {
+                'x': ev_states.x_dense,
+                'ids': ev_states.combo_ids,
+                'w': ev_states.weight,
+                'y': ev_y,
+            }
+
+        n = int(states.weight.shape[0])
+        n_valid = int(np.asarray(jnp.sum(states.weight)))
+        out = self._fit_loop(
+            params, data, n, loss_fn, eval_data, path=path,
+            n_samples=n_valid, init_opt_state=init_opt_state,
+        )
+        labels = {'platform': jax.default_backend()}
+        counter('seq/fits', unit='count').inc(1, **labels)
+        histogram('seq/fit_seconds', unit='s').observe(
+            time.perf_counter() - t0, **labels
+        )
+        return out
+
+    # -- inference ----------------------------------------------------------
+
+    def predict_proba_states(self, states: Any, layout: Any) -> jax.Array:
+        """P(y=1) per packed row -> ``(N,)`` device array."""
+        if self.params is None:
+            raise ValueError('classifier is not fitted')
+        mean_dev, std_dev = self._device_stats()
+        logits = seq_train_logits(
+            self.params, states.x_dense, states.combo_ids,
+            layout=layout, mean=mean_dev, std=std_dev,
+        )
+        return jax.nn.sigmoid(logits)
+
+    def predict_proba_device_batch(
+        self,
+        batch: Any,
+        *,
+        names: Tuple[str, ...],
+        k: int,
+        registry: str = 'standard',
+    ) -> jax.Array:
+        """P(y=1) per action of a packed batch -> ``(G, A)``.
+
+        The reference/fallback inference path: packs the batch
+        (:func:`~socceraction_tpu.ops.fused.build_train_states`) and
+        runs the head on the rows — no serving fold, no pair fusion.
+        ``names``/``k``/``registry`` must match the trained layout.
+        """
+        from ..ops.fused import build_train_states
+
+        states, layout = build_train_states(
+            batch, names=tuple(names), k=k, registry_name=registry
+        )
+        G, A = batch.type_id.shape
+        return self.predict_proba_states(states, layout).reshape(G, A)
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Save the fitted head to one ``.npz`` file (msgpack params).
+
+        Same artifact discipline as the MLP: parameter pytree as
+        msgpack bytes, full-column standardization statistics, the
+        hyperparameters, and a format-version stamp the loader checks
+        first. No optimizer state (in-process only, by contract).
+        """
+        import json
+
+        from flax import serialization
+
+        if self.params is None:
+            raise ValueError('cannot save an unfitted classifier')
+        hyper: Dict[str, Any] = {
+            'embed_dim': self.embed_dim,
+            'hidden': self.hidden,
+            'readout': self.readout,
+            'learning_rate': self.learning_rate,
+            'batch_size': self.batch_size,
+            'max_epochs': self.max_epochs,
+            'patience': self.patience,
+            'pos_weight': self.pos_weight,
+            'seed': self.seed,
+        }
+        host_params = jax.tree.map(
+            lambda a: np.asarray(a, dtype=np.float32), self.params
+        )
+        with open(path, 'wb') as f:
+            np.savez(
+                f,
+                format_version=np.array(SEQ_FORMAT_VERSION),
+                seq_params_msgpack=np.frombuffer(
+                    serialization.msgpack_serialize(host_params),
+                    dtype=np.uint8,
+                ),
+                mean=self.mean_,
+                std=self.std_,
+                hyper_json=np.array(json.dumps(hyper)),
+            )
+
+    @classmethod
+    def load(cls, path: str) -> 'SeqClassifier':
+        """Load a head saved with :meth:`save` (corruption -> ValueError).
+
+        The ``seq_params_msgpack`` key doubles as the artifact's kind
+        marker: an MLP artifact handed to this loader fails with the
+        corrupt-artifact error instead of deserializing garbage.
+        """
+        import json
+        import zipfile
+
+        from flax import serialization
+
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                version = (
+                    int(data['format_version'])
+                    if 'format_version' in data
+                    else 1
+                )
+                if version > SEQ_FORMAT_VERSION:
+                    raise ValueError(
+                        f'checkpoint at {path!r} has '
+                        f'format_version={version}, newer than this '
+                        f'library understands (<= {SEQ_FORMAT_VERSION}); '
+                        'upgrade socceraction_tpu to load it'
+                    )
+                hyper = json.loads(str(data['hyper_json']))
+                mean = data['mean']
+                std = data['std']
+                raw = data['seq_params_msgpack'].tobytes()
+        except (
+            zipfile.BadZipFile,
+            EOFError,
+            KeyError,
+            json.JSONDecodeError,
+        ) as e:
+            raise ValueError(
+                f'checkpoint artifact corrupt: {path!r} failed to parse '
+                f'as a seq checkpoint ({type(e).__name__}: {e}); the '
+                'file is truncated, damaged or not a save() artifact'
+            ) from e
+        clf = cls(**hyper)
+        clf.mean_ = mean.astype(np.float32)
+        clf.std_ = std.astype(np.float32)
+        clf.params = serialization.msgpack_restore(raw)
+        return clf

@@ -1,0 +1,348 @@
+"""The GRU sequence head: compute kernels over packed game states.
+
+*What Happened Next?* (arXiv 2106.01786) shows that a deep sequence
+model over the raw action stream credits **defensive and off-ball
+value** the hand-crafted VAEP features structurally cannot express: the
+model sees the k-action window as an ordered sequence and learns what a
+state is worth from how such sequences tend to continue, rather than
+from per-state aggregate columns alone.
+
+Architecture choice — a small **GRU**, not a causal transformer
+(``docs/sequence.md`` carries the full rationale):
+
+- the window is short (``k`` = 3..8 actions): a fixed-depth unrolled
+  recurrence is a handful of ``(E, H)``/``(H, H)`` matmuls — pure MXU
+  work with no attention masks, no positional encodings and no
+  ``O(k^2)`` score tensor that would be all padding at these lengths;
+- parameter count is independent of the window length, so one
+  checkpoint serves every window rung of the serving ladder;
+- the unrolled loop is shape-stable: every serving bucket compiles to
+  the same fixed sequence of dense ops, which is what keeps the
+  zero-steady-state-retrace contract cheap to uphold.
+
+The embedding layer IS the fused machinery: each game state already has
+a combined categorical id (:mod:`socceraction_tpu.ops.fused`), so the
+token embedding is one :func:`~socceraction_tpu.ops.fused.table_lookup`
+over a ``(combo_size, E)`` table — the same custom-VJP gather the fused
+MLP trains through, whose backward lowers to the MXU one-hot
+segment-sum (:mod:`socceraction_tpu.ops.segment`) unchanged: the
+``(N, k, E)`` cotangent and ``(N, k)`` id matrix flatten to rows
+exactly like the MLP's per-state gathers.
+
+Dense feature columns (the continuous ~10% of the layout) enter at the
+**readout**: they are per-state window aggregates already, so they
+condition the final value estimate rather than being forced through the
+recurrence as pseudo-tokens.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..obs.xla import instrument_jit
+from ..ops.fused import REGISTRIES, TrainLayout, table_lookup
+
+__all__ = [
+    'init_seq_params',
+    'seq_param_shapes',
+    'dense_stats',
+    'seq_logits',
+    'seq_train_logits',
+    'seq_pair_probs',
+]
+
+
+def seq_param_shapes(
+    *,
+    combo_size: int,
+    n_dense: int,
+    embed_dim: int,
+    hidden: int,
+    readout: int,
+) -> Dict[str, Any]:
+    """Abstract f32 shapes of a seq parameter pytree (for validation).
+
+    The same structure :func:`init_seq_params` returns, as
+    ``ShapeDtypeStruct`` leaves — warm-start validation compares against
+    this without allocating or running the PRNG.
+    """
+    f32 = jnp.float32
+
+    def s(*shape: int) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(shape, f32)
+
+    return {
+        'embed': s(combo_size, embed_dim),
+        'gru': {
+            'wz': s(embed_dim, hidden), 'uz': s(hidden, hidden), 'bz': s(hidden),
+            'wr': s(embed_dim, hidden), 'ur': s(hidden, hidden), 'br': s(hidden),
+            'wh': s(embed_dim, hidden), 'uh': s(hidden, hidden), 'bh': s(hidden),
+        },
+        'readout': {
+            'w1': s(hidden + n_dense, readout),
+            'b1': s(readout),
+            'w2': s(readout),
+            'b2': s(),
+        },
+    }
+
+
+def init_seq_params(
+    seed: int,
+    *,
+    combo_size: int,
+    n_dense: int,
+    embed_dim: int,
+    hidden: int,
+    readout: int,
+) -> Dict[str, Any]:
+    """Initialize a GRU head's parameter pytree (plain nested dict).
+
+    Variance-scaling normal init (LeCun: ``std = 1/sqrt(fan_in)``) on
+    every kernel, zeros on biases — the same family flax's ``Dense``
+    default draws from, kept explicit because this pytree is not a flax
+    module (no ``apply``-time machinery is needed; the forward is a
+    fixed unrolled recurrence).
+    """
+    shapes = seq_param_shapes(
+        combo_size=combo_size, n_dense=n_dense,
+        embed_dim=embed_dim, hidden=hidden, readout=readout,
+    )
+    rng = jax.random.fold_in(jax.random.PRNGKey(seed), 2**31 - 2)
+    leaves, treedef = jax.tree.flatten(shapes)
+    keys = jax.random.split(rng, len(leaves))
+
+    def draw(key: jax.Array, tpl: jax.ShapeDtypeStruct) -> jax.Array:
+        if len(tpl.shape) < 2:
+            return jnp.zeros(tpl.shape, tpl.dtype)  # biases (and w2/b2)
+        fan_in = tpl.shape[0]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(float(max(fan_in, 1))))
+        return (jax.random.normal(key, tpl.shape, tpl.dtype) * scale)
+
+    params = jax.tree.unflatten(
+        treedef, [draw(k, t) for k, t in zip(keys, leaves)]
+    )
+    # w2 is rank-1 but is a kernel, not a bias: give it a scaled draw too
+    k2 = jax.random.fold_in(rng, 7)
+    params['readout']['w2'] = jax.random.normal(
+        k2, shapes['readout']['w2'].shape, jnp.float32
+    ) / jnp.sqrt(jnp.asarray(float(max(shapes['readout']['w2'].shape[0], 1))))
+    return params
+
+
+def dense_stats(
+    mean: jax.Array, std: jax.Array, layout: TrainLayout
+) -> Tuple[jax.Array, jax.Array]:
+    """Slice full-column ``(mean, std)`` down to the dense sub-columns.
+
+    The fit path computes statistics over the FULL feature columns
+    (:func:`~socceraction_tpu.ops.fused.packed_feature_stats`) so
+    warm-start stat reuse stays layout-shaped and arch-agnostic; the seq
+    head standardizes only the dense sub-tensor it consumes. ``layout``
+    is static, so the slices are trace-time constants.
+    """
+    means = []
+    stds = []
+    for _name, kind, off, width in layout.spans:
+        if kind == 'dense':
+            means.append(mean[off : off + width])
+            stds.append(std[off : off + width])
+    if not means:
+        z = jnp.zeros((0,), jnp.float32)
+        return z, jnp.ones((0,), jnp.float32)
+    return jnp.concatenate(means), jnp.concatenate(stds)
+
+
+def _gru_pass(params: Dict[str, Any], emb: jax.Array) -> jax.Array:
+    """Run the unrolled GRU oldest-to-newest over ``(N, k, E)`` tokens.
+
+    Token ``i`` of a state window is the action ``i`` steps back
+    (``i == 0`` is the action being valued), so the recurrence consumes
+    ``i = k-1 .. 0``: the hidden state accumulates context forward in
+    match time and ends on the current action. ``k`` is a static shape,
+    so the loop unrolls into ``k`` fixed MXU matmul groups.
+    """
+    g = params['gru']
+    n, k, _e = emb.shape
+    h = jnp.zeros((n, g['uz'].shape[0]), emb.dtype)
+    for i in range(k - 1, -1, -1):
+        x = emb[:, i, :]
+        z = jax.nn.sigmoid(x @ g['wz'] + h @ g['uz'] + g['bz'])
+        r = jax.nn.sigmoid(x @ g['wr'] + h @ g['ur'] + g['br'])
+        hh = jnp.tanh(x @ g['wh'] + (r * h) @ g['uh'] + g['bh'])
+        h = (1.0 - z) * h + z * hh
+    return h
+
+
+def seq_logits(
+    params: Dict[str, Any],
+    x_dense: jax.Array,
+    combo_ids: jax.Array,
+    *,
+    dense_mean: jax.Array,
+    dense_std: jax.Array,
+) -> jax.Array:
+    """Differentiable GRU-head logits over packed rows -> ``(N,)``.
+
+    One :func:`~socceraction_tpu.ops.fused.table_lookup` embeds the
+    whole ``(N, k)`` id matrix at once — forward a single gather,
+    backward a single MXU segment-sum over ``N * k`` rows — then the
+    unrolled GRU runs oldest-to-newest and the readout conditions the
+    final hidden state on the standardized dense sub-columns.
+    """
+    embed = params['embed']
+    emb = table_lookup(embed, combo_ids, int(embed.shape[0]))
+    h = _gru_pass(params, emb)
+    dn = (x_dense - dense_mean) / dense_std
+    cat = jnp.concatenate([h, dn.astype(h.dtype)], axis=-1)
+    ro = params['readout']
+    r1 = jax.nn.relu(cat @ ro['w1'] + ro['b1'])
+    return r1 @ ro['w2'] + ro['b2']
+
+
+def seq_train_logits(
+    params: Dict[str, Any],
+    x_dense: jax.Array,
+    combo_ids: jax.Array,
+    *,
+    layout: TrainLayout,
+    mean: jax.Array,
+    std: jax.Array,
+) -> jax.Array:
+    """Training-path logits from full-column statistics -> ``(N,)``.
+
+    The signature mirror of
+    :func:`~socceraction_tpu.ops.fused.fused_train_logits`: callers hold
+    layout-shaped ``mean``/``std`` (so stats stay interchangeable with
+    the MLP's) and this wrapper slices the dense sub-columns before the
+    shared forward. Validates the parameter/layout agreement up front —
+    a silent mismatch would train a corrupted head.
+    """
+    registry = REGISTRIES[layout.registry_name]
+    combo_size = int(params['embed'].shape[0])
+    if combo_size != registry.combo_size:
+        raise ValueError(
+            f'embedding table has {combo_size} rows but registry '
+            f'{layout.registry_name!r} has combo_size={registry.combo_size}'
+        )
+    n_dense = sum(w for _n, kind, _o, w in layout.spans if kind == 'dense')
+    hidden = int(params['gru']['uz'].shape[0])
+    w1_rows = int(params['readout']['w1'].shape[0])
+    if w1_rows != hidden + n_dense:
+        raise ValueError(
+            f'readout expects {w1_rows} inputs but hidden={hidden} plus '
+            f'the layout dense width {n_dense} gives {hidden + n_dense}'
+        )
+    dm, ds = dense_stats(mean, std, layout)
+    return seq_logits(
+        params, x_dense, combo_ids, dense_mean=dm, dense_std=ds
+    )
+
+
+@functools.partial(
+    instrument_jit, name='seq_pair_probs',
+    static_argnames=('names', 'k', 'registry_name'),
+)
+def _seq_pair_fn(
+    params_a: Dict[str, Any],
+    params_b: Dict[str, Any],
+    stats_a: Tuple[jax.Array, jax.Array],
+    stats_b: Tuple[jax.Array, jax.Array],
+    batch: Any,
+    overrides: Optional[Dict[str, jax.Array]],
+    *,
+    names: Tuple[str, ...],
+    k: int,
+    registry_name: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Both heads' probabilities over a batch in ONE jitted dispatch.
+
+    Mirrors ``ops.fused._train_states_arrays``' packing (the dense
+    kernels and the combined-id gathers run once, shared by both heads)
+    with the serving layer's ``dense_overrides`` substitution: an
+    override replaces a named dense kernel's block wholesale — the
+    whole-match ``goalscore`` injection for suffix windows rides through
+    here exactly like the fused MLP path. Returns a nonfinite count as a
+    device scalar alongside the probabilities (drained by the caller
+    into the numerics guard surface, no sync here).
+    """
+    registry = REGISTRIES[registry_name]
+    s = registry.make_states(batch, k)
+    G, A = batch.type_id.shape
+    n = G * A
+    dense_blocks = []
+    for name in names:
+        if name in registry.onehot_specs:
+            continue
+        if overrides is not None and name in overrides:
+            dense_blocks.append(jnp.asarray(overrides[name]))
+        else:
+            dense_blocks.append(registry.kernels[name](s))
+    x_dense = (
+        jnp.concatenate(dense_blocks, axis=-1).reshape(n, -1).astype(jnp.float32)
+        if dense_blocks
+        else jnp.zeros((n, 0), jnp.float32)
+    )
+    ids = jnp.stack(
+        [registry.combo_ids(s, i).reshape(n) for i in range(k)], axis=1
+    ).astype(jnp.int32)
+    pa = jax.nn.sigmoid(
+        seq_logits(
+            params_a, x_dense, ids,
+            dense_mean=stats_a[0], dense_std=stats_a[1],
+        )
+    ).reshape(G, A)
+    pb = jax.nn.sigmoid(
+        seq_logits(
+            params_b, x_dense, ids,
+            dense_mean=stats_b[0], dense_std=stats_b[1],
+        )
+    ).reshape(G, A)
+    bad = jnp.sum(~jnp.isfinite(pa)) + jnp.sum(~jnp.isfinite(pb))
+    return pa, pb, bad
+
+
+def seq_pair_probs(
+    clf_a: Any,
+    clf_b: Any,
+    batch: Any,
+    *,
+    names: Tuple[str, ...],
+    k: int,
+    registry_name: str = 'standard',
+    dense_overrides: Optional[Dict[str, jax.Array]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Probabilities of two GRU heads in one jitted call -> ``((G,A), (G,A))``.
+
+    The seq analog of :func:`~socceraction_tpu.ops.fused.fused_pair_probs`
+    — ``VAEP.rate_batch`` rates a scores and a concedes head over the
+    same batch, and the packing work (dense kernels, id gathers) is
+    shared between them inside one dispatch. The heads'
+    standardization constants come from their cached device stats, and
+    the dense sub-slices are trace-time constants of the static layout.
+    """
+    from ..obs import numerics
+    from ..ops.fused import train_layout
+
+    layout = train_layout(
+        batch, names=tuple(names), k=k, registry_name=registry_name
+    )
+    mean_a, std_a = clf_a._device_stats()
+    mean_b, std_b = clf_b._device_stats()
+    pa, pb, bad = _seq_pair_fn(
+        clf_a.params,
+        clf_b.params,
+        dense_stats(mean_a, std_a, layout),
+        dense_stats(mean_b, std_b, layout),
+        batch,
+        dense_overrides or None,
+        names=tuple(names),
+        k=k,
+        registry_name=registry_name,
+    )
+    numerics.note_guard('seq_pair_probs', 'probs', bad)
+    return pa, pb

@@ -38,7 +38,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 import pandas as pd
 
-from ..core.batch import ActionBatch, pack_actions, pad_batch_games, unpack_values
+from ..core.batch import (
+    ActionBatch,
+    bucket_window,
+    pack_actions,
+    pad_batch_games,
+    unpack_values,
+    window_ladder,
+)
 from ..obs import REGISTRY, counter, gauge, histogram, span
 from ..obs.context import RequestContext, new_request_context, record_segment
 from ..obs.numerics import drain_guards
@@ -100,7 +107,14 @@ class _Payload:
 
     __slots__ = ('staging', 'gs', 'keep', 'index', 'ctx')
 
-    def __init__(self, staging, gs, keep=None, index=None, ctx=None) -> None:
+    def __init__(
+        self,
+        staging: Any,
+        gs: Optional[np.ndarray],
+        keep: Optional[Tuple[int, int]] = None,
+        index: Any = None,
+        ctx: Any = None,
+    ) -> None:
         self.staging = staging  # host ActionBatch, (1, A) numpy fields
         self.gs = gs  # (1, A, 3) f32 goalscore block
         self.keep = keep  # None (whole frame) | (context, m) window slice
@@ -120,7 +134,14 @@ class _ScenarioPayload:
 
     __slots__ = ('staging', 'gs', 'grid', 'index', 'ctx')
 
-    def __init__(self, staging, gs, grid, index=None, ctx=None) -> None:
+    def __init__(
+        self,
+        staging: Any,
+        gs: Optional[np.ndarray],
+        grid: Any,
+        index: Any = None,
+        ctx: Any = None,
+    ) -> None:
         self.staging = staging  # host ActionBatch, (1, A) numpy fields
         self.gs = gs  # (1, A, 3) f32 goalscore block
         self.grid = grid  # ScenarioGrid, P perturbations
@@ -544,12 +565,18 @@ class RatingService:
         # caller activates the target anywhere — one replica failing to
         # warm raises out of this loop and aborts the swap for all of
         # them, so no mixed-version mesh can ever serve
+        rungs: Tuple[Optional[int], ...] = (
+            window_ladder(A)
+            if getattr(new, 'time_rungs', False)
+            else (None,)
+        )
         for lane in range(self.n_replicas):
             for b in self._batcher.ladder:
-                self._device_rate(
-                    _empty_host_batch(1, A), _empty_gs(1, A), new, b,
-                    lane=lane,
-                )
+                for tl in rungs:
+                    self._device_rate(
+                        _empty_host_batch(1, A), _empty_gs(1, A), new, b,
+                        lane=lane, time_len=tl,
+                    )
         return new
 
     def swap_model(self, name: str, version: Optional[str] = None) -> Tuple[str, str]:
@@ -962,6 +989,7 @@ class RatingService:
         bucket: int,
         lane: int = 0,
         extra_overrides: Optional[Dict[str, np.ndarray]] = None,
+        time_len: Optional[int] = None,
     ) -> np.ndarray:
         """Pad to the bucket, dispatch on ``lane``'s device, fetch to host.
 
@@ -980,11 +1008,33 @@ class RatingService:
         path even on a fan-out service — the rare custom-grid case
         degrades to local dispatch rather than growing the mesh wire
         format.
+
+        ``time_len`` is the window-length rung for time-rung models
+        (``model.time_rungs``): the action axis is sliced to the rung
+        AFTER bucket padding, dispatched at the reduced shape, and the
+        returned values are zero-padded back to the caller's capacity —
+        so unpacking against full-capacity staging masks is unchanged.
+        Safe because every kernel is backward-looking over masked tails
+        and the rung never truncates a valid row
+        (``bucket_window(max n_actions) >= max n_actions``). The sliced
+        ``max_actions`` lands in the compiled-shape key, so each rung is
+        its own pinned program — the time analogue of the game-axis
+        bucket ladder.
         """
         import jax
         import jax.numpy as jnp
 
         host_batch, gs = _pad_to_bucket(host_batch, gs, bucket)
+        orig_A = host_batch.max_actions
+        if time_len is not None and time_len < orig_A:
+            host_batch, gs = _slice_window(host_batch, gs, time_len)
+            if extra_overrides:
+                extra_overrides = {
+                    k: v[:, :time_len] for k, v in extra_overrides.items()
+                }
+            counter('seq/window_slices', unit='count').inc(
+                1, window=str(time_len)
+            )
         key = (bucket, host_batch.max_actions, lane)
         with self._shape_lock:
             new_shape = key not in self._seen_shapes
@@ -998,9 +1048,10 @@ class RatingService:
             gauge('serve/compiled_shapes', unit='shapes').set(n_shapes)
         fault_point('serve.dispatch', bucket=bucket)
         if self.n_replicas > 1 and not extra_overrides:
-            return self._dispatcher_for(model).rate_replica(
+            values = self._dispatcher_for(model).rate_replica(
                 lane, host_batch, gs if self._gs_enabled else None
             )
+            return _pad_values_time(np.asarray(values), orig_A)
         batch = jax.device_put(host_batch)
         overrides: Dict[str, Any] = {}
         if self._gs_enabled and gs is not None:
@@ -1014,7 +1065,7 @@ class RatingService:
         values = model.rate_batch(
             batch, dense_overrides=overrides or None, bucket=False
         )
-        return np.asarray(jax.device_get(values))
+        return _pad_values_time(np.asarray(jax.device_get(values)), orig_A)
 
     def _reference_rate(
         self,
@@ -1048,6 +1099,7 @@ class RatingService:
         model: Any,
         bucket: int,
         lane: int = 0,
+        time_len: Optional[int] = None,
     ) -> Tuple[np.ndarray, str]:
         """One flush's rating through its lane's breaker; (values, path).
 
@@ -1069,7 +1121,9 @@ class RatingService:
         breaker = self._breakers[lane]
         if breaker is None:
             return (
-                self._device_rate(host_batch, gs, model, bucket, lane),
+                self._device_rate(
+                    host_batch, gs, model, bucket, lane, time_len=time_len
+                ),
                 'fused',
             )
         verdict = breaker.allow()
@@ -1079,7 +1133,9 @@ class RatingService:
             )
             return self._reference_rate(host_batch, gs, model), 'fallback'
         try:
-            values = self._device_rate(host_batch, gs, model, bucket, lane)
+            values = self._device_rate(
+                host_batch, gs, model, bucket, lane, time_len=time_len
+            )
         except Exception as e:
             tripped = breaker.record_failure(e)
             if tripped:
@@ -1296,9 +1352,20 @@ class RatingService:
         # overhead is charged to the 'pad' segment, never to 'dispatch'
         # (_device_rate's own pad then no-ops; warmup still relies on it)
         host_batch, gs = _pad_to_bucket(host_batch, gs, bucket)
+        # time-rung models (seq heads) also snap the WINDOW length to a
+        # power-of-two rung: the flush's longest game picks the rung, the
+        # dispatch runs at (bucket, rung), and values come back padded to
+        # full capacity so unpacking below is rung-blind
+        time_len = (
+            bucket_window(
+                int(np.asarray(host_batch.n_actions).max()), self.max_actions
+            )
+            if getattr(model, 'time_rungs', False)
+            else None
+        )
         t_pad = time.perf_counter()
         values, path = self._rate_with_breaker(
-            host_batch, gs, model, bucket, lane
+            host_batch, gs, model, bucket, lane, time_len=time_len
         )
         t_dispatch = time.perf_counter()
         if path == 'fused':
@@ -1789,16 +1856,25 @@ class RatingService:
         if self._aot_tried_for != (name, version):
             self._load_aot_for(name, version, model)
         A = self.max_actions
+        # time-rung models compile one program per (bucket, window rung):
+        # warm the full grid so mixed-length steady-state traffic — short
+        # live windows and whole-match replays alike — retraces nowhere
+        rungs: Tuple[Optional[int], ...] = (
+            window_ladder(A)
+            if getattr(model, 'time_rungs', False)
+            else (None,)
+        )
         with span('serve/warmup', buckets=list(buckets)):
             # every replica warms its own ladder: lanes compile (or
             # preload) independently, so steady-state traffic retraces
             # on NO replica, not just replica 0
             for lane in range(self.n_replicas):
                 for b in buckets:
-                    self._device_rate(
-                        _empty_host_batch(1, A), _empty_gs(1, A), model, b,
-                        lane=lane,
-                    )
+                    for tl in rungs:
+                        self._device_rate(
+                            _empty_host_batch(1, A), _empty_gs(1, A),
+                            model, b, lane=lane, time_len=tl,
+                        )
         return buckets
 
     def close(self, *, drain: bool = True) -> None:
@@ -1889,6 +1965,43 @@ def _pad_to_bucket(
         if gs is not None:
             gs = np.pad(gs, [(0, bucket - gs.shape[0]), (0, 0), (0, 0)])
     return host_batch, gs
+
+
+def _slice_window(
+    host_batch: ActionBatch, gs: Optional[np.ndarray], time_len: int
+) -> Tuple[ActionBatch, Optional[np.ndarray]]:
+    """Slice the action axis of a staging batch to its window rung.
+
+    Per-action ``(G, A)`` fields (and the ``(G, A, 3)`` goalscore block)
+    drop their masked tail beyond ``time_len``; per-game ``(G,)`` fields
+    pass through. Only valid for ``time_len >= n_actions.max()`` — the
+    rung choice (:func:`~socceraction_tpu.core.batch.bucket_window`)
+    guarantees that, so no valid row is ever cut.
+    """
+    import jax
+
+    sliced = jax.tree.map(
+        lambda a: a[:, :time_len] if getattr(a, 'ndim', 0) >= 2 else a,
+        host_batch,
+    )
+    if gs is not None:
+        gs = gs[:, :time_len]
+    return sliced, gs
+
+
+def _pad_values_time(values: np.ndarray, max_actions: int) -> np.ndarray:
+    """Zero-pad a ``(G, a, 3)`` values block back to full action capacity.
+
+    The inverse of :func:`_slice_window` on the output side: rows beyond
+    the dispatched rung are padding by construction (masked in staging),
+    so callers unpack against full-capacity masks without knowing which
+    rung served them.
+    """
+    if values.shape[1] < max_actions:
+        values = np.pad(
+            values, [(0, 0), (0, max_actions - values.shape[1]), (0, 0)]
+        )
+    return values
 
 
 def _empty_host_batch(n_games: int, max_actions: int) -> ActionBatch:

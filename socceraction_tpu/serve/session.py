@@ -53,7 +53,9 @@ WINDOW_LOCAL_KERNELS = frozenset(
 _GS_COLS = ('_gs_team', '_gs_opp')
 
 
-def _goal_flags(type_id: np.ndarray, result_id: np.ndarray):
+def _goal_flags(
+    type_id: np.ndarray, result_id: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
     """Host mirror of ``ops.labels._goal_masks`` (goal, owngoal) per row."""
     shot_like = (
         (type_id == spadlconfig.SHOT)

@@ -41,7 +41,7 @@ def _fix_clearances(actions: pd.DataFrame) -> pd.DataFrame:
     return actions
 
 
-def _fix_direction_of_play(actions: pd.DataFrame, home_team_id) -> pd.DataFrame:
+def _fix_direction_of_play(actions: pd.DataFrame, home_team_id: int) -> pd.DataFrame:
     """Mirror the away team's coordinates so both teams play left-to-right."""
     away = (actions['team_id'] != home_team_id).to_numpy()
     for col, extent in (
@@ -111,7 +111,7 @@ def _add_dribbles(actions: pd.DataFrame) -> pd.DataFrame:
     return actions
 
 
-def _single_event(event) -> pd.DataFrame:
+def _single_event(event: pd.Series | pd.DataFrame) -> pd.DataFrame:
     """Wrap a per-row ``pd.Series`` (the reference's row-wise API) as a frame.
 
     Shared by the Wyscout converters' row-wise ``determine_*`` wrappers.

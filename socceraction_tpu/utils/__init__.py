@@ -16,6 +16,8 @@ by jax-free bootstrap processes (tests/conftest.py, bench.py) that must not
 pay — or depend on — a ``jax`` import.
 """
 
+from typing import Any
+
 from socceraction_tpu.utils.env import cpu_device_env
 
 __all__ = [
@@ -33,7 +35,7 @@ _PROFILING_SYMBOLS = (
 )
 
 
-def __getattr__(name):
+def __getattr__(name: str) -> Any:
     if name in _PROFILING_SYMBOLS:
         from socceraction_tpu.utils import profiling
 

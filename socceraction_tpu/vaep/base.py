@@ -40,6 +40,7 @@ from ..core.batch import (
 )
 from ..ml.learners import LEARNERS
 from ..ml.mlp import MLPClassifier
+from ..seq.classifier import SeqClassifier
 from ..ops import features as _fops
 from ..ops import formula as _formulaops
 from ..ops import labels as _labops
@@ -57,13 +58,15 @@ class NotFittedError(ValueError):
 #: error instead of failing deep inside key access (the model registry,
 #: :mod:`socceraction_tpu.serve.registry`, depends on this contract).
 #: Version 2 adds quantized-serving metadata (``quantize`` mode +
-#: ``models/quant_scales.npz``). ``save_model`` stamps the MINIMUM
-#: version able to read the artifact: an unquantized checkpoint still
-#: stamps 1 (pre-quantization libraries keep loading it unchanged),
-#: while a quantized one stamps 2 so an older loader fails with the
+#: ``models/quant_scales.npz``); version 3 adds the ``'seq'`` head kind
+#: (GRU sequence heads, :mod:`socceraction_tpu.seq`). ``save_model``
+#: stamps the MINIMUM version able to read the artifact: an unquantized
+#: all-MLP checkpoint still stamps 1 (pre-quantization libraries keep
+#: loading it unchanged), a quantized one stamps 2, and a checkpoint
+#: with any seq head stamps 3 — an older loader fails with the
 #: actionable "newer than this library understands — upgrade" error
-#: instead of serving f32 where the publisher validated int8.
-CHECKPOINT_FORMAT_VERSION = 2
+#: instead of crashing on the unknown head kind.
+CHECKPOINT_FORMAT_VERSION = 3
 
 #: Relative path of the persisted int8 quantization scales inside a
 #: quantized ``save_model`` checkpoint — sha256-checksummed in
@@ -166,6 +169,35 @@ def _mlp_hyperparams(clf: MLPClassifier) -> Dict[str, Any]:
     return hyper
 
 
+def _seq_hyperparams(clf: SeqClassifier) -> Dict[str, Any]:
+    """The constructor kwargs reproducing a seq head's architecture.
+
+    The :func:`_mlp_hyperparams` twin for
+    :class:`~socceraction_tpu.seq.classifier.SeqClassifier` warm starts.
+    """
+    return {
+        'embed_dim': clf.embed_dim,
+        'hidden': clf.hidden,
+        'readout': clf.readout,
+        'learning_rate': clf.learning_rate,
+        'batch_size': clf.batch_size,
+        'max_epochs': clf.max_epochs,
+        'patience': clf.patience,
+        'pos_weight': clf.pos_weight,
+        'seed': clf.seed,
+    }
+
+
+#: Per-learner head class + hyperparameter extractor for the packed
+#: warm-start path: a warm head seeds the new fit only when its class
+#: matches the learner's (an MLP cannot seed a GRU), and the inherited
+#: hyperparameters come from the matching extractor.
+_PACKED_HEAD_KINDS: Dict[str, Tuple[type, Any]] = {
+    'mlp': (MLPClassifier, _mlp_hyperparams),
+    'seq': (SeqClassifier, _seq_hyperparams),
+}
+
+
 def _default_learner() -> str:
     try:
         import xgboost  # noqa: F401
@@ -250,7 +282,7 @@ class VAEP:
             names.append(name)
         return tuple(names)
 
-    def _pack(self, game_actions: pd.DataFrame, home_team_id) -> ActionBatch:
+    def _pack(self, game_actions: pd.DataFrame, home_team_id: int) -> ActionBatch:
         batch, _ = pack_actions(game_actions, home_team_id=home_team_id)
         return batch
 
@@ -399,9 +431,12 @@ class VAEP:
             stored season straight into training.
         learner : str
             A packed-capable learner
-            (:data:`socceraction_tpu.ml.learners.PACKED_LEARNERS`;
-            currently ``'mlp'``). Tree learners need the materialized
-            matrix — compute features and use :meth:`fit` for those.
+            (:data:`socceraction_tpu.ml.learners.PACKED_LEARNERS`):
+            ``'mlp'`` (the fused per-state MLP) or ``'seq'`` (the GRU
+            sequence head over the k-action window,
+            :mod:`socceraction_tpu.seq` — defensive / off-ball value).
+            Tree learners need the materialized matrix — compute
+            features and use :meth:`fit` for those.
         val_size : float
             Row fraction held out for early stopping (reference: 0.25).
         tree_params, fit_params : dict, optional
@@ -411,19 +446,22 @@ class VAEP:
             Seed for the train/validation row split; defaults to the
             global numpy RNG like :meth:`fit`.
         warm_start : VAEP, optional
-            A fitted model (same feature layout) whose MLP heads seed
-            this fit: each head trains from the existing parameters (and
-            in-process adam state, when available) instead of a fresh
-            random init — the incremental-retrain entry of the
-            continuous-learning loop (:mod:`socceraction_tpu.learn`).
-            Unless ``tree_params`` overrides them, each head also
-            inherits the warm model's hyperparameters so the
-            architecture cannot silently diverge, and the warm model's
-            standardization statistics are reused — the copied weights
-            are a function of that scaling; recomputing stats over the
-            grown season would perturb the continuation. The warm model
-            itself is never mutated (parameters are copied before
-            training).
+            A fitted model (same feature layout) whose heads seed this
+            fit: each head whose class matches the requested learner
+            trains from the existing parameters (and in-process adam
+            state, when available) instead of a fresh random init — the
+            incremental-retrain entry of the continuous-learning loop
+            (:mod:`socceraction_tpu.learn`). Unless ``tree_params``
+            overrides them, each matching head also inherits the warm
+            model's hyperparameters so the architecture cannot silently
+            diverge, and the warm model's standardization statistics are
+            reused — the copied weights are a function of that scaling;
+            recomputing stats over the grown season would perturb the
+            continuation. A cross-architecture warm start (an MLP model
+            seeding ``learner='seq'``, or vice versa) falls back to a
+            cold fit with fresh statistics — parameters of one
+            architecture cannot seed the other. The warm model itself
+            is never mutated (parameters are copied before training).
         """
         from ..ml.learners import PACKED_LEARNERS
         from ..ops.fused import (
@@ -503,13 +541,19 @@ class VAEP:
         # over the grown season would apply them to perturbed inputs,
         # breaking the continuation. A cold fit computes one stats pass
         # over the training rows, shared by both heads (fit() computes
-        # them per head from the same X_train — identical).
+        # them per head from the same X_train — identical). Stat reuse is
+        # class-matched like the parameter inheritance below: a
+        # cross-architecture warm start copies no weights, so it gets
+        # fresh stats over the current training rows instead.
+        head_cls, head_hyper = _PACKED_HEAD_KINDS.get(
+            learner, (MLPClassifier, _mlp_hyperparams)
+        )
         mean = std = None
         if warm_models:
             warm_head = next(
                 (
                     m for m in warm_models.values()
-                    if isinstance(m, MLPClassifier) and m.mean_ is not None
+                    if isinstance(m, head_cls) and m.mean_ is not None
                 ),
                 None,
             )
@@ -538,11 +582,11 @@ class VAEP:
                     ]
                 head_tree, head_fit = tree_params, fit_params
                 warm = warm_models.get(col) if warm_models else None
-                if isinstance(warm, MLPClassifier) and warm.params is not None:
+                if isinstance(warm, head_cls) and warm.params is not None:
                     # inherit the warm head's architecture (overridable
                     # schedule knobs) so the copied parameters are
                     # guaranteed to fit the head they seed
-                    head_tree = {**_mlp_hyperparams(warm), **(tree_params or {})}
+                    head_tree = {**head_hyper(warm), **(tree_params or {})}
                     head_fit = dict(head_fit or {})
                     head_fit.setdefault('init_params', warm.params)
                     if warm.opt_state_ is not None:
@@ -556,7 +600,7 @@ class VAEP:
         return self
 
     @staticmethod
-    def _iter_packed(batches: Any):
+    def _iter_packed(batches: Any) -> Any:
         """Normalize ``fit_packed`` inputs to an iterator of batch items."""
         if hasattr(batches, 'mask') and hasattr(batches, 'type_id'):
             return iter([batches])
@@ -578,15 +622,55 @@ class VAEP:
             Y_hat[col] = self._models[col].predict_proba(X[cols])[:, 1]
         return Y_hat
 
-    def _estimate_probabilities_batch(self, feats) -> Dict[str, Any]:
-        """Per-label probability tensors ``(G, A)`` from the feature tensor."""
+    def _estimate_probabilities_batch(
+        self,
+        feats: Any,
+        batch: Optional[ActionBatch] = None,
+        dense_overrides: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Per-label probability tensors ``(G, A)``, head-kind dispatched.
+
+        MLP heads consume the materialized feature tensor ``feats``
+        (which may be ``None`` when no head needs it); tree heads a host
+        copy of it; seq heads the *packed* representation rebuilt from
+        ``batch`` (they model the window as an ordered sequence — the
+        materialized per-state columns cannot feed them), with
+        ``dense_overrides`` substituted into the packed dense columns so
+        both representations see the same override semantics.
+        """
         import jax.numpy as jnp
 
         probs = {}
         flat = None  # host copy built lazily, shared by all tree models
+        seq_pack = None  # packed (states, layout), shared by all seq heads
         for col, model in self._models.items():
             if isinstance(model, MLPClassifier):
                 probs[col] = model.predict_proba_device(feats)
+            elif isinstance(model, SeqClassifier):
+                if batch is None:
+                    raise ValueError(
+                        'sequence heads rate from the packed batch; this '
+                        'call path only materialized features (pass the '
+                        'ActionBatch through)'
+                    )
+                if seq_pack is None:
+                    from ..ops.fused import build_train_states
+
+                    states, layout = build_train_states(
+                        batch,
+                        names=self._kernel_names(),
+                        k=self.nb_prev_actions,
+                        registry_name=self._fused_registry,
+                    )
+                    if dense_overrides:
+                        states = self._apply_packed_overrides(
+                            states, layout, dense_overrides
+                        )
+                    seq_pack = (states, layout)
+                G, A = batch.type_id.shape
+                probs[col] = model.predict_proba_states(
+                    seq_pack[0], seq_pack[1]
+                ).reshape(G, A)
             else:
                 if flat is None:
                     flat = pd.DataFrame(
@@ -598,6 +682,31 @@ class VAEP:
                     p.reshape(feats.shape[:-1]).astype(np.float32)
                 )
         return probs
+
+    @staticmethod
+    def _apply_packed_overrides(
+        states: Any, layout: Any, dense_overrides: Dict[str, Any]
+    ) -> Any:
+        """Substitute override blocks into packed dense columns.
+
+        The packed twin of :meth:`_apply_dense_overrides`: a
+        ``(G, A, width)`` override replaces its kernel's columns of
+        ``x_dense`` at the dense-local layout offset, so the seq
+        reference path is the same function of the same overrides as
+        the serving dispatch.
+        """
+        x = states.x_dense
+        dense_off = 0
+        for name, kind, _off, width in layout.spans:
+            if kind != 'dense':
+                continue
+            block = dense_overrides.get(name)
+            if block is not None:
+                x = x.at[:, dense_off : dense_off + width].set(
+                    jnp.asarray(block, x.dtype).reshape(-1, width)
+                )
+            dense_off += width
+        return states._replace(x_dense=x)
 
     def rate(
         self,
@@ -637,6 +746,32 @@ class VAEP:
             and self._fused_registry is not None
             and all(isinstance(m, MLPClassifier) for m in self._models.values())
         )
+
+    def _can_seq(self) -> bool:
+        """True when the one-dispatch seq pair path applies: every label
+        head is a GRU sequence head and the feature family has a fused
+        layout (the seq head embeds through the combined-id machinery)."""
+        return (
+            bool(self._models)
+            and self._fused_registry is not None
+            and all(isinstance(m, SeqClassifier) for m in self._models.values())
+        )
+
+    @property
+    def time_rungs(self) -> bool:
+        """True when serving should bucket the action (time) axis too.
+
+        Sequence heads compose window context action-by-action, so the
+        serving layer slices a mostly-empty action axis down to its
+        power-of-two window rung
+        (:func:`~socceraction_tpu.core.batch.bucket_window`) before
+        dispatch — every kernel in the rated pipeline is backward-looking
+        over masked tails, so the slice is bitwise-invariant. MLP models
+        keep the fixed full-capacity action axis (their compiled-shape
+        set is pinned by existing serving tests and gains nothing from
+        time rungs).
+        """
+        return self._can_seq()
 
     # -- quantized serving fold --------------------------------------------
 
@@ -722,7 +857,7 @@ class VAEP:
             self._quant_scales = None
         return self
 
-    def _prepared_pair(self):
+    def _prepared_pair(self) -> Any:
         """The cached serving fold, or ``None`` when the bit-pinned
         legacy dispatch serves this configuration.
 
@@ -844,7 +979,7 @@ class VAEP:
         return cached[1]
 
     def _validate_dense_overrides(
-        self, batch: ActionBatch, dense_overrides
+        self, batch: ActionBatch, dense_overrides: Optional[Dict[str, Any]]
     ) -> None:
         """Fail fast — by name, before any padding or dispatch.
 
@@ -875,7 +1010,7 @@ class VAEP:
                 )
 
     def _apply_dense_overrides(
-        self, batch: ActionBatch, feats: jax.Array, dense_overrides
+        self, batch: ActionBatch, feats: jax.Array, dense_overrides: Dict[str, Any]
     ) -> jax.Array:
         """Substitute precomputed blocks into a materialized feature tensor.
 
@@ -961,7 +1096,8 @@ class VAEP:
         from ..ops.profile import FUSED_PATH_HIDDEN_DTYPES, hidden_dtype_for
 
         fused = self._can_fuse() and path in FUSED_PATH_HIDDEN_DTYPES
-        selected = path if fused else 'materialized'
+        seq = not fused and self._can_seq()
+        selected = path if fused else ('seq' if seq else 'materialized')
         labels = {'path': selected, 'platform': jax.default_backend()}
         n_games = batch.n_games
         t0 = time.perf_counter()
@@ -999,13 +1135,42 @@ class VAEP:
                     prepared=self._prepared_pair(),
                 )
                 probs = dict(zip(cols, pair))
+            elif seq:
+                from ..seq.model import seq_pair_probs
+
+                # the seq analog of the fused pair dispatch: both GRU
+                # heads in one jitted call, sharing the dense kernels
+                # and the combined-id gathers
+                cols = list(self._label_columns)
+                pair = seq_pair_probs(
+                    self._models[cols[0]],
+                    self._models[cols[1]],
+                    batch,
+                    names=self._kernel_names(),
+                    k=self.nb_prev_actions,
+                    registry_name=self._fused_registry,
+                    dense_overrides=dense_overrides,
+                )
+                probs = dict(zip(cols, pair))
             else:
-                feats = self.compute_features_batch(batch)
-                if dense_overrides:
+                # mixed / tree / MLP-without-fusion configurations: seq
+                # heads (if any) rate from the packed form inside
+                # _estimate_probabilities_batch; the feature tensor is
+                # only materialized when some head actually consumes it
+                need_feats = any(
+                    not isinstance(m, SeqClassifier)
+                    for m in self._models.values()
+                )
+                feats = (
+                    self.compute_features_batch(batch) if need_feats else None
+                )
+                if feats is not None and dense_overrides:
                     feats = self._apply_dense_overrides(
                         batch, feats, dense_overrides
                     )
-                probs = self._estimate_probabilities_batch(feats)
+                probs = self._estimate_probabilities_batch(
+                    feats, batch=batch, dense_overrides=dense_overrides
+                )
             values = self._formula_kernel(
                 batch,
                 probs[self._label_columns[0]],
@@ -1028,6 +1193,13 @@ class VAEP:
             gauge('vaep/rate_actions_per_sec', unit='actions/s').set(
                 n_actions / dispatch_s, **labels
             )
+        if seq:
+            counter('seq/rated_actions', unit='actions').inc(
+                n_actions, platform=labels['platform']
+            )
+            histogram('seq/rate_seconds', unit='s').observe(
+                dispatch_s, platform=labels['platform']
+            )
         return values
 
     def rate_batch_reference(
@@ -1039,9 +1211,11 @@ class VAEP:
         """Materialized-path rating of a batch — the numerics parity oracle.
 
         The same function of the same parameters as :meth:`rate_batch`,
-        always computed through the materialized feature tensor
-        regardless of the platform profile's path choice — no
-        bucketing, no telemetry, no path selection. This is what the
+        always computed through the per-head reference representation
+        (the materialized feature tensor for MLP/tree heads, a fresh
+        packed build for seq heads) regardless of the platform profile's
+        path choice — no bucketing, no telemetry, no path selection,
+        no pair-fused dispatch. This is what the
         sampled shadow-parity probe
         (:class:`socceraction_tpu.obs.parity.ParityProbe`) re-rates
         served flushes through off the flusher thread; values on
@@ -1050,10 +1224,15 @@ class VAEP:
         if not self._models:
             raise NotFittedError('fit the model before calling rate')
         self._validate_dense_overrides(batch, dense_overrides)
-        feats = self.compute_features_batch(batch)
-        if dense_overrides:
+        need_feats = any(
+            not isinstance(m, SeqClassifier) for m in self._models.values()
+        )
+        feats = self.compute_features_batch(batch) if need_feats else None
+        if feats is not None and dense_overrides:
             feats = self._apply_dense_overrides(batch, feats, dense_overrides)
-        probs = self._estimate_probabilities_batch(feats)
+        probs = self._estimate_probabilities_batch(
+            feats, batch=batch, dense_overrides=dense_overrides
+        )
         return self._formula_kernel(
             batch,
             probs[self._label_columns[0]],
@@ -1108,6 +1287,10 @@ class VAEP:
                 heads[col] = 'mlp'
                 model.save(os.path.join(path, 'models', f'{col}.npz'))
                 artifacts.append(f'models/{col}.npz')
+            elif isinstance(model, SeqClassifier):
+                heads[col] = 'seq'
+                model.save(os.path.join(path, 'models', f'{col}.npz'))
+                artifacts.append(f'models/{col}.npz')
             else:
                 heads[col] = 'pickle'
                 with open(os.path.join(path, 'models', f'{col}.pkl'), 'wb') as f:
@@ -1135,11 +1318,16 @@ class VAEP:
             artifacts.append(_QUANT_SCALES_ARTIFACT)
         meta = {
             # the stamp is the MINIMUM reader version (see
-            # CHECKPOINT_FORMAT_VERSION): quantized checkpoints need a
-            # v2-aware loader (the LITERAL 2 — future format bumps must
-            # not inflate the floor of a feature v2 can read); everything
-            # else stays loadable by v1
-            'format_version': 2 if quantize != 'none' else 1,
+            # CHECKPOINT_FORMAT_VERSION): seq heads need a v3-aware
+            # loader, quantized checkpoints a v2-aware one (the LITERAL
+            # versions that introduced each feature — future format
+            # bumps must not inflate the floor older readers can
+            # handle); everything else stays loadable by v1
+            'format_version': (
+                3 if 'seq' in heads.values()
+                else 2 if quantize != 'none'
+                else 1
+            ),
             'class': type(self).__name__,
             'nb_prev_actions': self.nb_prev_actions,
             'backend': self.backend,
@@ -1177,6 +1365,10 @@ class VAEP:
         for col, kind in meta['heads'].items():
             if kind == 'mlp':
                 model._models[col] = MLPClassifier.load(
+                    os.path.join(path, 'models', f'{col}.npz')
+                )
+            elif kind == 'seq':
+                model._models[col] = SeqClassifier.load(
                     os.path.join(path, 'models', f'{col}.npz')
                 )
             else:

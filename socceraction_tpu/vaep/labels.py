@@ -15,7 +15,7 @@ from ..config import LABEL_LOOKAHEAD
 from ..spadl import config as spadlconfig
 
 
-def _goal_masks(actions: pd.DataFrame):
+def _goal_masks(actions: pd.DataFrame) -> tuple[np.ndarray, np.ndarray]:
     shot_like = actions['type_name'].str.contains('shot').to_numpy()
     goal = shot_like & (actions['result_id'] == spadlconfig.SUCCESS).to_numpy()
     owngoal = shot_like & (actions['result_id'] == spadlconfig.OWNGOAL).to_numpy()

@@ -48,7 +48,13 @@ _LEAKY = re.compile(r'^type_[a-z_]+_a0$')
 _LEAKY_EXACT = frozenset({'dx_a0', 'dy_a0', 'movement_a0'})
 
 
-def _fit_logistic(X, y, eval_set=None, tree_params=None, fit_params=None):
+def _fit_logistic(
+    X: Any,
+    y: Any,
+    eval_set: Any = None,
+    tree_params: Optional[Dict[str, Any]] = None,
+    fit_params: Optional[Dict[str, Any]] = None,
+) -> Any:
     """The notebook's first model: logistic regression.
 
     Standardization is added for solver conditioning (the notebook fits
@@ -103,7 +109,9 @@ class XGModel:
     # features / labels
     # ------------------------------------------------------------------
 
-    def _shot_states(self, game, game_actions: pd.DataFrame):
+    def _shot_states(
+        self, game: Any, game_actions: pd.DataFrame
+    ) -> tuple[pd.DataFrame, Any, np.ndarray]:
         # gamestates' shifted views assume a RangeIndex; normalize so
         # filtered/sliced caller frames don't misalign the axis=1 concat
         actions = spadlutils.add_names(game_actions.reset_index(drop=True))
@@ -113,7 +121,7 @@ class XGModel:
         shots = actions['type_id'].isin(spadlconfig.SHOT_LIKE).to_numpy()
         return actions, states, shots
 
-    def _shot_features(self, states, shots) -> pd.DataFrame:
+    def _shot_features(self, states: Any, shots: np.ndarray) -> pd.DataFrame:
         feats = pd.concat([fn(states) for fn in self.xfns], axis=1)
         return feats.loc[shots, self._feature_names]
 

@@ -383,7 +383,7 @@ class ExpectedThreat:
 
     # -- fitting -----------------------------------------------------------
 
-    def _value_iteration(self, sweep) -> None:
+    def _value_iteration(self, sweep: Callable[[np.ndarray], np.ndarray]) -> None:
         """Iterate ``xT <- sweep(xT)`` to convergence (shared host loop)."""
         xT = np.zeros((self.w, self.l))
         if self.keep_heatmaps:
@@ -515,7 +515,7 @@ class ExpectedThreat:
             )
             self._take_solution(sol)
 
-    def _group_codes(self, actions: pd.DataFrame, group_by) -> tuple:
+    def _group_codes(self, actions: pd.DataFrame, group_by: Any) -> tuple:
         """``(codes, keys)`` for a grouped fit/rate: per-row int codes into
         the sorted unique key array (``-1`` for null keys)."""
         if isinstance(group_by, str):
@@ -537,7 +537,7 @@ class ExpectedThreat:
         actions: pd.DataFrame,
         codes: np.ndarray,
         keys: np.ndarray,
-        group_by,
+        group_by: Any,
         variant: str,
     ) -> None:
         """One dispatch for the whole keyed surface fleet (see ``fit``)."""
@@ -581,7 +581,9 @@ class ExpectedThreat:
         finally:
             claim.release()
 
-    def _adopt_fleet(self, sol, probs, keys: np.ndarray, group_by) -> None:
+    def _adopt_fleet(
+        self, sol: Any, probs: Any, keys: np.ndarray, group_by: Any
+    ) -> None:
         """Convert one fleet solve's device stacks into host model state."""
         self.transition_matrices_ = (
             np.asarray(probs.transition, np.float64)
@@ -804,7 +806,7 @@ class ExpectedThreat:
         return fine[::-1]
 
     def _rate_grouped(
-        self, actions: pd.DataFrame, use_interpolation: bool, group_by
+        self, actions: pd.DataFrame, use_interpolation: bool, group_by: Any
     ) -> np.ndarray:
         """Batched rating against the fitted surface fleet.
 

@@ -186,8 +186,17 @@ def _package_problems(tmp_path, source):
 
 def test_untyped_public_def_flagged_in_package(tmp_path):
     probs = _package_problems(tmp_path, 'def f(x):\n    return x\n')
-    assert len(probs) == 1 and 'untyped public def f()' in probs[0]
+    assert len(probs) == 1 and 'untyped def f()' in probs[0]
     assert 'x, return' in probs[0]
+
+
+def test_untyped_private_def_flagged_in_package(tmp_path):
+    # the package ships py.typed, so private defs carry annotations too
+    # ([tool.mypy] disallow_untyped_defs; this rule is its stand-in when
+    # mypy is absent from the image)
+    probs = _package_problems(tmp_path, 'def _private(z):\n    return z\n')
+    assert len(probs) == 1 and 'untyped def _private()' in probs[0]
+    assert 'z, return' in probs[0]
 
 
 def test_untyped_def_exemptions(tmp_path):
@@ -198,8 +207,6 @@ def test_untyped_def_exemptions(tmp_path):
         '        def nested(y):\n'               # nested exempt
         '            return y\n'
         '        return nested(x)\n'
-        'def _private(z):\n'                     # _private exempt
-        '    return z\n'
         'def g(*args, **kwargs) -> None:\n'      # varargs exempt
         '    pass\n',
     )

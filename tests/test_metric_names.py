@@ -193,6 +193,23 @@ def test_scenario_area_and_labels_are_registered():
     ] == [1, 2, 4, 64, 128, 4096, 4096]
 
 
+def test_seq_area_and_labels_are_registered():
+    """The sequence head's metric area (``seq/*``: fit/rate telemetry +
+    the serving window-rung counter) and its label contract are governed
+    by the lint gate from day one (ISSUE 19 satellite): ``window``
+    follows the same power-of-two cardinality law as ``serve``'s bucket
+    ladder — the rung helper must emit exactly the ladder values."""
+    tool = _tool()
+    assert 'seq' in tool.KNOWN_AREAS
+    assert tool.KNOWN_LABELS['seq'] == {'platform', 'window'}
+    from socceraction_tpu.core.batch import bucket_window, window_ladder
+
+    assert window_ladder(512) == (128, 256, 512)
+    assert [
+        bucket_window(n, 512) for n in (0, 1, 128, 129, 256, 257, 512)
+    ] == [128, 128, 128, 256, 256, 512, 512]
+
+
 def test_gate_reports_all_violations_per_site(tmp_path):
     """One site breaking several rules surfaces every violation in one
     run — not one per fix-and-rerun cycle (ISSUE 8 satellite)."""

@@ -1,0 +1,316 @@
+"""Tests for the GRU sequence-model head (socceraction_tpu.seq).
+
+Covers the sequence-valuation contract: one-dispatch-per-epoch training
+through ``fit_packed(learner='seq')`` (the per-head epoch trace counter
+pinned to 1), time-padding invariance (a window packed at a wider action
+axis rates bitwise identically on CPU), window-rung serving through the
+:class:`RatingService` ladder with zero steady-state retraces under
+mixed window lengths, session single-action-tick streaming equal to the
+full-window replay bit-for-bit, the seq head's own checkpoint format
+version (and the VAEP checkpoint's minimum-reader stamp of 3), the
+``seq/*`` metric surface, and the continuous-learning loop driving a
+seq candidate through the same promotion gates as an MLP one — with the
+per-head architecture visible in the promotion report and ``obsctl``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from socceraction_tpu.core.batch import (
+    bucket_window,
+    pack_actions,
+    unpack_values,
+    window_ladder,
+)
+from socceraction_tpu.core.synthetic import (
+    append_synthetic_games,
+    synthetic_actions_frame,
+    synthetic_batch,
+    write_synthetic_season,
+)
+from socceraction_tpu.obs import REGISTRY
+from socceraction_tpu.seq import SEQ_FORMAT_VERSION, SeqClassifier
+from socceraction_tpu.serve import ModelRegistry, RatingService, TrafficCapture
+from socceraction_tpu.vaep.base import VAEP
+
+HOME = 100
+MAX_ACTIONS = 512
+
+SEQ_PARAMS = {
+    'max_epochs': 3,
+    'embed_dim': 8,
+    'hidden': 16,
+    'readout': 16,
+    'batch_size': 512,
+}
+
+
+@pytest.fixture(scope='module')
+def seq_model():
+    """A VAEP whose both heads are GRU sequence heads."""
+    batch = synthetic_batch(n_games=6, n_actions=256, seed=900)
+    model = VAEP(nb_prev_actions=3)
+    model.fit_packed(batch, learner='seq', tree_params=dict(SEQ_PARAMS))
+    return model
+
+
+def _reference(model, frame, max_actions=MAX_ACTIONS):
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=max_actions)
+    return unpack_values(model.rate_batch(batch, bucket=False), batch)
+
+
+# ------------------------------------------------------------- training ----
+
+
+def test_seq_epoch_training_is_one_dispatch(seq_model):
+    """max_epochs=3 trained through ONE compiled epoch scan per head."""
+    assert set(seq_model._models) == {'scores', 'concedes'}
+    for clf in seq_model._models.values():
+        assert isinstance(clf, SeqClassifier)
+        assert clf.n_epoch_traces_ == 1
+        assert clf.train_health_ is not None
+
+
+def test_seq_fit_and_rate_metrics_recorded(seq_model):
+    import jax
+
+    platform = jax.default_backend()
+    snap = REGISTRY.snapshot()
+    assert snap.value('seq/fits', platform=platform) >= 2  # both heads
+    assert snap.value('seq/fit_seconds', stat='count', platform=platform) >= 2
+    # rating through the seq path records the seq rate surface
+    frame = synthetic_actions_frame(game_id=3, seed=3, n_actions=64)
+    _reference(seq_model, frame, max_actions=128)
+    snap = REGISTRY.snapshot()
+    assert snap.value('seq/rated_actions', platform=platform) > 0
+
+
+def test_seq_probabilities_are_probabilities(seq_model):
+    frame = synthetic_actions_frame(game_id=4, seed=4, n_actions=120)
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=128)
+    values = np.asarray(seq_model.rate_batch(batch, bucket=False))
+    assert np.isfinite(values[np.asarray(batch.mask, bool)]).all()
+
+
+# ------------------------------------------------- time-padding parity ----
+
+
+def test_time_padded_window_matches_unpadded(seq_model):
+    """The kernels are backward-looking over masked tails: packing the
+    same game at a 4x wider action axis changes NOTHING, bitwise."""
+    frame = synthetic_actions_frame(game_id=1, seed=1, n_actions=100)
+    wide = _reference(seq_model, frame, max_actions=512)
+    narrow = _reference(seq_model, frame, max_actions=128)
+    np.testing.assert_array_equal(np.asarray(wide), np.asarray(narrow))
+
+
+def test_window_rung_helpers():
+    assert window_ladder(512) == (128, 256, 512)
+    assert [bucket_window(n, 512) for n in (0, 1, 128, 129, 512)] == [
+        128, 128, 128, 256, 512,
+    ]
+    # rungs never exceed the service capacity, even off powers of two
+    assert bucket_window(200, 192) == 192
+
+
+def test_seq_model_opts_into_time_rungs(seq_model):
+    assert seq_model.time_rungs is True
+    assert VAEP().time_rungs is False  # unfitted / non-seq: full-A serving
+
+
+# ------------------------------------------------------ rung serving -------
+
+
+def test_seq_mixed_windows_zero_steady_state_retraces(seq_model):
+    """Warmup compiles the (bucket x window-rung) grid; mixed traffic then
+    adds nothing, and every served frame is bitwise the direct
+    ``rate_batch`` reference."""
+    with RatingService(
+        seq_model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        svc.warmup()
+        shapes = svc.compiled_shapes
+        sliced_before = REGISTRY.snapshot().value(
+            'seq/window_slices', stat='count', window='128'
+        )
+        for i, n in enumerate((40, 120, 300, 500, 60, 200)):
+            frame = synthetic_actions_frame(
+                game_id=60 + i, seed=60 + i, n_actions=n
+            )
+            out = svc.rate_sync(frame, home_team_id=HOME, timeout=120)
+            np.testing.assert_array_equal(
+                out.to_numpy(), _reference(seq_model, frame)
+            )
+        assert svc.compiled_shapes == shapes
+    # short frames were genuinely served at the 128 rung (not full-A)
+    after = REGISTRY.snapshot().value(
+        'seq/window_slices', stat='count', window='128'
+    )
+    assert after > sliced_before
+
+
+def test_seq_session_single_action_ticks_bitwise(seq_model):
+    """The live-match extreme through the seq head: one action per tick,
+    bitwise equal to rating the full window at once."""
+    frame = synthetic_actions_frame(game_id=11, seed=11, n_actions=60)
+    with RatingService(
+        seq_model, max_actions=MAX_ACTIONS, max_batch_size=4, max_wait_ms=1.0
+    ) as svc:
+        sess = svc.open_session('seq-live', home_team_id=HOME)
+        for i in range(len(frame)):
+            sess.add_actions(frame.iloc[i : i + 1], timeout=60)
+        assert sess.n_actions == len(frame)
+        inc = sess.ratings()
+    np.testing.assert_array_equal(inc.to_numpy(), _reference(seq_model, frame))
+
+
+# ---------------------------------------------------------- checkpoints ----
+
+
+def test_seq_head_format_version_roundtrip(tmp_path, seq_model):
+    import jax
+
+    clf = seq_model._models['scores']
+    path = str(tmp_path / 'head.npz')
+    clf.save(path)
+    with np.load(path) as data:
+        assert int(data['format_version']) == SEQ_FORMAT_VERSION
+    loaded = SeqClassifier.load(path)
+    for a, b in zip(jax.tree.leaves(clf.params), jax.tree.leaves(loaded.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # forge a FUTURE artifact: the loader must reject it up front
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files}
+    arrays['format_version'] = np.array(SEQ_FORMAT_VERSION + 1)
+    future = str(tmp_path / 'future.npz')
+    with open(future, 'wb') as f:
+        np.savez(f, **arrays)
+    with pytest.raises(ValueError, match='format_version'):
+        SeqClassifier.load(future)
+
+
+def test_vaep_seq_checkpoint_stamps_v3(tmp_path, seq_model):
+    from socceraction_tpu.vaep.base import CHECKPOINT_FORMAT_VERSION, load_model
+
+    path = str(tmp_path / 'ckpt')
+    seq_model.save_model(path)
+    meta_path = os.path.join(path, 'meta.json')
+    with open(meta_path) as f:
+        meta = json.load(f)
+    # minimum-reader stamp: seq heads need a v3-aware loader (an MLP
+    # checkpoint keeps stamping 1/2 — tests/test_serve.py pins that)
+    assert meta['format_version'] == 3
+
+    loaded = load_model(path)
+    frame = synthetic_actions_frame(game_id=5, seed=5, n_actions=80)
+    batch, _ = pack_actions(frame, home_team_id=HOME, max_actions=128)
+    np.testing.assert_array_equal(
+        np.asarray(seq_model.rate_batch(batch, bucket=False)),
+        np.asarray(loaded.rate_batch(batch, bucket=False)),
+    )
+
+    meta['format_version'] = CHECKPOINT_FORMAT_VERSION + 1
+    with open(meta_path, 'w') as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match='format_version'):
+        load_model(path)
+
+
+# ---------------------------------------------------- learn-loop gates -----
+
+
+def test_seq_candidate_through_promotion_gates(tmp_path):
+    """A seq candidate rides the FULL loop — ingest, warm-started seq
+    fit, shadow replay, calibration gate, publish/reject — through the
+    same machinery as an MLP candidate, with the per-head architecture
+    on the promotion report."""
+    from socceraction_tpu.learn import ContinuousLearner, GateConfig, LearnConfig
+    from socceraction_tpu.pipeline.store import SeasonStore
+
+    A = 192
+    store_path = str(tmp_path / 'season')
+    write_synthetic_season(store_path, n_games=4, n_actions=A, seed=0)
+    registry = ModelRegistry(str(tmp_path / 'registry'))
+    cfg = LearnConfig(
+        model_name='vaep', max_actions=A, games_per_batch=4, random_state=0,
+        learner='seq',
+        gate=GateConfig(n_boot=16),
+        train_params={**SEQ_PARAMS, 'max_epochs': 4},
+    )
+    with SeasonStore(store_path, mode='a') as store:
+        # ---- bootstrap: the first seq model version is promoted
+        r1 = ContinuousLearner(store, registry, config=cfg).run_once()
+        assert r1.verdict == 'promoted' and r1.candidate_version == '1'
+        assert r1.archs == {'scores': 'seq', 'concedes': 'seq'}
+        assert registry.active()[:2] == ('vaep', '1')
+
+        capture = TrafficCapture(max_frames=16)
+        with RatingService(
+            registry=registry, max_actions=A, max_batch_size=4,
+            max_wait_ms=1.0, capture=capture,
+        ) as svc:
+            svc.warmup()
+            req = synthetic_actions_frame(game_id=70, seed=70, n_actions=120)
+            svc.rate_sync(req, home_team_id=HOME, timeout=120)
+            assert len(capture) == 1
+
+            learner = ContinuousLearner(
+                store, registry, service=svc, config=cfg
+            )
+            noop = learner.run_once()
+            assert noop.verdict == 'no_new_data'
+            assert noop.archs == {'scores': 'seq', 'concedes': 'seq'}
+
+            append_synthetic_games(store_path, 2, n_actions=A, seed=77)
+            r2 = learner.run_once()
+            # the gate RAN (promote or fail-closed reject — both are the
+            # gate doing its job; an exception is neither)
+            assert r2.verdict in ('promoted', 'rejected')
+            assert r2.archs == {'scores': 'seq', 'concedes': 'seq'}
+            assert r2.replay['source'] == 'capture'
+            assert r2.stage_seconds.keys() >= {
+                'ingest', 'train', 'shadow', 'gate',
+            }
+            if r2.verdict == 'promoted':
+                assert registry.active()[:2] == ('vaep', '2')
+            else:
+                assert r2.reasons
+                assert registry.active()[:2] == ('vaep', '1')
+            for col in ('scores', 'concedes'):
+                assert 'delta_ece' in r2.heads[col]
+
+    # the report (and its to_dict wire form) carries the archs map
+    assert r2.to_dict()['archs'] == {'scores': 'seq', 'concedes': 'seq'}
+
+
+def test_obsctl_promotion_renders_head_archs():
+    """``obsctl promotions`` labels each head verdict with its
+    architecture, so mixed mlp/seq reports read unambiguously."""
+    import tools.obsctl as obsctl
+
+    event = {
+        'verdict': 'promoted', 'name': 'vaep', 'candidate_version': '2',
+        'ts': 0.0,
+        'heads': {
+            'scores': {
+                'candidate': {'ece': 0.01, 'brier': 0.1},
+                'baseline': {'ece': 0.02, 'brier': 0.11},
+                'delta_ece': -0.01,
+            },
+        },
+        'archs': {'scores': 'seq', 'concedes': 'mlp'},
+    }
+    text = obsctl._fmt_promotion(event)
+    assert 'scores [seq]' in text
+    # heads without a gate entry still surface their architecture
+    bare = obsctl._fmt_promotion(
+        {'verdict': 'rejected', 'name': 'vaep', 'ts': 0.0,
+         'archs': {'scores': 'seq', 'concedes': 'seq'}}
+    )
+    assert 'archs' in bare and 'scores=seq' in bare

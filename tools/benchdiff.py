@@ -63,6 +63,10 @@ HEADLINE_KEYS: Tuple[str, ...] = (
     # second in one folded dispatch (bench.py --cf-smoke; its `value`
     # duplicates this key)
     'cf_values_per_sec',
+    # the sequence head's serving headline: actions rated through the
+    # window-rung ladder per second (bench.py --seq-smoke; its `value`
+    # duplicates this key)
+    'seq_actions_per_sec',
 )
 
 #: Artifact metrics whose headline ``value`` is a WALL or a SIZE, not a

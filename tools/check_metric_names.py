@@ -73,6 +73,7 @@ KNOWN_AREAS = {
     'pipeline',  # store/feed/cache stage timings
     'resil',  # fault injection / retries / breaker / recovery (resil/)
     'scenario',  # counterfactual engine: one-dispatch grid valuation (scenario/)
+    'seq',  # sequence-model head: GRU fit/rate/window telemetry (seq/)
     'serve',  # online rating service (batcher/session/registry/service)
     'slo',  # SLO engine: burn rates, budgets, sheds (obs/slo.py)
     'train',  # MLP fit loop + bench training configs
@@ -160,6 +161,10 @@ KNOWN_LABELS = {
     'pipeline': {'stage'},
     'resil': {'point', 'kind', 'site', 'outcome'},
     'scenario': {'verb', 'n_perturbations_bucket'},
+    # seq: ``window`` values are the power-of-two window-length rungs
+    # (``core.batch.window_ladder`` — O(log2(max_actions/128)) values by
+    # construction, the time analogue of serve's ``bucket``).
+    'seq': {'platform', 'window'},
     # serve: ``outcome`` is the AOT-tier load verdict (hit|stale|miss,
     # serve/aot_loads — serve/aot.py's three-valued contract).
     # ``replica`` values are lane ids minted through the same bounded

@@ -13,13 +13,16 @@ Rules:
 - **unused-local** — a function-local bound by plain assignment and
   never read (the second pyflakes staple). Loop/with/unpack targets and
   ``_``-prefixed names are exempt.
-- **untyped-def** — a PUBLIC module- or class-level function in the
-  package (``socceraction_tpu/``) missing a parameter or return
-  annotation: the statically-checkable slice of the reference's
-  ``disallow_untyped_defs`` mypy gate, enforced without mypy. Nested
-  helpers, ``_private`` defs, ``self``/``cls`` and ``*args``/``**kwargs``
-  are exempt; tests/tools/benchmarks are out of scope like the
-  reference's mypy gate (``[tool.mypy]`` covers the package only).
+- **untyped-def** — a module- or class-level function in the package
+  (``socceraction_tpu/``) missing a parameter or return annotation:
+  the statically-checkable slice of the ``disallow_untyped_defs`` /
+  ``disallow_incomplete_defs`` mypy gate, enforced without mypy.
+  Private (``_``-prefixed) and dunder defs are checked too — the
+  package ships ``py.typed``, so the typed surface is the whole
+  package, not just its public names. Nested helpers, ``self``/``cls``
+  and ``*args``/``**kwargs`` stay exempt; tests/tools/benchmarks are
+  out of scope like the mypy gate (``[tool.mypy]`` covers the package
+  only).
 - **unused-import** — a name imported at module level and never
   referenced (``__init__.py`` re-exports are exempt when listed in
   ``__all__`` or imported with ``from x import y as y``).
@@ -418,12 +421,16 @@ def check_scopes(tree: ast.Module, path: str) -> List[str]:
 
 
 def check_untyped_defs(tree: ast.Module, path: str) -> List[str]:
-    """Public top-level/class-level defs must carry full annotations."""
+    """Top-level/class-level defs must carry full annotations.
+
+    Private (``_``-prefixed) and dunder defs are checked like public
+    ones: the package ships a ``py.typed`` marker, so ``[tool.mypy]``
+    runs with ``disallow_untyped_defs`` over everything — this gate is
+    its dependency-free floor and must draw the same line.
+    """
     problems: List[str] = []
 
     def check_def(node, owner: str = '') -> None:
-        if node.name.startswith('_'):
-            return
         a = node.args
         named = [x for x in a.posonlyargs + a.args + a.kwonlyargs
                  if x.arg not in ('self', 'cls')]
@@ -432,7 +439,7 @@ def check_untyped_defs(tree: ast.Module, path: str) -> List[str]:
             missing.append('return')
         if missing:
             problems.append(
-                f'{path}:{node.lineno}: untyped public def '
+                f'{path}:{node.lineno}: untyped def '
                 f'{owner}{node.name}() (missing: {", ".join(missing)})'
             )
 

@@ -1243,9 +1243,14 @@ def _fmt_promotion(event: Dict[str, Any]) -> str:
             f'{replay.get("actions", "?")} action(s) '
             f'from {replay.get("source", "?")}'
         )
+    archs = event.get('archs') or {}
     for head, entry in sorted((event.get('heads') or {}).items()):
         cand = entry.get('candidate') or {}
-        parts = [f'  {head.ljust(9)}: ece {cand.get("ece", float("nan")):.4f}']
+        # per-head architecture tag: an mlp and a seq candidate pass the
+        # same gates but are different programs — the verdict line says
+        # which kind was judged
+        label = f'{head} [{archs[head]}]' if head in archs else head
+        parts = [f'  {label.ljust(9)}: ece {cand.get("ece", float("nan")):.4f}']
         ci = cand.get('ece_ci')
         if ci:
             parts.append(f'ci [{ci[0]:.4f}, {ci[1]:.4f}]')
@@ -1255,6 +1260,11 @@ def _fmt_promotion(event: Dict[str, Any]) -> str:
         if 'delta_brier' in entry:
             parts.append(f'Δbrier {entry["delta_brier"]:+.4f}')
         lines.append('  '.join(parts))
+    if archs and not event.get('heads'):
+        # rejected-before-shadow reports carry no per-head metrics but
+        # still say what was judged
+        rendered = ' '.join(f'{h}={a}' for h, a in sorted(archs.items()))
+        lines.append(f'  archs  : {rendered}')
     for reason in event.get('reasons') or []:
         lines.append(f'  reason : {reason}')
     return '\n'.join(lines)
